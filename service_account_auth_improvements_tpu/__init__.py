@@ -1,0 +1,23 @@
+"""service_account_auth_improvements_tpu — a TPU-native notebook platform.
+
+A from-scratch, TPU-first re-imagining of the Kubeflow platform components
+monorepo (surveyed in /root/repo/SURVEY.md). Two halves:
+
+* **Control plane** (`controlplane/`, `webhook/`, `webapps/`): Kubernetes
+  controllers, admission webhook, and backend-for-frontend APIs that land
+  Notebook CRs on Cloud TPU slices — emitting ``google.com/tpu`` resource
+  limits and GKE TPU topology node selectors (never ``nvidia.com/gpu``).
+  Level-triggered reconciliation over the K8s API, the reference's one
+  load-bearing architectural idea (reference:
+  components/notebook-controller/controllers/notebook_controller.go:89).
+
+* **Workload layer** (`models/`, `ops/`, `parallel/`, `train/`): the JAX/XLA
+  SPMD training stack those notebooks run — Llama-3 family models under
+  pjit over a ``jax.sharding.Mesh`` (dp/fsdp/tp/sp/ep axes), Pallas TPU
+  kernels for the hot ops, ring attention for long context, and a training
+  loop with MFU accounting targeting >=35% MFU (BASELINE.md).
+
+Import as ``import service_account_auth_improvements_tpu as satpu``.
+"""
+
+__version__ = "0.1.0"

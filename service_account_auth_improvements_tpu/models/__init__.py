@@ -1,0 +1,9 @@
+"""Model zoo for the TPU workload layer.
+
+Flagship: Llama-3 family (``llama.py``) — the BASELINE.md north-star workload
+(Llama-3-8B SPMD fine-tune at >=35% MFU). ResNet-50 (pmap config #3 in
+BASELINE.json) and an MNIST MLP (CPU smoke config #1) land with the
+model-zoo milestone.
+"""
+
+from service_account_auth_improvements_tpu.models import llama  # noqa: F401

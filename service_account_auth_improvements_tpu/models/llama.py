@@ -1,0 +1,227 @@
+"""Llama-3 family decoder-only transformer, TPU-first.
+
+Pure functional JAX: parameters are a plain pytree of arrays, the forward
+pass is a function, and parallelism comes entirely from logical-axis
+sharding rules (parallel/sharding.py) resolved under a ``jax.sharding.Mesh``
+— dp/fsdp data parallel, tp over heads/mlp, sp ring attention. Layers are
+stacked and iterated with ``lax.scan`` (one trace, one HLO body, fast
+compiles at 32+ layers) with optional ``jax.checkpoint`` rematerialization.
+Compute in bf16, softmax/norm statistics in fp32, master params fp32.
+
+This is the in-notebook workload the control plane exists to land on a TPU
+slice (BASELINE.json north star); the reference itself has no model code —
+its GPU surface is a ``nvidia.com/gpu`` limits key (reference:
+components/crud-web-apps/jupyter/backend/apps/common/form.py:226-252).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from service_account_auth_improvements_tpu.ops.attention import multi_head_attention
+from service_account_auth_improvements_tpu.ops.norms import rms_norm
+from service_account_auth_improvements_tpu.ops.rotary import apply_rope, rope_table
+from service_account_auth_improvements_tpu.parallel.sharding import shard_constraint
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128_256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    mlp_dim: int = 14_336
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: str = "bfloat16"          # activation/compute dtype
+    param_dtype: str = "float32"     # master parameter dtype
+    remat: bool = True
+    scan_layers: bool = True
+    attn_impl: str = "dense"         # dense | flash (ring lands with parallel/ring.py)
+
+    @property
+    def q_dim(self) -> int:
+        return self.n_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.n_kv_heads * self.head_dim
+
+    def param_count(self) -> int:
+        per_layer = (
+            2 * self.dim  # norms
+            + self.dim * self.q_dim  # wq
+            + 2 * self.dim * self.kv_dim  # wk, wv
+            + self.q_dim * self.dim  # wo
+            + 3 * self.dim * self.mlp_dim  # gate, up, down
+        )
+        return (
+            self.vocab_size * self.dim  # tok_embed
+            + self.n_layers * per_layer
+            + self.dim  # final norm
+            + self.dim * self.vocab_size  # lm_head
+        )
+
+    def matmul_param_count(self) -> int:
+        """Params that participate in matmuls — excludes the token-embedding
+        table (a gather, no FLOPs) but keeps the lm_head projection, per
+        standard (PaLM-style) MFU accounting."""
+        return self.param_count() - self.vocab_size * self.dim
+
+    def flops_per_token(self, seq_len: int | None = None) -> int:
+        """Approx training FLOPs/token: 6×(matmul params), plus the causal
+        attention-score term 12·L·s·H·d_head·(1/2) when ``seq_len`` given."""
+        flops = 6 * self.matmul_param_count()
+        if seq_len:
+            # qk^T + av, fwd+bwd (×3 fwd-equivalent ×2), causal halves it.
+            flops += 6 * self.n_layers * self.n_heads * self.head_dim * seq_len
+        return flops
+
+
+# Geometry notes: 8B/70B follow the published Llama-3 shapes; 1b follows
+# Llama-3.2-1B; "tiny"/"smoke" are CI-sized.
+PRESETS: dict[str, LlamaConfig] = {
+    "tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, mlp_dim=128, max_seq_len=128, rope_theta=10_000.0,
+    ),
+    "smoke": LlamaConfig(
+        vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=16, mlp_dim=256, max_seq_len=256, rope_theta=10_000.0,
+    ),
+    "llama3_1b": LlamaConfig(
+        vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        head_dim=64, mlp_dim=8192, max_seq_len=8192,
+    ),
+    "llama3_8b": LlamaConfig(),
+    "llama3_70b": LlamaConfig(
+        dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, head_dim=128,
+        mlp_dim=28_672,
+    ),
+}
+
+
+def logical_axes(cfg: LlamaConfig):
+    """Pytree (same structure as params) of logical-axis tuples."""
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "layers": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads"),
+            "wk": ("layers", "embed", "kv_heads"),
+            "wv": ("layers", "embed", "kv_heads"),
+            "wo": ("layers", "heads", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init(cfg: LlamaConfig, key: jax.Array):
+    """Initialize master params (param_dtype). Residual-out projections are
+    scaled down by 1/sqrt(2·n_layers) for depth-stable variance."""
+    pdt = jnp.dtype(cfg.param_dtype)
+    keys = iter(jax.random.split(key, 16))
+
+    def normal(key, shape, std):
+        return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
+
+    L = cfg.n_layers
+    std = 0.02
+    out_std = 0.02 / (2 * L) ** 0.5
+    params = {
+        "tok_embed": normal(next(keys), (cfg.vocab_size, cfg.dim), std),
+        "layers": {
+            "attn_norm": jnp.ones((L, cfg.dim), pdt),
+            "wq": normal(next(keys), (L, cfg.dim, cfg.q_dim), std),
+            "wk": normal(next(keys), (L, cfg.dim, cfg.kv_dim), std),
+            "wv": normal(next(keys), (L, cfg.dim, cfg.kv_dim), std),
+            "wo": normal(next(keys), (L, cfg.q_dim, cfg.dim), out_std),
+            "mlp_norm": jnp.ones((L, cfg.dim), pdt),
+            "w_gate": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
+            "w_up": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
+            "w_down": normal(next(keys), (L, cfg.mlp_dim, cfg.dim), out_std),
+        },
+        "final_norm": jnp.ones((cfg.dim,), pdt),
+        "lm_head": normal(next(keys), (cfg.dim, cfg.vocab_size), std),
+    }
+    return params
+
+
+def _layer(cfg: LlamaConfig, x, lp, cos, sin):
+    """One decoder block. x: [b, s, dim] in compute dtype."""
+    b, s, _ = x.shape
+    cdt = jnp.dtype(cfg.dtype)
+
+    h = rms_norm(x, lp["attn_norm"].astype(cdt), cfg.norm_eps)
+    q = (h @ lp["wq"].astype(cdt)).reshape(b, s, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ lp["wv"].astype(cdt)).reshape(b, s, cfg.n_kv_heads, cfg.head_dim)
+    q = shard_constraint(q, ("batch", "seq", "heads", None))
+    k = shard_constraint(k, ("batch", "seq", "kv_heads", None))
+    q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    attn = multi_head_attention(q, k, v, impl=cfg.attn_impl)
+    x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"].astype(cdt)
+
+    h = rms_norm(x, lp["mlp_norm"].astype(cdt), cfg.norm_eps)
+    gate = jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+    up = h @ lp["w_up"].astype(cdt)
+    ff = shard_constraint(gate * up, ("batch", "seq", "mlp"))
+    x = x + ff @ lp["w_down"].astype(cdt)
+    return shard_constraint(x, ("batch", "seq", None))
+
+
+def apply(cfg: LlamaConfig, params, tokens: jax.Array) -> jax.Array:
+    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32."""
+    cdt = jnp.dtype(cfg.dtype)
+    s = tokens.shape[1]
+    # mode="clip": out-of-range ids clamp instead of NaN-filling (jnp default)
+    # — avoids silent NaN-poisoning of a run and the fill-select on the hot path.
+    x = jnp.take(params["tok_embed"], tokens, axis=0, mode="clip").astype(cdt)
+    x = shard_constraint(x, ("batch", "seq", None))
+    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
+
+    layer_fn = partial(_layer, cfg)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, static_argnums=())
+    if cfg.scan_layers:
+        x, _ = jax.lax.scan(
+            lambda carry, lp: (layer_fn(carry, lp, cos, sin), None),
+            x,
+            params["layers"],
+        )
+    else:
+        for i in range(cfg.n_layers):
+            lp = jax.tree.map(lambda a: a[i], params["layers"])
+            x = layer_fn(x, lp, cos, sin)
+
+    x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, params["lm_head"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return shard_constraint(logits, ("batch", "seq", "vocab"))
+
+
+def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
+    """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
+    (1.0 where the *target* position counts)."""
+    logits = apply(cfg, params, tokens[:, :-1])
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        return nll.mean()
+    m = mask[:, 1:].astype(nll.dtype)
+    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)

@@ -1,0 +1,14 @@
+"""Numeric ops: attention (dense / Pallas flash / ring), norms, rotary.
+
+Pure-JAX reference implementations always exist; Pallas TPU kernels are used
+on TPU backends when available, selected at trace time by ``attn_impl``.
+"""
+
+from service_account_auth_improvements_tpu.ops.attention import (  # noqa: F401
+    multi_head_attention,
+)
+from service_account_auth_improvements_tpu.ops.rotary import (  # noqa: F401
+    rope_table,
+    apply_rope,
+)
+from service_account_auth_improvements_tpu.ops.norms import rms_norm  # noqa: F401

@@ -1,0 +1,58 @@
+"""Multi-head attention front-end with pluggable implementations.
+
+``impl``:
+  "dense"  pure-JAX causal softmax attention (reference implementation;
+           XLA already fuses the mask+softmax chain well on TPU).
+  "flash"  Pallas TPU flash-attention kernel (ops/flash_attention.py);
+           falls back to dense off-TPU.
+  "ring"   ring attention over the ``sp`` mesh axis (parallel/ring.py) —
+           wired by the model when sequence parallelism is on.
+
+All impls take q/k/v shaped ``[batch, seq, heads, head_dim]`` (kv may have
+fewer heads — GQA is handled here by logical head-group broadcast, not by
+materializing repeated KV).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0e38
+
+
+def _dense_attention(q, k, v, scale: float, causal: bool = True):
+    """Causal softmax attention with GQA via head-group einsum.
+
+    q: [b, sq, hq, d]; k/v: [b, sk, hkv, d]; hq = hkv * g.
+    Softmax in fp32; logits never materialized in bf16.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    g = hq // hkv
+    q = q.reshape(b, sq, hkv, g, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        q_pos = jnp.arange(sq)[:, None]
+        k_pos = jnp.arange(sk)[None, :]
+        # Supports sk >= sq (kv prefix longer than queries, e.g. ring steps).
+        mask = q_pos + (sk - sq) >= k_pos
+        logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+    return out.reshape(b, sq, hq, d)
+
+
+def multi_head_attention(q, k, v, *, impl: str = "dense", causal: bool = True):
+    """Dispatch attention. Returns ``[b, sq, hq, d]`` in q.dtype."""
+    scale = q.shape[-1] ** -0.5
+    if impl == "flash":
+        from service_account_auth_improvements_tpu.ops.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(q, k, v, causal=causal)
+    if impl != "dense":
+        raise ValueError(f"unknown attention impl {impl!r}")
+    return _dense_attention(q, k, v, scale, causal=causal)

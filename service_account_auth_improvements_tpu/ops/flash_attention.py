@@ -1,0 +1,15 @@
+"""Flash attention: Pallas TPU kernel (pending) with dense fallback.
+
+Round-1 placeholder: always dispatches to the fused dense path; the Pallas
+kernel lands with the ops/ kernel milestone, at which point TPU backends
+get the tiled online-softmax kernel and other backends keep this fallback.
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.ops import attention as _attn
+
+
+def flash_attention(q, k, v, *, causal: bool = True):
+    scale = q.shape[-1] ** -0.5
+    return _attn._dense_attention(q, k, v, scale, causal=causal)

@@ -1,0 +1,35 @@
+"""Rotary position embeddings (RoPE), Llama-3 flavour."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float = 500_000.0):
+    """Precompute (cos, sin) tables, each ``[seq_len, head_dim // 2]`` fp32."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    pos = jnp.arange(seq_len, dtype=jnp.float32)
+    angles = jnp.outer(pos, freqs)
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate ``x`` ``[b, seq, heads, head_dim]`` by position tables.
+
+    Uses the rotate-half (NeoX/contiguous-split) convention. NOTE for
+    checkpoint converters: Meta's Llama weights use the interleaved
+    (GPT-J/complex) convention — converting them to this layout requires
+    permuting wq/wk head_dim lanes (the standard HF-style permutation).
+    Self-trained runs are internally consistent either way.
+    Computation in fp32, result cast back to ``x.dtype``.
+    """
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    # cos/sin: [seq, head_dim/2] -> broadcast over batch and heads.
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

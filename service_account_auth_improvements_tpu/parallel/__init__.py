@@ -1,0 +1,20 @@
+"""SPMD parallelism: device meshes, sharding rules, collectives, multi-host.
+
+The reference contains no ML parallelism machinery (SURVEY.md §2b) — this
+subpackage is the net-new TPU-native surface: a ``jax.sharding.Mesh`` with
+dp/fsdp/tp/sp/ep axes, logical-axis sharding rules resolved to
+``PartitionSpec``s, ring attention for sequence/context parallelism, and
+multi-host bootstrap from the ``TPU_WORKER_*`` env the control plane injects.
+"""
+
+from service_account_auth_improvements_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshConfig,
+    make_mesh,
+)
+from service_account_auth_improvements_tpu.parallel.sharding import (  # noqa: F401
+    DEFAULT_RULES,
+    logical_to_mesh,
+    logical_sharding,
+    shard_constraint,
+)

@@ -1,0 +1,91 @@
+"""Device-mesh construction for SPMD training.
+
+TPU-first design: parallelism is expressed as a named ``jax.sharding.Mesh``
+over which ``jit`` partitions the program, with XLA inserting ICI/DCN
+collectives — not as explicit NCCL/MPI calls (the reference has none either;
+SURVEY.md §2b). Axis order puts data-parallel outermost so that gradient
+all-reduces ride the slowest links and tensor-parallel innermost so its
+all-gathers/reduce-scatters stay on the fastest ICI neighbours — the standard
+mesh layout recipe from the public scaling literature.
+
+Axes:
+  dp    pure data parallel (gradient all-reduce; DCN-friendly across slices)
+  fsdp  data parallel with parameter/optimizer sharding (ZeRO-3 style)
+  tp    tensor (megatron-style) parallel over heads / mlp dim
+  sp    sequence/context parallel (ring attention, `parallel/ring.py`)
+  ep    expert parallel (MoE models)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order, outermost first.
+MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "sp", "tp", "ep")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Per-axis sizes; ``-1`` on at most one axis means "absorb the rest"."""
+
+    dp: int = 1
+    fsdp: int = -1
+    tp: int = 1
+    sp: int = 1
+    ep: int = 1
+
+    def sizes(self) -> dict[str, int]:
+        return {
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "sp": self.sp,
+            "tp": self.tp,
+            "ep": self.ep,
+        }
+
+    def resolve(self, n_devices: int) -> dict[str, int]:
+        """Fill the single ``-1`` axis so the product equals ``n_devices``."""
+        sizes = self.sizes()
+        wild = [k for k, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        fixed = math.prod(v for v in sizes.values() if v != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}"
+                )
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {sizes} wants {fixed} devices but {n_devices} present"
+            )
+        return sizes
+
+
+def make_mesh(
+    config: MeshConfig | None = None, devices: list | None = None
+) -> Mesh:
+    """Build a named Mesh over ``devices`` (default: all local devices).
+
+    Devices are laid out in their natural enumeration order reshaped to the
+    axis sizes; on real TPU slices ``jax.devices()`` enumeration already
+    follows the physical torus so innermost axes land on ICI neighbours.
+    """
+    config = config or MeshConfig()
+    if devices is None:
+        devices = jax.devices()
+    sizes = config.resolve(len(devices))
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh() -> Mesh:
+    """A 1×1×1×1×1 mesh on the first device (bench / single-chip paths)."""
+    return make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1])

@@ -1,0 +1,51 @@
+"""Multi-host bootstrap: from control-plane-injected env to jax.distributed.
+
+The control plane (notebook-controller + PodDefaults webhook) injects
+``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES`` and (multi-slice) ``MEGASCALE_*``
+env into every pod of a multi-host slice — the TPU analog of the reference's
+``NB_PREFIX`` plumbing (reference: components/notebook-controller/controllers/
+notebook_controller.go:345-359). This module is the workload-side consumer:
+call ``maybe_initialize()`` first thing in a training script/notebook and the
+JAX runtime forms the slice.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+COORD_PORT = 8476
+
+
+def worker_env() -> tuple[int, list[str]]:
+    """Parse (worker_id, hostnames) from the injected env; ([0], single) when
+    absent (single-host or CPU dev)."""
+    wid = int(os.environ.get("TPU_WORKER_ID", "0"))
+    hosts_raw = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    hosts = [h.strip() for h in hosts_raw.split(",") if h.strip()]
+    return wid, hosts or ["localhost"]
+
+
+def maybe_initialize() -> int:
+    """Initialize jax.distributed iff the env declares a multi-host slice.
+
+    Returns the process index. Idempotent; safe on single host and CPU.
+    """
+    wid, hosts = worker_env()
+    if len(hosts) <= 1:
+        return 0
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"{hosts[0]}:{COORD_PORT}",
+            num_processes=len(hosts),
+            process_id=wid,
+        )
+    except RuntimeError as e:
+        # Idempotency only: a second initialize in the same process is fine.
+        # A real bootstrap failure (unreachable coordinator, rank mismatch)
+        # must propagate — silently degrading to single-host would deadlock
+        # the rest of the slice in its first collective.
+        if "already initialized" not in str(e).lower():
+            raise
+    return jax.process_index()

@@ -1,0 +1,88 @@
+"""Logical-axis sharding rules → ``PartitionSpec``.
+
+Models annotate arrays with *logical* axis names ("batch", "embed", "heads",
+…); a rule table maps each logical name to zero or more mesh axes. Changing
+the parallelism strategy (pure DP → FSDP → FSDP+TP → +SP) is a rule-table
+edit, not a model edit — the standard pjit recipe (scaling-book mental model;
+net-new vs the reference, SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# logical axis -> mesh axis (or tuple of mesh axes, or None = replicate).
+# "batch" spans dp+fsdp so the global batch divides across both kinds of data
+# parallelism; "embed" is the FSDP parameter shard axis (ZeRO-3: params are
+# gathered per-layer on use); "heads"/"mlp" are the tensor-parallel axes;
+# "seq" is ring-attention sequence parallelism.
+DEFAULT_RULES: dict[str, str | tuple[str, ...] | None] = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": "fsdp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": None,
+    "norm": None,
+}
+
+
+def logical_to_mesh(
+    axes: tuple[str | None, ...],
+    rules: dict | None = None,
+) -> P:
+    """Resolve a tuple of logical axis names to a PartitionSpec."""
+    rules = rules if rules is not None else DEFAULT_RULES
+    spec = []
+    used: set[str] = set()
+    for name in axes:
+        if name is None:
+            spec.append(None)
+            continue
+        mesh_axes = rules.get(name)
+        # A mesh axis may appear only once per spec; later duplicates
+        # degrade to replication (matches flax logical-rules behavior).
+        if mesh_axes is None:
+            spec.append(None)
+            continue
+        flat = (mesh_axes,) if isinstance(mesh_axes, str) else tuple(mesh_axes)
+        fresh = tuple(a for a in flat if a not in used)
+        used.update(fresh)
+        if not fresh:
+            spec.append(None)
+        elif len(fresh) == 1:
+            spec.append(fresh[0])
+        else:
+            spec.append(fresh)
+    return P(*spec)
+
+
+def logical_sharding(
+    mesh: Mesh,
+    axes: tuple[str | None, ...],
+    rules: dict | None = None,
+) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_mesh(axes, rules))
+
+
+def shard_constraint(x, axes: tuple[str | None, ...], rules: dict | None = None):
+    """``with_sharding_constraint`` by logical axes; no-op outside jit/mesh."""
+    if jax.sharding.get_abstract_mesh().empty:
+        # No mesh in scope (e.g. pure-eager unit tests) — leave unconstrained.
+        return x
+    return jax.lax.with_sharding_constraint(x, logical_to_mesh(axes, rules))
+
+
+def tree_logical_sharding(mesh: Mesh, axes_tree, rules: dict | None = None):
+    """Map a pytree of logical-axes tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: logical_sharding(mesh, axes, rules),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple)
+        and all(isinstance(a, (str, type(None))) for a in x),
+    )

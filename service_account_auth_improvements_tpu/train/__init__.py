@@ -1,0 +1,11 @@
+"""Training loop machinery: sharded train step, optimizer, MFU accounting."""
+
+from service_account_auth_improvements_tpu.train.step import (  # noqa: F401
+    TrainState,
+    make_train_step,
+    init_train_state,
+)
+from service_account_auth_improvements_tpu.train.mfu import (  # noqa: F401
+    chip_peak_flops,
+    mfu,
+)

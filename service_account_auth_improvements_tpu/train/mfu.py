@@ -1,0 +1,42 @@
+"""Model FLOPs Utilization accounting.
+
+Peak numbers are public per-chip bf16 figures (cloud.google.com/tpu docs):
+v4 275 TF/s, v5e 197 TF/s, v5p 459 TF/s, v6e 918 TF/s.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_PEAK_BF16 = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "trillium": 918e12,
+}
+
+
+def chip_peak_flops(device=None) -> float:
+    """Best-effort peak bf16 FLOP/s for the attached chip (0 if unknown)."""
+    if device is None:
+        devs = jax.devices()
+        if not devs:
+            return 0.0
+        device = devs[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in _PEAK_BF16.items():
+        if key in kind:
+            return peak
+    return 0.0
+
+
+def mfu(model_flops_per_step: float, step_time_s: float, n_chips: int,
+        peak_per_chip: float | None = None) -> float:
+    """Achieved model FLOPs / peak FLOPs over the step. 0 if peak unknown."""
+    peak = peak_per_chip if peak_per_chip is not None else chip_peak_flops()
+    if not peak or step_time_s <= 0:
+        return 0.0
+    return model_flops_per_step / (step_time_s * n_chips * peak)

@@ -1,0 +1,7 @@
+"""Shared utilities: env-var config, structured logging, metrics registry."""
+
+from service_account_auth_improvements_tpu.utils.env import (  # noqa: F401
+    get_env_default,
+    get_env_bool,
+    get_env_int,
+)

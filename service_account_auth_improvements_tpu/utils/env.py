@@ -1,0 +1,35 @@
+"""Environment-variable configuration helpers.
+
+The reference's controllers configure themselves from env vars with defaults
+(``GetEnvDefault`` — reference: components/notebook-controller/controllers/
+culling_controller.go:385-391, profile_controller.go:792). Same contract here.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def get_env_default(name: str, default: str) -> str:
+    """Return env var ``name`` or ``default`` when unset/empty."""
+    value = os.environ.get(name, "")
+    return value if value else default
+
+
+def get_env_bool(name: str, default: bool = False) -> bool:
+    """Parse a boolean env var; accepts true/1/yes/on (case-insensitive)."""
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    return value.strip().lower() in ("true", "1", "yes", "on")
+
+
+def get_env_int(name: str, default: int) -> int:
+    """Parse an integer env var, falling back to ``default`` on error."""
+    value = os.environ.get(name)
+    if value is None or value == "":
+        return default
+    try:
+        return int(value)
+    except ValueError:
+        return default

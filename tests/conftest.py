@@ -1,0 +1,23 @@
+"""Test harness: force an 8-device virtual CPU platform before first JAX use.
+
+Multi-chip hardware is unavailable in CI; sharding/collective correctness is
+validated on a virtual CPU mesh (the moral equivalent of the reference's
+envtest tier: test the objects/partitions, not the metal — SURVEY.md §4.2).
+
+Note: the environment's sitecustomize may already have *imported* jax to
+register a remote-TPU PJRT plugin, so env vars are too late — we must use
+``jax.config``. Backends are not initialized until first use, so XLA_FLAGS
+set here still takes effect. Export SATPU_TEST_TPU=1 to run on real TPU.
+"""
+
+import os
+
+if not os.environ.get("SATPU_TEST_TPU"):
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

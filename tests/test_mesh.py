@@ -1,0 +1,42 @@
+"""Mesh construction and logical-axis sharding rules."""
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from service_account_auth_improvements_tpu.parallel import (
+    MeshConfig,
+    make_mesh,
+    logical_to_mesh,
+)
+
+
+def test_eight_virtual_devices():
+    assert jax.device_count() == 8
+
+
+def test_mesh_resolve_wildcard():
+    cfg = MeshConfig(dp=2, fsdp=-1, tp=2)
+    sizes = cfg.resolve(8)
+    assert sizes == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2, "ep": 1}
+
+
+def test_mesh_shape():
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
+    assert mesh.shape == {"dp": 1, "fsdp": 4, "sp": 1, "tp": 2, "ep": 1}
+
+
+def test_mesh_rejects_bad_product():
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(dp=3, fsdp=1, tp=1))
+
+
+def test_logical_to_mesh_basic():
+    assert logical_to_mesh(("batch", "seq", None)) == P(("dp", "fsdp"), "sp", None)
+    assert logical_to_mesh(("embed", "heads")) == P("fsdp", "tp")
+
+
+def test_logical_duplicate_mesh_axis_degrades_to_replication():
+    # "heads" and "mlp" both map to tp; the second use must not repeat tp.
+    spec = logical_to_mesh(("heads", "mlp"))
+    assert spec == P("tp", None)
