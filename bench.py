@@ -1,0 +1,106 @@
+"""Headline benchmark: Llama train-step MFU on one TPU chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+The reference publishes no performance numbers (BASELINE.md) — the baseline
+is this project's own north star: >=35% MFU on the Llama training workload.
+``vs_baseline`` is achieved_MFU / 0.35, so 1.0 == target parity.
+
+Runs on the default JAX backend (the tunneled v5e chip under the driver);
+set SATPU_BENCH_PRESET to override the model size, SATPU_BENCH_CPU=1 to
+force the tiny CPU configuration for a smoke run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    if os.environ.get("SATPU_BENCH_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    import jax.numpy as jnp
+
+    from service_account_auth_improvements_tpu.models import llama
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.train import (
+        chip_peak_flops,
+        init_train_state,
+        make_train_step,
+    )
+    from service_account_auth_improvements_tpu.train.step import state_shardings
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    preset = os.environ.get(
+        "SATPU_BENCH_PRESET", "bench_400m" if on_accel else "tiny"
+    )
+    cfg = llama.PRESETS[preset]
+    batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
+    seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
+
+    n_dev = 1  # single-chip headline number
+    mesh = make_mesh(
+        MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1]
+    )
+    state = init_train_state(cfg, jax.random.key(0))
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, mesh=mesh)
+
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
+    )
+    mask = jnp.ones_like(tokens)
+
+    warmup = 2
+    iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
+    with jax.set_mesh(mesh):
+        for _ in range(warmup):
+            state, m = step(state, tokens, mask)
+        jax.block_until_ready(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, tokens, mask)
+        jax.block_until_ready(m["loss"])
+        dt = (time.perf_counter() - t0) / iters
+
+    # The train step consumes seq-1 target positions per row.
+    tokens_per_step = batch * (seq - 1)
+    tok_per_sec = tokens_per_step / dt
+    flops_per_step = cfg.flops_per_token(seq) * tokens_per_step
+    peak = chip_peak_flops()
+    mfu = flops_per_step / (dt * n_dev * peak) if peak else 0.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama_train_tokens_per_sec_per_chip",
+                "value": round(tok_per_sec, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+                "mfu": round(mfu, 4),
+                "preset": preset,
+                "batch": batch,
+                "seq": seq,
+                "step_time_s": round(dt, 4),
+                "backend": jax.default_backend(),
+                "device": getattr(jax.devices()[0], "device_kind", "?"),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

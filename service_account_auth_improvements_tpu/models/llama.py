@@ -96,6 +96,12 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
         head_dim=16, mlp_dim=256, max_seq_len=256, rope_theta=10_000.0,
     ),
+    # ~440M params: sized so fp32 master + Adam moments + bf16 compute fit a
+    # single v5e chip (16 GB HBM) with seq-2048 batches for the MFU bench.
+    "bench_400m": LlamaConfig(
+        vocab_size=32_768, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
+        head_dim=128, mlp_dim=4096, max_seq_len=2048,
+    ),
     "llama3_1b": LlamaConfig(
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         head_dim=64, mlp_dim=8192, max_seq_len=8192,
