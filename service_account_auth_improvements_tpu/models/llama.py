@@ -44,7 +44,7 @@ class LlamaConfig:
     param_dtype: str = "float32"     # master parameter dtype
     remat: bool = True
     scan_layers: bool = True
-    attn_impl: str = "dense"         # dense | flash (ring lands with parallel/ring.py)
+    attn_impl: str = "dense"         # dense | flash | ring (ring needs a mesh)
 
     @property
     def q_dim(self) -> int:

@@ -5,8 +5,8 @@
            XLA already fuses the mask+softmax chain well on TPU).
   "flash"  Pallas TPU flash-attention kernel (ops/flash_attention.py);
            falls back to dense off-TPU.
-  "ring"   ring attention over the ``sp`` mesh axis (parallel/ring.py) —
-           wired by the model when sequence parallelism is on.
+  "ring"   ring attention over the ``sp`` mesh axis (parallel/ring.py);
+           requires a mesh context with dp/fsdp/sp/tp axes (shard_map).
 
 All impls take q/k/v shaped ``[batch, seq, heads, head_dim]`` (kv may have
 fewer heads — GQA is handled here by logical head-group broadcast, not by
@@ -53,6 +53,12 @@ def multi_head_attention(q, k, v, *, impl: str = "dense", causal: bool = True):
         )
 
         return flash_attention(q, k, v, causal=causal)
+    if impl == "ring":
+        from service_account_auth_improvements_tpu.parallel.ring import (
+            ring_attention,
+        )
+
+        return ring_attention(q, k, v, causal=causal)
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}")
     return _dense_attention(q, k, v, scale, causal=causal)

@@ -1,15 +1,329 @@
-"""Flash attention: Pallas TPU kernel (pending) with dense fallback.
+"""Flash attention for TPU: Pallas tiled online-softmax kernels + custom VJP.
 
-Round-1 placeholder: always dispatches to the fused dense path; the Pallas
-kernel lands with the ops/ kernel milestone, at which point TPU backends
-get the tiled online-softmax kernel and other backends keep this fallback.
+Forward and backward are hand-tiled Pallas kernels (MXU-shaped 128-blocks,
+fp32 accumulators in VMEM, logsumexp saved for the backward recompute), with
+a pure-JAX dense fallback for shapes/backends the kernel doesn't cover.
+Layout in-kernel is ``[batch, heads, seq, head_dim]``; the public wrapper
+takes the model's ``[batch, seq, heads, head_dim]``. GQA is handled by the
+kv-head index map (no KV repetition in memory).
+
+Kernel playbook per /opt/skills/guides/pallas_guide.md. The reference repo
+has no kernels at all (its accelerator surface is a resource-limits string,
+SURVEY.md §2b) — this file is net-new TPU surface.
 """
 
 from __future__ import annotations
 
-from service_account_auth_improvements_tpu.ops import attention as _attn
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -2.0e38
+
+BLOCK_Q = 128
+BLOCK_K = 128
 
 
-def flash_attention(q, k, v, *, causal: bool = True):
-    scale = q.shape[-1] ** -0.5
-    return _attn._dense_attention(q, k, v, scale, causal=causal)
+def _use_pallas(q, k) -> bool:
+    if q.dtype not in (jnp.bfloat16, jnp.float32):
+        return False
+    sq, d = q.shape[1], q.shape[-1]
+    sk = k.shape[1]
+    if d % 64 != 0:
+        return False
+    if sq % BLOCK_Q or sk % BLOCK_K:
+        return False
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # pragma: no cover - backend probe only
+        return False
+
+
+# ---------------------------------------------------------------- forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk):
+    """One (batch, head, q-block) program: online softmax over kv blocks.
+
+    q_ref [1,1,bq,d]; k_ref/v_ref [1,1,sk,d]; o_ref [1,1,bq,d];
+    lse_ref [1,1,bq].
+    """
+    iq = pl.program_id(2)
+    bq = q_ref.shape[2]
+    d = q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32) * scale
+
+    nkv_total = sk // BLOCK_K
+    if causal:
+        nkv = jnp.minimum(((iq + 1) * bq + BLOCK_K - 1) // BLOCK_K, nkv_total)
+    else:
+        nkv = nkv_total
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [bq, bk]
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 0
+            )
+            cols = j * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nkv, body, (acc0, m0, l0))
+
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = (m[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, *, causal, interpret=False):
+    """q [b,h,sq,d]; k/v [b,hkv,sk,d] → (o [b,h,sq,d], lse [b,h,sq])."""
+    b, h, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    g = h // hkv
+    scale = d ** -0.5
+    grid = (b, h, sq // BLOCK_Q)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, sk=sk)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ---------------------------------------------------------------- backward
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale, causal, sk):
+    iq = pl.program_id(2)
+    bq = q_ref.shape[2]
+    d = q_ref.shape[3]
+    q = q_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0][:, None]
+    delta = delta_ref[0, 0][:, None]
+
+    nkv_total = sk // BLOCK_K
+    if causal:
+        nkv = jnp.minimum(((iq + 1) * bq + BLOCK_K - 1) // BLOCK_K, nkv_total)
+    else:
+        nkv = nkv_total
+
+    def body(j, dq):
+        kb = k_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        vb = v_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 0
+            )
+            cols = j * BLOCK_K + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, BLOCK_K), 1
+            )
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, nkv, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, sq, g):
+    ik = pl.program_id(2)
+    bk = k_ref.shape[2]
+    d = k_ref.shape[3]
+    kb = k_ref[0, 0].astype(jnp.float32)
+    vb = v_ref[0, 0].astype(jnp.float32)
+
+    nq_total = sq // BLOCK_Q
+    iq0 = (ik * bk) // BLOCK_Q if causal else 0
+    # Sum over the group of q-heads sharing this kv head, then q blocks.
+    def head_body(hg, carry):
+        dk, dv = carry
+
+        def body(i, carry2):
+            dk, dv = carry2
+            qb = q_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
+                jnp.float32
+            )
+            dob = do_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
+                jnp.float32
+            )
+            lseb = lse_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+            deltab = delta_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
+            s = jax.lax.dot_general(
+                qb, kb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale
+            if causal:
+                rows = i * BLOCK_Q + jax.lax.broadcasted_iota(
+                    jnp.int32, (BLOCK_Q, bk), 0
+                )
+                cols = ik * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (BLOCK_Q, bk), 1
+                )
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lseb)
+            dv2 = dv + jax.lax.dot_general(
+                p, dob, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                dob, vb, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - deltab) * scale
+            dk2 = dk + jax.lax.dot_general(
+                ds, qb, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk2, dv2
+
+        return jax.lax.fori_loop(iq0, nq_total, body, (dk, dv))
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, g, head_body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
+    b, h, sq, d = q.shape
+    _, hkv, sk, _ = k.shape
+    g = h // hkv
+    scale = d ** -0.5
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal, sk=sk),
+        grid=(b, h, sq // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
+            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal, sq=sq, g=g
+        ),
+        grid=(b, hkv, sk // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, g, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, g, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
+            pl.BlockSpec((1, g, sq), lambda ib, ih, ik: (ib, ih, 0)),
+            pl.BlockSpec((1, g, sq), lambda ib, ih, ik: (ib, ih, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------- public entry
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, interpret):
+    o, _ = _flash_fwd(q, k, v, causal=causal, interpret=interpret)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, causal, interpret):
+    o, lse = _flash_fwd(q, k, v, causal=causal, interpret=interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(causal, interpret, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, o, lse, do, causal=causal, interpret=interpret
+    )
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, interpret: bool | None = None):
+    """Public wrapper: q [b,sq,h,d], k/v [b,sk,hkv,d] → [b,sq,h,d].
+
+    Uses the Pallas kernels when the backend is TPU and shapes are
+    block-aligned; falls back to the fused dense path otherwise. Set
+    ``interpret=True`` to force the kernels through the Pallas interpreter
+    (CPU correctness tests).
+    """
+    from service_account_auth_improvements_tpu.ops import attention as _attn
+
+    force = interpret is not None
+    if not force and not _use_pallas(q, k):
+        scale = q.shape[-1] ** -0.5
+        return _attn._dense_attention(q, k, v, scale, causal=causal)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o = _flash(qt, kt, vt, causal, bool(interpret))
+    return jnp.swapaxes(o, 1, 2)

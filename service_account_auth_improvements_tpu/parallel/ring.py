@@ -1,0 +1,133 @@
+"""Ring attention: exact long-context attention over the ``sp`` mesh axis.
+
+Each device holds a contiguous sequence chunk of q/k/v. KV chunks rotate
+around the ring via ``ppermute`` (ICI neighbour exchange); every step each
+device computes attention of its q chunk against the visiting kv chunk and
+folds the result into a running online-softmax state — numerically exact,
+with peak memory O(seq/num_devices). Causality falls out of the *global*
+position mask (a kv chunk entirely in the future contributes -inf rows and
+is a numeric no-op), so there is no data-dependent control flow — the whole
+ring is one traced ``lax.scan`` body repeated n times, XLA overlapping the
+ppermute with compute.
+
+Net-new TPU surface (SURVEY.md §5 "long-context / sequence parallelism:
+absent" in the reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+NEG_INF = -2.0e38
+
+
+def _chunk_attention_with_lse(q, k, v, q_off, k_off, scale):
+    """Dense attention of a q chunk vs one kv chunk with GLOBAL causal mask.
+
+    q [b,sq,h,d]; k/v [b,sk,hkv,d]; offsets are global sequence positions of
+    element 0. Returns (out [b,sq,h,d] fp32-normalized, lse [b,sq,h] fp32);
+    rows with no visible keys come back as (0, -inf) and merge as no-ops.
+    """
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    s = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    q_pos = q_off + jnp.arange(sq)[:, None]
+    k_pos = k_off + jnp.arange(sk)[None, :]
+    mask = q_pos >= k_pos
+    s = jnp.where(mask[None, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    # Fully-masked rows: keep exp at 0, lse at -inf (avoid NaN from -inf - -inf).
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v).astype(
+        jnp.float32
+    )
+    # Normalize: l is [b,hkv,g,sq,1] → align to o [b,sq,hkv,g,d]
+    l_t = jnp.transpose(l[..., 0], (0, 3, 1, 2))[..., None]
+    o = o.reshape(b, sq, hkv, g, d) / jnp.maximum(l_t, 1e-30)
+    lse = jnp.where(
+        m[..., 0] <= NEG_INF / 2, NEG_INF, m[..., 0] + jnp.log(l[..., 0])
+    )
+    lse_t = jnp.transpose(lse, (0, 3, 1, 2)).reshape(b, sq, hq)
+    return o.reshape(b, sq, hq, d), lse_t
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Fold two normalized partial attentions (log-sum-exp weighted)."""
+    m = jnp.maximum(lse1, lse2)
+    m_safe = jnp.where(m <= NEG_INF, 0.0, m)
+    w1 = jnp.where(lse1 <= NEG_INF, 0.0, jnp.exp(lse1 - m_safe))
+    w2 = jnp.where(lse2 <= NEG_INF, 0.0, jnp.exp(lse2 - m_safe))
+    tot = jnp.maximum(w1 + w2, 1e-30)
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / tot[..., None]
+    lse = jnp.where(
+        jnp.maximum(lse1, lse2) <= NEG_INF, NEG_INF, m_safe + jnp.log(tot)
+    )
+    return o, lse
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = "sp",
+                         causal: bool = True):
+    """Ring attention body — call INSIDE shard_map, on per-device chunks.
+
+    q/k/v local chunks [b, s_local, h(kv), d], contiguous split of the global
+    sequence along ``axis_name``. Returns the local output chunk in q.dtype.
+    ``causal=False`` is expressed by a -inf-free mask (offsets ignored).
+    """
+    n = jax.lax.axis_size(axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, sq, hq, d = q.shape
+    scale = d ** -0.5
+    s_local = k.shape[1]
+    q_off = idx * sq
+
+    def step(carry, step_i):
+        o, lse, kc, vc = carry
+        j = (idx - step_i) % n
+        k_off = jnp.where(causal, j * s_local, q_off - 10**9)
+        oj, lsej = _chunk_attention_with_lse(q, kc, vc, q_off, k_off, scale)
+        o, lse = _merge(o, lse, oj, lsej)
+        kc = jax.lax.ppermute(
+            kc, axis_name, [(i, (i + 1) % n) for i in range(n)]
+        )
+        vc = jax.lax.ppermute(
+            vc, axis_name, [(i, (i + 1) % n) for i in range(n)]
+        )
+        return (o, lse, kc, vc), None
+
+    # Derive the initial state from q so it carries q's varying-axes type
+    # (a plain zeros const would be device-invariant and fail scan's VMA check).
+    o0 = jnp.zeros_like(q, dtype=jnp.float32)
+    lse0 = jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32)
+    (o, lse, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(n)
+    )
+    return o.astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, causal: bool = True, axis_name: str = "sp",
+                   batch_axes=("dp", "fsdp"), head_axis: str = "tp",
+                   kv_head_axis: str | None = None):
+    """Sharded entry: wraps ``ring_attention_local`` in shard_map over the
+    context mesh. q [b,s,hq,d], k/v [b,s,hkv,d] with seq sharded on
+    ``axis_name``; batch on ``batch_axes``; heads on ``head_axis``."""
+    kv_head_axis = kv_head_axis or head_axis
+    spec_q = P(tuple(batch_axes), axis_name, head_axis, None)
+    spec_kv = P(tuple(batch_axes), axis_name, kv_head_axis, None)
+    fn = functools.partial(
+        ring_attention_local, axis_name=axis_name, causal=causal
+    )
+    return jax.shard_map(
+        fn,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+    )(q, k, v)
