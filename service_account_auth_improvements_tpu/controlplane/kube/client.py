@@ -1,0 +1,190 @@
+"""Stdlib-only Kubernetes REST client (the production transport).
+
+Speaks the same interface as ``FakeKube`` so controllers are
+transport-agnostic. In-cluster config (service-account token + CA) or
+explicit base URL; chunked watch streaming over persistent connections.
+The reference reaches the API through client-go / the official Python
+client; zero-dependency rebuild uses ``http.client`` directly.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import http.client
+from urllib.parse import urlencode, urlsplit
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.kube.registry import (
+    DEFAULT_REGISTRY,
+    Registry,
+    Resource,
+)
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class KubeClient:
+    def __init__(self, base_url: str | None = None, token: str | None = None,
+                 ca_file: str | None = None, registry: Registry | None = None,
+                 insecure: bool = False):
+        self.registry = registry or DEFAULT_REGISTRY
+        if base_url is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "no base_url and not in-cluster "
+                    "(KUBERNETES_SERVICE_HOST unset)"
+                )
+            base_url = f"https://{host}:{port}"
+            token_path = os.path.join(SA_DIR, "token")
+            if token is None and os.path.exists(token_path):
+                with open(token_path) as f:
+                    token = f.read().strip()
+            ca = os.path.join(SA_DIR, "ca.crt")
+            if ca_file is None and os.path.exists(ca):
+                ca_file = ca
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        split = urlsplit(self.base_url)
+        self._host = split.hostname
+        self._port = split.port or (443 if split.scheme == "https" else 80)
+        self._https = split.scheme == "https"
+        if self._https:
+            if insecure:
+                self._ctx = ssl._create_unverified_context()
+            else:
+                self._ctx = ssl.create_default_context(cafile=ca_file)
+        else:
+            self._ctx = None
+
+    # ---------------------------------------------------------- transport
+
+    def _conn(self, timeout: float | None = 30) -> http.client.HTTPConnection:
+        if self._https:
+            return http.client.HTTPSConnection(
+                self._host, self._port, context=self._ctx, timeout=timeout
+            )
+        return http.client.HTTPConnection(
+            self._host, self._port, timeout=timeout
+        )
+
+    def _headers(self, extra=None) -> dict:
+        h = {"Accept": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        if extra:
+            h.update(extra)
+        return h
+
+    def _request(self, method: str, path: str, query: dict | None = None,
+                 body=None, content_type: str = "application/json"):
+        q = urlencode({k: v for k, v in (query or {}).items() if v})
+        url = path + ("?" + q if q else "")
+        conn = self._conn()
+        try:
+            payload = json.dumps(body).encode() if body is not None else None
+            headers = self._headers(
+                {"Content-Type": content_type} if payload else None
+            )
+            conn.request(method, url, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 400:
+                try:
+                    raise errors.ApiError.from_status(json.loads(data))
+                except (ValueError, KeyError):
+                    err = errors.ApiError(data.decode(errors="replace"))
+                    err.code = resp.status
+                    raise err
+            return json.loads(data) if data else None
+        finally:
+            conn.close()
+
+    # ----------------------------------------------------------- interface
+
+    def _res(self, plural: str, group: str | None) -> Resource:
+        return self.registry.by_plural(plural, group)
+
+    def create(self, plural, obj, namespace=None, group=None):
+        res = self._res(plural, group)
+        ns = namespace or (obj.get("metadata") or {}).get("namespace")
+        return self._request("POST", res.path(ns), body=obj)
+
+    def get(self, plural, name, namespace=None, group=None):
+        res = self._res(plural, group)
+        return self._request("GET", res.path(namespace, name))
+
+    def list(self, plural, namespace=None, label_selector="",
+             field_selector="", group=None):
+        res = self._res(plural, group)
+        return self._request(
+            "GET", res.path(namespace),
+            query={
+                "labelSelector": label_selector,
+                "fieldSelector": field_selector,
+            },
+        )
+
+    def update(self, plural, obj, namespace=None, group=None,
+               subresource=None):
+        res = self._res(plural, group)
+        meta = obj.get("metadata") or {}
+        ns = namespace or meta.get("namespace")
+        path = res.path(ns, meta.get("name"))
+        if subresource:
+            path += f"/{subresource}"
+        return self._request("PUT", path, body=obj)
+
+    def update_status(self, plural, obj, namespace=None, group=None):
+        return self.update(plural, obj, namespace, group, subresource="status")
+
+    def patch(self, plural, name, patch, namespace=None, group=None,
+              patch_type="merge"):
+        res = self._res(plural, group)
+        ctype = (
+            "application/json-patch+json" if patch_type == "json"
+            else "application/merge-patch+json"
+        )
+        return self._request(
+            "PATCH", res.path(namespace, name), body=patch,
+            content_type=ctype,
+        )
+
+    def delete(self, plural, name, namespace=None, group=None):
+        res = self._res(plural, group)
+        return self._request("DELETE", res.path(namespace, name))
+
+    def watch(self, plural, namespace=None, resource_version=0, group=None,
+              timeout: float | None = 30):
+        """Generator of watch events; one streaming HTTP request."""
+        res = self._res(plural, group)
+        q = urlencode({
+            "watch": "true",
+            "resourceVersion": str(resource_version or 0),
+            "timeoutSeconds": str(int(timeout or 30)),
+        })
+        conn = self._conn(timeout=(timeout or 30) + 10)
+        try:
+            conn.request(
+                "GET", res.path(namespace) + "?" + q,
+                headers=self._headers(),
+            )
+            resp = conn.getresponse()
+            if resp.status >= 400:
+                data = resp.read()
+                raise errors.ApiError.from_status(json.loads(data))
+            buf = b""
+            while True:
+                chunk = resp.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, _, buf = buf.partition(b"\n")
+                    if line.strip():
+                        yield json.loads(line)
+        finally:
+            conn.close()

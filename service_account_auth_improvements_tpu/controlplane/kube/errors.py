@@ -1,0 +1,72 @@
+"""K8s-style API errors (Status codes mirrored onto Python exceptions)."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+    def to_status(self) -> dict:
+        return {
+            "kind": "Status",
+            "apiVersion": "v1",
+            "status": "Failure",
+            "message": self.message,
+            "reason": self.reason,
+            "code": self.code,
+        }
+
+    @staticmethod
+    def from_status(status: dict) -> "ApiError":
+        code = status.get("code", 500)
+        msg = status.get("message", "")
+        for cls in (NotFound, Conflict, AlreadyExists, BadRequest, Forbidden,
+                    Invalid):
+            if cls.code == code and (
+                cls.reason == status.get("reason") or cls is NotFound
+            ):
+                return cls(msg)
+        err = ApiError(msg)
+        err.code = code
+        return err
+
+
+class NotFound(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExists(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class Conflict(ApiError):
+    code = 409
+    reason = "Conflict"
+
+
+class BadRequest(ApiError):
+    code = 400
+    reason = "BadRequest"
+
+
+class Forbidden(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+class Invalid(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+def is_not_found(e: Exception) -> bool:
+    """The reconciler idiom (reference: components/notebook-controller/
+    controllers/notebook_controller.go:61-71 ignoreNotFound)."""
+    return isinstance(e, NotFound)

@@ -1,0 +1,109 @@
+"""Resource registry: the API-surface map of the control plane.
+
+Maps plural resource names to (group, version, kind, namespaced) — the
+information needed to build REST paths and to seed the fake API server.
+Includes the core/apps/rbac/istio kinds the controllers write plus this
+framework's own CRDs (the TPU-native analogs of the reference CRDs:
+notebooks/profiles/poddefaults/tensorboards/pvcviewers — SURVEY.md §1 L0).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+GROUP = "tpukf.dev"  # this framework's CRD API group
+
+
+@dataclasses.dataclass(frozen=True)
+class Resource:
+    group: str          # "" for core
+    version: str
+    kind: str
+    plural: str
+    namespaced: bool = True
+
+    @property
+    def api_version(self) -> str:
+        return f"{self.group}/{self.version}" if self.group else self.version
+
+    def path(self, namespace: str | None = None, name: str | None = None) -> str:
+        base = (
+            f"/api/{self.version}" if not self.group
+            else f"/apis/{self.group}/{self.version}"
+        )
+        parts = [base]
+        if self.namespaced and namespace:
+            parts.append(f"namespaces/{namespace}")
+        parts.append(self.plural)
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+
+_BUILTIN = [
+    Resource("", "v1", "Pod", "pods"),
+    Resource("", "v1", "Service", "services"),
+    Resource("", "v1", "Namespace", "namespaces", namespaced=False),
+    Resource("", "v1", "Event", "events"),
+    Resource("", "v1", "ConfigMap", "configmaps"),
+    Resource("", "v1", "Secret", "secrets"),
+    Resource("", "v1", "ServiceAccount", "serviceaccounts"),
+    Resource("", "v1", "PersistentVolumeClaim", "persistentvolumeclaims"),
+    Resource("", "v1", "ResourceQuota", "resourcequotas"),
+    Resource("", "v1", "Node", "nodes", namespaced=False),
+    Resource("apps", "v1", "StatefulSet", "statefulsets"),
+    Resource("apps", "v1", "Deployment", "deployments"),
+    Resource("rbac.authorization.k8s.io", "v1", "Role", "roles"),
+    Resource("rbac.authorization.k8s.io", "v1", "RoleBinding", "rolebindings"),
+    Resource("rbac.authorization.k8s.io", "v1", "ClusterRole", "clusterroles",
+             namespaced=False),
+    Resource("rbac.authorization.k8s.io", "v1", "ClusterRoleBinding",
+             "clusterrolebindings", namespaced=False),
+    Resource("storage.k8s.io", "v1", "StorageClass", "storageclasses",
+             namespaced=False),
+    # Istio networking/security (the reference treats these as external CRDs).
+    Resource("networking.istio.io", "v1beta1", "VirtualService",
+             "virtualservices"),
+    Resource("security.istio.io", "v1beta1", "AuthorizationPolicy",
+             "authorizationpolicies"),
+    # This framework's CRDs.
+    Resource(GROUP, "v1beta1", "Notebook", "notebooks"),
+    Resource(GROUP, "v1", "Profile", "profiles", namespaced=False),
+    Resource(GROUP, "v1alpha1", "PodDefault", "poddefaults"),
+    Resource(GROUP, "v1alpha1", "Tensorboard", "tensorboards"),
+    Resource(GROUP, "v1alpha1", "PVCViewer", "pvcviewers"),
+]
+
+
+class Registry:
+    def __init__(self, resources=()):
+        self._by_plural: dict[tuple[str, str], Resource] = {}
+        self._by_kind: dict[tuple[str, str], Resource] = {}
+        for r in resources:
+            self.add(r)
+
+    def add(self, r: Resource) -> None:
+        self._by_plural[(r.group, r.plural)] = r
+        self._by_kind[(r.group, r.kind)] = r
+
+    def by_plural(self, plural: str, group: str | None = None) -> Resource:
+        if group is not None:
+            return self._by_plural[(group, plural)]
+        matches = [r for (g, p), r in self._by_plural.items() if p == plural]
+        if len(matches) != 1:
+            raise KeyError(f"ambiguous or unknown plural {plural!r}")
+        return matches[0]
+
+    def by_kind(self, kind: str, group: str | None = None) -> Resource:
+        if group is not None:
+            return self._by_kind[(group, kind)]
+        matches = [r for (g, k), r in self._by_kind.items() if k == kind]
+        if len(matches) != 1:
+            raise KeyError(f"ambiguous or unknown kind {kind!r}")
+        return matches[0]
+
+    def all(self):
+        return list(self._by_plural.values())
+
+
+DEFAULT_REGISTRY = Registry(_BUILTIN)

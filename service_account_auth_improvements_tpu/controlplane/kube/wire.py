@@ -1,0 +1,116 @@
+"""REST+watch wire protocol over WSGI for the fake API server.
+
+Speaks enough of the Kubernetes API conventions for our ``KubeClient``:
+collection GET/POST, item GET/PUT/PATCH/DELETE, ``?watch=true`` chunked
+JSON-lines streaming, status subresource, and Status-object errors.
+"""
+
+from __future__ import annotations
+
+import json
+from urllib.parse import parse_qs
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+
+def _parse_path(registry, path: str):
+    """Return (resource, namespace, name, subresource) for an API path."""
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... (core) or /apis/<group>/<version>/...
+    if not parts or parts[0] not in ("api", "apis"):
+        raise errors.NotFound(f"unknown path {path!r}")
+    if parts[0] == "api":
+        group, rest = "", parts[2:]
+    else:
+        group, rest = parts[1], parts[3:]
+    namespace = None
+    if len(rest) >= 2 and rest[0] == "namespaces" and (
+        len(rest) > 2 or group or rest[1]
+    ):
+        # Disambiguate /api/v1/namespaces (collection) from
+        # /api/v1/namespaces/<ns>/<plural>/...
+        if len(rest) >= 3:
+            namespace, rest = rest[1], rest[2:]
+        elif group == "" and len(rest) == 2:
+            # /api/v1/namespaces/<name> — the Namespace object itself
+            pass
+    plural = rest[0]
+    name = rest[1] if len(rest) > 1 else None
+    sub = rest[2] if len(rest) > 2 else None
+    res = registry.by_plural(plural, group)
+    return res, namespace, name, sub
+
+
+def handle(fake, environ, start_response):
+    method = environ["REQUEST_METHOD"]
+    path = environ.get("PATH_INFO", "")
+    qs = parse_qs(environ.get("QUERY_STRING", ""))
+
+    def body():
+        try:
+            length = int(environ.get("CONTENT_LENGTH") or 0)
+        except ValueError:
+            length = 0
+        raw = environ["wsgi.input"].read(length) if length else b""
+        return json.loads(raw) if raw else None
+
+    try:
+        res, namespace, name, sub = _parse_path(fake.registry, path)
+        kwargs = {"group": res.group}
+        if method == "GET" and name is None:
+            if qs.get("watch", ["false"])[0] == "true":
+                rv = qs.get("resourceVersion", ["0"])[0]
+                timeout = float(qs.get("timeoutSeconds", ["30"])[0])
+                start_response(
+                    "200 OK", [("Content-Type", "application/json")]
+                )
+
+                def stream():
+                    for ev in fake.watch(
+                        res.plural, namespace=namespace,
+                        resource_version=rv, timeout=timeout, **kwargs
+                    ):
+                        yield (json.dumps(ev) + "\n").encode()
+
+                return stream()
+            out = fake.list(
+                res.plural, namespace=namespace,
+                label_selector=qs.get("labelSelector", [""])[0],
+                field_selector=qs.get("fieldSelector", [""])[0],
+                **kwargs,
+            )
+        elif method == "GET":
+            out = fake.get(res.plural, name, namespace=namespace, **kwargs)
+        elif method == "POST":
+            out = fake.create(res.plural, body(), namespace=namespace, **kwargs)
+        elif method == "PUT":
+            out = fake.update(
+                res.plural, body(), namespace=namespace,
+                subresource=sub, **kwargs,
+            )
+        elif method == "PATCH":
+            ctype = environ.get("CONTENT_TYPE", "")
+            ptype = "json" if "json-patch" in ctype else "merge"
+            out = fake.patch(
+                res.plural, name, body(), namespace=namespace,
+                patch_type=ptype, **kwargs,
+            )
+        elif method == "DELETE":
+            out = fake.delete(res.plural, name, namespace=namespace, **kwargs)
+        else:
+            raise errors.BadRequest(f"method {method} not supported")
+        payload = json.dumps(out).encode()
+        start_response(
+            "200 OK",
+            [("Content-Type", "application/json"),
+             ("Content-Length", str(len(payload)))],
+        )
+        return [payload]
+    except errors.ApiError as e:
+        payload = json.dumps(e.to_status()).encode()
+        start_response(
+            f"{e.code} {e.reason}",
+            [("Content-Type", "application/json"),
+             ("Content-Length", str(len(payload)))],
+        )
+        return [payload]

@@ -1,0 +1,9 @@
+"""Prometheus metrics, text exposition format, stdlib only."""
+
+from service_account_auth_improvements_tpu.controlplane.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    REGISTRY,
+)

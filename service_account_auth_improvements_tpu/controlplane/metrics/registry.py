@@ -1,0 +1,170 @@
+"""Minimal Prometheus client: counters, gauges, histograms + text format.
+
+Parity surface for the reference's metrics everywhere (notebook metrics
+components/notebook-controller/pkg/metrics/metrics.go:13-99; profile
+monitoring controllers/monitoring.go:26-78; KFAM kfam/monitoring.go:46-76).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = "", labels: tuple = (),
+                 registry: "Registry | None" = None):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(labels)
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        (registry if registry is not None else REGISTRY).register(self)
+
+    def labels(self, *values) -> "_Child":
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: want {len(self.label_names)} labels"
+            )
+        return _Child(self, tuple(str(v) for v in values))
+
+    def _fmt_labels(self, values: tuple) -> str:
+        if not values:
+            return ""
+        inner = ",".join(
+            f'{k}="{v}"' for k, v in zip(self.label_names, values)
+        )
+        return "{" + inner + "}"
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            items = sorted(self._values.items())
+            if not items and not self.label_names:
+                items = [((), 0.0)]
+            for values, v in items:
+                lines.append(f"{self.name}{self._fmt_labels(values)} {v}")
+        return "\n".join(lines)
+
+
+class _Child:
+    def __init__(self, metric: _Metric, values: tuple):
+        self.metric = metric
+        self.values = values
+
+    def inc(self, amount: float = 1.0):
+        self.metric._add(self.values, amount)
+
+    def set(self, value: float):
+        self.metric._set(self.values, value)
+
+    def observe(self, value: float):
+        self.metric._observe(self.values, value)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0):
+        self._add((), amount)
+
+    def _add(self, key: tuple, amount: float):
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float):
+        self._set((), value)
+
+    def inc(self, amount: float = 1.0):
+        self._add((), amount)
+
+    def _set(self, key: tuple, value: float):
+        with self._lock:
+            self._values[key] = float(value)
+
+    def _add(self, key: tuple, amount: float):
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, *label_values) -> float:
+        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+    DEFAULT_BUCKETS = (
+        0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60,
+    )
+
+    def __init__(self, name, help_="", labels=(), buckets=None,
+                 registry=None):
+        self.buckets = tuple(buckets or self.DEFAULT_BUCKETS)
+        self._counts: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+        super().__init__(name, help_, labels, registry)
+
+    def observe(self, value: float):
+        self._observe((), value)
+
+    def _observe(self, key: tuple, value: float):
+        with self._lock:
+            counts = self._counts.setdefault(
+                key, [0] * (len(self.buckets) + 1)
+            )
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+
+    def render(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            for key in sorted(self._counts):
+                counts = self._counts[key]  # already cumulative per bucket
+                for i, b in enumerate(self.buckets):
+                    labels = dict(zip(self.label_names, key))
+                    labels["le"] = str(b)
+                    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                    lines.append(
+                        f"{self.name}_bucket{{{inner}}} {counts[i]}"
+                    )
+                labels = dict(zip(self.label_names, key))
+                labels["le"] = "+Inf"
+                inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                lines.append(f"{self.name}_bucket{{{inner}}} {counts[-1]}")
+                base = self._fmt_labels(key)
+                lines.append(f"{self.name}_sum{base} {self._sums[key]}")
+                lines.append(f"{self.name}_count{base} {counts[-1]}")
+        return "\n".join(lines)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def register(self, m: _Metric):
+        with self._lock:
+            self._metrics.append(m)
+
+    def render(self) -> str:
+        with self._lock:
+            return "\n".join(m.render() for m in self._metrics) + "\n"
+
+
+REGISTRY = Registry()

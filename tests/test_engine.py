@@ -1,0 +1,159 @@
+"""Reconcile engine: level-triggered loop against the fake API server."""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+
+class ChildReconciler(Reconciler):
+    """For each Notebook, ensure a same-named ConfigMap child exists."""
+
+    resource = "notebooks"
+    group = "tpukf.dev"
+
+    def __init__(self, kube):
+        self.kube = kube
+        self.count = 0
+
+    def reconcile(self, req: Request):
+        self.count += 1
+        try:
+            nb = self.kube.get("notebooks", req.name, namespace=req.namespace)
+        except errors.NotFound:
+            return Result()
+        desired = {
+            "metadata": {
+                "name": req.name,
+                "namespace": req.namespace,
+                "ownerReferences": [{
+                    "kind": "Notebook",
+                    "name": req.name,
+                    "uid": nb["metadata"]["uid"],
+                }],
+            },
+            "data": {"image": nb["spec"].get("image", "")},
+        }
+        try:
+            cur = self.kube.get("configmaps", req.name, namespace=req.namespace)
+            if cur.get("data") != desired["data"]:
+                cur["data"] = desired["data"]
+                self.kube.update("configmaps", cur)
+        except errors.NotFound:
+            self.kube.create("configmaps", desired)
+        return Result()
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    rec = ChildReconciler(kube)
+    ctl = mgr.add_reconciler(rec)
+    mgr.watch_owned(ctl, "configmaps", owner_kind="Notebook")
+    mgr.start()
+    yield kube, mgr, rec
+    mgr.stop()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_creates_child_and_levels_on_spec_change(world):
+    kube, mgr, rec = world
+    kube.create("notebooks", {
+        "metadata": {"name": "n1", "namespace": "u1"},
+        "spec": {"image": "img:1"},
+    })
+    assert _wait(lambda: _cm_image(kube) == "img:1")
+    nb = kube.get("notebooks", "n1", namespace="u1")
+    nb["spec"]["image"] = "img:2"
+    kube.update("notebooks", nb)
+    assert _wait(lambda: _cm_image(kube) == "img:2")
+
+
+def _cm_image(kube):
+    try:
+        return kube.get("configmaps", "n1", namespace="u1")["data"]["image"]
+    except errors.NotFound:
+        return None
+
+
+def test_child_deletion_triggers_recreate(world):
+    kube, mgr, rec = world
+    kube.create("notebooks", {
+        "metadata": {"name": "n1", "namespace": "u1"},
+        "spec": {"image": "img:1"},
+    })
+    assert _wait(lambda: _cm_image(kube) == "img:1")
+    kube.delete("configmaps", "n1", namespace="u1")
+    assert _wait(lambda: _cm_image(kube) == "img:1")
+
+
+class FlakyReconciler(Reconciler):
+    resource = "notebooks"
+    group = "tpukf.dev"
+
+    def __init__(self):
+        self.attempts = 0
+
+    def reconcile(self, req):
+        self.attempts += 1
+        if self.attempts < 3:
+            raise RuntimeError("transient")
+        return Result()
+
+
+def test_error_backoff_retries():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    rec = FlakyReconciler()
+    mgr.add_reconciler(rec)
+    mgr.start()
+    try:
+        kube.create("notebooks", {
+            "metadata": {"name": "n1", "namespace": "u1"}, "spec": {},
+        })
+        assert _wait(lambda: rec.attempts >= 3)
+    finally:
+        mgr.stop()
+
+
+def test_requeue_after():
+    kube = FakeKube()
+    mgr = Manager(kube)
+
+    class Periodic(Reconciler):
+        resource = "notebooks"
+        group = "tpukf.dev"
+        runs = 0
+
+        def reconcile(self, req):
+            Periodic.runs += 1
+            return Result(requeue_after=0.05)
+
+    mgr.add_reconciler(Periodic())
+    mgr.start()
+    try:
+        kube.create("notebooks", {
+            "metadata": {"name": "n1", "namespace": "u1"}, "spec": {},
+        })
+        assert _wait(lambda: Periodic.runs >= 3)
+    finally:
+        mgr.stop()

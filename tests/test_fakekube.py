@@ -1,0 +1,155 @@
+"""Fake API server semantics: CRUD, RV conflicts, finalizers, watch, GC."""
+
+import threading
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+
+@pytest.fixture()
+def kube():
+    return FakeKube()
+
+
+def _nb(name="nb1", ns="user1", labels=None):
+    return {
+        "apiVersion": "tpukf.dev/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": name, "namespace": ns, "labels": labels or {}},
+        "spec": {"template": {"spec": {"containers": []}}},
+    }
+
+
+def test_create_get_roundtrip(kube):
+    created = kube.create("notebooks", _nb())
+    assert created["metadata"]["uid"]
+    assert created["metadata"]["resourceVersion"] == "1"
+    got = kube.get("notebooks", "nb1", namespace="user1")
+    assert got["spec"] == created["spec"]
+
+
+def test_create_duplicate_conflicts(kube):
+    kube.create("notebooks", _nb())
+    with pytest.raises(errors.AlreadyExists):
+        kube.create("notebooks", _nb())
+
+
+def test_update_stale_rv_conflicts(kube):
+    obj = kube.create("notebooks", _nb())
+    obj2 = kube.get("notebooks", "nb1", namespace="user1")
+    obj2["spec"]["x"] = 1
+    kube.update("notebooks", obj2)
+    obj["spec"]["x"] = 2  # stale resourceVersion
+    with pytest.raises(errors.Conflict):
+        kube.update("notebooks", obj)
+
+
+def test_spec_update_bumps_generation_status_does_not(kube):
+    obj = kube.create("notebooks", _nb())
+    assert obj["metadata"]["generation"] == 1
+    obj["spec"]["x"] = 1
+    obj = kube.update("notebooks", obj)
+    assert obj["metadata"]["generation"] == 2
+    obj["status"] = {"readyReplicas": 1}
+    obj = kube.update_status("notebooks", obj)
+    assert obj["metadata"]["generation"] == 2
+    assert kube.get("notebooks", "nb1", namespace="user1")["status"] == {
+        "readyReplicas": 1
+    }
+
+
+def test_list_label_selector(kube):
+    kube.create("notebooks", _nb("a", labels={"team": "x"}))
+    kube.create("notebooks", _nb("b", labels={"team": "y"}))
+    kube.create("notebooks", _nb("c"))
+    out = kube.list("notebooks", namespace="user1", label_selector="team=x")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["a"]
+    out = kube.list("notebooks", namespace="user1", label_selector="team!=x")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["b", "c"]
+    out = kube.list("notebooks", namespace="user1", label_selector="team")
+    assert [o["metadata"]["name"] for o in out["items"]] == ["a", "b"]
+
+
+def test_finalizer_blocks_delete(kube):
+    obj = _nb()
+    obj["metadata"]["finalizers"] = ["tpukf.dev/cleanup"]
+    kube.create("notebooks", obj)
+    kube.delete("notebooks", "nb1", namespace="user1")
+    cur = kube.get("notebooks", "nb1", namespace="user1")
+    assert cur["metadata"]["deletionTimestamp"]
+    cur["metadata"]["finalizers"] = []
+    kube.update("notebooks", cur)
+    with pytest.raises(errors.NotFound):
+        kube.get("notebooks", "nb1", namespace="user1")
+
+
+def test_owner_reference_cascade(kube):
+    nb = kube.create("notebooks", _nb())
+    sts = {
+        "metadata": {
+            "name": "nb1", "namespace": "user1",
+            "ownerReferences": [{
+                "kind": "Notebook", "name": "nb1",
+                "uid": nb["metadata"]["uid"],
+            }],
+        },
+        "spec": {},
+    }
+    kube.create("statefulsets", sts, group="apps")
+    kube.delete("notebooks", "nb1", namespace="user1")
+    with pytest.raises(errors.NotFound):
+        kube.get("statefulsets", "nb1", namespace="user1", group="apps")
+
+
+def test_merge_patch_and_json_patch(kube):
+    kube.create("notebooks", _nb())
+    kube.patch(
+        "notebooks", "nb1",
+        {"metadata": {"annotations": {"stopped": "now"}}},
+        namespace="user1",
+    )
+    cur = kube.get("notebooks", "nb1", namespace="user1")
+    assert cur["metadata"]["annotations"] == {"stopped": "now"}
+    kube.patch(
+        "notebooks", "nb1",
+        [{"op": "remove", "path": "/metadata/annotations/stopped"}],
+        namespace="user1", patch_type="json",
+    )
+    cur = kube.get("notebooks", "nb1", namespace="user1")
+    assert cur["metadata"]["annotations"] == {}
+
+
+def test_watch_replay_and_live(kube):
+    kube.create("notebooks", _nb("a"))
+    events = []
+    done = threading.Event()
+
+    def consume():
+        for ev in kube.watch("notebooks", resource_version=0, timeout=1.5):
+            events.append((ev["type"], ev["object"]["metadata"]["name"]))
+            if len(events) >= 3:
+                break
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    kube.create("notebooks", _nb("b"))
+    kube.delete("notebooks", "a", namespace="user1")
+    assert done.wait(5.0)
+    assert events == [("ADDED", "a"), ("ADDED", "b"), ("DELETED", "a")]
+
+
+def test_cluster_scoped_profile(kube):
+    kube.create("profiles", {
+        "metadata": {"name": "alice"},
+        "spec": {"owner": {"kind": "User", "name": "alice@example.com"}},
+    })
+    got = kube.get("profiles", "alice")
+    assert "namespace" not in got["metadata"]

@@ -1,0 +1,93 @@
+"""KubeClient over real HTTP against the fake server's WSGI wire protocol."""
+
+import threading
+import wsgiref.simple_server
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    KubeClient,
+    errors,
+)
+
+
+class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture(scope="module")
+def server():
+    kube = FakeKube()
+    httpd = wsgiref.simple_server.make_server(
+        "127.0.0.1", 0, kube.wsgi_app, handler_class=_QuietHandler
+    )
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield kube, f"http://127.0.0.1:{httpd.server_port}"
+    httpd.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    _, url = server
+    return KubeClient(base_url=url)
+
+
+def test_crud_over_wire(client):
+    client.create("pods", {
+        "metadata": {"name": "p1", "namespace": "ns1"},
+        "spec": {"containers": [{"name": "c", "image": "i"}]},
+    })
+    got = client.get("pods", "p1", namespace="ns1")
+    assert got["spec"]["containers"][0]["image"] == "i"
+    got["spec"]["containers"][0]["image"] = "j"
+    client.update("pods", got)
+    assert client.get("pods", "p1", namespace="ns1")["spec"]["containers"][0][
+        "image"
+    ] == "j"
+    out = client.list("pods", namespace="ns1")
+    assert len(out["items"]) == 1
+    client.patch(
+        "pods", "p1", {"metadata": {"labels": {"a": "b"}}}, namespace="ns1"
+    )
+    assert client.list("pods", namespace="ns1", label_selector="a=b")["items"]
+    client.delete("pods", "p1", namespace="ns1")
+    with pytest.raises(errors.NotFound):
+        client.get("pods", "p1", namespace="ns1")
+
+
+def test_status_subresource_over_wire(client):
+    client.create("notebooks", {
+        "metadata": {"name": "nb", "namespace": "ns1"},
+        "spec": {"a": 1},
+    })
+    cur = client.get("notebooks", "nb", namespace="ns1")
+    cur["status"] = {"phase": "Running"}
+    client.update_status("notebooks", cur)
+    assert client.get("notebooks", "nb", namespace="ns1")["status"] == {
+        "phase": "Running"
+    }
+
+
+def test_watch_over_wire_streams_live_events(server, client):
+    kube, _ = server
+    events = []
+
+    def consume():
+        for ev in client.watch("configmaps", namespace="wns",
+                               resource_version=0, timeout=10):
+            events.append((ev["type"], ev["object"]["metadata"]["name"]))
+            if len(events) >= 2:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    import time
+
+    time.sleep(0.3)
+    kube.create("configmaps", {"metadata": {"name": "cm1", "namespace": "wns"}})
+    kube.create("configmaps", {"metadata": {"name": "cm2", "namespace": "wns"}})
+    t.join(timeout=10)
+    assert events == [("ADDED", "cm1"), ("ADDED", "cm2")]
