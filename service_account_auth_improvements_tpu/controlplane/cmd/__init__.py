@@ -1,0 +1,1 @@
+"""Controller binaries (``python -m ...cmd.<name>``)."""

@@ -1,0 +1,1 @@
+"""Reconcilers: notebook, culling, profile, tensorboard, pvcviewer."""

@@ -1,0 +1,168 @@
+"""Idle-culling controller: scale idle notebooks to zero.
+
+On TPUs this is the highest-leverage controller in the repo — an idle slice
+burns real money — so it's first-class here (the reference buries it as a
+side controller: components/notebook-controller/controllers/
+culling_controller.go:78-162). Behavior parity:
+
+- Probes each notebook's Jupyter ``/api/kernels`` through cluster DNS
+  (reference :202-241), stamps ``tpukf.dev/last-activity`` and
+  ``tpukf.dev/last_activity_check_timestamp`` annotations (:51-52),
+- All-idle kernels → last activity is the max kernel timestamp (:243-308);
+  any busy kernel keeps the notebook alive,
+- Idle longer than CULL_IDLE_TIME → sets the stop annotation the notebook
+  reconciler maps to replicas=0 (:355-372).
+
+TPU addition: a ``tpukf.dev/culling-policy: training`` annotation opts a
+notebook out — SPMD training is busy-but-quiet, a kernel-idleness heuristic
+must not kill it (SURVEY.md §7 hard parts).
+
+Env knobs (reference :30-40, :405): CULL_IDLE_TIME (minutes, default 1440),
+IDLENESS_CHECK_PERIOD (minutes, default 1), CLUSTER_DOMAIN, DEV.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import urllib.request
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    GROUP,
+    STOP_ANNOTATION,
+    NotebookMetrics,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.metrics import Registry
+from service_account_auth_improvements_tpu.utils.env import (
+    get_env_default,
+    get_env_int,
+)
+
+LAST_ACTIVITY = "tpukf.dev/last-activity"
+LAST_CHECK = "tpukf.dev/last_activity_check_timestamp"
+CULLING_POLICY = "tpukf.dev/culling-policy"
+TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
+PROBE_TIMEOUT = 10  # seconds (reference culling_controller.go:204-206)
+
+
+def _parse_time(s: str) -> dt.datetime | None:
+    for fmt in (TIME_FMT, "%Y-%m-%dT%H:%M:%S.%fZ"):
+        try:
+            return dt.datetime.strptime(s, fmt).replace(
+                tzinfo=dt.timezone.utc
+            )
+        except (ValueError, TypeError):
+            continue
+    return None
+
+
+def default_fetch_kernels(url: str):
+    """GET the Jupyter kernels endpoint; None on any failure."""
+    try:
+        with urllib.request.urlopen(url, timeout=PROBE_TIMEOUT) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
+class CullingReconciler(Reconciler):
+    resource = "notebooks"
+    group = GROUP
+
+    def __init__(self, kube, metrics: NotebookMetrics | None = None,
+                 fetch_kernels=default_fetch_kernels, now=None):
+        self.kube = kube
+        self.metrics = metrics or NotebookMetrics(Registry())
+        self.fetch_kernels = fetch_kernels
+        self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
+        self.cull_idle_minutes = get_env_int("CULL_IDLE_TIME", 1440)
+        self.check_period_minutes = get_env_int("IDLENESS_CHECK_PERIOD", 1)
+        self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
+        self.dev = get_env_default("DEV", "false").lower() == "true"
+
+    def register(self, manager) -> "CullingReconciler":
+        manager.add_reconciler(self)
+        return self
+
+    def kernels_url(self, name: str, ns: str) -> str:
+        if self.dev:
+            return f"http://localhost:8001/api/v1/namespaces/{ns}/services/{name}:http-{name}/proxy/notebook/{ns}/{name}/api/kernels"
+        return (
+            f"http://{name}.{ns}.svc.{self.cluster_domain}"
+            f"/notebook/{ns}/{name}/api/kernels"
+        )
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Result:
+        period = dt.timedelta(minutes=self.check_period_minutes)
+        try:
+            nb = self.kube.get("notebooks", req.name, namespace=req.namespace,
+                               group=GROUP)
+        except errors.NotFound:
+            return Result()
+        annots = nb["metadata"].get("annotations") or {}
+        if STOP_ANNOTATION in annots:
+            return Result()  # already stopped; resume clears and re-enqueues
+        if annots.get(CULLING_POLICY) in ("training", "disabled"):
+            return Result(requeue_after=period.total_seconds())
+
+        now = self.now()
+        kernels = self.fetch_kernels(
+            self.kernels_url(req.name, req.namespace)
+        )
+        patch = {"metadata": {"annotations": {
+            LAST_CHECK: now.strftime(TIME_FMT),
+        }}}
+        last_activity = _parse_time(annots.get(LAST_ACTIVITY, ""))
+        if kernels is None:
+            # Unreachable (booting, crashed, network): never cull blind —
+            # stamp the check time and retry next period.
+            self.kube.patch("notebooks", req.name, patch,
+                            namespace=req.namespace, group=GROUP)
+            return Result(requeue_after=period.total_seconds())
+        elif self._any_busy(kernels) or not kernels:
+            # Busy kernels — and kernel-less servers (plain JupyterLab
+            # landing) — count as active now.
+            last_activity = now
+            patch["metadata"]["annotations"][LAST_ACTIVITY] = now.strftime(
+                TIME_FMT
+            )
+        else:
+            latest = max(
+                (t for k in kernels
+                 if (t := _parse_time(k.get("last_activity", "")))),
+                default=None,
+            )
+            if latest and (last_activity is None or latest > last_activity):
+                last_activity = latest
+                patch["metadata"]["annotations"][LAST_ACTIVITY] = (
+                    latest.strftime(TIME_FMT)
+                )
+        if last_activity is None:
+            last_activity = now
+            patch["metadata"]["annotations"].setdefault(
+                LAST_ACTIVITY, now.strftime(TIME_FMT)
+            )
+
+        idle_for = now - last_activity
+        if idle_for > dt.timedelta(minutes=self.cull_idle_minutes):
+            patch["metadata"]["annotations"][STOP_ANNOTATION] = (
+                now.strftime(TIME_FMT)
+            )
+            self.metrics.culled.labels(req.namespace).inc()
+        self.kube.patch("notebooks", req.name, patch,
+                        namespace=req.namespace, group=GROUP)
+        return Result(requeue_after=period.total_seconds())
+
+    @staticmethod
+    def _any_busy(kernels) -> bool:
+        return any(
+            k.get("execution_state") == "busy" for k in kernels
+        )

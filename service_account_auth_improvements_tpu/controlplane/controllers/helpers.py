@@ -1,0 +1,115 @@
+"""Create-or-update helpers with field-copy semantics.
+
+The contract the reference centralizes in components/common/reconcilehelper/
+util.go:18-219: ensure a child object exists, and on drift copy only the
+fields the controller owns — preserving cluster-assigned fields (clusterIP,
+nodePorts) and operator intent where appropriate.
+"""
+
+from __future__ import annotations
+
+import copy
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+
+def owner_reference(obj: dict, controller: bool = True) -> dict:
+    return {
+        "apiVersion": obj.get("apiVersion"),
+        "kind": obj.get("kind"),
+        "name": obj["metadata"]["name"],
+        "uid": obj["metadata"]["uid"],
+        "controller": controller,
+        "blockOwnerDeletion": True,
+    }
+
+
+def ensure(kube, plural: str, desired: dict, group: str | None = None,
+           copy_fields=None) -> tuple[dict, bool]:
+    """Create ``desired`` or update the live object's controller-owned
+    fields. Returns (live_object, changed)."""
+    meta = desired["metadata"]
+    ns = meta.get("namespace")
+    try:
+        live = kube.get(plural, meta["name"], namespace=ns, group=group)
+    except errors.NotFound:
+        return kube.create(plural, desired, namespace=ns, group=group), True
+    updated = copy.deepcopy(live)
+    changed = (copy_fields or copy_spec_fields)(desired, updated)
+    if changed:
+        return kube.update(plural, updated, namespace=ns, group=group), True
+    return live, False
+
+
+def _copy_meta(desired: dict, live: dict) -> bool:
+    changed = False
+    dmeta, lmeta = desired["metadata"], live["metadata"]
+    for field in ("labels", "annotations"):
+        want = dmeta.get(field) or {}
+        have = lmeta.get(field) or {}
+        # Controller-owned keys win; foreign keys are preserved.
+        merged = {**have, **want}
+        if merged != have:
+            lmeta[field] = merged
+            changed = True
+    return changed
+
+
+def copy_spec_fields(desired: dict, live: dict) -> bool:
+    """Default: owned metadata + whole spec (Deployment-style —
+    reference util.go CopyDeploymentSetFields)."""
+    changed = _copy_meta(desired, live)
+    if live.get("spec") != desired.get("spec"):
+        live["spec"] = copy.deepcopy(desired.get("spec"))
+        changed = True
+    return changed
+
+
+def copy_statefulset_fields(desired: dict, live: dict) -> bool:
+    """Replicas + template + labels/annotations; leaves the rest of spec
+    (volumeClaimTemplates are immutable) — reference util.go:107-134."""
+    changed = _copy_meta(desired, live)
+    dspec, lspec = desired.get("spec", {}), live.setdefault("spec", {})
+    for field in ("replicas", "template", "serviceName"):
+        if field in dspec and lspec.get(field) != dspec[field]:
+            lspec[field] = copy.deepcopy(dspec[field])
+            changed = True
+    return changed
+
+
+def copy_service_fields(desired: dict, live: dict) -> bool:
+    """Selector + ports, but preserve clusterIP(s)/nodePorts the cluster
+    assigned — reference util.go:74-105."""
+    changed = _copy_meta(desired, live)
+    dspec = copy.deepcopy(desired.get("spec", {}))
+    lspec = live.setdefault("spec", {})
+    for keep in ("clusterIP", "clusterIPs", "ipFamilies",
+                 "ipFamilyPolicy"):
+        if keep in lspec:
+            dspec[keep] = lspec[keep]
+    for dport in dspec.get("ports", []):
+        for lport in lspec.get("ports", []):
+            if dport.get("port") == lport.get("port") and \
+                    "nodePort" in lport and "nodePort" not in dport:
+                dport["nodePort"] = lport["nodePort"]
+    if lspec != dspec:
+        live["spec"] = dspec
+        changed = True
+    return changed
+
+
+def get_condition(obj: dict, ctype: str) -> dict | None:
+    for c in (obj.get("status") or {}).get("conditions") or []:
+        if c.get("type") == ctype:
+            return c
+    return None
+
+
+def set_condition(obj: dict, condition: dict) -> None:
+    status = obj.setdefault("status", {})
+    conds = status.setdefault("conditions", [])
+    for i, c in enumerate(conds):
+        if c.get("type") == condition.get("type"):
+            conds[i] = condition
+            return
+    conds.append(condition)

@@ -1,0 +1,365 @@
+"""Notebook controller: Notebook CR → StatefulSet + Services (+ Istio VS).
+
+TPU-native rethink of the reference's notebook-controller (reconcile shape:
+components/notebook-controller/controllers/notebook_controller.go:89-225):
+
+- ``spec.tpu`` resolves to GKE TPU node selectors + ``google.com/tpu``
+  chip limits (controlplane/tpu.py) instead of a GPU limits key.
+- Multi-host slices become ``replicas = num_hosts`` with a headless service
+  for stable per-host DNS and injected ``TPU_WORKER_*`` rendezvous env —
+  the reference is structurally single-pod (pod ``<name>-0``,
+  notebook_controller.go:211).
+- Stop/resume via the ``tpukf.dev/resource-stopped`` annotation mapping to
+  replicas=0 (reference semantics at notebook_controller.go:362-365).
+- Status mirrors the rank-0 pod's container state onto the CR and counts
+  ready hosts (reference: notebook_controller.go:210-302).
+- Optional Istio VirtualService at ``/notebook/<ns>/<name>/`` gated by
+  USE_ISTIO (reference: notebook_controller.go:202-208, 471-612).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Gauge,
+    Registry,
+)
+from service_account_auth_improvements_tpu.utils.env import (
+    get_env_bool,
+    get_env_default,
+)
+
+GROUP = "tpukf.dev"
+STOP_ANNOTATION = "tpukf.dev/resource-stopped"
+NOTEBOOK_PORT = 8888
+SERVICE_PORT = 80
+DEFAULT_CONTAINER = "notebook"
+
+
+class NotebookMetrics:
+    def __init__(self, registry: Registry | None = None):
+        self.created = Counter(
+            "notebook_create_total", "Notebooks created", registry=registry
+        )
+        self.create_failed = Counter(
+            "notebook_create_failed_total", "Notebook creations failed",
+            registry=registry,
+        )
+        self.running = Gauge(
+            "notebook_running", "Running notebooks", ("namespace",),
+            registry=registry,
+        )
+        self.culled = Counter(
+            "notebook_culled_total", "Notebooks culled", ("namespace",),
+            registry=registry,
+        )
+
+
+class NotebookReconciler(Reconciler):
+    resource = "notebooks"
+    group = GROUP
+
+    def __init__(self, kube, metrics: NotebookMetrics | None = None):
+        self.kube = kube
+        self.metrics = metrics or NotebookMetrics(Registry())
+        self.use_istio = get_env_bool("USE_ISTIO", False)
+        self.istio_gateway = get_env_default(
+            "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
+        )
+        self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
+        self.add_fsgroup = get_env_bool("ADD_FSGROUP", True)
+
+    # ------------------------------------------------------------ wiring
+
+    def register(self, manager) -> "NotebookReconciler":
+        ctl = manager.add_reconciler(self)
+        manager.watch_owned(ctl, "statefulsets", group="apps",
+                            owner_kind="Notebook")
+        manager.watch_owned(ctl, "services", owner_kind="Notebook")
+        manager.watch_mapped(ctl, "pods", self._map_pod)
+        return self
+
+    @staticmethod
+    def _map_pod(ev_type, pod):
+        labels = pod["metadata"].get("labels") or {}
+        name = labels.get("notebook-name")
+        if name:
+            return [Request(pod["metadata"].get("namespace"), name)]
+        return []
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            nb = self.kube.get("notebooks", req.name, namespace=req.namespace,
+                               group=GROUP)
+        except errors.NotFound:
+            return Result()  # children are garbage-collected via ownerRefs
+        if nb["metadata"].get("deletionTimestamp"):
+            return Result()
+
+        try:
+            resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
+        except tpu.TpuValidationError as e:
+            # Terminal user error: surface on the CR, don't retry-storm
+            # (the reference's appendErrorConditionAndReturn pattern —
+            # profile_controller.go:337-347).
+            self.metrics.create_failed.inc()
+            nb = copy.deepcopy(nb)
+            helpers.set_condition(nb, {
+                "type": "InvalidTpuSpec", "status": "True", "message": str(e),
+            })
+            try:
+                self.kube.update_status("notebooks", nb, group=GROUP)
+            except errors.ApiError:
+                pass
+            return Result()
+
+        fresh = False
+        try:
+            self.kube.get("statefulsets", req.name, namespace=req.namespace,
+                          group="apps")
+        except errors.NotFound:
+            fresh = True
+        sts, sts_changed = helpers.ensure(
+            self.kube, "statefulsets",
+            self.generate_statefulset(nb, resolved), group="apps",
+            copy_fields=helpers.copy_statefulset_fields,
+        )
+        if fresh:
+            self.metrics.created.inc()
+        helpers.ensure(
+            self.kube, "services", self.generate_service(nb),
+            copy_fields=helpers.copy_service_fields,
+        )
+        helpers.ensure(
+            self.kube, "services", self.generate_headless_service(nb),
+            copy_fields=helpers.copy_service_fields,
+        )
+        if self.use_istio:
+            helpers.ensure(
+                self.kube, "virtualservices",
+                self.generate_virtual_service(nb),
+                group="networking.istio.io",
+            )
+        self.update_status(nb, sts, resolved)
+        return Result()
+
+    # --------------------------------------------------------- generators
+
+    def _stopped(self, nb: dict) -> bool:
+        annots = nb["metadata"].get("annotations") or {}
+        return STOP_ANNOTATION in annots
+
+    def generate_statefulset(self, nb: dict, resolved) -> dict:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        replicas = 0 if self._stopped(nb) else (
+            resolved.num_hosts if resolved else 1
+        )
+        template = copy.deepcopy(
+            ((nb.get("spec") or {}).get("template")) or {"spec": {}}
+        )
+        pod_spec = template.setdefault("spec", {})
+        meta = template.setdefault("metadata", {})
+        labels = meta.setdefault("labels", {})
+        labels.update({"statefulset": name, "notebook-name": name})
+        # Copy CR labels/annotations onto the pod, minus volatile ones
+        # (reference copies all but last-activity style annotations).
+        for k, v in (nb["metadata"].get("labels") or {}).items():
+            labels.setdefault(k, v)
+        annots = {
+            k: v for k, v in (nb["metadata"].get("annotations") or {}).items()
+            if not k.startswith("kubectl.kubernetes.io/")
+            and k != STOP_ANNOTATION
+        }
+        if annots:
+            meta.setdefault("annotations", {}).update(annots)
+
+        containers = pod_spec.setdefault("containers", [])
+        if not containers:
+            containers.append({"name": DEFAULT_CONTAINER, "image": ""})
+        main = containers[0]
+        main.setdefault("name", DEFAULT_CONTAINER)
+        env = main.setdefault("env", [])
+        self._set_env(env, "NB_PREFIX", f"/notebook/{ns}/{name}")
+        if resolved:
+            limits = main.setdefault("resources", {}).setdefault("limits", {})
+            limits[tpu.RESOURCE_TPU] = str(resolved.chips_per_host)
+            requests = main["resources"].setdefault("requests", {})
+            requests[tpu.RESOURCE_TPU] = str(resolved.chips_per_host)
+            pod_spec.setdefault("nodeSelector", {}).update(resolved.selector)
+            for e in tpu.worker_env(
+                name, f"{name}-hl", ns, resolved
+            ):
+                self._set_env_obj(env, e)
+            meta.setdefault("annotations", {})[tpu.ANNOTATION_SLICE] = (
+                f"{resolved.generation}:{resolved.topology}"
+            )
+        if self.add_fsgroup:
+            pod_spec.setdefault("securityContext", {}).setdefault(
+                "fsGroup", 100
+            )
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {"notebook-name": name},
+                "ownerReferences": [helpers.owner_reference(nb)],
+            },
+            "spec": {
+                "replicas": replicas,
+                "serviceName": f"{name}-hl",
+                "selector": {"matchLabels": {"statefulset": name}},
+                "template": template,
+            },
+        }
+
+    @staticmethod
+    def _set_env(env: list, name: str, value: str) -> None:
+        for e in env:
+            if e.get("name") == name:
+                e["value"] = value
+                e.pop("valueFrom", None)
+                return
+        env.append({"name": name, "value": value})
+
+    @staticmethod
+    def _set_env_obj(env: list, item: dict) -> None:
+        for i, e in enumerate(env):
+            if e.get("name") == item["name"]:
+                env[i] = item
+                return
+        env.append(item)
+
+    def generate_service(self, nb: dict) -> dict:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name,
+                "namespace": ns,
+                "labels": {"notebook-name": name},
+                "ownerReferences": [helpers.owner_reference(nb)],
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"statefulset": name},
+                "ports": [{
+                    "name": "http-" + name,
+                    "port": SERVICE_PORT,
+                    "targetPort": NOTEBOOK_PORT,
+                    "protocol": "TCP",
+                }],
+            },
+        }
+
+    def generate_headless_service(self, nb: dict) -> dict:
+        """Stable per-host DNS for slice rendezvous (multi-host ICI)."""
+        name = nb["metadata"]["name"]
+        svc = self.generate_service(nb)
+        svc["metadata"]["name"] = f"{name}-hl"
+        svc["spec"]["clusterIP"] = "None"
+        svc["spec"].pop("type", None)
+        return svc
+
+    def generate_virtual_service(self, nb: dict) -> dict:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        prefix = f"/notebook/{ns}/{name}/"
+        host = f"{name}.{ns}.svc.{self.cluster_domain}"
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {
+                "name": f"notebook-{ns}-{name}",
+                "namespace": ns,
+                "ownerReferences": [helpers.owner_reference(nb)],
+            },
+            "spec": {
+                "hosts": ["*"],
+                "gateways": [self.istio_gateway],
+                "http": [{
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": prefix},
+                    "route": [{"destination": {
+                        "host": host, "port": {"number": SERVICE_PORT},
+                    }}],
+                    "timeout": "300s",
+                }],
+            },
+        }
+
+    # -------------------------------------------------------------- status
+
+    def update_status(self, nb: dict, sts: dict, resolved) -> None:
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        status: dict = {
+            "readyReplicas": (sts.get("status") or {}).get("readyReplicas", 0),
+            "containerState": {},
+            "conditions": (nb.get("status") or {}).get("conditions") or [],
+        }
+        try:
+            pod = self.kube.get("pods", f"{name}-0", namespace=ns)
+        except errors.NotFound:
+            pod = None
+        if pod:
+            for cs in (pod.get("status") or {}).get("containerStatuses") or []:
+                if cs.get("name") == self._main_container_name(nb):
+                    state = cs.get("state") or {}
+                    status["containerState"] = state
+                    cond = self._condition_from_state(state)
+                    if cond:
+                        conds = status["conditions"]
+                        if not conds or conds[-1].get("type") != cond["type"]:
+                            conds.append(cond)
+                    break
+        if self._stopped(nb):
+            self.metrics.running.labels(ns).set(0)
+        else:
+            self.metrics.running.labels(ns).set(status["readyReplicas"])
+        cur = (nb.get("status") or {})
+        if cur != status:
+            nb = copy.deepcopy(nb)
+            nb["status"] = status
+            try:
+                self.kube.update_status("notebooks", nb, group=GROUP)
+            except errors.Conflict:
+                pass  # next event re-levels
+
+    def _main_container_name(self, nb: dict) -> str:
+        containers = (
+            ((nb.get("spec") or {}).get("template") or {}).get("spec") or {}
+        ).get("containers") or []
+        return (containers[0].get("name") if containers
+                else DEFAULT_CONTAINER) or DEFAULT_CONTAINER
+
+    @staticmethod
+    def _condition_from_state(state: dict) -> dict | None:
+        if "running" in state:
+            return {"type": "Running",
+                    "lastProbeTime": state["running"].get("startedAt", "")}
+        if "waiting" in state:
+            return {"type": "Waiting",
+                    "reason": state["waiting"].get("reason", "")}
+        if "terminated" in state:
+            return {"type": "Terminated",
+                    "reason": state["terminated"].get("reason", "")}
+        return None

@@ -79,14 +79,16 @@ class Informer:
                 rv = listing["metadata"].get("resourceVersion", "0")
                 fresh = {self._key(o): o for o in listing.get("items", [])}
                 with self._lock:
-                    stale = set(self._cache) - set(fresh)
+                    # Keep the last-known objects for keys that vanished while
+                    # the watch was down — handlers (e.g. Owns mapping by
+                    # ownerReferences) need the real object, not a stub.
+                    stale_objs = [
+                        obj for key, obj in self._cache.items()
+                        if key not in fresh
+                    ]
                     self._cache = fresh
-                for key in stale:
-                    self._dispatch(
-                        "DELETED",
-                        {"metadata": {"namespace": key[0] or None,
-                                      "name": key[1]}},
-                    )
+                for obj in stale_objs:
+                    self._dispatch("DELETED", obj)
                 for obj in fresh.values():
                     self._dispatch("SYNC", obj)
                 self._synced.set()

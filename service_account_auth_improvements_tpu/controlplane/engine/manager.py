@@ -114,6 +114,11 @@ class Manager:
     def informer(self, plural: str, group: str | None = None) -> Informer:
         key = (group or "", plural)
         if key not in self._informers:
+            if self._started:
+                raise RuntimeError(
+                    "cannot register new watches after Manager.start() — "
+                    "the informer thread would never run"
+                )
             self._informers[key] = Informer(
                 self.client, plural, group=group, namespace=self.namespace
             )
@@ -121,6 +126,10 @@ class Manager:
 
     def add_reconciler(self, reconciler: Reconciler,
                        workers: int = 1) -> Controller:
+        if self._started:
+            raise RuntimeError(
+                "cannot add reconcilers after Manager.start()"
+            )
         ctl = Controller(self, reconciler, workers=workers)
         self._controllers.append(ctl)
 

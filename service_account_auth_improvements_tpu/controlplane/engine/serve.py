@@ -1,0 +1,47 @@
+"""Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz.
+
+The manager-port surface of the reference binaries (metrics on :8080,
+probes — components/notebook-controller/main.go:64-131).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
+
+
+def serve_ops(port: int, registry=None, ready_check=None,
+              host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Start the ops endpoint in a daemon thread; returns the server."""
+    reg = registry if registry is not None else REGISTRY
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_GET(self):
+            if self.path.startswith("/metrics"):
+                body = reg.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+            elif self.path.startswith("/healthz"):
+                body = b"ok"
+                self.send_response(200)
+            elif self.path.startswith("/readyz"):
+                ok = ready_check() if ready_check else True
+                body = b"ok" if ok else b"not ready"
+                self.send_response(200 if ok else 503)
+            else:
+                body = b"not found"
+                self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

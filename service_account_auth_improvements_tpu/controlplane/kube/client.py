@@ -25,6 +25,20 @@ from service_account_auth_improvements_tpu.controlplane.kube.registry import (
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
 
+def _error_from_body(status_code: int, data: bytes) -> errors.ApiError:
+    """Build an ApiError from a response body that may not be a JSON Status
+    (proxies return HTML/plain-text; some servers return bare JSON strings)."""
+    try:
+        parsed = json.loads(data)
+        if isinstance(parsed, dict):
+            return errors.ApiError.from_status(parsed)
+    except ValueError:
+        pass
+    err = errors.ApiError(data.decode(errors="replace")[:2048])
+    err.code = status_code
+    return err
+
+
 class KubeClient:
     def __init__(self, base_url: str | None = None, token: str | None = None,
                  ca_file: str | None = None, registry: Registry | None = None,
@@ -93,12 +107,7 @@ class KubeClient:
             resp = conn.getresponse()
             data = resp.read()
             if resp.status >= 400:
-                try:
-                    raise errors.ApiError.from_status(json.loads(data))
-                except (ValueError, KeyError):
-                    err = errors.ApiError(data.decode(errors="replace"))
-                    err.code = resp.status
-                    raise err
+                raise _error_from_body(resp.status, data)
             return json.loads(data) if data else None
         finally:
             conn.close()
@@ -174,8 +183,7 @@ class KubeClient:
             )
             resp = conn.getresponse()
             if resp.status >= 400:
-                data = resp.read()
-                raise errors.ApiError.from_status(json.loads(data))
+                raise _error_from_body(resp.status, resp.read())
             buf = b""
             while True:
                 chunk = resp.read1(65536)

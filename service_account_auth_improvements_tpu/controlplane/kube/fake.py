@@ -210,11 +210,17 @@ class FakeKube:
              group: str | None = None) -> dict:
         res = self._res(plural, group)
         pred = parse_label_selector(label_selector)
-        fields = {}
+        fields = {}  # key -> (negate, value); supports =, ==, !=
         for term in (field_selector or "").split(","):
-            if "=" in term:
-                k, _, v = term.partition("=")
-                fields[k.strip()] = v.strip()
+            term = term.strip()
+            if not term:
+                continue
+            if "!=" in term:
+                k, _, v = term.partition("!=")
+                fields[k.strip()] = (True, v.strip())
+            elif "=" in term:
+                k, _, v = term.partition("==" if "==" in term else "=")
+                fields[k.strip()] = (False, v.strip())
         with self._lock:
             items = []
             for (g, p, ns, name), obj in self._store.items():
@@ -226,11 +232,11 @@ class FakeKube:
                     continue
                 if fields:
                     ok = True
-                    for fk, fv in fields.items():
+                    for fk, (negate, fv) in fields.items():
                         cur = obj
                         for part in fk.split("."):
                             cur = (cur or {}).get(part)
-                        if cur != fv:
+                        if (cur == fv) == negate:
                             ok = False
                             break
                     if not ok:
@@ -398,7 +404,11 @@ class FakeKube:
     def _filter_ns(self, ev, res, namespace):
         if namespace and res.namespaced:
             if ev["object"]["metadata"].get("namespace") != namespace:
-                return {"type": "BOOKMARK", "object": ev["object"]}
+                # Keep the stream's RV monotonic but never leak the foreign
+                # object across the namespace boundary.
+                rv = ev["object"]["metadata"].get("resourceVersion")
+                return {"type": "BOOKMARK",
+                        "object": {"metadata": {"resourceVersion": rv}}}
         return ev
 
     # -------------------------------------------------- WSGI wire protocol

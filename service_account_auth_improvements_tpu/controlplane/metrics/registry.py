@@ -160,6 +160,10 @@ class Registry:
 
     def register(self, m: _Metric):
         with self._lock:
+            if any(existing.name == m.name for existing in self._metrics):
+                raise ValueError(
+                    f"duplicate metric name {m.name!r} in registry"
+                )
             self._metrics.append(m)
 
     def render(self) -> str:

@@ -1,0 +1,169 @@
+"""TPU topology model: the accelerator-aware core of the control plane.
+
+The reference treats accelerators as an opaque limits key
+(``nvidia.com/gpu`` written by the spawner form — reference: components/
+crud-web-apps/jupyter/backend/apps/common/form.py:226-252) with zero
+topology awareness (SURVEY.md §2b). Here the accelerator is first-class:
+a ``TpuSpec`` in the Notebook CR resolves to GKE TPU node selectors,
+``google.com/tpu`` chip limits, host counts for multi-host slices, and the
+rendezvous env (``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``) the JAX
+workload layer consumes (parallel/multihost.py).
+
+Topology/host math follows the public GKE TPU documentation:
+single-host slices up to the per-host chip maximum, multi-host slices at
+4 chips per host.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+RESOURCE_TPU = "google.com/tpu"
+SEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
+SEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+ANNOTATION_SLICE = "tpukf.dev/tpu-slice"
+
+# accelerator -> (gke accelerator label value, dims, single-host max chips,
+#                 multi-host chips per host)
+GENERATIONS: dict[str, dict] = {
+    "v4": {
+        "selector": "tpu-v4-podslice", "dims": 3,
+        "single_host_max": 4, "chips_per_host": 4,
+    },
+    "v5e": {
+        "selector": "tpu-v5-lite-podslice", "dims": 2,
+        "single_host_max": 8, "chips_per_host": 4,
+    },
+    "v5p": {
+        "selector": "tpu-v5p-slice", "dims": 3,
+        "single_host_max": 4, "chips_per_host": 4,
+    },
+    "v6e": {
+        "selector": "tpu-v6e-slice", "dims": 2,
+        "single_host_max": 8, "chips_per_host": 4,
+    },
+}
+
+
+class TpuValidationError(ValueError):
+    pass
+
+
+def parse_topology(topology: str) -> tuple[int, ...]:
+    try:
+        dims = tuple(int(x) for x in topology.lower().split("x"))
+    except ValueError:
+        raise TpuValidationError(f"malformed topology {topology!r}")
+    if not dims or any(d < 1 for d in dims):
+        raise TpuValidationError(f"malformed topology {topology!r}")
+    return dims
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolvedTpu:
+    generation: str
+    topology: str
+    total_chips: int
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def selector(self) -> dict[str, str]:
+        return {
+            SEL_ACCELERATOR: GENERATIONS[self.generation]["selector"],
+            SEL_TOPOLOGY: self.topology,
+        }
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+
+def resolve(spec: dict | None) -> ResolvedTpu | None:
+    """Resolve a Notebook ``spec.tpu`` block.
+
+    Accepted keys: ``generation`` (v4|v5e|v5p|v6e), ``topology`` ("2x4"),
+    or ``chips`` (topology inferred for single-host sizes). Returns None
+    when the spec is absent (CPU notebook).
+    """
+    if not spec:
+        return None
+    gen = str(spec.get("generation", "v5e")).lower()
+    if gen not in GENERATIONS:
+        raise TpuValidationError(
+            f"unknown TPU generation {gen!r}; know {sorted(GENERATIONS)}"
+        )
+    info = GENERATIONS[gen]
+    topology = spec.get("topology")
+    chips = spec.get("chips")
+    if topology is None and chips is None:
+        raise TpuValidationError("tpu spec needs topology or chips")
+    if topology is None:
+        chips = int(chips)
+        topology = _infer_topology(gen, chips)
+    dims = parse_topology(str(topology))
+    if len(dims) != info["dims"]:
+        raise TpuValidationError(
+            f"{gen} topologies have {info['dims']} dims, got {topology!r}"
+        )
+    total = math.prod(dims)
+    if chips is not None and int(chips) != total:
+        raise TpuValidationError(
+            f"chips={chips} inconsistent with topology {topology} ({total})"
+        )
+    if total <= info["single_host_max"]:
+        hosts, per_host = 1, total
+    else:
+        per_host = info["chips_per_host"]
+        if total % per_host:
+            raise TpuValidationError(
+                f"multi-host slice of {total} chips not divisible by "
+                f"{per_host} chips/host"
+            )
+        hosts = total // per_host
+    return ResolvedTpu(
+        generation=gen, topology=str(topology).lower(), total_chips=total,
+        num_hosts=hosts, chips_per_host=per_host,
+    )
+
+
+def _infer_topology(gen: str, chips: int) -> str:
+    info = GENERATIONS[gen]
+    if info["dims"] == 2:
+        known = {1: "1x1", 4: "2x2", 8: "2x4", 16: "4x4", 32: "4x8",
+                 64: "8x8", 128: "8x16", 256: "16x16"}
+    else:
+        known = {4: "2x2x1", 8: "2x2x2", 16: "2x2x4", 32: "2x4x4",
+                 64: "4x4x4", 128: "4x4x8"}
+    if chips not in known:
+        raise TpuValidationError(
+            f"cannot infer {gen} topology for {chips} chips; "
+            f"specify topology explicitly"
+        )
+    return known[chips]
+
+
+def worker_env(name: str, service: str, namespace: str,
+               resolved: ResolvedTpu) -> list[dict]:
+    """Env vars for slice rendezvous, consumed by parallel/multihost.py.
+
+    TPU_WORKER_ID comes from the pod-index label via the downward API
+    (StatefulSet ordinal); hostnames are the headless-service DNS names.
+    The reference's closest analog is its NB_PREFIX env plumbing
+    (components/notebook-controller/controllers/notebook_controller.go:
+    345-359) — topology-blind, single pod.
+    """
+    hostnames = ",".join(
+        f"{name}-{i}.{service}.{namespace}.svc"
+        for i in range(resolved.num_hosts)
+    )
+    return [
+        {"name": "TPU_WORKER_ID", "valueFrom": {"fieldRef": {
+            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"
+        }}},
+        {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
+        {"name": "TPU_TOPOLOGY", "value": resolved.topology},
+        {"name": "TPU_CHIPS_PER_HOST", "value": str(resolved.chips_per_host)},
+    ]

@@ -1,0 +1,109 @@
+"""Culling controller: idleness detection → stop annotation."""
+
+import datetime as dt
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.culling import (
+    CULLING_POLICY,
+    LAST_ACTIVITY,
+    LAST_CHECK,
+    CullingReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Request
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+
+NOW = dt.datetime(2026, 7, 29, 12, 0, 0, tzinfo=dt.timezone.utc)
+
+
+def _world(kernels, annotations=None, idle_minutes=60):
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "nb", "namespace": "u",
+                     "annotations": annotations or {}},
+        "spec": {},
+    })
+    rec = CullingReconciler(
+        kube, fetch_kernels=lambda url: kernels, now=lambda: NOW
+    )
+    rec.cull_idle_minutes = idle_minutes
+    return kube, rec
+
+
+def _annots(kube):
+    return kube.get("notebooks", "nb", namespace="u",
+                    group="tpukf.dev")["metadata"]["annotations"]
+
+
+def test_busy_kernel_keeps_alive_and_stamps_activity():
+    kube, rec = _world([{"execution_state": "busy"}])
+    res = rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[LAST_ACTIVITY] == "2026-07-29T12:00:00Z"
+    assert a[LAST_CHECK] == "2026-07-29T12:00:00Z"
+    assert res.requeue_after == 60.0  # IDLENESS_CHECK_PERIOD default 1 min
+
+
+def test_idle_past_threshold_is_culled():
+    stale = (NOW - dt.timedelta(minutes=120)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec = _world(
+        [{"execution_state": "idle", "last_activity": stale}],
+        idle_minutes=60,
+    )
+    rec.reconcile(Request("u", "nb"))
+    assert STOP_ANNOTATION in _annots(kube)
+
+
+def test_idle_within_threshold_survives():
+    recent = (NOW - dt.timedelta(minutes=30)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec = _world(
+        [{"execution_state": "idle", "last_activity": recent}],
+        idle_minutes=60,
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[LAST_ACTIVITY] == recent
+
+
+def test_unreachable_probe_never_culls():
+    # Even with ancient recorded activity, a failed probe must not cull
+    # (pod may be booting/crashed); only the check timestamp is stamped.
+    old = (NOW - dt.timedelta(days=7)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec = _world(None, annotations={LAST_ACTIVITY: old})
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[LAST_CHECK] == "2026-07-29T12:00:00Z"
+    assert a[LAST_ACTIVITY] == old
+
+
+def test_training_policy_opts_out():
+    kube, rec = _world(
+        [{"execution_state": "idle",
+          "last_activity": "2020-01-01T00:00:00Z"}],
+        annotations={CULLING_POLICY: "training"},
+    )
+    rec.reconcile(Request("u", "nb"))
+    assert STOP_ANNOTATION not in _annots(kube)
+
+
+def test_already_stopped_is_skipped():
+    kube, rec = _world(
+        [{"execution_state": "idle"}],
+        annotations={STOP_ANNOTATION: "x"},
+    )
+    res = rec.reconcile(Request("u", "nb"))
+    assert res.requeue_after == 0.0
+    assert LAST_CHECK not in _annots(kube)
+
+
+def test_kernels_url_shape():
+    kube, rec = _world([])
+    assert rec.kernels_url("nb", "u") == (
+        "http://nb.u.svc.cluster.local/notebook/u/nb/api/kernels"
+    )

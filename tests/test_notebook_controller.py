@@ -1,0 +1,197 @@
+"""Notebook controller: CR → StatefulSet/Service/VS, TPU resolution, status.
+
+The envtest model (SURVEY.md §4.2): the pod never runs; we assert on the
+objects the controller writes.
+"""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _nb(name="nb1", ns="user1", tpu_spec=None, annotations=None):
+    obj = {
+        "metadata": {"name": name, "namespace": ns,
+                     "annotations": annotations or {}},
+        "spec": {
+            "template": {"spec": {"containers": [{
+                "name": "notebook",
+                "image": "ghcr.io/tpukf/jupyter-jax-tpu:latest",
+            }]}},
+        },
+    }
+    if tpu_spec:
+        obj["spec"]["tpu"] = tpu_spec
+    return obj
+
+
+@pytest.fixture()
+def world(monkeypatch):
+    monkeypatch.setenv("USE_ISTIO", "true")
+    kube = FakeKube()
+    mgr = Manager(kube)
+    NotebookReconciler(kube).register(mgr)
+    mgr.start()
+    yield kube, mgr
+    mgr.stop()
+
+
+def _sts(kube, name="nb1", ns="user1"):
+    try:
+        return kube.get("statefulsets", name, namespace=ns, group="apps")
+    except errors.NotFound:
+        return None
+
+
+def test_cpu_notebook_creates_children_no_tpu_no_gpu(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _sts(kube) is not None)
+    sts = _sts(kube)
+    assert sts["spec"]["replicas"] == 1
+    pod = sts["spec"]["template"]["spec"]
+    limits = pod["containers"][0].get("resources", {}).get("limits", {})
+    assert "nvidia.com/gpu" not in limits
+    assert tpu.RESOURCE_TPU not in limits
+    env = {e["name"]: e.get("value") for e in pod["containers"][0]["env"]}
+    assert env["NB_PREFIX"] == "/notebook/user1/nb1"
+    # Services: routing + headless for slice DNS.
+    svc = kube.get("services", "nb1", namespace="user1")
+    assert svc["spec"]["ports"][0]["targetPort"] == 8888
+    hl = kube.get("services", "nb1-hl", namespace="user1")
+    assert hl["spec"]["clusterIP"] == "None"
+    # Istio VS at the notebook prefix.
+    vs = kube.get("virtualservices", "notebook-user1-nb1",
+                  namespace="user1", group="networking.istio.io")
+    prefix = vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+    assert prefix == "/notebook/user1/nb1/"
+
+
+def test_single_host_tpu_notebook(world):
+    kube, _ = world
+    kube.create("notebooks", _nb(tpu_spec={"generation": "v5e", "chips": 8}))
+    assert _wait(lambda: _sts(kube) is not None)
+    sts = _sts(kube)
+    assert sts["spec"]["replicas"] == 1
+    pod = sts["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    assert c["resources"]["limits"][tpu.RESOURCE_TPU] == "8"
+    assert pod["nodeSelector"][tpu.SEL_ACCELERATOR] == "tpu-v5-lite-podslice"
+    assert pod["nodeSelector"][tpu.SEL_TOPOLOGY] == "2x4"
+
+
+def test_multi_host_slice_replicas_and_rendezvous(world):
+    kube, _ = world
+    kube.create("notebooks", _nb(
+        name="big", tpu_spec={"generation": "v5e", "topology": "4x4"},
+    ))
+    assert _wait(lambda: _sts(kube, "big") is not None)
+    sts = _sts(kube, "big")
+    assert sts["spec"]["replicas"] == 4  # 16 chips / 4 per host
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    env = {e["name"]: e for e in c["env"]}
+    hosts = env["TPU_WORKER_HOSTNAMES"]["value"].split(",")
+    assert len(hosts) == 4
+    assert hosts[0] == "big-0.big-hl.user1.svc"
+    assert env["TPU_WORKER_ID"]["valueFrom"]["fieldRef"]["fieldPath"] == (
+        "metadata.labels['apps.kubernetes.io/pod-index']"
+    )
+    assert c["resources"]["limits"][tpu.RESOURCE_TPU] == "4"
+
+
+def test_stop_annotation_scales_to_zero_and_resume(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _sts(kube) is not None)
+    kube.patch(
+        "notebooks", "nb1",
+        {"metadata": {"annotations": {STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}},
+        namespace="user1", group="tpukf.dev",
+    )
+    assert _wait(lambda: _sts(kube)["spec"]["replicas"] == 0)
+    kube.patch(
+        "notebooks", "nb1",
+        [{"op": "remove",
+          "path": "/metadata/annotations/tpukf.dev~1resource-stopped"}],
+        namespace="user1", group="tpukf.dev", patch_type="json",
+    )
+    assert _wait(lambda: _sts(kube)["spec"]["replicas"] == 1)
+
+
+def test_sts_drift_is_reverted(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _sts(kube) is not None)
+    sts = _sts(kube)
+    sts["spec"]["replicas"] = 5
+    kube.update("statefulsets", sts, group="apps")
+    assert _wait(lambda: _sts(kube)["spec"]["replicas"] == 1)
+
+
+def test_status_mirrors_rank0_pod(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _sts(kube) is not None)
+    kube.create("pods", {
+        "metadata": {"name": "nb1-0", "namespace": "user1",
+                     "labels": {"statefulset": "nb1",
+                                "notebook-name": "nb1"}},
+        "spec": {"containers": [{"name": "notebook", "image": "i"}]},
+        "status": {"containerStatuses": [{
+            "name": "notebook",
+            "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}},
+        }]},
+    })
+
+    def mirrored():
+        nb = kube.get("notebooks", "nb1", namespace="user1", group="tpukf.dev")
+        return "running" in (nb.get("status") or {}).get("containerState", {})
+
+    assert _wait(mirrored)
+
+
+def test_invalid_tpu_spec_sets_condition_not_retry_storm(world):
+    kube, _ = world
+    kube.create("notebooks", _nb(name="bad", tpu_spec={"generation": "h100"}))
+
+    def has_condition():
+        nb = kube.get("notebooks", "bad", namespace="user1", group="tpukf.dev")
+        conds = (nb.get("status") or {}).get("conditions") or []
+        return any(c["type"] == "InvalidTpuSpec" for c in conds)
+
+    assert _wait(has_condition)
+    assert _sts(kube, "bad") is None
+
+
+def test_tpu_resolution_table():
+    r = tpu.resolve({"generation": "v5e", "chips": 1})
+    assert (r.topology, r.num_hosts, r.chips_per_host) == ("1x1", 1, 1)
+    r = tpu.resolve({"generation": "v5p", "topology": "2x2x4"})
+    assert (r.total_chips, r.num_hosts, r.chips_per_host) == (16, 4, 4)
+    r = tpu.resolve({"generation": "v6e", "topology": "8x8"})
+    assert (r.total_chips, r.num_hosts) == (64, 16)
+    with pytest.raises(tpu.TpuValidationError):
+        tpu.resolve({"generation": "v5e", "topology": "3x5x2"})
+    with pytest.raises(tpu.TpuValidationError):
+        tpu.resolve({"generation": "v5e", "topology": "2x4", "chips": 16})
+    assert tpu.resolve(None) is None
