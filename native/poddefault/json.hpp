@@ -1,0 +1,351 @@
+// Minimal JSON value: parse + serialize, written for the PodDefault merge
+// engine. Design notes:
+//  - numbers are kept as their raw source tokens and re-emitted verbatim, so
+//    round-tripping a pod spec never rewrites 8888 as 8888.0;
+//  - object member order is preserved (vector of pairs), matching the
+//    behaviour of the JSON libraries on the Python side;
+//  - \uXXXX escapes (incl. surrogate pairs) are decoded to UTF-8 and
+//    re-encoded minimally on output.
+// No external dependencies; C++17.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace pdjson {
+
+class Value;
+using Member = std::pair<std::string, Value>;
+
+enum class Type { Null, Bool, Number, String, Array, Object };
+
+class Value {
+ public:
+  Type type = Type::Null;
+  bool boolean = false;
+  std::string number;  // raw token, e.g. "8888" or "1.5e3"
+  std::string str;
+  std::vector<Value> items;
+  std::vector<Member> members;
+
+  Value() = default;
+  static Value make_null() { return Value(); }
+  static Value make_bool(bool b) {
+    Value v; v.type = Type::Bool; v.boolean = b; return v;
+  }
+  static Value make_string(const std::string& s) {
+    Value v; v.type = Type::String; v.str = s; return v;
+  }
+  static Value make_array() { Value v; v.type = Type::Array; return v; }
+  static Value make_object() { Value v; v.type = Type::Object; return v; }
+
+  bool is_object() const { return type == Type::Object; }
+  bool is_array() const { return type == Type::Array; }
+  bool is_string() const { return type == Type::String; }
+  bool is_null() const { return type == Type::Null; }
+
+  const Value* find(const std::string& key) const {
+    if (type != Type::Object) return nullptr;
+    for (const auto& m : members)
+      if (m.first == key) return &m.second;
+    return nullptr;
+  }
+  Value* find(const std::string& key) {
+    if (type != Type::Object) return nullptr;
+    for (auto& m : members)
+      if (m.first == key) return &m.second;
+    return nullptr;
+  }
+  // Get-or-create member (object assumed/coerced).
+  Value& at_or_insert(const std::string& key, Type t) {
+    if (type != Type::Object) { type = Type::Object; members.clear(); }
+    for (auto& m : members)
+      if (m.first == key) return m.second;
+    Value v; v.type = t;
+    members.emplace_back(key, std::move(v));
+    return members.back().second;
+  }
+  void set(const std::string& key, Value v) {
+    if (type != Type::Object) { type = Type::Object; members.clear(); }
+    for (auto& m : members)
+      if (m.first == key) { m.second = std::move(v); return; }
+    members.emplace_back(key, std::move(v));
+  }
+
+  bool operator==(const Value& o) const {
+    if (type != o.type) return false;
+    switch (type) {
+      case Type::Null: return true;
+      case Type::Bool: return boolean == o.boolean;
+      case Type::Number: return num_eq(number, o.number);
+      case Type::String: return str == o.str;
+      case Type::Array: {
+        if (items.size() != o.items.size()) return false;
+        for (size_t i = 0; i < items.size(); ++i)
+          if (!(items[i] == o.items[i])) return false;
+        return true;
+      }
+      case Type::Object: {
+        if (members.size() != o.members.size()) return false;
+        for (const auto& m : members) {
+          const Value* ov = o.find(m.first);
+          if (!ov || !(m.second == *ov)) return false;
+        }
+        return true;
+      }
+    }
+    return false;
+  }
+  bool operator!=(const Value& o) const { return !(*this == o); }
+
+ private:
+  static bool num_eq(const std::string& a, const std::string& b) {
+    if (a == b) return true;
+    // Fall back to numeric comparison for representational differences.
+    try { return std::stod(a) == std::stod(b); } catch (...) { return false; }
+  }
+};
+
+// ------------------------------------------------------------------ parser
+
+class ParseError : public std::runtime_error {
+ public:
+  explicit ParseError(const std::string& m) : std::runtime_error(m) {}
+};
+
+class Parser {
+ public:
+  explicit Parser(const std::string& src) : s_(src) {}
+
+  Value parse() {
+    Value v = value();
+    ws();
+    if (pos_ != s_.size()) throw ParseError("trailing characters");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void fail(const std::string& why) {
+    throw ParseError(why + " at offset " + std::to_string(pos_));
+  }
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end");
+    return s_[pos_];
+  }
+  char next() { char c = peek(); ++pos_; return c; }
+  void ws() {
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if (c == ' ' || c == '\t' || c == '\n' || c == '\r') ++pos_;
+      else break;
+    }
+  }
+  void expect(char c) {
+    if (next() != c) fail(std::string("expected '") + c + "'");
+  }
+  bool consume(char c) {
+    if (pos_ < s_.size() && s_[pos_] == c) { ++pos_; return true; }
+    return false;
+  }
+
+  Value value() {
+    ws();
+    char c = peek();
+    switch (c) {
+      case '{': return object();
+      case '[': return array();
+      case '"': return Value::make_string(string());
+      case 't': literal("true"); return Value::make_bool(true);
+      case 'f': literal("false"); return Value::make_bool(false);
+      case 'n': literal("null"); return Value::make_null();
+      default: return number();
+    }
+  }
+  void literal(const char* lit) {
+    for (const char* p = lit; *p; ++p)
+      if (pos_ >= s_.size() || s_[pos_++] != *p) fail("bad literal");
+  }
+  Value object() {
+    expect('{');
+    Value v = Value::make_object();
+    ws();
+    if (consume('}')) return v;
+    while (true) {
+      ws();
+      std::string key = string();
+      ws();
+      expect(':');
+      Value item = value();
+      v.members.emplace_back(std::move(key), std::move(item));
+      ws();
+      if (consume(',')) continue;
+      expect('}');
+      return v;
+    }
+  }
+  Value array() {
+    expect('[');
+    Value v = Value::make_array();
+    ws();
+    if (consume(']')) return v;
+    while (true) {
+      v.items.push_back(value());
+      ws();
+      if (consume(',')) continue;
+      expect(']');
+      return v;
+    }
+  }
+  Value number() {
+    size_t start = pos_;
+    if (consume('-')) {}
+    if (pos_ >= s_.size()) fail("bad number");
+    while (pos_ < s_.size()) {
+      char c = s_[pos_];
+      if ((c >= '0' && c <= '9') || c == '.' || c == 'e' || c == 'E' ||
+          c == '+' || c == '-') ++pos_;
+      else break;
+    }
+    if (pos_ == start) fail("bad number");
+    Value v; v.type = Type::Number;
+    v.number = s_.substr(start, pos_ - start);
+    // Validate it parses.
+    try { (void)std::stod(v.number); } catch (...) { fail("bad number"); }
+    return v;
+  }
+  void utf8_append(std::string& out, uint32_t cp) {
+    if (cp < 0x80) out.push_back(static_cast<char>(cp));
+    else if (cp < 0x800) {
+      out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else if (cp < 0x10000) {
+      out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    } else {
+      out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+      out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+    }
+  }
+  uint32_t hex4() {
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) {
+      char c = next();
+      v <<= 4;
+      if (c >= '0' && c <= '9') v |= c - '0';
+      else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+      else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+      else fail("bad \\u escape");
+    }
+    return v;
+  }
+  std::string string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      char c = next();
+      if (c == '"') return out;
+      if (c == '\\') {
+        char e = next();
+        switch (e) {
+          case '"': out.push_back('"'); break;
+          case '\\': out.push_back('\\'); break;
+          case '/': out.push_back('/'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'n': out.push_back('\n'); break;
+          case 'r': out.push_back('\r'); break;
+          case 't': out.push_back('\t'); break;
+          case 'u': {
+            uint32_t cp = hex4();
+            if (cp >= 0xD800 && cp <= 0xDBFF) {
+              if (next() != '\\' || next() != 'u') fail("bad surrogate");
+              uint32_t lo = hex4();
+              if (lo < 0xDC00 || lo > 0xDFFF) fail("bad surrogate");
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+            utf8_append(out, cp);
+            break;
+          }
+          default: fail("bad escape");
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+  }
+};
+
+inline Value parse(const std::string& src) { return Parser(src).parse(); }
+
+// --------------------------------------------------------------- serialize
+
+inline void escape_to(const std::string& s, std::string& out) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+inline void dump_to(const Value& v, std::string& out) {
+  switch (v.type) {
+    case Type::Null: out += "null"; break;
+    case Type::Bool: out += v.boolean ? "true" : "false"; break;
+    case Type::Number: out += v.number; break;
+    case Type::String: escape_to(v.str, out); break;
+    case Type::Array: {
+      out.push_back('[');
+      for (size_t i = 0; i < v.items.size(); ++i) {
+        if (i) out.push_back(',');
+        dump_to(v.items[i], out);
+      }
+      out.push_back(']');
+      break;
+    }
+    case Type::Object: {
+      out.push_back('{');
+      for (size_t i = 0; i < v.members.size(); ++i) {
+        if (i) out.push_back(',');
+        escape_to(v.members[i].first, out);
+        out.push_back(':');
+        dump_to(v.members[i].second, out);
+      }
+      out.push_back('}');
+      break;
+    }
+  }
+}
+
+inline std::string dump(const Value& v) {
+  std::string out;
+  dump_to(v, out);
+  return out;
+}
+
+}  // namespace pdjson

@@ -1,0 +1,217 @@
+// PodDefault merge engine — the admission webhook's hot path, native.
+//
+// Behavior parity with the reference webhook's merge pipeline
+// (conflict-check then merge of volumes/volumeMounts/env/envFrom/
+// tolerations/imagePullSecrets/initContainers/sidecars/labels/annotations/
+// command/args/serviceAccount — reference: components/admission-webhook/
+// main.go:101-556), reimplemented from its documented behavior for JSON pod
+// specs. The Python side (webhook/engine.py) holds an identical fallback;
+// differential tests keep the two honest.
+//
+// C ABI:
+//   char* poddefault_apply(const char* request_json)
+//     request:  {"pod": {...}, "poddefaults": [{...}, ...]}
+//     response: {"pod": {...mutated...}, "applied": ["name", ...]}
+//               or {"error": "reason"}
+//   void poddefault_free(char*)
+//
+// Build: g++ -std=c++17 -O2 -shared -fPIC merge.cpp -o libpoddefault.so
+
+#include <string>
+#include <vector>
+
+#include "json.hpp"
+
+using pdjson::Type;
+using pdjson::Value;
+
+namespace {
+
+const char* kStampPrefix = "poddefault.admission.tpukf.dev/";
+
+std::string name_of(const Value& obj) {
+  const Value* meta = obj.find("metadata");
+  if (meta) {
+    const Value* n = meta->find("name");
+    if (n && n->is_string()) return n->str;
+  }
+  return "";
+}
+
+const Value* item_by_name(const Value& arr, const std::string& name) {
+  if (!arr.is_array()) return nullptr;
+  for (const auto& it : arr.items) {
+    const Value* n = it.find("name");
+    if (n && n->is_string() && n->str == name) return &it;
+  }
+  return nullptr;
+}
+
+// Append items from `src` into array member `key` of `dst_obj`, keyed by
+// item "name": identical duplicates are skipped, differing duplicates are a
+// conflict. Returns false + sets err on conflict.
+bool merge_named_array(Value& dst_obj, const std::string& key,
+                       const Value* src, const std::string& what,
+                       std::string* err) {
+  if (!src || !src->is_array() || src->items.empty()) return true;
+  Value& dst = dst_obj.at_or_insert(key, Type::Array);
+  for (const auto& it : src->items) {
+    const Value* n = it.find("name");
+    std::string nm = (n && n->is_string()) ? n->str : "";
+    const Value* existing = item_by_name(dst, nm);
+    if (existing) {
+      if (*existing != it) {
+        *err = what + " '" + nm + "' already exists with different content";
+        return false;
+      }
+      continue;
+    }
+    dst.items.push_back(it);
+  }
+  return true;
+}
+
+// Append unique whole-value items (tolerations have no name key).
+void merge_plain_array(Value& dst_obj, const std::string& key,
+                       const Value* src) {
+  if (!src || !src->is_array() || src->items.empty()) return;
+  Value& dst = dst_obj.at_or_insert(key, Type::Array);
+  for (const auto& it : src->items) {
+    bool dup = false;
+    for (const auto& have : dst.items)
+      if (have == it) { dup = true; break; }
+    if (!dup) dst.items.push_back(it);
+  }
+}
+
+bool merge_string_map(Value& meta, const std::string& key, const Value* src,
+                      const std::string& what, std::string* err) {
+  if (!src || !src->is_object() || src->members.empty()) return true;
+  Value& dst = meta.at_or_insert(key, Type::Object);
+  for (const auto& m : src->members) {
+    const Value* have = dst.find(m.first);
+    if (have) {
+      if (*have != m.second) {
+        *err = what + " '" + m.first + "' conflicts with existing value";
+        return false;
+      }
+      continue;
+    }
+    dst.set(m.first, m.second);
+  }
+  return true;
+}
+
+bool apply_to_containers(Value& pod_spec, const Value& pd_spec,
+                         std::string* err) {
+  Value* containers = pod_spec.find("containers");
+  if (!containers || !containers->is_array()) return true;
+  for (auto& c : containers->items) {
+    if (!merge_named_array(c, "env", pd_spec.find("env"), "env var", err))
+      return false;
+    merge_plain_array(c, "envFrom", pd_spec.find("envFrom"));
+    if (!merge_named_array(c, "volumeMounts", pd_spec.find("volumeMounts"),
+                           "volumeMount", err))
+      return false;
+  }
+  // command/args apply to the first (main) container only, and only when
+  // the image's own entrypoint is not overridden already.
+  if (!containers->items.empty()) {
+    Value& main = containers->items[0];
+    const Value* cmd = pd_spec.find("command");
+    if (cmd && !main.find("command")) main.set("command", *cmd);
+    const Value* args = pd_spec.find("args");
+    if (args && !main.find("args")) main.set("args", *args);
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+char* poddefault_apply(const char* request_json) {
+  std::string out;
+  try {
+    Value req = pdjson::parse(request_json ? request_json : "");
+    const Value* podp = req.find("pod");
+    const Value* pds = req.find("poddefaults");
+    if (!podp || !pds || !pds->is_array()) {
+      out = "{\"error\":\"request needs pod and poddefaults\"}";
+    } else {
+      Value pod = *podp;  // mutate a copy
+      Value& meta = pod.at_or_insert("metadata", Type::Object);
+      Value& spec = pod.at_or_insert("spec", Type::Object);
+      Value applied = Value::make_array();
+      std::string err;
+      bool ok = true;
+      for (const auto& pd : pds->items) {
+        const Value* pd_specp = pd.find("spec");
+        if (!pd_specp) continue;
+        const Value& ps = *pd_specp;
+        if (!merge_string_map(meta, "labels", ps.find("labels"), "label",
+                              &err) ||
+            !merge_string_map(meta, "annotations", ps.find("annotations"),
+                              "annotation", &err) ||
+            !merge_named_array(spec, "volumes", ps.find("volumes"), "volume",
+                               &err) ||
+            !merge_named_array(spec, "initContainers",
+                               ps.find("initContainers"), "initContainer",
+                               &err) ||
+            !merge_named_array(spec, "containers", ps.find("sidecars"),
+                               "container", &err) ||
+            !apply_to_containers(spec, ps, &err)) {
+          ok = false;
+          break;
+        }
+        merge_plain_array(spec, "tolerations", ps.find("tolerations"));
+        if (!merge_named_array(spec, "imagePullSecrets",
+                               ps.find("imagePullSecrets"),
+                               "imagePullSecret", &err)) {
+          ok = false;
+          break;
+        }
+        const Value* sa = ps.find("serviceAccountName");
+        if (sa && sa->is_string() && !spec.find("serviceAccountName"))
+          spec.set("serviceAccountName", *sa);
+        const Value* am = ps.find("automountServiceAccountToken");
+        if (am && !spec.find("automountServiceAccountToken"))
+          spec.set("automountServiceAccountToken", *am);
+        // Stamp which defaults were applied (reference stamps an
+        // annotation per applied PodDefault).
+        std::string pd_name = name_of(pd);
+        std::string rv;
+        if (const Value* m = pd.find("metadata"))
+          if (const Value* r = m->find("resourceVersion"))
+            if (r->is_string()) rv = r->str;
+        Value& annots = meta.at_or_insert("annotations", Type::Object);
+        annots.set(kStampPrefix + pd_name,
+                   Value::make_string(rv.empty() ? "applied" : rv));
+        applied.items.push_back(Value::make_string(pd_name));
+      }
+      if (!ok) {
+        Value resp = Value::make_object();
+        resp.set("error", Value::make_string(err));
+        out = pdjson::dump(resp);
+      } else {
+        Value resp = Value::make_object();
+        resp.set("pod", std::move(pod));
+        resp.set("applied", std::move(applied));
+        out = pdjson::dump(resp);
+      }
+    }
+  } catch (const std::exception& e) {
+    Value resp = Value::make_object();
+    resp.set("error",
+             Value::make_string(std::string("engine exception: ") + e.what()));
+    out = pdjson::dump(resp);
+  }
+  char* buf = new char[out.size() + 1];
+  out.copy(buf, out.size());
+  buf[out.size()] = '\0';
+  return buf;
+}
+
+void poddefault_free(char* p) { delete[] p; }
+
+}  // extern "C"

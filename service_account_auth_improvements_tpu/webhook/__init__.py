@@ -1,0 +1,1 @@
+"""PodDefaults admission webhook (L3 of the layer map, SURVEY.md §1)."""

@@ -1,0 +1,167 @@
+"""PodDefault merge engine: C++ fast path + identical Python fallback.
+
+The native library (native/poddefault/merge.cpp) is the production engine;
+this module loads it via ctypes, auto-building with g++ on first use when
+the toolchain is present. ``apply_py`` is the semantics-identical Python
+implementation used as fallback and as the differential-test oracle.
+
+Reference behavior being matched: components/admission-webhook/main.go —
+conflict check (:101 safeToApplyPodDefaultsOnPod) then merge (:480
+applyPodDefaultsOnPod, merge fns :170-475).
+"""
+
+from __future__ import annotations
+
+import copy
+import ctypes
+import json
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger(__name__)
+
+STAMP_PREFIX = "poddefault.admission.tpukf.dev/"
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpoddefault.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_lib_failed = False
+
+
+def _load_native():
+    global _lib, _lib_failed
+    with _lib_lock:
+        if _lib is not None or _lib_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO_PATH):
+                subprocess.run(
+                    ["make", "-C", _NATIVE_DIR],
+                    check=True, capture_output=True, timeout=120,
+                )
+            lib = ctypes.CDLL(_SO_PATH)
+            lib.poddefault_apply.argtypes = [ctypes.c_char_p]
+            lib.poddefault_apply.restype = ctypes.c_void_p
+            lib.poddefault_free.argtypes = [ctypes.c_void_p]
+            lib.poddefault_free.restype = None
+            _lib = lib
+        except Exception:
+            log.exception("native poddefault engine unavailable; "
+                          "using python fallback")
+            _lib_failed = True
+        return _lib
+
+
+class MergeConflict(Exception):
+    pass
+
+
+def apply_native(pod: dict, poddefaults: list[dict]) -> tuple[dict, list[str]]:
+    lib = _load_native()
+    if lib is None:
+        return apply_py(pod, poddefaults)
+    req = json.dumps({"pod": pod, "poddefaults": poddefaults}).encode()
+    ptr = lib.poddefault_apply(req)
+    try:
+        resp = json.loads(ctypes.string_at(ptr))
+    finally:
+        lib.poddefault_free(ptr)
+    if "error" in resp:
+        raise MergeConflict(resp["error"])
+    return resp["pod"], resp["applied"]
+
+
+# ------------------------------------------------------- python fallback
+
+def _merge_named_array(obj: dict, key: str, src, what: str) -> None:
+    if not src:
+        return
+    dst = obj.setdefault(key, [])
+    have = {item.get("name"): item for item in dst}
+    for item in src:
+        name = item.get("name")
+        if name in have:
+            if have[name] != item:
+                raise MergeConflict(
+                    f"{what} '{name}' already exists with different content"
+                )
+            continue
+        dst.append(copy.deepcopy(item))
+        have[name] = item
+
+
+def _merge_plain_array(obj: dict, key: str, src) -> None:
+    if not src:
+        return
+    dst = obj.setdefault(key, [])
+    for item in src:
+        if item not in dst:
+            dst.append(copy.deepcopy(item))
+
+
+def _merge_string_map(meta: dict, key: str, src, what: str) -> None:
+    if not src:
+        return
+    dst = meta.setdefault(key, {})
+    for k, v in src.items():
+        if k in dst:
+            if dst[k] != v:
+                raise MergeConflict(
+                    f"{what} '{k}' conflicts with existing value"
+                )
+            continue
+        dst[k] = v
+
+
+def apply_py(pod: dict, poddefaults: list[dict]) -> tuple[dict, list[str]]:
+    pod = copy.deepcopy(pod)
+    meta = pod.setdefault("metadata", {})
+    spec = pod.setdefault("spec", {})
+    applied: list[str] = []
+    for pd in poddefaults:
+        ps = pd.get("spec") or {}
+        _merge_string_map(meta, "labels", ps.get("labels"), "label")
+        _merge_string_map(
+            meta, "annotations", ps.get("annotations"), "annotation"
+        )
+        _merge_named_array(spec, "volumes", ps.get("volumes"), "volume")
+        _merge_named_array(
+            spec, "initContainers", ps.get("initContainers"), "initContainer"
+        )
+        _merge_named_array(spec, "containers", ps.get("sidecars"), "container")
+        for c in spec.get("containers", []):
+            _merge_named_array(c, "env", ps.get("env"), "env var")
+            _merge_plain_array(c, "envFrom", ps.get("envFrom"))
+            _merge_named_array(
+                c, "volumeMounts", ps.get("volumeMounts"), "volumeMount"
+            )
+        containers = spec.get("containers", [])
+        if containers:
+            if "command" in ps and "command" not in containers[0]:
+                containers[0]["command"] = copy.deepcopy(ps["command"])
+            if "args" in ps and "args" not in containers[0]:
+                containers[0]["args"] = copy.deepcopy(ps["args"])
+        _merge_plain_array(spec, "tolerations", ps.get("tolerations"))
+        _merge_named_array(
+            spec, "imagePullSecrets", ps.get("imagePullSecrets"),
+            "imagePullSecret",
+        )
+        if ps.get("serviceAccountName") and "serviceAccountName" not in spec:
+            spec["serviceAccountName"] = ps["serviceAccountName"]
+        if "automountServiceAccountToken" in ps and \
+                "automountServiceAccountToken" not in spec:
+            spec["automountServiceAccountToken"] = ps[
+                "automountServiceAccountToken"
+            ]
+        name = (pd.get("metadata") or {}).get("name", "")
+        rv = (pd.get("metadata") or {}).get("resourceVersion") or "applied"
+        meta.setdefault("annotations", {})[STAMP_PREFIX + name] = rv
+        applied.append(name)
+    return pod, applied

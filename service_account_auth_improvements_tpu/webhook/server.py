@@ -1,0 +1,155 @@
+"""AdmissionReview webhook server for PodDefaults.
+
+Mutating-webhook endpoint ``/apply-poddefault`` on pod CREATE (reference:
+components/admission-webhook/main.go:751-773): lists PodDefault CRs in the
+pod's namespace, label-selector matches them (main.go:72 filterPodDefaults),
+runs the merge engine (native C++ with Python fallback, webhook/engine.py)
+and responds with an RFC-6902 patch. Opt-out annotation
+``poddefault.tpukf.dev/exclude`` (reference :627). Conflicts admit the pod
+UNMODIFIED (fail-open mutation, matching the reference's conflict policy)
+with a warning in the response.
+
+TPU role: this is the mechanism that injects slice env (MEGASCALE_*/JAX
+flags) into every pod of a profile namespace — BASELINE.json config #3.
+"""
+
+from __future__ import annotations
+
+import base64
+import copy
+import json
+import logging
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+    match_selector,
+)
+from service_account_auth_improvements_tpu.webhook import engine
+
+log = logging.getLogger(__name__)
+
+EXCLUDE_ANNOTATION = "poddefault.tpukf.dev/exclude"
+GROUP = "tpukf.dev"
+
+
+def filter_poddefaults(pod: dict, poddefaults: list[dict]) -> list[dict]:
+    """Label-selector match, sorted by name for deterministic application."""
+    annots = (pod.get("metadata") or {}).get("annotations") or {}
+    if annots.get(EXCLUDE_ANNOTATION, "").lower() == "true":
+        return []
+    matched = [
+        pd for pd in poddefaults
+        if match_selector(pod, (pd.get("spec") or {}).get("selector"))
+    ]
+    return sorted(matched, key=lambda p: (p.get("metadata") or {}).get("name", ""))
+
+
+def mutate_pod(pod: dict, poddefaults: list[dict]) -> tuple[list, list[str], str]:
+    """Return (json_patch_ops, applied_names, warning)."""
+    selected = filter_poddefaults(pod, poddefaults)
+    if not selected:
+        return [], [], ""
+    try:
+        mutated, applied = engine.apply_native(pod, selected)
+    except engine.MergeConflict as e:
+        return [], [], f"poddefaults skipped: {e}"
+    ops = []
+    if mutated.get("spec") != pod.get("spec"):
+        ops.append({"op": "replace", "path": "/spec", "value": mutated["spec"]})
+    for field in ("labels", "annotations"):
+        old = (pod.get("metadata") or {}).get(field)
+        new = (mutated.get("metadata") or {}).get(field)
+        if new != old:
+            op = "replace" if old is not None else "add"
+            ops.append({
+                "op": op, "path": f"/metadata/{field}", "value": new,
+            })
+    return ops, applied, ""
+
+
+def review_response(review: dict, list_poddefaults) -> dict:
+    """Process an AdmissionReview request dict → AdmissionReview response."""
+    request = review.get("request") or {}
+    uid = request.get("uid", "")
+    pod = request.get("object") or {}
+    namespace = request.get("namespace") or (
+        pod.get("metadata") or {}
+    ).get("namespace")
+    resp: dict = {"uid": uid, "allowed": True}
+    try:
+        pds = list_poddefaults(namespace)
+        ops, applied, warning = mutate_pod(pod, pds)
+        if warning:
+            resp["warnings"] = [warning]
+        if ops:
+            resp["patchType"] = "JSONPatch"
+            resp["patch"] = base64.b64encode(
+                json.dumps(ops).encode()
+            ).decode()
+            resp["auditAnnotations"] = {
+                "poddefaults-applied": ",".join(applied)
+            }
+    except Exception as e:  # never block pod creation on webhook bugs
+        log.exception("webhook mutation failed")
+        resp["warnings"] = [f"poddefault webhook error: {e}"]
+    return {
+        "apiVersion": review.get("apiVersion", "admission.k8s.io/v1"),
+        "kind": "AdmissionReview",
+        "response": resp,
+    }
+
+
+def make_server(kube, port: int = 8443, certfile: str | None = None,
+                keyfile: str | None = None,
+                host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """HTTP(S) server exposing /apply-poddefault (+ /healthz)."""
+
+    def list_poddefaults(namespace):
+        out = kube.list("poddefaults", namespace=namespace, group=GROUP)
+        return out.get("items", [])
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = b"ok" if self.path.startswith("/healthz") else b"not found"
+            self.send_response(200 if body == b"ok" else 404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            if not self.path.startswith("/apply-poddefault"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            try:
+                review = json.loads(self.rfile.read(length))
+                out = review_response(review, list_poddefaults)
+                payload = json.dumps(out).encode()
+                self.send_response(200)
+            except Exception as e:
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    server = ThreadingHTTPServer((host, port), Handler)
+    if certfile:
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(certfile, keyfile)
+        server.socket = ctx.wrap_socket(server.socket, server_side=True)
+    return server
+
+
+def serve_background(kube, port: int = 8443, **kw) -> ThreadingHTTPServer:
+    server = make_server(kube, port, **kw)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server

@@ -1,0 +1,181 @@
+"""PodDefault webhook: C++/Python differential, conflicts, AdmissionReview."""
+
+import base64
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+    _apply_json_patch,
+)
+from service_account_auth_improvements_tpu.webhook import engine, server
+
+
+def _pod(labels=None, annotations=None, env=None):
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": "p", "namespace": "u",
+            "labels": labels or {"notebook-name": "nb"},
+            "annotations": annotations or {},
+        },
+        "spec": {
+            "containers": [{
+                "name": "notebook", "image": "img",
+                "env": env or [{"name": "A", "value": "1"}],
+                "ports": [{"containerPort": 8888}],
+            }],
+        },
+    }
+
+
+def _pd(name="tpu-env", rv="7", **spec):
+    return {
+        "metadata": {"name": name, "namespace": "u", "resourceVersion": rv},
+        "spec": {"selector": {"matchLabels": {}}, **spec},
+    }
+
+
+TPU_PD = _pd(
+    env=[
+        {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": "nb-hl:8080"},
+        {"name": "JAX_PLATFORMS", "value": "tpu"},
+    ],
+    tolerations=[{"key": "google.com/tpu", "operator": "Exists",
+                  "effect": "NoSchedule"}],
+    labels={"tpu-injected": "true"},
+    volumes=[{"name": "dshm", "emptyDir": {"medium": "Memory"}}],
+    volumeMounts=[{"name": "dshm", "mountPath": "/dev/shm"}],
+)
+
+CASES = [
+    ("tpu_env", _pod(), [TPU_PD]),
+    ("sidecar_init", _pod(), [_pd(
+        name="proxy",
+        sidecars=[{"name": "istio-proxy", "image": "proxy:1"}],
+        initContainers=[{"name": "init-home", "image": "busybox"}],
+        imagePullSecrets=[{"name": "regcred"}],
+        serviceAccountName="default-editor",
+    )]),
+    ("cmd_args", _pod(), [_pd(
+        name="cmd", command=["jupyter"], args=["lab", "--port=8888"],
+        annotations={"sidecar.istio.io/inject": "false"},
+    )]),
+    ("two_defaults", _pod(), [TPU_PD, _pd(
+        name="extra", env=[{"name": "B", "value": "2"}],
+    )]),
+    ("idempotent_dup", _pod(env=[
+        {"name": "JAX_PLATFORMS", "value": "tpu"},
+    ]), [TPU_PD]),
+    ("unicode", _pod(labels={"team": "café"}), [_pd(
+        name="uni", annotations={"note": "日本語 \"quoted\" \\slash\n"},
+    )]),
+    ("empty_defaults", _pod(), []),
+]
+
+
+@pytest.mark.parametrize("name,pod,pds", CASES, ids=[c[0] for c in CASES])
+def test_differential_native_vs_python(name, pod, pds):
+    """The C++ engine and the Python oracle must agree exactly."""
+    if engine._load_native() is None:
+        pytest.skip("native engine unavailable")
+    got_pod, got_applied = engine.apply_native(pod, pds)
+    want_pod, want_applied = engine.apply_py(pod, pds)
+    assert got_applied == want_applied
+    assert got_pod == want_pod
+
+
+def test_native_engine_is_actually_loaded():
+    assert engine._load_native() is not None, (
+        "native merge engine failed to build/load"
+    )
+
+
+@pytest.mark.parametrize("make_conflict", [
+    lambda: ([_pd(name="a", env=[{"name": "A", "value": "other"}])],
+             "env var"),
+    lambda: ([_pd(name="a", volumes=[{"name": "v", "emptyDir": {}}]),
+              _pd(name="b", volumes=[{"name": "v", "hostPath": {"path": "/x"}}])],
+             "volume"),
+    lambda: ([_pd(name="a", labels={"notebook-name": "different"})],
+             "label"),
+    lambda: ([_pd(name="a", sidecars=[{"name": "notebook", "image": "x"}])],
+             "container"),
+])
+def test_conflicts_raise_in_both_engines(make_conflict):
+    pds, what = make_conflict()
+    with pytest.raises(engine.MergeConflict, match=what):
+        engine.apply_py(_pod(), pds)
+    if engine._load_native() is not None:
+        with pytest.raises(engine.MergeConflict, match=what):
+            engine.apply_native(_pod(), pds)
+
+
+def test_patch_ops_reproduce_mutation():
+    pod = _pod()
+    ops, applied, warning = server.mutate_pod(pod, [TPU_PD])
+    assert applied == ["tpu-env"] and not warning
+    patched = _apply_json_patch(pod, ops)
+    want, _ = engine.apply_py(pod, [TPU_PD])
+    assert patched == want
+    env = {e["name"]: e["value"]
+           for e in patched["spec"]["containers"][0]["env"]}
+    assert env["JAX_PLATFORMS"] == "tpu"
+    assert patched["spec"]["tolerations"][0]["key"] == "google.com/tpu"
+
+
+def test_exclude_annotation_and_selector_filtering():
+    pod = _pod(annotations={"poddefault.tpukf.dev/exclude": "true"})
+    assert server.filter_poddefaults(pod, [TPU_PD]) == []
+    sel_pd = _pd(name="sel")
+    sel_pd["spec"]["selector"] = {"matchLabels": {"team": "ml"}}
+    assert server.filter_poddefaults(_pod(), [sel_pd]) == []
+    pod2 = _pod(labels={"team": "ml"})
+    assert server.filter_poddefaults(pod2, [sel_pd]) == [sel_pd]
+
+
+def test_conflict_admits_unmodified_with_warning():
+    pds = [_pd(name="bad", env=[{"name": "A", "value": "other"}])]
+    ops, applied, warning = server.mutate_pod(_pod(), pds)
+    assert ops == [] and applied == []
+    assert "env var" in warning
+
+
+@pytest.fixture(scope="module")
+def webhook_server():
+    kube = FakeKube()
+    kube.create("poddefaults", dict(TPU_PD, metadata={
+        "name": "tpu-env", "namespace": "u",
+    }), group="tpukf.dev")
+    srv = server.make_server(kube, port=0, host="127.0.0.1")
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{srv.server_port}"
+    srv.shutdown()
+
+
+def test_admission_review_over_http(webhook_server):
+    review = {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": "123", "namespace": "u", "object": _pod()},
+    }
+    req = urllib.request.Request(
+        webhook_server + "/apply-poddefault",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req) as r:
+        out = json.loads(r.read())
+    resp = out["response"]
+    assert resp["uid"] == "123" and resp["allowed"]
+    assert resp["patchType"] == "JSONPatch"
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    patched = _apply_json_patch(_pod(), ops)
+    env = {e["name"]: e["value"]
+           for e in patched["spec"]["containers"][0]["env"]}
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "nb-hl:8080"
