@@ -1,0 +1,45 @@
+"""KFAM server binary (reference: access-management/main.go:36-58 — flags
+userid-header, userid-prefix, cluster-admin; listens :8081)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socketserver
+import wsgiref.simple_server
+
+from service_account_auth_improvements_tpu.controlplane.kfam import KfamApp
+from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
+
+
+class ThreadingWSGIServer(socketserver.ThreadingMixIn,
+                          wsgiref.simple_server.WSGIServer):
+    daemon_threads = True
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--kube-url", default=None)
+    parser.add_argument("--cluster-admin", default=None)
+    parser.add_argument("--userid-header", default=None)
+    parser.add_argument("--userid-prefix", default=None)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    client = KubeClient(base_url=args.kube_url)
+    app = KfamApp(
+        client,
+        cluster_admin=args.cluster_admin,
+        userid_header=args.userid_header,
+        userid_prefix=args.userid_prefix,
+    )
+    httpd = wsgiref.simple_server.make_server(
+        "0.0.0.0", args.port, app, server_class=ThreadingWSGIServer,
+    )
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

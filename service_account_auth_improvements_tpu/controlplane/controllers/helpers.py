@@ -106,8 +106,12 @@ def get_condition(obj: dict, ctype: str) -> dict | None:
 
 
 def set_condition(obj: dict, condition: dict) -> None:
-    status = obj.setdefault("status", {})
-    conds = status.setdefault("conditions", [])
+    if not obj.get("status"):
+        obj["status"] = {}
+    status = obj["status"]
+    if not status.get("conditions"):
+        status["conditions"] = []
+    conds = status["conditions"]
     for i, c in enumerate(conds):
         if c.get("type") == condition.get("type"):
             conds[i] = condition

@@ -1,0 +1,344 @@
+"""Profile controller: multi-tenant namespace onboarding with TPU quotas.
+
+Reconciles the cluster-scoped ``Profile`` CR into a tenant namespace with
+RBAC, Istio ACLs, service accounts, quota, and cloud-IAM plugins — the
+reference flow at components/profile-controller/controllers/
+profile_controller.go:105-331:
+
+- owned Namespace with owner annotation + configurable default labels
+  (:127-198; label hot-reload via a mounted file, :368-399),
+- Istio AuthorizationPolicy gating the namespace to its owner/contributors
+  plus same-namespace traffic and the culler's kernels probe (:419-556),
+- ``default-editor``/``default-viewer`` ServiceAccounts bound to edit/view
+  ClusterRoles (:592-671) and the owner's admin RoleBinding (:230-251),
+- ``kf-resource-quota`` from ``spec.resourceQuotaSpec`` (:253-280) — in the
+  TPU build this is where per-tenant ``requests.google.com/tpu`` chip
+  budgets are enforced (BASELINE.json config #4),
+- plugin interface with GCP Workload Identity (plugin_workload_identity.go)
+  behind an injectable IAM client; finalizer-driven revoke (:296-331).
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import logging
+import os
+
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.utils.env import get_env_default
+
+log = logging.getLogger(__name__)
+
+GROUP = "tpukf.dev"
+OWNER_ANNOTATION = "owner"
+FINALIZER = "profile-finalizer.tpukf.dev"
+ADMIN_BINDING = "namespaceAdmin"
+EDIT_SA, VIEW_SA = "default-editor", "default-viewer"
+QUOTA_NAME = "kf-resource-quota"
+
+DEFAULT_NAMESPACE_LABELS = {
+    "istio-injection": "enabled",
+    "app.kubernetes.io/part-of": "tpukf",
+}
+
+
+class WorkloadIdentityPlugin:
+    """GCP Workload Identity: annotate default-editor KSA and bind the GSA
+    (reference: plugin_workload_identity.go:44-120). The IAM policy call is
+    injectable; default is a no-op recorder usable in air-gapped tests."""
+
+    kind = "WorkloadIdentity"
+
+    def __init__(self, iam_client=None):
+        self.iam = iam_client or _RecordingIam()
+
+    def apply(self, kube, profile: dict, spec: dict) -> None:
+        ns = profile["metadata"]["name"]
+        gsa = spec.get("gcpServiceAccount", "")
+        if not gsa:
+            return
+        try:
+            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+        except errors.NotFound:
+            return
+        annots = sa["metadata"].setdefault("annotations", {})
+        if annots.get("iam.gke.io/gcp-service-account") != gsa:
+            annots["iam.gke.io/gcp-service-account"] = gsa
+            kube.update("serviceaccounts", sa)
+        self.iam.bind(gsa, ns, EDIT_SA)
+
+    def revoke(self, kube, profile: dict, spec: dict) -> None:
+        gsa = spec.get("gcpServiceAccount", "")
+        if gsa:
+            self.iam.unbind(gsa, profile["metadata"]["name"], EDIT_SA)
+
+
+class _RecordingIam:
+    def __init__(self):
+        self.bound: list[tuple] = []
+
+    def bind(self, gsa, ns, ksa):
+        self.bound.append((gsa, ns, ksa))
+
+    def unbind(self, gsa, ns, ksa):
+        self.bound = [b for b in self.bound if b != (gsa, ns, ksa)]
+
+
+class ProfileReconciler(Reconciler):
+    resource = "profiles"
+    group = GROUP
+
+    def __init__(self, kube, plugins: dict | None = None,
+                 namespace_labels_path: str | None = None):
+        self.kube = kube
+        self.plugins = plugins if plugins is not None else {
+            WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin(),
+        }
+        self.userid_header = get_env_default("USERID_HEADER", "kubeflow-userid")
+        self.userid_prefix = get_env_default("USERID_PREFIX", "")
+        self.labels_path = namespace_labels_path or os.environ.get(
+            "NAMESPACE_LABELS_PATH", ""
+        )
+
+    def register(self, manager) -> "ProfileReconciler":
+        ctl = manager.add_reconciler(self)
+        manager.watch_owned(ctl, "namespaces", owner_kind="Profile")
+        manager.watch_owned(ctl, "rolebindings",
+                            group="rbac.authorization.k8s.io",
+                            owner_kind="Profile")
+        return self
+
+    # ----------------------------------------------------------- reconcile
+
+    def namespace_labels(self) -> dict:
+        """Default labels, hot-reloaded from the mounted file when present
+        (reference fsnotify dance: profile_controller.go:368-399 — here we
+        simply re-read per reconcile, which level-triggering makes cheap)."""
+        labels = dict(DEFAULT_NAMESPACE_LABELS)
+        if self.labels_path and os.path.exists(self.labels_path):
+            try:
+                with open(self.labels_path) as f:
+                    labels.update(json.load(f))
+            except (ValueError, OSError):
+                log.exception("bad namespace-labels file %s", self.labels_path)
+        return labels
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            profile = self.kube.get("profiles", req.name, group=GROUP)
+        except errors.NotFound:
+            return Result()
+        meta = profile["metadata"]
+
+        if meta.get("deletionTimestamp"):
+            self._revoke_plugins(profile)
+            if FINALIZER in (meta.get("finalizers") or []):
+                profile = copy.deepcopy(profile)
+                profile["metadata"]["finalizers"] = [
+                    f for f in meta["finalizers"] if f != FINALIZER
+                ]
+                self.kube.update("profiles", profile, group=GROUP)
+            return Result()
+
+        if FINALIZER not in (meta.get("finalizers") or []):
+            profile = copy.deepcopy(profile)
+            profile["metadata"].setdefault("finalizers", []).append(FINALIZER)
+            profile = self.kube.update("profiles", profile, group=GROUP)
+
+        owner = ((profile.get("spec") or {}).get("owner") or {})
+        owner_name = owner.get("name", "")
+        ns_name = profile["metadata"]["name"]
+
+        try:
+            self._ensure_namespace(profile, ns_name, owner_name)
+            self._ensure_authorization_policy(profile, ns_name, owner_name)
+            self._ensure_service_accounts(profile, ns_name)
+            self._ensure_owner_binding(profile, ns_name, owner)
+            self._ensure_quota(profile, ns_name)
+            self._apply_plugins(profile)
+        except errors.ApiError as e:
+            self._set_error_condition(profile, str(e))
+            raise
+        self._set_ready_condition(profile)
+        return Result()
+
+    # ------------------------------------------------------------ children
+
+    def _ensure_namespace(self, profile, ns_name, owner_name):
+        desired = {
+            "apiVersion": "v1",
+            "kind": "Namespace",
+            "metadata": {
+                "name": ns_name,
+                "labels": self.namespace_labels(),
+                "annotations": {OWNER_ANNOTATION: owner_name},
+                "ownerReferences": [helpers.owner_reference(profile)],
+            },
+        }
+        helpers.ensure(self.kube, "namespaces", desired,
+                       copy_fields=self._copy_ns_fields)
+
+    @staticmethod
+    def _copy_ns_fields(desired, live):
+        changed = False
+        for field in ("labels", "annotations"):
+            want = desired["metadata"].get(field) or {}
+            have = live["metadata"].setdefault(field, {})
+            for k, v in want.items():
+                if have.get(k) != v:
+                    have[k] = v
+                    changed = True
+        return changed
+
+    def _ensure_authorization_policy(self, profile, ns_name, owner_name):
+        """Four-rule ACL (reference :419-556): owner by userid header via
+        the ingress, same-namespace traffic, knative probes, and the
+        notebook culler's /api/kernels probe path."""
+        desired = {
+            "apiVersion": "security.istio.io/v1beta1",
+            "kind": "AuthorizationPolicy",
+            "metadata": {
+                "name": "ns-owner-access-istio",
+                "namespace": ns_name,
+                "ownerReferences": [helpers.owner_reference(profile)],
+            },
+            "spec": {
+                "rules": [
+                    {"when": [{
+                        "key": f"request.headers[{self.userid_header}]",
+                        "values": [self.userid_prefix + owner_name],
+                    }]},
+                    {"from": [{"source": {
+                        "namespaces": [ns_name],
+                    }}]},
+                    {"to": [{"operation": {
+                        "paths": ["/healthz", "/metrics", "/wait-for-drain"],
+                    }}]},
+                    {"from": [{"source": {"principals": [
+                        "cluster.local/ns/tpukf-system/sa/notebook-controller",
+                    ]}}, ], "to": [{"operation": {
+                        "paths": ["*/api/kernels"],
+                    }}]},
+                ],
+            },
+        }
+        helpers.ensure(self.kube, "authorizationpolicies", desired,
+                       group="security.istio.io")
+
+    def _ensure_service_accounts(self, profile, ns_name):
+        for sa_name, role in ((EDIT_SA, "kubeflow-edit"),
+                              (VIEW_SA, "kubeflow-view")):
+            helpers.ensure(self.kube, "serviceaccounts", {
+                "apiVersion": "v1",
+                "kind": "ServiceAccount",
+                "metadata": {
+                    "name": sa_name, "namespace": ns_name,
+                    "ownerReferences": [helpers.owner_reference(profile)],
+                },
+            }, copy_fields=lambda d, l: False)
+            helpers.ensure(self.kube, "rolebindings", {
+                "apiVersion": "rbac.authorization.k8s.io/v1",
+                "kind": "RoleBinding",
+                "metadata": {
+                    "name": sa_name, "namespace": ns_name,
+                    "ownerReferences": [helpers.owner_reference(profile)],
+                },
+                "roleRef": {
+                    "apiGroup": "rbac.authorization.k8s.io",
+                    "kind": "ClusterRole", "name": role,
+                },
+                "subjects": [{
+                    "kind": "ServiceAccount", "name": sa_name,
+                    "namespace": ns_name,
+                }],
+            }, group="rbac.authorization.k8s.io")
+
+    def _ensure_owner_binding(self, profile, ns_name, owner):
+        if not owner.get("name"):
+            return
+        helpers.ensure(self.kube, "rolebindings", {
+            "apiVersion": "rbac.authorization.k8s.io/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": ADMIN_BINDING, "namespace": ns_name,
+                "annotations": {
+                    "user": owner["name"], "role": "admin",
+                },
+                "ownerReferences": [helpers.owner_reference(profile)],
+            },
+            "roleRef": {
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": "ClusterRole", "name": "kubeflow-admin",
+            },
+            "subjects": [{
+                "apiGroup": "rbac.authorization.k8s.io",
+                "kind": owner.get("kind", "User"),
+                "name": owner["name"],
+            }],
+        }, group="rbac.authorization.k8s.io")
+
+    def _ensure_quota(self, profile, ns_name):
+        quota_spec = (profile.get("spec") or {}).get("resourceQuotaSpec")
+        if not quota_spec:
+            try:
+                self.kube.delete("resourcequotas", QUOTA_NAME,
+                                 namespace=ns_name)
+            except errors.NotFound:
+                pass
+            return
+        helpers.ensure(self.kube, "resourcequotas", {
+            "apiVersion": "v1",
+            "kind": "ResourceQuota",
+            "metadata": {
+                "name": QUOTA_NAME, "namespace": ns_name,
+                "ownerReferences": [helpers.owner_reference(profile)],
+            },
+            "spec": quota_spec,
+        })
+
+    # -------------------------------------------------------------- plugins
+
+    def _apply_plugins(self, profile):
+        for pspec in ((profile.get("spec") or {}).get("plugins") or []):
+            plugin = self.plugins.get(pspec.get("kind"))
+            if plugin:
+                plugin.apply(self.kube, profile, pspec.get("spec") or {})
+
+    def _revoke_plugins(self, profile):
+        for pspec in ((profile.get("spec") or {}).get("plugins") or []):
+            plugin = self.plugins.get(pspec.get("kind"))
+            if plugin:
+                try:
+                    plugin.revoke(self.kube, profile, pspec.get("spec") or {})
+                except Exception:
+                    log.exception("plugin revoke failed")
+
+    # --------------------------------------------------------------- status
+
+    def _set_ready_condition(self, profile):
+        self._set_condition(profile, {"type": "Ready", "status": "True"})
+
+    def _set_error_condition(self, profile, message):
+        self._set_condition(profile, {
+            "type": "Error", "status": "True", "message": message,
+        })
+
+    def _set_condition(self, profile, cond):
+        cur = self.kube.get("profiles", profile["metadata"]["name"],
+                            group=GROUP)
+        before = copy.deepcopy(cur.get("status"))
+        helpers.set_condition(cur, cond)
+        if cur.get("status") != before:
+            try:
+                self.kube.update_status("profiles", cur, group=GROUP)
+            except errors.Conflict:
+                pass

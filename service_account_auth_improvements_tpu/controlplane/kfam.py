@@ -1,0 +1,247 @@
+"""KFAM — access management API (contributors & profiles façade).
+
+REST façade over Profile CRs and contributor RoleBindings, the reference's
+access-management component (routes: components/access-management/kfam/
+routers.go:32-103; binding logic kfam/bindings.go:61-141; authorization
+kfam/api_default.go:293-310):
+
+- ``POST/DELETE/GET /kfam/v1/bindings`` — contributor RoleBinding named
+  ``user-<safe-email>-clusterrole-<role>`` plus a matching per-user Istio
+  AuthorizationPolicy in the target namespace,
+- ``POST /kfam/v1/profiles``, ``DELETE /kfam/v1/profiles/{name}``,
+- ``GET /kfam/v1/role/clusteradmin`` — is the caller cluster admin,
+- ``GET /metrics`` — Prometheus.
+
+Caller identity comes from the trusted userid header (Istio ingress);
+mutations require the caller to be the cluster admin or the owner of the
+referred namespace's Profile. Stdlib WSGI; runs threaded under
+``cmd/access_management.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from urllib.parse import parse_qs
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Registry,
+)
+from service_account_auth_improvements_tpu.utils.env import get_env_default
+
+GROUP = "tpukf.dev"
+RBAC_GROUP = "rbac.authorization.k8s.io"
+ISTIO_SEC = "security.istio.io"
+
+
+def safe_email(email: str) -> str:
+    return re.sub(r"[^a-z0-9]", "-", email.lower())
+
+
+def binding_name(user: str, role: str) -> str:
+    return f"user-{safe_email(user)}-clusterrole-{role}"
+
+
+class KfamApp:
+    def __init__(self, kube, cluster_admin: str | None = None,
+                 userid_header: str | None = None,
+                 userid_prefix: str | None = None,
+                 registry: Registry | None = None):
+        self.kube = kube
+        self.cluster_admin = cluster_admin if cluster_admin is not None else \
+            get_env_default("CLUSTER_ADMIN", "admin@kubeflow.org")
+        self.userid_header = userid_header or get_env_default(
+            "USERID_HEADER", "kubeflow-userid"
+        )
+        self.userid_prefix = userid_prefix if userid_prefix is not None else \
+            get_env_default("USERID_PREFIX", "")
+        reg = registry or Registry()
+        self.registry = reg
+        self.requests = Counter(
+            "request_kf_total", "KFAM requests", ("path", "status"),
+            registry=reg,
+        )
+
+    # ------------------------------------------------------------- helpers
+
+    def _caller(self, environ) -> str:
+        key = "HTTP_" + self.userid_header.upper().replace("-", "_")
+        raw = environ.get(key, "")
+        if self.userid_prefix and raw.startswith(self.userid_prefix):
+            raw = raw[len(self.userid_prefix):]
+        return raw
+
+    def _is_cluster_admin(self, user: str) -> bool:
+        return bool(user) and user == self.cluster_admin
+
+    def _is_owner(self, user: str, namespace: str) -> bool:
+        try:
+            profile = self.kube.get("profiles", namespace, group=GROUP)
+        except errors.NotFound:
+            return False
+        owner = ((profile.get("spec") or {}).get("owner") or {})
+        return owner.get("name") == user
+
+    def _authorized(self, user: str, namespace: str) -> bool:
+        return self._is_cluster_admin(user) or self._is_owner(user, namespace)
+
+    # ------------------------------------------------------------- actions
+
+    def create_binding(self, body: dict) -> None:
+        user = ((body.get("user") or {}).get("name")) or ""
+        namespace = body.get("referredNamespace") or ""
+        role = ((body.get("roleRef") or {}).get("name")) or "edit"
+        name = binding_name(user, role)
+        rb = {
+            "apiVersion": f"{RBAC_GROUP}/v1",
+            "kind": "RoleBinding",
+            "metadata": {
+                "name": name, "namespace": namespace,
+                "annotations": {"user": user, "role": role},
+            },
+            "roleRef": {
+                "apiGroup": RBAC_GROUP, "kind": "ClusterRole",
+                "name": f"kubeflow-{role}",
+            },
+            "subjects": [{
+                "apiGroup": RBAC_GROUP,
+                "kind": (body.get("user") or {}).get("kind", "User"),
+                "name": user,
+            }],
+        }
+        try:
+            self.kube.create("rolebindings", rb, group=RBAC_GROUP)
+        except errors.AlreadyExists:
+            pass
+        ap = {
+            "apiVersion": f"{ISTIO_SEC}/v1beta1",
+            "kind": "AuthorizationPolicy",
+            "metadata": {
+                "name": name, "namespace": namespace,
+                "annotations": {"user": user, "role": role},
+            },
+            "spec": {"rules": [{"when": [{
+                "key": f"request.headers[{self.userid_header}]",
+                "values": [self.userid_prefix + user],
+            }]}]},
+        }
+        try:
+            self.kube.create("authorizationpolicies", ap, group=ISTIO_SEC)
+        except errors.AlreadyExists:
+            pass
+
+    def delete_binding(self, body: dict) -> None:
+        user = ((body.get("user") or {}).get("name")) or ""
+        namespace = body.get("referredNamespace") or ""
+        role = ((body.get("roleRef") or {}).get("name")) or "edit"
+        name = binding_name(user, role)
+        for plural, group in (("rolebindings", RBAC_GROUP),
+                              ("authorizationpolicies", ISTIO_SEC)):
+            try:
+                self.kube.delete(plural, name, namespace=namespace,
+                                 group=group)
+            except errors.NotFound:
+                pass
+
+    def list_bindings(self, namespace: str | None) -> dict:
+        out = self.kube.list("rolebindings", namespace=namespace,
+                             group=RBAC_GROUP)
+        bindings = []
+        for rb in out.get("items", []):
+            annots = rb["metadata"].get("annotations") or {}
+            if "user" not in annots:
+                continue  # not a KFAM contributor binding
+            bindings.append({
+                "user": {"kind": "User", "name": annots["user"]},
+                "referredNamespace": rb["metadata"].get("namespace"),
+                "roleRef": {
+                    "kind": "ClusterRole",
+                    "name": annots.get("role", "edit"),
+                },
+            })
+        return {"bindings": bindings}
+
+    def create_profile(self, body: dict) -> dict:
+        name = (body.get("name")
+                or ((body.get("metadata") or {}).get("name")) or "")
+        owner = (body.get("owner")
+                 or ((body.get("spec") or {}).get("owner")) or {})
+        return self.kube.create("profiles", {
+            "apiVersion": f"{GROUP}/v1",
+            "kind": "Profile",
+            "metadata": {"name": name},
+            "spec": {"owner": owner},
+        }, group=GROUP)
+
+    # ---------------------------------------------------------------- wsgi
+
+    def __call__(self, environ, start_response):
+        method = environ["REQUEST_METHOD"]
+        path = environ.get("PATH_INFO", "")
+        qs = parse_qs(environ.get("QUERY_STRING", ""))
+        caller = self._caller(environ)
+
+        def respond(code: int, payload) -> list:
+            body = json.dumps(payload).encode() if payload is not None else b""
+            self.requests.labels(path, str(code)).inc()
+            start_response(
+                f"{code} {'OK' if code < 400 else 'Error'}",
+                [("Content-Type", "application/json"),
+                 ("Content-Length", str(len(body)))],
+            )
+            return [body]
+
+        def body() -> dict:
+            try:
+                length = int(environ.get("CONTENT_LENGTH") or 0)
+            except ValueError:
+                length = 0
+            raw = environ["wsgi.input"].read(length) if length else b""
+            return json.loads(raw) if raw else {}
+
+        try:
+            if path == "/metrics":
+                text = self.registry.render().encode()
+                start_response("200 OK", [
+                    ("Content-Type", "text/plain; version=0.0.4"),
+                    ("Content-Length", str(len(text))),
+                ])
+                return [text]
+            if path == "/kfam/v1/role/clusteradmin" and method == "GET":
+                user = qs.get("user", [caller])[0]
+                return respond(200, self._is_cluster_admin(user))
+            if path == "/kfam/v1/bindings":
+                if method == "GET":
+                    ns = qs.get("namespace", [None])[0]
+                    return respond(200, self.list_bindings(ns))
+                payload = body()
+                ns = payload.get("referredNamespace") or ""
+                if not self._authorized(caller, ns):
+                    return respond(403, {"error": (
+                        f"user {caller!r} is not the owner of {ns!r} "
+                        "nor the cluster admin"
+                    )})
+                if method == "POST":
+                    self.create_binding(payload)
+                    return respond(200, {"status": "ok"})
+                if method == "DELETE":
+                    self.delete_binding(payload)
+                    return respond(200, {"status": "ok"})
+            if path == "/kfam/v1/profiles" and method == "POST":
+                payload = body()
+                out = self.create_profile(payload)
+                return respond(200, out)
+            m = re.fullmatch(r"/kfam/v1/profiles/([^/]+)", path)
+            if m and method == "DELETE":
+                name = m.group(1)
+                if not self._authorized(caller, name):
+                    return respond(403, {"error": "not authorized"})
+                self.kube.delete("profiles", name, group=GROUP)
+                return respond(200, {"status": "ok"})
+            return respond(404, {"error": f"no route {method} {path}"})
+        except errors.ApiError as e:
+            return respond(e.code, e.to_status())
+        except ValueError as e:
+            return respond(400, {"error": str(e)})

@@ -1,0 +1,162 @@
+"""KFAM API: bindings, profiles, authorization — via the WSGI interface."""
+
+import io
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kfam import (
+    KfamApp,
+    binding_name,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+RBAC = "rbac.authorization.k8s.io"
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    kube.create("profiles", {
+        "metadata": {"name": "alice"},
+        "spec": {"owner": {"kind": "User", "name": "alice@example.com"}},
+    }, group="tpukf.dev")
+    app = KfamApp(kube, cluster_admin="root@example.com")
+    return kube, app
+
+
+def call(app, method, path, body=None, user="", query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": query,
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    if user:
+        environ["HTTP_KUBEFLOW_USERID"] = user
+    status = {}
+
+    def start_response(st, headers):
+        status["code"] = int(st.split()[0])
+
+    out = b"".join(app(environ, start_response))
+    return status["code"], json.loads(out) if out else None
+
+
+def test_binding_name_sanitization():
+    assert binding_name("Bob.Smith@Example.com", "edit") == (
+        "user-bob-smith-example-com-clusterrole-edit"
+    )
+
+
+def test_owner_can_add_contributor(world):
+    kube, app = world
+    code, _ = call(app, "POST", "/kfam/v1/bindings", {
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"kind": "ClusterRole", "name": "edit"},
+    }, user="alice@example.com")
+    assert code == 200
+    name = binding_name("bob@example.com", "edit")
+    rb = kube.get("rolebindings", name, namespace="alice", group=RBAC)
+    assert rb["subjects"][0]["name"] == "bob@example.com"
+    ap = kube.get("authorizationpolicies", name, namespace="alice",
+                  group="security.istio.io")
+    assert ap["spec"]["rules"][0]["when"][0]["values"] == ["bob@example.com"]
+
+
+def test_stranger_cannot_add_contributor(world):
+    kube, app = world
+    code, body = call(app, "POST", "/kfam/v1/bindings", {
+        "user": {"kind": "User", "name": "eve@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"name": "edit"},
+    }, user="eve@example.com")
+    assert code == 403
+    with pytest.raises(errors.NotFound):
+        kube.get("rolebindings", binding_name("eve@example.com", "edit"),
+                 namespace="alice", group=RBAC)
+
+
+def test_cluster_admin_can_do_anything(world):
+    kube, app = world
+    code, _ = call(app, "POST", "/kfam/v1/bindings", {
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"name": "view"},
+    }, user="root@example.com")
+    assert code == 200
+
+
+def test_list_and_delete_binding(world):
+    kube, app = world
+    payload = {
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"name": "edit"},
+    }
+    call(app, "POST", "/kfam/v1/bindings", payload, user="alice@example.com")
+    code, out = call(app, "GET", "/kfam/v1/bindings", None,
+                     query="namespace=alice")
+    assert code == 200
+    assert out["bindings"] == [{
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"kind": "ClusterRole", "name": "edit"},
+    }]
+    code, _ = call(app, "DELETE", "/kfam/v1/bindings", payload,
+                   user="alice@example.com")
+    assert code == 200
+    _, out = call(app, "GET", "/kfam/v1/bindings", None,
+                  query="namespace=alice")
+    assert out["bindings"] == []
+
+
+def test_create_profile_and_clusteradmin_check(world):
+    kube, app = world
+    code, _ = call(app, "POST", "/kfam/v1/profiles", {
+        "name": "bob", "owner": {"kind": "User", "name": "bob@example.com"},
+    }, user="bob@example.com")
+    assert code == 200
+    prof = kube.get("profiles", "bob", group="tpukf.dev")
+    assert prof["spec"]["owner"]["name"] == "bob@example.com"
+    code, is_admin = call(app, "GET", "/kfam/v1/role/clusteradmin",
+                          user="root@example.com")
+    assert (code, is_admin) == (200, True)
+    code, is_admin = call(app, "GET", "/kfam/v1/role/clusteradmin",
+                          user="bob@example.com")
+    assert (code, is_admin) == (200, False)
+
+
+def test_owner_can_delete_own_profile_stranger_cannot(world):
+    kube, app = world
+    code, _ = call(app, "DELETE", "/kfam/v1/profiles/alice",
+                   user="eve@example.com")
+    assert code == 403
+    code, _ = call(app, "DELETE", "/kfam/v1/profiles/alice",
+                   user="alice@example.com")
+    assert code == 200
+
+
+def test_metrics_endpoint(world):
+    _, app = world
+    call(app, "GET", "/kfam/v1/bindings", None, query="namespace=alice")
+    code, _ = None, None
+    environ = {
+        "REQUEST_METHOD": "GET", "PATH_INFO": "/metrics",
+        "QUERY_STRING": "", "CONTENT_LENGTH": "0",
+        "wsgi.input": io.BytesIO(b""),
+    }
+    status = {}
+
+    def start_response(st, headers):
+        status["code"] = int(st.split()[0])
+
+    out = b"".join(app(environ, start_response)).decode()
+    assert status["code"] == 200
+    assert "request_kf_total" in out

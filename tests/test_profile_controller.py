@@ -1,0 +1,152 @@
+"""Profile controller: namespace onboarding, RBAC, TPU quota, plugins."""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.profile import (
+    FINALIZER,
+    ProfileReconciler,
+    WorkloadIdentityPlugin,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+RBAC = "rbac.authorization.k8s.io"
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if pred():
+                return True
+        except errors.ApiError:
+            pass
+        time.sleep(0.02)
+    return False
+
+
+def _profile(name="alice", email="alice@example.com", quota=None, plugins=None):
+    spec = {"owner": {"kind": "User", "name": email}}
+    if quota:
+        spec["resourceQuotaSpec"] = quota
+    if plugins:
+        spec["plugins"] = plugins
+    return {"metadata": {"name": name}, "spec": spec}
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    wi = WorkloadIdentityPlugin()
+    ProfileReconciler(kube, plugins={"WorkloadIdentity": wi}).register(mgr)
+    mgr.start()
+    yield kube, wi
+    mgr.stop()
+
+
+def test_profile_creates_namespace_rbac_acl(world):
+    kube, _ = world
+    kube.create("profiles", _profile())
+    assert _wait(lambda: kube.get("namespaces", "alice"))
+    ns = kube.get("namespaces", "alice")
+    assert ns["metadata"]["annotations"]["owner"] == "alice@example.com"
+    assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+    for sa in ("default-editor", "default-viewer"):
+        assert _wait(
+            lambda sa=sa: kube.get("serviceaccounts", sa, namespace="alice")
+        )
+        rb = kube.get("rolebindings", sa, namespace="alice", group=RBAC)
+        assert rb["roleRef"]["name"] in ("kubeflow-edit", "kubeflow-view")
+    admin = kube.get("rolebindings", "namespaceAdmin", namespace="alice",
+                     group=RBAC)
+    assert admin["subjects"][0]["name"] == "alice@example.com"
+    ap = kube.get("authorizationpolicies", "ns-owner-access-istio",
+                  namespace="alice", group="security.istio.io")
+    rule0 = ap["spec"]["rules"][0]["when"][0]
+    assert rule0["values"] == ["alice@example.com"]
+    # Profile is marked Ready and carries the finalizer.
+    prof = kube.get("profiles", "alice", group="tpukf.dev")
+    assert FINALIZER in prof["metadata"]["finalizers"]
+    assert _wait(lambda: any(
+        c["type"] == "Ready"
+        for c in (kube.get("profiles", "alice", group="tpukf.dev")
+                  .get("status") or {}).get("conditions", [])
+    ))
+
+
+def test_tpu_resource_quota(world):
+    kube, _ = world
+    kube.create("profiles", _profile(
+        name="team-a",
+        quota={"hard": {
+            "requests.google.com/tpu": "16", "cpu": "32", "memory": "128Gi",
+        }},
+    ))
+    assert _wait(
+        lambda: kube.get("resourcequotas", "kf-resource-quota",
+                         namespace="team-a")
+    )
+    rq = kube.get("resourcequotas", "kf-resource-quota", namespace="team-a")
+    assert rq["spec"]["hard"]["requests.google.com/tpu"] == "16"
+    # Removing the quota spec removes the quota object.
+    prof = kube.get("profiles", "team-a", group="tpukf.dev")
+    del prof["spec"]["resourceQuotaSpec"]
+    kube.update("profiles", prof, group="tpukf.dev")
+
+    def quota_gone():
+        try:
+            kube.get("resourcequotas", "kf-resource-quota",
+                     namespace="team-a")
+            return False
+        except errors.NotFound:
+            return True
+
+    assert _wait(quota_gone)
+
+
+def test_workload_identity_plugin_apply_and_revoke(world):
+    kube, wi = world
+    kube.create("profiles", _profile(
+        name="ml", email="ml@example.com",
+        plugins=[{"kind": "WorkloadIdentity",
+                  "spec": {"gcpServiceAccount": "gsa@proj.iam"}}],
+    ))
+    assert _wait(lambda: ("gsa@proj.iam", "ml", "default-editor") in wi.iam.bound)
+    sa = kube.get("serviceaccounts", "default-editor", namespace="ml")
+    assert sa["metadata"]["annotations"]["iam.gke.io/gcp-service-account"] == (
+        "gsa@proj.iam"
+    )
+    kube.delete("profiles", "ml")
+    assert _wait(lambda: wi.iam.bound == [])
+
+    def profile_gone():
+        try:
+            kube.get("profiles", "ml", group="tpukf.dev")
+            return False
+        except errors.NotFound:
+            return True
+
+    assert _wait(profile_gone)  # finalizer removed after revoke
+
+
+def test_two_tenants_quota_isolation(world):
+    """BASELINE config #4: two tenants sharing one v5e-16 under quota."""
+    kube, _ = world
+    for name in ("tenant-a", "tenant-b"):
+        kube.create("profiles", _profile(
+            name=name, email=f"{name}@example.com",
+            quota={"hard": {"requests.google.com/tpu": "8"}},
+        ))
+    for name in ("tenant-a", "tenant-b"):
+        assert _wait(
+            lambda name=name: kube.get("resourcequotas", "kf-resource-quota",
+                                       namespace=name)
+        )
+        rq = kube.get("resourcequotas", "kf-resource-quota", namespace=name)
+        assert rq["spec"]["hard"]["requests.google.com/tpu"] == "8"
