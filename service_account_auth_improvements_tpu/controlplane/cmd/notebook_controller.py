@@ -1,18 +1,15 @@
 """notebook-controller manager binary.
 
 Process shape mirrors the reference manager startup (components/
-notebook-controller/main.go:57-146): flags, metrics/probe endpoint,
-reconcilers registered on a manager, signal-driven shutdown. Culling is an
-opt-in side reconciler (ENABLE_CULLING — reference main.go:110).
+notebook-controller/main.go:57-146). Culling is an opt-in side reconciler
+(ENABLE_CULLING — reference main.go:110).
 """
 
 from __future__ import annotations
 
-import argparse
-import logging
-import signal
-import threading
-
+from service_account_auth_improvements_tpu.controlplane.cmd.runner import (
+    run_manager,
+)
 from service_account_auth_improvements_tpu.controlplane.controllers.culling import (
     CullingReconciler,
 )
@@ -20,46 +17,18 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
     NotebookMetrics,
     NotebookReconciler,
 )
-from service_account_auth_improvements_tpu.controlplane.engine import Manager
-from service_account_auth_improvements_tpu.controlplane.engine.serve import (
-    serve_ops,
-)
-from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
 from service_account_auth_improvements_tpu.utils.env import get_env_bool
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--metrics-port", type=int, default=8080)
-    parser.add_argument("--kube-url", default=None,
-                        help="API server base URL (default: in-cluster)")
-    parser.add_argument("--namespace", default=None,
-                        help="restrict to one namespace (default: all)")
-    parser.add_argument("--workers", type=int, default=2)
-    args = parser.parse_args(argv)
-
-    logging.basicConfig(
-        level=logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
-    client = KubeClient(base_url=args.kube_url)
-    manager = Manager(client, namespace=args.namespace)
+def _register(client, manager, args):
     metrics = NotebookMetrics()
     NotebookReconciler(client, metrics).register(manager)
     if get_env_bool("ENABLE_CULLING", False):
         CullingReconciler(client, metrics).register(manager)
 
-    ready = {"ok": False}
-    serve_ops(args.metrics_port, ready_check=lambda: ready["ok"])
-    manager.start()
-    ready["ok"] = True
 
-    stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
-    stop.wait()
-    manager.stop()
-    return 0
+def main(argv=None) -> int:
+    return run_manager(_register, argv)
 
 
 if __name__ == "__main__":

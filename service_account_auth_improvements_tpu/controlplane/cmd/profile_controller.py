@@ -3,50 +3,29 @@ main.go:59-146 — userid-header/userid-prefix/workload-identity flags)."""
 
 from __future__ import annotations
 
-import argparse
-import logging
-import signal
-import threading
-
+from service_account_auth_improvements_tpu.controlplane.cmd.runner import (
+    run_manager,
+)
 from service_account_auth_improvements_tpu.controlplane.controllers.profile import (
     ProfileReconciler,
     WorkloadIdentityPlugin,
 )
-from service_account_auth_improvements_tpu.controlplane.engine import Manager
-from service_account_auth_improvements_tpu.controlplane.engine.serve import (
-    serve_ops,
-)
-from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
 
 
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--metrics-port", type=int, default=8080)
-    parser.add_argument("--kube-url", default=None)
+def _add_args(parser):
     parser.add_argument("--namespace-labels-path", default=None)
-    parser.add_argument("--workers", type=int, default=2)
-    args = parser.parse_args(argv)
 
-    logging.basicConfig(level=logging.INFO)
-    client = KubeClient(base_url=args.kube_url)
-    manager = Manager(client)
+
+def _register(client, manager, args):
     ProfileReconciler(
         client,
         plugins={WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin()},
         namespace_labels_path=args.namespace_labels_path,
     ).register(manager)
 
-    ready = {"ok": False}
-    serve_ops(args.metrics_port, ready_check=lambda: ready["ok"])
-    manager.start()
-    ready["ok"] = True
 
-    stop = threading.Event()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        signal.signal(sig, lambda *_: stop.set())
-    stop.wait()
-    manager.stop()
-    return 0
+def main(argv=None) -> int:
+    return run_manager(_register, argv, add_args=_add_args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,25 @@
+"""pvcviewer-controller manager binary (reference shape:
+components/pvcviewer-controller/main.go; defaulting/validation also run
+in-reconcile, so the binary needs no webhook wiring to be safe)."""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane.cmd.runner import (
+    run_manager,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.pvcviewer import (
+    PVCViewerReconciler,
+)
+
+
+def main(argv=None) -> int:
+    return run_manager(
+        lambda client, manager, args: PVCViewerReconciler(client).register(
+            manager
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

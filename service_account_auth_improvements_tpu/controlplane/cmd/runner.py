@@ -1,0 +1,57 @@
+"""Shared manager-binary scaffold.
+
+Every controller binary has the same process shape (reference:
+components/*/main.go — flags, metrics/probe endpoint on one port,
+reconcilers registered on a manager, signal-driven shutdown). The four
+managers differ only in which reconcilers they register, so that is the
+one thing a binary provides: a ``register(client, manager, args)``
+callback.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
+
+
+def run_manager(register, argv=None, add_args=None) -> int:
+    """Parse common flags, build client+manager, register reconcilers via
+    ``register(client, manager, args)``, serve ops, run until signalled.
+    ``add_args(parser)`` may add binary-specific flags."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--metrics-port", type=int, default=8080)
+    parser.add_argument("--kube-url", default=None,
+                        help="API server base URL (default: in-cluster)")
+    parser.add_argument("--namespace", default=None,
+                        help="restrict to one namespace (default: all)")
+    if add_args:
+        add_args(parser)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    client = KubeClient(base_url=args.kube_url)
+    manager = Manager(client, namespace=args.namespace)
+    register(client, manager, args)
+
+    ready = {"ok": False}
+    serve_ops(args.metrics_port, ready_check=lambda: ready["ok"])
+    manager.start()
+    ready["ok"] = True
+
+    stop = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    manager.stop()
+    return 0

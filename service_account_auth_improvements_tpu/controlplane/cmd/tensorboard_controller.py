@@ -1,0 +1,24 @@
+"""tensorboard-controller manager binary (reference shape:
+components/tensorboard-controller/main.go)."""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane.cmd.runner import (
+    run_manager,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.tensorboard import (
+    TensorboardReconciler,
+)
+
+
+def main(argv=None) -> int:
+    return run_manager(
+        lambda client, manager, args: TensorboardReconciler(client).register(
+            manager
+        ),
+        argv,
+    )
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -325,18 +325,23 @@ class ProfileReconciler(Reconciler):
     # --------------------------------------------------------------- status
 
     def _set_ready_condition(self, profile):
-        self._set_condition(profile, {"type": "Ready", "status": "True"})
+        # A successful pass clears any prior Error so recovered profiles
+        # don't report Error=True alongside Ready=True forever.
+        self._set_condition(profile, {"type": "Ready", "status": "True"},
+                            {"type": "Error", "status": "False"})
 
     def _set_error_condition(self, profile, message):
         self._set_condition(profile, {
             "type": "Error", "status": "True", "message": message,
-        })
+        }, {"type": "Ready", "status": "False"})
 
-    def _set_condition(self, profile, cond):
+    def _set_condition(self, profile, cond, *extra):
         cur = self.kube.get("profiles", profile["metadata"]["name"],
                             group=GROUP)
         before = copy.deepcopy(cur.get("status"))
         helpers.set_condition(cur, cond)
+        for c in extra:
+            helpers.set_condition(cur, c)
         if cur.get("status") != before:
             try:
                 self.kube.update_status("profiles", cur, group=GROUP)

@@ -1,0 +1,381 @@
+"""PVCViewer controller: PVCViewer CR → Deployment (+ Service + VS).
+
+TPU-native rethink of the reference's pvcviewer-controller (reconcile:
+components/pvcviewer-controller/controllers/pvcviewer_controller.go:96-147;
+defaulting/validating webhook: api/v1alpha1/pvcviewer_webhook.go:37-199):
+
+- ``spec.podSpec`` defaults to a filebrowser UI over ``spec.pvc`` —
+  loaded from the file named by DEFAULT_POD_SPEC_PATH when set (webhook
+  :53-67), else a built-in filebrowser container (:95-133); the
+  viewer-volume for ``spec.pvc`` is appended to the defaulted podSpec
+  (:135-146). An explicit podSpec must mount the PVC itself.
+- Validation requires ``spec.pvc`` and that the podSpec mounts it
+  (webhook :153-178); an invalid CR gets an InvalidSpec condition rather
+  than an endless retry loop.
+- Deployment uses Recreate strategy so affinity changes release the RWO
+  volume before the new pod mounts it (controller :190-195).
+- RWO affinity is computed only at Deployment creation: if the PVC is
+  ReadWriteOnce and exactly one non-viewer running pod on a known node
+  mounts it, prefer that node (controller :165-180, :372-430).
+- Service + VirtualService exist only when ``spec.networking`` is set
+  (controller :210-213, :252-255); status carries the relative URL,
+  readiness, and appended Deployment conditions (:338-370).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+
+import yaml
+
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.utils.env import get_env_default
+
+GROUP = "tpukf.dev"
+RESOURCE_PREFIX = "pvcviewer-"
+SERVICE_PORT = 80
+VOLUME_NAME = "viewer-volume"
+
+NAME_LABEL = "app.kubernetes.io/name"
+INSTANCE_LABEL = "app.kubernetes.io/instance"
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+PART_OF_VALUE = "pvcviewer"
+
+DEFAULT_POD_SPEC_PATH_ENV = "DEFAULT_POD_SPEC_PATH"
+
+
+class ValidationError(ValueError):
+    pass
+
+
+def _builtin_pod_spec(viewer: dict) -> dict:
+    ns = viewer["metadata"].get("namespace", "")
+    name = viewer["metadata"]["name"]
+    base_prefix = (
+        ((viewer.get("spec") or {}).get("networking")) or {}
+    ).get("basePrefix", "")
+    return {
+        "containers": [{
+            "name": "pvcviewer",
+            "image": "filebrowser/filebrowser:latest",
+            "ports": [{"containerPort": 8080, "protocol": "TCP"}],
+            "env": [
+                {"name": "FB_ADDRESS", "value": "0.0.0.0"},
+                {"name": "FB_PORT", "value": "8080"},
+                {"name": "FB_DATABASE", "value": "/tmp/filebrowser.db"},
+                {"name": "FB_NOAUTH", "value": "true"},
+                {"name": "FB_BASEURL",
+                 "value": f"{base_prefix}/{ns}/{name}/"},
+            ],
+            "workingDir": "/data",
+            "volumeMounts": [{"name": VOLUME_NAME, "mountPath": "/data"}],
+        }],
+    }
+
+
+def apply_defaults(viewer: dict) -> dict:
+    """Defaulting webhook: fill an empty podSpec and bind the PVC volume
+    (reference pvcviewer_webhook.go:70-147). Returns a defaulted copy."""
+    viewer = copy.deepcopy(viewer)
+    spec = viewer.setdefault("spec", {})
+    if not spec.get("podSpec"):
+        default_path = get_env_default(DEFAULT_POD_SPEC_PATH_ENV, "")
+        pod_spec = None
+        if default_path and os.path.exists(default_path):
+            with open(default_path) as f:
+                pod_spec = yaml.safe_load(f)
+        spec["podSpec"] = pod_spec or _builtin_pod_spec(viewer)
+        # Always append (not replace) so extra volumes survive, and the
+        # default file needn't know the PVC name in advance.
+        spec["podSpec"].setdefault("volumes", []).append({
+            "name": VOLUME_NAME,
+            "persistentVolumeClaim": {"claimName": spec.get("pvc", "")},
+        })
+    return viewer
+
+
+def validate(viewer: dict) -> None:
+    """Validating webhook (reference pvcviewer_webhook.go:153-178)."""
+    spec = viewer.get("spec") or {}
+    pvc = spec.get("pvc")
+    if not pvc:
+        raise ValidationError("PVC name must be specified")
+    pod_spec = spec.get("podSpec")
+    if not pod_spec:
+        raise ValidationError("PodSpec must be specified")
+    for volume in pod_spec.get("volumes") or []:
+        claim = (volume.get("persistentVolumeClaim") or {})
+        if claim.get("claimName") == pvc:
+            return
+    raise ValidationError(f"PVC {pvc} must be used in the podSpec")
+
+
+class PVCViewerReconciler(Reconciler):
+    resource = "pvcviewers"
+    group = GROUP
+
+    def __init__(self, kube):
+        self.kube = kube
+        self.istio_gateway = get_env_default(
+            "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
+        )
+        self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
+
+    def register(self, manager) -> "PVCViewerReconciler":
+        ctl = manager.add_reconciler(self)
+        manager.watch_owned(ctl, "deployments", group="apps",
+                            owner_kind="PVCViewer")
+        manager.watch_owned(ctl, "services", owner_kind="PVCViewer")
+        return self
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            viewer = self.kube.get("pvcviewers", req.name,
+                                   namespace=req.namespace, group=GROUP)
+        except errors.NotFound:
+            return Result()
+        if viewer["metadata"].get("deletionTimestamp"):
+            # Keep status honest while GC runs (reference :105-116).
+            self.update_status(viewer)
+            return Result()
+
+        # Defaulting normally happens at admission; re-apply here so the
+        # controller is safe against CRs created before the webhook was up.
+        viewer = apply_defaults(viewer)
+        try:
+            validate(viewer)
+        except ValidationError as e:
+            # Terminal user error (e.g. explicit podSpec not mounting the
+            # PVC): surface on the CR instead of retry-storming.
+            self._set_invalid_condition(viewer, str(e))
+            return Result()
+
+        labels = self._labels(viewer)
+        self._reconcile_deployment(viewer, labels)
+        if self._networking(viewer):
+            helpers.ensure(
+                self.kube, "services", self.generate_service(viewer, labels),
+                copy_fields=helpers.copy_service_fields,
+            )
+            helpers.ensure(
+                self.kube, "virtualservices",
+                self.generate_virtual_service(viewer, labels),
+                group="networking.istio.io",
+            )
+        self.update_status(viewer)
+        return Result()
+
+    # --------------------------------------------------------- generators
+
+    @staticmethod
+    def _labels(viewer: dict) -> dict:
+        name = viewer["metadata"]["name"]
+        return {
+            NAME_LABEL: name,
+            INSTANCE_LABEL: RESOURCE_PREFIX + name,
+            PART_OF_LABEL: PART_OF_VALUE,
+        }
+
+    @staticmethod
+    def _networking(viewer: dict) -> dict:
+        return ((viewer.get("spec") or {}).get("networking")) or {}
+
+    def _reconcile_deployment(self, viewer: dict, labels: dict) -> None:
+        name = RESOURCE_PREFIX + viewer["metadata"]["name"]
+        ns = viewer["metadata"]["namespace"]
+        existing = None
+        try:
+            existing = self.kube.get("deployments", name, namespace=ns,
+                                     group="apps")
+        except errors.NotFound:
+            pass
+
+        pod_spec = copy.deepcopy((viewer.get("spec") or {}).get("podSpec"))
+        if existing is not None:
+            # Affinity is decided once, at creation (reference :165-170).
+            affinity = (
+                ((existing["spec"].get("template") or {}).get("spec") or {})
+            ).get("affinity")
+            if affinity is not None:
+                pod_spec["affinity"] = affinity
+        elif (viewer.get("spec") or {}).get("rwoScheduling"):
+            affinity = self._generate_affinity(viewer)
+            if affinity:
+                pod_spec["affinity"] = affinity
+
+        desired = {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": name, "namespace": ns, "labels": labels,
+                "ownerReferences": [helpers.owner_reference(viewer)],
+            },
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": labels},
+                "strategy": {"type": "Recreate"},
+                "template": {
+                    "metadata": {"labels": labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+        helpers.ensure(self.kube, "deployments", desired, group="apps")
+
+    def _generate_affinity(self, viewer: dict) -> dict | None:
+        """Prefer the single node where a foreign running pod mounts the
+        RWO PVC; omit on ambiguity (reference :372-430)."""
+        ns = viewer["metadata"]["namespace"]
+        pvcname = (viewer.get("spec") or {}).get("pvc", "")
+        try:
+            pvc = self.kube.get("persistentvolumeclaims", pvcname,
+                                namespace=ns)
+        except errors.NotFound:
+            return None
+        modes = (pvc.get("spec") or {}).get("accessModes") or []
+        if modes != ["ReadWriteOnce"]:
+            return None
+        nodename = None
+        for pod in self.kube.list("pods", namespace=ns).get("items", []):
+            pod_labels = pod["metadata"].get("labels") or {}
+            if pod_labels.get(PART_OF_LABEL) == PART_OF_VALUE:
+                continue  # skip pods this controller created
+            for vol in (pod.get("spec") or {}).get("volumes") or []:
+                claim = (vol.get("persistentVolumeClaim") or {})
+                if claim.get("claimName") != pvcname:
+                    continue
+                this_node = (pod.get("spec") or {}).get("nodeName", "")
+                if not this_node:
+                    return None  # pod not yet scheduled: can't decide
+                if nodename is not None and nodename != this_node:
+                    return None  # mounted on multiple nodes: ambiguous
+                nodename = this_node
+        if nodename is None:
+            return None
+        return {"nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [{
+                "weight": 100,
+                "preference": {"matchExpressions": [{
+                    "key": "kubernetes.io/hostname",
+                    "operator": "In",
+                    "values": [nodename],
+                }]},
+            }],
+        }}
+
+    def generate_service(self, viewer: dict, labels: dict) -> dict:
+        name = RESOURCE_PREFIX + viewer["metadata"]["name"]
+        ns = viewer["metadata"]["namespace"]
+        target = self._networking(viewer).get("targetPort", 8080)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name, "namespace": ns, "labels": labels,
+                "ownerReferences": [helpers.owner_reference(viewer)],
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": labels,
+                "ports": [{
+                    "name": "http",
+                    "port": SERVICE_PORT,
+                    "targetPort": target,
+                }],
+            },
+        }
+
+    def generate_virtual_service(self, viewer: dict, labels: dict) -> dict:
+        name = viewer["metadata"]["name"]
+        ns = viewer["metadata"]["namespace"]
+        net = self._networking(viewer)
+        prefix = f"{net.get('basePrefix', '')}/{ns}/{name}/"
+        rewrite = net.get("rewrite") or prefix
+        host = (
+            f"{RESOURCE_PREFIX}{name}.{ns}.svc.{self.cluster_domain}"
+        )
+        http = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": rewrite},
+            "route": [{"destination": {
+                "host": host, "port": {"number": SERVICE_PORT},
+            }}],
+        }
+        if net.get("timeout"):
+            http["timeout"] = net["timeout"]
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {
+                "name": RESOURCE_PREFIX + name, "namespace": ns,
+                "labels": labels,
+                "ownerReferences": [helpers.owner_reference(viewer)],
+            },
+            "spec": {
+                "hosts": ["*"],
+                "gateways": [self.istio_gateway],
+                "http": [http],
+            },
+        }
+
+    # -------------------------------------------------------------- status
+
+    def _set_invalid_condition(self, viewer: dict, message: str) -> None:
+        viewer = copy.deepcopy(viewer)
+        status = viewer.setdefault("status", {})
+        status["ready"] = False
+        conds = status.setdefault("conditions", [])
+        if not conds or conds[-1].get("type") != "InvalidSpec":
+            conds.append({"type": "InvalidSpec", "status": "True",
+                          "message": message})
+        try:
+            self.kube.update_status("pvcviewers", viewer, group=GROUP)
+        except (errors.Conflict, errors.NotFound):
+            pass
+
+    def update_status(self, viewer: dict) -> None:
+        name = viewer["metadata"]["name"]
+        ns = viewer["metadata"]["namespace"]
+        status = dict(viewer.get("status") or {})
+        net = self._networking(viewer)
+        if net:
+            status["url"] = f"{net.get('basePrefix', '')}/{ns}/{name}/"
+        else:
+            status.pop("url", None)
+        try:
+            deploy = self.kube.get("deployments", RESOURCE_PREFIX + name,
+                                   namespace=ns, group="apps")
+        except errors.NotFound:
+            status["ready"] = False
+        else:
+            dstatus = deploy.get("status") or {}
+            status["ready"] = (
+                deploy["spec"].get("replicas", 1)
+                == dstatus.get("readyReplicas", -1)
+            )
+            dconds = dstatus.get("conditions") or []
+            if dconds:
+                conds = status.setdefault("conditions", [])
+                # Append on state change only — comparing whole dicts (as
+                # the reference does, pvcviewer_controller.go:356-360)
+                # grows status unboundedly on timestamp-only updates.
+                if not conds or conds[-1].get("type") != dconds[0].get("type"):
+                    conds.append(dconds[0])
+        if (viewer.get("status") or {}) != status:
+            viewer = copy.deepcopy(viewer)
+            viewer["status"] = status
+            try:
+                self.kube.update_status("pvcviewers", viewer, group=GROUP)
+            except (errors.Conflict, errors.NotFound):
+                pass

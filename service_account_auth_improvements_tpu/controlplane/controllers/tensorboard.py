@@ -1,0 +1,303 @@
+"""Tensorboard controller: Tensorboard CR → Deployment + Service (+ VS).
+
+TPU-native rethink of the reference's tensorboard-controller (reconcile
+shape: components/tensorboard-controller/controllers/
+tensorboard_controller.go:67-225):
+
+- ``spec.logspath`` is ``pvc://<name>/<subpath>`` (mounted read-only at
+  /tensorboard_logs, reference :180-205) or ``gs://bucket/path``. For GCS
+  the reference mounts a ``user-gcp-sa`` secret (:231-246); here we run the
+  server as the profile's ``default-editor`` ServiceAccount, which the
+  profile-controller's workload-identity plugin binds to a GCP SA — no
+  key material in pods (the GKE-idiomatic path).
+- JAX/XLA profile traces are first-class: ``spec.profile: true`` loads the
+  tensorboard profile plugin so ``jax.profiler.trace`` output written by a
+  TPU workload is browsable. The reference has no profiling story
+  (SURVEY.md §5 "Tracing/profiling: none").
+- RWO-PVC affinity: when RWO_PVC_SCHEDULING=true and the logs PVC is
+  ReadWriteOnce and currently mounted by a running pod, prefer that pod's
+  node (reference :428-476 generateNodeAffinity + rwoPVCScheduling).
+- Status appends a condition whenever the Deployment's leading condition
+  type changes, and mirrors readyReplicas (reference :120-155).
+"""
+
+from __future__ import annotations
+
+import copy
+
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.utils.env import (
+    get_env_bool,
+    get_env_default,
+)
+
+GROUP = "tpukf.dev"
+TB_PORT = 6006
+SERVICE_PORT = 80
+MOUNT_PATH = "/tensorboard_logs/"
+DEFAULT_IMAGE = "ghcr.io/tpukf/tensorboard-tpu:latest"
+
+
+def is_gcs_path(path: str) -> bool:
+    return path.startswith("gs://")
+
+
+def is_pvc_path(path: str) -> bool:
+    return path.startswith("pvc://")
+
+
+def split_pvc_path(path: str) -> tuple[str, str]:
+    """``pvc://name/sub/dir`` → (name, "sub/dir") (reference :497-515)."""
+    trimmed = path.removeprefix("pvc://")
+    name, _, subpath = trimmed.partition("/")
+    return name, subpath
+
+
+class TensorboardReconciler(Reconciler):
+    resource = "tensorboards"
+    group = GROUP
+
+    def __init__(self, kube):
+        self.kube = kube
+        self.image = get_env_default("TENSORBOARD_IMAGE", DEFAULT_IMAGE)
+        self.use_istio = get_env_bool("USE_ISTIO", False)
+        self.istio_gateway = get_env_default(
+            "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
+        )
+        self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
+        self.rwo_scheduling = get_env_bool("RWO_PVC_SCHEDULING", False)
+
+    def register(self, manager) -> "TensorboardReconciler":
+        ctl = manager.add_reconciler(self)
+        manager.watch_owned(ctl, "deployments", group="apps",
+                            owner_kind="Tensorboard")
+        manager.watch_owned(ctl, "services", owner_kind="Tensorboard")
+        return self
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Result:
+        try:
+            tb = self.kube.get("tensorboards", req.name,
+                               namespace=req.namespace, group=GROUP)
+        except errors.NotFound:
+            return Result()
+        if tb["metadata"].get("deletionTimestamp"):
+            # TWA deletes with foreground policy; don't fight the GC
+            # (reference :84-90).
+            return Result()
+
+        deploy, _ = helpers.ensure(
+            self.kube, "deployments", self.generate_deployment(tb),
+            group="apps",
+        )
+        helpers.ensure(
+            self.kube, "services", self.generate_service(tb),
+            copy_fields=helpers.copy_service_fields,
+        )
+        if self.use_istio:
+            helpers.ensure(
+                self.kube, "virtualservices",
+                self.generate_virtual_service(tb),
+                group="networking.istio.io",
+            )
+        self.update_status(tb, deploy)
+        return Result()
+
+    # --------------------------------------------------------- generators
+
+    def generate_deployment(self, tb: dict) -> dict:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        spec = tb.get("spec") or {}
+        logspath = spec.get("logspath", "")
+
+        volumes: list[dict] = []
+        mounts: list[dict] = []
+        pod_spec: dict = {}
+        logdir = logspath
+        if is_gcs_path(logspath):
+            # Workload Identity: default-editor KSA is IAM-bound by the
+            # profile plugin; tensorboard reads the bucket with ADC.
+            pod_spec["serviceAccountName"] = "default-editor"
+        else:
+            if is_pvc_path(logspath):
+                pvcname, subpath = split_pvc_path(logspath)
+            else:
+                # Legacy form: bare path inside the conventional PVC
+                # (reference :186-189 "tb-volume" compatibility).
+                pvcname, subpath = "tb-volume", ""
+            logdir = MOUNT_PATH
+            mounts.append({
+                "name": "tbpd", "readOnly": True,
+                "mountPath": MOUNT_PATH, "subPath": subpath,
+            })
+            volumes.append({
+                "name": "tbpd",
+                "persistentVolumeClaim": {"claimName": pvcname},
+            })
+            if self.rwo_scheduling:
+                affinity = self._rwo_affinity(ns, pvcname)
+                if affinity:
+                    pod_spec["affinity"] = affinity
+
+        args = [f"--logdir={logdir}", "--bind_all"]
+        if spec.get("profile", True):
+            # The profile plugin scans the logdir's plugins/profile dir
+            # written by jax.profiler; slow-load mode is required for it.
+            args.append("--load_fast=false")
+
+        pod_labels = dict(tb["metadata"].get("labels") or {})
+        pod_labels["app"] = name
+        pod_spec.update({
+            "restartPolicy": "Always",
+            "containers": [{
+                "name": "tensorboard",
+                "image": self.image,
+                "imagePullPolicy": "IfNotPresent",
+                "command": ["tensorboard"],
+                "workingDir": "/",
+                "args": args,
+                "ports": [{"containerPort": TB_PORT}],
+                "volumeMounts": mounts,
+            }],
+            "volumes": volumes,
+        })
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {
+                "name": name, "namespace": ns,
+                "labels": {"app": name},
+                "ownerReferences": [helpers.owner_reference(tb)],
+            },
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": name}},
+                "template": {
+                    "metadata": {"labels": pod_labels},
+                    "spec": pod_spec,
+                },
+            },
+        }
+
+    def _rwo_affinity(self, ns: str, pvcname: str) -> dict | None:
+        """Prefer the node where a running pod already mounts the RWO PVC
+        (reference :388-412, :428-476)."""
+        try:
+            pvc = self.kube.get("persistentvolumeclaims", pvcname,
+                                namespace=ns)
+        except errors.NotFound:
+            return None
+        modes = (pvc.get("status") or {}).get("accessModes") or \
+            (pvc.get("spec") or {}).get("accessModes") or []
+        if not modes or modes[0] != "ReadWriteOnce":
+            return None
+        nodename = ""
+        for pod in self.kube.list("pods", namespace=ns).get("items", []):
+            if (pod.get("status") or {}).get("phase") != "Running":
+                continue
+            for vol in (pod.get("spec") or {}).get("volumes") or []:
+                claim = (vol.get("persistentVolumeClaim") or {})
+                if claim.get("claimName") == pvcname:
+                    nodename = (pod.get("spec") or {}).get("nodeName", "")
+                    break
+            if nodename:
+                break
+        if not nodename:
+            return None
+        return {"nodeAffinity": {
+            "preferredDuringSchedulingIgnoredDuringExecution": [{
+                "weight": 100,
+                "preference": {"matchExpressions": [{
+                    "key": "kubernetes.io/hostname",
+                    "operator": "In",
+                    "values": [nodename],
+                }]},
+            }],
+        }}
+
+    def generate_service(self, tb: dict) -> dict:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": name, "namespace": ns,
+                "labels": {"app": name},
+                "ownerReferences": [helpers.owner_reference(tb)],
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"app": name},
+                "ports": [{
+                    "name": "http-" + name,
+                    "port": SERVICE_PORT,
+                    "targetPort": TB_PORT,
+                    "protocol": "TCP",
+                }],
+            },
+        }
+
+    def generate_virtual_service(self, tb: dict) -> dict:
+        name = tb["metadata"]["name"]
+        ns = tb["metadata"]["namespace"]
+        prefix = f"/tensorboard/{ns}/{name}/"
+        host = f"{name}.{ns}.svc.{self.cluster_domain}"
+        return {
+            "apiVersion": "networking.istio.io/v1beta1",
+            "kind": "VirtualService",
+            "metadata": {
+                "name": name, "namespace": ns,
+                "ownerReferences": [helpers.owner_reference(tb)],
+            },
+            "spec": {
+                "hosts": ["*"],
+                "gateways": [self.istio_gateway],
+                "http": [{
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": "/"},
+                    "route": [{"destination": {
+                        "host": host, "port": {"number": SERVICE_PORT},
+                    }}],
+                    "timeout": "300s",
+                }],
+            },
+        }
+
+    # -------------------------------------------------------------- status
+
+    def update_status(self, tb: dict, deploy: dict) -> None:
+        dstatus = deploy.get("status") or {}
+        status = {
+            "readyReplicas": dstatus.get("readyReplicas", 0),
+            "conditions": list(
+                (tb.get("status") or {}).get("conditions") or []
+            ),
+        }
+        dconds = dstatus.get("conditions") or []
+        if dconds:
+            cond = {
+                "deploymentState": dconds[0].get("type", ""),
+                "lastProbeTime": dconds[0].get("lastUpdateTime", ""),
+            }
+            prev = status["conditions"]
+            if not prev or prev[-1].get("deploymentState") != \
+                    cond["deploymentState"]:
+                prev.append(cond)
+        if (tb.get("status") or {}) != status:
+            tb = copy.deepcopy(tb)
+            tb["status"] = status
+            try:
+                self.kube.update_status("tensorboards", tb, group=GROUP)
+            except (errors.Conflict, errors.NotFound):
+                pass  # deleted or re-leveled mid-reconcile

@@ -231,6 +231,21 @@ class KfamApp:
                     return respond(200, {"status": "ok"})
             if path == "/kfam/v1/profiles" and method == "POST":
                 payload = body()
+                owner = (payload.get("owner")
+                         or ((payload.get("spec") or {}).get("owner")) or {})
+                # Self-registration: the caller may create a profile they
+                # own; only the cluster admin may create for others (the
+                # reference performs no check here — api_default.go:134-155
+                # — but its docstring contract and ours say mutations are
+                # owner-or-admin gated).
+                if not caller or (
+                    owner.get("name") != caller
+                    and not self._is_cluster_admin(caller)
+                ):
+                    return respond(403, {"error": (
+                        f"user {caller!r} may only create a profile "
+                        "they own"
+                    )})
                 out = self.create_profile(payload)
                 return respond(200, out)
             m = re.fullmatch(r"/kfam/v1/profiles/([^/]+)", path)

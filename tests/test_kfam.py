@@ -160,3 +160,24 @@ def test_metrics_endpoint(world):
     out = b"".join(app(environ, start_response)).decode()
     assert status["code"] == 200
     assert "request_kf_total" in out
+
+
+def test_create_profile_requires_self_or_admin(world):
+    kube, app = world
+    # Forged owner: bob tries to create a profile owned by someone else.
+    code, _ = call(app, "POST", "/kfam/v1/profiles", {
+        "name": "evil", "owner": {"kind": "User", "name": "victim@example.com"},
+    }, user="bob@example.com")
+    assert code == 403
+    # Anonymous (no userid header) is rejected outright.
+    code, _ = call(app, "POST", "/kfam/v1/profiles", {
+        "name": "anon", "owner": {"kind": "User", "name": "x@example.com"},
+    })
+    assert code == 403
+    with pytest.raises(errors.NotFound):
+        kube.get("profiles", "evil", group="tpukf.dev")
+    # The cluster admin may create on behalf of others.
+    code, _ = call(app, "POST", "/kfam/v1/profiles", {
+        "name": "carol", "owner": {"kind": "User", "name": "carol@example.com"},
+    }, user="root@example.com")
+    assert code == 200

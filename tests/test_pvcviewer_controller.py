@@ -1,0 +1,197 @@
+"""PVCViewer controller: defaulting, validation, Deployment/Service/VS,
+RWO affinity, status (envtest model — SURVEY.md §4.2; the reference covers
+this surface in pvcviewer_controller_test.go:30-249)."""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.pvcviewer import (
+    RESOURCE_PREFIX,
+    PVCViewerReconciler,
+    ValidationError,
+    apply_defaults,
+    validate,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+GROUP = "tpukf.dev"
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _viewer(name="v1", ns="user1", **spec):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"pvc": "data-pvc", **spec},
+    }
+
+
+def _deploy(kube, name="v1", ns="user1"):
+    try:
+        return kube.get("deployments", RESOURCE_PREFIX + name,
+                        namespace=ns, group="apps")
+    except errors.NotFound:
+        return None
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    PVCViewerReconciler(kube).register(mgr)
+    mgr.start()
+    yield kube, mgr
+    mgr.stop()
+
+
+# ------------------------------------------------- webhook logic (pure)
+
+def test_defaulting_builds_filebrowser_and_binds_pvc():
+    out = apply_defaults(_viewer(networking={"basePrefix": "/pvcviewer"}))
+    pod_spec = out["spec"]["podSpec"]
+    c = pod_spec["containers"][0]
+    assert c["image"].startswith("filebrowser/")
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["FB_BASEURL"] == "/pvcviewer/user1/v1/"
+    assert pod_spec["volumes"][-1]["persistentVolumeClaim"]["claimName"] == \
+        "data-pvc"
+    validate(out)  # defaulted CR must validate
+
+
+def test_defaulting_from_file(tmp_path, monkeypatch):
+    f = tmp_path / "podspec.yaml"
+    f.write_text(
+        "containers:\n- name: custom\n  image: img:1\n"
+    )
+    monkeypatch.setenv("DEFAULT_POD_SPEC_PATH", str(f))
+    out = apply_defaults(_viewer())
+    assert out["spec"]["podSpec"]["containers"][0]["name"] == "custom"
+    # PVC volume still appended to the file-provided spec.
+    assert out["spec"]["podSpec"]["volumes"][-1][
+        "persistentVolumeClaim"]["claimName"] == "data-pvc"
+
+
+def test_defaulting_preserves_explicit_podspec():
+    explicit = {"containers": [{"name": "x", "image": "y"}],
+                "volumes": [{"name": "v",
+                             "persistentVolumeClaim":
+                                 {"claimName": "data-pvc"}}]}
+    out = apply_defaults(_viewer(podSpec=explicit))
+    assert out["spec"]["podSpec"] == explicit
+
+
+def test_validation_rejects():
+    with pytest.raises(ValidationError):
+        validate({"metadata": {"name": "a"}, "spec": {}})
+    with pytest.raises(ValidationError):
+        validate({"metadata": {"name": "a"}, "spec": {"pvc": "p"}})
+    with pytest.raises(ValidationError):
+        validate(_viewer(podSpec={"containers": [], "volumes": []}))
+
+
+# ------------------------------------------------------- reconciliation
+
+def test_reconcile_creates_deployment_recreate_strategy(world):
+    kube, _ = world
+    kube.create("pvcviewers", _viewer(), group=GROUP)
+    assert _wait(lambda: _deploy(kube) is not None)
+    dep = _deploy(kube)
+    assert dep["spec"]["strategy"]["type"] == "Recreate"
+    vols = dep["spec"]["template"]["spec"]["volumes"]
+    assert vols[-1]["persistentVolumeClaim"]["claimName"] == "data-pvc"
+    # No networking → no Service/VS.
+    with pytest.raises(errors.NotFound):
+        kube.get("services", RESOURCE_PREFIX + "v1", namespace="user1")
+
+
+def test_networking_creates_service_and_vs(world):
+    kube, _ = world
+    kube.create("pvcviewers", _viewer(
+        name="n1",
+        networking={"basePrefix": "/pvcviewer", "targetPort": 8080,
+                    "rewrite": "/", "timeout": "30s"},
+    ), group=GROUP)
+    assert _wait(lambda: _deploy(kube, "n1") is not None)
+    svc = kube.get("services", RESOURCE_PREFIX + "n1", namespace="user1")
+    assert svc["spec"]["ports"][0]["targetPort"] == 8080
+    vs = kube.get("virtualservices", RESOURCE_PREFIX + "n1",
+                  namespace="user1", group="networking.istio.io")
+    http = vs["spec"]["http"][0]
+    assert http["match"][0]["uri"]["prefix"] == "/pvcviewer/user1/n1/"
+    assert http["rewrite"]["uri"] == "/"
+    assert http["timeout"] == "30s"
+
+    def has_url():
+        v = kube.get("pvcviewers", "n1", namespace="user1", group=GROUP)
+        return (v.get("status") or {}).get("url") == "/pvcviewer/user1/n1/"
+
+    assert _wait(has_url)
+
+
+def test_rwo_scheduling_prefers_mounting_node(world):
+    kube, _ = world
+    kube.create("persistentvolumeclaims", {
+        "metadata": {"name": "data-pvc", "namespace": "user1"},
+        "spec": {"accessModes": ["ReadWriteOnce"]},
+    })
+    kube.create("pods", {
+        "metadata": {"name": "writer", "namespace": "user1"},
+        "spec": {"nodeName": "node-3",
+                 "containers": [{"name": "c", "image": "i"}],
+                 "volumes": [{"name": "v", "persistentVolumeClaim":
+                              {"claimName": "data-pvc"}}]},
+        "status": {"phase": "Running"},
+    })
+    kube.create("pvcviewers", _viewer(name="r1", rwoScheduling=True),
+                group=GROUP)
+    assert _wait(lambda: _deploy(kube, "r1") is not None)
+    aff = _deploy(kube, "r1")["spec"]["template"]["spec"]["affinity"]
+    pref = aff["nodeAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0]
+    assert pref["preference"]["matchExpressions"][0]["values"] == ["node-3"]
+
+
+def test_status_ready_mirrors_deployment(world):
+    kube, _ = world
+    kube.create("pvcviewers", _viewer(name="s1"), group=GROUP)
+    assert _wait(lambda: _deploy(kube, "s1") is not None)
+    dep = _deploy(kube, "s1")
+    dep["status"] = {"readyReplicas": 1,
+                     "conditions": [{"type": "Available", "status": "True"}]}
+    kube.update_status("deployments", dep, group="apps")
+
+    def ready():
+        v = kube.get("pvcviewers", "s1", namespace="user1", group=GROUP)
+        st = v.get("status") or {}
+        return st.get("ready") is True and \
+            (st.get("conditions") or [])[-1]["type"] == "Available"
+
+    assert _wait(ready)
+
+
+def test_invalid_explicit_podspec_sets_condition_not_retry_storm(world):
+    kube, _ = world
+    kube.create("pvcviewers", _viewer(
+        name="bad",
+        podSpec={"containers": [{"name": "x", "image": "y"}]},  # no PVC vol
+    ), group=GROUP)
+
+    def has_condition():
+        v = kube.get("pvcviewers", "bad", namespace="user1", group=GROUP)
+        conds = (v.get("status") or {}).get("conditions") or []
+        return any(c["type"] == "InvalidSpec" for c in conds)
+
+    assert _wait(has_condition)
+    assert _deploy(kube, "bad") is None

@@ -1,0 +1,148 @@
+"""Tensorboard controller: CR → Deployment/Service/VS, logspath forms,
+RWO-PVC affinity, status conditions (envtest model — SURVEY.md §4.2)."""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.tensorboard import (
+    TensorboardReconciler,
+    split_pvc_path,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+GROUP = "tpukf.dev"
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _tb(name="tb1", ns="user1", logspath="pvc://logs-pvc/run1"):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"logspath": logspath},
+    }
+
+
+def _deploy(kube, name="tb1", ns="user1"):
+    try:
+        return kube.get("deployments", name, namespace=ns, group="apps")
+    except errors.NotFound:
+        return None
+
+
+@pytest.fixture()
+def world(monkeypatch):
+    monkeypatch.setenv("USE_ISTIO", "true")
+    monkeypatch.setenv("RWO_PVC_SCHEDULING", "true")
+    kube = FakeKube()
+    mgr = Manager(kube)
+    TensorboardReconciler(kube).register(mgr)
+    mgr.start()
+    yield kube, mgr
+    mgr.stop()
+
+
+def test_split_pvc_path():
+    assert split_pvc_path("pvc://mypvc/a/b") == ("mypvc", "a/b")
+    assert split_pvc_path("pvc://mypvc") == ("mypvc", "")
+    assert split_pvc_path("pvc://mypvc/") == ("mypvc", "")
+
+
+def test_pvc_logspath_mounts_readonly(world):
+    kube, _ = world
+    kube.create("tensorboards", _tb(), group=GROUP)
+    assert _wait(lambda: _deploy(kube) is not None)
+    dep = _deploy(kube)
+    pod = dep["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    mount = c["volumeMounts"][0]
+    assert mount["readOnly"] is True
+    assert mount["mountPath"] == "/tensorboard_logs/"
+    assert mount["subPath"] == "run1"
+    assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "logs-pvc"
+    assert f"--logdir=/tensorboard_logs/" in c["args"]
+    # Routing service + VS at the tensorboard prefix.
+    svc = kube.get("services", "tb1", namespace="user1")
+    assert svc["spec"]["ports"][0]["targetPort"] == 6006
+    vs = kube.get("virtualservices", "tb1", namespace="user1",
+                  group="networking.istio.io")
+    prefix = vs["spec"]["http"][0]["match"][0]["uri"]["prefix"]
+    assert prefix == "/tensorboard/user1/tb1/"
+
+
+def test_gcs_logspath_uses_workload_identity_not_secret(world):
+    kube, _ = world
+    kube.create("tensorboards", _tb(name="gtb", logspath="gs://b/run"),
+                group=GROUP)
+    assert _wait(lambda: _deploy(kube, "gtb") is not None)
+    pod = _deploy(kube, "gtb")["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "default-editor"
+    assert not pod["volumes"]  # no gcp key secret mounted
+    assert "--logdir=gs://b/run" in pod["containers"][0]["args"]
+
+
+def test_profile_plugin_flag(world):
+    kube, _ = world
+    kube.create("tensorboards", _tb(name="ptb"), group=GROUP)
+    assert _wait(lambda: _deploy(kube, "ptb") is not None)
+    assert "--load_fast=false" in \
+        _deploy(kube, "ptb")["spec"]["template"]["spec"]["containers"][0]["args"]
+
+
+def test_rwo_pvc_affinity_prefers_mounting_node(world):
+    kube, _ = world
+    kube.create("persistentvolumeclaims", {
+        "metadata": {"name": "logs-pvc", "namespace": "user1"},
+        "spec": {"accessModes": ["ReadWriteOnce"]},
+        "status": {"accessModes": ["ReadWriteOnce"]},
+    })
+    kube.create("pods", {
+        "metadata": {"name": "writer", "namespace": "user1"},
+        "spec": {
+            "nodeName": "node-7",
+            "containers": [{"name": "c", "image": "i"}],
+            "volumes": [{"name": "v",
+                         "persistentVolumeClaim": {"claimName": "logs-pvc"}}],
+        },
+        "status": {"phase": "Running"},
+    })
+    kube.create("tensorboards", _tb(name="atb"), group=GROUP)
+    assert _wait(lambda: _deploy(kube, "atb") is not None)
+    pod = _deploy(kube, "atb")["spec"]["template"]["spec"]
+    pref = pod["affinity"]["nodeAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0]
+    assert pref["preference"]["matchExpressions"][0]["values"] == ["node-7"]
+
+
+def test_status_tracks_deployment_conditions(world):
+    kube, _ = world
+    kube.create("tensorboards", _tb(name="stb"), group=GROUP)
+    assert _wait(lambda: _deploy(kube, "stb") is not None)
+    dep = _deploy(kube, "stb")
+    dep["status"] = {
+        "readyReplicas": 1,
+        "conditions": [{"type": "Available",
+                        "lastUpdateTime": "2026-01-01T00:00:00Z"}],
+    }
+    kube.update_status("deployments", dep, group="apps")
+
+    def mirrored():
+        tb = kube.get("tensorboards", "stb", namespace="user1", group=GROUP)
+        st = tb.get("status") or {}
+        conds = st.get("conditions") or []
+        return st.get("readyReplicas") == 1 and conds and \
+            conds[-1]["deploymentState"] == "Available"
+
+    assert _wait(mirrored)
