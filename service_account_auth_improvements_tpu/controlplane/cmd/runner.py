@@ -32,6 +32,8 @@ def run_manager(register, argv=None, add_args=None) -> int:
                         help="API server base URL (default: in-cluster)")
     parser.add_argument("--namespace", default=None,
                         help="restrict to one namespace (default: all)")
+    parser.add_argument("--workers", type=int, default=2,
+                        help="reconcile workers per controller")
     if add_args:
         add_args(parser)
     args = parser.parse_args(argv)
@@ -41,7 +43,8 @@ def run_manager(register, argv=None, add_args=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
     client = KubeClient(base_url=args.kube_url)
-    manager = Manager(client, namespace=args.namespace)
+    manager = Manager(client, namespace=args.namespace,
+                      default_workers=args.workers)
     register(client, manager, args)
 
     ready = {"ok": False}

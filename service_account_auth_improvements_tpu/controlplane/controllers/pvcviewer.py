@@ -251,6 +251,12 @@ class PVCViewerReconciler(Reconciler):
             pod_labels = pod["metadata"].get("labels") or {}
             if pod_labels.get(PART_OF_LABEL) == PART_OF_VALUE:
                 continue  # skip pods this controller created
+            if (pod.get("status") or {}).get("phase") != "Running":
+                # Succeeded/Pending pods no longer (or don't yet) hold the
+                # mount; counting them corrupts the node decision. (The
+                # reference lists all pods here, pvcviewer_controller.go:
+                # 393-398 — its tensorboard sibling filters Running.)
+                continue
             for vol in (pod.get("spec") or {}).get("volumes") or []:
                 claim = (vol.get("persistentVolumeClaim") or {})
                 if claim.get("claimName") != pvcname:

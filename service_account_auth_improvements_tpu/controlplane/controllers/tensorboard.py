@@ -133,8 +133,9 @@ class TensorboardReconciler(Reconciler):
                 pvcname, subpath = split_pvc_path(logspath)
             else:
                 # Legacy form: bare path inside the conventional PVC
-                # (reference :186-189 "tb-volume" compatibility).
-                pvcname, subpath = "tb-volume", ""
+                # (reference :186-189 "tb-volume" compatibility) — the
+                # path is the subPath within that PVC.
+                pvcname, subpath = "tb-volume", logspath.strip("/")
             logdir = MOUNT_PATH
             mounts.append({
                 "name": "tbpd", "readOnly": True,

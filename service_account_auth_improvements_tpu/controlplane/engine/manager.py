@@ -102,9 +102,11 @@ class Controller:
 
 
 class Manager:
-    def __init__(self, client, namespace: str | None = None):
+    def __init__(self, client, namespace: str | None = None,
+                 default_workers: int = 1):
         self.client = client
         self.namespace = namespace
+        self.default_workers = default_workers
         self._informers: dict[tuple, Informer] = {}
         self._controllers: list[Controller] = []
         self._started = False
@@ -125,12 +127,13 @@ class Manager:
         return self._informers[key]
 
     def add_reconciler(self, reconciler: Reconciler,
-                       workers: int = 1) -> Controller:
+                       workers: int | None = None) -> Controller:
         if self._started:
             raise RuntimeError(
                 "cannot add reconcilers after Manager.start()"
             )
-        ctl = Controller(self, reconciler, workers=workers)
+        ctl = Controller(self, reconciler,
+                         workers=workers or self.default_workers)
         self._controllers.append(ctl)
 
         def primary_handler(ev_type, obj):

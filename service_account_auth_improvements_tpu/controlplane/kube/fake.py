@@ -166,6 +166,8 @@ class FakeKube:
     def create(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None) -> dict:
         res = self._res(plural, group)
+        if res.kind == "SubjectAccessReview":
+            return self._evaluate_sar(obj)
         with self._lock:
             obj = copy.deepcopy(obj)
             meta = obj.setdefault("metadata", {})
@@ -194,6 +196,19 @@ class FakeKube:
             self._store[key] = obj
             self._emit(res, "ADDED", obj)
             return copy.deepcopy(obj)
+
+    def _evaluate_sar(self, sar: dict) -> dict:
+        """SubjectAccessReview is an ephemeral evaluation, not an object:
+        POST returns the review with status.allowed filled in. Policy comes
+        from ``sar_hook(spec) -> bool`` (tests install deny rules there);
+        default allow keeps the webapp tier usable out of the box."""
+        sar = copy.deepcopy(sar or {})
+        spec = sar.get("spec") or {}
+        allowed = bool(self.sar_hook(spec)) if self.sar_hook else True
+        sar.setdefault("apiVersion", "authorization.k8s.io/v1")
+        sar.setdefault("kind", "SubjectAccessReview")
+        sar["status"] = {"allowed": allowed}
+        return sar
 
     def get(self, plural: str, name: str, namespace: str | None = None,
             group: str | None = None) -> dict:

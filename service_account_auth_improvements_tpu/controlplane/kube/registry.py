@@ -66,6 +66,9 @@ _BUILTIN = [
              "virtualservices"),
     Resource("security.istio.io", "v1beta1", "AuthorizationPolicy",
              "authorizationpolicies"),
+    # Ephemeral review API (never stored; POST-only evaluation).
+    Resource("authorization.k8s.io", "v1", "SubjectAccessReview",
+             "subjectaccessreviews", namespaced=False),
     # This framework's CRDs.
     Resource(GROUP, "v1beta1", "Notebook", "notebooks"),
     Resource(GROUP, "v1", "Profile", "profiles", namespaced=False),

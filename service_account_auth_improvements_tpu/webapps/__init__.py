@@ -1,0 +1,7 @@
+"""Backend-for-frontend web apps (SURVEY.md §1 L4/L5).
+
+``core`` is the shared library (the reference's crud_backend —
+components/crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/);
+``jupyter``/``volumes``/``tensorboards`` are the per-resource apps and
+``dashboard`` is the central-dashboard BFF.
+"""

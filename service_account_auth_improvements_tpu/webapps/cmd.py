@@ -1,0 +1,61 @@
+"""Web-app binaries: ``python -m ...webapps.cmd <app>`` (the reference
+ships one container per app with its own entrypoint.py; one module with
+an app argument keeps them as separate deployables without four copies).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from service_account_auth_improvements_tpu.webapps.serve import run_webapp
+
+
+def _build_dashboard(kube, static_dir=None, mode=None):
+    import os
+
+    from service_account_auth_improvements_tpu.controlplane.kfam import (
+        KfamApp,
+    )
+    from service_account_auth_improvements_tpu.webapps.dashboard import (
+        build_app,
+    )
+    from service_account_auth_improvements_tpu.webapps.dashboard.metrics \
+        import PrometheusMetricsService
+
+    metrics = None
+    prom = os.environ.get("PROMETHEUS_URL")
+    if prom:
+        metrics = PrometheusMetricsService(prom)
+    return build_app(kube, KfamApp(kube), metrics=metrics,
+                     static_dir=static_dir, mode=mode)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv:
+        print("usage: cmd.py {jupyter|volumes|tensorboards|dashboard} "
+              "[--port N] ...", file=sys.stderr)
+        return 2
+    which, rest = argv[0], argv[1:]
+    if which == "jupyter":
+        from service_account_auth_improvements_tpu.webapps.jupyter import (
+            build_app,
+        )
+        return run_webapp(build_app, default_port=5000, argv=rest)
+    if which == "volumes":
+        from service_account_auth_improvements_tpu.webapps.volumes import (
+            build_app,
+        )
+        return run_webapp(build_app, default_port=5001, argv=rest)
+    if which == "tensorboards":
+        from service_account_auth_improvements_tpu.webapps.tensorboards \
+            import build_app
+        return run_webapp(build_app, default_port=5002, argv=rest)
+    if which == "dashboard":
+        return run_webapp(_build_dashboard, default_port=8082, argv=rest)
+    print(f"unknown app {which!r}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

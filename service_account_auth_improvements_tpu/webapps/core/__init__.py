@@ -1,0 +1,22 @@
+"""Shared CRUD-backend library for the web apps.
+
+Stdlib-WSGI re-imagining of the reference's Flask crud_backend
+(components/crud-web-apps/common/backend/kubeflow/kubeflow/crud_backend/
+__init__.py:16 create_app): app factory wiring authn (trusted userid
+header), authz (SubjectAccessReview per request), CSRF (double-submit
+cookie), probes, error handlers, and SPA static serving.
+"""
+
+from service_account_auth_improvements_tpu.webapps.core.app import (
+    HttpError,
+    Request,
+    WebApp,
+)
+from service_account_auth_improvements_tpu.webapps.core.status import (
+    STATUS_PHASE,
+    create_status,
+)
+
+__all__ = [
+    "HttpError", "Request", "WebApp", "STATUS_PHASE", "create_status",
+]

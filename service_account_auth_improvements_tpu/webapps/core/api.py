@@ -1,0 +1,126 @@
+"""Authorization-embedded K8s API access for the web apps.
+
+Mirrors the reference's crud_backend/api/ package (notebook.py, pvc.py,
+custom_resource.py, events.py, pod.py, poddefault.py, storageclass.py,
+namespace.py): every call runs a SubjectAccessReview for the
+authenticated user before touching the API server, so handlers cannot
+forget the check.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from service_account_auth_improvements_tpu.webapps.core import authz
+
+GROUP = "tpukf.dev"
+
+
+@dataclasses.dataclass(frozen=True)
+class _Kind:
+    plural: str
+    group: str
+    version: str
+
+
+KINDS = {
+    "notebooks": _Kind("notebooks", GROUP, "v1beta1"),
+    "poddefaults": _Kind("poddefaults", GROUP, "v1alpha1"),
+    "tensorboards": _Kind("tensorboards", GROUP, "v1alpha1"),
+    "pvcviewers": _Kind("pvcviewers", GROUP, "v1alpha1"),
+    "profiles": _Kind("profiles", GROUP, "v1"),
+    "persistentvolumeclaims": _Kind("persistentvolumeclaims", "", "v1"),
+    "pods": _Kind("pods", "", "v1"),
+    "events": _Kind("events", "", "v1"),
+    "secrets": _Kind("secrets", "", "v1"),
+    "namespaces": _Kind("namespaces", "", "v1"),
+    "storageclasses": _Kind("storageclasses", "storage.k8s.io", "v1"),
+}
+
+
+class KubeApi:
+    """Per-request façade: bound to the caller's identity so every verb is
+    SubjectAccessReview-gated (reference crud_backend/api/notebook.py:14-21
+    repeats this pattern per resource; here it is centralized)."""
+
+    def __init__(self, kube, user: str | None, mode: str | None = None):
+        self.kube = kube
+        self.user = user
+        self.mode = mode
+
+    def _ensure(self, verb: str, kind: _Kind,
+                namespace: str | None = None) -> None:
+        authz.ensure_authorized(
+            self.kube, self.user, verb, kind.group, kind.version,
+            kind.plural, namespace=namespace, mode=self.mode,
+        )
+
+    def _kind(self, plural: str) -> _Kind:
+        return KINDS[plural]
+
+    # ----------------------------------------------------------- generic
+
+    def list(self, plural: str, namespace: str | None = None,
+             label_selector: str = "", field_selector: str = "") -> list:
+        kind = self._kind(plural)
+        self._ensure("list", kind, namespace)
+        out = self.kube.list(
+            kind.plural, namespace=namespace, label_selector=label_selector,
+            field_selector=field_selector, group=kind.group or None,
+        )
+        return out.get("items", [])
+
+    def get(self, plural: str, name: str,
+            namespace: str | None = None) -> dict:
+        kind = self._kind(plural)
+        self._ensure("get", kind, namespace)
+        return self.kube.get(kind.plural, name, namespace=namespace,
+                             group=kind.group or None)
+
+    def create(self, plural: str, obj: dict,
+               namespace: str | None = None) -> dict:
+        kind = self._kind(plural)
+        self._ensure("create", kind, namespace)
+        return self.kube.create(kind.plural, obj, namespace=namespace,
+                                group=kind.group or None)
+
+    def delete(self, plural: str, name: str,
+               namespace: str | None = None) -> dict:
+        kind = self._kind(plural)
+        self._ensure("delete", kind, namespace)
+        return self.kube.delete(kind.plural, name, namespace=namespace,
+                                group=kind.group or None)
+
+    def patch(self, plural: str, name: str, patch,
+              namespace: str | None = None, patch_type: str = "merge") -> dict:
+        kind = self._kind(plural)
+        self._ensure("patch", kind, namespace)
+        return self.kube.patch(kind.plural, name, patch, namespace=namespace,
+                               group=kind.group or None,
+                               patch_type=patch_type)
+
+    # --------------------------------------------------------- shortcuts
+
+    def events_for(self, namespace: str, kind: str, name: str) -> list:
+        """Events for one object, newest last (reference api/events.py)."""
+        items = self.list(
+            "events", namespace=namespace,
+            field_selector=f"involvedObject.kind={kind},"
+                           f"involvedObject.name={name}",
+        )
+        return sorted(
+            items,
+            key=lambda e: e.get("lastTimestamp")
+            or e.get("eventTime") or "",
+        )
+
+    def pods_using_pvc(self, namespace: str, pvc: str) -> list:
+        """Reference api/pod.py list_pods filtered by PVC volume."""
+        out = []
+        for pod in self.list("pods", namespace=namespace):
+            for vol in (pod.get("spec") or {}).get("volumes") or []:
+                claim = vol.get("persistentVolumeClaim") or {}
+                if claim.get("claimName") == pvc:
+                    out.append(pod)
+                    break
+        return out

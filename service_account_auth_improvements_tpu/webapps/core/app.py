@@ -1,0 +1,243 @@
+"""Minimal WSGI web framework for the CRUD backends.
+
+Plays the role Flask plays for the reference's crud_backend: routing with
+path params, before-request hooks (authn — crud_backend/authn.py:35;
+CSRF — csrf.py:91), JSON requests/responses, error handlers mapping
+exceptions to JSON bodies (errors/handlers.py), probe routes
+(probes.py:8-17), and SPA index serving that refreshes the CSRF cookie
+(serving.py:18-31).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import traceback
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.webapps.core import (
+    authn,
+    csrf,
+    settings,
+)
+
+log = logging.getLogger(__name__)
+
+_STATUS_TEXT = {
+    200: "OK", 400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+    404: "Not Found", 405: "Method Not Allowed", 500: "Internal Server Error",
+}
+
+
+class HttpError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class Request:
+    def __init__(self, environ: dict, params: dict):
+        self.environ = environ
+        self.params = params          # path params, e.g. {"namespace": ...}
+        self.method = environ["REQUEST_METHOD"]
+        self.path = environ.get("PATH_INFO", "")
+        self._body = None
+
+    @property
+    def query(self) -> dict:
+        from urllib.parse import parse_qs
+        return {k: v[0] for k, v in
+                parse_qs(self.environ.get("QUERY_STRING", "")).items()}
+
+    def header(self, name: str) -> str | None:
+        key = "HTTP_" + name.upper().replace("-", "_")
+        return self.environ.get(key)
+
+    @property
+    def cookies(self) -> dict:
+        out = {}
+        for part in (self.environ.get("HTTP_COOKIE") or "").split(";"):
+            name, _, value = part.strip().partition("=")
+            if name:
+                out[name] = value
+        return out
+
+    @property
+    def user(self) -> str | None:
+        return authn.get_username(self.environ)
+
+    def json(self) -> dict:
+        if self._body is None:
+            try:
+                length = int(self.environ.get("CONTENT_LENGTH") or 0)
+            except ValueError:
+                length = 0
+            raw = self.environ["wsgi.input"].read(length) if length else b""
+            try:
+                self._body = json.loads(raw) if raw else {}
+            except ValueError:
+                raise HttpError(400, "request body is not valid JSON")
+        return self._body
+
+
+class Response:
+    def __init__(self, body: bytes, status: int = 200,
+                 content_type: str = "application/json"):
+        self.body = body
+        self.status = status
+        self.headers = [("Content-Type", content_type)]
+
+    @classmethod
+    def json(cls, payload, status: int = 200) -> "Response":
+        return cls(json.dumps(payload).encode(), status)
+
+
+def _compile(pattern: str):
+    """``/api/namespaces/<namespace>/notebooks/<name>`` → regex."""
+    regex = re.sub(r"<([a-zA-Z_]+)>", r"(?P<\1>[^/]+)", pattern)
+    return re.compile("^" + regex + "$")
+
+
+class WebApp:
+    """App factory product (reference: crud_backend/__init__.py:16).
+
+    Routes + hooks + static SPA serving. Instances are WSGI callables.
+    """
+
+    def __init__(self, name: str, static_dir: str | None = None,
+                 prefix: str = "/", mode: str | None = None):
+        self.name = name
+        self.static_dir = static_dir
+        self.prefix = prefix
+        self.mode = mode if mode is not None else os.environ.get(
+            "BACKEND_MODE", "prod"
+        )
+        self._routes: list[tuple[str, re.Pattern, object]] = []
+        self.add_probe_routes()
+
+    # ------------------------------------------------------------- wiring
+
+    def route(self, method: str, pattern: str):
+        def register(fn):
+            self._routes.append((method.upper(), _compile(pattern), fn))
+            return fn
+        return register
+
+    def add_probe_routes(self) -> None:
+        @self.route("GET", "/healthz/liveness")
+        @authn.no_authentication
+        def liveness(req):
+            return "alive"
+
+        @self.route("GET", "/healthz/readiness")
+        @authn.no_authentication
+        def readiness(req):
+            return "ready"
+
+    # ------------------------------------------------------------ serving
+
+    def __call__(self, environ, start_response):
+        req_path = environ.get("PATH_INFO", "")
+        method = environ["REQUEST_METHOD"]
+        try:
+            for m, regex, fn in self._routes:
+                match = regex.match(req_path)
+                if match and m == method:
+                    req = Request(environ, match.groupdict())
+                    self._check_authn(fn, req)
+                    self._check_csrf(req)
+                    out = fn(req)
+                    resp = out if isinstance(out, Response) else \
+                        Response.json({
+                            "success": True, "status": 200,
+                            **(out if isinstance(out, dict) else
+                               {"result": out}),
+                        })
+                    return self._finish(resp, start_response)
+            if method == "GET" and self.static_dir:
+                return self._finish(
+                    self._serve_static(req_path), start_response
+                )
+            raise HttpError(404, f"no route {method} {req_path}")
+        except HttpError as e:
+            return self._finish(self._error_response(e.code, e.message),
+                                start_response)
+        except errors.ApiError as e:
+            # K8s errors pass through with their code (reference
+            # errors/handlers.py maps ApiException the same way).
+            return self._finish(
+                self._error_response(e.code, str(e)), start_response
+            )
+        except Exception:
+            log.error("unhandled error serving %s %s\n%s", method, req_path,
+                      traceback.format_exc())
+            return self._finish(
+                self._error_response(500, "internal server error"),
+                start_response,
+            )
+
+    # -------------------------------------------------------------- hooks
+
+    def _check_authn(self, fn, req: Request) -> None:
+        """Every route is authenticated unless opted out
+        (reference authn.py:35-66)."""
+        if settings.dev_mode(self.mode) or settings.disable_auth():
+            return
+        if getattr(fn, "no_authentication", False):
+            return
+        if req.user is None:
+            raise HttpError(401, "No user detected.")
+
+    def _check_csrf(self, req: Request) -> None:
+        if settings.dev_mode(self.mode):
+            return
+        csrf.check(req)
+
+    # ------------------------------------------------------------- output
+
+    def _error_response(self, code: int, message: str) -> Response:
+        return Response.json(
+            {"success": False, "status": code, "log": message,
+             "user_error": message},
+            status=code,
+        )
+
+    def _serve_static(self, path: str) -> Response:
+        """Hashed assets get long cache; everything else serves index.html
+        with a fresh CSRF cookie and no-cache (reference serving.py)."""
+        rel = path.lstrip("/") or "index.html"
+        root = os.path.abspath(self.static_dir)
+        full = os.path.abspath(os.path.join(root, rel))
+        if not (full == root or full.startswith(root + os.sep)):
+            full = ""  # traversal attempt: fall through to index
+        if full and os.path.isfile(full) and rel != "index.html":
+            ctype = _content_type(full)
+            with open(full, "rb") as f:
+                resp = Response(f.read(), content_type=ctype)
+            resp.headers.append(("Cache-Control", "max-age=31536000"))
+            return resp
+        index = os.path.join(self.static_dir, "index.html")
+        if not os.path.isfile(index):
+            raise HttpError(404, "not found")
+        with open(index, "rb") as f:
+            resp = Response(f.read(), content_type="text/html")
+        resp.headers.append(
+            ("Cache-Control", "no-cache, no-store, must-revalidate, max-age=0")
+        )
+        csrf.set_cookie(resp, self.prefix)
+        return resp
+
+    @staticmethod
+    def _finish(resp: Response, start_response):
+        resp.headers.append(("Content-Length", str(len(resp.body))))
+        status = f"{resp.status} {_STATUS_TEXT.get(resp.status, 'Status')}"
+        start_response(status, resp.headers)
+        return [resp.body]
+
+
+def _content_type(path: str) -> str:
+    import mimetypes
+    return mimetypes.guess_type(path)[0] or "application/octet-stream"

@@ -1,0 +1,24 @@
+"""Authentication from the trusted userid header.
+
+The Istio ingress/auth layer injects the user's identity as an HTTP
+header; the backends trust it (reference: crud_backend/authn.py:12-23
+get_username, settings.py env knobs). ``no_authentication`` marks a route
+as public (authn.py:26-32).
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.webapps.core import settings
+
+
+def get_username(environ: dict) -> str | None:
+    key = "HTTP_" + settings.userid_header().upper().replace("-", "_")
+    if key not in environ:
+        return None
+    user = environ[key]
+    return user.replace(settings.userid_prefix(), "")
+
+
+def no_authentication(fn):
+    fn.no_authentication = True
+    return fn

@@ -1,0 +1,74 @@
+"""Authorization via Kubernetes SubjectAccessReview.
+
+Every API handler asks the K8s RBAC layer whether the authenticated user
+may perform the verb on the resource (reference: crud_backend/
+authz.py:25-113 — create_subject_access_review / is_authorized /
+ensure_authorized). RBAC stays the single source of truth; the web tier
+holds no policy of its own.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from service_account_auth_improvements_tpu.webapps.core import settings
+from service_account_auth_improvements_tpu.webapps.core.app import HttpError
+
+log = logging.getLogger(__name__)
+
+AUTHZ_GROUP = "authorization.k8s.io"
+
+
+def is_authorized(kube, user: str | None, verb: str, group: str,
+                  version: str, resource: str, namespace: str | None = None,
+                  subresource: str | None = None,
+                  mode: str | None = None) -> bool:
+    if settings.dev_mode(mode) or settings.disable_auth():
+        return True
+    if user is None:
+        raise HttpError(401, "No user credentials were found!")
+    sar = {
+        "apiVersion": f"{AUTHZ_GROUP}/v1",
+        "kind": "SubjectAccessReview",
+        "spec": {
+            "user": user,
+            "resourceAttributes": {
+                "group": group,
+                "namespace": namespace,
+                "verb": verb,
+                "resource": resource,
+                "version": version,
+                "subresource": subresource,
+            },
+        },
+    }
+    out = kube.create("subjectaccessreviews", sar, group=AUTHZ_GROUP)
+    status = out.get("status")
+    if status is None:
+        log.error("SubjectAccessReview doesn't have status.")
+        return False
+    return bool(status.get("allowed"))
+
+
+def unauthorized_message(user, verb, group, version, resource,
+                         subresource=None, namespace=None) -> str:
+    msg = f"User '{user}' is not authorized to {verb}"
+    msg += f" {version}/{resource}" if not group else \
+        f" {group}/{version}/{resource}"
+    if subresource:
+        msg += f"/{subresource}"
+    if namespace:
+        msg += f" in namespace '{namespace}'"
+    return msg
+
+
+def ensure_authorized(kube, user, verb, group, version, resource,
+                      namespace=None, subresource=None,
+                      mode: str | None = None) -> None:
+    if not is_authorized(kube, user, verb, group, version, resource,
+                         namespace=namespace, subresource=subresource,
+                         mode=mode):
+        raise HttpError(403, unauthorized_message(
+            user, verb, group, version, resource,
+            subresource=subresource, namespace=namespace,
+        ))

@@ -1,0 +1,59 @@
+"""CSRF protection: double-submit cookie + custom header.
+
+The index response sets a random ``XSRF-TOKEN`` cookie; the SPA echoes it
+in an ``X-XSRF-TOKEN`` header on every unsafe request and the backend
+requires the pair to match (reference: crud_backend/csrf.py:48-118).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+
+from service_account_auth_improvements_tpu.webapps.core import settings
+
+CSRF_COOKIE = "XSRF-TOKEN"
+CSRF_HEADER = "X-" + CSRF_COOKIE
+SAFE_METHODS = ("GET", "HEAD", "OPTIONS", "TRACE")
+SAMESITE_VALUES = ("Strict", "Lax", "None")
+
+
+def set_cookie(resp, prefix: str = "/") -> None:
+    token = secrets.token_urlsafe(32)
+    samesite = os.environ.get("CSRF_SAMESITE", "Strict")
+    if samesite not in SAMESITE_VALUES:
+        samesite = "Strict"
+    attrs = [
+        f"{CSRF_COOKIE}={token}",
+        f"Path={prefix}",
+        f"SameSite={samesite}",
+    ]
+    if settings.secure_cookies():
+        attrs.append("Secure")
+    # HttpOnly deliberately absent: the SPA must read the cookie to echo
+    # it back in the header.
+    resp.headers.append(("Set-Cookie", "; ".join(attrs)))
+
+
+def check(req) -> None:
+    from service_account_auth_improvements_tpu.webapps.core.app import (
+        HttpError,
+    )
+
+    if req.method in SAFE_METHODS:
+        return
+    cookie = req.cookies.get(CSRF_COOKIE)
+    if not cookie:
+        raise HttpError(
+            403, f"Could not find CSRF cookie {CSRF_COOKIE} in the request."
+        )
+    header = req.header(CSRF_HEADER)
+    if not header:
+        raise HttpError(
+            403, f"Could not detect CSRF protection header {CSRF_HEADER}."
+        )
+    if header != cookie:
+        raise HttpError(
+            403, "CSRF check failed. Token in cookie doesn't match token "
+            "in header.",
+        )

@@ -1,0 +1,28 @@
+"""Env-var settings (reference: crud_backend/settings.py:1-6 and
+config.py BackendMode — dev mode skips authn/authz so Cypress-style e2e
+can run without Istio, crud_backend/config.py:18-21, authn.py:41-43)."""
+
+from __future__ import annotations
+
+import os
+
+
+def userid_header() -> str:
+    return os.environ.get("USERID_HEADER", "kubeflow-userid")
+
+
+def userid_prefix() -> str:
+    return os.environ.get("USERID_PREFIX", ":")
+
+
+def disable_auth() -> bool:
+    return os.environ.get("APP_DISABLE_AUTH", "false").lower() == "true"
+
+
+def secure_cookies() -> bool:
+    return os.environ.get("APP_SECURE_COOKIES", "true").lower() == "true"
+
+
+def dev_mode(mode: str | None = None) -> bool:
+    mode = mode if mode is not None else os.environ.get("BACKEND_MODE", "prod")
+    return mode in ("dev", "development")

@@ -1,0 +1,21 @@
+"""Shared status vocabulary for the web frontends
+(reference: crud_backend/status.py — the frontend expects exactly these
+phase strings)."""
+
+from __future__ import annotations
+
+
+class STATUS_PHASE:
+    READY = "ready"
+    WAITING = "waiting"
+    WARNING = "warning"
+    ERROR = "error"
+    UNINITIALIZED = "uninitialized"
+    UNAVAILABLE = "unavailable"
+    TERMINATING = "terminating"
+    STOPPED = "stopped"
+
+
+def create_status(phase: str = "", message: str = "",
+                  state: str = "") -> dict:
+    return {"phase": phase, "message": message, "state": state}

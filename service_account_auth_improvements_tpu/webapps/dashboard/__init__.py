@@ -1,0 +1,10 @@
+"""Central dashboard BFF — the reference's centraldashboard Express app
+(components/centraldashboard/app/). Shell API (/api), workgroup API
+(/api/workgroup proxying KFAM), and a metrics service with TPU duty-cycle
+queries the reference's GPU-blind version never had."""
+
+from service_account_auth_improvements_tpu.webapps.dashboard.app import (
+    build_app,
+)
+
+__all__ = ["build_app"]

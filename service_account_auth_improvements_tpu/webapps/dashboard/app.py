@@ -1,0 +1,264 @@
+"""Central dashboard BFF routes.
+
+Shell API (reference centraldashboard app/api.ts:32-100: namespaces,
+activities, metrics, dashboard-links, dashboard-settings) and workgroup
+API (app/api_workgroup.ts:256-390: exists/create/env-info/nuke-self/
+get-all-namespaces/contributors) — the latter orchestrating Profiles via
+KFAM. Identity comes from the trusted userid header (attachUser
+middleware, app/attach_user_middleware.ts).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.webapps.core import (
+    HttpError,
+    WebApp,
+)
+from service_account_auth_improvements_tpu.webapps.core.api import KubeApi
+
+GROUP = "tpukf.dev"
+
+DEFAULT_LINKS = {
+    "menuLinks": [
+        {"type": "item", "link": "/jupyter/", "text": "Notebooks",
+         "icon": "book"},
+        {"type": "item", "link": "/tensorboards/", "text": "TensorBoards",
+         "icon": "assessment"},
+        {"type": "item", "link": "/volumes/", "text": "Volumes",
+         "icon": "device:storage"},
+    ],
+    "externalLinks": [],
+    "quickLinks": [
+        {"text": "Create a new Notebook server",
+         "desc": "Notebook Servers", "link": "/jupyter/new"},
+        {"text": "View all TPU slices", "desc": "Notebook Servers",
+         "link": "/jupyter/"},
+    ],
+    "documentationItems": [],
+}
+
+
+def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
+              mode: str | None = None,
+              registration_flow: bool = True) -> WebApp:
+    """``kfam`` is any object with the KfamApp action surface
+    (create_profile, create_binding, delete_binding, list_bindings) —
+    in-process KfamApp or an HTTP client facade (the reference uses a
+    swagger-generated KFAM client, clients/profile_controller.ts)."""
+    app = WebApp("centraldashboard", static_dir=static_dir, mode=mode)
+
+    cluster_admin = os.environ.get("CLUSTER_ADMIN", "admin@kubeflow.org")
+
+    def is_admin(user: str | None) -> bool:
+        return bool(user) and user == cluster_admin
+
+    def owned_profiles(user: str) -> list[dict]:
+        out = []
+        for profile in kube.list("profiles", group=GROUP).get("items", []):
+            owner = ((profile.get("spec") or {}).get("owner")) or {}
+            if owner.get("name") == user:
+                out.append(profile)
+        return out
+
+    def contributed_namespaces(user: str) -> list[str]:
+        bindings = kfam.list_bindings(None).get("bindings", [])
+        return [b["referredNamespace"] for b in bindings
+                if (b.get("user") or {}).get("name") == user]
+
+    # ----------------------------------------------------------- shell API
+
+    @app.route("GET", "/api/namespaces")
+    def get_namespaces(req):
+        # Names only, via the privileged SA — the namespace-selector UI
+        # needs the full list (reference k8s_service.ts:72 getNamespaces
+        # does the same); object reads below are SAR-gated per user.
+        items = kube.list("namespaces").get("items", [])
+        return {"namespaces": [n["metadata"]["name"] for n in items]}
+
+    @app.route("GET", "/api/activities/<namespace>")
+    def get_activities(req):
+        ns = req.params["namespace"]
+        events = KubeApi(kube, req.user, mode=app.mode).list("events", ns)
+        events.sort(key=lambda e: e.get("lastTimestamp")
+                    or e.get("eventTime") or "", reverse=True)
+        return {"activities": events}
+
+    @app.route("GET", "/api/dashboard-links")
+    def get_links(req):
+        path = os.environ.get("DASHBOARD_LINKS_CONFIGMAP", "")
+        links = DEFAULT_LINKS
+        if path and os.path.exists(path):
+            with open(path) as f:
+                links = json.load(f)
+        return {"links": links}
+
+    @app.route("GET", "/api/dashboard-settings")
+    def get_settings(req):
+        try:
+            cm = kube.get("configmaps", "dashboard-settings",
+                          namespace="kubeflow")
+            data = json.loads((cm.get("data") or {}).get("settings", "{}"))
+        except errors.NotFound:
+            data = {"DASHBOARD_FORCE_IFRAME": True}
+        return {"settings": data}
+
+    @app.route("GET", "/api/metrics/<mtype>")
+    def get_metrics(req):
+        if metrics is None:
+            raise HttpError(405, "No metrics service configured")
+        mtype = req.params["mtype"]
+        interval = req.query.get("interval", "Last15m")
+        try:
+            return {"metrics": metrics.series(mtype, interval)}
+        except KeyError:
+            raise HttpError(400, f"unknown metric type {mtype!r}")
+
+    # ------------------------------------------------------- workgroup API
+
+    @app.route("GET", "/api/workgroup/exists")
+    def workgroup_exists(req):
+        user = req.user or ""
+        has_profile = bool(owned_profiles(user)) or \
+            bool(contributed_namespaces(user))
+        return {
+            "hasAuth": user != "",
+            "user": user,
+            "hasWorkgroup": has_profile,
+            "registrationFlowAllowed": registration_flow,
+        }
+
+    @app.route("POST", "/api/workgroup/create")
+    def workgroup_create(req):
+        user = req.user
+        if not user:
+            raise HttpError(401, "No user detected.")
+        body = req.json()
+        namespace = body.get("namespace") or user.split("@")[0].replace(
+            ".", "-"
+        )
+        kfam.create_profile({
+            "name": namespace,
+            "owner": {"kind": "User", "name": user},
+        })
+        return {"message": f"Profile {namespace} created."}
+
+    @app.route("GET", "/api/workgroup/env-info")
+    def env_info(req):
+        user = req.user or ""
+        namespaces = [
+            {"namespace": p["metadata"]["name"], "role": "owner",
+             "user": user}
+            for p in owned_profiles(user)
+        ] + [
+            {"namespace": ns, "role": "contributor", "user": user}
+            for ns in contributed_namespaces(user)
+        ]
+        if is_admin(user):
+            namespaces = [
+                {"namespace": p["metadata"]["name"],
+                 "role": "owner" if ((p.get("spec") or {}).get("owner") or
+                                     {}).get("name") == user else "admin",
+                 "user": user}
+                for p in kube.list("profiles", group=GROUP).get("items", [])
+            ]
+        return {
+            "user": user,
+            "platform": {
+                "provider": os.environ.get("PLATFORM_PROVIDER", "gke"),
+                "providerName": "gke",
+                "kubeflowVersion": os.environ.get("KF_VERSION", "dev"),
+            },
+            "namespaces": namespaces,
+            "isClusterAdmin": is_admin(user),
+        }
+
+    @app.route("DELETE", "/api/workgroup/nuke-self")
+    def nuke_self(req):
+        user = req.user
+        if not user:
+            raise HttpError(401, "No user detected.")
+        profiles = owned_profiles(user)
+        if not profiles:
+            raise HttpError(404, f"No profile owned by {user}")
+        for profile in profiles:
+            kube.delete("profiles", profile["metadata"]["name"], group=GROUP)
+        return {"message": "Profiles deleted."}
+
+    @app.route("GET", "/api/workgroup/get-all-namespaces")
+    def all_namespaces(req):
+        if not is_admin(req.user):
+            raise HttpError(403, "Only the cluster admin may list all "
+                            "namespaces")
+        bindings = kfam.list_bindings(None).get("bindings", [])
+        by_ns: dict[str, list] = {}
+        for profile in kube.list("profiles", group=GROUP).get("items", []):
+            name = profile["metadata"]["name"]
+            owner = ((profile.get("spec") or {}).get("owner") or {}).get(
+                "name", ""
+            )
+            by_ns[name] = [owner] if owner else []
+        for b in bindings:
+            by_ns.setdefault(b["referredNamespace"], []).append(
+                (b.get("user") or {}).get("name")
+            )
+        return {"namespaces": [
+            {"namespace": ns, "contributors": users}
+            for ns, users in sorted(by_ns.items())
+        ]}
+
+    @app.route("GET", "/api/workgroup/get-contributors/<namespace>")
+    def get_contributors(req):
+        ns = req.params["namespace"]
+        _require_binding_rights(req, ns)
+        bindings = kfam.list_bindings(ns).get("bindings", [])
+        return {"contributors": [
+            (b.get("user") or {}).get("name") for b in bindings
+        ]}
+
+    def _require_binding_rights(req, ns: str) -> None:
+        user = req.user or ""
+        if is_admin(user):
+            return
+        try:
+            profile = kube.get("profiles", ns, group=GROUP)
+        except errors.NotFound:
+            raise HttpError(404, f"no profile {ns!r}")
+        owner = ((profile.get("spec") or {}).get("owner") or {})
+        if owner.get("name") != user:
+            raise HttpError(
+                403, f"user {user!r} is not the owner of {ns!r}"
+            )
+
+    @app.route("POST", "/api/workgroup/add-contributor/<namespace>")
+    def add_contributor(req):
+        ns = req.params["namespace"]
+        _require_binding_rights(req, ns)
+        contributor = req.json().get("contributor")
+        if not contributor:
+            raise HttpError(400, "Request body must include 'contributor'")
+        kfam.create_binding({
+            "user": {"kind": "User", "name": contributor},
+            "referredNamespace": ns,
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        })
+        return {"message": f"Contributor {contributor} added to {ns}."}
+
+    @app.route("DELETE", "/api/workgroup/remove-contributor/<namespace>")
+    def remove_contributor(req):
+        ns = req.params["namespace"]
+        _require_binding_rights(req, ns)
+        contributor = req.json().get("contributor")
+        if not contributor:
+            raise HttpError(400, "Request body must include 'contributor'")
+        kfam.delete_binding({
+            "user": {"kind": "User", "name": contributor},
+            "referredNamespace": ns,
+            "roleRef": {"kind": "ClusterRole", "name": "edit"},
+        })
+        return {"message": f"Contributor {contributor} removed from {ns}."}
+
+    return app

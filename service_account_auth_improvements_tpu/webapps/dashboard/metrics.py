@@ -1,0 +1,72 @@
+"""Cluster metrics for the dashboard graphs.
+
+The reference defines a MetricsService interface with Prometheus and
+Stackdriver drivers (centraldashboard app/metrics_service.ts:26-46,
+prometheus_metrics_service.ts:4-60 — node CPU, pod CPU, pod memory over
+rangeQuery). TPU-native addition: chip duty-cycle and HBM utilization
+series from the GKE TPU device-plugin metrics, so idle slices are visible
+from the shell UI.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+import urllib.request
+
+INTERVALS = {
+    "Last5m": 5, "Last15m": 15, "Last30m": 30, "Last60m": 60,
+    "Last180m": 180,
+}
+
+QUERIES = {
+    "node": "sum(rate(node_cpu_seconds_total[5m])) by (instance)",
+    "podcpu": "sum(rate(container_cpu_usage_seconds_total[5m]))",
+    "podmem": "sum(container_memory_usage_bytes)",
+    # TPU device-plugin metrics (per-chip duty cycle percent and HBM use).
+    "tpu": "avg(duty_cycle) by (accelerator_id)",
+    "tpumem": "sum(memory_used) by (accelerator_id)",
+}
+
+
+class PrometheusMetricsService:
+    """range-query driver; ``query_fn`` is injectable for tests and
+    alternative backends (the reference's Stackdriver driver analog)."""
+
+    def __init__(self, base_url: str, query_fn=None):
+        self.base_url = base_url.rstrip("/")
+        self.query_fn = query_fn or self._http_range_query
+
+    def _http_range_query(self, query: str, start: float, end: float,
+                          step: int = 10) -> list:
+        params = urllib.parse.urlencode({
+            "query": query, "start": start, "end": end, "step": step,
+        })
+        url = f"{self.base_url}/api/v1/query_range?{params}"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.loads(resp.read())
+        if payload.get("status") != "success":
+            return []
+        return payload.get("data", {}).get("result", [])
+
+    def series(self, metric: str, interval: str = "Last15m") -> list[dict]:
+        if metric not in QUERIES:
+            raise KeyError(metric)
+        minutes = INTERVALS.get(interval, 15)
+        end = time.time()
+        start = end - minutes * 60
+        out = []
+        for series in self.query_fn(QUERIES[metric], start, end):
+            label = ",".join(
+                f"{k}={v}" for k, v in sorted(
+                    (series.get("metric") or {}).items()
+                )
+            )
+            for ts, value in series.get("values") or []:
+                out.append({
+                    "timestamp": int(float(ts)),
+                    "value": float(value),
+                    "label": label,
+                })
+        return out

@@ -1,0 +1,10 @@
+"""Jupyter web app (spawner UI backend) — the reference's JWA
+(components/crud-web-apps/jupyter/backend/). TPU-native: the accelerator
+picker is generation+topology (compiled to ``spec.tpu`` on the Notebook
+CR) instead of a GPU vendor limits key."""
+
+from service_account_auth_improvements_tpu.webapps.jupyter.app import (
+    build_app,
+)
+
+__all__ = ["build_app"]

@@ -1,0 +1,189 @@
+"""Jupyter web app routes.
+
+The reference's JWA API surface (jupyter backend apps/default/routes/
+post.py:12-75, apps/common/routes/{get,patch,delete}.py): spawner config,
+PVC/PodDefault/Notebook listings, Notebook creation from the form,
+start/stop via the stop annotation, deletion. All authz flows through
+SubjectAccessReview (webapps/core/api.py).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+)
+from service_account_auth_improvements_tpu.webapps.core import (
+    HttpError,
+    WebApp,
+)
+from service_account_auth_improvements_tpu.webapps.core.api import KubeApi
+from service_account_auth_improvements_tpu.webapps.jupyter import (
+    config,
+    form,
+    status,
+)
+
+
+def _now() -> str:
+    return dt.datetime.now(dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def notebook_summary(nb: dict, events: list | None = None) -> dict:
+    """Row shape the frontend table renders (reference apps/common/
+    utils.py notebook_dict_from_k8s_obj), plus the TPU block."""
+    meta = nb["metadata"]
+    containers = (
+        ((nb.get("spec") or {}).get("template") or {}).get("spec") or {}
+    ).get("containers") or []
+    # Tolerate kubectl-created CRs with minimal specs: one malformed
+    # object must not 500 the whole listing.
+    container = containers[0] if containers else {}
+    tpu_spec = (nb.get("spec") or {}).get("tpu") or None
+    return {
+        "name": meta["name"],
+        "namespace": meta.get("namespace"),
+        "serverType": (meta.get("annotations") or {}).get(
+            form.SERVER_TYPE_ANNOTATION
+        ),
+        "age": meta.get("creationTimestamp"),
+        "image": container.get("image"),
+        "shortImage": (container.get("image") or "").split("/")[-1],
+        "cpu": (container.get("resources") or {}).get(
+            "requests", {}
+        ).get("cpu"),
+        "memory": (container.get("resources") or {}).get(
+            "requests", {}
+        ).get("memory"),
+        "tpu": tpu_spec,
+        "labels": meta.get("labels"),
+        "annotations": meta.get("annotations"),
+        "status": status.process_status(nb, events),
+    }
+
+
+def build_app(kube, static_dir: str | None = None,
+              mode: str | None = None) -> WebApp:
+    app = WebApp("jupyter-web-app", static_dir=static_dir, mode=mode)
+
+    def api_for(req) -> KubeApi:
+        return KubeApi(kube, req.user, mode=app.mode)
+
+    # ------------------------------------------------------------- reads
+
+    @app.route("GET", "/api/config")
+    def get_config(req):
+        return {"config": config.load_spawner_ui_config()}
+
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs")
+    def get_pvcs(req):
+        ns = req.params["namespace"]
+        pvcs = api_for(req).list("persistentvolumeclaims", ns)
+        return {"pvcs": [{
+            "name": p["metadata"]["name"],
+            "size": (p["spec"].get("resources") or {}).get(
+                "requests", {}
+            ).get("storage"),
+            "mode": (p["spec"].get("accessModes") or [""])[0],
+        } for p in pvcs]}
+
+    @app.route("GET", "/api/namespaces/<namespace>/poddefaults")
+    def get_poddefaults(req):
+        ns = req.params["namespace"]
+        contents = []
+        for pd in api_for(req).list("poddefaults", ns):
+            spec = pd.get("spec") or {}
+            match_labels = (spec.get("selector") or {}).get(
+                "matchLabels"
+            ) or {}
+            pd["label"] = next(iter(match_labels), "")
+            pd["desc"] = spec.get("desc", pd["metadata"]["name"])
+            contents.append(pd)
+        return {"poddefaults": contents}
+
+    @app.route("GET", "/api/namespaces/<namespace>/notebooks")
+    def get_notebooks(req):
+        ns = req.params["namespace"]
+        nbs = api_for(req).list("notebooks", ns)
+        return {"notebooks": [notebook_summary(nb) for nb in nbs]}
+
+    @app.route("GET", "/api/namespaces/<namespace>/notebooks/<name>")
+    def get_notebook(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        api = api_for(req)
+        nb = api.get("notebooks", name, ns)
+        events = api.events_for(ns, "Notebook", name)
+        return {"notebook": nb, "summary": notebook_summary(nb, events),
+                "events": events}
+
+    # ------------------------------------------------------------ writes
+
+    @app.route("POST", "/api/namespaces/<namespace>/notebooks")
+    def post_notebook(req):
+        ns = req.params["namespace"]
+        body = req.json()
+        if "name" not in body:
+            raise HttpError(400, "Request body must include 'name'")
+        api = api_for(req)
+        defaults = config.load_spawner_ui_config()
+        nb = form.notebook_template(
+            body["name"], ns, req.user or "anonymous@kubeflow.org"
+        )
+        form.set_image(nb, body, defaults)
+        form.set_server_type(nb, body, defaults)
+        form.set_cpu(nb, body, defaults)
+        form.set_memory(nb, body, defaults)
+        form.set_tpu(nb, body, defaults)
+        form.set_tolerations(nb, body, defaults)
+        form.set_affinity(nb, body, defaults)
+        form.set_configurations(nb, body, defaults)
+        form.set_shm(nb, body, defaults)
+        form.set_environment(nb, body, defaults)
+
+        volumes = form.volume_requests(body["name"], body, defaults)
+        for vol in volumes:
+            pvc = form.new_pvc_from(vol)
+            if pvc is not None:
+                created = api.create("persistentvolumeclaims", pvc, ns)
+                pvc_name = created["metadata"]["name"]
+            else:
+                pvc_name = vol.get("existingSource") or vol.get("name")
+                if not pvc_name:
+                    raise HttpError(
+                        400, "volume needs newPvc or existingSource/name"
+                    )
+            form.attach_volume(nb, vol, pvc_name)
+
+        api.create("notebooks", nb, ns)
+        return {"message": "Notebook created successfully."}
+
+    @app.route("PATCH", "/api/namespaces/<namespace>/notebooks/<name>")
+    def patch_notebook(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        body = req.json()
+        if "stopped" not in body:
+            raise HttpError(
+                400, "Request body must include at least one supported key: "
+                "['stopped']"
+            )
+        api = api_for(req)
+        if body["stopped"]:
+            nb = api.get("notebooks", name, ns)
+            if STOP_ANNOTATION in (nb["metadata"].get("annotations") or {}):
+                raise HttpError(
+                    409, f"Notebook {ns}/{name} is already stopped."
+                )
+            patch = {"metadata": {"annotations": {STOP_ANNOTATION: _now()}}}
+        else:
+            patch = {"metadata": {"annotations": {STOP_ANNOTATION: None}}}
+        api.patch("notebooks", name, patch, ns)
+        return {"message": "ok"}
+
+    @app.route("DELETE", "/api/namespaces/<namespace>/notebooks/<name>")
+    def delete_notebook(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        api_for(req).delete("notebooks", name, ns)
+        return {"message": f"Notebook {name} successfully deleted."}
+
+    return app

@@ -1,0 +1,106 @@
+"""Spawner UI configuration.
+
+The reference mounts ``spawner_ui_config.yaml`` as a ConfigMap and
+re-reads it on every request so edits hot-reload (reference: jupyter
+backend apps/common/utils.py load_spawner_ui_config; GPU vendor section
+at yaml/spawner_ui_config.yaml:119-141). Here the accelerator section is
+a TPU picker: generation + topology dropdowns that the form compiles to
+``spec.tpu`` — the control plane resolves chips/hosts/selectors from it
+(controlplane/tpu.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+
+import yaml
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+
+CONFIG_ENV = "JWA_UI_CONFIG"
+
+DEFAULT_CONFIG: dict = {
+    "image": {
+        "value": "ghcr.io/tpukf/jupyter-jax-tpu:latest",
+        "options": [
+            "ghcr.io/tpukf/jupyter-jax-tpu:latest",
+            "ghcr.io/tpukf/jupyter-scipy:latest",
+            "ghcr.io/tpukf/codeserver-python:latest",
+        ],
+        "readOnly": False,
+    },
+    "imagePullPolicy": {"value": "IfNotPresent", "readOnly": False},
+    "serverType": {"value": "jupyter", "readOnly": False},
+    "cpu": {"value": "0.5", "limitFactor": "1.2", "readOnly": False},
+    "memory": {"value": "1.0Gi", "limitFactor": "1.2", "readOnly": False},
+    # The TPU picker (replaces the reference's `gpus.vendors` dropdown).
+    "tpu": {
+        "readOnly": False,
+        "value": {"generation": "none", "topology": ""},
+        "generations": [
+            {
+                "key": gen,
+                "uiName": f"TPU {gen}",
+                "topologies": topos,
+            }
+            for gen, topos in (
+                ("v4", ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4"]),
+                ("v5e", ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16",
+                         "16x16"]),
+                ("v5p", ["2x2x1", "2x2x2", "2x2x4", "2x4x4", "4x4x4",
+                         "4x4x8"]),
+                ("v6e", ["1x1", "2x2", "2x4", "4x4", "4x8", "8x8", "8x16",
+                         "16x16"]),
+            )
+        ],
+    },
+    "workspaceVolume": {
+        "value": {
+            "mount": "/home/jovyan",
+            "newPvc": {
+                "metadata": {"name": "{notebook-name}-workspace"},
+                "spec": {
+                    "resources": {"requests": {"storage": "10Gi"}},
+                    "accessModes": ["ReadWriteOnce"],
+                },
+            },
+        },
+        "readOnly": False,
+    },
+    "dataVolumes": {"value": [], "readOnly": False},
+    "tolerationGroup": {"value": "none", "options": [], "readOnly": False},
+    "affinityConfig": {"value": "none", "options": [], "readOnly": False},
+    "configurations": {"value": [], "readOnly": False},
+    "shm": {"value": True, "readOnly": False},
+    "environment": {"value": {}, "readOnly": False},
+}
+
+
+def load_spawner_ui_config() -> dict:
+    """Per-request load so a mounted ConfigMap hot-reloads; the file only
+    needs to override the sections it cares about."""
+    path = os.environ.get(CONFIG_ENV, "")
+    config = copy.deepcopy(DEFAULT_CONFIG)
+    if path and os.path.exists(path):
+        with open(path) as f:
+            loaded = yaml.safe_load(f) or {}
+        config.update(loaded.get("spawnerFormDefaults", loaded))
+    return config
+
+
+def validate_tpu_choice(config: dict, generation: str, topology: str) -> None:
+    """The picker only offers supported combinations; reject anything else
+    before it reaches the CR (the controller re-validates, tpu.py)."""
+    gens = {g["key"]: g for g in config["tpu"].get("generations", [])}
+    if generation not in gens:
+        raise tpu.TpuValidationError(
+            f"unknown TPU generation {generation!r}; "
+            f"choose one of {sorted(gens)}"
+        )
+    topos = gens[generation].get("topologies", [])
+    if topos and topology not in topos:
+        raise tpu.TpuValidationError(
+            f"topology {topology!r} not offered for {generation}; "
+            f"choose one of {topos}"
+        )

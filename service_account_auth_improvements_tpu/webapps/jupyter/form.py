@@ -1,0 +1,301 @@
+"""Form → Notebook CR compilation.
+
+The reference builds the CR from a YAML template plus per-field setters
+that honor the config's readOnly flags (jupyter backend
+apps/common/form.py:74-283). The accelerator setter writes ``spec.tpu``
+(resolved by the controller into chips/selectors/rendezvous env) instead
+of a ``nvidia.com/gpu`` limits key (reference form.py:226-252).
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.webapps.core.app import HttpError
+from service_account_auth_improvements_tpu.webapps.jupyter import config as \
+    jwa_config
+
+GROUP = "tpukf.dev"
+SERVER_TYPE_ANNOTATION = "notebooks.tpukf.dev/server-type"
+CREATOR_ANNOTATION = "notebooks.tpukf.dev/creator"
+VALID_SERVER_TYPES = ("jupyter", "group-one", "group-two")
+
+
+def notebook_template(name: str, namespace: str, creator: str) -> dict:
+    """The reference's notebook_template.yaml as a literal (jupyter backend
+    apps/common/yaml/notebook_template.yaml)."""
+    return {
+        "apiVersion": f"{GROUP}/v1beta1",
+        "kind": "Notebook",
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+            "labels": {"app": name},
+            "annotations": {
+                SERVER_TYPE_ANNOTATION: "",
+                CREATOR_ANNOTATION: creator,
+            },
+        },
+        "spec": {
+            "template": {"spec": {
+                "serviceAccountName": "default-editor",
+                "containers": [{
+                    "name": name,
+                    "image": "",
+                    "volumeMounts": [],
+                    "env": [],
+                    "resources": {
+                        "requests": {"cpu": "0.1", "memory": "0.1Gi"},
+                    },
+                }],
+                "volumes": [],
+                "tolerations": [],
+            }},
+        },
+    }
+
+
+def get_form_value(body: dict, defaults: dict, body_field: str,
+                   defaults_field: str | None = None, optional: bool = False):
+    """readOnly semantics (reference form.py:16-60): a readOnly field must
+    not appear in the request; a writable field falls back to its default
+    only when optional."""
+    defaults_field = defaults_field or body_field
+    user_value = body.get(body_field)
+    if defaults_field not in defaults:
+        return user_value
+    entry = defaults[defaults_field]
+    if entry.get("readOnly"):
+        if body_field in body:
+            raise HttpError(
+                400, f"{body_field!r} is readonly but a value was provided"
+            )
+        return entry.get("value")
+    if user_value is None:
+        if body_field in body:
+            return None  # explicit null
+        if optional:
+            return entry.get("value")
+        raise HttpError(400, f"No value provided for: {body_field}")
+    return user_value
+
+
+def _container(nb: dict) -> dict:
+    return nb["spec"]["template"]["spec"]["containers"][0]
+
+
+def _pod_spec(nb: dict) -> dict:
+    return nb["spec"]["template"]["spec"]
+
+
+def set_image(nb: dict, body: dict, defaults: dict) -> None:
+    field = "customImage" if body.get("customImage") else "image"
+    image = get_form_value(body, defaults, field, "image", optional=True)
+    if not image:
+        raise HttpError(400, "No value provided for: image")
+    _container(nb)["image"] = str(image).strip()
+    policy = get_form_value(body, defaults, "imagePullPolicy", optional=True)
+    if policy:
+        _container(nb)["imagePullPolicy"] = policy
+
+
+def set_server_type(nb: dict, body: dict, defaults: dict) -> None:
+    server_type = get_form_value(body, defaults, "serverType",
+                                 optional=True) or "jupyter"
+    if server_type not in VALID_SERVER_TYPES:
+        raise HttpError(400, f"{server_type!r} is not a valid server type")
+    annotations = nb["metadata"]["annotations"]
+    annotations[SERVER_TYPE_ANNOTATION] = server_type
+    if server_type in ("group-one", "group-two"):
+        annotations["notebooks.tpukf.dev/http-rewrite-uri"] = "/"
+    if server_type == "group-two":
+        ns, name = nb["metadata"]["namespace"], nb["metadata"]["name"]
+        annotations["notebooks.tpukf.dev/http-headers-request-set"] = (
+            '{"X-RStudio-Root-Path":"/notebook/%s/%s/"}' % (ns, name)
+        )
+
+
+_CPU_SUFFIX = {"m": 1e-3, "": 1.0}
+_MEM_SUFFIX = {  # bytes per unit
+    "": 1, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40,
+}
+
+
+def parse_quantity(value: str, field: str) -> tuple[float, str]:
+    """K8s quantity → (normalized number, suffix). cpu normalizes to
+    cores, memory to the raw multiplier of its own suffix."""
+    value = str(value).strip()
+    suffixes = _CPU_SUFFIX if field == "cpu" else _MEM_SUFFIX
+    for suffix in sorted(suffixes, key=len, reverse=True):
+        if suffix and value.endswith(suffix):
+            number = value[: -len(suffix)]
+            break
+    else:
+        suffix, number = "", value
+    try:
+        num = float(number)
+    except ValueError:
+        raise HttpError(400, f"Invalid value for {field}: {value!r}")
+    return num * suffixes[suffix], suffix
+
+
+def _set_resource(nb: dict, body: dict, defaults: dict, field: str) -> None:
+    """cpu/memory request + limitFactor-derived limit (reference
+    form.py:118-176). Accepts any K8s quantity suffix ("500m", "512Mi")
+    — the reference only handled bare cores / Gi."""
+    value = get_form_value(body, defaults, field, optional=True)
+    if value is None:
+        return
+    value = str(value)
+    request_norm, suffix = parse_quantity(value, field)
+    limit = body.get(field + "Limit")
+    factor = defaults.get(field, {}).get("limitFactor", "none")
+    if limit is None and factor != "none":
+        # Keep the limit in the same unit the user chose.
+        raw = float(value.removesuffix(suffix)) * float(factor)
+        limit = f"{round(raw, 1):g}{suffix}"
+    container = _container(nb)
+    key = "cpu" if field == "cpu" else "memory"
+    container["resources"].setdefault("requests", {})[key] = value
+    if limit:
+        limit = str(limit)
+        limit_norm, _ = parse_quantity(limit, field)
+        if limit_norm < request_norm:
+            raise HttpError(
+                400, f"{field} limit must be greater than the request"
+            )
+        container["resources"].setdefault("limits", {})[key] = limit
+
+
+def set_cpu(nb, body, defaults):
+    _set_resource(nb, body, defaults, "cpu")
+
+
+def set_memory(nb, body, defaults):
+    _set_resource(nb, body, defaults, "memory")
+
+
+def set_tpu(nb: dict, body: dict, defaults: dict) -> None:
+    """The accelerator setter. Form value {generation, topology} (or
+    {generation, chips}); "none" means CPU-only. Validated against the
+    picker config, then stored as spec.tpu for the controller to resolve
+    (controlplane/tpu.py resolve)."""
+    choice = get_form_value(body, defaults, "tpu", optional=True)
+    if not choice:
+        return
+    generation = str(choice.get("generation", "none")).lower()
+    if generation in ("", "none"):
+        return
+    topology = str(choice.get("topology", "")).lower()
+    chips = choice.get("chips")
+    spec: dict = {"generation": generation}
+    if topology:
+        spec["topology"] = topology
+    if chips is not None:
+        spec["chips"] = int(chips)
+    # Fail fast with the picker's offerings and the same validator the
+    # controller uses.
+    try:
+        if topology:
+            jwa_config.validate_tpu_choice(defaults, generation, topology)
+        tpu.resolve(spec)
+    except tpu.TpuValidationError as e:
+        raise HttpError(400, str(e))
+    nb["spec"]["tpu"] = spec
+
+
+def set_tolerations(nb: dict, body: dict, defaults: dict) -> None:
+    key = get_form_value(body, defaults, "tolerationGroup", optional=True)
+    if not key or key == "none":
+        return
+    for group in defaults.get("tolerationGroup", {}).get("options", []):
+        if group.get("groupKey") == key:
+            _pod_spec(nb)["tolerations"].extend(group.get("tolerations", []))
+            return
+
+
+def set_affinity(nb: dict, body: dict, defaults: dict) -> None:
+    key = get_form_value(body, defaults, "affinityConfig", optional=True)
+    if not key or key == "none":
+        return
+    for cfg in defaults.get("affinityConfig", {}).get("options", []):
+        if cfg.get("configKey") == key:
+            _pod_spec(nb)["affinity"] = cfg.get("affinity", {})
+            return
+
+
+def set_configurations(nb: dict, body: dict, defaults: dict) -> None:
+    """PodDefault labels: the admission webhook matches them
+    (reference form.py:255-263)."""
+    labels = get_form_value(body, defaults, "configurations", optional=True)
+    if labels is None:
+        return
+    if not isinstance(labels, list):
+        raise HttpError(400, "configurations must be a list of labels")
+    for label in labels:
+        nb["metadata"]["labels"][label] = "true"
+
+
+def set_shm(nb: dict, body: dict, defaults: dict) -> None:
+    if not get_form_value(body, defaults, "shm", optional=True):
+        return
+    _pod_spec(nb)["volumes"].append(
+        {"name": "dshm", "emptyDir": {"medium": "Memory"}}
+    )
+    _container(nb)["volumeMounts"].append(
+        {"mountPath": "/dev/shm", "name": "dshm"}
+    )
+
+
+def set_environment(nb: dict, body: dict, defaults: dict) -> None:
+    env = get_form_value(body, defaults, "environment", optional=True) or {}
+    if isinstance(env, str):
+        import json
+        env = json.loads(env) if env else {}
+    _container(nb)["env"].extend(
+        {"name": k, "value": str(v)} for k, v in env.items()
+    )
+
+
+# ------------------------------------------------------------- volumes
+
+def volume_requests(nb_name: str, body: dict, defaults: dict) -> list[dict]:
+    """Workspace + data volumes from the form (reference post.py:41-49).
+    Each request: {mount, newPvc} or {mount, existingSource|name}."""
+    vols = list(get_form_value(body, defaults, "datavols", "dataVolumes",
+                               optional=True) or [])
+    workspace = get_form_value(body, defaults, "workspace",
+                               "workspaceVolume", optional=True)
+    if workspace:
+        vols.append(workspace)
+    # Template the {notebook-name} placeholder the config uses.
+    import copy as _copy
+    import json as _json
+    out = []
+    for vol in vols:
+        out.append(_copy.deepcopy(_json.loads(
+            _json.dumps(vol).replace("{notebook-name}", nb_name)
+        )))
+    return out
+
+
+def new_pvc_from(volume: dict) -> dict | None:
+    pvc = volume.get("newPvc")
+    if not pvc:
+        return None
+    pvc = dict(pvc)
+    pvc.setdefault("apiVersion", "v1")
+    pvc.setdefault("kind", "PersistentVolumeClaim")
+    return pvc
+
+
+def attach_volume(nb: dict, volume: dict, pvc_name: str) -> None:
+    vol_name = pvc_name
+    _pod_spec(nb)["volumes"].append({
+        "name": vol_name,
+        "persistentVolumeClaim": {"claimName": pvc_name},
+    })
+    _container(nb)["volumeMounts"].append({
+        "name": vol_name,
+        "mountPath": volume.get("mount", f"/mnt/{vol_name}"),
+    })

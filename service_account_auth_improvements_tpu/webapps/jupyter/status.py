@@ -1,0 +1,111 @@
+"""Notebook status aggregation for the UI.
+
+Priority chain ported from the reference (jupyter backend
+apps/common/status.py:9-57 process_status): empty → stopped →
+terminating → ready → containerState → conditions → warning events →
+generic warning. Multi-host twist: "ready" means every host of the slice
+is ready, not replicas==1 (the reference is single-pod).
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+)
+from service_account_auth_improvements_tpu.webapps.core import (
+    STATUS_PHASE,
+    create_status,
+)
+
+EVENT_TYPE_WARNING = "Warning"
+
+
+def expected_hosts(notebook: dict) -> int:
+    try:
+        resolved = tpu.resolve((notebook.get("spec") or {}).get("tpu"))
+    except tpu.TpuValidationError:
+        return 1
+    return resolved.num_hosts if resolved else 1
+
+
+def process_status(notebook: dict, events: list | None = None) -> dict:
+    meta = notebook.get("metadata") or {}
+    nb_status = notebook.get("status") or {}
+    ready = nb_status.get("readyReplicas", 0)
+    annotations = meta.get("annotations") or {}
+
+    # Fresh CR with no status yet: generic waiting for the first moments.
+    if not nb_status.get("containerState") and not nb_status.get("conditions"):
+        created = meta.get("creationTimestamp")
+        if created:
+            age = (
+                dt.datetime.now(dt.timezone.utc).replace(tzinfo=None)
+                - dt.datetime.strptime(created, "%Y-%m-%dT%H:%M:%SZ")
+            ).total_seconds()
+            if age <= 10:
+                return create_status(
+                    STATUS_PHASE.WAITING,
+                    "Waiting for StatefulSet to create the underlying Pod.",
+                )
+
+    if STOP_ANNOTATION in annotations:
+        if ready == 0:
+            return create_status(
+                STATUS_PHASE.STOPPED,
+                "No Pods are currently running for this Notebook Server.",
+            )
+        return create_status(
+            STATUS_PHASE.WAITING, "Notebook Server is stopping."
+        )
+
+    if "deletionTimestamp" in meta:
+        return create_status(
+            STATUS_PHASE.TERMINATING, "Deleting this Notebook Server."
+        )
+
+    hosts = expected_hosts(notebook)
+    if ready >= hosts:
+        msg = "Running" if hosts == 1 else \
+            f"Running on all {hosts} hosts of the slice"
+        return create_status(STATUS_PHASE.READY, msg)
+    if ready > 0:
+        return create_status(
+            STATUS_PHASE.WAITING,
+            f"{ready}/{hosts} slice hosts are ready.",
+        )
+
+    state = nb_status.get("containerState") or {}
+    if "waiting" in state:
+        waiting = state["waiting"]
+        reason = waiting.get("reason", "Undefined")
+        if reason == "PodInitializing":
+            return create_status(STATUS_PHASE.WAITING, reason)
+        return create_status(
+            STATUS_PHASE.WARNING,
+            f"{reason}: "
+            f"{waiting.get('message', 'No available message.')}",
+        )
+
+    for condition in nb_status.get("conditions") or []:
+        if "reason" in condition:
+            return create_status(
+                STATUS_PHASE.WARNING,
+                f"{condition['reason']}: {condition.get('message', '')}",
+            )
+
+    for event in sorted(
+        events or [],
+        key=lambda e: e.get("lastTimestamp") or "", reverse=True,
+    ):
+        if event.get("type") == EVENT_TYPE_WARNING:
+            return create_status(
+                STATUS_PHASE.WARNING, event.get("message", "")
+            )
+
+    return create_status(
+        STATUS_PHASE.WARNING,
+        "Couldn't find any information for the status of this notebook.",
+    )

@@ -1,0 +1,52 @@
+"""WSGI serving for the web apps (the reference runs gunicorn via
+entrypoint.py; stdlib build uses a threading WSGI server — threading is
+required because SPA clients hold keep-alive connections)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socketserver
+import wsgiref.simple_server
+
+from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
+
+
+class ThreadingWSGIServer(socketserver.ThreadingMixIn,
+                          wsgiref.simple_server.WSGIServer):
+    daemon_threads = True
+
+
+class _Handler(wsgiref.simple_server.WSGIRequestHandler):
+    def log_message(self, format, *args):  # route to logging, not stderr
+        logging.getLogger("http").info(format, *args)
+
+
+def serve(app, port: int, host: str = "0.0.0.0") -> None:
+    httpd = wsgiref.simple_server.make_server(
+        host, port, app, server_class=ThreadingWSGIServer,
+        handler_class=_Handler,
+    )
+    logging.info("serving %s on %s:%s", getattr(app, "name", "app"), host,
+                 port)
+    httpd.serve_forever()
+
+
+def run_webapp(build, default_port: int = 5000, argv=None) -> int:
+    """Shared main: ``build(kube, static_dir, mode) -> WSGI app``."""
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=default_port)
+    parser.add_argument("--kube-url", default=None,
+                        help="API server base URL (default: in-cluster)")
+    parser.add_argument("--static-dir", default=None)
+    parser.add_argument("--mode", default=None,
+                        help="prod (default) or dev (skips authn/authz)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s | %(name)s | %(levelname)s | %(message)s",
+    )
+    kube = KubeClient(base_url=args.kube_url)
+    app = build(kube, static_dir=args.static_dir, mode=args.mode)
+    serve(app, args.port)
+    return 0

@@ -1,0 +1,8 @@
+"""Tensorboards web app — the reference's TWA
+(components/crud-web-apps/tensorboards/backend/)."""
+
+from service_account_auth_improvements_tpu.webapps.tensorboards.app import (
+    build_app,
+)
+
+__all__ = ["build_app"]

@@ -1,0 +1,92 @@
+"""Tensorboards web app routes: Tensorboard CR CRUD.
+
+The reference's TWA surface (tensorboards backend app/routes/get.py:9-33,
+post.py:14-38, delete.py:8-12) plus PVC/PodDefault helper listings for
+the creation form.
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.webapps.core import (
+    STATUS_PHASE,
+    HttpError,
+    WebApp,
+    create_status,
+)
+from service_account_auth_improvements_tpu.webapps.core.api import KubeApi
+
+
+def tensorboard_status(tb: dict) -> dict:
+    if "deletionTimestamp" in tb["metadata"]:
+        return create_status(STATUS_PHASE.TERMINATING,
+                             "Deleting Tensorboard...")
+    st = tb.get("status") or {}
+    if st.get("readyReplicas", 0) >= 1:
+        return create_status(STATUS_PHASE.READY, "Running")
+    conds = st.get("conditions") or []
+    if conds:
+        return create_status(
+            STATUS_PHASE.WAITING, conds[-1].get("deploymentState", "")
+        )
+    return create_status(STATUS_PHASE.WAITING,
+                         "Waiting for the Deployment to become ready.")
+
+
+def parse_tensorboard(tb: dict) -> dict:
+    return {
+        "name": tb["metadata"]["name"],
+        "namespace": tb["metadata"].get("namespace"),
+        "logspath": (tb.get("spec") or {}).get("logspath"),
+        "age": tb["metadata"].get("creationTimestamp"),
+        "status": tensorboard_status(tb),
+    }
+
+
+def build_app(kube, static_dir: str | None = None,
+              mode: str | None = None) -> WebApp:
+    app = WebApp("tensorboards-web-app", static_dir=static_dir, mode=mode)
+
+    def api_for(req) -> KubeApi:
+        return KubeApi(kube, req.user, mode=app.mode)
+
+    @app.route("GET", "/api/namespaces/<namespace>/tensorboards")
+    def get_tensorboards(req):
+        ns = req.params["namespace"]
+        return {"tensorboards": [
+            parse_tensorboard(tb)
+            for tb in api_for(req).list("tensorboards", ns)
+        ]}
+
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs")
+    def get_pvcs(req):
+        ns = req.params["namespace"]
+        return {"pvcs": [
+            p["metadata"]["name"]
+            for p in api_for(req).list("persistentvolumeclaims", ns)
+        ]}
+
+    @app.route("POST", "/api/namespaces/<namespace>/tensorboards")
+    def post_tensorboard(req):
+        ns = req.params["namespace"]
+        body = req.json()
+        for field in ("name", "logspath"):
+            if field not in body:
+                raise HttpError(400, f"Request body must include {field!r}")
+        tb = {
+            "apiVersion": "tpukf.dev/v1alpha1",
+            "kind": "Tensorboard",
+            "metadata": {"name": body["name"], "namespace": ns},
+            "spec": {"logspath": body["logspath"]},
+        }
+        if "profile" in body:
+            tb["spec"]["profile"] = bool(body["profile"])
+        api_for(req).create("tensorboards", tb, ns)
+        return {"message": "Tensorboard created successfully."}
+
+    @app.route("DELETE", "/api/namespaces/<namespace>/tensorboards/<name>")
+    def delete_tensorboard(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        api_for(req).delete("tensorboards", name, ns)
+        return {"message": "Tensorboard deleted successfully."}
+
+    return app

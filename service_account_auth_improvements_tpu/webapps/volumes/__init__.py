@@ -1,0 +1,8 @@
+"""Volumes web app — the reference's VWA
+(components/crud-web-apps/volumes/backend/)."""
+
+from service_account_auth_improvements_tpu.webapps.volumes.app import (
+    build_app,
+)
+
+__all__ = ["build_app"]

@@ -1,0 +1,254 @@
+"""Volumes web app routes: PVC CRUD + PVCViewer launcher.
+
+The reference's VWA surface (volumes backend apps/default/routes/
+get.py:9-46, post.py:11-49, delete.py:12-67): PVC listing enriched with
+viewer state and mounting notebooks, PVC creation from the form, deletion
+guarded against non-viewer consumers, and PVCViewer CRs created from a
+templated spec with env substitution (apps/common/viewer.py:16-49).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import yaml
+
+from service_account_auth_improvements_tpu.webapps.core import (
+    STATUS_PHASE,
+    HttpError,
+    WebApp,
+    create_status,
+)
+from service_account_auth_improvements_tpu.webapps.core.api import KubeApi
+
+VIEWER_SPEC_ENV = "VWA_VIEWER_SPEC"
+POD_PARENT_VIEWER_LABEL = "app.kubernetes.io/name"
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+
+DEFAULT_VIEWER_SPEC = {
+    "pvc": "$PVC_NAME",
+    "networking": {
+        "targetPort": 8080,
+        "basePrefix": "/pvcviewer",
+        "rewrite": "/",
+        "timeout": "30s",
+    },
+    "rwoScheduling": True,
+}
+
+
+def substitute_env(data, variables: dict):
+    """$VAR substitution through a nested structure (reference
+    viewer.py:53-70)."""
+    if isinstance(data, dict):
+        return {k: substitute_env(v, variables) for k, v in data.items()}
+    if isinstance(data, list):
+        return [substitute_env(v, variables) for v in data]
+    if isinstance(data, str):
+        return re.sub(
+            r"\$\{?([A-Za-z_][A-Za-z0-9_]*)\}?",
+            lambda m: str(variables.get(m.group(1), m.group(0))),
+            data,
+        )
+    return data
+
+
+def viewer_from_template(name: str, namespace: str) -> dict:
+    path = os.environ.get(VIEWER_SPEC_ENV, "")
+    if path and os.path.exists(path):
+        with open(path) as f:
+            spec = yaml.safe_load(f) or {}
+    else:
+        spec = DEFAULT_VIEWER_SPEC
+    variables = dict(os.environ)
+    variables.update({"PVC_NAME": name, "NAMESPACE": namespace,
+                      "NAME": name})
+    return {
+        "apiVersion": "tpukf.dev/v1alpha1",
+        "kind": "PVCViewer",
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": substitute_env(spec, variables),
+    }
+
+
+def pvc_status(pvc: dict, events: list) -> dict:
+    """Reference volumes apps/common/status.py pvc_status."""
+    if "deletionTimestamp" in pvc["metadata"]:
+        return create_status(STATUS_PHASE.TERMINATING, "Deleting Volume...")
+    if (pvc.get("status") or {}).get("phase") == "Bound":
+        return create_status(STATUS_PHASE.READY, "Bound")
+    if not events:
+        return create_status(STATUS_PHASE.WAITING, "Provisioning Volume...")
+    ev = events[-1]
+    reason = ev.get("reason", "")
+    msg = f"Pending: {ev.get('message', '')}"
+    if reason == "WaitForFirstConsumer":
+        return create_status(
+            STATUS_PHASE.UNAVAILABLE,
+            "Pending: This volume will be bound when its first consumer"
+            " is created. E.g., when you first browse its contents, or"
+            " attach it to a notebook server", reason,
+        )
+    if reason == "Provisioning":
+        return create_status(STATUS_PHASE.WAITING, msg, reason)
+    if reason == "FailedBinding" or ev.get("type") == "Warning":
+        return create_status(STATUS_PHASE.WARNING, msg, reason)
+    return create_status(STATUS_PHASE.READY, msg, reason)
+
+
+def viewer_status(viewer: dict | None) -> str:
+    if not viewer:
+        return STATUS_PHASE.UNINITIALIZED
+    if "deletionTimestamp" in viewer.get("metadata", {}):
+        return STATUS_PHASE.TERMINATING
+    if (viewer.get("status") or {}).get("ready"):
+        return STATUS_PHASE.READY
+    return STATUS_PHASE.WAITING
+
+
+def notebooks_using_pvc(pvc_name: str, notebooks: list) -> list[str]:
+    out = []
+    for nb in notebooks:
+        vols = (
+            ((nb.get("spec") or {}).get("template") or {}).get("spec") or {}
+        ).get("volumes") or []
+        for vol in vols:
+            claim = vol.get("persistentVolumeClaim") or {}
+            if claim.get("claimName") == pvc_name:
+                out.append(nb["metadata"]["name"])
+                break
+    return out
+
+
+def build_app(kube, static_dir: str | None = None,
+              mode: str | None = None) -> WebApp:
+    app = WebApp("volumes-web-app", static_dir=static_dir, mode=mode)
+
+    def api_for(req) -> KubeApi:
+        return KubeApi(kube, req.user, mode=app.mode)
+
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs")
+    def get_pvcs(req):
+        ns = req.params["namespace"]
+        api = api_for(req)
+        notebooks = api.list("notebooks", ns)
+        viewers = {v["metadata"]["name"]: v
+                   for v in api.list("pvcviewers", ns)}
+        # One events list for the namespace, grouped per PVC — a per-row
+        # events_for would cost one SAR + full list per PVC.
+        events_by_pvc: dict[str, list] = {}
+        for ev in sorted(
+            api.list("events", ns),
+            key=lambda e: e.get("lastTimestamp") or e.get("eventTime") or "",
+        ):
+            involved = ev.get("involvedObject") or {}
+            if involved.get("kind") == "PersistentVolumeClaim":
+                events_by_pvc.setdefault(involved.get("name"), []).append(ev)
+        rows = []
+        for pvc in api.list("persistentvolumeclaims", ns):
+            name = pvc["metadata"]["name"]
+            capacity = (pvc.get("status") or {}).get("capacity", {}).get(
+                "storage"
+            ) or (pvc["spec"].get("resources") or {}).get(
+                "requests", {}
+            ).get("storage")
+            events = events_by_pvc.get(name, [])
+            viewer = viewers.get(name)
+            rows.append({
+                "name": name,
+                "namespace": ns,
+                "status": pvc_status(pvc, events),
+                "age": pvc["metadata"].get("creationTimestamp"),
+                "capacity": capacity,
+                "modes": pvc["spec"].get("accessModes"),
+                "class": pvc["spec"].get("storageClassName"),
+                "notebooks": notebooks_using_pvc(name, notebooks),
+                "viewer": {
+                    "status": viewer_status(viewer),
+                    "url": (viewer or {}).get("status", {}).get("url"),
+                },
+            })
+        return {"pvcs": rows}
+
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs/<name>/pods")
+    def get_pvc_pods(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        return {"pods": api_for(req).pods_using_pvc(ns, name)}
+
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs/<name>/events")
+    def get_pvc_events(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        return {"events": api_for(req).events_for(
+            ns, "PersistentVolumeClaim", name
+        )}
+
+    @app.route("POST", "/api/namespaces/<namespace>/pvcs")
+    def post_pvc(req):
+        ns = req.params["namespace"]
+        body = req.json()
+        for field in ("name", "mode", "size"):
+            if field not in body:
+                raise HttpError(400, f"Request body must include {field!r}")
+        storage_class = body.get("class")
+        if storage_class == "{none}":
+            storage_class = ""
+        elif storage_class == "{empty}":
+            storage_class = None
+        pvc = {
+            "apiVersion": "v1",
+            "kind": "PersistentVolumeClaim",
+            "metadata": {"name": body["name"], "namespace": ns},
+            "spec": {
+                "accessModes": [body["mode"]],
+                "resources": {"requests": {"storage": body["size"]}},
+            },
+        }
+        if storage_class is not None:
+            pvc["spec"]["storageClassName"] = storage_class
+        api_for(req).create("persistentvolumeclaims", pvc, ns)
+        return {"message": "PVC created successfully."}
+
+    @app.route("DELETE", "/api/namespaces/<namespace>/pvcs/<name>")
+    def delete_pvc(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        api = api_for(req)
+        viewer_pods, other_pods = [], []
+        for pod in api.pods_using_pvc(ns, name):
+            labels = pod["metadata"].get("labels") or {}
+            if labels.get(PART_OF_LABEL) == "pvcviewer":
+                viewer_pods.append(pod)
+            else:
+                other_pods.append(pod)
+        if other_pods:
+            names = [p["metadata"]["name"] for p in other_pods]
+            raise HttpError(
+                409, f"Cannot delete PVC '{name}' because it is being "
+                f"used by pods: {names}"
+            )
+        for pod in viewer_pods:
+            owner = (pod["metadata"].get("labels") or {}).get(
+                POD_PARENT_VIEWER_LABEL
+            )
+            if owner:
+                api.delete("pvcviewers", owner, ns)
+        api.delete("persistentvolumeclaims", name, ns)
+        return {"message": f"PVC {name} successfully deleted."}
+
+    @app.route("POST", "/api/namespaces/<namespace>/viewers")
+    def post_viewer(req):
+        ns = req.params["namespace"]
+        body = req.json()
+        if "name" not in body:
+            raise HttpError(400, "Request body must include 'name'")
+        viewer = viewer_from_template(body["name"], ns)
+        api_for(req).create("pvcviewers", viewer, ns)
+        return {"message": "PVCViewer created successfully."}
+
+    @app.route("DELETE", "/api/namespaces/<namespace>/viewers/<name>")
+    def delete_viewer(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        api_for(req).delete("pvcviewers", name, ns)
+        return {"message": f"Viewer {name} successfully deleted."}
+
+    return app

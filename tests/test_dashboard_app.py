@@ -1,0 +1,166 @@
+"""Central dashboard BFF: shell API, workgroup flows, metrics service
+(reference surface: centraldashboard app/api.ts + api_workgroup.ts)."""
+
+import io
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kfam import KfamApp
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.dashboard import build_app
+from service_account_auth_improvements_tpu.webapps.dashboard.metrics import (
+    PrometheusMetricsService,
+)
+
+ADMIN = "root@example.com"
+
+
+def call(app, method, path, body=None, user="alice@example.com", query=""):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": query,
+        "CONTENT_LENGTH": str(len(raw)), "wsgi.input": io.BytesIO(raw),
+        "HTTP_COOKIE": "XSRF-TOKEN=tok", "HTTP_X_XSRF_TOKEN": "tok",
+    }
+    if user:
+        environ["HTTP_KUBEFLOW_USERID"] = user
+    out = {}
+
+    def sr(status_line, hdrs):
+        out["code"] = int(status_line.split()[0])
+
+    out["body"] = json.loads(b"".join(app(environ, sr)) or b"{}")
+    return out
+
+
+@pytest.fixture()
+def world(monkeypatch):
+    monkeypatch.setenv("CLUSTER_ADMIN", ADMIN)
+    kube = FakeKube()
+    kfam = KfamApp(kube, cluster_admin=ADMIN)
+    app = build_app(kube, kfam, mode="prod")
+    return kube, kfam, app
+
+
+def test_workgroup_lifecycle(world):
+    kube, kfam, app = world
+    # New user has no workgroup.
+    out = call(app, "GET", "/api/workgroup/exists")
+    assert out["body"]["hasWorkgroup"] is False
+    assert out["body"]["hasAuth"] is True
+    # Registration creates a profile owned by the caller.
+    out = call(app, "POST", "/api/workgroup/create", {"namespace": "alice"})
+    assert out["code"] == 200
+    prof = kube.get("profiles", "alice", group="tpukf.dev")
+    assert prof["spec"]["owner"]["name"] == "alice@example.com"
+    out = call(app, "GET", "/api/workgroup/exists")
+    assert out["body"]["hasWorkgroup"] is True
+    # env-info reflects ownership.
+    out = call(app, "GET", "/api/workgroup/env-info")
+    assert out["body"]["namespaces"] == [
+        {"namespace": "alice", "role": "owner", "user": "alice@example.com"}
+    ]
+    assert out["body"]["isClusterAdmin"] is False
+    # nuke-self removes it.
+    out = call(app, "DELETE", "/api/workgroup/nuke-self")
+    assert out["code"] == 200
+    with pytest.raises(errors.NotFound):
+        kube.get("profiles", "alice", group="tpukf.dev")
+
+
+def test_contributor_flow(world):
+    kube, kfam, app = world
+    call(app, "POST", "/api/workgroup/create", {"namespace": "alice"})
+    # Owner adds bob.
+    out = call(app, "POST", "/api/workgroup/add-contributor/alice",
+               {"contributor": "bob@example.com"})
+    assert out["code"] == 200
+    out = call(app, "GET", "/api/workgroup/get-contributors/alice")
+    assert out["body"]["contributors"] == ["bob@example.com"]
+    # Bob sees the namespace as contributor.
+    out = call(app, "GET", "/api/workgroup/env-info",
+               user="bob@example.com")
+    assert out["body"]["namespaces"] == [
+        {"namespace": "alice", "role": "contributor",
+         "user": "bob@example.com"}
+    ]
+    # A stranger cannot add contributors.
+    out = call(app, "POST", "/api/workgroup/add-contributor/alice",
+               {"contributor": "eve@example.com"}, user="mallory@example.com")
+    assert out["code"] == 403
+    # Owner removes bob.
+    out = call(app, "DELETE", "/api/workgroup/remove-contributor/alice",
+               {"contributor": "bob@example.com"})
+    assert out["code"] == 200
+    out = call(app, "GET", "/api/workgroup/get-contributors/alice")
+    assert out["body"]["contributors"] == []
+
+
+def test_admin_surfaces(world):
+    kube, kfam, app = world
+    call(app, "POST", "/api/workgroup/create", {"namespace": "alice"})
+    call(app, "POST", "/api/workgroup/create", {"namespace": "bob"},
+         user="bob@example.com")
+    out = call(app, "GET", "/api/workgroup/get-all-namespaces", user=ADMIN)
+    assert out["code"] == 200
+    names = {n["namespace"] for n in out["body"]["namespaces"]}
+    assert names == {"alice", "bob"}
+    # Non-admin denied.
+    assert call(app, "GET",
+                "/api/workgroup/get-all-namespaces")["code"] == 403
+    # Admin env-info lists every profile.
+    out = call(app, "GET", "/api/workgroup/env-info", user=ADMIN)
+    assert out["body"]["isClusterAdmin"] is True
+    assert len(out["body"]["namespaces"]) == 2
+
+
+def test_shell_api(world):
+    kube, _, app = world
+    kube.create("namespaces", {"metadata": {"name": "kubeflow"}})
+    kube.create("events", {
+        "metadata": {"name": "e1", "namespace": "kubeflow"},
+        "lastTimestamp": "2026-01-01T00:00:00Z", "message": "old",
+    })
+    kube.create("events", {
+        "metadata": {"name": "e2", "namespace": "kubeflow"},
+        "lastTimestamp": "2026-01-02T00:00:00Z", "message": "new",
+    })
+    out = call(app, "GET", "/api/namespaces")
+    assert "kubeflow" in out["body"]["namespaces"]
+    out = call(app, "GET", "/api/activities/kubeflow")
+    assert out["body"]["activities"][0]["message"] == "new"
+    out = call(app, "GET", "/api/dashboard-links")
+    links = out["body"]["links"]["menuLinks"]
+    assert any(l["link"] == "/jupyter/" for l in links)
+    out = call(app, "GET", "/api/dashboard-settings")
+    assert out["code"] == 200
+
+
+def test_metrics_service_tpu_series(world, monkeypatch):
+    kube, kfam, _ = world
+
+    calls = {}
+
+    def fake_query(query, start, end, step=10):
+        calls["query"] = query
+        return [{
+            "metric": {"accelerator_id": "tpu-0"},
+            "values": [[start, "0.93"], [end, "0.95"]],
+        }]
+
+    svc = PrometheusMetricsService("http://prom:9090", query_fn=fake_query)
+    app = build_app(kube, kfam, metrics=svc, mode="prod")
+    out = call(app, "GET", "/api/metrics/tpu", query="interval=Last5m")
+    assert out["code"] == 200
+    points = out["body"]["metrics"]
+    assert len(points) == 2
+    assert points[-1]["value"] == 0.95
+    assert "duty_cycle" in calls["query"]
+    # Unknown type is a 400; no service configured is 405.
+    assert call(app, "GET", "/api/metrics/nope")["code"] == 400
+    app2 = build_app(kube, kfam, mode="prod")
+    assert call(app2, "GET", "/api/metrics/node")["code"] == 405

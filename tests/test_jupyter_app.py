@@ -1,0 +1,252 @@
+"""JWA spawner backend: config, form→CR compilation (TPU picker), volume
+creation, start/stop, status aggregation (reference surface: jupyter
+backend routes + form.py + status.py)."""
+
+import io
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.jupyter import build_app
+from service_account_auth_improvements_tpu.webapps.jupyter.status import (
+    process_status,
+)
+
+HEADERS = {
+    "kubeflow-userid": "alice@example.com",
+    "Cookie": "XSRF-TOKEN=tok",
+    "X-XSRF-TOKEN": "tok",
+}
+
+
+def call(app, method, path, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": "",
+        "CONTENT_LENGTH": str(len(raw)), "wsgi.input": io.BytesIO(raw),
+    }
+    for k, v in HEADERS.items():
+        environ["HTTP_" + k.upper().replace("-", "_")] = v
+    out = {}
+
+    def sr(status_line, hdrs):
+        out["code"] = int(status_line.split()[0])
+
+    out["body"] = json.loads(b"".join(app(environ, sr)) or b"{}")
+    return out
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    return kube, build_app(kube, mode="prod")
+
+
+def test_config_offers_tpu_not_gpu(world):
+    _, app = world
+    out = call(app, "GET", "/api/config")
+    cfg = out["body"]["config"]
+    assert "tpu" in cfg and "gpus" not in cfg
+    gens = {g["key"] for g in cfg["tpu"]["generations"]}
+    assert {"v4", "v5e", "v5p", "v6e"} <= gens
+
+
+def test_create_notebook_full_form(world):
+    kube, app = world
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {
+        "name": "nb1",
+        "image": "ghcr.io/tpukf/jupyter-jax-tpu:latest",
+        "cpu": "1.0", "memory": "2.0Gi",
+        "tpu": {"generation": "v5e", "topology": "2x4"},
+        "configurations": ["access-ml-pipeline"],
+        "shm": True,
+        "environment": {"FOO": "bar"},
+        "workspace": {
+            "mount": "/home/jovyan",
+            "newPvc": {
+                "metadata": {"name": "{notebook-name}-workspace"},
+                "spec": {
+                    "resources": {"requests": {"storage": "5Gi"}},
+                    "accessModes": ["ReadWriteOnce"],
+                },
+            },
+        },
+    })
+    assert out["code"] == 200, out
+    nb = kube.get("notebooks", "nb1", namespace="user1", group="tpukf.dev")
+    assert nb["spec"]["tpu"] == {"generation": "v5e", "topology": "2x4"}
+    pod = nb["spec"]["template"]["spec"]
+    c = pod["containers"][0]
+    # cpu limit = 1.2x request (limitFactor).
+    assert c["resources"]["requests"]["cpu"] == "1.0"
+    assert c["resources"]["limits"]["cpu"] == "1.2"
+    assert c["resources"]["limits"]["memory"] == "2.4Gi"
+    assert nb["metadata"]["labels"]["access-ml-pipeline"] == "true"
+    assert {"name": "FOO", "value": "bar"} in c["env"]
+    # Workspace PVC created and mounted; shm volume present.
+    pvc = kube.get("persistentvolumeclaims", "nb1-workspace",
+                   namespace="user1")
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "5Gi"
+    vols = {v["name"] for v in pod["volumes"]}
+    assert "dshm" in vols and "nb1-workspace" in vols
+    mounts = {m["mountPath"] for m in c["volumeMounts"]}
+    assert "/home/jovyan" in mounts and "/dev/shm" in mounts
+    # No GPU key anywhere.
+    assert "nvidia.com/gpu" not in json.dumps(nb)
+
+
+def test_create_rejects_bad_tpu_choice(world):
+    _, app = world
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {
+        "name": "bad", "image": "img",
+        "tpu": {"generation": "v5e", "topology": "3x7"},
+    })
+    assert out["code"] == 400
+
+
+def test_readonly_field_rejected(world, monkeypatch, tmp_path):
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "spawnerFormDefaults:\n"
+        "  image:\n    value: pinned:1\n    readOnly: true\n"
+    )
+    monkeypatch.setenv("JWA_UI_CONFIG", str(cfg))
+    kube, app = world
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {
+        "name": "nb2", "image": "evil:1",
+    })
+    assert out["code"] == 400
+    # Without the field, the pinned default applies.
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {"name": "nb2"})
+    assert out["code"] == 200
+    nb = kube.get("notebooks", "nb2", namespace="user1", group="tpukf.dev")
+    assert nb["spec"]["template"]["spec"]["containers"][0]["image"] == \
+        "pinned:1"
+
+
+def test_stop_start_and_conflict(world):
+    kube, app = world
+    call(app, "POST", "/api/namespaces/user1/notebooks",
+         {"name": "nb3", "image": "img"})
+    out = call(app, "PATCH", "/api/namespaces/user1/notebooks/nb3",
+               {"stopped": True})
+    assert out["code"] == 200
+    nb = kube.get("notebooks", "nb3", namespace="user1", group="tpukf.dev")
+    assert STOP_ANNOTATION in nb["metadata"]["annotations"]
+    # Double stop conflicts (reference patch.py:49-52).
+    out = call(app, "PATCH", "/api/namespaces/user1/notebooks/nb3",
+               {"stopped": True})
+    assert out["code"] == 409
+    out = call(app, "PATCH", "/api/namespaces/user1/notebooks/nb3",
+               {"stopped": False})
+    assert out["code"] == 200
+    nb = kube.get("notebooks", "nb3", namespace="user1", group="tpukf.dev")
+    assert STOP_ANNOTATION not in (nb["metadata"].get("annotations") or {})
+
+
+def test_list_and_delete(world):
+    kube, app = world
+    call(app, "POST", "/api/namespaces/user1/notebooks",
+         {"name": "nb4", "image": "img",
+          "tpu": {"generation": "v5e", "chips": 8}})
+    out = call(app, "GET", "/api/namespaces/user1/notebooks")
+    rows = out["body"]["notebooks"]
+    assert rows[0]["name"] == "nb4"
+    assert rows[0]["tpu"] == {"generation": "v5e", "chips": 8}
+    out = call(app, "DELETE", "/api/namespaces/user1/notebooks/nb4")
+    assert out["code"] == 200
+    with pytest.raises(errors.NotFound):
+        kube.get("notebooks", "nb4", namespace="user1", group="tpukf.dev")
+
+
+# ------------------------------------------------------------- status
+
+def _nb(status=None, annotations=None, tpu_spec=None, meta=None):
+    nb = {
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "creationTimestamp": "2026-01-01T00:00:00Z",
+                     "annotations": annotations or {}, **(meta or {})},
+        "spec": {"template": {"spec": {"containers": [{"name": "nb"}]}}},
+        "status": status or {},
+    }
+    if tpu_spec:
+        nb["spec"]["tpu"] = tpu_spec
+    return nb
+
+
+def test_status_chain():
+    st = process_status(_nb(status={"readyReplicas": 1,
+                                    "containerState": {"running": {}}}))
+    assert st["phase"] == "ready"
+    st = process_status(_nb(annotations={STOP_ANNOTATION: "t"}))
+    assert st["phase"] == "stopped"
+    st = process_status(_nb(annotations={STOP_ANNOTATION: "t"},
+                            status={"readyReplicas": 1}))
+    assert st["phase"] == "waiting"
+    st = process_status(_nb(meta={"deletionTimestamp": "t"}))
+    assert st["phase"] == "terminating"
+    st = process_status(_nb(status={
+        "containerState": {"waiting": {"reason": "ImagePullBackOff",
+                                       "message": "nope"}},
+    }))
+    assert st["phase"] == "warning" and "ImagePullBackOff" in st["message"]
+
+
+def test_status_multihost_partial_ready():
+    tpu_spec = {"generation": "v5e", "topology": "4x4"}  # 4 hosts
+    st = process_status(_nb(status={"readyReplicas": 2,
+                                    "containerState": {"running": {}}},
+                            tpu_spec=tpu_spec))
+    assert st["phase"] == "waiting" and "2/4" in st["message"]
+    st = process_status(_nb(status={"readyReplicas": 4,
+                                    "containerState": {"running": {}}},
+                            tpu_spec=tpu_spec))
+    assert st["phase"] == "ready"
+
+
+def test_status_from_warning_events():
+    st = process_status(
+        _nb(status={"containerState": {}, "conditions": []}),
+        events=[{"type": "Warning", "message": "Insufficient google.com/tpu",
+                 "lastTimestamp": "2026-01-01T00:01:00Z"}],
+    )
+    assert st["phase"] == "warning"
+    assert "Insufficient google.com/tpu" in st["message"]
+
+
+def test_quantity_suffixes_accepted(world):
+    kube, app = world
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {
+        "name": "nbq", "image": "img", "cpu": "500m", "memory": "512Mi",
+    })
+    assert out["code"] == 200, out
+    nb = kube.get("notebooks", "nbq", namespace="user1", group="tpukf.dev")
+    res = nb["spec"]["template"]["spec"]["containers"][0]["resources"]
+    assert res["requests"] == {"cpu": "500m", "memory": "512Mi"}
+    # limitFactor 1.2 applied in the user's own unit.
+    assert res["limits"]["cpu"] == "600m"
+    assert res["limits"]["memory"] == "614.4Mi"
+    # Garbage still rejected as 400, not 500.
+    out = call(app, "POST", "/api/namespaces/user1/notebooks", {
+        "name": "nbg", "image": "img", "cpu": "lots",
+    })
+    assert out["code"] == 400
+
+
+def test_listing_tolerates_malformed_cr(world):
+    kube, app = world
+    kube.create("notebooks", {
+        "metadata": {"name": "bare", "namespace": "user1"}, "spec": {},
+    }, group="tpukf.dev")
+    call(app, "POST", "/api/namespaces/user1/notebooks",
+         {"name": "good", "image": "img"})
+    out = call(app, "GET", "/api/namespaces/user1/notebooks")
+    assert out["code"] == 200
+    assert {r["name"] for r in out["body"]["notebooks"]} == {"bare", "good"}

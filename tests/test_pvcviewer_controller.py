@@ -195,3 +195,34 @@ def test_invalid_explicit_podspec_sets_condition_not_retry_storm(world):
 
     assert _wait(has_condition)
     assert _deploy(kube, "bad") is None
+
+
+def test_rwo_affinity_ignores_finished_pods(world):
+    kube, _ = world
+    kube.create("persistentvolumeclaims", {
+        "metadata": {"name": "data-pvc", "namespace": "user1"},
+        "spec": {"accessModes": ["ReadWriteOnce"]},
+    })
+    vol = [{"name": "v", "persistentVolumeClaim": {"claimName": "data-pvc"}}]
+    kube.create("pods", {
+        "metadata": {"name": "done-job", "namespace": "user1"},
+        "spec": {"nodeName": "node-old",
+                 "containers": [{"name": "c", "image": "i"}],
+                 "volumes": vol},
+        "status": {"phase": "Succeeded"},
+    })
+    kube.create("pods", {
+        "metadata": {"name": "writer", "namespace": "user1"},
+        "spec": {"nodeName": "node-live",
+                 "containers": [{"name": "c", "image": "i"}],
+                 "volumes": vol},
+        "status": {"phase": "Running"},
+    })
+    kube.create("pvcviewers", _viewer(name="f1", rwoScheduling=True),
+                group=GROUP)
+    assert _wait(lambda: _deploy(kube, "f1") is not None)
+    aff = _deploy(kube, "f1")["spec"]["template"]["spec"]["affinity"]
+    pref = aff["nodeAffinity"][
+        "preferredDuringSchedulingIgnoredDuringExecution"][0]
+    assert pref["preference"]["matchExpressions"][0]["values"] == \
+        ["node-live"]

@@ -146,3 +146,15 @@ def test_status_tracks_deployment_conditions(world):
             conds[-1]["deploymentState"] == "Available"
 
     assert _wait(mirrored)
+
+
+def test_legacy_bare_logspath_mounts_subpath(world):
+    kube, _ = world
+    kube.create("tensorboards", _tb(name="leg", logspath="/logs/run1"),
+                group=GROUP)
+    assert _wait(lambda: _deploy(kube, "leg") is not None)
+    pod = _deploy(kube, "leg")["spec"]["template"]["spec"]
+    mount = pod["containers"][0]["volumeMounts"][0]
+    assert pod["volumes"][0]["persistentVolumeClaim"]["claimName"] == \
+        "tb-volume"
+    assert mount["subPath"] == "logs/run1"

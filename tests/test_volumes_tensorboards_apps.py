@@ -1,0 +1,162 @@
+"""VWA + TWA backends: PVC CRUD with viewer integration, guarded deletes,
+Tensorboard CRUD (reference surface: volumes/tensorboards backend routes)."""
+
+import io
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.tensorboards import (
+    build_app as build_twa,
+)
+from service_account_auth_improvements_tpu.webapps.volumes import (
+    build_app as build_vwa,
+)
+from service_account_auth_improvements_tpu.webapps.volumes.app import (
+    substitute_env,
+)
+
+HEADERS = {
+    "kubeflow-userid": "alice@example.com",
+    "Cookie": "XSRF-TOKEN=tok",
+    "X-XSRF-TOKEN": "tok",
+}
+
+
+def call(app, method, path, body=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method, "PATH_INFO": path, "QUERY_STRING": "",
+        "CONTENT_LENGTH": str(len(raw)), "wsgi.input": io.BytesIO(raw),
+    }
+    for k, v in HEADERS.items():
+        environ["HTTP_" + k.upper().replace("-", "_")] = v
+    out = {}
+
+    def sr(status_line, hdrs):
+        out["code"] = int(status_line.split()[0])
+
+    out["body"] = json.loads(b"".join(app(environ, sr)) or b"{}")
+    return out
+
+
+@pytest.fixture()
+def kube():
+    return FakeKube()
+
+
+def test_substitute_env():
+    out = substitute_env(
+        {"a": "$PVC_NAME", "b": ["x", "${NAMESPACE}"], "c": 3},
+        {"PVC_NAME": "p1", "NAMESPACE": "ns"},
+    )
+    assert out == {"a": "p1", "b": ["x", "ns"], "c": 3}
+    # Unknown variables stay literal.
+    assert substitute_env("$NOPE", {}) == "$NOPE"
+
+
+def test_vwa_pvc_lifecycle(kube):
+    app = build_vwa(kube, mode="prod")
+    out = call(app, "POST", "/api/namespaces/u1/pvcs", {
+        "name": "vol1", "mode": "ReadWriteOnce", "size": "10Gi",
+        "class": "{none}",
+    })
+    assert out["code"] == 200
+    pvc = kube.get("persistentvolumeclaims", "vol1", namespace="u1")
+    assert pvc["spec"]["storageClassName"] == ""
+    out = call(app, "GET", "/api/namespaces/u1/pvcs")
+    rows = out["body"]["pvcs"]
+    assert rows[0]["name"] == "vol1"
+    assert rows[0]["viewer"]["status"] == "uninitialized"
+    # Launch a viewer for it.
+    out = call(app, "POST", "/api/namespaces/u1/viewers", {"name": "vol1"})
+    assert out["code"] == 200
+    viewer = kube.get("pvcviewers", "vol1", namespace="u1", group="tpukf.dev")
+    assert viewer["spec"]["pvc"] == "vol1"
+    assert viewer["spec"]["rwoScheduling"] is True
+    out = call(app, "GET", "/api/namespaces/u1/pvcs")
+    assert out["body"]["pvcs"][0]["viewer"]["status"] == "waiting"
+    # Delete viewer then PVC.
+    assert call(app, "DELETE", "/api/namespaces/u1/viewers/vol1")["code"] == \
+        200
+    assert call(app, "DELETE", "/api/namespaces/u1/pvcs/vol1")["code"] == 200
+    with pytest.raises(errors.NotFound):
+        kube.get("persistentvolumeclaims", "vol1", namespace="u1")
+
+
+def test_vwa_delete_blocked_by_consumer(kube):
+    app = build_vwa(kube, mode="prod")
+    call(app, "POST", "/api/namespaces/u1/pvcs",
+         {"name": "vol2", "mode": "ReadWriteOnce", "size": "1Gi"})
+    kube.create("pods", {
+        "metadata": {"name": "consumer", "namespace": "u1"},
+        "spec": {"containers": [{"name": "c", "image": "i"}],
+                 "volumes": [{"name": "v", "persistentVolumeClaim":
+                              {"claimName": "vol2"}}]},
+    })
+    out = call(app, "DELETE", "/api/namespaces/u1/pvcs/vol2")
+    assert out["code"] == 409
+    assert "consumer" in out["body"]["log"]
+
+
+def test_vwa_delete_cascades_viewer_pod(kube):
+    app = build_vwa(kube, mode="prod")
+    call(app, "POST", "/api/namespaces/u1/pvcs",
+         {"name": "vol3", "mode": "ReadWriteOnce", "size": "1Gi"})
+    call(app, "POST", "/api/namespaces/u1/viewers", {"name": "vol3"})
+    # A viewer pod (labelled as the pvcviewer controller labels them).
+    kube.create("pods", {
+        "metadata": {"name": "viewer-pod", "namespace": "u1",
+                     "labels": {"app.kubernetes.io/part-of": "pvcviewer",
+                                "app.kubernetes.io/name": "vol3"}},
+        "spec": {"containers": [{"name": "c", "image": "i"}],
+                 "volumes": [{"name": "v", "persistentVolumeClaim":
+                              {"claimName": "vol3"}}]},
+    })
+    out = call(app, "DELETE", "/api/namespaces/u1/pvcs/vol3")
+    assert out["code"] == 200
+    with pytest.raises(errors.NotFound):
+        kube.get("pvcviewers", "vol3", namespace="u1", group="tpukf.dev")
+
+
+def test_vwa_notebook_cross_reference(kube):
+    app = build_vwa(kube, mode="prod")
+    call(app, "POST", "/api/namespaces/u1/pvcs",
+         {"name": "vol4", "mode": "ReadWriteOnce", "size": "1Gi"})
+    kube.create("notebooks", {
+        "metadata": {"name": "nb", "namespace": "u1"},
+        "spec": {"template": {"spec": {
+            "containers": [{"name": "nb"}],
+            "volumes": [{"name": "v", "persistentVolumeClaim":
+                         {"claimName": "vol4"}}],
+        }}},
+    }, group="tpukf.dev")
+    out = call(app, "GET", "/api/namespaces/u1/pvcs")
+    assert out["body"]["pvcs"][0]["notebooks"] == ["nb"]
+
+
+def test_twa_lifecycle(kube):
+    app = build_twa(kube, mode="prod")
+    out = call(app, "POST", "/api/namespaces/u1/tensorboards", {
+        "name": "tb1", "logspath": "pvc://logs/run1",
+    })
+    assert out["code"] == 200
+    tb = kube.get("tensorboards", "tb1", namespace="u1", group="tpukf.dev")
+    assert tb["spec"]["logspath"] == "pvc://logs/run1"
+    out = call(app, "GET", "/api/namespaces/u1/tensorboards")
+    rows = out["body"]["tensorboards"]
+    assert rows[0]["name"] == "tb1"
+    assert rows[0]["status"]["phase"] == "waiting"
+    tb["status"] = {"readyReplicas": 1}
+    kube.update_status("tensorboards", tb, group="tpukf.dev")
+    out = call(app, "GET", "/api/namespaces/u1/tensorboards")
+    assert out["body"]["tensorboards"][0]["status"]["phase"] == "ready"
+    assert call(app, "DELETE",
+                "/api/namespaces/u1/tensorboards/tb1")["code"] == 200
+    out = call(app, "POST", "/api/namespaces/u1/tensorboards",
+               {"name": "bad"})
+    assert out["code"] == 400

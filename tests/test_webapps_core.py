@@ -1,0 +1,207 @@
+"""crud_backend core: authn header, SAR authz, CSRF double-submit, routing,
+static SPA serving (reference surface: crud_backend/{authn,authz,csrf}.py)."""
+
+import io
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+from service_account_auth_improvements_tpu.webapps.core import WebApp
+from service_account_auth_improvements_tpu.webapps.core.api import KubeApi
+from service_account_auth_improvements_tpu.webapps.core.app import HttpError
+from service_account_auth_improvements_tpu.webapps.core.authn import (
+    no_authentication,
+)
+
+
+def call(app, method, path, body=None, headers=None):
+    raw = json.dumps(body).encode() if body is not None else b""
+    environ = {
+        "REQUEST_METHOD": method,
+        "PATH_INFO": path,
+        "QUERY_STRING": "",
+        "CONTENT_LENGTH": str(len(raw)),
+        "wsgi.input": io.BytesIO(raw),
+    }
+    for k, v in (headers or {}).items():
+        environ["HTTP_" + k.upper().replace("-", "_")] = v
+    out = {}
+
+    def start_response(status, hdrs):
+        out["code"] = int(status.split()[0])
+        out["headers"] = dict(hdrs)
+
+    raw_out = b"".join(app(environ, start_response))
+    try:
+        out["body"] = json.loads(raw_out)
+    except ValueError:
+        out["body"] = raw_out
+    return out
+
+
+AUTH = {"kubeflow-userid": "alice@example.com"}
+CSRF = {"Cookie": "XSRF-TOKEN=tok", "X-XSRF-TOKEN": "tok"}
+
+
+@pytest.fixture()
+def app():
+    app = WebApp("test", mode="prod")
+
+    @app.route("GET", "/api/namespaces/<namespace>/things")
+    def list_things(req):
+        return {"things": [req.params["namespace"], req.user]}
+
+    @app.route("POST", "/api/namespaces/<namespace>/things")
+    def make_thing(req):
+        return {"made": req.json().get("name")}
+
+    @app.route("GET", "/public")
+    @no_authentication
+    def public(req):
+        return {"open": True}
+
+    return app
+
+
+def test_routes_require_userid_header(app):
+    assert call(app, "GET", "/api/namespaces/ns1/things")["code"] == 401
+    out = call(app, "GET", "/api/namespaces/ns1/things", headers=AUTH)
+    assert out["code"] == 200
+    assert out["body"]["things"] == ["ns1", "alice@example.com"]
+
+
+def test_userid_prefix_stripped(app, monkeypatch):
+    monkeypatch.setenv("USERID_PREFIX", "accounts.google.com:")
+    out = call(app, "GET", "/api/namespaces/ns1/things",
+               headers={"kubeflow-userid": "accounts.google.com:bob@x.com"})
+    assert out["body"]["things"][1] == "bob@x.com"
+
+
+def test_no_authentication_routes_are_public(app):
+    assert call(app, "GET", "/public")["code"] == 200
+
+
+def test_probe_routes_no_auth(app):
+    assert call(app, "GET", "/healthz/liveness")["code"] == 200
+    assert call(app, "GET", "/healthz/readiness")["code"] == 200
+
+
+def test_disable_auth_env(app, monkeypatch):
+    monkeypatch.setenv("APP_DISABLE_AUTH", "true")
+    assert call(app, "GET", "/api/namespaces/ns1/things")["code"] == 200
+
+
+def test_dev_mode_skips_authn_and_csrf():
+    app = WebApp("test", mode="dev")
+
+    @app.route("POST", "/api/x")
+    def x(req):
+        return {}
+
+    assert call(app, "POST", "/api/x", body={})["code"] == 200
+
+
+def test_csrf_required_on_unsafe_methods(app):
+    # Missing cookie+header.
+    out = call(app, "POST", "/api/namespaces/ns1/things",
+               body={"name": "a"}, headers=AUTH)
+    assert out["code"] == 403
+    # Mismatched pair.
+    bad = dict(AUTH, **{"Cookie": "XSRF-TOKEN=a", "X-XSRF-TOKEN": "b"})
+    assert call(app, "POST", "/api/namespaces/ns1/things",
+                body={"name": "a"}, headers=bad)["code"] == 403
+    # Matching pair passes.
+    good = dict(AUTH, **CSRF)
+    out = call(app, "POST", "/api/namespaces/ns1/things",
+               body={"name": "a"}, headers=good)
+    assert out["code"] == 200
+    assert out["body"]["made"] == "a"
+
+
+def test_404_and_error_shape(app):
+    out = call(app, "GET", "/api/nope", headers=AUTH)
+    assert out["code"] == 404
+    assert out["body"]["success"] is False
+
+
+def test_static_index_sets_csrf_cookie(tmp_path):
+    (tmp_path / "index.html").write_text("<html>spa</html>")
+    (tmp_path / "main.abc123.js").write_text("js")
+    app = WebApp("test", static_dir=str(tmp_path), mode="prod")
+    out = call(app, "GET", "/", headers=AUTH)
+    assert out["code"] == 200
+    assert b"spa" in out["body"]
+    assert "XSRF-TOKEN=" in out["headers"].get("Set-Cookie", "")
+    assert "no-cache" in out["headers"]["Cache-Control"]
+    # Hashed asset: long cache, no cookie.
+    out = call(app, "GET", "/main.abc123.js", headers=AUTH)
+    assert "max-age=31536000" in out["headers"]["Cache-Control"]
+    # SPA fallback: unknown path serves index.
+    out = call(app, "GET", "/some/route", headers=AUTH)
+    assert b"spa" in out["body"]
+
+
+def test_static_path_traversal_blocked(tmp_path):
+    (tmp_path / "index.html").write_text("<html>spa</html>")
+    app = WebApp("test", static_dir=str(tmp_path), mode="prod")
+    out = call(app, "GET", "/../../etc/passwd", headers=AUTH)
+    # Must not leak the file: falls back to index.
+    assert b"spa" in out["body"] or out["code"] == 404
+
+
+# ---------------------------------------------------------------- KubeApi
+
+def test_kubeapi_sar_gates_requests():
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "nb", "namespace": "ns1"}, "spec": {},
+    }, group="tpukf.dev")
+
+    denied = []
+
+    def policy(spec):
+        attrs = spec.get("resourceAttributes") or {}
+        ok = spec.get("user") == "alice" and \
+            attrs.get("namespace") == "ns1"
+        if not ok:
+            denied.append((spec.get("user"), attrs.get("namespace")))
+        return ok
+
+    kube.sar_hook = policy
+    api = KubeApi(kube, "alice")
+    assert [n["metadata"]["name"] for n in api.list("notebooks", "ns1")] == \
+        ["nb"]
+    with pytest.raises(HttpError) as e:
+        KubeApi(kube, "mallory").list("notebooks", "ns2")
+    assert e.value.code == 403
+    assert denied == [("mallory", "ns2")]
+
+
+def test_kubeapi_helpers():
+    kube = FakeKube()
+    api = KubeApi(kube, "alice")
+    kube.create("pods", {
+        "metadata": {"name": "p1", "namespace": "ns1"},
+        "spec": {"containers": [{"name": "c", "image": "i"}],
+                 "volumes": [{"name": "v", "persistentVolumeClaim":
+                              {"claimName": "pvc1"}}]},
+    })
+    kube.create("pods", {
+        "metadata": {"name": "p2", "namespace": "ns1"},
+        "spec": {"containers": [{"name": "c", "image": "i"}]},
+    })
+    assert [p["metadata"]["name"]
+            for p in api.pods_using_pvc("ns1", "pvc1")] == ["p1"]
+    kube.create("events", {
+        "metadata": {"name": "e1", "namespace": "ns1"},
+        "involvedObject": {"kind": "Notebook", "name": "nb"},
+        "lastTimestamp": "2026-01-02T00:00:00Z", "message": "late",
+    })
+    kube.create("events", {
+        "metadata": {"name": "e2", "namespace": "ns1"},
+        "involvedObject": {"kind": "Notebook", "name": "nb"},
+        "lastTimestamp": "2026-01-01T00:00:00Z", "message": "early",
+    })
+    evs = api.events_for("ns1", "Notebook", "nb")
+    assert [e["message"] for e in evs] == ["early", "late"]
