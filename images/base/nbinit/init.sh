@@ -1,0 +1,23 @@
+#!/bin/bash
+# nbinit — minimal workload-image launcher.
+#
+# Contract (replaces s6-overlay from the reference base image):
+#   1. run every executable in /opt/nbinit/init.d in lexical order,
+#      aborting the container on the first failure (the reference sets
+#      S6_BEHAVIOUR_IF_STAGE2_FAILS=2 for the same effect);
+#   2. exec /opt/nbinit/run (installed by a child image) as PID 1's
+#      single foreground service, so signals reach it directly.
+set -euo pipefail
+
+for hook in /opt/nbinit/init.d/*; do
+  [ -x "$hook" ] || continue
+  echo "nbinit: running init hook ${hook##*/}" >&2
+  "$hook"
+done
+
+if [ -x /opt/nbinit/run ]; then
+  exec /opt/nbinit/run "$@"
+fi
+
+echo "nbinit: no /opt/nbinit/run installed; dropping to shell" >&2
+exec /bin/bash "$@"

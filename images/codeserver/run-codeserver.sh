@@ -1,0 +1,10 @@
+#!/bin/bash
+# Foreground code-server service (reference: codeserver/s6/services.d/code-server/run).
+# Auth handled at the mesh edge, same as jupyter.
+set -euo pipefail
+
+exec code-server \
+  --bind-addr=0.0.0.0:8888 \
+  --disable-telemetry \
+  --auth=none \
+  "${HOME}"

@@ -1,0 +1,43 @@
+# Shared make targets for the workload image tree.
+#
+# Per-image Makefiles set IMAGE_NAME, BASE_IMAGE, and BASE_IMAGE_FOLDERS
+# (parent directories, whitespace separated) then `include ../common.mk`.
+# The *-dep targets walk the tree so any leaf can be built from scratch.
+# (Same contract as the reference's example-notebook-servers/common.mk, with
+# the cache/tag plumbing simplified.)
+
+REGISTRY ?= ghcr.io/tpukf
+TAG      ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
+ARCH     ?= linux/amd64,linux/arm64
+
+IMAGE_REF := $(REGISTRY)/$(IMAGE_NAME)
+
+.PHONY: docker-build
+docker-build:
+	docker build --build-arg BASE_IMG=$(BASE_IMAGE) \
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .
+
+.PHONY: docker-build-dep
+docker-build-dep: $(addprefix docker-build-dep--, $(BASE_IMAGE_FOLDERS)) docker-build
+docker-build-dep--%:
+	$(MAKE) docker-build-dep -C ../$*
+
+.PHONY: docker-push
+docker-push:
+	docker push "$(IMAGE_REF):$(TAG)"
+
+.PHONY: docker-push-dep
+docker-push-dep: $(addprefix docker-push-dep--, $(BASE_IMAGE_FOLDERS)) docker-push
+docker-push-dep--%:
+	$(MAKE) docker-push-dep -C ../$*
+
+.PHONY: docker-build-multi-arch
+docker-build-multi-arch:
+	docker buildx build --load --platform $(ARCH) \
+		--build-arg BASE_IMG=$(BASE_IMAGE) \
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .
+
+.PHONY: docker-build-multi-arch-dep
+docker-build-multi-arch-dep: $(addprefix docker-build-multi-arch-dep--, $(BASE_IMAGE_FOLDERS)) docker-build-multi-arch
+docker-build-multi-arch-dep--%:
+	$(MAKE) docker-build-multi-arch-dep -C ../$*

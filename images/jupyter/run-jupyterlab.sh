@@ -1,0 +1,18 @@
+#!/bin/bash
+# Foreground JupyterLab service (reference: jupyter/s6/services.d/jupyterlab/run).
+#
+# Token auth is disabled because authn/authz happen at the mesh edge
+# (Istio AuthorizationPolicy written by the profile controller); the pod
+# is only reachable through the per-notebook VirtualService route.
+set -euo pipefail
+
+exec jupyter lab \
+  --notebook-dir="${HOME}" \
+  --ip=0.0.0.0 \
+  --port=8888 \
+  --no-browser \
+  --ServerApp.base_url="${NB_PREFIX:-/}" \
+  --ServerApp.token="" \
+  --ServerApp.password="" \
+  --ServerApp.allow_origin="*" \
+  --ServerApp.authenticate_prometheus=False

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Foreground RStudio Server (reference: rstudio/s6/services.d/rstudio/run).
+set -euo pipefail
+
+exec /usr/lib/rstudio-server/bin/rserver \
+  --server-daemonize=0 \
+  --www-address=0.0.0.0 \
+  --www-port=8888 \
+  --www-root-path="${NB_PREFIX:-/}" \
+  --auth-none=1 \
+  --server-user="${NB_USER}"

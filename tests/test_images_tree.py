@@ -1,0 +1,93 @@
+"""Structural checks on the workload image tree (images/).
+
+The reference validates its image graph by building it in CI
+(example-notebook-servers/common.mk + *_docker_publish workflows); without
+docker in the test environment we instead assert the graph is well-formed:
+every Makefile's declared parent folders exist, BASE_IMAGE names match the
+parent's IMAGE_NAME, and the nbinit service contract holds.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+IMAGES = Path(__file__).resolve().parent.parent / "images"
+
+
+def image_dirs():
+    return sorted(
+        d for d in IMAGES.iterdir() if d.is_dir() and (d / "Makefile").exists()
+    )
+
+
+def parse_makefile(d):
+    text = (d / "Makefile").read_text()
+    get = lambda key: re.search(
+        rf"^{key}[ \t]*:?=[ \t]*(.*)$", text, re.MULTILINE
+    )
+    return {
+        "name": get("IMAGE_NAME").group(1).strip(),
+        "base": get("BASE_IMAGE").group(1).strip(),
+        "parents": (get("BASE_IMAGE_FOLDERS").group(1) or "").split(),
+    }
+
+
+def test_tree_has_expected_images():
+    names = {d.name for d in image_dirs()}
+    assert {
+        "base", "jupyter", "jupyter-scipy", "jupyter-jax-tpu",
+        "jupyter-jax-tpu-full", "codeserver", "codeserver-python",
+        "rstudio", "rstudio-tidyverse",
+    } <= names
+
+
+def test_no_cuda_anywhere():
+    # the zero-GPU invariant (BASELINE.md) extends to the image tree;
+    # comments may mention CUDA (they cite the reference), config must not
+    for d in image_dirs():
+        for f in d.rglob("*"):
+            if f.is_file() and f.suffix not in {".png", ".ipynb", ".md"}:
+                lines = f.read_text(errors="ignore").lower().splitlines()
+                code = [l for l in lines if not l.lstrip().startswith("#")]
+                text = "\n".join(code)
+                assert "cuda" not in text, f"CUDA reference in {f}"
+                assert "nvidia" not in text, f"NVIDIA reference in {f}"
+
+
+@pytest.mark.parametrize("d", image_dirs(), ids=lambda d: d.name)
+def test_makefile_graph_consistent(d):
+    mk = parse_makefile(d)
+    assert mk["name"] == d.name
+    for parent in mk["parents"]:
+        assert (IMAGES / parent / "Makefile").exists(), (
+            f"{d.name} depends on missing image dir {parent}"
+        )
+    if mk["parents"]:
+        # BASE_IMAGE must reference the (single) parent's image name
+        assert len(mk["parents"]) == 1
+        assert f"/{mk['parents'][0]}:" in mk["base"]
+    # Dockerfile must take BASE_IMG as an arg and FROM it
+    df = (d / "Dockerfile").read_text()
+    assert re.search(r"^ARG BASE_IMG=", df, re.MULTILINE)
+    assert re.search(r"^FROM \$BASE_IMG", df, re.MULTILINE)
+
+
+def test_service_images_install_nbinit_run():
+    # images that run a foreground service must install /opt/nbinit/run
+    for name in ("jupyter", "codeserver", "rstudio"):
+        df = (IMAGES / name / "Dockerfile").read_text()
+        assert "/opt/nbinit/run" in df, name
+
+
+def test_base_init_hooks_are_ordered_scripts():
+    hooks = sorted((IMAGES / "base" / "nbinit" / "init.d").iterdir())
+    assert hooks, "base image must ship at least the home-seed hook"
+    for h in hooks:
+        assert re.match(r"\d{2}-", h.name), "hooks run in lexical order"
+        assert h.read_text().startswith("#!/bin/bash")
+
+
+def test_jax_tpu_image_has_cpu_fallback():
+    df = (IMAGES / "jupyter-jax-tpu" / "Dockerfile").read_text()
+    assert "JAX_PLATFORMS=tpu,cpu" in df
