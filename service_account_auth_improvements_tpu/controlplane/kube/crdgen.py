@@ -1,0 +1,304 @@
+"""CRD manifest generator.
+
+The reference ships kubebuilder-generated CRD YAML under each component's
+``config/crd/bases`` (e.g. notebook-controller/config/crd/, driven by
+``make manifests`` — notebook-controller/Makefile). Here the API types live
+in Python, so the equivalent is this module: declarative schemas →
+CustomResourceDefinition dicts → ``manifests/crd/bases/*.yaml``.
+
+Regenerate with ``python -m service_account_auth_improvements_tpu.controlplane.kube.crdgen``;
+tests assert the checked-in YAML matches (the "make manifests is clean"
+CI gate of the reference).
+"""
+
+from __future__ import annotations
+
+from .registry import GROUP
+
+# ---------------------------------------------------------------- schemas
+
+def _preserve(desc: str = "") -> dict:
+    s: dict = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _str(desc: str = "") -> dict:
+    s: dict = {"type": "string"}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _int(desc: str = "") -> dict:
+    s: dict = {"type": "integer"}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _arr(items: dict, desc: str = "") -> dict:
+    s: dict = {"type": "array", "items": items}
+    if desc:
+        s["description"] = desc
+    return s
+
+
+def _obj(props: dict, required: list[str] | None = None,
+         desc: str = "") -> dict:
+    s: dict = {"type": "object", "properties": props}
+    if required:
+        s["required"] = required
+    if desc:
+        s["description"] = desc
+    return s
+
+
+_CONDITIONS = _arr(_preserve(), "standard condition list")
+
+TPU_SPEC = _obj(
+    {
+        "generation": _str("TPU generation: v4 | v5e | v5p | v6e"),
+        "topology": _str('chip topology, e.g. "2x4" (v5e/v6e) or "2x2x2" '
+                         "(v4/v5p); resolved to "
+                         "cloud.google.com/gke-tpu-topology"),
+        "chips": _int("total chip count; alternative to topology for "
+                      "single-host shapes"),
+    },
+    desc="TPU attachment — the accelerator-aware replacement for the "
+         "reference's opaque GPU limits key "
+         "(jupyter spawner_ui_config.yaml:119-136)",
+)
+
+NOTEBOOK_SPEC = _obj(
+    {
+        "template": _preserve("pod template (reference "
+                              "notebook_types.go:38-42)"),
+        "tpu": TPU_SPEC,
+    },
+)
+
+NOTEBOOK_STATUS = _obj(
+    {
+        "conditions": _CONDITIONS,
+        "readyReplicas": _int(),
+        "containerState": _preserve("mirror of the main container state "
+                                    "(reference notebook_types.go:67-76)"),
+    },
+)
+
+CRDS: list[dict] = [
+    {
+        "kind": "Notebook",
+        "plural": "notebooks",
+        "singular": "notebook",
+        "scope": "Namespaced",
+        "versions": [
+            {
+                "name": "v1beta1",
+                "served": True,
+                "storage": True,
+                "spec": NOTEBOOK_SPEC,
+                "status": NOTEBOOK_STATUS,
+                "printercolumns": [
+                    {"name": "Ready", "type": "integer",
+                     "jsonPath": ".status.readyReplicas"},
+                    {"name": "TPU", "type": "string",
+                     "jsonPath": ".spec.tpu.generation"},
+                ],
+            },
+        ],
+    },
+    {
+        "kind": "Profile",
+        "plural": "profiles",
+        "singular": "profile",
+        "scope": "Cluster",
+        "versions": [
+            {
+                "name": "v1",
+                "served": True,
+                "storage": True,
+                "spec": _obj(
+                    {
+                        "owner": _preserve("rbac Subject of the namespace "
+                                           "owner (reference "
+                                           "profile_types.go:36-44)"),
+                        "plugins": _arr(_preserve(),
+                                        "cloud-IAM plugins (kind + "
+                                        "RawExtension spec, reference "
+                                        "profile_types.go:24-28)"),
+                        "resourceQuotaSpec": _preserve(
+                            "corev1 ResourceQuotaSpec; may include "
+                            "requests.google.com/tpu chip quota"),
+                    },
+                ),
+                "status": _obj({"conditions": _CONDITIONS}),
+            },
+        ],
+    },
+    {
+        "kind": "PodDefault",
+        "plural": "poddefaults",
+        "singular": "poddefault",
+        "scope": "Namespaced",
+        "versions": [
+            {
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "spec": _obj(
+                    {
+                        "desc": _str(),
+                        "selector": _preserve("label selector choosing the "
+                                              "pods to mutate"),
+                        "env": _arr(_preserve()),
+                        "envFrom": _arr(_preserve()),
+                        "volumes": _arr(_preserve()),
+                        "volumeMounts": _arr(_preserve()),
+                        "tolerations": _arr(_preserve()),
+                        "imagePullSecrets": _arr(_preserve()),
+                        "initContainers": _arr(_preserve()),
+                        "sidecars": _arr(_preserve()),
+                        "labels": _preserve(),
+                        "annotations": _preserve(),
+                        "command": _arr(_str()),
+                        "args": _arr(_str()),
+                        "serviceAccountName": _str(),
+                        "automountServiceAccountToken": {"type": "boolean"},
+                    },
+                    required=["selector"],
+                    desc="pod mutations applied at admission (reference "
+                         "poddefault_types.go:33-88)",
+                ),
+            },
+        ],
+    },
+    {
+        "kind": "Tensorboard",
+        "plural": "tensorboards",
+        "singular": "tensorboard",
+        "scope": "Namespaced",
+        "versions": [
+            {
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "spec": _obj(
+                    {"logspath": _str("pvc://<name>/<subpath> or gs:// "
+                                      "(reference "
+                                      "tensorboard_types.go:28-33)")},
+                    required=["logspath"],
+                ),
+                "status": _obj(
+                    {"conditions": _CONDITIONS,
+                     "readyReplicas": _int()},
+                ),
+            },
+        ],
+    },
+    {
+        "kind": "PVCViewer",
+        "plural": "pvcviewers",
+        "singular": "pvcviewer",
+        "scope": "Namespaced",
+        "versions": [
+            {
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "spec": _obj(
+                    {
+                        "pvc": _str("claim to browse"),
+                        "podSpec": _preserve("viewer pod spec; defaulted by "
+                                             "the webhook (reference "
+                                             "pvcviewer_webhook.go:37-80)"),
+                        "networking": _obj({
+                            "targetPort": _int(),
+                            "basePrefix": _str(),
+                            "rewrite": _str(),
+                            "timeout": _str(),
+                        }),
+                        "rwoScheduling": {"type": "boolean"},
+                    },
+                    required=["pvc"],
+                ),
+                "status": _obj(
+                    {"ready": {"type": "boolean"},
+                     "url": _str(),
+                     "conditions": _CONDITIONS},
+                ),
+            },
+        ],
+    },
+]
+
+
+# ---------------------------------------------------------------- emit
+
+def build_crd(spec: dict) -> dict:
+    versions = []
+    for v in spec["versions"]:
+        schema = {
+            "type": "object",
+            "properties": {
+                "apiVersion": {"type": "string"},
+                "kind": {"type": "string"},
+                "metadata": {"type": "object"},
+                "spec": v["spec"],
+                **({"status": v["status"]} if "status" in v else {}),
+            },
+        }
+        version = {
+            "name": v["name"],
+            "served": v["served"],
+            "storage": v["storage"],
+            "schema": {"openAPIV3Schema": schema},
+        }
+        if "status" in v:
+            version["subresources"] = {"status": {}}
+        if v.get("printercolumns"):
+            version["additionalPrinterColumns"] = v["printercolumns"]
+        versions.append(version)
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{spec['plural']}.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "scope": spec["scope"],
+            "names": {
+                "kind": spec["kind"],
+                "listKind": f"{spec['kind']}List",
+                "plural": spec["plural"],
+                "singular": spec["singular"],
+            },
+            "versions": versions,
+        },
+    }
+
+
+def render_all() -> dict[str, str]:
+    """filename → YAML document for every CRD."""
+    import yaml
+
+    out = {}
+    for spec in CRDS:
+        name = f"{GROUP}_{spec['plural']}.yaml"
+        out[name] = yaml.safe_dump(build_crd(spec), sort_keys=False)
+    return out
+
+
+def main() -> None:
+    import pathlib
+
+    base = pathlib.Path(__file__).resolve().parents[3] / "manifests" / "crd" / "bases"
+    base.mkdir(parents=True, exist_ok=True)
+    for name, text in render_all().items():
+        (base / name).write_text(text)
+        print(f"wrote {base / name}")
+
+
+if __name__ == "__main__":
+    main()

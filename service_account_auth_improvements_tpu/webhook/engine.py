@@ -27,7 +27,11 @@ STAMP_PREFIX = "poddefault.admission.tpukf.dev/"
 _REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 )
-_NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+# TPUKF_NATIVE_DIR points at the dir CONTAINING build/libpoddefault.so
+# (set by the controlplane image where the package lives outside the repo)
+_NATIVE_DIR = os.environ.get(
+    "TPUKF_NATIVE_DIR", os.path.join(_REPO_ROOT, "native")
+)
 _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libpoddefault.so")
 
 _lib = None

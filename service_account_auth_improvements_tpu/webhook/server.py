@@ -153,3 +153,45 @@ def serve_background(kube, port: int = 8443, **kw) -> ThreadingHTTPServer:
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server
+
+
+def main(argv=None) -> int:
+    """Webhook binary (reference: admission-webhook/main.go:755-773 — HTTPS
+    server with TLS cert/key mounted from a secret)."""
+    import argparse
+
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        KubeClient,
+    )
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, default=8443)
+    parser.add_argument("--kube-url", default=None,
+                        help="API server base URL (default: in-cluster)")
+    parser.add_argument("--tls-cert", default=None)
+    parser.add_argument("--tls-key", default=None)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    if bool(args.tls_cert) != bool(args.tls_key):
+        parser.error("--tls-cert and --tls-key must be given together")
+    if not args.tls_cert:
+        # the apiserver only calls webhooks over HTTPS; plain HTTP is only
+        # useful behind a TLS-terminating proxy or in tests
+        log.warning("serving WITHOUT TLS — the kube-apiserver will not be "
+                    "able to call this webhook directly")
+    server = make_server(KubeClient(base_url=args.kube_url), args.port,
+                         certfile=args.tls_cert, keyfile=args.tls_key)
+    log.info("poddefault webhook listening on :%d", args.port)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
