@@ -1,0 +1,213 @@
+/* tpukf.js — shared SPA runtime for the CRUD web apps.
+ *
+ * Plays the role of kubeflow-common-lib (reference
+ * crud-web-apps/common/frontend/kubeflow-common-lib/projects/kubeflow/src/lib):
+ * backend client with CSRF echo, poller, resource table, status icons,
+ * namespace handling, snackbar, confirm dialog. No framework: these apps
+ * are API-shaped CRUD pages; vanilla DOM keeps the images dependency-free.
+ */
+(function () {
+  "use strict";
+
+  // ----------------------------------------------------------- api client
+  function cookie(name) {
+    for (const part of document.cookie.split(";")) {
+      const [k, ...v] = part.trim().split("=");
+      if (k === name) return v.join("=");
+    }
+    return "";
+  }
+
+  async function api(method, path, body) {
+    const headers = { "Content-Type": "application/json" };
+    if (!["GET", "HEAD", "OPTIONS"].includes(method)) {
+      // double-submit echo (backend: webapps/core/csrf.py)
+      headers["X-XSRF-TOKEN"] = cookie("XSRF-TOKEN");
+    }
+    const resp = await fetch(path, {
+      method,
+      headers,
+      body: body === undefined ? undefined : JSON.stringify(body),
+      credentials: "same-origin",
+    });
+    let data = {};
+    try { data = await resp.json(); } catch (e) { /* empty body */ }
+    if (!resp.ok) {
+      const msg = (data && (data.log || data.error || data.message)) ||
+        `${method} ${path} failed (${resp.status})`;
+      throw new Error(msg);
+    }
+    return data;
+  }
+
+  // ----------------------------------------------------------- namespace
+  // The dashboard iframes each app with ?ns=<namespace> (reference
+  // iframe-container.js); standalone use falls back to localStorage.
+  function currentNamespace() {
+    const fromUrl = new URLSearchParams(location.search).get("ns");
+    if (fromUrl) {
+      localStorage.setItem("tpukf.namespace", fromUrl);
+      return fromUrl;
+    }
+    return localStorage.getItem("tpukf.namespace") || "";
+  }
+
+  function namespaceInput(onChange) {
+    const wrap = document.createElement("div");
+    wrap.className = "row";
+    const label = document.createElement("span");
+    label.className = "muted";
+    label.textContent = "namespace";
+    const input = document.createElement("input");
+    input.style.width = "160px";
+    input.value = currentNamespace();
+    input.placeholder = "namespace";
+    input.addEventListener("change", () => {
+      localStorage.setItem("tpukf.namespace", input.value.trim());
+      onChange(input.value.trim());
+    });
+    wrap.append(label, input);
+    return wrap;
+  }
+
+  // ----------------------------------------------------------- widgets
+  function snackbar(message, isError) {
+    let el = document.querySelector(".snackbar");
+    if (!el) {
+      el = document.createElement("div");
+      el.className = "snackbar";
+      document.body.appendChild(el);
+    }
+    el.textContent = message;
+    el.classList.toggle("error", !!isError);
+    el.classList.add("show");
+    clearTimeout(el._timer);
+    el._timer = setTimeout(() => el.classList.remove("show"), 4000);
+  }
+
+  function confirmDialog(title, text) {
+    return new Promise((resolve) => {
+      const dlg = document.createElement("dialog");
+      // DOM-built (never innerHTML): title/text often embed resource and
+      // user names, which are untrusted
+      const h = document.createElement("h3");
+      h.style.marginTop = "0";
+      h.textContent = title;
+      const p = document.createElement("p");
+      p.className = "muted";
+      p.textContent = text;
+      const row = document.createElement("div");
+      row.className = "row";
+      row.style.justifyContent = "flex-end";
+      const cancel = document.createElement("button");
+      cancel.value = "no";
+      cancel.textContent = "Cancel";
+      const ok = document.createElement("button");
+      ok.value = "yes";
+      ok.className = "danger";
+      ok.textContent = "Delete";
+      row.append(cancel, ok);
+      dlg.append(h, p, row);
+      [cancel, ok].forEach((b) =>
+        b.addEventListener("click", () => { dlg.close(b.value); })
+      );
+      dlg.addEventListener("close", () => {
+        resolve(dlg.returnValue === "yes");
+        dlg.remove();
+      });
+      document.body.appendChild(dlg);
+      dlg.showModal();
+    });
+  }
+
+  function statusIcon(phase, message) {
+    // phases: ready | waiting | warning | error | stopped (reference
+    // status-icon component + jupyter apps/common/status.py)
+    const span = document.createElement("span");
+    span.className = `status ${phase}`;
+    span.title = message || "";
+    const dot = document.createElement("span");
+    dot.className = "dot";
+    span.append(dot, document.createTextNode(message || phase));
+    return span;
+  }
+
+  // columns: [{title, render(item) -> Node|string}]
+  function resourceTable(columns, items, emptyText) {
+    const table = document.createElement("table");
+    table.className = "resources";
+    const thead = table.createTHead().insertRow();
+    for (const c of columns) {
+      const th = document.createElement("th");
+      th.textContent = c.title;
+      thead.appendChild(th);
+    }
+    const body = table.createTBody();
+    if (!items.length) {
+      const cell = body.insertRow().insertCell();
+      cell.colSpan = columns.length;
+      cell.className = "muted";
+      cell.textContent = emptyText || "nothing here yet";
+    }
+    for (const item of items) {
+      const row = body.insertRow();
+      for (const c of columns) {
+        const cell = row.insertCell();
+        const out = c.render(item);
+        if (out instanceof Node) cell.appendChild(out);
+        else cell.textContent = out == null ? "" : String(out);
+      }
+    }
+    return table;
+  }
+
+  // ----------------------------------------------------------- poller
+  // Exponential-backoff poller, reset on user action (reference
+  // poller.service.ts + ExponentialBackoff).
+  function poller(fn, baseMs) {
+    let delay = baseMs || 2000;
+    let timer = null;
+    let stopped = false;
+    let generation = 0;
+    async function tick(gen) {
+      if (stopped || gen !== generation) return;
+      try {
+        await fn();
+        delay = baseMs || 2000;
+      } catch (e) {
+        delay = Math.min(delay * 2, 30000);
+      }
+      // a reset() while fn() was in flight bumped the generation and
+      // started its own chain — this stale run must not reschedule
+      if (stopped || gen !== generation) return;
+      timer = setTimeout(() => tick(gen), delay);
+    }
+    tick(generation);
+    return {
+      reset() {
+        clearTimeout(timer);
+        delay = baseMs || 2000;
+        tick(++generation);
+      },
+      stop() { stopped = true; clearTimeout(timer); },
+    };
+  }
+
+  function el(tag, attrs, ...children) {
+    const node = document.createElement(tag);
+    for (const [k, v] of Object.entries(attrs || {})) {
+      if (k === "class") node.className = v;
+      else if (k.startsWith("on")) node.addEventListener(k.slice(2), v);
+      else node.setAttribute(k, v);
+    }
+    for (const c of children) {
+      node.append(c instanceof Node ? c : document.createTextNode(c));
+    }
+    return node;
+  }
+
+  window.TpuKF = {
+    api, currentNamespace, namespaceInput, snackbar, confirmDialog,
+    statusIcon, resourceTable, poller, el,
+  };
+})();

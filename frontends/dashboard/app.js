@@ -1,0 +1,212 @@
+/* Central dashboard shell.
+ * API surface: webapps/dashboard/app.py (+ the in-process KFAM).
+ * Views: #/ home (quick links + activities + metrics),
+ *        #/_/<app>/ iframe container (passes ?ns= to the embedded app,
+ *        the reference's iframe-container.js contract),
+ *        #/manage-users contributor management.
+ */
+(function () {
+  "use strict";
+  const { api, snackbar, confirmDialog, resourceTable, el } = window.TpuKF;
+
+  const main = document.getElementById("main");
+  const sidebar = document.getElementById("sidebar");
+  let envInfo = { namespaces: [], user: "" };
+  let links = { menuLinks: [], quickLinks: [] };
+  let namespace = localStorage.getItem("tpukf.namespace") || "";
+
+  // --------------------------------------------------------- bootstrap
+  async function boot() {
+    try {
+      const exists = await api("GET", "api/workgroup/exists");
+      if (exists.hasWorkgroup === false && exists.registrationFlowAllowed) {
+        renderRegistration(exists);
+        return;
+      }
+    } catch (e) { /* fall through to shell; errors surface per-view */ }
+    await loadShell();
+  }
+
+  async function loadShell() {
+    [envInfo, links] = await Promise.all([
+      api("GET", "api/workgroup/env-info"),
+      api("GET", "api/dashboard-links").then((d) => d.links),
+    ]);
+    if (!namespace && envInfo.namespaces.length) {
+      namespace = envInfo.namespaces[0].namespace;
+    }
+    renderHeader();
+    renderSidebar();
+    route();
+  }
+
+  function setNamespace(ns) {
+    namespace = ns;
+    localStorage.setItem("tpukf.namespace", ns);
+    route();
+  }
+
+  function renderHeader() {
+    const select = el("select", { style: "width:180px" });
+    for (const n of envInfo.namespaces) {
+      select.appendChild(el("option", { value: n.namespace },
+        `${n.namespace} (${n.role})`));
+    }
+    select.value = namespace;
+    select.addEventListener("change", () => setNamespace(select.value));
+    document.getElementById("ns-slot").replaceChildren(select);
+    document.getElementById("user-slot").textContent = envInfo.user || "";
+  }
+
+  function renderSidebar() {
+    sidebar.replaceChildren(
+      el("a", { href: "#/" }, "Home"),
+      ...links.menuLinks.map((l) =>
+        el("a", { href: `#/_${l.link}` }, l.text)),
+      el("a", { href: "#/manage-users" }, "Manage Contributors"),
+    );
+    const current = location.hash || "#/";
+    for (const a of sidebar.querySelectorAll("a")) {
+      a.classList.toggle("active", a.getAttribute("href") === current);
+    }
+  }
+
+  // ------------------------------------------------------------- views
+  function renderRegistration(exists) {
+    sidebar.replaceChildren();
+    const name = el("input", {
+      placeholder: "namespace",
+      value: (exists.user || "").split("@")[0].replace(/\./g, "-"),
+      style: "width:240px",
+    });
+    const btn = el("button", { class: "primary" }, "Create workspace");
+    btn.addEventListener("click", async () => {
+      btn.disabled = true;
+      try {
+        await api("POST", "api/workgroup/create",
+          { namespace: name.value.trim() });
+        snackbar("Workspace created");
+        await loadShell();
+      } catch (e) { snackbar(e.message, true); btn.disabled = false; }
+    });
+    main.replaceChildren(el("div", { class: "card" },
+      el("h3", { style: "margin-top:0" },
+        `Welcome, ${exists.user || "user"}`),
+      el("p", { class: "muted" },
+        "You don't have a workspace yet. Create a profile namespace to " +
+        "start launching TPU notebooks."),
+      el("div", { class: "row" }, name, btn)));
+  }
+
+  async function renderHome() {
+    const quick = el("div", { class: "card" },
+      el("h3", { style: "margin-top:0" }, "Quick shortcuts"),
+      ...(links.quickLinks || []).map((q) =>
+        el("div", {}, el("a", { href: `#/_${q.link}` }, q.text))));
+
+    const activitiesCard = el("div", { class: "card" },
+      el("h3", { style: "margin-top:0" }, `Activity in ${namespace}`),
+      el("span", { class: "muted" }, "loading…"));
+    main.replaceChildren(quick, activitiesCard);
+
+    try {
+      const { activities } = await api("GET",
+        `api/activities/${namespace}`);
+      const columns = [
+        { title: "Time", render: (a) => a.lastTimestamp || a.eventTime },
+        { title: "Type", render: (a) => a.type },
+        { title: "Object", render: (a) =>
+            `${(a.involvedObject || {}).kind}/${(a.involvedObject || {}).name}` },
+        { title: "Message", render: (a) => a.message },
+      ];
+      activitiesCard.replaceChildren(
+        el("h3", { style: "margin-top:0" }, `Activity in ${namespace}`),
+        resourceTable(columns, activities.slice(0, 20), "no recent events"));
+    } catch (e) {
+      activitiesCard.replaceChildren(
+        el("span", { class: "muted" }, e.message));
+    }
+
+    try {
+      const { metrics } = await api("GET", "api/metrics/cpu");
+      if (metrics && metrics.length) {
+        main.appendChild(el("div", { class: "card" },
+          el("h3", { style: "margin-top:0" }, "Cluster CPU"),
+          el("div", { class: "muted" },
+            `${metrics.length} series from the metrics service`)));
+      }
+    } catch (e) { /* metrics service optional */ }
+  }
+
+  function renderIframe(path) {
+    // embedded apps read ?ns= (frontends/common/tpukf.js
+    // currentNamespace); the query must precede any SPA hash fragment
+    // ("/jupyter/#/new" → "/jupyter/?ns=x#/new")
+    const [base, ...frag] = path.split("#");
+    const src = `${base}${base.includes("?") ? "&" : "?"}` +
+      `ns=${encodeURIComponent(namespace)}` +
+      (frag.length ? "#" + frag.join("#") : "");
+    main.replaceChildren(el("iframe", { class: "embed", src }));
+  }
+
+  async function renderManageUsers() {
+    const card = el("div", { class: "card" },
+      el("h3", { style: "margin-top:0" },
+        `Contributors to ${namespace}`),
+      el("span", { class: "muted" }, "loading…"));
+    main.replaceChildren(card);
+    let contributors = [];
+    try {
+      ({ contributors } = await api("GET",
+        `api/workgroup/get-contributors/${namespace}`));
+    } catch (e) {
+      card.replaceChildren(el("span", { class: "muted" }, e.message));
+      return;
+    }
+    const email = el("input", { placeholder: "user@example.com",
+      style: "width:260px" });
+    const add = el("button", { class: "primary" }, "Add");
+    add.addEventListener("click", async () => {
+      try {
+        await api("POST",
+          `api/workgroup/add-contributor/${namespace}`,
+          { contributor: email.value.trim() });
+        snackbar("Contributor added");
+        renderManageUsers();
+      } catch (e) { snackbar(e.message, true); }
+    });
+    const columns = [
+      { title: "User", render: (c) => c },
+      { title: "", render: (c) => el("button", {
+          class: "danger",
+          onclick: async () => {
+            if (!(await confirmDialog("Remove contributor",
+                `Remove ${c} from ${namespace}?`))) return;
+            try {
+              await api("DELETE",
+                `api/workgroup/remove-contributor/${namespace}`,
+                { contributor: c });
+              snackbar("Contributor removed");
+              renderManageUsers();
+            } catch (e) { snackbar(e.message, true); }
+          },
+        }, "Remove") },
+    ];
+    card.replaceChildren(
+      el("h3", { style: "margin-top:0" }, `Contributors to ${namespace}`),
+      resourceTable(columns, contributors, "no contributors"),
+      el("div", { class: "row", style: "margin-top:12px" }, email, add));
+  }
+
+  // ------------------------------------------------------------- router
+  function route() {
+    renderSidebar();
+    const hash = location.hash || "#/";
+    if (hash.startsWith("#/_")) renderIframe(hash.slice(3));
+    else if (hash === "#/manage-users") {
+      renderManageUsers().catch((e) => snackbar(e.message, true));
+    } else renderHome().catch((e) => snackbar(e.message, true));
+  }
+  window.addEventListener("hashchange", route);
+  boot().catch((e) => snackbar(e.message, true));
+})();

@@ -1,0 +1,219 @@
+/* Jupyter web app — notebook list + spawner form.
+ * API surface: webapps/jupyter/app.py (GET/POST/PATCH/DELETE notebooks,
+ * GET config/pvcs/poddefaults). Form field names match form.py setters.
+ */
+(function () {
+  "use strict";
+  const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
+          statusIcon, resourceTable, poller, el } = window.TpuKF;
+
+  const main = document.getElementById("main");
+  let ns = currentNamespace();
+  let listPoller = null;
+
+  document.getElementById("ns-slot").appendChild(
+    namespaceInput((value) => { ns = value; route(); })
+  );
+  document.getElementById("new-btn").addEventListener("click", () => {
+    location.hash = "#/new";
+  });
+
+  // -------------------------------------------------------------- list
+  function tpuLabel(tpu) {
+    if (!tpu) return "—";
+    return `${tpu.generation}${tpu.topology ? " " + tpu.topology : ""}` +
+      (tpu.chips ? ` (${tpu.chips} chips)` : "");
+  }
+
+  async function renderList() {
+    if (listPoller) listPoller.stop();
+    if (!ns) {
+      main.replaceChildren(el("div", { class: "card muted" },
+        "Set a namespace to list notebooks."));
+      return;
+    }
+    const container = el("div", { class: "card" });
+    main.replaceChildren(container);
+
+    async function refresh() {
+      const data = await api("GET", `api/namespaces/${ns}/notebooks`);
+      const columns = [
+        { title: "Status", render: (nb) =>
+            statusIcon(nb.status.phase, nb.status.message) },
+        { title: "Name", render: (nb) => nb.name },
+        { title: "Type", render: (nb) => nb.serverType || "jupyter" },
+        { title: "Image", render: (nb) => nb.shortImage },
+        { title: "TPU", render: (nb) => tpuLabel(nb.tpu) },
+        { title: "CPU", render: (nb) => nb.cpu },
+        { title: "Memory", render: (nb) => nb.memory },
+        { title: "", render: (nb) => rowActions(nb) },
+      ];
+      container.replaceChildren(
+        resourceTable(columns, data.notebooks, "no notebooks in " + ns)
+      );
+    }
+
+    function rowActions(nb) {
+      const row = el("div", { class: "row" });
+      const stopped = nb.status.phase === "stopped";
+      row.appendChild(el("button", {
+        onclick: async () => {
+          try {
+            await api("PATCH",
+              `api/namespaces/${ns}/notebooks/${nb.name}`,
+              { stopped: !stopped });
+            snackbar(`${stopped ? "Starting" : "Stopping"} ${nb.name}…`);
+            listPoller.reset();
+          } catch (e) { snackbar(e.message, true); }
+        },
+      }, stopped ? "Start" : "Stop"));
+      row.appendChild(el("button", {
+        onclick: () => {
+          window.open(`/notebook/${ns}/${nb.name}/`, "_blank");
+        },
+      }, "Connect"));
+      row.appendChild(el("button", {
+        class: "danger",
+        onclick: async () => {
+          if (!(await confirmDialog("Delete notebook",
+              `Delete ${nb.name} and keep its volumes?`))) return;
+          try {
+            await api("DELETE", `api/namespaces/${ns}/notebooks/${nb.name}`);
+            snackbar(`Deleting ${nb.name}…`);
+            listPoller.reset();
+          } catch (e) { snackbar(e.message, true); }
+        },
+      }, "Delete"));
+      return row;
+    }
+
+    listPoller = poller(refresh, 3000);
+  }
+
+  // -------------------------------------------------------------- form
+  async function renderForm() {
+    if (listPoller) listPoller.stop();
+    const { config } = await api("GET", "api/config");
+    const form = el("div", { class: "card" });
+
+    const name = el("input", { placeholder: "my-notebook" });
+    const image = el("select", {});
+    for (const opt of config.image.options) {
+      image.appendChild(el("option", { value: opt }, opt));
+    }
+    image.value = config.image.value;
+    const customImage = el("input",
+      { placeholder: "custom image (optional)" });
+    const serverType = el("select", {});
+    for (const t of ["jupyter", "group-one", "group-two"]) {
+      serverType.appendChild(el("option", { value: t }, t));
+    }
+    const cpu = el("input", { value: config.cpu.value });
+    const memory = el("input", { value: config.memory.value });
+
+    // TPU picker (replaces the reference's GPU vendor dropdown)
+    const tpuGen = el("select", {});
+    tpuGen.appendChild(el("option", { value: "none" }, "none (CPU only)"));
+    for (const g of config.tpu.generations) {
+      tpuGen.appendChild(el("option", { value: g.key }, g.uiName));
+    }
+    const tpuTopo = el("select", { disabled: "" });
+    tpuGen.addEventListener("change", () => {
+      tpuTopo.replaceChildren();
+      const gen = config.tpu.generations.find((g) => g.key === tpuGen.value);
+      if (!gen) { tpuTopo.disabled = true; return; }
+      tpuTopo.disabled = false;
+      for (const t of gen.topologies) {
+        tpuTopo.appendChild(el("option", { value: t }, t));
+      }
+    });
+
+    const wsSize = el("input", { value: "10Gi", style: "width:100px" });
+    const shm = el("input", { type: "checkbox", checked: "" });
+
+    // configurations = PodDefault labels (admission webhook matches them)
+    const podDefaultsBox = el("div", {}, el("span", { class: "muted" },
+      ns ? "loading…" : "set a namespace to list configurations"));
+    if (ns) {
+      api("GET", `api/namespaces/${ns}/poddefaults`).then(({ poddefaults }) => {
+        podDefaultsBox.replaceChildren();
+        if (!poddefaults.length) {
+          podDefaultsBox.appendChild(
+            el("span", { class: "muted" }, "none available"));
+        }
+        for (const pd of poddefaults) {
+          podDefaultsBox.appendChild(el("label", { class: "chip" },
+            el("input", { type: "checkbox", "data-label": pd.label }),
+            " " + pd.desc));
+        }
+      }).catch((e) => snackbar(e.message, true));
+    }
+
+    const grid = el("div", { class: "form-grid" },
+      el("label", {}, "Name"), name,
+      el("label", {}, "Image"), image,
+      el("label", {}, "Custom image"), customImage,
+      el("label", {}, "Server type"), serverType,
+      el("label", {}, "CPU"), cpu,
+      el("label", {}, "Memory"), memory,
+      el("label", {}, "TPU"), el("div", { class: "row" }, tpuGen, tpuTopo),
+      el("label", {}, "Workspace size"), wsSize,
+      el("label", {}, "Shared memory"), el("div", {}, shm),
+      el("label", {}, "Configurations"), podDefaultsBox,
+    );
+
+    const submit = el("button", { class: "primary" }, "Launch");
+    submit.addEventListener("click", async () => {
+      const body = {
+        name: name.value.trim(),
+        image: image.value,
+        customImage: customImage.value.trim() || undefined,
+        serverType: serverType.value,
+        cpu: cpu.value, memory: memory.value,
+        shm: shm.checked,
+        configurations: [...podDefaultsBox.querySelectorAll("input:checked")]
+          .map((c) => c.dataset.label).filter(Boolean),
+        workspace: {
+          mount: "/home/jovyan",
+          newPvc: {
+            metadata: { name: "{notebook-name}-workspace" },
+            spec: {
+              resources: { requests: { storage: wsSize.value } },
+              accessModes: ["ReadWriteOnce"],
+            },
+          },
+        },
+      };
+      if (tpuGen.value !== "none") {
+        body.tpu = { generation: tpuGen.value, topology: tpuTopo.value };
+      }
+      submit.disabled = true;
+      try {
+        await api("POST", `api/namespaces/${ns}/notebooks`, body);
+        snackbar("Notebook created");
+        location.hash = "#/";
+      } catch (e) {
+        snackbar(e.message, true);
+        submit.disabled = false;
+      }
+    });
+
+    form.append(
+      el("h3", { style: "margin-top:0" }, `New notebook in ${ns || "?"}`),
+      grid,
+      el("div", { class: "row", style: "margin-top:16px" },
+        submit,
+        el("button", { onclick: () => { location.hash = "#/"; } }, "Cancel")),
+    );
+    main.replaceChildren(form);
+  }
+
+  // ------------------------------------------------------------- router
+  function route() {
+    if (location.hash === "#/new") renderForm().catch(
+      (e) => snackbar(e.message, true));
+    else renderList().catch((e) => snackbar(e.message, true));
+  }
+  window.addEventListener("hashchange", route);
+  route();
+})();

@@ -1,0 +1,122 @@
+/* Tensorboards web app — Tensorboard CR table + create dialog.
+ * API surface: webapps/tensorboards/app.py. The logs path is either a
+ * PVC (pvc://name/subpath) or an object-store URL (gs://...).
+ */
+(function () {
+  "use strict";
+  const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
+          statusIcon, resourceTable, poller, el } = window.TpuKF;
+
+  const main = document.getElementById("main");
+  let ns = currentNamespace();
+  let listPoller = null;
+
+  document.getElementById("ns-slot").appendChild(
+    namespaceInput((value) => { ns = value; render(); })
+  );
+  document.getElementById("new-btn").addEventListener("click", newDialog);
+
+  async function newDialog() {
+    const dlg = el("dialog", {});
+    const name = el("input", { placeholder: "my-tensorboard" });
+    const kind = el("select", {},
+      el("option", { value: "pvc" }, "PVC"),
+      el("option", { value: "gs" }, "Object store (gs://)"));
+    const pvcSelect = el("select", {});
+    const subpath = el("input", { placeholder: "logs/" });
+    const gsPath = el("input", { placeholder: "gs://bucket/logs" });
+
+    try {
+      const { pvcs } = await api("GET", `api/namespaces/${ns}/pvcs`);
+      for (const p of pvcs) pvcSelect.appendChild(
+        el("option", { value: p }, p));
+    } catch (e) { snackbar(e.message, true); }
+
+    const pvcRow = el("div", { class: "row" }, pvcSelect, subpath);
+    const gsRow = el("div", { style: "display:none" }, gsPath);
+    kind.addEventListener("change", () => {
+      pvcRow.style.display = kind.value === "pvc" ? "" : "none";
+      gsRow.style.display = kind.value === "gs" ? "" : "none";
+    });
+
+    const create = el("button", { class: "primary" }, "Create");
+    create.addEventListener("click", async () => {
+      const logspath = kind.value === "pvc"
+        ? `pvc://${pvcSelect.value}/${subpath.value.replace(/^\//, "")}`
+        : gsPath.value.trim();
+      try {
+        await api("POST", `api/namespaces/${ns}/tensorboards`,
+          { name: name.value.trim(), logspath });
+        snackbar("TensorBoard created");
+        dlg.close(); dlg.remove();
+        listPoller.reset();
+      } catch (e) { snackbar(e.message, true); }
+    });
+
+    dlg.append(
+      el("h3", { style: "margin-top:0" }, `New TensorBoard in ${ns || "?"}`),
+      el("div", { class: "form-grid" },
+        el("label", {}, "Name"), name,
+        el("label", {}, "Logs source"), kind,
+        el("label", {}, "Location"), el("div", {}, pvcRow, gsRow)),
+      el("div", { class: "row", style: "margin-top:14px" },
+        create,
+        el("button", { onclick: () => { dlg.close(); dlg.remove(); } },
+          "Cancel")),
+    );
+    document.body.appendChild(dlg);
+    dlg.showModal();
+  }
+
+  async function render() {
+    if (listPoller) listPoller.stop();
+    if (!ns) {
+      main.replaceChildren(el("div", { class: "card muted" },
+        "Set a namespace to list TensorBoards."));
+      return;
+    }
+    const container = el("div", { class: "card" });
+    main.replaceChildren(container);
+
+    async function refresh() {
+      const data = await api("GET", `api/namespaces/${ns}/tensorboards`);
+      const columns = [
+        { title: "Status", render: (t) =>
+            statusIcon(t.status.phase, t.status.message) },
+        { title: "Name", render: (t) => t.name },
+        { title: "Logs path", render: (t) => t.logspath },
+        { title: "Age", render: (t) => t.age },
+        { title: "", render: (t) => actions(t) },
+      ];
+      container.replaceChildren(
+        resourceTable(columns, data.tensorboards,
+          "no tensorboards in " + ns));
+    }
+
+    function actions(t) {
+      const row = el("div", { class: "row" });
+      row.appendChild(el("button", {
+        onclick: () => window.open(
+          `/tensorboard/${ns}/${t.name}/`, "_blank"),
+      }, "Connect"));
+      row.appendChild(el("button", {
+        class: "danger",
+        onclick: async () => {
+          if (!(await confirmDialog("Delete TensorBoard",
+              `Delete ${t.name}?`))) return;
+          try {
+            await api("DELETE",
+              `api/namespaces/${ns}/tensorboards/${t.name}`);
+            snackbar(`Deleting ${t.name}…`);
+            listPoller.reset();
+          } catch (e) { snackbar(e.message, true); }
+        },
+      }, "Delete"));
+      return row;
+    }
+
+    listPoller = poller(refresh, 3000);
+  }
+
+  render();
+})();

@@ -1,0 +1,119 @@
+/* Volumes web app — PVC table, create dialog, PVCViewer launch.
+ * API surface: webapps/volumes/app.py.
+ */
+(function () {
+  "use strict";
+  const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
+          statusIcon, resourceTable, poller, el } = window.TpuKF;
+
+  const main = document.getElementById("main");
+  let ns = currentNamespace();
+  let listPoller = null;
+
+  document.getElementById("ns-slot").appendChild(
+    namespaceInput((value) => { ns = value; render(); })
+  );
+  document.getElementById("new-btn").addEventListener("click", newPvcDialog);
+
+  function newPvcDialog() {
+    const dlg = el("dialog", {});
+    const name = el("input", { placeholder: "my-volume" });
+    const size = el("input", { value: "10Gi" });
+    const mode = el("select", {},
+      el("option", { value: "ReadWriteOnce" }, "ReadWriteOnce"),
+      el("option", { value: "ReadWriteMany" }, "ReadWriteMany"),
+      el("option", { value: "ReadOnlyMany" }, "ReadOnlyMany"));
+    const cls = el("input", { placeholder: "storage class ({empty} = default)",
+      value: "{empty}" });
+    const create = el("button", { class: "primary" }, "Create");
+    create.addEventListener("click", async () => {
+      try {
+        await api("POST", `api/namespaces/${ns}/pvcs`, {
+          name: name.value.trim(), size: size.value.trim(),
+          mode: mode.value, class: cls.value.trim(),
+        });
+        snackbar("Volume created");
+        dlg.close(); dlg.remove();
+        listPoller.reset();
+      } catch (e) { snackbar(e.message, true); }
+    });
+    dlg.append(
+      el("h3", { style: "margin-top:0" }, `New volume in ${ns || "?"}`),
+      el("div", { class: "form-grid" },
+        el("label", {}, "Name"), name,
+        el("label", {}, "Size"), size,
+        el("label", {}, "Access mode"), mode,
+        el("label", {}, "Storage class"), cls),
+      el("div", { class: "row", style: "margin-top:14px" },
+        create,
+        el("button", { onclick: () => { dlg.close(); dlg.remove(); } },
+          "Cancel")),
+    );
+    document.body.appendChild(dlg);
+    dlg.showModal();
+  }
+
+  async function render() {
+    if (listPoller) listPoller.stop();
+    if (!ns) {
+      main.replaceChildren(el("div", { class: "card muted" },
+        "Set a namespace to list volumes."));
+      return;
+    }
+    const container = el("div", { class: "card" });
+    main.replaceChildren(container);
+
+    async function refresh() {
+      const data = await api("GET", `api/namespaces/${ns}/pvcs`);
+      const columns = [
+        { title: "Status", render: (p) =>
+            statusIcon(p.status.phase, p.status.message) },
+        { title: "Name", render: (p) => p.name },
+        { title: "Size", render: (p) => p.capacity },
+        { title: "Modes", render: (p) => (p.modes || []).join(", ") },
+        { title: "Class", render: (p) => p.class },
+        { title: "Used by", render: (p) =>
+            (p.notebooks || []).join(", ") || "—" },
+        { title: "", render: (p) => actions(p) },
+      ];
+      container.replaceChildren(
+        resourceTable(columns, data.pvcs, "no volumes in " + ns));
+    }
+
+    function actions(p) {
+      const row = el("div", { class: "row" });
+      const viewerReady = p.viewer && p.viewer.status === "ready";
+      row.appendChild(el("button", {
+        onclick: async () => {
+          if (viewerReady && p.viewer.url) {
+            window.open(p.viewer.url, "_blank");
+            return;
+          }
+          try {
+            await api("POST", `api/namespaces/${ns}/viewers`,
+              { name: p.name });
+            snackbar("Launching file browser…");
+            listPoller.reset();
+          } catch (e) { snackbar(e.message, true); }
+        },
+      }, viewerReady ? "Browse" : "Launch browser"));
+      row.appendChild(el("button", {
+        class: "danger",
+        onclick: async () => {
+          if (!(await confirmDialog("Delete volume",
+              `Delete ${p.name}? Data is lost.`))) return;
+          try {
+            await api("DELETE", `api/namespaces/${ns}/pvcs/${p.name}`);
+            snackbar(`Deleting ${p.name}…`);
+            listPoller.reset();
+          } catch (e) { snackbar(e.message, true); }
+        },
+      }, "Delete"));
+      return row;
+    }
+
+    listPoller = poller(refresh, 3000);
+  }
+
+  render();
+})();

@@ -11,6 +11,7 @@ from service_account_auth_improvements_tpu.webapps.core.app import (
     HttpError,
     Request,
     WebApp,
+    frontend_dirs,
 )
 from service_account_auth_improvements_tpu.webapps.core.status import (
     STATUS_PHASE,
@@ -19,4 +20,5 @@ from service_account_auth_improvements_tpu.webapps.core.status import (
 
 __all__ = [
     "HttpError", "Request", "WebApp", "STATUS_PHASE", "create_status",
+    "frontend_dirs",
 ]

@@ -101,6 +101,25 @@ def _compile(pattern: str):
     return re.compile("^" + regex + "$")
 
 
+def frontend_dirs(app_name: str) -> tuple[str | None, str | None]:
+    """(static_dir, shared_static_dir) for an app's checked-in SPA.
+
+    The SPAs live in ``frontends/<app>`` with the shared lib in
+    ``frontends/common`` (the reference builds Angular bundles into each
+    backend's static dir; ours are plain files needing no build step).
+    ``TPUKF_FRONTENDS_DIR`` overrides the root for container images.
+    """
+    root = os.environ.get("TPUKF_FRONTENDS_DIR")
+    if not root:
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+        root = os.path.join(repo, "frontends")
+    app_dir = os.path.join(root, app_name)
+    common = os.path.join(root, "common")
+    return (app_dir if os.path.isdir(app_dir) else None,
+            common if os.path.isdir(common) else None)
+
+
 class WebApp:
     """App factory product (reference: crud_backend/__init__.py:16).
 
@@ -108,9 +127,14 @@ class WebApp:
     """
 
     def __init__(self, name: str, static_dir: str | None = None,
-                 prefix: str = "/", mode: str | None = None):
+                 prefix: str = "/", mode: str | None = None,
+                 shared_static_dir: str | None = None):
         self.name = name
         self.static_dir = static_dir
+        # requests for common/* assets (the shared frontend lib) fall back
+        # here — the analog of kubeflow-common-lib being linked into every
+        # app's build (reference jwa_frontend_tests.yaml:33-50)
+        self.shared_static_dir = shared_static_dir
         self.prefix = prefix
         self.mode = mode if mode is not None else os.environ.get(
             "BACKEND_MODE", "prod"
@@ -209,15 +233,24 @@ class WebApp:
         """Hashed assets get long cache; everything else serves index.html
         with a fresh CSRF cookie and no-cache (reference serving.py)."""
         rel = path.lstrip("/") or "index.html"
-        root = os.path.abspath(self.static_dir)
-        full = os.path.abspath(os.path.join(root, rel))
-        if not (full == root or full.startswith(root + os.sep)):
-            full = ""  # traversal attempt: fall through to index
+        full = self._safe_join(self.static_dir, rel)
+        if (not (full and os.path.isfile(full))
+                and rel.startswith("common/") and self.shared_static_dir):
+            full = self._safe_join(self.shared_static_dir,
+                                   rel[len("common/"):])
         if full and os.path.isfile(full) and rel != "index.html":
             ctype = _content_type(full)
             with open(full, "rb") as f:
                 resp = Response(f.read(), content_type=ctype)
-            resp.headers.append(("Cache-Control", "max-age=31536000"))
+            # assets are NOT content-hashed, so the browser must
+            # revalidate; only truly hashed names may cache long
+            # "hashed" = a ≥6-char hex segment containing a digit
+            # (e.g. main.abc123.js), so plain names like app.js revalidate
+            cache = ("max-age=31536000, immutable"
+                     if re.search(r"\.(?=[0-9a-f]*\d)[0-9a-f]{6,}\.",
+                                  os.path.basename(full))
+                     else "no-cache")
+            resp.headers.append(("Cache-Control", cache))
             return resp
         index = os.path.join(self.static_dir, "index.html")
         if not os.path.isfile(index):
@@ -229,6 +262,15 @@ class WebApp:
         )
         csrf.set_cookie(resp, self.prefix)
         return resp
+
+    @staticmethod
+    def _safe_join(root: str, rel: str) -> str:
+        """Absolute path under ``root`` or "" on traversal attempts."""
+        root = os.path.abspath(root)
+        full = os.path.abspath(os.path.join(root, rel))
+        if full == root or full.startswith(root + os.sep):
+            return full
+        return ""
 
     @staticmethod
     def _finish(resp: Response, start_response):

@@ -15,6 +15,7 @@ import os
 
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.webapps.core import (
+    frontend_dirs,
     HttpError,
     WebApp,
 )
@@ -34,7 +35,7 @@ DEFAULT_LINKS = {
     "externalLinks": [],
     "quickLinks": [
         {"text": "Create a new Notebook server",
-         "desc": "Notebook Servers", "link": "/jupyter/new"},
+         "desc": "Notebook Servers", "link": "/jupyter/#/new"},
         {"text": "View all TPU slices", "desc": "Notebook Servers",
          "link": "/jupyter/"},
     ],
@@ -49,7 +50,9 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
     (create_profile, create_binding, delete_binding, list_bindings) —
     in-process KfamApp or an HTTP client facade (the reference uses a
     swagger-generated KFAM client, clients/profile_controller.ts)."""
-    app = WebApp("centraldashboard", static_dir=static_dir, mode=mode)
+    default_static, shared = frontend_dirs("dashboard")
+    app = WebApp("centraldashboard", static_dir=static_dir or default_static,
+                 mode=mode, shared_static_dir=shared)
 
     cluster_admin = os.environ.get("CLUSTER_ADMIN", "admin@kubeflow.org")
 

@@ -15,6 +15,7 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
     STOP_ANNOTATION,
 )
 from service_account_auth_improvements_tpu.webapps.core import (
+    frontend_dirs,
     HttpError,
     WebApp,
 )
@@ -65,7 +66,9 @@ def notebook_summary(nb: dict, events: list | None = None) -> dict:
 
 def build_app(kube, static_dir: str | None = None,
               mode: str | None = None) -> WebApp:
-    app = WebApp("jupyter-web-app", static_dir=static_dir, mode=mode)
+    default_static, shared = frontend_dirs("jupyter")
+    app = WebApp("jupyter-web-app", static_dir=static_dir or default_static,
+                 mode=mode, shared_static_dir=shared)
 
     def api_for(req) -> KubeApi:
         return KubeApi(kube, req.user, mode=app.mode)

@@ -8,6 +8,7 @@ the creation form.
 from __future__ import annotations
 
 from service_account_auth_improvements_tpu.webapps.core import (
+    frontend_dirs,
     STATUS_PHASE,
     HttpError,
     WebApp,
@@ -44,7 +45,9 @@ def parse_tensorboard(tb: dict) -> dict:
 
 def build_app(kube, static_dir: str | None = None,
               mode: str | None = None) -> WebApp:
-    app = WebApp("tensorboards-web-app", static_dir=static_dir, mode=mode)
+    default_static, shared = frontend_dirs("tensorboards")
+    app = WebApp("tensorboards-web-app", static_dir=static_dir or default_static,
+                 mode=mode, shared_static_dir=shared)
 
     def api_for(req) -> KubeApi:
         return KubeApi(kube, req.user, mode=app.mode)

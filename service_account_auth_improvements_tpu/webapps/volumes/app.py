@@ -15,6 +15,7 @@ import re
 import yaml
 
 from service_account_auth_improvements_tpu.webapps.core import (
+    frontend_dirs,
     STATUS_PHASE,
     HttpError,
     WebApp,
@@ -123,7 +124,9 @@ def notebooks_using_pvc(pvc_name: str, notebooks: list) -> list[str]:
 
 def build_app(kube, static_dir: str | None = None,
               mode: str | None = None) -> WebApp:
-    app = WebApp("volumes-web-app", static_dir=static_dir, mode=mode)
+    default_static, shared = frontend_dirs("volumes")
+    app = WebApp("volumes-web-app", static_dir=static_dir or default_static,
+                 mode=mode, shared_static_dir=shared)
 
     def api_for(req) -> KubeApi:
         return KubeApi(kube, req.user, mode=app.mode)

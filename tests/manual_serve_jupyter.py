@@ -1,0 +1,32 @@
+"""Manual driver: jupyter web app on :5099 (dev mode, fake kube).
+
+Used for browser-based verification of the SPA (not collected by pytest).
+"""
+import socketserver
+import wsgiref.simple_server
+
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+    FakeKube,
+)
+from service_account_auth_improvements_tpu.webapps.jupyter.app import (
+    build_app,
+)
+
+
+class ThreadingWSGIServer(socketserver.ThreadingMixIn,
+                          wsgiref.simple_server.WSGIServer):
+    daemon_threads = True
+
+
+def main():
+    kube = FakeKube()
+    kube.create("namespaces", {"metadata": {"name": "team-a"}})
+    app = build_app(kube, mode="dev")
+    httpd = wsgiref.simple_server.make_server(
+        "127.0.0.1", 5099, app, server_class=ThreadingWSGIServer)
+    print("serving on http://127.0.0.1:5099", flush=True)
+    httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,150 @@
+"""Frontend serving + contract tests.
+
+The reference tests its SPAs with Angular unit tests and Cypress e2e
+against a dev-mode backend (SURVEY.md §4.4). The equivalents here:
+serve each checked-in SPA through its real backend (dev mode, fake kube)
+and assert (a) index/asset serving incl. the shared lib fallback and CSRF
+cookie, (b) every API path the JS calls exists on the backend router.
+"""
+
+import re
+from pathlib import Path
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+    FakeKube,
+)
+from service_account_auth_improvements_tpu.webapps.jupyter import (
+    app as jupyter_app,
+)
+from service_account_auth_improvements_tpu.webapps.volumes import (
+    app as volumes_app,
+)
+from service_account_auth_improvements_tpu.webapps.tensorboards import (
+    app as tensorboards_app,
+)
+
+FRONTENDS = Path(__file__).resolve().parent.parent / "frontends"
+
+APPS = {
+    "jupyter": jupyter_app.build_app,
+    "volumes": volumes_app.build_app,
+    "tensorboards": tensorboards_app.build_app,
+}
+
+
+def wsgi_get(app, path):
+    out = {}
+
+    def start_response(status, headers):
+        out["status"] = int(status.split()[0])
+        out["headers"] = headers
+
+    body = b"".join(app({
+        "REQUEST_METHOD": "GET", "PATH_INFO": path, "QUERY_STRING": "",
+        "wsgi.input": None,
+    }, start_response))
+    return out["status"], dict(out["headers"]), body
+
+
+@pytest.fixture
+def kube():
+    return FakeKube()
+
+
+@pytest.mark.parametrize("name", sorted(APPS))
+def test_index_served_with_csrf_cookie(kube, name):
+    app = APPS[name](kube, mode="dev")
+    status, headers, body = wsgi_get(app, "/")
+    assert status == 200
+    assert b"<!doctype html>" in body.lower()
+    assert "XSRF-TOKEN" in headers.get("Set-Cookie", "")
+    assert "no-cache" in headers.get("Cache-Control", "")
+
+
+@pytest.mark.parametrize("name", sorted(APPS))
+def test_shared_lib_served_via_common_fallback(kube, name):
+    app = APPS[name](kube, mode="dev")
+    for asset, ctype in (("/common/tpukf.js", "javascript"),
+                        ("/common/tpukf.css", "css")):
+        status, headers, body = wsgi_get(app, asset)
+        assert status == 200, f"{name}{asset}"
+        assert ctype in headers.get("Content-Type", "")
+        assert b"TpuKF" in body or b"--accent" in body
+        # unhashed assets must revalidate (stale SPA code breaks the
+        # API contract after upgrades)
+        assert headers.get("Cache-Control") == "no-cache"
+
+
+@pytest.mark.parametrize("name", sorted(APPS))
+def test_app_js_served(kube, name):
+    app = APPS[name](kube, mode="dev")
+    status, _, body = wsgi_get(app, "/app.js")
+    assert status == 200
+    assert b"window.TpuKF" in body
+
+
+def test_unknown_path_falls_back_to_index(kube):
+    app = APPS["jupyter"](kube, mode="dev")
+    status, _, body = wsgi_get(app, "/some/spa/route")
+    assert status == 200
+    assert b"<!doctype html>" in body.lower()
+
+
+def test_traversal_attempts_fall_back_to_index(kube):
+    app = APPS["jupyter"](kube, mode="dev")
+    status, _, body = wsgi_get(app, "/../../etc/passwd")
+    assert status == 200
+    assert b"root:" not in body
+
+
+# ------------------------------------------------------- JS/API contract
+
+API_CALL_RE = re.compile(
+    r'api\(\s*"(GET|POST|PATCH|DELETE)",\s*[`"]([^`"]+)[`"]'
+)
+
+
+def js_api_calls(app_name):
+    text = (FRONTENDS / app_name / "app.js").read_text()
+    for method, path in API_CALL_RE.findall(text):
+        # template params like ${ns} → route param placeholders
+        norm = re.sub(r"\$\{[^}]+\}", "x", path)
+        yield method, "/" + norm.lstrip("/")
+
+
+def routes_of(app):
+    return [(m, regex) for (m, regex, fn) in app._routes]
+
+
+@pytest.mark.parametrize("name", sorted(APPS))
+def test_every_js_api_call_has_a_backend_route(kube, name):
+    app = APPS[name](kube, mode="dev")
+    routes = routes_of(app)
+    calls = list(js_api_calls(name))
+    assert calls, f"{name}/app.js should call its API"
+    for method, path in calls:
+        assert any(m == method and regex.match(path)
+                   for m, regex in routes), (
+            f"{name}/app.js calls {method} {path} but no backend route "
+            "matches"
+        )
+
+
+def test_dashboard_js_calls_match_backend():
+    from service_account_auth_improvements_tpu.controlplane.kfam import (
+        KfamApp,
+    )
+    from service_account_auth_improvements_tpu.webapps.dashboard import (
+        build_app,
+    )
+
+    kube = FakeKube()
+    app = build_app(kube, KfamApp(kube), mode="dev")
+    routes = routes_of(app)
+    for method, path in js_api_calls("dashboard"):
+        assert any(m == method and regex.match(path)
+                   for m, regex in routes), (
+            f"dashboard/app.js calls {method} {path} with no backend route"
+        )
