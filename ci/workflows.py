@@ -1,0 +1,190 @@
+"""CI workflow builder.
+
+The reference generates its fleet CI programmatically (py/kubeflow/kubeflow/
+ci/workflow_utils.py:30 ArgoTestBuilder + per-component *_tests.py emitting
+Argo Workflows for Prow, prow_config.yaml:8-40). This is the same idea
+pointed at GitHub Actions: component descriptions → workflow YAML under
+``.github/workflows/``.
+
+Regenerate with ``python -m ci.workflows``; tests assert the checked-in
+YAML is current (the "generated files are clean" CI gate).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+WORKFLOWS = REPO / ".github" / "workflows"
+
+PY_TEST_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+CHECKOUT = {"name": "Checkout", "uses": "actions/checkout@v4"}
+SETUP_PY = {
+    "name": "Set up Python",
+    "uses": "actions/setup-python@v5",
+    "with": {"python-version": "3.12"},
+}
+INSTALL_DEPS = {
+    "name": "Install dependencies",
+    "run": "pip install 'jax[cpu]' flax optax pyyaml pytest",
+}
+
+
+def workflow(name: str, paths: list[str], jobs: dict) -> dict:
+    return {
+        "name": name,
+        "on": {
+            "pull_request": {"paths": paths, "branches": ["main"]},
+            "push": {"branches": ["main"]},
+        },
+        "concurrency": {
+            "group": "${{ github.workflow }}-${{ github.ref }}",
+            "cancel-in-progress": True,
+        },
+        "jobs": jobs,
+    }
+
+
+def job(steps: list[dict], env: dict | None = None) -> dict:
+    out: dict = {"runs-on": "ubuntu-latest", "steps": steps}
+    if env:
+        out["env"] = env
+    return out
+
+
+def kind_integration_steps(wait_selectors: list[str]) -> list[dict]:
+    """Build controlplane image → KinD → apply overlay → wait Ready →
+    fake-TPU smoke (the reference's per-controller KinD recipe,
+    nb_controller_intergration_test.yaml:18-64, with the GPU-less smoke
+    replaced by a fake google.com/tpu extended resource)."""
+    waits = "\n".join(
+        "kubectl wait pods -n kubeflow -l app=%s "
+        "--for=condition=Ready --timeout=300s" % sel
+        for sel in wait_selectors
+    )
+    return [
+        CHECKOUT,
+        {"name": "Build controlplane image",
+         "run": "make -C images/controlplane docker-build "
+                "REGISTRY=local TAG=it"},
+        {"name": "Install KinD",
+         "run": "./testing/gh-actions/install_kind.sh"},
+        {"name": "Create KinD cluster",
+         "run": "kind create cluster "
+                "--config testing/gh-actions/kind-config.yaml"},
+        {"name": "Load image",
+         "run": "kind load docker-image local/controlplane:it"},
+        {"name": "Install kustomize",
+         "run": "./testing/gh-actions/install_kustomize.sh"},
+        {"name": "Install cert-manager",
+         "run": "./testing/gh-actions/install_cert_manager.sh"},
+        {"name": "Apply manifests",
+         "run": "kustomize build manifests/overlays/kubeflow "
+                "| sed 's|ghcr.io/tpukf/controlplane:latest"
+                "|local/controlplane:it|g' "
+                "| kubectl apply -f -"},
+        {"name": "Wait for control plane", "run": waits},
+        {"name": "Fake TPU capacity on the node",
+         "run": "./testing/gh-actions/fake_tpu_node.sh"},
+        {"name": "Smoke: profile + TPU notebook",
+         "run": "kubectl apply -f testing/resources/user-profile.yaml\n"
+                "sleep 10\n"
+                "kubectl apply -f testing/resources/test-notebook.yaml\n"
+                "kubectl wait statefulset -n kf-ci-user test-notebook "
+                "--for=jsonpath='{.status.replicas}'=1 --timeout=300s"},
+    ]
+
+
+COMPONENT_WORKFLOWS: dict[str, dict] = {
+    "unit_tests.yaml": workflow(
+        "Unit Tests",
+        ["service_account_auth_improvements_tpu/**", "tests/**", "native/**"],
+        {"pytest": job(
+            [CHECKOUT, SETUP_PY, INSTALL_DEPS,
+             {"name": "Build native components", "run": "make -C native"},
+             {"name": "Run tests",
+              "run": "python -m pytest tests/ -x -q"}],
+            env=PY_TEST_ENV,
+        )},
+    ),
+    "manifests_validation.yaml": workflow(
+        "Manifests Validation",
+        ["manifests/**",
+         "service_account_auth_improvements_tpu/controlplane/kube/crdgen.py"],
+        {"kustomize": job([
+            CHECKOUT,
+            {"name": "Install kustomize",
+             "run": "./testing/gh-actions/install_kustomize.sh"},
+            {"name": "kustomize build",
+             "run": "kustomize build manifests/overlays/kubeflow "
+                    "> /dev/null"},
+        ]),
+         "generated-clean": job([
+            CHECKOUT, SETUP_PY,
+            {"name": "Install dependencies", "run": "pip install pyyaml"},
+            {"name": "CRDs are regenerated",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.kube.crdgen && git diff --exit-code "
+                    "manifests/crd"},
+            {"name": "Workflows are regenerated",
+             "run": "python -m ci.workflows && git diff --exit-code "
+                    ".github/workflows"},
+        ])},
+    ),
+    "controlplane_integration_test.yaml": workflow(
+        "Control Plane Integration Test",
+        ["service_account_auth_improvements_tpu/**", "manifests/**",
+         "images/controlplane/**", "testing/**"],
+        {"kind": job(kind_integration_steps(
+            ["notebook-controller", "profile-controller",
+             "jupyter-web-app", "centraldashboard"]
+        ))},
+    ),
+    "images_build_test.yaml": workflow(
+        "Workload Images Build",
+        ["images/**"],
+        {"build": job([
+            CHECKOUT,
+            {"name": "Setup Docker Buildx",
+             "uses": "docker/setup-buildx-action@v3"},
+            {"name": "Build image tree",
+             "run": "make -C images docker-build-all REGISTRY=local "
+                    "TAG=ci"},
+        ])},
+    ),
+    "bench_smoke.yaml": workflow(
+        "Bench Smoke (CPU)",
+        ["service_account_auth_improvements_tpu/**", "bench.py"],
+        {"bench": job(
+            [CHECKOUT, SETUP_PY, INSTALL_DEPS,
+             {"name": "Run bench on CPU",
+              "run": "SATPU_BENCH_CPU=1 python bench.py"}],
+        )},
+    ),
+}
+
+
+def render_all() -> dict[str, str]:
+    import yaml
+
+    out = {}
+    for name, wf in COMPONENT_WORKFLOWS.items():
+        text = yaml.safe_dump(wf, sort_keys=False, width=78)
+        # pyyaml quotes the 'on' key oddly sometimes; keep it plain
+        out[name] = "# generated by ci/workflows.py — do not edit\n" + text
+    return out
+
+
+def main() -> None:
+    WORKFLOWS.mkdir(parents=True, exist_ok=True)
+    for name, text in render_all().items():
+        (WORKFLOWS / name).write_text(text)
+        print(f"wrote {WORKFLOWS / name}")
+
+
+if __name__ == "__main__":
+    main()

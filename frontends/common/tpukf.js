@@ -121,14 +121,16 @@
   }
 
   function statusIcon(phase, message) {
-    // phases: ready | waiting | warning | error | stopped (reference
-    // status-icon component + jupyter apps/common/status.py)
+    // phases: ready | waiting | warning | error | stopped | unavailable |
+    // uninitialized | terminating (reference status-icon component +
+    // status.py helpers). Inline text is the short phase; the (often
+    // long) message lives in the tooltip.
     const span = document.createElement("span");
     span.className = `status ${phase}`;
     span.title = message || "";
     const dot = document.createElement("span");
     dot.className = "dot";
-    span.append(dot, document.createTextNode(message || phase));
+    span.append(dot, document.createTextNode(phase));
     return span;
   }
 

@@ -32,8 +32,12 @@
       api("GET", "api/workgroup/env-info"),
       api("GET", "api/dashboard-links").then((d) => d.links),
     ]);
-    if (!namespace && envInfo.namespaces.length) {
-      namespace = envInfo.namespaces[0].namespace;
+    const known = envInfo.namespaces.map((n) => n.namespace);
+    if (!known.includes(namespace)) {
+      // stored namespace may belong to a deleted profile — never keep a
+      // selection the header select cannot display
+      namespace = known[0] || "";
+      localStorage.setItem("tpukf.namespace", namespace);
     }
     renderHeader();
     renderSidebar();

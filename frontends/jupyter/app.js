@@ -36,7 +36,15 @@
     main.replaceChildren(container);
 
     async function refresh() {
-      const data = await api("GET", `api/namespaces/${ns}/notebooks`);
+      let data;
+      try {
+        data = await api("GET", `api/namespaces/${ns}/notebooks`);
+      } catch (e) {
+        // surface the failure in the card (403 vs empty list must be
+        // distinguishable); rethrow so the poller backs off
+        container.replaceChildren(el("div", { class: "muted" }, e.message));
+        throw e;
+      }
       const columns = [
         { title: "Status", render: (nb) =>
             statusIcon(nb.status.phase, nb.status.message) },

@@ -79,7 +79,13 @@
     main.replaceChildren(container);
 
     async function refresh() {
-      const data = await api("GET", `api/namespaces/${ns}/tensorboards`);
+      let data;
+      try {
+        data = await api("GET", `api/namespaces/${ns}/tensorboards`);
+      } catch (e) {
+        container.replaceChildren(el("div", { class: "muted" }, e.message));
+        throw e;
+      }
       const columns = [
         { title: "Status", render: (t) =>
             statusIcon(t.status.phase, t.status.message) },

@@ -64,7 +64,13 @@
     main.replaceChildren(container);
 
     async function refresh() {
-      const data = await api("GET", `api/namespaces/${ns}/pvcs`);
+      let data;
+      try {
+        data = await api("GET", `api/namespaces/${ns}/pvcs`);
+      } catch (e) {
+        container.replaceChildren(el("div", { class: "muted" }, e.message));
+        throw e;
+      }
       const columns = [
         { title: "Status", render: (p) =>
             statusIcon(p.status.phase, p.status.message) },

@@ -181,9 +181,15 @@ class WebApp:
                                {"result": out}),
                         })
                     return self._finish(resp, start_response)
-            if method == "GET" and self.static_dir:
+            # unmatched API paths must stay JSON 404s — falling through to
+            # the SPA index would hand HTML to the JS api() helper
+            if (method == "GET" and self.static_dir
+                    and not req_path.startswith("/api/")):
                 return self._finish(
-                    self._serve_static(req_path), start_response
+                    self._serve_static(
+                        req_path, environ.get("QUERY_STRING", "")
+                    ),
+                    start_response,
                 )
             raise HttpError(404, f"no route {method} {req_path}")
         except HttpError as e:
@@ -229,9 +235,13 @@ class WebApp:
             status=code,
         )
 
-    def _serve_static(self, path: str) -> Response:
-        """Hashed assets get long cache; everything else serves index.html
-        with a fresh CSRF cookie and no-cache (reference serving.py)."""
+    def _serve_static(self, path: str, query: str = "") -> Response:
+        """Hashed assets get long cache; the index serves with a fresh
+        CSRF cookie and no-cache (reference serving.py). Unknown deep
+        paths redirect to the app root RELATIVELY ("../.." style) so the
+        redirect lands correctly under any ingress prefix (/jupyter/...),
+        which the backend cannot see — the SPAs are hash-routed, so no
+        deep path is meaningful and relative assets would 404 as HTML."""
         rel = path.lstrip("/") or "index.html"
         full = self._safe_join(self.static_dir, rel)
         if (not (full and os.path.isfile(full))
@@ -251,6 +261,15 @@ class WebApp:
                                   os.path.basename(full))
                      else "no-cache")
             resp.headers.append(("Cache-Control", cache))
+            return resp
+        if rel != "index.html":
+            segments = [s for s in path.split("/") if s]
+            ups = len(segments) - (0 if path.endswith("/") else 1)
+            location = "../" * ups or "./"
+            if query:
+                location += "?" + query
+            resp = Response(b"", status=302, content_type="text/plain")
+            resp.headers.append(("Location", location))
             return resp
         index = os.path.join(self.static_dir, "index.html")
         if not os.path.isfile(index):

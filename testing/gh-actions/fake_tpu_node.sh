@@ -1,0 +1,22 @@
+#!/bin/bash
+# Grant every KinD node a fake google.com/tpu extended resource so TPU
+# notebooks schedule in CI (SURVEY.md §7: "use a fake google.com/tpu
+# device-plugin/extended-resource patch for integration tests").
+#
+# Extended resources are added through the status subresource.
+set -euo pipefail
+
+CHIPS="${CHIPS:-8}"
+
+for node in $(kubectl get nodes -o name); do
+  kubectl patch "${node}" --subresource=status --type=json -p "[
+    {\"op\": \"add\",
+     \"path\": \"/status/capacity/google.com~1tpu\",
+     \"value\": \"${CHIPS}\"},
+    {\"op\": \"add\",
+     \"path\": \"/status/allocatable/google.com~1tpu\",
+     \"value\": \"${CHIPS}\"}
+  ]"
+done
+kubectl get nodes -o \
+  custom-columns='NAME:.metadata.name,TPU:.status.allocatable.google\.com/tpu'

@@ -1,0 +1,9 @@
+#!/bin/bash
+# Install cert-manager (webhook serving certs; manifests/webhook).
+set -euo pipefail
+
+CERT_MANAGER_VERSION="${CERT_MANAGER_VERSION:-v1.15.1}"
+kubectl apply -f \
+  "https://github.com/cert-manager/cert-manager/releases/download/${CERT_MANAGER_VERSION}/cert-manager.yaml"
+kubectl -n cert-manager wait deploy --all --for=condition=Available \
+  --timeout=300s

@@ -1,0 +1,10 @@
+#!/bin/bash
+# Install a minimal Istio for the routing layer the controllers target.
+set -euo pipefail
+
+ISTIO_VERSION="${ISTIO_VERSION:-1.22.1}"
+curl -fsSL https://istio.io/downloadIstio | \
+  ISTIO_VERSION="${ISTIO_VERSION}" TARGET_ARCH=x86_64 sh -
+"istio-${ISTIO_VERSION}/bin/istioctl" install -y --set profile=minimal
+kubectl -n istio-system wait deploy/istiod --for=condition=Available \
+  --timeout=300s

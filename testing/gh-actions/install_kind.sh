@@ -1,0 +1,10 @@
+#!/bin/bash
+# Install KinD (reference: components/testing/gh-actions/install_kind.sh).
+set -euo pipefail
+
+KIND_VERSION="${KIND_VERSION:-v0.23.0}"
+curl -fsSLo ./kind \
+  "https://kind.sigs.k8s.io/dl/${KIND_VERSION}/kind-linux-amd64"
+chmod +x ./kind
+sudo mv ./kind /usr/local/bin/kind
+kind version

@@ -1,0 +1,11 @@
+#!/bin/bash
+# Install kustomize.
+set -euo pipefail
+
+KUSTOMIZE_VERSION="${KUSTOMIZE_VERSION:-5.4.2}"
+curl -fsSL \
+  "https://github.com/kubernetes-sigs/kustomize/releases/download/kustomize%2Fv${KUSTOMIZE_VERSION}/kustomize_v${KUSTOMIZE_VERSION}_linux_amd64.tar.gz" \
+  | tar xz
+chmod +x kustomize
+sudo mv kustomize /usr/local/bin/kustomize
+kustomize version

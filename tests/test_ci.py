@@ -1,0 +1,93 @@
+"""CI infrastructure checks (ci/ + .github/workflows + testing/).
+
+Mirrors the reference's guarantees: generated workflows are current
+(its Prow config pins generated Argo workflows), harness scripts are
+executable and syntactically valid, smoke resources target our CRDs.
+"""
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+import yaml
+
+from ci import workflows
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_checked_in_workflows_match_generator():
+    for name, text in workflows.render_all().items():
+        on_disk = (REPO / ".github" / "workflows" / name).read_text()
+        assert on_disk == text, (
+            f"{name} is stale — regenerate with python -m ci.workflows"
+        )
+
+
+def test_workflows_are_valid_yaml_with_jobs():
+    for f in (REPO / ".github" / "workflows").glob("*.yaml"):
+        wf = yaml.safe_load(f.read_text())
+        assert wf.get("jobs"), f
+        for jname, j in wf["jobs"].items():
+            assert j.get("steps"), f"{f}:{jname}"
+            assert j.get("runs-on"), f"{f}:{jname}"
+
+
+def test_harness_scripts_executable_and_valid():
+    scripts = sorted((REPO / "testing" / "gh-actions").glob("*.sh"))
+    assert len(scripts) >= 5
+    for s in scripts:
+        assert os.stat(s).st_mode & stat.S_IXUSR, f"{s} not executable"
+        subprocess.run(["bash", "-n", str(s)], check=True)
+        text = s.read_text()
+        assert text.startswith("#!/bin/bash")
+        assert "set -euo pipefail" in text, f"{s} must fail fast"
+
+
+def test_workflow_referenced_scripts_exist():
+    for name, text in workflows.render_all().items():
+        for line in text.splitlines():
+            for token in line.split():
+                if token.startswith("./testing/"):
+                    assert (REPO / token[2:]).exists(), (
+                        f"{name} references missing {token}"
+                    )
+
+
+def test_smoke_resources_use_our_crds_and_tpu():
+    nb = yaml.safe_load(
+        (REPO / "testing" / "resources" / "test-notebook.yaml").read_text()
+    )
+    assert nb["apiVersion"] == "tpukf.dev/v1beta1"
+    assert nb["spec"]["tpu"] == {"generation": "v5e", "topology": "1x1"}
+    prof = yaml.safe_load(
+        (REPO / "testing" / "resources" / "user-profile.yaml").read_text()
+    )
+    assert prof["apiVersion"] == "tpukf.dev/v1"
+    quota = prof["spec"]["resourceQuotaSpec"]["hard"]
+    assert "requests.google.com/tpu" in quota
+    # the smoke notebook must fit the profile quota
+    assert int(quota["requests.google.com/tpu"]) >= 1
+
+
+def test_smoke_notebook_resolves_on_the_control_plane():
+    """The CI smoke CR must round-trip through the real TPU resolver."""
+    from service_account_auth_improvements_tpu.controlplane import tpu
+
+    nb = yaml.safe_load(
+        (REPO / "testing" / "resources" / "test-notebook.yaml").read_text()
+    )
+    resolved = tpu.resolve(nb["spec"]["tpu"])
+    assert resolved.total_chips == 1
+    assert resolved.selector["cloud.google.com/gke-tpu-accelerator"] == \
+        "tpu-v5-lite-podslice"
+    # matches the labels kind-config.yaml puts on the node
+    kind_cfg = yaml.safe_load(
+        (REPO / "testing" / "gh-actions" / "kind-config.yaml").read_text()
+    )
+    node_labels = kind_cfg["nodes"][0]["labels"]
+    for key, value in resolved.selector.items():
+        assert node_labels.get(key) == value, (
+            f"KinD node label {key} must match what the controller emits"
+        )

@@ -85,17 +85,33 @@ def test_app_js_served(kube, name):
     assert b"window.TpuKF" in body
 
 
-def test_unknown_path_falls_back_to_index(kube):
+def test_unknown_deep_path_redirects_to_app_root_relatively(kube):
+    # deep links can't serve index (relative assets would 404 as HTML)
+    # and the backend can't see the ingress prefix, so it must redirect
+    # RELATIVELY to the app root
     app = APPS["jupyter"](kube, mode="dev")
-    status, _, body = wsgi_get(app, "/some/spa/route")
-    assert status == 200
-    assert b"<!doctype html>" in body.lower()
+    status, headers, _ = wsgi_get(app, "/some/spa/route")
+    assert status == 302
+    # browser at <prefix>/some/spa/route resolves ../../ → <prefix>/
+    assert headers["Location"] == "../../"
+    status, headers, _ = wsgi_get(app, "/new")
+    assert status == 302
+    assert headers["Location"] == "./"
 
 
-def test_traversal_attempts_fall_back_to_index(kube):
+def test_unknown_api_path_stays_json_404(kube):
+    # /api/* must never fall through to the SPA (the JS api() helper
+    # would mistake HTML for an empty success)
+    app = APPS["jupyter"](kube, mode="dev")
+    status, headers, body = wsgi_get(app, "/api/activities/")
+    assert status == 404
+    assert "application/json" in headers.get("Content-Type", "")
+
+
+def test_traversal_attempts_do_not_leak(kube):
     app = APPS["jupyter"](kube, mode="dev")
     status, _, body = wsgi_get(app, "/../../etc/passwd")
-    assert status == 200
+    assert status == 302
     assert b"root:" not in body
 
 

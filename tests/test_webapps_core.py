@@ -137,17 +137,20 @@ def test_static_index_sets_csrf_cookie(tmp_path):
     # Hashed asset: long cache, no cookie.
     out = call(app, "GET", "/main.abc123.js", headers=AUTH)
     assert "max-age=31536000" in out["headers"]["Cache-Control"]
-    # SPA fallback: unknown path serves index.
+    # SPA fallback: unknown deep paths redirect relatively to the app
+    # root (hash-routed SPAs; relative assets would 404 under a prefix).
     out = call(app, "GET", "/some/route", headers=AUTH)
-    assert b"spa" in out["body"]
+    assert out["code"] == 302
+    assert out["headers"]["Location"] == "../"
 
 
 def test_static_path_traversal_blocked(tmp_path):
     (tmp_path / "index.html").write_text("<html>spa</html>")
     app = WebApp("test", static_dir=str(tmp_path), mode="prod")
     out = call(app, "GET", "/../../etc/passwd", headers=AUTH)
-    # Must not leak the file: falls back to index.
-    assert b"spa" in out["body"] or out["code"] == 404
+    # Must not leak the file: redirects away.
+    assert out["code"] == 302
+    assert b"root:" not in out["body"]
 
 
 # ---------------------------------------------------------------- KubeApi
