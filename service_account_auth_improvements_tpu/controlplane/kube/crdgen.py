@@ -94,7 +94,19 @@ CRDS: list[dict] = [
         "plural": "notebooks",
         "singular": "notebook",
         "scope": "Namespaced",
+        # three served versions, v1beta1 hub + storage, converted by the
+        # webhook's /convert endpoint (kube/notebook_versions.py; the
+        # reference's api/{v1alpha1,v1beta1,v1} hub-and-spoke)
+        "conversion": True,
         "versions": [
+            {
+                # pre-TPU spoke: no spec.tpu
+                "name": "v1alpha1",
+                "served": True,
+                "storage": False,
+                "spec": _obj({"template": _preserve("pod template")}),
+                "status": NOTEBOOK_STATUS,
+            },
             {
                 "name": "v1beta1",
                 "served": True,
@@ -107,6 +119,15 @@ CRDS: list[dict] = [
                     {"name": "TPU", "type": "string",
                      "jsonPath": ".spec.tpu.generation"},
                 ],
+            },
+            {
+                # conditions carry fewer fields (enforced by conversion,
+                # notebook_versions.py; schema-wise identical)
+                "name": "v1",
+                "served": True,
+                "storage": False,
+                "spec": NOTEBOOK_SPEC,
+                "status": NOTEBOOK_STATUS,
             },
         ],
     },
@@ -261,21 +282,42 @@ def build_crd(spec: dict) -> dict:
         if v.get("printercolumns"):
             version["additionalPrinterColumns"] = v["printercolumns"]
         versions.append(version)
+    crd_spec: dict = {
+        "group": GROUP,
+        "scope": spec["scope"],
+        "names": {
+            "kind": spec["kind"],
+            "listKind": f"{spec['kind']}List",
+            "plural": spec["plural"],
+            "singular": spec["singular"],
+        },
+        "versions": versions,
+    }
+    metadata: dict = {"name": f"{spec['plural']}.{GROUP}"}
+    if spec.get("conversion"):
+        # the conversion webhook and its cert-manager CA injection are
+        # one mechanism (pairs with manifests/webhook/webhookconfig.yaml)
+        crd_spec["conversion"] = {
+            "strategy": "Webhook",
+            "webhook": {
+                "conversionReviewVersions": ["v1"],
+                "clientConfig": {
+                    "service": {
+                        "name": "admission-webhook",
+                        "namespace": "kubeflow",
+                        "path": "/convert",
+                    },
+                },
+            },
+        }
+        metadata["annotations"] = {
+            "cert-manager.io/inject-ca-from": "kubeflow/admission-webhook-tls",
+        }
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
-        "metadata": {"name": f"{spec['plural']}.{GROUP}"},
-        "spec": {
-            "group": GROUP,
-            "scope": spec["scope"],
-            "names": {
-                "kind": spec["kind"],
-                "listKind": f"{spec['kind']}List",
-                "plural": spec["plural"],
-                "singular": spec["singular"],
-            },
-            "versions": versions,
-        },
+        "metadata": metadata,
+        "spec": crd_spec,
     }
 
 

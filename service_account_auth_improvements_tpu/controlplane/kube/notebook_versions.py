@@ -1,0 +1,138 @@
+"""Notebook API versions: hub-and-spoke conversion.
+
+The reference serves three Notebook versions with v1beta1 as the hub
+(notebook-controller/api/{v1alpha1,v1beta1,v1}; ConvertTo/ConvertFrom in
+api/v1/notebook_conversion.go and api/v1alpha1/notebook_conversion.go).
+Same model here, on dict-shaped objects:
+
+- ``v1beta1`` — hub + storage version. Full surface: ``spec.template``,
+  ``spec.tpu``, rich conditions.
+- ``v1`` — conditions carry only {type, lastProbeTime, reason, message}
+  (the reference's v1 conversion copies exactly those fields).
+- ``v1alpha1`` — predates the TPU block: ``spec.tpu`` is dropped on
+  conversion from the hub (the moral equivalent of the reference's
+  spoke versions lacking newer fields).
+
+The conversion endpoint (webhook/server.py ``/convert``) lets the
+apiserver serve every version from v1beta1 storage.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+
+from .registry import GROUP
+
+HUB = "v1beta1"
+VERSIONS = ("v1alpha1", "v1beta1", "v1")
+
+# Conversion webhooks MUST round-trip: a narrower spoke cannot carry the
+# hub-only fields, so they ride along in this annotation and are restored
+# on the way back (the standard stash pattern; without it a GET-modify-PUT
+# through v1alpha1 would silently delete spec.tpu from storage).
+STASH_ANNOTATION = f"notebooks.{GROUP}/conversion-stash"
+
+_V1_CONDITION_FIELDS = ("type", "lastProbeTime", "reason", "message")
+
+
+def _set_stash(obj: dict, stash: dict) -> None:
+    annotations = obj.setdefault("metadata", {}).setdefault(
+        "annotations", {}
+    )
+    annotations[STASH_ANNOTATION] = json.dumps(stash, sort_keys=True)
+
+
+def _pop_stash(obj: dict) -> dict:
+    annotations = (obj.get("metadata") or {}).get("annotations") or {}
+    raw = annotations.pop(STASH_ANNOTATION, None)
+    if not raw:
+        return {}
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return {}
+
+
+def to_hub(obj: dict) -> dict:
+    """Spoke (or hub) Notebook → hub (v1beta1), restoring stashed
+    hub-only fields."""
+    version = obj.get("apiVersion", "").rpartition("/")[2]
+    if version not in VERSIONS:
+        raise ValueError(f"unknown Notebook version {version!r}")
+    out = copy.deepcopy(obj)
+    out["apiVersion"] = f"{GROUP}/{HUB}"
+    stash = _pop_stash(out)
+    if "tpu" in stash and "tpu" not in (out.get("spec") or {}):
+        out.setdefault("spec", {})["tpu"] = stash["tpu"]
+    if "conditions" in stash and "status" in out:
+        # merge per index while the condition types still line up; a
+        # client that rewrote the list wins over the stash
+        stashed = stash["conditions"]
+        merged = []
+        for i, cond in enumerate(out["status"].get("conditions") or []):
+            if (i < len(stashed)
+                    and stashed[i].get("type") == cond.get("type")):
+                merged.append({**stashed[i], **cond})
+            else:
+                merged.append(cond)
+        out["status"]["conditions"] = merged
+    return out
+
+
+def from_hub(obj: dict, target: str) -> dict:
+    """Hub Notebook → ``target`` version. Narrower spokes stash what
+    they drop (mirroring the reference's lossy ConvertFrom, plus the
+    round-trip guarantee the apiserver requires)."""
+    if target not in VERSIONS:
+        raise ValueError(f"unknown Notebook version {target!r}")
+    out = copy.deepcopy(obj)
+    out["apiVersion"] = f"{GROUP}/{target}"
+    stash: dict = {}
+    if target == "v1" and "status" in out:
+        conditions = out["status"].get("conditions") or []
+        if any(set(c) - set(_V1_CONDITION_FIELDS) for c in conditions):
+            stash["conditions"] = copy.deepcopy(conditions)
+        out["status"]["conditions"] = [
+            {k: c[k] for k in _V1_CONDITION_FIELDS if k in c}
+            for c in conditions
+        ]
+    if target == "v1alpha1":
+        tpu = (out.get("spec") or {}).pop("tpu", None)
+        if tpu is not None:
+            stash["tpu"] = tpu
+    if stash:
+        _set_stash(out, stash)
+    return out
+
+
+def convert(obj: dict, target: str) -> dict:
+    """Any served version → any served version, through the hub."""
+    return from_hub(to_hub(obj), target)
+
+
+def convert_review(review: dict) -> dict:
+    """Handle an apiextensions ``ConversionReview`` (the payload the
+    apiserver POSTs to the CRD conversion webhook; strategy: Webhook in
+    the CRD spec — reference equivalent: controller-runtime's conversion
+    webhook registered in main.go via SetupWebhookWithManager)."""
+    request = review.get("request") or {}
+    desired = request.get("desiredAPIVersion", "")
+    target = desired.rpartition("/")[2]
+    converted, result = [], {"status": "Success"}
+    try:
+        for obj in request.get("objects") or []:
+            converted.append(convert(obj, target))
+    except (ValueError, KeyError) as e:
+        converted = []
+        result = {"status": "Failed", "message": str(e)}
+    return {
+        "apiVersion": review.get("apiVersion",
+                                 "apiextensions.k8s.io/v1"),
+        "kind": "ConversionReview",
+        "response": {
+            "uid": request.get("uid", ""),
+            "convertedObjects": converted,
+            "result": result,
+        },
+    }

@@ -26,6 +26,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from service_account_auth_improvements_tpu.controlplane.kube.fake import (
     match_selector,
 )
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    notebook_versions,
+)
 from service_account_auth_improvements_tpu.webhook import engine
 
 log = logging.getLogger(__name__)
@@ -121,16 +124,11 @@ def make_server(kube, port: int = 8443, certfile: str | None = None,
             self.end_headers()
             self.wfile.write(body)
 
-        def do_POST(self):
-            if not self.path.startswith("/apply-poddefault"):
-                self.send_response(404)
-                self.end_headers()
-                return
+        def _handle_json(self, fn):
             length = int(self.headers.get("Content-Length") or 0)
             try:
                 review = json.loads(self.rfile.read(length))
-                out = review_response(review, list_poddefaults)
-                payload = json.dumps(out).encode()
+                payload = json.dumps(fn(review)).encode()
                 self.send_response(200)
             except Exception as e:
                 payload = json.dumps({"error": str(e)}).encode()
@@ -139,6 +137,20 @@ def make_server(kube, port: int = 8443, certfile: str | None = None,
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+        def do_POST(self):
+            if self.path.startswith("/convert"):
+                # CRD conversion webhook (Notebook hub-and-spoke,
+                # kube/notebook_versions.py)
+                self._handle_json(notebook_versions.convert_review)
+            elif self.path.startswith("/apply-poddefault"):
+                self._handle_json(
+                    lambda review: review_response(review,
+                                                   list_poddefaults)
+                )
+            else:
+                self.send_response(404)
+                self.end_headers()
 
     server = ThreadingHTTPServer((host, port), Handler)
     if certfile:

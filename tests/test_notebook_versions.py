@@ -1,0 +1,195 @@
+"""Notebook hub-and-spoke conversion tests (kube/notebook_versions.py).
+
+Mirrors the reference's conversion contract (api/v1/notebook_conversion.go,
+api/v1alpha1/notebook_conversion.go): spokes round-trip through the
+v1beta1 hub, narrower spokes drop fields, and the ConversionReview
+endpoint speaks the apiextensions protocol.
+"""
+
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    notebook_versions as nv,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.registry import (
+    GROUP,
+)
+
+
+def hub_notebook():
+    return {
+        "apiVersion": f"{GROUP}/v1beta1",
+        "kind": "Notebook",
+        "metadata": {"name": "nb", "namespace": "ns"},
+        "spec": {
+            "template": {"spec": {"containers": [{"name": "nb",
+                                                  "image": "img"}]}},
+            "tpu": {"generation": "v5e", "topology": "2x4"},
+        },
+        "status": {
+            "readyReplicas": 1,
+            "containerState": {"running": {"startedAt": "t0"}},
+            "conditions": [{
+                "type": "Running", "status": "True",
+                "lastProbeTime": "t1", "lastTransitionTime": "t2",
+                "reason": "started", "message": "ok",
+            }],
+        },
+    }
+
+
+def test_hub_to_v1_strips_condition_fields():
+    out = nv.convert(hub_notebook(), "v1")
+    assert out["apiVersion"] == f"{GROUP}/v1"
+    cond = out["status"]["conditions"][0]
+    assert cond == {"type": "Running", "lastProbeTime": "t1",
+                    "reason": "started", "message": "ok"}
+    # spec is untouched (v1 has the full spec surface)
+    assert out["spec"]["tpu"]["generation"] == "v5e"
+
+
+def test_hub_to_v1alpha1_drops_tpu():
+    out = nv.convert(hub_notebook(), "v1alpha1")
+    assert "tpu" not in out["spec"]
+    assert out["spec"]["template"]["spec"]["containers"]
+
+
+def test_spoke_to_hub_is_identity_shaped():
+    v1 = nv.convert(hub_notebook(), "v1")
+    back = nv.convert(v1, "v1beta1")
+    assert back["apiVersion"] == f"{GROUP}/v1beta1"
+    assert back["spec"] == hub_notebook()["spec"]
+
+
+def test_round_trip_through_v1alpha1_preserves_tpu():
+    # a GET-modify-PUT through the narrow spoke must not lose spec.tpu
+    # (apiserver round-trip requirement; stash annotation)
+    spoke = nv.convert(hub_notebook(), "v1alpha1")
+    assert "tpu" not in spoke["spec"]
+    assert nv.STASH_ANNOTATION in spoke["metadata"]["annotations"]
+    back = nv.convert(spoke, "v1beta1")
+    assert back["spec"]["tpu"] == {"generation": "v5e", "topology": "2x4"}
+    # the stash does not leak into the restored hub object
+    assert nv.STASH_ANNOTATION not in back["metadata"]["annotations"]
+
+
+def test_round_trip_through_v1_preserves_condition_fields():
+    spoke = nv.convert(hub_notebook(), "v1")
+    back = nv.convert(spoke, "v1beta1")
+    cond = back["status"]["conditions"][0]
+    assert cond["status"] == "True"
+    assert cond["lastTransitionTime"] == "t2"
+    # spoke-side edits win over the stash
+    spoke2 = nv.convert(hub_notebook(), "v1")
+    spoke2["status"]["conditions"][0]["message"] = "edited"
+    back2 = nv.convert(spoke2, "v1beta1")
+    assert back2["status"]["conditions"][0]["message"] == "edited"
+    assert back2["status"]["conditions"][0]["status"] == "True"
+
+
+def test_rewritten_condition_list_drops_stale_stash():
+    spoke = nv.convert(hub_notebook(), "v1")
+    spoke["status"]["conditions"] = [{"type": "Waiting",
+                                      "reason": "restarted"}]
+    back = nv.convert(spoke, "v1beta1")
+    assert back["status"]["conditions"] == [{"type": "Waiting",
+                                             "reason": "restarted"}]
+
+
+def test_conversion_does_not_mutate_input():
+    nb = hub_notebook()
+    snapshot = json.loads(json.dumps(nb))
+    nv.convert(nb, "v1alpha1")
+    assert nb == snapshot
+
+
+def test_unknown_version_rejected():
+    with pytest.raises(ValueError):
+        nv.convert(hub_notebook(), "v2")
+    bad = hub_notebook()
+    bad["apiVersion"] = f"{GROUP}/v9"
+    with pytest.raises(ValueError):
+        nv.to_hub(bad)
+
+
+def test_convert_review_success():
+    review = {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "ConversionReview",
+        "request": {
+            "uid": "u1",
+            "desiredAPIVersion": f"{GROUP}/v1alpha1",
+            "objects": [hub_notebook(), hub_notebook()],
+        },
+    }
+    out = nv.convert_review(review)
+    resp = out["response"]
+    assert resp["uid"] == "u1"
+    assert resp["result"]["status"] == "Success"
+    assert len(resp["convertedObjects"]) == 2
+    assert all("tpu" not in o["spec"] for o in resp["convertedObjects"])
+
+
+def test_convert_review_failure():
+    review = {"request": {"uid": "u2",
+                          "desiredAPIVersion": f"{GROUP}/v99",
+                          "objects": [hub_notebook()]}}
+    out = nv.convert_review(review)
+    assert out["response"]["result"]["status"] == "Failed"
+    assert out["response"]["convertedObjects"] == []
+    assert out["response"]["uid"] == "u2"
+
+
+def test_webhook_serves_convert_endpoint():
+    import urllib.request
+
+    from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+        FakeKube,
+    )
+    from service_account_auth_improvements_tpu.webhook.server import (
+        serve_background,
+    )
+
+    server = serve_background(FakeKube(), port=0, host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        review = {
+            "request": {
+                "uid": "u3",
+                "desiredAPIVersion": f"{GROUP}/v1",
+                "objects": [hub_notebook()],
+            },
+        }
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/convert",
+            data=json.dumps(review).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out["kind"] == "ConversionReview"
+        cond = out["response"]["convertedObjects"][0]["status"][
+            "conditions"][0]
+        assert "status" not in cond
+    finally:
+        server.shutdown()
+
+
+def test_crd_registers_conversion_webhook():
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        crdgen,
+    )
+
+    crd = crdgen.build_crd(
+        next(s for s in crdgen.CRDS if s["kind"] == "Notebook")
+    )
+    conv = crd["spec"]["conversion"]
+    assert conv["strategy"] == "Webhook"
+    assert conv["webhook"]["clientConfig"]["service"]["path"] == "/convert"
+    versions = {v["name"]: v for v in crd["spec"]["versions"]}
+    assert set(versions) == set(nv.VERSIONS)
+    assert versions["v1beta1"]["storage"] is True
+    assert not versions["v1"]["storage"]
+    assert not versions["v1alpha1"]["storage"]
