@@ -1,0 +1,144 @@
+"""Service-account → IAP OIDC token helper.
+
+The utility that gives the reference repo its name (reference: root
+auth.py:17-63 get_service_account_token + docs/gke/iap_request.py):
+programmatic access to an IAP-protected Kubeflow endpoint using a GCP
+service account identity.
+
+Re-designed stdlib-first for the environments this framework actually
+runs in:
+
+1. **Metadata server** (GKE/GCE — incl. every TPU node pool): the
+   instance identity endpoint mints the audience-bound OIDC token
+   directly; no crypto, no extra deps.
+2. **Service-account key file** (`GOOGLE_APPLICATION_CREDENTIALS`):
+   needs RS256, so this path defers to `google-auth` when it is
+   installed and fails with a clear message when it is not. An
+   explicitly configured key file takes precedence over the metadata
+   server.
+
+Usage:
+    python auth.py <iap-client-id> [url]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+
+METADATA_IDENTITY_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "service-accounts/default/identity"
+)
+METADATA_EMAIL_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/"
+    "service-accounts/default/email"
+)
+
+
+class AuthError(RuntimeError):
+    pass
+
+
+def _metadata_get(url: str, timeout: float = 3.0) -> str:
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+def token_from_metadata_server(audience: str) -> tuple[str, str]:
+    """(id_token, service_account_email) via the GCE/GKE metadata server.
+
+    The recommended path on GKE: the metadata server signs the identity
+    token for us, bound to the IAP client id as audience.
+    """
+    query = urllib.parse.urlencode({"audience": audience, "format": "full"})
+    try:
+        token = _metadata_get(f"{METADATA_IDENTITY_URL}?{query}")
+        email = _metadata_get(METADATA_EMAIL_URL)
+    except (urllib.error.URLError, OSError) as e:
+        raise AuthError(f"metadata server unreachable: {e}")
+    return token, email
+
+
+def token_from_key_file(audience: str, key_path: str) -> tuple[str, str]:
+    """(id_token, email) from a service-account key file.
+
+    RS256 signing requires google-auth; kept optional so the metadata
+    path stays dependency-free (reference auth.py:28-35 builds the same
+    target_audience claim through google.oauth2.service_account).
+    """
+    try:
+        from google.auth.transport.requests import Request
+        from google.oauth2 import service_account
+    except ImportError:
+        raise AuthError(
+            "key-file flow needs the google-auth package; on GKE prefer "
+            "the metadata-server flow (no extra dependencies)"
+        )
+    creds = service_account.IDTokenCredentials.from_service_account_file(
+        key_path, target_audience=audience
+    )
+    creds.refresh(Request())
+    return creds.token, creds.service_account_email
+
+
+def get_service_account_token(client_id: str) -> tuple[str, str]:
+    """(open-id-connect token, signer email) for the ambient service
+    account. An explicit ``GOOGLE_APPLICATION_CREDENTIALS`` key file
+    wins; otherwise the metadata server is used (reference
+    get_service_account_token, auth.py:17)."""
+    key_path = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS", "")
+    if key_path and os.path.exists(key_path):
+        return token_from_key_file(client_id, key_path)
+    return token_from_metadata_server(client_id)
+
+
+def make_iap_request(url: str, token: str, data: dict | None = None,
+                     timeout: float = 30.0) -> str:
+    """GET/POST ``url`` through IAP with the OIDC bearer token
+    (reference make_request, auth.py:80)."""
+    body = json.dumps(data).encode() if data is not None else None
+    req = urllib.request.Request(
+        url,
+        data=body,
+        headers={
+            "Authorization": f"Bearer {token}",
+            **({"Content-Type": "application/json"} if body else {}),
+        },
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode()
+    except urllib.error.HTTPError as e:
+        raise AuthError(f"IAP request failed: {e.code} {e.reason}")
+    except urllib.error.URLError as e:
+        raise AuthError(f"IAP request failed: {e.reason}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("client_id", help="IAP OAuth client id (audience)")
+    parser.add_argument("url", nargs="?",
+                        help="optional IAP-protected URL to request")
+    args = parser.parse_args(argv)
+    try:
+        token, email = get_service_account_token(args.client_id)
+        print(f"# identity: {email}", file=sys.stderr)
+        if args.url:
+            print(make_iap_request(args.url, token))
+        else:
+            print(token)
+    except AuthError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

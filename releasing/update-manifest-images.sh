@@ -1,0 +1,15 @@
+#!/bin/bash
+# Pin every image reference in manifests/ to a release tag (analog of the
+# reference's releasing/update-manifests-images).
+#
+# Usage: releasing/update-manifest-images.sh v0.1.0
+set -euo pipefail
+
+TAG="${1:?usage: update-manifest-images.sh <tag>}"
+REPO_ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+grep -rl 'ghcr.io/tpukf/' "${REPO_ROOT}/manifests" | while read -r f; do
+  sed -i -E "s|(ghcr\.io/tpukf/[a-z0-9-]+):[A-Za-z0-9_.-]+|\1:${TAG}|g" "$f"
+done
+echo "pinned manifests to ${TAG}"
+git -C "${REPO_ROOT}" diff --stat -- manifests
