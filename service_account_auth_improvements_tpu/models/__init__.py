@@ -1,9 +1,13 @@
 """Model zoo for the TPU workload layer.
 
-Flagship: Llama-3 family (``llama.py``) — the BASELINE.md north-star workload
-(Llama-3-8B SPMD fine-tune at >=35% MFU). ResNet-50 (pmap config #3 in
-BASELINE.json) and an MNIST MLP (CPU smoke config #1) land with the
-model-zoo milestone.
+Flagship: Llama-3 family (``llama.py``) — the BASELINE.md north-star
+workload (Llama-3-8B SPMD fine-tune at >=35% MFU). ``resnet.py`` covers
+the data-parallel vision config (#3 in BASELINE.json, ResNet-50 on a
+v5e-8 slice) and ``mnist.py`` the CPU/1-chip smoke configs (#1/#2).
 """
 
-from service_account_auth_improvements_tpu.models import llama  # noqa: F401
+from service_account_auth_improvements_tpu.models import (  # noqa: F401
+    llama,
+    mnist,
+    resnet,
+)

@@ -1,0 +1,97 @@
+"""MNIST MLP — the CPU smoke workload (BASELINE.json config #1).
+
+The smallest end-to-end proof that a notebook launched by the control
+plane can train: pure-functional params, one ``pjit``-able step with the
+batch sharded over the ``dp`` axis. Runs identically on CPU devices
+(KinD CI) and a single TPU chip (config #2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistConfig:
+    in_dim: int = 784
+    hidden_dim: int = 256
+    num_classes: int = 10
+    num_layers: int = 2
+
+    def param_count(self) -> int:
+        dims = self._dims()
+        return sum((a + 1) * b for a, b in zip(dims[:-1], dims[1:]))
+
+    def _dims(self) -> list[int]:
+        return ([self.in_dim]
+                + [self.hidden_dim] * (self.num_layers - 1)
+                + [self.num_classes])
+
+
+def init(cfg: MnistConfig, key: jax.Array) -> dict:
+    dims = cfg._dims()
+    params = {}
+    for i, (a, b) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params[f"w{i}"] = (
+            jax.random.normal(sub, (a, b), jnp.float32)
+            * jnp.sqrt(2.0 / a)
+        )
+        params[f"b{i}"] = jnp.zeros((b,), jnp.float32)
+    return params
+
+
+def apply(cfg: MnistConfig, params: dict, x: jax.Array) -> jax.Array:
+    """(batch, 784) images → (batch, 10) logits. bfloat16 on the MXU,
+    float32 accumulation at the head."""
+    h = x.astype(jnp.bfloat16)
+    n = cfg.num_layers
+    for i in range(n):
+        w = params[f"w{i}"].astype(jnp.bfloat16)
+        h = h @ w + params[f"b{i}"].astype(jnp.bfloat16)
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h.astype(jnp.float32)
+
+
+def loss_fn(cfg: MnistConfig, params: dict, x: jax.Array,
+            labels: jax.Array) -> jax.Array:
+    logits = apply(cfg, params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(
+        jnp.take_along_axis(logp, labels[:, None], axis=1)
+    )
+
+
+def accuracy(cfg: MnistConfig, params: dict, x: jax.Array,
+             labels: jax.Array) -> jax.Array:
+    return jnp.mean(jnp.argmax(apply(cfg, params, x), axis=-1) == labels)
+
+
+def make_sgd_step(cfg: MnistConfig, lr: float = 0.1, mesh=None):
+    """One fused train step; with a mesh, the batch shards over ``dp``
+    and XLA inserts the gradient all-reduce."""
+
+    def step(params, x, labels):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cfg, p, x, labels)
+        )(params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads
+        )
+        return new_params, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch = NamedSharding(mesh, P("dp"))
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch, batch),
+        out_shardings=(replicated, replicated),
+    )

@@ -1,0 +1,217 @@
+"""ResNet-v1.5 family — the data-parallel vision workload
+(BASELINE.json config #3: ResNet-50 across a v5e-8 slice).
+
+Pure-functional: ``init`` → (params, batch_stats); ``apply`` returns
+(logits, new_batch_stats). Under ``jit`` over a dp-sharded batch, the
+batch-norm reductions run over the GLOBAL batch — XLA inserts the
+cross-device psums, which is exactly synchronized ("cross-replica")
+batch norm without any collective in user code. Convs stay NHWC in
+bfloat16, the layout the MXU wants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: tuple = (3, 4, 6, 3)     # resnet-50
+    width: int = 64
+    num_classes: int = 1000
+    bottleneck: bool = True
+    bn_momentum: float = 0.9
+    bn_eps: float = 1e-5
+
+    def param_count(self) -> int:
+        # exact count comes from the pytree; this is the headline number
+        return sum(
+            p.size for p in jax.tree_util.tree_leaves(
+                jax.eval_shape(
+                    lambda: init(self, jax.random.key(0))[0]
+                )
+            )
+        )
+
+
+PRESETS = {
+    "resnet18-smoke": ResNetConfig(stage_sizes=(1, 1), width=8,
+                                   num_classes=10, bottleneck=False),
+    "resnet50": ResNetConfig(),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return (jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+            * jnp.sqrt(2.0 / fan_in))
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn_apply(x, scale, bias, mean, var, eps):
+    inv = jax.lax.rsqrt(var + eps) * scale
+    return (x - mean) * inv.astype(x.dtype) + bias.astype(x.dtype)
+
+
+def _bn(x, params, stats, train, momentum, eps):
+    """Batch norm. train=True: batch statistics (global under SPMD) and
+    EMA-updated running stats; train=False: running stats."""
+    if train:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        new_stats = {
+            "mean": momentum * stats["mean"] + (1 - momentum) * mean,
+            "var": momentum * stats["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = stats["mean"], stats["var"]
+        new_stats = stats
+    out = _bn_apply(x, params["scale"], params["bias"],
+                    mean.astype(x.dtype), var.astype(x.dtype), eps)
+    return out, new_stats
+
+
+def _block_names(cfg: ResNetConfig):
+    for stage, size in enumerate(cfg.stage_sizes):
+        for block in range(size):
+            yield f"s{stage}b{block}", stage, block
+
+
+def init(cfg: ResNetConfig, key: jax.Array):
+    """(params, batch_stats) pytrees."""
+    params: dict = {}
+    stats: dict = {}
+
+    def bn_init(c):
+        return ({"scale": jnp.ones((c,), jnp.float32),
+                 "bias": jnp.zeros((c,), jnp.float32)},
+                {"mean": jnp.zeros((c,), jnp.float32),
+                 "var": jnp.ones((c,), jnp.float32)})
+
+    key, sub = jax.random.split(key)
+    params["stem"] = {"conv": _conv_init(sub, 7, 7, 3, cfg.width)}
+    params["stem"]["bn"], stats["stem"] = bn_init(cfg.width)
+
+    cin = cfg.width
+    expansion = 4 if cfg.bottleneck else 1
+    for name, stage, block in _block_names(cfg):
+        cmid = cfg.width * (2 ** stage)
+        cout = cmid * expansion
+        stride = 2 if (stage > 0 and block == 0) else 1
+        bp: dict = {}
+        bs: dict = {}
+        if cfg.bottleneck:
+            shapes = [(1, 1, cin, cmid), (3, 3, cmid, cmid),
+                      (1, 1, cmid, cout)]
+        else:
+            shapes = [(3, 3, cin, cmid), (3, 3, cmid, cout)]
+        for i, (kh, kw, a, b) in enumerate(shapes):
+            key, sub = jax.random.split(key)
+            bp[f"conv{i}"] = _conv_init(sub, kh, kw, a, b)
+            bp[f"bn{i}"], bs[f"bn{i}"] = bn_init(b)
+        if cin != cout or stride != 1:
+            key, sub = jax.random.split(key)
+            bp["proj"] = _conv_init(sub, 1, 1, cin, cout)
+            bp["proj_bn"], bs["proj_bn"] = bn_init(cout)
+        params[name] = bp
+        stats[name] = bs
+        cin = cout
+
+    key, sub = jax.random.split(key)
+    params["head"] = {
+        "w": jnp.zeros((cin, cfg.num_classes), jnp.float32),
+        "b": jnp.zeros((cfg.num_classes,), jnp.float32),
+    }
+    return params, stats
+
+
+def apply(cfg: ResNetConfig, params: dict, stats: dict, x: jax.Array,
+          train: bool = True):
+    """(batch, H, W, 3) NHWC images → ((batch, classes) logits,
+    new_batch_stats)."""
+    bn = functools.partial(_bn, train=train, momentum=cfg.bn_momentum,
+                           eps=cfg.bn_eps)
+    new_stats: dict = {}
+    h = x.astype(jnp.bfloat16)
+    h = _conv(h, params["stem"]["conv"], stride=2)
+    h, new_stats["stem"] = bn(h, params["stem"]["bn"], stats["stem"])
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(
+        h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+
+    strides = {name: (2 if (stage > 0 and block == 0) else 1)
+               for name, stage, block in _block_names(cfg)}
+    n_convs = 3 if cfg.bottleneck else 2
+    for name, stage, block in _block_names(cfg):
+        bp, bs = params[name], stats[name]
+        ns: dict = {}
+        residual = h
+        out = h
+        for i in range(n_convs):
+            stride = strides[name] if i == (1 if cfg.bottleneck else 0) \
+                else 1
+            out = _conv(out, bp[f"conv{i}"], stride=stride)
+            out, ns[f"bn{i}"] = bn(out, bp[f"bn{i}"], bs[f"bn{i}"])
+            if i < n_convs - 1:
+                out = jax.nn.relu(out)
+        if "proj" in bp:
+            residual = _conv(residual, bp["proj"], stride=strides[name])
+            residual, ns["proj_bn"] = bn(residual, bp["proj_bn"],
+                                         bs["proj_bn"])
+        h = jax.nn.relu(out + residual)
+        new_stats[name] = ns
+
+    h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
+    logits = h @ params["head"]["w"] + params["head"]["b"]
+    return logits, new_stats
+
+
+def loss_fn(cfg: ResNetConfig, params: dict, stats: dict, x: jax.Array,
+            labels: jax.Array):
+    logits, new_stats = apply(cfg, params, stats, x, train=True)
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
+    return loss, new_stats
+
+
+def make_train_step(cfg: ResNetConfig, lr: float = 0.1, mesh=None):
+    """Momentum-SGD data-parallel step. With a mesh the batch shards
+    over dp; grads/batch-norm reductions become XLA collectives."""
+
+    def step(params, stats, momentum, x, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            loss_fn, argnums=1, has_aux=True
+        )(cfg, params, stats, x, labels)
+        new_momentum = jax.tree_util.tree_map(
+            lambda m, g: 0.9 * m + g, momentum, grads
+        )
+        new_params = jax.tree_util.tree_map(
+            lambda p, m: p - lr * m, params, new_momentum
+        )
+        return new_params, new_stats, new_momentum, loss
+
+    if mesh is None:
+        return jax.jit(step)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch = NamedSharding(mesh, P("dp"))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(rep, rep, rep, batch, batch),
+        out_shardings=(rep, rep, rep, rep),
+    )
