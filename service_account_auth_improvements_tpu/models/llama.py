@@ -45,6 +45,14 @@ class LlamaConfig:
     remat: bool = True
     scan_layers: bool = True
     attn_impl: str = "dense"         # dense | flash | ring (ring needs a mesh)
+    # Embedding lookup strategy. The table is (vocab→tp, embed→fsdp)
+    # sharded; a positional gather across the tp-sharded vocab axis makes
+    # the SPMD partitioner replicate ("involuntary full
+    # rematerialization"), while a one-hot contraction reduces over it as
+    # a clean psum (MaxText's use_iota_embed). Costs ~2·V·d extra FLOPs
+    # per token (one lm_head), so: True for tp>1 slices, False for
+    # single-chip where the local gather is free.
+    iota_embed: bool = False
 
     @property
     def q_dim(self) -> int:
@@ -192,9 +200,22 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array) -> jax.Array:
     """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32."""
     cdt = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
-    # mode="clip": out-of-range ids clamp instead of NaN-filling (jnp default)
-    # — avoids silent NaN-poisoning of a run and the fill-select on the hot path.
-    x = jnp.take(params["tok_embed"], tokens, axis=0, mode="clip").astype(cdt)
+    if cfg.iota_embed:
+        # one-hot contraction over the tp-sharded vocab axis (see config
+        # comment); products are exactly 0 or the row value, so this is
+        # bit-identical to gather-then-cast in cdt. Clip first: one_hot
+        # of an out-of-range id is all-zero (a silently poisoned zero
+        # embedding), while the gather path clamps via mode="clip".
+        safe = jnp.clip(tokens, 0, cfg.vocab_size - 1)
+        onehot = jax.nn.one_hot(safe, cfg.vocab_size, dtype=cdt)
+        x = jnp.einsum("bsv,vd->bsd", onehot,
+                       params["tok_embed"].astype(cdt))
+    else:
+        # mode="clip": out-of-range ids clamp instead of NaN-filling (jnp
+        # default) — avoids silent NaN-poisoning of a run and the
+        # fill-select on the hot path.
+        x = jnp.take(params["tok_embed"], tokens, axis=0,
+                     mode="clip").astype(cdt)
     x = shard_constraint(x, ("batch", "seq", None))
     cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
 
@@ -222,11 +243,23 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array) -> jax.Array:
 
 def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
     """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
-    (1.0 where the *target* position counts)."""
+    (1.0 where the *target* position counts).
+
+    The target logit comes from a one-hot contraction, NOT
+    ``take_along_axis``: logits are vocab-sharded over ``tp``, and a
+    positional gather across a sharded axis makes the SPMD partitioner
+    fully replicate [b, s, vocab] ("involuntary full rematerialization").
+    Contractions and logsumexp reduce over the sharded axis as ordinary
+    psums, so the big tensor never materializes unsharded.
+    """
     logits = apply(cfg, params, tokens[:, :-1])
-    targets = tokens[:, 1:]
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    # clip like the embedding path: an out-of-range target would one-hot
+    # to all-zeros and make nll = logz instead of a real cross-entropy
+    targets = jnp.clip(tokens[:, 1:], 0, cfg.vocab_size - 1)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(targets, cfg.vocab_size, dtype=logits.dtype)
+    target_logit = jnp.einsum("bsv,bsv->bs", logits, onehot)
+    nll = logz - target_logit
     if mask is None:
         return nll.mean()
     m = mask[:, 1:].astype(nll.dtype)

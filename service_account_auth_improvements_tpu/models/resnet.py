@@ -90,6 +90,12 @@ def _block_names(cfg: ResNetConfig):
             yield f"s{stage}b{block}", stage, block
 
 
+def _block_stride(stage: int, block: int) -> int:
+    """Each stage after the first downsamples in its first block — the
+    single definition used by init and apply."""
+    return 2 if (stage > 0 and block == 0) else 1
+
+
 def init(cfg: ResNetConfig, key: jax.Array):
     """(params, batch_stats) pytrees."""
     params: dict = {}
@@ -110,7 +116,7 @@ def init(cfg: ResNetConfig, key: jax.Array):
     for name, stage, block in _block_names(cfg):
         cmid = cfg.width * (2 ** stage)
         cout = cmid * expansion
-        stride = 2 if (stage > 0 and block == 0) else 1
+        stride = _block_stride(stage, block)
         bp: dict = {}
         bs: dict = {}
         if cfg.bottleneck:
@@ -153,23 +159,23 @@ def apply(cfg: ResNetConfig, params: dict, stats: dict, x: jax.Array,
         h, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
     )
 
-    strides = {name: (2 if (stage > 0 and block == 0) else 1)
-               for name, stage, block in _block_names(cfg)}
     n_convs = 3 if cfg.bottleneck else 2
     for name, stage, block in _block_names(cfg):
         bp, bs = params[name], stats[name]
+        block_stride = _block_stride(stage, block)
         ns: dict = {}
         residual = h
         out = h
         for i in range(n_convs):
-            stride = strides[name] if i == (1 if cfg.bottleneck else 0) \
+            # v1.5: the 3x3 conv carries the stride in bottleneck blocks
+            stride = block_stride if i == (1 if cfg.bottleneck else 0) \
                 else 1
             out = _conv(out, bp[f"conv{i}"], stride=stride)
             out, ns[f"bn{i}"] = bn(out, bp[f"bn{i}"], bs[f"bn{i}"])
             if i < n_convs - 1:
                 out = jax.nn.relu(out)
         if "proj" in bp:
-            residual = _conv(residual, bp["proj"], stride=strides[name])
+            residual = _conv(residual, bp["proj"], stride=block_stride)
             residual, ns["proj_bn"] = bn(residual, bp["proj_bn"],
                                          bs["proj_bn"])
         h = jax.nn.relu(out + residual)

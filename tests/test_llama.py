@@ -81,3 +81,25 @@ def test_sharded_forward_matches_single_device(params):
 def test_param_count_matches_tree(params):
     n = sum(x.size for x in jax.tree.leaves(params))
     assert n == CFG.param_count()
+
+
+def test_iota_embed_bit_identical_to_gather(params):
+    # one-hot products are exactly 0 or the row value, so the iota path
+    # must match gather-then-cast bit for bit (llama.py iota_embed)
+    cfg_iota = dataclasses.replace(CFG, iota_embed=True)
+    a = llama.apply(CFG, params, _tokens())
+    b = llama.apply(cfg_iota, params, _tokens())
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loss_onehot_matches_gather_formulation(params):
+    # next_token_loss computes CE via logsumexp - onehot-contraction
+    # (SPMD-friendly); must equal the take_along_axis formulation
+    toks = _tokens(s=24, seed=5)
+    logits = llama.apply(CFG32, params, toks[:, :-1])
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    gathered = float(
+        -jnp.take_along_axis(logp, toks[:, 1:][..., None], axis=-1).mean()
+    )
+    ours = float(llama.next_token_loss(CFG32, params, toks))
+    assert abs(gathered - ours) < 1e-5

@@ -107,9 +107,12 @@ def test_resnet_trains_on_dp_mesh(dp_mesh):
 
 def test_resnet50_param_count_is_canonical():
     cfg = resnet.PRESETS["resnet50"]
-    params, _ = jax.eval_shape(lambda: resnet.init(cfg,
-                                                   jax.random.key(0)))
-    total = sum(int(np.prod(p.shape))
-                for p in jax.tree_util.tree_leaves(params))
+    total = cfg.param_count()
     # ~25.5M params is the canonical ResNet-50 size
     assert 25_000_000 < total < 26_100_000, total
+    # and the public method agrees with the concrete pytree
+    params, _ = jax.eval_shape(lambda: resnet.init(cfg,
+                                                   jax.random.key(0)))
+    tree_total = sum(int(np.prod(p.shape))
+                     for p in jax.tree_util.tree_leaves(params))
+    assert total == tree_total
