@@ -45,7 +45,7 @@ def main() -> None:
 
     on_accel = jax.default_backend() not in ("cpu",)
     preset = os.environ.get(
-        "SATPU_BENCH_PRESET", "bench_400m" if on_accel else "tiny"
+        "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
     )
     cfg = llama.PRESETS[preset]
     batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
@@ -69,12 +69,17 @@ def main() -> None:
     with jax.set_mesh(mesh):
         for _ in range(warmup):
             state, m = step(state, tokens, mask)
-        jax.block_until_ready(m["loss"])
+        # host fetch, not block_until_ready: the remote-TPU PJRT plugin
+        # has been seen returning from block_until_ready without waiting,
+        # which once produced a nonsense 0.1ms/step reading; a
+        # device→host transfer of the loss cannot complete early
+        loss = float(m["loss"])
         t0 = time.perf_counter()
         for _ in range(iters):
             state, m = step(state, tokens, mask)
-        jax.block_until_ready(m["loss"])
+        loss = float(m["loss"])
         dt = (time.perf_counter() - t0) / iters
+        assert jnp.isfinite(loss), f"non-finite loss {loss}"
 
     # The train step consumes seq-1 target positions per row.
     tokens_per_step = batch * (seq - 1)

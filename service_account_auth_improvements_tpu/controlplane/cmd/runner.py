@@ -34,6 +34,12 @@ def run_manager(register, argv=None, add_args=None) -> int:
                         help="restrict to one namespace (default: all)")
     parser.add_argument("--workers", type=int, default=2,
                         help="reconcile workers per controller")
+    parser.add_argument("--leader-elect", action="store_true",
+                        help="enable Lease-based leader election "
+                             "(reference main.go:68 enable-leader-election)")
+    parser.add_argument("--leader-elect-name", default=None,
+                        help="lease name (default: derived from the binary)")
+    parser.add_argument("--leader-elect-namespace", default="kubeflow")
     if add_args:
         add_args(parser)
     args = parser.parse_args(argv)
@@ -49,6 +55,26 @@ def run_manager(register, argv=None, add_args=None) -> int:
 
     ready = {"ok": False}
     serve_ops(args.metrics_port, ready_check=lambda: ready["ok"])
+
+    elector = None
+    if args.leader_elect:
+        import sys
+
+        from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+            LeaderElector,
+        )
+
+        name = args.leader_elect_name or (
+            "tpukf-" + (sys.argv[0].rsplit("/", 1)[-1]
+                        .removesuffix(".py").replace("_", "-"))
+        )
+        elector = LeaderElector(client, name,
+                                namespace=args.leader_elect_namespace)
+        logging.getLogger(__name__).info(
+            "waiting for leader lease %s/%s",
+            args.leader_elect_namespace, name)
+        elector.acquire()
+
     manager.start()
     ready["ok"] = True
 
@@ -57,4 +83,6 @@ def run_manager(register, argv=None, add_args=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     manager.stop()
+    if elector is not None:
+        elector.release()
     return 0

@@ -1,0 +1,214 @@
+"""Lease-based leader election.
+
+The reference gets controller HA from controller-runtime's leader
+election (notebook-controller/main.go:68,90-92 `LeaderElection: true`,
+profile-controller/main.go:69-77): at most one active reconciler per
+deployment, failover via a coordination.k8s.io Lease. Same protocol
+here, on the stdlib kube client:
+
+- acquire: create the Lease, or take it over when expired / already ours;
+  optimistic concurrency (resourceVersion) arbitrates racing candidates;
+- renew: update ``renewTime`` every ``renew_period``;
+- lost lease (renewal failing past the deadline): ``on_lost`` fires —
+  default os._exit, the controller-runtime behavior, because continuing
+  as a deposed leader would mean two active reconcilers.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+import threading
+import time
+import uuid
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+log = logging.getLogger(__name__)
+
+LEASE_GROUP = "coordination.k8s.io"
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _fmt(ts: datetime.datetime) -> str:
+    return ts.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse(raw: str | None) -> datetime.datetime | None:
+    if not raw:
+        return None
+    try:
+        return datetime.datetime.strptime(
+            raw, "%Y-%m-%dT%H:%M:%S.%fZ"
+        ).replace(tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return None
+
+
+class LeaderElector:
+    def __init__(self, kube, lease_name: str,
+                 namespace: str = "kubeflow",
+                 identity: str | None = None,
+                 lease_duration: float = 15.0,
+                 renew_period: float = 5.0,
+                 retry_period: float = 2.0,
+                 on_lost=None):
+        self.kube = kube
+        self.lease_name = lease_name
+        self.namespace = namespace
+        self.identity = identity or f"{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.retry_period = retry_period
+        self.on_lost = on_lost if on_lost is not None else self._die
+        self._stop = threading.Event()
+        self._renewer: threading.Thread | None = None
+        self.is_leader = False
+
+    # ------------------------------------------------------------ public
+
+    def acquire(self) -> None:
+        """Block until this candidate holds the lease."""
+        if self._stop.is_set():
+            # returning silently would let the caller run WITHOUT the
+            # lease — the exact two-active-reconcilers state this module
+            # prevents
+            raise RuntimeError(
+                "LeaderElector was released; create a new instance"
+            )
+        while not self._stop.is_set():
+            if self._try_acquire():
+                self.is_leader = True
+                log.info("leader election: %s acquired %s/%s",
+                         self.identity, self.namespace, self.lease_name)
+                self._renewer = threading.Thread(
+                    target=self._renew_loop, daemon=True,
+                    name=f"lease-renew-{self.lease_name}",
+                )
+                self._renewer.start()
+                return
+            self._stop.wait(self.retry_period)
+
+    def release(self) -> None:
+        """Voluntary handoff on clean shutdown (clears holderIdentity so
+        the next candidate doesn't wait out the lease)."""
+        self._stop.set()
+        if not self.is_leader:
+            return
+        self.is_leader = False
+        try:
+            lease = self._get()
+            if lease and self._holder(lease) == self.identity:
+                lease["spec"]["holderIdentity"] = None
+                self.kube.update("leases", lease,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+        except errors.ApiError:
+            pass
+
+    # ----------------------------------------------------------- internal
+
+    @staticmethod
+    def _die():  # pragma: no cover - terminal
+        log.error("leader election: lease lost, exiting")
+        os._exit(1)
+
+    @staticmethod
+    def _holder(lease: dict) -> str | None:
+        return (lease.get("spec") or {}).get("holderIdentity")
+
+    def _get(self) -> dict | None:
+        try:
+            return self.kube.get("leases", self.lease_name,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+        except errors.NotFound:
+            return None
+
+    def _expired(self, lease: dict) -> bool:
+        spec = lease.get("spec") or {}
+        renew = _parse(spec.get("renewTime")) or \
+            _parse(spec.get("acquireTime"))
+        if renew is None:
+            return True
+        duration = spec.get("leaseDurationSeconds")
+        if duration is None:  # 0 is a valid (instant-expiry) duration
+            duration = self.lease_duration
+        return (_now() - renew).total_seconds() > duration
+
+    def _try_acquire(self) -> bool:
+        lease = self._get()
+        now = _fmt(_now())
+        try:
+            if lease is None:
+                self.kube.create("leases", {
+                    "apiVersion": f"{LEASE_GROUP}/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self.lease_name,
+                                 "namespace": self.namespace},
+                    "spec": {
+                        "holderIdentity": self.identity,
+                        # kept as-is (not int()-floored) so sub-second
+                        # test durations survive the round-trip
+                        "leaseDurationSeconds": self.lease_duration,
+                        "acquireTime": now,
+                        "renewTime": now,
+                        "leaseTransitions": 0,
+                    },
+                }, namespace=self.namespace, group=LEASE_GROUP)
+                return True
+            holder = self._holder(lease)
+            if holder == self.identity or not holder or \
+                    self._expired(lease):
+                spec = lease.setdefault("spec", {})
+                if holder != self.identity:
+                    spec["leaseTransitions"] = \
+                        int(spec.get("leaseTransitions") or 0) + 1
+                    spec["acquireTime"] = now
+                spec["holderIdentity"] = self.identity
+                spec["leaseDurationSeconds"] = self.lease_duration
+                spec["renewTime"] = now
+                # resourceVersion carries over → optimistic concurrency
+                self.kube.update("leases", lease,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+                return True
+            return False
+        except (errors.Conflict, errors.AlreadyExists):
+            return False  # somebody else won the race; retry
+
+    def _renew_loop(self) -> None:
+        deadline = time.monotonic() + self.lease_duration
+        while not self._stop.wait(self.renew_period):
+            try:
+                if self._try_acquire():
+                    deadline = time.monotonic() + self.lease_duration
+                    continue
+                # _try_acquire returning False may be a transient
+                # Conflict (e.g. racing our own release()); only depose
+                # after a confirming re-read shows another live holder
+                if self._stop.is_set():
+                    return
+                lease = self._get()
+                holder = self._holder(lease) if lease else None
+                if holder == self.identity:
+                    deadline = time.monotonic() + self.lease_duration
+                    continue
+                if holder and not self._expired(lease):
+                    log.error("leader election: lease %s taken by %s",
+                              self.lease_name, holder)
+                    self.is_leader = False
+                    self.on_lost()
+                    return
+            except errors.ApiError as e:
+                log.warning("leader election: renew failed: %s", e)
+            if self._stop.is_set():
+                return
+            if time.monotonic() > deadline:
+                self.is_leader = False
+                self.on_lost()
+                return

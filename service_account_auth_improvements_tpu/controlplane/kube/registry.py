@@ -69,6 +69,8 @@ _BUILTIN = [
     # Ephemeral review API (never stored; POST-only evaluation).
     Resource("authorization.k8s.io", "v1", "SubjectAccessReview",
              "subjectaccessreviews", namespaced=False),
+    # Leader-election leases (engine/leaderelection.py).
+    Resource("coordination.k8s.io", "v1", "Lease", "leases"),
     # This framework's CRDs.
     Resource(GROUP, "v1beta1", "Notebook", "notebooks"),
     Resource(GROUP, "v1", "Profile", "profiles", namespaced=False),

@@ -7,6 +7,12 @@ Layout in-kernel is ``[batch, heads, seq, head_dim]``; the public wrapper
 takes the model's ``[batch, seq, heads, head_dim]``. GQA is handled by the
 kv-head index map (no KV repetition in memory).
 
+Mosaic lowering constraints shape two choices here: singleton block dims
+are squeezed with ``None`` (a literal 1 in the last two block dims fails
+the (8, 128) divisibility check on real TPUs), and causal inputs whose
+sequence is not a 128-multiple (the train step's seq-1!) are padded to the
+block size rather than silently falling back to dense.
+
 Kernel playbook per /opt/skills/guides/pallas_guide.md. The reference repo
 has no kernels at all (its accelerator surface is a resource-limits string,
 SURVEY.md §2b) — this file is net-new TPU surface.
@@ -19,22 +25,33 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -2.0e38
 
 BLOCK_Q = 128
 BLOCK_K = 128
+# lse/delta are per-row scalars; Mosaic needs the last two block dims to be
+# (8k, 128)-shaped, so they are stored lane-replicated [.., seq, LSE_LANES]
+# (the same trick as upstream jax.experimental.pallas.ops.tpu.flash_attention
+# MIN_BLOCK_SIZE).
+LSE_LANES = 128
 
 
-def _use_pallas(q, k) -> bool:
+def _use_pallas(q, k, causal: bool) -> bool:
     if q.dtype not in (jnp.bfloat16, jnp.float32):
         return False
     sq, d = q.shape[1], q.shape[-1]
     sk = k.shape[1]
     if d % 64 != 0:
         return False
-    if sq % BLOCK_Q or sk % BLOCK_K:
+    if causal and sq != sk:
+        # the kernel's causal mask is start-aligned (row >= col); dense
+        # handles the end-aligned sq != sk case (and padding would put
+        # zero-keys inside real rows' windows when sq > sk)
+        return False
+    if (sq % BLOCK_Q or sk % BLOCK_K) and not causal:
+        # only the causal mask makes zero-padding sound (padded keys sit
+        # "in the future" of every real query row)
         return False
     try:
         return jax.default_backend() in ("tpu", "axon")
@@ -48,7 +65,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk):
     """One (batch, head, q-block) program: online softmax over kv blocks.
 
     q_ref [1,1,bq,d]; k_ref/v_ref [1,1,sk,d]; o_ref [1,1,bq,d];
-    lse_ref [1,1,bq].
+    lse_ref [1,1,bq,LSE_LANES] (lane-replicated row scalars).
     """
     iq = pl.program_id(2)
     bq = q_ref.shape[2]
@@ -93,7 +110,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk):
     acc, m, l = jax.lax.fori_loop(0, nkv, body, (acc0, m0, l0))
 
     o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = (m[:, 0] + jnp.log(l[:, 0])).astype(jnp.float32)
+    lse_ref[0, 0] = jnp.broadcast_to(
+        (m + jnp.log(l)).astype(jnp.float32), (bq, LSE_LANES)
+    )
 
 
 def _flash_fwd(q, k, v, *, causal, interpret=False):
@@ -114,11 +133,12 @@ def _flash_fwd(q, k, v, *, causal, interpret=False):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
+            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
+                         lambda ib, ih, iq: (ib, ih, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b, h, sq, LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -133,8 +153,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     d = q_ref.shape[3]
     q = q_ref[0, 0].astype(jnp.float32)
     do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0][:, None]
-    delta = delta_ref[0, 0][:, None]
+    lse = lse_ref[0, 0, :, :1]      # [bq, 1] (lanes are replicated)
+    delta = delta_ref[0, 0, :, :1]
 
     nkv_total = sk // BLOCK_K
     if causal:
@@ -173,64 +193,72 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, sq, g):
+                dk_ref, dv_ref, *, scale, causal, sq):
+    """One (batch, kv-head, k-block, group-head) program.
+
+    The group-head axis is the INNERMOST grid dim and revisits the same
+    dk/dv output block, accumulating across the q-heads that share this
+    kv head (TPU grids are sequential, so revisiting is a reduction).
+    Refs are squeezed: q/do [sq, d]; k/v [bk, d]; lse/delta
+    [sq, LSE_LANES] lane-replicated; dk/dv [bk, d] float32.
+    """
     ik = pl.program_id(2)
-    bk = k_ref.shape[2]
-    d = k_ref.shape[3]
-    kb = k_ref[0, 0].astype(jnp.float32)
-    vb = v_ref[0, 0].astype(jnp.float32)
+    hg = pl.program_id(3)
+    bk = k_ref.shape[0]
+    d = k_ref.shape[1]
+    kb = k_ref[...].astype(jnp.float32)
+    vb = v_ref[...].astype(jnp.float32)
 
     nq_total = sq // BLOCK_Q
     iq0 = (ik * bk) // BLOCK_Q if causal else 0
-    # Sum over the group of q-heads sharing this kv head, then q blocks.
-    def head_body(hg, carry):
+
+    def body(i, carry):
         dk, dv = carry
-
-        def body(i, carry2):
-            dk, dv = carry2
-            qb = q_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
-                jnp.float32
+        qb = q_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        dob = do_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
+        lseb = lse_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :1]
+        deltab = delta_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :1]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            rows = i * BLOCK_Q + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, bk), 0
             )
-            dob = do_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(
-                jnp.float32
+            cols = ik * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, bk), 1
             )
-            lseb = lse_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-            deltab = delta_ref[0, hg, pl.ds(i * BLOCK_Q, BLOCK_Q)][:, None]
-            s = jax.lax.dot_general(
-                qb, kb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale
-            if causal:
-                rows = i * BLOCK_Q + jax.lax.broadcasted_iota(
-                    jnp.int32, (BLOCK_Q, bk), 0
-                )
-                cols = ik * bk + jax.lax.broadcasted_iota(
-                    jnp.int32, (BLOCK_Q, bk), 1
-                )
-                s = jnp.where(rows >= cols, s, NEG_INF)
-            p = jnp.exp(s - lseb)
-            dv2 = dv + jax.lax.dot_general(
-                p, dob, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            dp = jax.lax.dot_general(
-                dob, vb, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - deltab) * scale
-            dk2 = dk + jax.lax.dot_general(
-                ds, qb, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return dk2, dv2
-
-        return jax.lax.fori_loop(iq0, nq_total, body, (dk, dv))
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lseb)
+        dv2 = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - deltab) * scale
+        dk2 = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk2, dv2
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, g, head_body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+    dk, dv = jax.lax.fori_loop(iq0, nq_total, body, (dk0, dv0))
+
+    @pl.when(hg == 0)
+    def _init():
+        dk_ref[...] = dk
+        dv_ref[...] = dv
+
+    @pl.when(hg != 0)
+    def _accumulate():
+        dk_ref[...] += dk
+        dv_ref[...] += dv
 
 
 def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
@@ -238,7 +266,11 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
     _, hkv, sk, _ = k.shape
     g = h // hkv
     scale = d ** -0.5
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1,
+                keepdims=True),
+        (b, h, sq, LSE_LANES),
+    )
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, sk=sk),
@@ -248,8 +280,10 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
             pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
             pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
             pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
-            pl.BlockSpec((1, 1, BLOCK_Q), lambda ib, ih, iq: (ib, ih, iq)),
+            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
+                         lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
+                         lambda ib, ih, iq: (ib, ih, iq, 0)),
         ],
         out_specs=pl.BlockSpec(
             (1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)
@@ -259,29 +293,36 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _dkv_kernel, scale=scale, causal=causal, sq=sq, g=g
-        ),
-        grid=(b, hkv, sk // BLOCK_K),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, sq=sq),
+        grid=(b, hkv, sk // BLOCK_K, g),
         in_specs=[
-            pl.BlockSpec((1, g, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, g, sq, d), lambda ib, ih, ik: (ib, ih, 0, 0)),
-            pl.BlockSpec((1, g, sq), lambda ib, ih, ik: (ib, ih, 0)),
-            pl.BlockSpec((1, g, sq), lambda ib, ih, ik: (ib, ih, 0)),
+            pl.BlockSpec((None, None, sq, d),
+                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
+            pl.BlockSpec((None, None, BLOCK_K, d),
+                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
+            pl.BlockSpec((None, None, BLOCK_K, d),
+                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
+            pl.BlockSpec((None, None, sq, d),
+                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
+            pl.BlockSpec((None, None, sq, LSE_LANES),
+                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
+            pl.BlockSpec((None, None, sq, LSE_LANES),
+                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda ib, ih, ik: (ib, ih, ik, 0)),
+            pl.BlockSpec((None, None, BLOCK_K, d),
+                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
+            pl.BlockSpec((None, None, BLOCK_K, d),
+                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hkv, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, hkv, sk, d), v.dtype),
+            # f32 accumulation across the group-head revisits
+            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 # ----------------------------------------------------------- public entry
@@ -308,22 +349,39 @@ def _flash_vjp_bwd(causal, interpret, res, do):
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+def _pad_seq(x, block: int):
+    """Zero-pad [b, s, h, d] along s to a multiple of ``block``."""
+    s = x.shape[1]
+    pad = (-s) % block
+    if not pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
 def flash_attention(q, k, v, *, causal: bool = True, interpret: bool | None = None):
     """Public wrapper: q [b,sq,h,d], k/v [b,sk,hkv,d] → [b,sq,h,d].
 
-    Uses the Pallas kernels when the backend is TPU and shapes are
-    block-aligned; falls back to the fused dense path otherwise. Set
-    ``interpret=True`` to force the kernels through the Pallas interpreter
-    (CPU correctness tests).
+    Uses the Pallas kernels when the backend is TPU; falls back to the
+    fused dense path otherwise. Non-block-aligned causal sequences (the
+    train step's seq-1 shape) are zero-padded: padded KEYS are in every
+    real row's causal future, so they are masked; padded QUERY rows are
+    sliced off, and their cotangents are zero by construction of
+    pad/slice under autodiff. Set ``interpret=True`` to force the kernels
+    through the Pallas interpreter (CPU correctness tests).
     """
     from service_account_auth_improvements_tpu.ops import attention as _attn
 
     force = interpret is not None
-    if not force and not _use_pallas(q, k):
+    if not force and not _use_pallas(q, k, causal):
         scale = q.shape[-1] ** -0.5
         return _attn._dense_attention(q, k, v, scale, causal=causal)
+    sq = q.shape[1]
+    if causal:
+        q = _pad_seq(q, BLOCK_Q)
+        k = _pad_seq(k, BLOCK_K)
+        v = _pad_seq(v, BLOCK_K)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     o = _flash(qt, kt, vt, causal, bool(interpret))
-    return jnp.swapaxes(o, 1, 2)
+    return jnp.swapaxes(o, 1, 2)[:, :sq]

@@ -59,3 +59,26 @@ def test_fallback_on_unaligned_shapes():
     want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=True)
     got = flash_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=2e-5)
+
+
+def test_padded_kernel_matches_dense_on_unaligned_causal_seq():
+    # the train step always runs seq-1 (e.g. 2047): the kernel must pad to
+    # the block size and match dense exactly on the real rows — this is
+    # the shape where a silent dense fallback once hid the kernel entirely
+    q, k, v = _make_qkv(sq=127, sk=127)
+    want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=True)
+    got = flash_attention(q, k, v, causal=True, interpret=True)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=5e-4)
+
+
+def test_padded_kernel_grads_have_no_nan():
+    q, k, v = _make_qkv(sq=127, sk=127)
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, causal=True, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in zip(grads, "qkv"):
+        assert bool(jnp.isfinite(g).all()), f"d{name} has non-finite values"
