@@ -10,6 +10,9 @@ from service_account_auth_improvements_tpu.controlplane.controllers.profile impo
     ProfileReconciler,
     WorkloadIdentityPlugin,
 )
+from service_account_auth_improvements_tpu.controlplane.metrics.monitoring import (
+    ControllerMonitor,
+)
 
 
 def _add_args(parser):
@@ -21,6 +24,8 @@ def _register(client, manager, args):
         client,
         plugins={WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin()},
         namespace_labels_path=args.namespace_labels_path,
+        # binary wires the monitor onto the global /metrics registry
+        monitor=ControllerMonitor("profile-controller"),
     ).register(manager)
 
 

@@ -98,7 +98,8 @@ class ProfileReconciler(Reconciler):
     group = GROUP
 
     def __init__(self, kube, plugins: dict | None = None,
-                 namespace_labels_path: str | None = None):
+                 namespace_labels_path: str | None = None,
+                 monitor=None):
         self.kube = kube
         self.plugins = plugins if plugins is not None else {
             WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin(),
@@ -108,8 +109,26 @@ class ProfileReconciler(Reconciler):
         self.labels_path = namespace_labels_path or os.environ.get(
             "NAMESPACE_LABELS_PATH", ""
         )
+        # request_kf/request_kf_failure/service_heartbeat parity
+        # (reference monitoring.go:26-78, 10s heartbeat goroutine);
+        # default = isolated registry so repeated construction (tests)
+        # never collides — the binary passes one on the global REGISTRY
+        from service_account_auth_improvements_tpu.controlplane.metrics.monitoring import (  # noqa: E501
+            ControllerMonitor,
+        )
+        from service_account_auth_improvements_tpu.controlplane.metrics.registry import (  # noqa: E501
+            Registry,
+        )
+        self.monitor = monitor or ControllerMonitor(
+            "profile-controller", registry=Registry()
+        )
+
+    def shutdown(self) -> None:
+        """Manager-stop hook: halt the heartbeat thread."""
+        self.monitor.stop()
 
     def register(self, manager) -> "ProfileReconciler":
+        self.monitor.start_heartbeat()
         ctl = manager.add_reconciler(self)
         manager.watch_owned(ctl, "namespaces", owner_kind="Profile")
         manager.watch_owned(ctl, "rolebindings",
@@ -133,6 +152,15 @@ class ProfileReconciler(Reconciler):
         return labels
 
     def reconcile(self, req: Request) -> Result:
+        try:
+            result = self._reconcile(req)
+            self.monitor.observe("reconcile")
+            return result
+        except Exception as e:
+            self.monitor.observe("reconcile", error=e)
+            raise
+
+    def _reconcile(self, req: Request) -> Result:
         try:
             profile = self.kube.get("profiles", req.name, group=GROUP)
         except errors.NotFound:

@@ -81,7 +81,15 @@ class LeaderElector:
                 "LeaderElector was released; create a new instance"
             )
         while not self._stop.is_set():
-            if self._try_acquire():
+            try:
+                acquired = self._try_acquire()
+            except errors.ApiError as e:
+                # a transient apiserver hiccup must not kill a standby
+                # candidate (controller-runtime retries forever too)
+                log.warning("leader election: acquire attempt failed: %s",
+                            e)
+                acquired = False
+            if acquired:
                 self.is_leader = True
                 log.info("leader election: %s acquired %s/%s",
                          self.identity, self.namespace, self.lease_name)
@@ -97,18 +105,27 @@ class LeaderElector:
         """Voluntary handoff on clean shutdown (clears holderIdentity so
         the next candidate doesn't wait out the lease)."""
         self._stop.set()
+        # let an in-flight renewal finish so its rv bump can't race the
+        # clear below into a swallowed Conflict
+        if self._renewer is not None and self._renewer.is_alive():
+            self._renewer.join(timeout=self.renew_period + 1.0)
         if not self.is_leader:
             return
         self.is_leader = False
-        try:
-            lease = self._get()
-            if lease and self._holder(lease) == self.identity:
+        for _ in range(2):  # one retry absorbs a late concurrent writer
+            try:
+                lease = self._get()
+                if not lease or self._holder(lease) != self.identity:
+                    return
                 lease["spec"]["holderIdentity"] = None
                 self.kube.update("leases", lease,
                                  namespace=self.namespace,
                                  group=LEASE_GROUP)
-        except errors.ApiError:
-            pass
+                return
+            except errors.Conflict:
+                continue
+            except errors.ApiError:
+                return
 
     # ----------------------------------------------------------- internal
 
@@ -116,6 +133,14 @@ class LeaderElector:
     def _die():  # pragma: no cover - terminal
         log.error("leader election: lease lost, exiting")
         os._exit(1)
+
+    def _wire_duration(self):
+        """Lease.spec.leaseDurationSeconds is int32 on a real apiserver;
+        only sub-second test durations stay float (the fake tolerates
+        them, a real cluster never sees them)."""
+        if float(self.lease_duration).is_integer():
+            return int(self.lease_duration)
+        return self.lease_duration
 
     @staticmethod
     def _holder(lease: dict) -> str | None:
@@ -152,9 +177,7 @@ class LeaderElector:
                                  "namespace": self.namespace},
                     "spec": {
                         "holderIdentity": self.identity,
-                        # kept as-is (not int()-floored) so sub-second
-                        # test durations survive the round-trip
-                        "leaseDurationSeconds": self.lease_duration,
+                        "leaseDurationSeconds": self._wire_duration(),
                         "acquireTime": now,
                         "renewTime": now,
                         "leaseTransitions": 0,
@@ -170,7 +193,7 @@ class LeaderElector:
                         int(spec.get("leaseTransitions") or 0) + 1
                     spec["acquireTime"] = now
                 spec["holderIdentity"] = self.identity
-                spec["leaseDurationSeconds"] = self.lease_duration
+                spec["leaseDurationSeconds"] = self._wire_duration()
                 spec["renewTime"] = now
                 # resourceVersion carries over → optimistic concurrency
                 self.kube.update("leases", lease,

@@ -195,6 +195,13 @@ class Manager:
             ctl.stop()
         for inf in self._informers.values():
             inf.stop()
+        # reconcilers may hold background resources (heartbeat threads,
+        # monitors) — give them a shutdown hook, controller-runtime's
+        # Runnable-stop analog
+        for ctl in self._controllers:
+            shutdown = getattr(ctl.reconciler, "shutdown", None)
+            if callable(shutdown):
+                shutdown()
 
     # Convenience for tests: block until all queues drain.
     def quiesce(self, timeout: float = 10.0) -> bool:

@@ -34,6 +34,23 @@ def serve_ops(port: int, registry=None, ready_check=None,
                 ok = ready_check() if ready_check else True
                 body = b"ok" if ok else b"not ready"
                 self.send_response(200 if ok else 503)
+            elif self.path.startswith("/debug/threadz"):
+                # the Python analog of Go's pprof goroutine dump
+                # (SURVEY.md §5: the reference has no profiling wiring;
+                # the TPU build adds it) — one stack per live thread
+                import sys
+                import traceback
+
+                names = {t.ident: t.name for t in threading.enumerate()}
+                parts = []
+                for ident, frame in sys._current_frames().items():
+                    parts.append(
+                        f"Thread {names.get(ident, '?')} ({ident}):\n"
+                        + "".join(traceback.format_stack(frame))
+                    )
+                body = "\n".join(parts).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
             else:
                 body = b"not found"
                 self.send_response(404)

@@ -1,0 +1,74 @@
+"""Controller monitoring: request counters + liveness heartbeat.
+
+Parity with the reference's profile-controller monitoring
+(controllers/monitoring.go:26-78: ``request_kf``/``request_kf_failure``
+counters with severity labels and a ``service_heartbeat`` gauge bumped by
+a 10 s goroutine; KFAM mirrors it in kfam/monitoring.go:46-76).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.metrics.registry import (
+    Counter,
+    Gauge,
+    REGISTRY,
+)
+
+
+class ControllerMonitor:
+    """Per-controller request accounting + heartbeat thread."""
+
+    def __init__(self, component: str, registry=None,
+                 heartbeat_period: float = 10.0,
+                 requests=None, failures=None, heartbeat=None):
+        """``requests``/``failures``/``heartbeat`` let a second component
+        in the same process reuse the metric families (a registry rejects
+        duplicate names)."""
+        reg = registry if registry is not None else REGISTRY
+        self.component = component
+        self.requests = requests if requests is not None else Counter(
+            "request_kf_total",
+            "reconcile/API requests handled",
+            ("component", "action"),
+            registry=reg,
+        )
+        self.failures = failures if failures is not None else Counter(
+            "request_kf_failure_total",
+            "failed requests by severity",
+            ("component", "action", "severity"),
+            registry=reg,
+        )
+        self.heartbeat = heartbeat if heartbeat is not None else Gauge(
+            "service_heartbeat",
+            "unix time of the service's last liveness beat",
+            ("component",),
+            registry=reg,
+        )
+        self._period = heartbeat_period
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def observe(self, action: str, error: Exception | None = None,
+                severity: str = "major") -> None:
+        self.requests.labels(self.component, action).inc()
+        if error is not None:
+            self.failures.labels(self.component, action, severity).inc()
+
+    def start_heartbeat(self) -> "ControllerMonitor":
+        def beat():
+            while not self._stop.wait(self._period):
+                self.heartbeat.labels(self.component).set(time.time())
+
+        self.heartbeat.labels(self.component).set(time.time())
+        self._thread = threading.Thread(
+            target=beat, daemon=True,
+            name=f"heartbeat-{self.component}",
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -375,6 +375,18 @@ def flash_attention(q, k, v, *, causal: bool = True, interpret: bool | None = No
     if not force and not _use_pallas(q, k, causal):
         scale = q.shape[-1] ** -0.5
         return _attn._dense_attention(q, k, v, scale, causal=causal)
+    # the force path skips _use_pallas, so re-assert the shape contract
+    # rather than silently computing a wrong (start-aligned) causal mask
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            "flash_attention kernels require sq == sk for causal "
+            f"(got sq={q.shape[1]}, sk={k.shape[1]}); use the dense path"
+        )
+    if not causal and (q.shape[1] % BLOCK_Q or k.shape[1] % BLOCK_K):
+        raise ValueError(
+            "non-causal flash_attention needs block-aligned sequences "
+            f"(got sq={q.shape[1]}, sk={k.shape[1]})"
+        )
     sq = q.shape[1]
     if causal:
         q = _pad_seq(q, BLOCK_Q)

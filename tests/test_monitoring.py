@@ -1,0 +1,68 @@
+"""ControllerMonitor + ops-endpoint debug handler tests
+(metrics/monitoring.py, engine/serve.py)."""
+
+import time
+import urllib.request
+
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.metrics.monitoring import (
+    ControllerMonitor,
+)
+from service_account_auth_improvements_tpu.controlplane.metrics.registry import (
+    Registry,
+)
+
+
+def test_monitor_counts_requests_and_failures():
+    reg = Registry()
+    mon = ControllerMonitor("profile-controller", registry=reg)
+    mon.observe("reconcile")
+    mon.observe("reconcile", error=RuntimeError("boom"))
+    text = reg.render()
+    assert ('request_kf_total{component="profile-controller",'
+            'action="reconcile"} 2') in text
+    assert ('request_kf_failure_total{component="profile-controller",'
+            'action="reconcile",severity="major"} 1') in text
+
+
+def test_heartbeat_beats(monkeypatch):
+    reg = Registry()
+    mon = ControllerMonitor("kfam", registry=reg, heartbeat_period=0.02)
+    mon.start_heartbeat()
+    try:
+        before = time.time()
+        time.sleep(0.08)
+        line = [l for l in reg.render().splitlines()
+                if l.startswith("service_heartbeat{")][0]
+        beat = float(line.rsplit(" ", 1)[1])
+        assert beat >= before - 1
+    finally:
+        mon.stop()
+
+
+def test_two_monitors_share_one_registry_without_collision():
+    reg = Registry()
+    a = ControllerMonitor("profile-controller", registry=reg)
+    # a second component must reuse the metric families, not re-register
+    b = ControllerMonitor("kfam", registry=reg, requests=a.requests,
+                          failures=a.failures, heartbeat=a.heartbeat)
+    a.observe("reconcile")
+    b.observe("bindings")
+    text = reg.render()
+    assert 'component="profile-controller"' in text
+    assert 'component="kfam"' in text
+
+
+def test_serve_ops_debug_threadz():
+    server = serve_ops(0, registry=Registry(), host="127.0.0.1")
+    try:
+        port = server.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/threadz", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "Thread" in body or "File" in body
+    finally:
+        server.shutdown()
