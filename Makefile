@@ -1,0 +1,22 @@
+# Top-level targets (the reference drives everything through per-component
+# Makefiles; this is the one-stop equivalent).
+
+.PHONY: test native manifests workflows images bench-cpu
+
+test: native
+	python -m pytest tests/ -x -q
+
+native:
+	$(MAKE) -C native
+
+manifests:
+	python -m service_account_auth_improvements_tpu.controlplane.kube.crdgen
+
+workflows:
+	python -m ci.workflows
+
+images:
+	$(MAKE) -C images docker-build-all
+
+bench-cpu:
+	SATPU_BENCH_CPU=1 python bench.py
