@@ -94,7 +94,15 @@ def get_service_account_token(client_id: str) -> tuple[str, str]:
     wins; otherwise the metadata server is used (reference
     get_service_account_token, auth.py:17)."""
     key_path = os.environ.get("GOOGLE_APPLICATION_CREDENTIALS", "")
-    if key_path and os.path.exists(key_path):
+    if key_path:
+        # An explicitly configured identity must never silently degrade to
+        # the node's default service account — a typo'd path would otherwise
+        # mint a token for the wrong principal.
+        if not os.path.exists(key_path):
+            raise AuthError(
+                "GOOGLE_APPLICATION_CREDENTIALS is set but the file does "
+                f"not exist: {key_path}"
+            )
         return token_from_key_file(client_id, key_path)
     return token_from_metadata_server(client_id)
 

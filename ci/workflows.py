@@ -82,6 +82,10 @@ def kind_integration_steps(wait_selectors: list[str]) -> list[dict]:
          "run": "./testing/gh-actions/install_kustomize.sh"},
         {"name": "Install cert-manager",
          "run": "./testing/gh-actions/install_cert_manager.sh"},
+        # the kubeflow overlay contains VirtualService/AuthorizationPolicy
+        # objects — without the Istio CRDs the apply below fails
+        {"name": "Install Istio",
+         "run": "./testing/gh-actions/install_istio.sh"},
         {"name": "Apply manifests",
          "run": "kustomize build manifests/overlays/kubeflow "
                 "| sed 's|ghcr.io/tpukf/controlplane:latest"
@@ -165,7 +169,96 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
               "run": "SATPU_BENCH_CPU=1 python bench.py"}],
         )},
     ),
+    "images_multi_arch_test.yaml": workflow(
+        "Images Multi-Arch Build Test",
+        ["images/**", "native/**",
+         "service_account_auth_improvements_tpu/**"],
+        {"build": job([
+            CHECKOUT,
+            {"name": "Setup QEMU",
+             "uses": "docker/setup-qemu-action@v3"},
+            {"name": "Setup Docker Buildx",
+             "uses": "docker/setup-buildx-action@v3"},
+            # each platform separately, like the reference's
+            # *_multi_arch_test.yaml (nb_controller_multi_arch_test.yaml)
+            {"name": "Build base multi-arch",
+             "run": "ARCH=linux/amd64 make -C images/base "
+                    "docker-build-multi-arch REGISTRY=local TAG=ci\n"
+                    "ARCH=linux/arm64 make -C images/base "
+                    "docker-build-multi-arch REGISTRY=local TAG=ci"},
+            {"name": "Build controlplane multi-arch",
+             "run": "ARCH=linux/amd64 make -C images/controlplane "
+                    "docker-build-multi-arch REGISTRY=local TAG=ci\n"
+                    "ARCH=linux/arm64 make -C images/controlplane "
+                    "docker-build-multi-arch REGISTRY=local TAG=ci"},
+        ])},
+    ),
 }
+
+
+PUBLISHED_IMAGES = (
+    "base", "jupyter", "jupyter-jax-tpu", "jupyter-jax-tpu-full",
+    "jupyter-scipy", "codeserver", "codeserver-python", "rstudio",
+    "rstudio-tidyverse", "controlplane",
+)
+
+
+def publish_workflow() -> dict:
+    """Push-triggered multi-arch publish of the image tree + controlplane
+    (the reference's *_docker_publish.yaml lanes, e.g.
+    nb_controller_docker_publish.yaml: login → QEMU/buildx → build-push on
+    main, re-tag on releasing VERSION change)."""
+
+    def publish_step(d: str, tag: str, cond: str | None = None) -> dict:
+        # buildx --push in one invocation: --load can't export a
+        # multi-platform manifest list
+        step = {
+            "name": f"Publish {d} ({tag})",
+            "env": {"REGISTRY": "ghcr.io/${{ github.repository_owner }}"},
+            "run": f"TAG={tag} ARCH=linux/amd64,linux/arm64 "
+                   f"make -C images/{d} docker-build-push-multi-arch "
+                   "REGISTRY=$REGISTRY",
+        }
+        if cond:
+            step["if"] = cond
+        return step
+
+    steps = [
+        CHECKOUT,
+        {"name": "Detect VERSION change",
+         "id": "filter",
+         "uses": "dorny/paths-filter@v3",
+         "with": {"base": "${{ github.ref }}",
+                  "filters": "version:\n  - 'releasing/VERSION'\n"}},
+        {"name": "Login to registry",
+         "uses": "docker/login-action@v3",
+         "with": {"registry": "ghcr.io",
+                  "username": "${{ github.actor }}",
+                  "password": "${{ secrets.GITHUB_TOKEN }}"}},
+        {"name": "Setup QEMU", "uses": "docker/setup-qemu-action@v3"},
+        {"name": "Setup Docker Buildx",
+         "uses": "docker/setup-buildx-action@v3"},
+    ]
+    steps += [publish_step(d, "${{ github.sha }}")
+              for d in PUBLISHED_IMAGES]
+    steps += [publish_step(d, "$(cat releasing/VERSION)",
+                           cond="steps.filter.outputs.version == 'true'")
+              for d in PUBLISHED_IMAGES]
+    return {
+        "name": "Build & Publish Images",
+        "on": {"push": {"branches": ["main"],
+                        "paths": ["images/**", "native/**",
+                                  "service_account_auth_improvements_tpu/**",
+                                  "releasing/VERSION"]}},
+        # serialize publishes: concurrent runs could leave a version tag
+        # pointing at a stale sha
+        "concurrency": {"group": "${{ github.workflow }}",
+                        "cancel-in-progress": False},
+        "jobs": {"push_to_registry": job(steps)},
+    }
+
+
+COMPONENT_WORKFLOWS["images_docker_publish.yaml"] = publish_workflow()
 
 
 def render_all() -> dict[str, str]:

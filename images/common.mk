@@ -41,3 +41,12 @@ docker-build-multi-arch:
 docker-build-multi-arch-dep: $(addprefix docker-build-multi-arch-dep--, $(BASE_IMAGE_FOLDERS)) docker-build-multi-arch
 docker-build-multi-arch-dep--%:
 	$(MAKE) docker-build-multi-arch-dep -C ../$*
+
+# buildx --load cannot export a multi-platform manifest list; publishing
+# multi-arch must build and push in one invocation (reference
+# example-notebook-servers/common.mk docker-build-push-multi-arch)
+.PHONY: docker-build-push-multi-arch
+docker-build-push-multi-arch:
+	docker buildx build --push --platform $(ARCH) \
+		--build-arg BASE_IMG=$(BASE_IMAGE) \
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .

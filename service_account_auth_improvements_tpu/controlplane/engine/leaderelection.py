@@ -83,6 +83,15 @@ class LeaderElector:
         while not self._stop.is_set():
             try:
                 acquired = self._try_acquire()
+            except errors.Forbidden as e:
+                # Forbidden is RBAC misconfiguration (missing
+                # coordination.k8s.io/leases rule), not a hiccup — retrying
+                # forever would leave the controller silently never-Ready
+                raise RuntimeError(
+                    "leader election: apiserver denied lease access — the "
+                    "controller ServiceAccount needs get/list/watch/create/"
+                    f"update on coordination.k8s.io leases: {e}"
+                ) from e
             except errors.ApiError as e:
                 # a transient apiserver hiccup must not kill a standby
                 # candidate (controller-runtime retries forever too)

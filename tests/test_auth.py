@@ -88,6 +88,15 @@ def test_key_file_flow_requires_google_auth(monkeypatch, tmp_path):
             auth.get_service_account_token("cid")
 
 
+def test_missing_key_file_is_an_error_not_a_fallback(monkeypatch, tmp_path):
+    # a typo'd GOOGLE_APPLICATION_CREDENTIALS must not silently mint a
+    # token for the node's default service account
+    monkeypatch.setenv("GOOGLE_APPLICATION_CREDENTIALS",
+                       str(tmp_path / "nope.json"))
+    with pytest.raises(auth.AuthError, match="does not exist"):
+        auth.get_service_account_token("cid")
+
+
 def test_cli_prints_token(fake_metadata, monkeypatch, capsys):
     monkeypatch.delenv("GOOGLE_APPLICATION_CREDENTIALS", raising=False)
     assert auth.main(["iap-client-xyz"]) == 0

@@ -112,3 +112,22 @@ def test_acquire_blocks_until_lease_free(kube):
     assert got.wait(2.0), "b should take over after a releases"
     assert b.is_leader
     b.release()
+
+
+def test_forbidden_is_fatal_misconfiguration(kube):
+    # missing coordination.k8s.io/leases RBAC must surface loudly, not
+    # retry forever as a never-Ready standby
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        errors,
+    )
+
+    class ForbiddenKube:
+        def get(self, *a, **kw):
+            raise errors.Forbidden("leases is forbidden")
+
+        create = update = get
+
+    a = LeaderElector(ForbiddenKube(), "test-controller", identity="a",
+                      retry_period=0.01, on_lost=lambda: None)
+    with pytest.raises(RuntimeError, match="coordination.k8s.io"):
+        a.acquire()
