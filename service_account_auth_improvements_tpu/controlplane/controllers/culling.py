@@ -37,6 +37,9 @@ from service_account_auth_improvements_tpu.controlplane.engine import (
     Request,
     Result,
 )
+from service_account_auth_improvements_tpu.controlplane.events import (
+    EventRecorder,
+)
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.metrics import Registry
 from service_account_auth_improvements_tpu.utils.env import (
@@ -79,6 +82,7 @@ class CullingReconciler(Reconciler):
                  fetch_kernels=default_fetch_kernels, now=None):
         self.kube = kube
         self.metrics = metrics or NotebookMetrics(Registry())
+        self.recorder = EventRecorder(kube, "culling-controller")
         self.fetch_kernels = fetch_kernels
         self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
         self.cull_idle_minutes = get_env_int("CULL_IDLE_TIME", 1440)
@@ -157,6 +161,11 @@ class CullingReconciler(Reconciler):
                 now.strftime(TIME_FMT)
             )
             self.metrics.culled.labels(req.namespace).inc()
+            self.recorder.event(
+                nb, "Normal", "Culled",
+                f"Culled after {idle_for.total_seconds() / 3600:.1f}h idle "
+                f"(threshold {self.cull_idle_minutes} min)",
+            )
         self.kube.patch("notebooks", req.name, patch,
                         namespace=req.namespace, group=GROUP)
         return Result(requeue_after=period.total_seconds())

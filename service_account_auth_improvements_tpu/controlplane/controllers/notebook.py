@@ -25,6 +25,11 @@ from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers import (
     helpers,
 )
+from service_account_auth_improvements_tpu.controlplane.events import (
+    WARNING,
+    EventRecorder,
+    involved_kind_and_name,
+)
 from service_account_auth_improvements_tpu.controlplane.engine import (
     Reconciler,
     Request,
@@ -74,6 +79,7 @@ class NotebookReconciler(Reconciler):
     def __init__(self, kube, metrics: NotebookMetrics | None = None):
         self.kube = kube
         self.metrics = metrics or NotebookMetrics(Registry())
+        self.recorder = EventRecorder(kube, "notebook-controller")
         self.use_istio = get_env_bool("USE_ISTIO", False)
         self.istio_gateway = get_env_default(
             "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
@@ -89,6 +95,11 @@ class NotebookReconciler(Reconciler):
                             owner_kind="Notebook")
         manager.watch_owned(ctl, "services", owner_kind="Notebook")
         manager.watch_mapped(ctl, "pods", self._map_pod)
+        # re-emit child pod/STS events onto the CR — the reference routes
+        # these through the reconcile queue (notebook_controller.go:94-122);
+        # handled directly on the watch here so re-emission can't be
+        # coalesced away by queue dedup
+        manager.informer("events").add_handler(self._on_event)
         return self
 
     @staticmethod
@@ -98,6 +109,40 @@ class NotebookReconciler(Reconciler):
         if name:
             return [Request(pod["metadata"].get("namespace"), name)]
         return []
+
+    def _on_event(self, ev_type, event) -> None:
+        """Re-emit a child pod/STS event onto the owning Notebook
+        (reference: notebook_controller.go:109-117 "Reissued from ...",
+        filters nbNameFromInvolvedObject :611-641)."""
+        if ev_type == "DELETED":
+            return
+        kind, obj_name = involved_kind_and_name(event)
+        ns = event["metadata"].get("namespace")
+        if kind == "StatefulSet":
+            nb_name = obj_name
+        elif kind == "Pod":
+            try:
+                pod = self.kube.get("pods", obj_name, namespace=ns)
+            except errors.ApiError:
+                return
+            nb_name = (pod["metadata"].get("labels") or {}).get(
+                "notebook-name"
+            )
+        else:
+            return
+        if not nb_name:
+            return
+        try:
+            nb = self.kube.get("notebooks", nb_name, namespace=ns,
+                               group=GROUP)
+        except errors.ApiError:
+            return
+        self.recorder.event(
+            nb, event.get("type") or "Normal",
+            event.get("reason") or "ChildEvent",
+            f"Reissued from {kind.lower()}/{obj_name}: "
+            f"{event.get('message', '')}",
+        )
 
     # ---------------------------------------------------------- reconcile
 
@@ -117,6 +162,7 @@ class NotebookReconciler(Reconciler):
             # (the reference's appendErrorConditionAndReturn pattern —
             # profile_controller.go:337-347).
             self.metrics.create_failed.inc()
+            self.recorder.event(nb, WARNING, "InvalidTpuSpec", str(e))
             nb = copy.deepcopy(nb)
             helpers.set_condition(nb, {
                 "type": "InvalidTpuSpec", "status": "True", "message": str(e),
@@ -140,6 +186,10 @@ class NotebookReconciler(Reconciler):
         )
         if fresh:
             self.metrics.created.inc()
+            self.recorder.event(
+                nb, "Normal", "CreatedStatefulSet",
+                f"Created StatefulSet {req.namespace}/{req.name}",
+            )
         helpers.ensure(
             self.kube, "services", self.generate_service(nb),
             copy_fields=helpers.copy_service_fields,

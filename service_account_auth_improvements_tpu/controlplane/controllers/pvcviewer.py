@@ -37,6 +37,10 @@ from service_account_auth_improvements_tpu.controlplane.engine import (
     Request,
     Result,
 )
+from service_account_auth_improvements_tpu.controlplane.events import (
+    WARNING,
+    EventRecorder,
+)
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.utils.env import get_env_default
 
@@ -125,6 +129,7 @@ class PVCViewerReconciler(Reconciler):
 
     def __init__(self, kube):
         self.kube = kube
+        self.recorder = EventRecorder(kube, "pvcviewer-controller")
         self.istio_gateway = get_env_default(
             "ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"
         )
@@ -158,11 +163,23 @@ class PVCViewerReconciler(Reconciler):
         except ValidationError as e:
             # Terminal user error (e.g. explicit podSpec not mounting the
             # PVC): surface on the CR instead of retry-storming.
+            self.recorder.event(viewer, WARNING, "InvalidSpec", str(e))
             self._set_invalid_condition(viewer, str(e))
             return Result()
 
         labels = self._labels(viewer)
+        fresh = False
+        try:
+            self.kube.get("deployments", req.name, namespace=req.namespace,
+                          group="apps")
+        except errors.NotFound:
+            fresh = True
         self._reconcile_deployment(viewer, labels)
+        if fresh:
+            self.recorder.event(
+                viewer, "Normal", "CreatedDeployment",
+                f"Created Deployment {req.namespace}/{req.name}",
+            )
         if self._networking(viewer):
             helpers.ensure(
                 self.kube, "services", self.generate_service(viewer, labels),

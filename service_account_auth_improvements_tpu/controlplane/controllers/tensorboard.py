@@ -33,6 +33,9 @@ from service_account_auth_improvements_tpu.controlplane.engine import (
     Request,
     Result,
 )
+from service_account_auth_improvements_tpu.controlplane.events import (
+    EventRecorder,
+)
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.utils.env import (
     get_env_bool,
@@ -67,6 +70,7 @@ class TensorboardReconciler(Reconciler):
 
     def __init__(self, kube):
         self.kube = kube
+        self.recorder = EventRecorder(kube, "tensorboard-controller")
         self.image = get_env_default("TENSORBOARD_IMAGE", DEFAULT_IMAGE)
         self.use_istio = get_env_bool("USE_ISTIO", False)
         self.istio_gateway = get_env_default(
@@ -95,10 +99,21 @@ class TensorboardReconciler(Reconciler):
             # (reference :84-90).
             return Result()
 
+        fresh = False
+        try:
+            self.kube.get("deployments", req.name, namespace=req.namespace,
+                          group="apps")
+        except errors.NotFound:
+            fresh = True
         deploy, _ = helpers.ensure(
             self.kube, "deployments", self.generate_deployment(tb),
             group="apps",
         )
+        if fresh:
+            self.recorder.event(
+                tb, "Normal", "CreatedDeployment",
+                f"Created Deployment {req.namespace}/{req.name}",
+            )
         helpers.ensure(
             self.kube, "services", self.generate_service(tb),
             copy_fields=helpers.copy_service_fields,

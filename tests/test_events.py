@@ -1,0 +1,312 @@
+"""Controller Events: recording, aggregation, and pod/STS re-emission.
+
+The reference's most user-visible debugging surface: the notebook
+reconciler re-emits child events onto the Notebook CR
+(notebook_controller.go:94-122) so the spawner UI can show image-pull
+errors and scheduling failures. These tests cover the recorder itself
+and the full fake-kube path down to the jupyter web app's events list.
+"""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.events import (
+    EventRecorder,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+from service_account_auth_improvements_tpu.webapps.jupyter.app import (
+    build_app,
+)
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _events_for(kube, ns, kind, name):
+    return [
+        e for e in kube.list("events", namespace=ns)["items"]
+        if (e.get("involvedObject") or {}).get("kind") == kind
+        and (e.get("involvedObject") or {}).get("name") == name
+    ]
+
+
+# ------------------------------------------------------------- recorder
+
+
+def test_recorder_creates_event_with_involved_object():
+    kube = FakeKube()
+    rec = EventRecorder(kube, "test-controller")
+    nb = {"apiVersion": "tpukf.dev/v1beta1", "kind": "Notebook",
+          "metadata": {"name": "nb1", "namespace": "user1", "uid": "u-1"}}
+    rec.event(nb, "Warning", "FailedCreate", "boom")
+    evs = _events_for(kube, "user1", "Notebook", "nb1")
+    assert len(evs) == 1
+    ev = evs[0]
+    assert ev["reason"] == "FailedCreate"
+    assert ev["type"] == "Warning"
+    assert ev["count"] == 1
+    assert ev["source"]["component"] == "test-controller"
+    assert ev["involvedObject"]["uid"] == "u-1"
+
+
+def test_recorder_aggregates_repeats_into_count_bump():
+    kube = FakeKube()
+    rec = EventRecorder(kube, "test-controller")
+    nb = {"kind": "Notebook",
+          "metadata": {"name": "nb1", "namespace": "user1"}}
+    for _ in range(3):
+        rec.event(nb, "Warning", "FailedCreate", "boom")
+    evs = _events_for(kube, "user1", "Notebook", "nb1")
+    assert len(evs) == 1, "repeats must aggregate, not accumulate"
+    assert evs[0]["count"] == 3
+
+
+def test_recorder_distinct_messages_make_distinct_events():
+    kube = FakeKube()
+    rec = EventRecorder(kube, "test-controller")
+    nb = {"kind": "Notebook",
+          "metadata": {"name": "nb1", "namespace": "user1"}}
+    rec.event(nb, "Warning", "FailedCreate", "boom")
+    rec.event(nb, "Warning", "FailedCreate", "other boom")
+    assert len(_events_for(kube, "user1", "Notebook", "nb1")) == 2
+
+
+def test_recorder_swallows_api_errors():
+    class DeadKube:
+        def get(self, *a, **kw):
+            from service_account_auth_improvements_tpu.controlplane.kube import (
+                errors,
+            )
+            raise errors.ApiError("apiserver down")
+
+        create = patch = get
+
+    rec = EventRecorder(DeadKube(), "test-controller")
+    # must not raise — losing an event can't fail a reconcile
+    rec.event({"kind": "Notebook",
+               "metadata": {"name": "n", "namespace": "ns"}},
+              "Normal", "X", "y")
+
+
+# ---------------------------------------------------- controller e2e
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    NotebookReconciler(kube).register(mgr)
+    mgr.start()
+    yield kube, mgr
+    mgr.stop()
+
+
+def _nb(name="nb1", ns="user1"):
+    return {
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "notebook", "image": "ghcr.io/tpukf/jupyter:x"}
+        ]}}},
+    }
+
+
+def test_reconcile_emits_created_statefulset_event(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _events_for(kube, "user1", "Notebook", "nb1"))
+    reasons = {e["reason"]
+               for e in _events_for(kube, "user1", "Notebook", "nb1")}
+    assert "CreatedStatefulSet" in reasons
+
+
+def test_pod_image_pull_failure_reemitted_onto_notebook(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: kube.list("statefulsets", namespace="user1",
+                                   group="apps")["items"])
+    # kubelet-side: the pod exists and an ImagePullBackOff event fires
+    kube.create("pods", {
+        "metadata": {"name": "nb1-0", "namespace": "user1",
+                     "labels": {"notebook-name": "nb1",
+                                "statefulset": "nb1"}},
+        "spec": {}, "status": {},
+    })
+    kube.create("events", {
+        "metadata": {"name": "nb1-0.pullfail", "namespace": "user1"},
+        "involvedObject": {"kind": "Pod", "name": "nb1-0",
+                           "namespace": "user1"},
+        "type": "Warning",
+        "reason": "Failed",
+        "message": 'Failed to pull image "ghcr.io/tpukf/jupyter:x"',
+    })
+
+    def reemitted():
+        return [e for e in _events_for(kube, "user1", "Notebook", "nb1")
+                if "Reissued from pod/nb1-0" in e.get("message", "")]
+
+    assert _wait(reemitted), "pod event must be re-emitted onto the CR"
+    ev = reemitted()[0]
+    assert ev["type"] == "Warning"
+    assert ev["reason"] == "Failed"
+    assert 'Failed to pull image' in ev["message"]
+
+
+def test_statefulset_event_reemitted_onto_notebook(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: kube.list("statefulsets", namespace="user1",
+                                   group="apps")["items"])
+    kube.create("events", {
+        "metadata": {"name": "nb1.stsfail", "namespace": "user1"},
+        "involvedObject": {"kind": "StatefulSet", "name": "nb1",
+                           "namespace": "user1"},
+        "type": "Warning",
+        "reason": "FailedCreate",
+        "message": "create Pod nb1-0 in StatefulSet nb1 failed",
+    })
+
+    def reemitted():
+        return [e for e in _events_for(kube, "user1", "Notebook", "nb1")
+                if "Reissued from statefulset/nb1" in e.get("message", "")]
+
+    assert _wait(reemitted)
+
+
+def test_unrelated_events_not_reemitted(world):
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: kube.list("statefulsets", namespace="user1",
+                                   group="apps")["items"])
+    kube.create("events", {
+        "metadata": {"name": "other.ev", "namespace": "user1"},
+        "involvedObject": {"kind": "Deployment", "name": "other",
+                           "namespace": "user1"},
+        "type": "Warning", "reason": "X", "message": "y",
+    })
+    kube.create("events", {
+        "metadata": {"name": "stray-pod.ev", "namespace": "user1"},
+        "involvedObject": {"kind": "Pod", "name": "stray-pod",
+                           "namespace": "user1"},
+        "type": "Warning", "reason": "X", "message": "y",
+    })
+    time.sleep(0.3)
+    assert not [
+        e for e in _events_for(kube, "user1", "Notebook", "nb1")
+        if "Reissued" in e.get("message", "")
+    ]
+
+
+# ------------------------------------------------------- webapp surface
+
+
+def test_jupyter_app_events_list_nonempty_after_pull_failure(world):
+    """The VERDICT acceptance: the spawner UI's events list actually
+    shows the failure (reference JWA: apps/common/status.py feeds the
+    frontend from these events)."""
+    kube, _ = world
+    app = build_app(kube, mode="dev")
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: kube.list("statefulsets", namespace="user1",
+                                   group="apps")["items"])
+    kube.create("pods", {
+        "metadata": {"name": "nb1-0", "namespace": "user1",
+                     "labels": {"notebook-name": "nb1"}},
+        "spec": {}, "status": {},
+    })
+    kube.create("events", {
+        "metadata": {"name": "nb1-0.pullfail", "namespace": "user1"},
+        "involvedObject": {"kind": "Pod", "name": "nb1-0",
+                           "namespace": "user1"},
+        "type": "Warning", "reason": "Failed",
+        "message": "Failed to pull image",
+    })
+    assert _wait(lambda: [
+        e for e in _events_for(kube, "user1", "Notebook", "nb1")
+        if "Reissued" in e.get("message", "")
+    ])
+
+    import io
+    import json
+
+    environ = {
+        "REQUEST_METHOD": "GET",
+        "PATH_INFO": "/api/namespaces/user1/notebooks/nb1",
+        "QUERY_STRING": "", "CONTENT_LENGTH": "0",
+        "wsgi.input": io.BytesIO(b""),
+    }
+    out = {}
+
+    def sr(status_line, hdrs):
+        out["code"] = int(status_line.split()[0])
+
+    body = json.loads(b"".join(app(environ, sr)))
+    assert out["code"] == 200
+    assert body["events"], "JWA events list must be non-empty"
+    assert any("Reissued" in e.get("message", "") for e in body["events"])
+
+
+def test_tensorboard_and_pvcviewer_emit_created_events():
+    from service_account_auth_improvements_tpu.controlplane.controllers.pvcviewer import (
+        PVCViewerReconciler,
+    )
+    from service_account_auth_improvements_tpu.controlplane.controllers.tensorboard import (
+        TensorboardReconciler,
+    )
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+
+    kube = FakeKube()
+    kube.create("tensorboards", {
+        "metadata": {"name": "tb1", "namespace": "user1"},
+        "spec": {"logspath": "pvc://logs/tb"},
+    }, group="tpukf.dev")
+    TensorboardReconciler(kube).reconcile(Request("user1", "tb1"))
+    assert any(e["reason"] == "CreatedDeployment"
+               for e in _events_for(kube, "user1", "Tensorboard", "tb1"))
+
+    kube.create("persistentvolumeclaims", {
+        "metadata": {"name": "data", "namespace": "user1"},
+        "spec": {"accessModes": ["ReadWriteOnce"]},
+    })
+    kube.create("pvcviewers", {
+        "metadata": {"name": "v1", "namespace": "user1"},
+        "spec": {"pvc": "data"},
+    }, group="tpukf.dev")
+    PVCViewerReconciler(kube).reconcile(Request("user1", "v1"))
+    assert any(e["reason"] == "CreatedDeployment"
+               for e in _events_for(kube, "user1", "PVCViewer", "v1"))
+
+
+def test_culling_emits_culled_event(monkeypatch):
+    import datetime as dt
+
+    from service_account_auth_improvements_tpu.controlplane.controllers.culling import (
+        CullingReconciler,
+    )
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+
+    monkeypatch.setenv("CULL_IDLE_TIME", "60")
+    kube = FakeKube()
+    kube.create("notebooks", _nb())
+    now = dt.datetime(2026, 7, 29, 12, 0, tzinfo=dt.timezone.utc)
+    idle = [{"execution_state": "idle",
+             "last_activity": "2026-07-29T00:00:00Z"}]
+    rec = CullingReconciler(kube, fetch_kernels=lambda url: idle,
+                            now=lambda: now)
+    rec.reconcile(Request("user1", "nb1"))
+    evs = _events_for(kube, "user1", "Notebook", "nb1")
+    assert any(e["reason"] == "Culled" for e in evs)
