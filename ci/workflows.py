@@ -215,7 +215,7 @@ def publish_workflow() -> dict:
         step = {
             "name": f"Publish {d} ({tag})",
             "env": {"REGISTRY": "ghcr.io/${{ github.repository_owner }}"},
-            "run": f"TAG={tag} ARCH=linux/amd64,linux/arm64 "
+            "run": f"TAG={tag} PUSH_ARCH=linux/amd64,linux/arm64 "
                    f"make -C images/{d} docker-build-push-multi-arch "
                    "REGISTRY=$REGISTRY",
         }

@@ -8,7 +8,11 @@
 
 REGISTRY ?= ghcr.io/tpukf
 TAG      ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
-ARCH     ?= linux/amd64,linux/arm64
+# ARCH feeds the --load build targets, which can only export a single
+# platform — so it defaults to one; override per-invocation (CI does).
+# PUSH_ARCH feeds the push target, which can export a manifest list.
+ARCH      ?= linux/amd64
+PUSH_ARCH ?= linux/amd64,linux/arm64
 
 IMAGE_REF := $(REGISTRY)/$(IMAGE_NAME)
 
@@ -47,6 +51,6 @@ docker-build-multi-arch-dep--%:
 # example-notebook-servers/common.mk docker-build-push-multi-arch)
 .PHONY: docker-build-push-multi-arch
 docker-build-push-multi-arch:
-	docker buildx build --push --platform $(ARCH) \
+	docker buildx build --push --platform $(PUSH_ARCH) \
 		--build-arg BASE_IMG=$(BASE_IMAGE) \
 		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .

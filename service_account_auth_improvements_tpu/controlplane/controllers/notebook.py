@@ -20,6 +20,10 @@ components/notebook-controller/controllers/notebook_controller.go:89-225):
 from __future__ import annotations
 
 import copy
+import json
+import logging
+import queue
+import threading
 
 from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers import (
@@ -46,11 +50,24 @@ from service_account_auth_improvements_tpu.utils.env import (
     get_env_default,
 )
 
+log = logging.getLogger(__name__)
+
 GROUP = "tpukf.dev"
 STOP_ANNOTATION = "tpukf.dev/resource-stopped"
 NOTEBOOK_PORT = 8888
 SERVICE_PORT = 80
 DEFAULT_CONTAINER = "notebook"
+MAX_STATUS_CONDITIONS = 20
+REEMIT_MAX_ATTEMPTS = 3
+REEMIT_RETRY_DELAY = 0.5
+
+# Per-CR VirtualService customization (reference reads the analogous
+# notebooks.kubeflow.org/* annotations at notebook_controller.go:484-486,
+# 521-528): code-server rewrites to "/", RStudio additionally needs its
+# root path in a request header — the spawner form writes these for
+# group-one/group-two servers (webapps/jupyter/form.py set_server_type).
+ANNOTATION_REWRITE_URI = "notebooks.tpukf.dev/http-rewrite-uri"
+ANNOTATION_HEADERS_REQUEST_SET = "notebooks.tpukf.dev/http-headers-request-set"
 
 
 class NotebookMetrics:
@@ -86,6 +103,14 @@ class NotebookReconciler(Reconciler):
         )
         self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
         self.add_fsgroup = get_env_bool("ADD_FSGROUP", True)
+        # Re-emission work queue: the events-informer watch thread only
+        # enqueues; API round-trips happen on a dedicated worker so a busy
+        # cluster can't head-of-line-block event delivery (the reference
+        # routes these through the reconcile workqueue,
+        # notebook_controller.go:94-122).
+        self._reemit_q: queue.Queue = queue.Queue()
+        self._reemit_thread: threading.Thread | None = None
+        self._reemit_stop = threading.Event()
 
     # ------------------------------------------------------------ wiring
 
@@ -95,11 +120,11 @@ class NotebookReconciler(Reconciler):
                             owner_kind="Notebook")
         manager.watch_owned(ctl, "services", owner_kind="Notebook")
         manager.watch_mapped(ctl, "pods", self._map_pod)
-        # re-emit child pod/STS events onto the CR — the reference routes
-        # these through the reconcile queue (notebook_controller.go:94-122);
-        # handled directly on the watch here so re-emission can't be
-        # coalesced away by queue dedup
-        manager.informer("events").add_handler(self._on_event)
+        # re-emit child pod/STS events onto the CR via a dedicated work
+        # queue (never coalesced by reconcile-queue dedup, never blocking
+        # the watch thread)
+        manager.informer("events").add_handler(self._enqueue_event)
+        self._start_reemit_worker()
         return self
 
     @staticmethod
@@ -110,34 +135,83 @@ class NotebookReconciler(Reconciler):
             return [Request(pod["metadata"].get("namespace"), name)]
         return []
 
-    def _on_event(self, ev_type, event) -> None:
+    def _enqueue_event(self, ev_type, event) -> None:
+        """Watch-thread side: filter cheaply, enqueue for the worker."""
+        if ev_type == "DELETED":
+            return
+        kind, _ = involved_kind_and_name(event)
+        if kind not in ("StatefulSet", "Pod"):
+            return
+        self._reemit_q.put((event, 0))
+
+    def _start_reemit_worker(self) -> None:
+        if self._reemit_thread is not None:
+            return
+        self._reemit_thread = threading.Thread(
+            target=self._reemit_loop, name="notebook-event-reemit",
+            daemon=True,
+        )
+        self._reemit_thread.start()
+
+    def shutdown(self) -> None:
+        self._reemit_stop.set()
+        self._reemit_q.put(None)
+
+    def _reemit_loop(self) -> None:
+        while not self._reemit_stop.is_set():
+            item = self._reemit_q.get()
+            if item is None:
+                return
+            event, attempts = item
+            try:
+                self._reemit(event)
+            except errors.NotFound:
+                pass  # pod/notebook gone — event is moot, drop
+            except Exception as e:
+                # broad catch: the production transport (KubeClient) raises
+                # raw OSError/ConnectionError on network blips, not just
+                # ApiError — any of them must not kill the worker thread.
+                # Retry a bounded number of times rather than silently
+                # losing the re-emission; the delay rides a timer so the
+                # worker never sleeps (no head-of-line blocking of other
+                # queued events).
+                if attempts + 1 < REEMIT_MAX_ATTEMPTS:
+                    t = threading.Timer(
+                        REEMIT_RETRY_DELAY,
+                        self._reemit_q.put, args=((event, attempts + 1),),
+                    )
+                    t.daemon = True
+                    t.start()
+                else:
+                    log.warning("event re-emission dropped after %d "
+                                "attempts: %s", attempts + 1, e)
+
+    def _reemit(self, event: dict) -> None:
         """Re-emit a child pod/STS event onto the owning Notebook
         (reference: notebook_controller.go:109-117 "Reissued from ...",
         filters nbNameFromInvolvedObject :611-641)."""
-        if ev_type == "DELETED":
-            return
         kind, obj_name = involved_kind_and_name(event)
         ns = event["metadata"].get("namespace")
         if kind == "StatefulSet":
             nb_name = obj_name
-        elif kind == "Pod":
+        else:
             try:
                 pod = self.kube.get("pods", obj_name, namespace=ns)
-            except errors.ApiError:
-                return
+            except errors.NotFound:
+                return  # stray event for a pod we never knew — drop
             nb_name = (pod["metadata"].get("labels") or {}).get(
                 "notebook-name"
             )
-        else:
-            return
         if not nb_name:
             return
         try:
             nb = self.kube.get("notebooks", nb_name, namespace=ns,
                                group=GROUP)
-        except errors.ApiError:
-            return
-        self.recorder.event(
+        except errors.NotFound:
+            return  # not one of ours (e.g. a bare STS named like no CR)
+        # raising variant (not the fire-and-forget ``event()``) so a failed
+        # write propagates to the worker's bounded retry
+        self.recorder.emit(
             nb, event.get("type") or "Normal",
             event.get("reason") or "ChildEvent",
             f"Reissued from {kind.lower()}/{obj_name}: "
@@ -332,8 +406,33 @@ class NotebookReconciler(Reconciler):
     def generate_virtual_service(self, nb: dict) -> dict:
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
+        annotations = nb["metadata"].get("annotations") or {}
         prefix = f"/notebook/{ns}/{name}/"
         host = f"{name}.{ns}.svc.{self.cluster_domain}"
+        # code-server/RStudio serve from "/" — honor the per-CR rewrite
+        # annotation (reference: notebook_controller.go:484-488)
+        rewrite = annotations.get(ANNOTATION_REWRITE_URI) or prefix
+        http_route: dict = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "rewrite": {"uri": rewrite},
+            "route": [{"destination": {
+                "host": host, "port": {"number": SERVICE_PORT},
+            }}],
+            "timeout": "300s",
+        }
+        # request-header injection, a JSON object in the annotation
+        # (reference: notebook_controller.go:521-533 — malformed JSON
+        # degrades to no headers, never a failed reconcile)
+        raw = annotations.get(ANNOTATION_HEADERS_REQUEST_SET)
+        if raw:
+            try:
+                headers = json.loads(raw)
+            except ValueError:
+                headers = None
+            if isinstance(headers, dict) and headers:
+                http_route["headers"] = {"request": {"set": {
+                    str(k): str(v) for k, v in headers.items()
+                }}}
         return {
             "apiVersion": "networking.istio.io/v1beta1",
             "kind": "VirtualService",
@@ -345,14 +444,7 @@ class NotebookReconciler(Reconciler):
             "spec": {
                 "hosts": ["*"],
                 "gateways": [self.istio_gateway],
-                "http": [{
-                    "match": [{"uri": {"prefix": prefix}}],
-                    "rewrite": {"uri": prefix},
-                    "route": [{"destination": {
-                        "host": host, "port": {"number": SERVICE_PORT},
-                    }}],
-                    "timeout": "300s",
-                }],
+                "http": [http_route],
             },
         }
 
@@ -377,9 +469,9 @@ class NotebookReconciler(Reconciler):
                     status["containerState"] = state
                     cond = self._condition_from_state(state)
                     if cond:
-                        conds = status["conditions"]
-                        if not conds or conds[-1].get("type") != cond["type"]:
-                            conds.append(cond)
+                        status["conditions"] = self._append_condition(
+                            status["conditions"], cond
+                        )
                     break
         if self._stopped(nb):
             self.metrics.running.labels(ns).set(0)
@@ -400,6 +492,23 @@ class NotebookReconciler(Reconciler):
         ).get("containers") or []
         return (containers[0].get("name") if containers
                 else DEFAULT_CONTAINER) or DEFAULT_CONTAINER
+
+    @staticmethod
+    def _append_condition(conds: list, cond: dict) -> list:
+        """Append with dedupe + cap so a pod flapping Running↔Waiting can't
+        grow status.conditions without bound (the reference has this flaw at
+        notebook_controller.go:243-302; copying it is not parity worth
+        keeping). A repeat of the latest type refreshes it in place; history
+        keeps the most recent MAX_STATUS_CONDITIONS entries."""
+        if conds and conds[-1].get("type") == cond["type"]:
+            merged = dict(conds[-1])
+            merged.update(cond)
+            conds = conds[:-1] + [merged]
+        else:
+            conds = conds + [cond]
+        # cap on both branches so a list oversized by the pre-cap version
+        # shrinks on the next refresh too
+        return conds[-MAX_STATUS_CONDITIONS:]
 
     @staticmethod
     def _condition_from_state(state: dict) -> dict | None:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import logging
 import threading
 
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
 log = logging.getLogger(__name__)
 
 
@@ -70,28 +72,41 @@ class Informer:
             except Exception:  # handler bugs must not kill the watch loop
                 log.exception("informer handler failed (%s)", self.plural)
 
+    def _relist(self) -> str:
+        """Full list: replace the cache, dispatch deltas, return the list RV.
+
+        Expensive (O(objects) apiserver load) — performed once at startup
+        and again only when the watch RV has been compacted away (410), the
+        client-go reflector contract. Between relists, watches resume from
+        the last seen resourceVersion.
+        """
+        listing = self.client.list(
+            self.plural, namespace=self.namespace, group=self.group
+        )
+        rv = listing["metadata"].get("resourceVersion", "0")
+        fresh = {self._key(o): o for o in listing.get("items", [])}
+        with self._lock:
+            # Keep the last-known objects for keys that vanished while
+            # the watch was down — handlers (e.g. Owns mapping by
+            # ownerReferences) need the real object, not a stub.
+            stale_objs = [
+                obj for key, obj in self._cache.items()
+                if key not in fresh
+            ]
+            self._cache = fresh
+        for obj in stale_objs:
+            self._dispatch("DELETED", obj)
+        for obj in fresh.values():
+            self._dispatch("SYNC", obj)
+        self._synced.set()
+        return rv
+
     def _run(self) -> None:
+        rv: str | None = None  # None → must (re)list before watching
         while not self._stop.is_set():
             try:
-                listing = self.client.list(
-                    self.plural, namespace=self.namespace, group=self.group
-                )
-                rv = listing["metadata"].get("resourceVersion", "0")
-                fresh = {self._key(o): o for o in listing.get("items", [])}
-                with self._lock:
-                    # Keep the last-known objects for keys that vanished while
-                    # the watch was down — handlers (e.g. Owns mapping by
-                    # ownerReferences) need the real object, not a stub.
-                    stale_objs = [
-                        obj for key, obj in self._cache.items()
-                        if key not in fresh
-                    ]
-                    self._cache = fresh
-                for obj in stale_objs:
-                    self._dispatch("DELETED", obj)
-                for obj in fresh.values():
-                    self._dispatch("SYNC", obj)
-                self._synced.set()
+                if rv is None:
+                    rv = self._relist()
                 for ev in self.client.watch(
                     self.plural, namespace=self.namespace,
                     resource_version=rv, group=self.group,
@@ -100,6 +115,24 @@ class Informer:
                     if self._stop.is_set():
                         return
                     et, obj = ev.get("type"), ev.get("object")
+                    if et == "ERROR":
+                        # in-stream Status object: 410/Expired means our RV
+                        # was compacted → relist; anything else → back off
+                        # briefly, then re-watch (no tight retry loop)
+                        status = obj or {}
+                        if (status.get("code") == 410
+                                or status.get("reason") in ("Expired",
+                                                            "Gone")):
+                            rv = None
+                        else:
+                            self._stop.wait(1.0)
+                        break
+                    if obj is not None:
+                        new_rv = (obj.get("metadata") or {}).get(
+                            "resourceVersion"
+                        )
+                        if new_rv:
+                            rv = new_rv
                     if et == "BOOKMARK" or obj is None:
                         continue
                     key = self._key(obj)
@@ -109,6 +142,12 @@ class Informer:
                         else:
                             self._cache[key] = obj
                     self._dispatch(et, obj)
+                # normal watch expiry (timeout): re-watch from the last RV
+                # without relisting
+            except errors.Gone:
+                log.info("informer %s: resourceVersion expired; relisting",
+                         self.plural)
+                rv = None
             except Exception:
                 if self._stop.is_set():
                     return

@@ -46,13 +46,15 @@ class EventRecorder:
     def event(self, obj: dict, etype: str, reason: str,
               message: str) -> None:
         try:
-            self._event(obj, etype, reason, message)
+            self.emit(obj, etype, reason, message)
         except errors.ApiError as e:
             log.warning("event %s/%s dropped: %s", reason,
                         obj["metadata"].get("name"), e)
 
-    def _event(self, obj: dict, etype: str, reason: str,
-               message: str) -> None:
+    def emit(self, obj: dict, etype: str, reason: str,
+             message: str) -> None:
+        """Raising variant of ``event()`` — for callers with their own
+        retry policy (e.g. the notebook re-emission worker)."""
         meta = obj["metadata"]
         namespace = meta.get("namespace")
         involved = {
@@ -62,8 +64,14 @@ class EventRecorder:
             "namespace": namespace,
             "uid": meta.get("uid", ""),
         }
+        # The digest must include the recorder's component (and namespace):
+        # two controllers emitting the same (kind, name, type, reason,
+        # message) would otherwise collide on one Event object and the
+        # second write would be mis-attributed to the first's
+        # source.component.
         digest = hashlib.sha1(
-            "\x00".join((involved["kind"], involved["name"], etype, reason,
+            "\x00".join((self.component, namespace or "", involved["kind"],
+                         involved["name"], etype, reason,
                          message)).encode()
         ).hexdigest()[:12]
         name = f"{meta['name']}.{digest}"
@@ -96,9 +104,15 @@ class EventRecorder:
                 "reportingComponent": self.component,
             }, namespace=namespace)
         except errors.AlreadyExists:
-            # lost a create race with another worker — fold into a bump
+            # lost a create race with another worker — re-read the winner's
+            # count so occurrences aren't undercounted, then fold into a bump
+            try:
+                existing = self.kube.get("events", name, namespace=namespace)
+                count = int(existing.get("count") or 1) + 1
+            except errors.ApiError:
+                count = 2
             self.kube.patch("events", name,
-                            {"count": 2, "lastTimestamp": now},
+                            {"count": count, "lastTimestamp": now},
                             namespace=namespace)
 
 

@@ -35,6 +35,13 @@ GROUP = "tpukf.dev"
 RBAC_GROUP = "rbac.authorization.k8s.io"
 ISTIO_SEC = "security.istio.io"
 
+# Contributor roles a namespace owner may grant. The role is interpolated
+# into ``ClusterRole kubeflow-<role>``; without this allowlist an owner
+# could bind a contributor to ANY kubeflow-* ClusterRole (e.g.
+# kubeflow-admin), escalating beyond the reference's intended contributor
+# set (access-management/kfam/bindings.go:61-141 only ever grants edit).
+ALLOWED_ROLES = ("edit", "view")
+
 
 def safe_email(email: str) -> str:
     return re.sub(r"[^a-z0-9]", "-", email.lower())
@@ -87,12 +94,22 @@ class KfamApp:
     def _authorized(self, user: str, namespace: str) -> bool:
         return self._is_cluster_admin(user) or self._is_owner(user, namespace)
 
+    @staticmethod
+    def _checked_role(body: dict) -> str:
+        role = ((body.get("roleRef") or {}).get("name")) or "edit"
+        if role not in ALLOWED_ROLES:
+            raise ValueError(
+                f"role {role!r} is not a grantable contributor role "
+                f"(allowed: {', '.join(ALLOWED_ROLES)})"
+            )
+        return role
+
     # ------------------------------------------------------------- actions
 
     def create_binding(self, body: dict) -> None:
         user = ((body.get("user") or {}).get("name")) or ""
         namespace = body.get("referredNamespace") or ""
-        role = ((body.get("roleRef") or {}).get("name")) or "edit"
+        role = self._checked_role(body)
         name = binding_name(user, role)
         rb = {
             "apiVersion": f"{RBAC_GROUP}/v1",
@@ -135,6 +152,8 @@ class KfamApp:
     def delete_binding(self, body: dict) -> None:
         user = ((body.get("user") or {}).get("name")) or ""
         namespace = body.get("referredNamespace") or ""
+        # deletion is not an escalation vector — no allowlist here, so
+        # bindings created before the allowlist existed remain deletable
         role = ((body.get("roleRef") or {}).get("name")) or "edit"
         name = binding_name(user, role)
         for plural, group in (("rolebindings", RBAC_GROUP),

@@ -26,9 +26,10 @@ class ApiError(Exception):
         code = status.get("code", 500)
         msg = status.get("message", "")
         for cls in (NotFound, Conflict, AlreadyExists, BadRequest, Forbidden,
-                    Invalid):
+                    Invalid, Gone):
             if cls.code == code and (
-                cls.reason == status.get("reason") or cls is NotFound
+                cls.reason == status.get("reason")
+                or cls in (NotFound, Gone)
             ):
                 return cls(msg)
         err = ApiError(msg)
@@ -64,6 +65,13 @@ class Forbidden(ApiError):
 class Invalid(ApiError):
     code = 422
     reason = "Invalid"
+
+
+class Gone(ApiError):
+    """410: the requested resourceVersion has been compacted away — the
+    apiserver's signal that a watcher must relist (reason "Expired")."""
+    code = 410
+    reason = "Expired"
 
 
 def is_not_found(e: Exception) -> bool:

@@ -22,6 +22,7 @@ import queue
 import threading
 import time
 import uuid
+import weakref
 
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.kube.registry import (
@@ -131,6 +132,7 @@ class FakeKube:
         self._store: dict[tuple, dict] = {}     # (group,plural,ns,name) -> obj
         self._rv = 0
         self._history: dict[tuple, list] = {}   # (group,plural) -> [(rv, ev)]
+        self._pruned: dict[tuple, int] = {}     # (group,plural) -> last rv dropped
         self._watches: list[_Watch] = []
         self.sar_hook = None  # SubjectAccessReview callback (web tier)
 
@@ -156,6 +158,8 @@ class FakeKube:
         event = {"type": ev_type, "object": copy.deepcopy(obj)}
         self._history.setdefault(hkey, []).append((rv, event))
         if len(self._history[hkey]) > 4096:
+            dropped = self._history[hkey][:-2048]
+            self._pruned[hkey] = dropped[-1][0]
             self._history[hkey] = self._history[hkey][-2048:]
         for w in self._watches:
             if w.key == hkey and not w.closed:
@@ -359,6 +363,11 @@ class FakeKube:
         obj = self._store.pop(key, None)
         if obj is None:
             return
+        # a real apiserver bumps the RV on delete; emitting the stale
+        # pre-delete RV would make a resume-from-last-RV watcher (the
+        # informer) drop the DELETED event from its backlog — or regress
+        # its tracked RV and replay newer events
+        obj["metadata"]["resourceVersion"] = str(self._bump())
         self._emit(res, "DELETED", obj)
         # ownerReference cascade (synchronous; foreground-ish for tests).
         uid = obj["metadata"].get("uid")
@@ -385,36 +394,76 @@ class FakeKube:
     def watch(self, plural: str, namespace: str | None = None,
               resource_version: str | int = 0, group: str | None = None,
               timeout: float | None = None):
-        """Yield watch events {type, object} after ``resource_version``.
+        """Return a generator of watch events {type, object} after
+        ``resource_version``.
 
-        Generator blocks waiting for events; ends after ``timeout`` seconds
-        of inactivity if given (else runs until closed by the caller).
+        The expired-RV check and backlog snapshot happen EAGERLY at call
+        time — so 410 Gone raises here, before any stream bytes are
+        produced (the wire layer must be able to answer with an HTTP 410
+        status, not a truncated 200 stream). The returned generator blocks
+        waiting for events; it ends after ``timeout`` seconds of inactivity
+        if given (else runs until closed by the caller).
         """
         res = self._res(plural, group)
         hkey = (res.group, res.plural)
         rv = int(resource_version or 0)
         w = _Watch(hkey, rv)
         with self._lock:
+            # a nonzero start-RV older than the retained history window is
+            # exactly the apiserver's "too old resource version" — the
+            # watcher must relist (kube semantics: 410 Gone / Expired)
+            if rv and rv < self._pruned.get(hkey, 0):
+                raise errors.Gone(
+                    f"too old resource version: {rv} "
+                    f"(oldest retained: {self._pruned[hkey] + 1})"
+                )
             backlog = [
                 ev for (erv, ev) in self._history.get(hkey, []) if erv > rv
             ]
             self._watches.append(w)
-        try:
-            for ev in backlog:
-                yield self._filter_ns(ev, res, namespace)
-            while not w.closed:
-                try:
-                    ev = w.q.get(timeout=timeout if timeout else 0.5)
-                except queue.Empty:
-                    if timeout:
-                        return
-                    continue
-                yield self._filter_ns(ev, res, namespace)
-        finally:
+
+        def cleanup():
             w.closed = True
             with self._lock:
                 if w in self._watches:
                     self._watches.remove(w)
+
+        def stream():
+            try:
+                for ev in backlog:
+                    yield self._filter_ns(ev, res, namespace)
+                while not w.closed:
+                    try:
+                        ev = w.q.get(timeout=timeout if timeout else 0.5)
+                    except queue.Empty:
+                        if timeout:
+                            return
+                        continue
+                    yield self._filter_ns(ev, res, namespace)
+            finally:
+                cleanup()
+
+        gen = stream()
+        # registration is eager (no event gap between the backlog snapshot
+        # and iteration), so a generator that is never started must still
+        # deregister — close() on a never-started generator skips finally
+        weakref.finalize(gen, cleanup)
+        return gen
+
+    def compact_history(self, plural: str | None = None,
+                        group: str | None = None) -> None:
+        """Drop retained watch history (test helper): the next watch from a
+        pre-compaction RV gets 410 Gone, like an etcd compaction."""
+        with self._lock:
+            if plural is None:
+                keys = list(self._history)
+            else:
+                res = self._res(plural, group)
+                keys = [(res.group, res.plural)]
+            for hkey in keys:
+                if self._history.get(hkey):
+                    self._pruned[hkey] = self._history[hkey][-1][0]
+                    self._history[hkey] = []
 
     def _filter_ns(self, ev, res, namespace):
         if namespace and res.namespaced:

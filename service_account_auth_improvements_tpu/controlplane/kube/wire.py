@@ -61,15 +61,20 @@ def handle(fake, environ, start_response):
             if qs.get("watch", ["false"])[0] == "true":
                 rv = qs.get("resourceVersion", ["0"])[0]
                 timeout = float(qs.get("timeoutSeconds", ["30"])[0])
+                # eager call: an expired RV raises Gone HERE so the client
+                # gets a real HTTP 410 (a lazy check after start_response
+                # would surface as a truncated 200 stream and the watcher
+                # would re-watch the same stale RV forever)
+                events = fake.watch(
+                    res.plural, namespace=namespace,
+                    resource_version=rv, timeout=timeout, **kwargs
+                )
                 start_response(
                     "200 OK", [("Content-Type", "application/json")]
                 )
 
                 def stream():
-                    for ev in fake.watch(
-                        res.plural, namespace=namespace,
-                        resource_version=rv, timeout=timeout, **kwargs
-                    ):
+                    for ev in events:
                         yield (json.dumps(ev) + "\n").encode()
 
                 return stream()

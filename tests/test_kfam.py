@@ -181,3 +181,49 @@ def test_create_profile_requires_self_or_admin(world):
         "name": "carol", "owner": {"kind": "User", "name": "carol@example.com"},
     }, user="root@example.com")
     assert code == 200
+
+
+def test_role_escalation_blocked(world):
+    """A namespace owner must not be able to bind a contributor to an
+    arbitrary kubeflow-* ClusterRole (e.g. kubeflow-admin) — only the
+    allowlisted contributor roles {edit, view} are grantable."""
+    kube, app = world
+    for role in ("admin", "cluster-admin", "../evil"):
+        code, body = call(app, "POST", "/kfam/v1/bindings", {
+            "user": {"kind": "User", "name": "bob@example.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": role},
+        }, user="alice@example.com")
+        assert code == 400, f"role {role!r} must be rejected"
+    assert not kube.list("rolebindings", namespace="alice",
+                         group=RBAC)["items"]
+    # DELETE is NOT gated — a binding created before the allowlist existed
+    # (the escalation being remediated) must remain deletable.
+    kube.create("rolebindings", {
+        "metadata": {"name": binding_name("bob@example.com", "admin"),
+                     "namespace": "alice",
+                     "annotations": {"user": "bob@example.com",
+                                     "role": "admin"}},
+        "roleRef": {"kind": "ClusterRole", "name": "kubeflow-admin"},
+        "subjects": [],
+    }, group=RBAC)
+    code, _ = call(app, "DELETE", "/kfam/v1/bindings", {
+        "user": {"kind": "User", "name": "bob@example.com"},
+        "referredNamespace": "alice",
+        "roleRef": {"kind": "ClusterRole", "name": "admin"},
+    }, user="alice@example.com")
+    assert code == 200
+    assert not kube.list("rolebindings", namespace="alice",
+                         group=RBAC)["items"]
+    # The allowlisted roles still work.
+    for role in ("edit", "view"):
+        code, _ = call(app, "POST", "/kfam/v1/bindings", {
+            "user": {"kind": "User", "name": "bob@example.com"},
+            "referredNamespace": "alice",
+            "roleRef": {"kind": "ClusterRole", "name": role},
+        }, user="alice@example.com")
+        assert code == 200
+    names = {rb["metadata"]["name"] for rb in
+             kube.list("rolebindings", namespace="alice", group=RBAC)["items"]}
+    assert names == {binding_name("bob@example.com", "edit"),
+                     binding_name("bob@example.com", "view")}

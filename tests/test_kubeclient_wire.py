@@ -91,3 +91,39 @@ def test_watch_over_wire_streams_live_events(server, client):
     kube.create("configmaps", {"metadata": {"name": "cm2", "namespace": "wns"}})
     t.join(timeout=10)
     assert events == [("ADDED", "cm1"), ("ADDED", "cm2")]
+
+
+def test_watch_expired_rv_is_http_410_gone(server):
+    """An expired resourceVersion must round-trip as a real HTTP 410 →
+    errors.Gone — NOT a truncated 200 stream (which a watcher would read
+    as normal expiry and spin on the stale RV forever)."""
+    kube, url = server
+    client = KubeClient(base_url=url)
+    client.create("configmaps", {
+        "metadata": {"name": "g0", "namespace": "ns-gone"}, "data": {}
+    })
+    old_rv = client.list("configmaps",
+                         namespace="ns-gone")["metadata"]["resourceVersion"]
+    client.create("configmaps", {
+        "metadata": {"name": "g1", "namespace": "ns-gone"}, "data": {}
+    })
+    kube.compact_history("configmaps")
+    with pytest.raises(errors.Gone):
+        for _ in client.watch("configmaps", namespace="ns-gone",
+                              resource_version=old_rv, timeout=1):
+            pass
+    # a fresh watch (rv from a new list) still streams events — the g2
+    # create lands in history first and replays as backlog (the server
+    # fixture is single-threaded, so no concurrent request during the
+    # long-poll)
+    rv = client.list("configmaps",
+                     namespace="ns-gone")["metadata"]["resourceVersion"]
+    client.create("configmaps", {
+        "metadata": {"name": "g2", "namespace": "ns-gone"}, "data": {}
+    })
+    seen = []
+    for ev in client.watch("configmaps", namespace="ns-gone",
+                           resource_version=rv, timeout=1):
+        seen.append(ev)
+        break
+    assert seen and seen[0]["object"]["metadata"]["name"] == "g2"

