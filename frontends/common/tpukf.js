@@ -208,8 +208,94 @@
     return node;
   }
 
+  // ------------------------------------------------------- detail widgets
+  // (reference kubeflow-common-lib: conditions-table, logs-viewer, editor)
+
+  // status.conditions -> table (reference lib/conditions-table)
+  function conditionsTable(conditions) {
+    return resourceTable([
+      { title: "Type", render: (c) => c.type },
+      { title: "Status", render: (c) => c.status || "" },
+      { title: "Reason", render: (c) => c.reason || "" },
+      { title: "Message", render: (c) => c.message || "" },
+      { title: "Last seen", render: (c) =>
+          c.lastProbeTime || c.lastTransitionTime || "" },
+    ], conditions || [], "no conditions reported");
+  }
+
+  // events list -> table (reference lib/resource-table event usage)
+  function eventsTable(events) {
+    return resourceTable([
+      { title: "Type", render: (e) =>
+          statusIcon(e.type === "Warning" ? "warning" : "ready", e.type) },
+      { title: "Reason", render: (e) => e.reason || "" },
+      { title: "Message", render: (e) => e.message || "" },
+      { title: "Count", render: (e) => e.count || 1 },
+      { title: "Last seen", render: (e) =>
+          e.lastTimestamp || e.eventTime || "" },
+    ], events || [], "no events");
+  }
+
+  // minimal YAML emitter for the read-only object view (reference ships
+  // Monaco for this; a serializer + <pre> covers the read path without
+  // megabytes of editor)
+  function toYaml(value, indent) {
+    const pad = "  ".repeat(indent || 0);
+    if (value === null || value === undefined) return "null";
+    if (typeof value !== "object") {
+      if (typeof value === "string") {
+        return /^[\w./:@-]*$/.test(value) && value !== "" ?
+          value : JSON.stringify(value);
+      }
+      return String(value);
+    }
+    if (Array.isArray(value)) {
+      if (!value.length) return "[]";
+      return value.map((v) => {
+        const body = toYaml(v, (indent || 0) + 1);
+        return typeof v === "object" && v !== null ?
+          `${pad}-\n${body.replace(/^/, "")}` :
+          `${pad}- ${body}`;
+      }).join("\n");
+    }
+    const keys = Object.keys(value);
+    if (!keys.length) return "{}";
+    return keys.map((k) => {
+      const v = value[k];
+      if (typeof v === "object" && v !== null &&
+          (Array.isArray(v) ? v.length : Object.keys(v).length)) {
+        return `${pad}${k}:\n${toYaml(v, (indent || 0) + 1)}`;
+      }
+      return `${pad}${k}: ${toYaml(v, 0)}`;
+    }).join("\n");
+  }
+
+  function objectView(obj) {
+    return el("pre", { class: "object-view" }, toYaml(obj, 0));
+  }
+
+  // fetchLines: async () => string[]; returns {node, poller}
+  function logsViewer(fetchLines, pollMs) {
+    const pre = el("pre", { class: "logs-view" }, "loading…");
+    let follow = true;
+    async function refresh() {
+      const lines = await fetchLines();
+      pre.textContent = lines.join("\n") || "(no log output)";
+      if (follow) pre.scrollTop = pre.scrollHeight;
+    }
+    pre.addEventListener("scroll", () => {
+      follow = pre.scrollTop + pre.clientHeight >= pre.scrollHeight - 8;
+    });
+    const p = poller(() => refresh().catch((e) => {
+      pre.textContent = e.message;
+      throw e;
+    }), pollMs || 4000);
+    return { node: pre, poller: p };
+  }
+
   window.TpuKF = {
     api, currentNamespace, namespaceInput, snackbar, confirmDialog,
     statusIcon, resourceTable, poller, el,
+    conditionsTable, eventsTable, objectView, logsViewer, toYaml,
   };
 })();

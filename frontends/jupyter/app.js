@@ -5,7 +5,9 @@
 (function () {
   "use strict";
   const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
-          statusIcon, resourceTable, poller, el } = window.TpuKF;
+          statusIcon, resourceTable, poller, el,
+          conditionsTable, eventsTable, objectView, logsViewer } =
+    window.TpuKF;
 
   const main = document.getElementById("main");
   let ns = currentNamespace();
@@ -80,6 +82,9 @@
           window.open(`/notebook/${ns}/${nb.name}/`, "_blank");
         },
       }, "Connect"));
+      row.appendChild(el("button", {
+        onclick: () => { location.hash = `#/details/${nb.name}`; },
+      }, "Details"));
       row.appendChild(el("button", {
         class: "danger",
         onclick: async () => {
@@ -216,9 +221,127 @@
     main.replaceChildren(form);
   }
 
+  // ----------------------------------------------------------- details
+  // (reference JWA notebook details page: overview/logs/events/yaml —
+  // "why is my slice pod Pending/CrashLooping" answered in the UI)
+  let detailPollers = [];
+  let tabEpoch = 0;  // bumped on every tab switch / route change: async
+                     // continuations from a superseded tab must not touch
+                     // the pane or the poller list
+
+  function stopDetailPollers() {
+    tabEpoch++;
+    for (const p of detailPollers) p.stop();
+    detailPollers = [];
+  }
+
+  async function renderDetails(name) {
+    if (listPoller) listPoller.stop();
+    stopDetailPollers();
+    const card = el("div", { class: "card" });
+    const title = el("h3", { style: "margin-top:0" },
+      `${ns}/${name}`);
+    const tabBar = el("div", { class: "row tabs" });
+    const pane = el("div", { class: "tab-pane" });
+    card.append(
+      el("div", { class: "row", style: "justify-content:space-between" },
+        title,
+        el("button", { onclick: () => { location.hash = "#/"; } }, "Back")),
+      tabBar, pane);
+    main.replaceChildren(card);
+
+    async function overviewTab() {
+      stopDetailPollers();
+      const box = el("div", {});
+      pane.replaceChildren(box);
+      const p = poller(async () => {
+        const data = await api(
+          "GET", `api/namespaces/${ns}/notebooks/${name}`);
+        const conds = (data.notebook.status || {}).conditions || [];
+        box.replaceChildren(
+          el("div", { class: "row" },
+            statusIcon(data.summary.status.phase,
+                       data.summary.status.message),
+            el("span", { class: "muted" },
+               data.summary.status.message || "")),
+          el("h4", {}, "Conditions"), conditionsTable(conds),
+          el("h4", {}, "Events"), eventsTable(data.events),
+        );
+      }, 4000);
+      detailPollers.push(p);
+    }
+
+    async function logsTab() {
+      stopDetailPollers();
+      const epoch = tabEpoch;
+      pane.replaceChildren(el("span", { class: "muted" }, "loading…"));
+      let pods;
+      try {
+        pods = (await api(
+          "GET", `api/namespaces/${ns}/notebooks/${name}/pod`)).pods;
+      } catch (e) {
+        if (epoch !== tabEpoch) return;
+        pane.replaceChildren(el("div", { class: "muted" }, e.message));
+        return;
+      }
+      // the user may have switched tabs while the pod fetch was in
+      // flight; a stale continuation must not clobber the active pane
+      if (epoch !== tabEpoch) return;
+      const podSel = el("select", {});
+      for (const p of pods) {
+        podSel.appendChild(el("option", { value: p.metadata.name },
+          p.metadata.name));
+      }
+      const holder = el("div", {});
+      function showPod() {
+        for (const p of detailPollers) p.stop();
+        detailPollers = [];
+        const viewer = logsViewer(async () => (await api("GET",
+          `api/namespaces/${ns}/notebooks/${name}/pod/${podSel.value}/logs`
+        )).logs);
+        detailPollers.push(viewer.poller);
+        holder.replaceChildren(viewer.node);
+      }
+      podSel.addEventListener("change", showPod);
+      pane.replaceChildren(
+        el("div", { class: "row" },
+          el("span", { class: "muted" }, "host pod"), podSel), holder);
+      showPod();
+    }
+
+    async function yamlTab() {
+      stopDetailPollers();
+      const epoch = tabEpoch;
+      const data = await api("GET", `api/namespaces/${ns}/notebooks/${name}`);
+      if (epoch !== tabEpoch) return;
+      pane.replaceChildren(objectView(data.notebook));
+    }
+
+    const tabs = [
+      ["Overview", overviewTab], ["Logs", logsTab], ["YAML", yamlTab],
+    ];
+    for (const [label, fn] of tabs) {
+      const btn = el("button", {
+        onclick: () => {
+          for (const b of tabBar.children) b.classList.remove("primary");
+          btn.classList.add("primary");
+          fn().catch((e) => snackbar(e.message, true));
+        },
+      }, label);
+      tabBar.appendChild(btn);
+    }
+    tabBar.children[0].classList.add("primary");
+    await overviewTab();
+  }
+
   // ------------------------------------------------------------- router
   function route() {
+    stopDetailPollers();
+    const details = location.hash.match(/^#\/details\/([^/]+)$/);
     if (location.hash === "#/new") renderForm().catch(
+      (e) => snackbar(e.message, true));
+    else if (details) renderDetails(
+      decodeURIComponent(details[1])).catch(
       (e) => snackbar(e.message, true));
     else renderList().catch((e) => snackbar(e.message, true));
   }

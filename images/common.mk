@@ -13,13 +13,16 @@ TAG      ?= $(shell git rev-parse --short HEAD 2>/dev/null || echo dev)
 # PUSH_ARCH feeds the push target, which can export a manifest list.
 ARCH      ?= linux/amd64
 PUSH_ARCH ?= linux/amd64,linux/arm64
+# build context; images needing a wider context (e.g. the controlplane
+# image building from the repo root) override this
+CONTEXT   ?= .
 
 IMAGE_REF := $(REGISTRY)/$(IMAGE_NAME)
 
 .PHONY: docker-build
 docker-build:
 	docker build --build-arg BASE_IMG=$(BASE_IMAGE) \
-		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile $(CONTEXT)
 
 .PHONY: docker-build-dep
 docker-build-dep: $(addprefix docker-build-dep--, $(BASE_IMAGE_FOLDERS)) docker-build
@@ -39,7 +42,7 @@ docker-push-dep--%:
 docker-build-multi-arch:
 	docker buildx build --load --platform $(ARCH) \
 		--build-arg BASE_IMG=$(BASE_IMAGE) \
-		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile $(CONTEXT)
 
 .PHONY: docker-build-multi-arch-dep
 docker-build-multi-arch-dep: $(addprefix docker-build-multi-arch-dep--, $(BASE_IMAGE_FOLDERS)) docker-build-multi-arch
@@ -53,4 +56,4 @@ docker-build-multi-arch-dep--%:
 docker-build-push-multi-arch:
 	docker buildx build --push --platform $(PUSH_ARCH) \
 		--build-arg BASE_IMG=$(BASE_IMAGE) \
-		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile .
+		--tag "$(IMAGE_REF):$(TAG)" -f Dockerfile $(CONTEXT)

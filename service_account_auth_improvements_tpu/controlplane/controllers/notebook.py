@@ -20,6 +20,7 @@ components/notebook-controller/controllers/notebook_controller.go:89-225):
 from __future__ import annotations
 
 import copy
+import datetime
 import json
 import logging
 import queue
@@ -52,6 +53,13 @@ from service_account_auth_improvements_tpu.utils.env import (
 
 log = logging.getLogger(__name__)
 
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+
+
 GROUP = "tpukf.dev"
 STOP_ANNOTATION = "tpukf.dev/resource-stopped"
 NOTEBOOK_PORT = 8888
@@ -60,6 +68,18 @@ DEFAULT_CONTAINER = "notebook"
 MAX_STATUS_CONDITIONS = 20
 REEMIT_MAX_ATTEMPTS = 3
 REEMIT_RETRY_DELAY = 0.5
+
+# Gang scheduling for multi-host slices (SURVEY §7 hard part #1, design in
+# proposals/20260729-tpu-gang-scheduling.md): a v5e-16 notebook is 4 pods
+# that must land on one slice together. Every multi-host pod is born with
+# this scheduling gate; the controller lifts the gates only when ALL
+# num_hosts pods exist with a consistent slice placement — so a partially
+# created gang can never run a lone pod that holds chips while
+# jax.distributed blocks at rendezvous. The reference never faced this
+# (1 pod per notebook, STS semantics at notebook_controller.go:361-436).
+GANG_GATE = "tpukf.dev/gang"
+GANG_CONDITION_TYPES = ("SliceIncomplete", "SlicePlacementConflict",
+                        "GangScheduled")
 
 # Per-CR VirtualService customization (reference reads the analogous
 # notebooks.kubeflow.org/* annotations at notebook_controller.go:484-486,
@@ -111,6 +131,7 @@ class NotebookReconciler(Reconciler):
         self._reemit_q: queue.Queue = queue.Queue()
         self._reemit_thread: threading.Thread | None = None
         self._reemit_stop = threading.Event()
+        self._pods_informer = None  # set by register(); None in bare tests
 
     # ------------------------------------------------------------ wiring
 
@@ -120,6 +141,10 @@ class NotebookReconciler(Reconciler):
                             owner_kind="Notebook")
         manager.watch_owned(ctl, "services", owner_kind="Notebook")
         manager.watch_mapped(ctl, "pods", self._map_pod)
+        # gang admission reads host pods from this cache instead of a live
+        # apiserver LIST per reconcile; the same informer enqueues the
+        # reconcile, so its cache is already updated when we run
+        self._pods_informer = manager.informer("pods")
         # re-emit child pod/STS events onto the CR via a dedicated work
         # queue (never coalesced by reconcile-queue dedup, never blocking
         # the watch thread)
@@ -137,7 +162,10 @@ class NotebookReconciler(Reconciler):
 
     def _enqueue_event(self, ev_type, event) -> None:
         """Watch-thread side: filter cheaply, enqueue for the worker."""
-        if ev_type == "DELETED":
+        if ev_type in ("DELETED", "SYNC"):
+            # SYNC is the informer's list replay (startup / 410 relist):
+            # re-emitting those would inflate every retained child event's
+            # count on each controller restart with O(events) API calls
             return
         kind, _ = involved_kind_and_name(event)
         if kind not in ("StatefulSet", "Pod"):
@@ -247,15 +275,36 @@ class NotebookReconciler(Reconciler):
                 pass
             return Result()
 
-        fresh = False
+        desired_sts = self.generate_statefulset(nb, resolved)
+        live_sts = None
         try:
-            self.kube.get("statefulsets", req.name, namespace=req.namespace,
-                          group="apps")
+            live_sts = self.kube.get("statefulsets", req.name,
+                                     namespace=req.namespace, group="apps")
         except errors.NotFound:
-            fresh = True
+            pass
+        if live_sts is not None:
+            # podManagementPolicy is immutable; a single-host→multi-host
+            # tpu change needs Parallel or the gated gang deadlocks
+            # (OrderedReady waits for gated pod-0 to go Ready before
+            # creating pod-1) — recreate the STS, cascading its pods
+            want_policy = desired_sts["spec"].get(
+                "podManagementPolicy", "OrderedReady"
+            )
+            have_policy = (live_sts.get("spec") or {}).get(
+                "podManagementPolicy", "OrderedReady"
+            )
+            if want_policy != have_policy:
+                self.recorder.event(
+                    nb, "Normal", "RecreatingStatefulSet",
+                    f"podManagementPolicy {have_policy} -> {want_policy} "
+                    "is immutable; recreating StatefulSet",
+                )
+                self.kube.delete("statefulsets", req.name,
+                                 namespace=req.namespace, group="apps")
+                live_sts = None
+        fresh = live_sts is None
         sts, sts_changed = helpers.ensure(
-            self.kube, "statefulsets",
-            self.generate_statefulset(nb, resolved), group="apps",
+            self.kube, "statefulsets", desired_sts, group="apps",
             copy_fields=helpers.copy_statefulset_fields,
         )
         if fresh:
@@ -278,8 +327,91 @@ class NotebookReconciler(Reconciler):
                 self.generate_virtual_service(nb),
                 group="networking.istio.io",
             )
-        self.update_status(nb, sts, resolved)
+        gang_cond = None
+        if resolved and resolved.multi_host and not self._stopped(nb):
+            gang_cond = self._reconcile_gang(nb, resolved)
+        self.update_status(nb, sts, resolved, gang_cond)
         return Result()
+
+    # -------------------------------------------------------------- gang
+
+    @staticmethod
+    def _gate_names(pod: dict) -> list[str]:
+        return [g.get("name")
+                for g in (pod.get("spec") or {}).get("schedulingGates") or []]
+
+    def _reconcile_gang(self, nb: dict, resolved) -> dict:
+        """Lift scheduling gates only when the whole gang can run.
+
+        Returns the current gang condition for status. Placement is
+        "resolvable" when every host pod pins the same slice: its
+        nodeSelector carries the resolved GKE accelerator+topology
+        selectors (a GKE TPU node pool with those labels IS one slice, so
+        agreeing selectors co-locate by construction) and its slice
+        annotation matches the CR's resolved slice.
+        """
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        want = resolved.num_hosts
+        if self._pods_informer is not None:
+            pods = [
+                p for p in (
+                    self._pods_informer.get(ns, f"{name}-{i}")
+                    for i in range(want)
+                ) if p is not None
+            ]
+        else:
+            expected = {f"{name}-{i}" for i in range(want)}
+            pods = [
+                p for p in self.kube.list(
+                    "pods", namespace=ns,
+                    label_selector=f"statefulset={name}",
+                )["items"]
+                if p["metadata"]["name"] in expected
+            ]
+        if len(pods) < want:
+            msg = (f"waiting for slice hosts: {len(pods)}/{want} "
+                   "pods created")
+            self.recorder.event(nb, WARNING, "SliceIncomplete", msg)
+            return {"type": "SliceIncomplete", "status": "True",
+                    "reason": "WaitingForHosts", "message": msg}
+        slice_id = f"{resolved.generation}:{resolved.topology}"
+        for p in pods:
+            sel = (p.get("spec") or {}).get("nodeSelector") or {}
+            annot = (p["metadata"].get("annotations") or {})
+            if any(sel.get(k) != v for k, v in resolved.selector.items()) \
+                    or annot.get(tpu.ANNOTATION_SLICE) != slice_id:
+                msg = (f"pod {p['metadata']['name']} does not pin slice "
+                       f"{slice_id}; refusing to lift gang gates")
+                self.recorder.event(
+                    nb, WARNING, "SlicePlacementConflict", msg
+                )
+                return {"type": "SlicePlacementConflict", "status": "True",
+                        "reason": "InconsistentPlacement", "message": msg}
+        lifted = 0
+        for p in pods:
+            gates = (p.get("spec") or {}).get("schedulingGates") or []
+            if GANG_GATE not in [g.get("name") for g in gates]:
+                continue
+            remaining = [g for g in gates if g.get("name") != GANG_GATE]
+            # an ApiError here propagates: the worker requeues with
+            # backoff, and a half-lifted gang is safe (ungated pods
+            # schedule; the rest lift on retry)
+            self.kube.patch(
+                "pods", p["metadata"]["name"],
+                {"spec": {"schedulingGates": remaining}}, namespace=ns,
+            )
+            lifted += 1
+        if lifted:
+            self.recorder.event(
+                nb, "Normal", "GangScheduled",
+                f"all {want} slice host pods present; "
+                f"lifted {lifted} scheduling gate(s)",
+            )
+        return {"type": "GangScheduled", "status": "True",
+                "reason": "AllHostsPresent",
+                "message": f"{want}/{want} host pods admitted to "
+                           f"slice {slice_id}"}
 
     # --------------------------------------------------------- generators
 
@@ -332,11 +464,17 @@ class NotebookReconciler(Reconciler):
             meta.setdefault("annotations", {})[tpu.ANNOTATION_SLICE] = (
                 f"{resolved.generation}:{resolved.topology}"
             )
+            if resolved.multi_host:
+                # every host pod is born gated; _reconcile_gang lifts the
+                # gates once the whole gang exists with consistent placement
+                gates = pod_spec.setdefault("schedulingGates", [])
+                if GANG_GATE not in [g.get("name") for g in gates]:
+                    gates.append({"name": GANG_GATE})
         if self.add_fsgroup:
             pod_spec.setdefault("securityContext", {}).setdefault(
                 "fsGroup", 100
             )
-        return {
+        sts = {
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
             "metadata": {
@@ -352,6 +490,13 @@ class NotebookReconciler(Reconciler):
                 "template": template,
             },
         }
+        if resolved and resolved.multi_host:
+            # OrderedReady would deadlock the gang: the STS controller
+            # waits for pod-0 Ready before creating pod-1, but a gated
+            # pod-0 can never become Ready — all hosts must be created
+            # up front for the gates to ever lift
+            sts["spec"]["podManagementPolicy"] = "Parallel"
+        return sts
 
     @staticmethod
     def _set_env(env: list, name: str, value: str) -> None:
@@ -450,7 +595,8 @@ class NotebookReconciler(Reconciler):
 
     # -------------------------------------------------------------- status
 
-    def update_status(self, nb: dict, sts: dict, resolved) -> None:
+    def update_status(self, nb: dict, sts: dict, resolved,
+                      gang_cond: dict | None = None) -> None:
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
         status: dict = {
@@ -458,6 +604,13 @@ class NotebookReconciler(Reconciler):
             "containerState": {},
             "conditions": (nb.get("status") or {}).get("conditions") or [],
         }
+        # gang conditions are phase state, not history: strip them up front
+        # so the container-state dedupe below sees pure history; the
+        # current gang phase (if any) is re-appended at the end
+        status["conditions"] = [
+            c for c in status["conditions"]
+            if c.get("type") not in GANG_CONDITION_TYPES
+        ]
         try:
             pod = self.kube.get("pods", f"{name}-0", namespace=ns)
         except errors.NotFound:
@@ -473,6 +626,23 @@ class NotebookReconciler(Reconciler):
                             status["conditions"], cond
                         )
                     break
+        if gang_cond is not None:
+            # k8s convention: lastTransitionTime marks when this condition
+            # type+status began, surviving refreshes (otherwise "how long
+            # has the slice been incomplete" is unanswerable in the UI and
+            # every reconcile would churn a status write)
+            prev = next(
+                (c for c in (nb.get("status") or {}).get("conditions") or []
+                 if c.get("type") == gang_cond["type"]), None,
+            )
+            if prev and prev.get("status") == gang_cond.get("status") \
+                    and prev.get("lastTransitionTime"):
+                gang_cond["lastTransitionTime"] = prev["lastTransitionTime"]
+            else:
+                gang_cond["lastTransitionTime"] = _utcnow()
+            status["conditions"] = (
+                status["conditions"] + [gang_cond]
+            )[-MAX_STATUS_CONDITIONS:]
         if self._stopped(nb):
             self.metrics.running.labels(ns).set(0)
         else:

@@ -94,7 +94,8 @@ class KubeClient:
         return h
 
     def _request(self, method: str, path: str, query: dict | None = None,
-                 body=None, content_type: str = "application/json"):
+                 body=None, content_type: str = "application/json",
+                 parse: bool = True):
         q = urlencode({k: v for k, v in (query or {}).items() if v})
         url = path + ("?" + q if q else "")
         conn = self._conn()
@@ -108,6 +109,8 @@ class KubeClient:
             data = resp.read()
             if resp.status >= 400:
                 raise _error_from_body(resp.status, data)
+            if not parse:
+                return data.decode(errors="replace")
             return json.loads(data) if data else None
         finally:
             conn.close()
@@ -165,6 +168,16 @@ class KubeClient:
     def delete(self, plural, name, namespace=None, group=None):
         res = self._res(plural, group)
         return self._request("DELETE", res.path(namespace, name))
+
+    def pod_logs(self, name, namespace=None, container=None,
+                 tail_lines=None):
+        """``GET .../pods/<name>/log`` — plain-text log body."""
+        res = self._res("pods", None)
+        return self._request(
+            "GET", res.path(namespace, name) + "/log",
+            query={"container": container, "tailLines": tail_lines},
+            parse=False,
+        )
 
     def watch(self, plural, namespace=None, resource_version=0, group=None,
               timeout: float | None = 30):

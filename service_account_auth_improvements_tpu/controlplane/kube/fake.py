@@ -134,6 +134,7 @@ class FakeKube:
         self._history: dict[tuple, list] = {}   # (group,plural) -> [(rv, ev)]
         self._pruned: dict[tuple, int] = {}     # (group,plural) -> last rv dropped
         self._watches: list[_Watch] = []
+        self._pod_logs: dict[tuple, str] = {}   # (ns, pod) -> log text
         self.sar_hook = None  # SubjectAccessReview callback (web tier)
 
     # ------------------------------------------------------------ helpers
@@ -449,6 +450,23 @@ class FakeKube:
         # deregister — close() on a never-started generator skips finally
         weakref.finalize(gen, cleanup)
         return gen
+
+    # ---------------------------------------------------------------- logs
+
+    def set_pod_logs(self, namespace: str, name: str, text: str) -> None:
+        """Test helper (plays the kubelet): stage log text for a pod."""
+        self._pod_logs[(namespace or "", name)] = text
+
+    def pod_logs(self, name: str, namespace: str | None = None,
+                 container: str | None = None,
+                 tail_lines: int | None = None) -> str:
+        """``GET pods/<name>/log`` (reference crud_backend/api/pod.py
+        read_namespaced_pod_log). 404s if the pod doesn't exist."""
+        self.get("pods", name, namespace=namespace)
+        text = self._pod_logs.get((namespace or "", name), "")
+        if tail_lines is not None:
+            text = "\n".join(text.splitlines()[-int(tail_lines):])
+        return text
 
     def compact_history(self, plural: str | None = None,
                         group: str | None = None) -> None:

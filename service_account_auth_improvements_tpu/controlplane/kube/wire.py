@@ -85,6 +85,19 @@ def handle(fake, environ, start_response):
                 **kwargs,
             )
         elif method == "GET":
+            if sub == "log" and res.plural == "pods":
+                tail = qs.get("tailLines", [None])[0]
+                text = fake.pod_logs(
+                    name, namespace=namespace,
+                    container=qs.get("container", [None])[0],
+                    tail_lines=int(tail) if tail else None,
+                )
+                payload = text.encode()
+                start_response("200 OK", [
+                    ("Content-Type", "text/plain"),
+                    ("Content-Length", str(len(payload))),
+                ])
+                return [payload]
             out = fake.get(res.plural, name, namespace=namespace, **kwargs)
         elif method == "POST":
             out = fake.create(res.plural, body(), namespace=namespace, **kwargs)

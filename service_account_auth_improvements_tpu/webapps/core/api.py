@@ -114,6 +114,21 @@ class KubeApi:
             or e.get("eventTime") or "",
         )
 
+    def pod_logs(self, namespace: str, pod: str,
+                 container: str | None = None,
+                 tail_lines: int | None = None) -> str:
+        """SAR-gated on the ``pods/log`` subresource (reference
+        crud_backend/api/pod.py get_pod_logs:14-21)."""
+        kind = self._kind("pods")
+        authz.ensure_authorized(
+            self.kube, self.user, "get", kind.group, kind.version,
+            kind.plural, namespace=namespace, subresource="log",
+            mode=self.mode,
+        )
+        return self.kube.pod_logs(pod, namespace=namespace,
+                                  container=container,
+                                  tail_lines=tail_lines)
+
     def pods_using_pvc(self, namespace: str, pvc: str) -> list:
         """Reference api/pod.py list_pods filtered by PVC volume."""
         out = []

@@ -27,8 +27,18 @@ from service_account_auth_improvements_tpu.webapps.jupyter import (
 )
 
 
+DEFAULT_LOG_TAIL_LINES = 1000
+
+
 def _now() -> str:
     return dt.datetime.now(dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+def app_container_name(pod: dict) -> str | None:
+    """The notebook container to read logs from (first container, the
+    template's main — reference uses the notebook name as container)."""
+    containers = (pod.get("spec") or {}).get("containers") or []
+    return containers[0].get("name") if containers else None
 
 
 def notebook_summary(nb: dict, events: list | None = None) -> dict:
@@ -119,6 +129,55 @@ def build_app(kube, static_dir: str | None = None,
         events = api.events_for(ns, "Notebook", name)
         return {"notebook": nb, "summary": notebook_summary(nb, events),
                 "events": events}
+
+    # --------------------------------------------- notebook details views
+    # (reference: jupyter/backend/apps/common/routes/get.py:68-100 — on a
+    # TPU platform "why is my slice pod Pending/CrashLooping" is THE
+    # debugging question, so the pod/logs/events surface is first-class)
+
+    @app.route("GET", "/api/namespaces/<namespace>/notebooks/<name>/pod")
+    def get_notebook_pods(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        pods = api_for(req).list(
+            "pods", ns, label_selector=f"notebook-name={name}"
+        )
+        if not pods:
+            raise HttpError(404, "No pod detected.")
+        pods.sort(key=lambda p: p["metadata"]["name"])
+        # multi-host slices have one pod per host; "pod" stays the rank-0
+        # pod for reference-shape compatibility
+        return {"pod": pods[0], "pods": pods}
+
+    @app.route(
+        "GET",
+        "/api/namespaces/<namespace>/notebooks/<name>/pod/<pod>/logs",
+    )
+    def get_pod_logs(req):
+        ns = req.params["namespace"]
+        name, pod_name = req.params["name"], req.params["pod"]
+        api = api_for(req)
+        pod = api.get("pods", pod_name, ns)
+        if (pod["metadata"].get("labels") or {}).get(
+                "notebook-name") != name:
+            raise HttpError(
+                404, f"Pod {pod_name} does not belong to notebook {name}."
+            )
+        # cap the transfer: the UI polls this every few seconds, and a
+        # long-running pod's full log is arbitrarily large
+        try:
+            tail = int(req.query.get("tailLines", DEFAULT_LOG_TAIL_LINES))
+        except ValueError:
+            raise HttpError(400, "tailLines must be an integer")
+        logs = api.pod_logs(ns, pod_name,
+                            container=app_container_name(pod),
+                            tail_lines=tail)
+        return {"logs": logs.split("\n")}
+
+    @app.route("GET", "/api/namespaces/<namespace>/notebooks/<name>/events")
+    def get_notebook_events(req):
+        ns, name = req.params["namespace"], req.params["name"]
+        return {"events":
+                api_for(req).events_for(ns, "Notebook", name)}
 
     # ------------------------------------------------------------ writes
 

@@ -250,3 +250,90 @@ def test_listing_tolerates_malformed_cr(world):
     out = call(app, "GET", "/api/namespaces/user1/notebooks")
     assert out["code"] == 200
     assert {r["name"] for r in out["body"]["notebooks"]} == {"bare", "good"}
+
+
+# --------------------------------------------- notebook details surface
+
+
+def _details_world(kube):
+    """A notebook with two host pods, staged logs, and a warning event."""
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "notebook", "image": "img"}]}}},
+    }, group="tpukf.dev")
+    for i in range(2):
+        kube.create("pods", {
+            "metadata": {"name": f"nb1-{i}", "namespace": "u1",
+                         "labels": {"notebook-name": "nb1",
+                                    "statefulset": "nb1"}},
+            "spec": {"containers": [{"name": "notebook", "image": "img"}]},
+            "status": {"phase": "Pending"},
+        })
+    kube.set_pod_logs("u1", "nb1-0", "line-one\nline-two\nline-three")
+    kube.create("events", {
+        "metadata": {"name": "nb1.ev1", "namespace": "u1"},
+        "involvedObject": {"kind": "Notebook", "name": "nb1",
+                           "namespace": "u1"},
+        "type": "Warning", "reason": "SliceIncomplete",
+        "message": "waiting for slice hosts: 1/2 pods created",
+        "lastTimestamp": "2026-07-29T00:00:01Z",
+    })
+
+
+def test_notebook_pod_route(world):
+    kube, app = world
+    _details_world(kube)
+    out = call(app, "GET", "/api/namespaces/u1/notebooks/nb1/pod")
+    assert out["code"] == 200
+    assert out["body"]["pod"]["metadata"]["name"] == "nb1-0"
+    assert [p["metadata"]["name"] for p in out["body"]["pods"]] == [
+        "nb1-0", "nb1-1"]
+    # no pods -> 404, reference shape
+    out = call(app, "GET", "/api/namespaces/u1/notebooks/ghost/pod")
+    assert out["code"] == 404
+
+
+def test_notebook_pod_logs_route(world):
+    kube, app = world
+    _details_world(kube)
+    out = call(app, "GET",
+               "/api/namespaces/u1/notebooks/nb1/pod/nb1-0/logs")
+    assert out["code"] == 200
+    assert out["body"]["logs"] == ["line-one", "line-two", "line-three"]
+    # a pod not belonging to the notebook is not readable via this route
+    kube.create("pods", {
+        "metadata": {"name": "other", "namespace": "u1"},
+        "spec": {}, "status": {},
+    })
+    out = call(app, "GET",
+               "/api/namespaces/u1/notebooks/nb1/pod/other/logs")
+    assert out["code"] == 404
+
+
+def test_notebook_pod_logs_requires_log_subresource_sar(world):
+    kube, app = world
+    _details_world(kube)
+    denied = []
+
+    def sar_hook(spec):
+        attrs = spec.get("resourceAttributes") or {}
+        if attrs.get("subresource") == "log":
+            denied.append(attrs)
+            return False
+        return True
+
+    kube.sar_hook = sar_hook
+    out = call(app, "GET",
+               "/api/namespaces/u1/notebooks/nb1/pod/nb1-0/logs")
+    assert out["code"] == 403
+    assert denied and denied[0]["resource"] == "pods"
+
+
+def test_notebook_events_route(world):
+    kube, app = world
+    _details_world(kube)
+    out = call(app, "GET", "/api/namespaces/u1/notebooks/nb1/events")
+    assert out["code"] == 200
+    evs = out["body"]["events"]
+    assert any(e["reason"] == "SliceIncomplete" for e in evs)
