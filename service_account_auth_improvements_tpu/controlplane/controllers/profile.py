@@ -83,14 +83,82 @@ class WorkloadIdentityPlugin:
 
 
 class _RecordingIam:
+    """In-memory IAM recorder shared by the cloud plugins: binds are
+    idempotent (level-triggered reconciles repeat; the record must not
+    grow)."""
+
     def __init__(self):
         self.bound: list[tuple] = []
 
-    def bind(self, gsa, ns, ksa):
-        self.bound.append((gsa, ns, ksa))
+    def bind(self, *key):
+        if key not in self.bound:
+            self.bound.append(key)
 
-    def unbind(self, gsa, ns, ksa):
-        self.bound = [b for b in self.bound if b != (gsa, ns, ksa)]
+    def unbind(self, *key):
+        self.bound = [b for b in self.bound if b != key]
+
+
+class AwsIamForServiceAccountPlugin:
+    """AWS IRSA: annotate default-editor with the IAM role ARN and update
+    the role's trust (assume-role) policy to admit the KSA (reference:
+    plugin_iam.go:36-120 — annotation ``eks.amazonaws.com/role-arn``,
+    UpdateAssumeRolePolicy; ``annotateOnly`` skips the IAM mutation).
+    The trust-policy call is injectable; the default records in-memory so
+    air-gapped tests and clusters without AWS credentials still reconcile.
+    """
+
+    kind = "AwsIamForServiceAccount"
+    ANNOTATION = "eks.amazonaws.com/role-arn"
+
+    def __init__(self, iam_client=None):
+        self.iam = iam_client or _RecordingAwsIam()
+
+    def apply(self, kube, profile: dict, spec: dict) -> None:
+        ns = profile["metadata"]["name"]
+        role = spec.get("awsIamRole", "")
+        if not role:
+            # reference errors here (plugin_iam.go:67-69): an IRSA plugin
+            # without a role is a user mistake, not a no-op
+            raise ValueError(
+                "AwsIamForServiceAccount plugin requires awsIamRole"
+            )
+        try:
+            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+        except errors.NotFound:
+            return  # SAs not reconciled yet; the next pass re-applies
+        annots = sa["metadata"].setdefault("annotations", {})
+        if annots.get(self.ANNOTATION) != role:
+            annots[self.ANNOTATION] = role
+            kube.update("serviceaccounts", sa)
+        if not spec.get("annotateOnly"):
+            self.iam.admit(role, ns, EDIT_SA)
+
+    def revoke(self, kube, profile: dict, spec: dict) -> None:
+        ns = profile["metadata"]["name"]
+        role = spec.get("awsIamRole", "")
+        try:
+            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+        except errors.NotFound:
+            sa = None
+        if sa is not None:
+            annots = sa["metadata"].get("annotations") or {}
+            if self.ANNOTATION in annots:
+                annots.pop(self.ANNOTATION)
+                kube.update("serviceaccounts", sa)
+        if role and not spec.get("annotateOnly"):
+            self.iam.expel(role, ns, EDIT_SA)
+
+
+class _RecordingAwsIam(_RecordingIam):
+    """Same recorder, IRSA verb names: ``admitted`` triples are the
+    (role, ns, ksa) entries in the assume-role trust policy."""
+
+    admit = _RecordingIam.bind
+    expel = _RecordingIam.unbind
+
+    @property
+    def admitted(self) -> list[tuple]:
+        return self.bound
 
 
 class ProfileReconciler(Reconciler):
@@ -103,6 +171,8 @@ class ProfileReconciler(Reconciler):
         self.kube = kube
         self.plugins = plugins if plugins is not None else {
             WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin(),
+            AwsIamForServiceAccountPlugin.kind:
+                AwsIamForServiceAccountPlugin(),
         }
         self.userid_header = get_env_default("USERID_HEADER", "kubeflow-userid")
         self.userid_prefix = get_env_default("USERID_PREFIX", "")
@@ -196,6 +266,11 @@ class ProfileReconciler(Reconciler):
         except errors.ApiError as e:
             self._set_error_condition(profile, str(e))
             raise
+        except ValueError as e:
+            # terminal user error (e.g. a plugin spec missing a required
+            # field): surface on the CR, don't retry-storm
+            self._set_error_condition(profile, str(e))
+            return Result()
         self._set_ready_condition(profile)
         return Result()
 

@@ -150,3 +150,65 @@ def test_two_tenants_quota_isolation(world):
         )
         rq = kube.get("resourcequotas", "kf-resource-quota", namespace=name)
         assert rq["spec"]["hard"]["requests.google.com/tpu"] == "8"
+
+
+def test_aws_iam_plugin_apply_and_revoke():
+    """Reference parity for plugin_iam.go:36-120: role-arn annotation on
+    default-editor + trust-policy admit; revoke on delete; annotateOnly
+    skips the IAM mutation; a missing role is a terminal user error
+    surfaced as a condition, not a retry storm."""
+    from service_account_auth_improvements_tpu.controlplane.controllers.profile import (
+        AwsIamForServiceAccountPlugin,
+    )
+
+    kube = FakeKube()
+    mgr = Manager(kube)
+    aws = AwsIamForServiceAccountPlugin()
+    ProfileReconciler(
+        kube, plugins={AwsIamForServiceAccountPlugin.kind: aws}
+    ).register(mgr)
+    mgr.start()
+    try:
+        role = "arn:aws:iam::1234:role/kf-user"
+        kube.create("profiles", _profile(
+            name="aws-ns", email="a@example.com",
+            plugins=[{"kind": "AwsIamForServiceAccount",
+                      "spec": {"awsIamRole": role}}],
+        ))
+        assert _wait(lambda: (role, "aws-ns", "default-editor")
+                     in aws.iam.admitted)
+        sa = kube.get("serviceaccounts", "default-editor",
+                      namespace="aws-ns")
+        assert sa["metadata"]["annotations"][
+            "eks.amazonaws.com/role-arn"] == role
+
+        kube.delete("profiles", "aws-ns")
+        assert _wait(lambda: aws.iam.admitted == [])
+
+        # annotateOnly: annotation lands, IAM untouched
+        kube.create("profiles", _profile(
+            name="aws-anno", email="b@example.com",
+            plugins=[{"kind": "AwsIamForServiceAccount",
+                      "spec": {"awsIamRole": role, "annotateOnly": True}}],
+        ))
+        assert _wait(lambda: "eks.amazonaws.com/role-arn" in (
+            kube.get("serviceaccounts", "default-editor",
+                     namespace="aws-anno")["metadata"].get("annotations")
+            or {}))
+        assert aws.iam.admitted == []
+
+        # missing role: error condition, no crash loop
+        kube.create("profiles", _profile(
+            name="aws-bad", email="c@example.com",
+            plugins=[{"kind": "AwsIamForServiceAccount", "spec": {}}],
+        ))
+
+        def has_error():
+            p = kube.get("profiles", "aws-bad", group="tpukf.dev")
+            return any("awsIamRole" in (c.get("message") or "")
+                       for c in (p.get("status") or {}).get(
+                           "conditions") or [])
+
+        assert _wait(has_error)
+    finally:
+        mgr.stop()
