@@ -1,0 +1,79 @@
+"""Standalone flash-attention kernel benchmark for iteration (not shipped).
+
+Times fwd and fwd+bwd of ops.flash_attention at the bench_800m shape vs the
+dense fallback, prints achieved TFLOP/s.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from service_account_auth_improvements_tpu.ops import flash_attention as fa
+from service_account_auth_improvements_tpu.ops import attention as attn
+
+
+def _sync(out):
+    # block_until_ready is unreliable on the remote PJRT plugin; a
+    # device->host fetch of one element cannot complete early
+    leaf = jax.tree.leaves(out)[0]
+    return float(leaf.ravel()[0])
+
+
+def timeit(f, *args, iters=10):
+    f(*args)  # warmup/compile
+    _sync(f(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+    _sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    b, s, h, hkv, d = 8, 2048, 12, 4, 128
+    key = jax.random.key(0)
+    kq, kk, kv, kdo = jax.random.split(key, 4)
+    q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, s, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, s, hkv, d), jnp.bfloat16)
+    do = jax.random.normal(kdo, (b, s, h, d), jnp.bfloat16)
+
+    # causal attention core FLOPs: qk + av, each 2*b*h*s^2*d, halved by mask
+    fwd_flops = 2 * 2 * b * h * s * s * d / 2
+    bwd_flops = 2 * fwd_flops
+
+    flash_f = jax.jit(functools.partial(fa.flash_attention, causal=True))
+    dense_f = jax.jit(
+        lambda q, k, v: attn._dense_attention(q, k, v, d ** -0.5, causal=True)
+    )
+
+    def loss_flash(q, k, v):
+        return (fa.flash_attention(q, k, v, causal=True)
+                .astype(jnp.float32) * do.astype(jnp.float32)).sum()
+
+    def loss_dense(q, k, v):
+        return (attn._dense_attention(q, k, v, d ** -0.5, causal=True)
+                .astype(jnp.float32) * do.astype(jnp.float32)).sum()
+
+    grad_flash = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    grad_dense = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))
+
+    for name, f, flops in [
+        ("flash fwd", flash_f, fwd_flops),
+        ("dense fwd", dense_f, fwd_flops * 2),  # dense computes full s^2
+        ("flash fwd+bwd", grad_flash, fwd_flops + bwd_flops),
+        ("dense fwd+bwd", grad_dense, (fwd_flops + bwd_flops) * 2),
+    ]:
+        dt = timeit(f, q, k, v)
+        print(f"{name:16s} {dt*1e3:8.2f} ms  {flops/dt/1e12:6.1f} TF/s "
+              f"(useful: {(fwd_flops if 'fwd+' not in name else fwd_flops+bwd_flops)/dt/1e12:6.1f})")
+
+    # numeric check vs dense
+    of = flash_f(q, k, v)
+    od = dense_f(q, k, v)
+    print("max |flash-dense| =", jnp.max(jnp.abs(of.astype(jnp.float32) - od.astype(jnp.float32))))
+
+
+if __name__ == "__main__":
+    main()

@@ -13,12 +13,22 @@ culling_controller.go:78-162). Behavior parity:
 - Idle longer than CULL_IDLE_TIME → sets the stop annotation the notebook
   reconciler maps to replicas=0 (:355-372).
 
-TPU addition: a ``tpukf.dev/culling-policy: training`` annotation opts a
-notebook out — SPMD training is busy-but-quiet, a kernel-idleness heuristic
-must not kill it (SURVEY.md §7 hard parts).
+TPU additions (proposals/20260729-tpu-aware-culling.md):
+
+- a ``tpukf.dev/culling-policy: training`` annotation opts a notebook out —
+  SPMD training is busy-but-quiet, a kernel-idleness heuristic must not
+  kill it (SURVEY.md §7 hard parts);
+- a *bounded* unreachable policy: the reference retries a dead notebook
+  forever (culling_controller.go never stops one it cannot probe), which
+  on TPU means a crash-looping multi-host notebook holds a whole slice
+  indefinitely. Here consecutive probe failures are counted in an
+  annotation; after CULL_UNREACHABLE_LIMIT failures *with the rank-0 pod
+  not Ready* the notebook is stopped. A Ready pod is never culled blind —
+  it may simply not be serving the Jupyter kernels API.
 
 Env knobs (reference :30-40, :405): CULL_IDLE_TIME (minutes, default 1440),
-IDLENESS_CHECK_PERIOD (minutes, default 1), CLUSTER_DOMAIN, DEV.
+IDLENESS_CHECK_PERIOD (minutes, default 1), CLUSTER_DOMAIN, DEV,
+CULL_UNREACHABLE_LIMIT (consecutive failures, default 30, 0 disables).
 """
 
 from __future__ import annotations
@@ -50,6 +60,7 @@ from service_account_auth_improvements_tpu.utils.env import (
 LAST_ACTIVITY = "tpukf.dev/last-activity"
 LAST_CHECK = "tpukf.dev/last_activity_check_timestamp"
 CULLING_POLICY = "tpukf.dev/culling-policy"
+PROBE_FAILURES = "tpukf.dev/probe-failures"
 TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
 PROBE_TIMEOUT = 10  # seconds (reference culling_controller.go:204-206)
 
@@ -89,6 +100,7 @@ class CullingReconciler(Reconciler):
         self.check_period_minutes = get_env_int("IDLENESS_CHECK_PERIOD", 1)
         self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
         self.dev = get_env_default("DEV", "false").lower() == "true"
+        self.unreachable_limit = get_env_int("CULL_UNREACHABLE_LIMIT", 30)
         # each probe can block for PROBE_TIMEOUT (10s); one worker would
         # serialize a namespace of slow/unreachable notebooks and silently
         # degrade the 1-minute check period — run the probes concurrently
@@ -132,12 +144,45 @@ class CullingReconciler(Reconciler):
         }}}
         last_activity = _parse_time(annots.get(LAST_ACTIVITY, ""))
         if kernels is None:
-            # Unreachable (booting, crashed, network): never cull blind —
-            # stamp the check time and retry next period.
+            # Unreachable (booting, crashed, network). Three cases:
+            #  - rank-0 pod Ready: never cull blind (it may simply not
+            #    serve the kernels API); reset the failure count.
+            #  - pod BOUND to a node but not Ready (crash-looping, stuck
+            #    container): it holds TPU chips while dead — count the
+            #    consecutive failures and stop the notebook at the limit
+            #    (the expensive failure mode the reference never bounded).
+            #  - pod missing or still unbound (gang-gated, Pending on
+            #    capacity, image pull): it holds NO chips; waiting is
+            #    cheap and stopping would kill a healthy still-starting
+            #    workload — leave the counter alone.
+            state = self._rank0_pod_state(req.name, req.namespace)
+            if state == "ready":
+                patch["metadata"]["annotations"][PROBE_FAILURES] = "0"
+            elif state == "bound-not-ready":
+                failures = self._int_annot(annots, PROBE_FAILURES) + 1
+                if (self.unreachable_limit
+                        and failures >= self.unreachable_limit):
+                    patch["metadata"]["annotations"][STOP_ANNOTATION] = (
+                        now.strftime(TIME_FMT)
+                    )
+                    patch["metadata"]["annotations"][PROBE_FAILURES] = "0"
+                    self.metrics.culled.labels(req.namespace).inc()
+                    self.recorder.event(
+                        nb, "Warning", "CulledUnreachable",
+                        f"Stopped after {failures} consecutive failed "
+                        f"kernel probes with the rank-0 pod bound but not "
+                        f"Ready (limit {self.unreachable_limit})",
+                    )
+                else:
+                    patch["metadata"]["annotations"][PROBE_FAILURES] = (
+                        str(failures)
+                    )
             self.kube.patch("notebooks", req.name, patch,
                             namespace=req.namespace, group=GROUP)
             return Result(requeue_after=period.total_seconds())
-        elif self._any_busy(kernels) or not kernels:
+        if self._int_annot(annots, PROBE_FAILURES):
+            patch["metadata"]["annotations"][PROBE_FAILURES] = "0"
+        if self._any_busy(kernels) or not kernels:
             # Busy kernels — and kernel-less servers (plain JupyterLab
             # landing) — count as active now.
             last_activity = now
@@ -181,3 +226,33 @@ class CullingReconciler(Reconciler):
         return any(
             k.get("execution_state") == "busy" for k in kernels
         )
+
+    @staticmethod
+    def _int_annot(annots: dict, key: str) -> int:
+        try:
+            return int(annots.get(key, "0"))
+        except (TypeError, ValueError):
+            return 0
+
+    def _rank0_pod_state(self, name: str, ns: str) -> str:
+        """Rank-0 pod scheduling state: ``ready`` | ``bound-not-ready`` |
+        ``unbound``.
+
+        ``<name>-0`` for single-slice notebooks, ``<name>-s0-0`` for
+        multi-slice (per-slice StatefulSet naming in the notebook
+        controller). A pod without ``spec.nodeName`` (missing, gated,
+        Pending on capacity) holds no chips and reports ``unbound``."""
+        pod = None
+        for cand in (f"{name}-0", f"{name}-s0-0"):
+            try:
+                pod = self.kube.get("pods", cand, namespace=ns)
+                break
+            except errors.NotFound:
+                continue
+        if pod is None or not (pod.get("spec") or {}).get("nodeName"):
+            return "unbound"
+        for cond in (pod.get("status") or {}).get("conditions") or []:
+            if cond.get("type") == "Ready":
+                return ("ready" if cond.get("status") == "True"
+                        else "bound-not-ready")
+        return "bound-not-ready"

@@ -132,6 +132,8 @@ class NotebookReconciler(Reconciler):
         self._reemit_thread: threading.Thread | None = None
         self._reemit_stop = threading.Event()
         self._pods_informer = None  # set by register(); None in bare tests
+        self._sts_informer = None
+        self._node_pool_cache: dict[str, str | None] = {}
 
     # ------------------------------------------------------------ wiring
 
@@ -145,6 +147,7 @@ class NotebookReconciler(Reconciler):
         # apiserver LIST per reconcile; the same informer enqueues the
         # reconcile, so its cache is already updated when we run
         self._pods_informer = manager.informer("pods")
+        self._sts_informer = manager.informer("statefulsets", group="apps")
         # re-emit child pod/STS events onto the CR via a dedicated work
         # queue (never coalesced by reconcile-queue dedup, never blocking
         # the watch thread)
@@ -221,7 +224,16 @@ class NotebookReconciler(Reconciler):
         kind, obj_name = involved_kind_and_name(event)
         ns = event["metadata"].get("namespace")
         if kind == "StatefulSet":
-            nb_name = obj_name
+            # resolve the owning CR via the STS's notebook-name label:
+            # a multi-slice STS is named <nb>-s<j>, not <nb>
+            try:
+                sts = self.kube.get("statefulsets", obj_name, namespace=ns,
+                                    group="apps")
+            except errors.NotFound:
+                return  # stray event for an STS we never knew — drop
+            nb_name = (sts["metadata"].get("labels") or {}).get(
+                "notebook-name"
+            )
         else:
             try:
                 pod = self.kube.get("pods", obj_name, namespace=ns)
@@ -275,50 +287,59 @@ class NotebookReconciler(Reconciler):
                 pass
             return Result()
 
-        desired_sts = self.generate_statefulset(nb, resolved)
-        live_sts = None
-        try:
-            live_sts = self.kube.get("statefulsets", req.name,
-                                     namespace=req.namespace, group="apps")
-        except errors.NotFound:
-            pass
-        if live_sts is not None:
-            # podManagementPolicy is immutable; a single-host→multi-host
-            # tpu change needs Parallel or the gated gang deadlocks
-            # (OrderedReady waits for gated pod-0 to go Ready before
-            # creating pod-1) — recreate the STS, cascading its pods
-            want_policy = desired_sts["spec"].get(
-                "podManagementPolicy", "OrderedReady"
-            )
-            have_policy = (live_sts.get("spec") or {}).get(
-                "podManagementPolicy", "OrderedReady"
-            )
-            if want_policy != have_policy:
-                self.recorder.event(
-                    nb, "Normal", "RecreatingStatefulSet",
-                    f"podManagementPolicy {have_policy} -> {want_policy} "
-                    "is immutable; recreating StatefulSet",
+        num_slices = resolved.num_slices if resolved else 1
+        slice_names = [
+            self._sts_name(req.name, j, num_slices) for j in range(num_slices)
+        ]
+        self._prune_stale_statefulsets(nb, keep=set(slice_names))
+        all_sts = []
+        for j, sts_name in enumerate(slice_names):
+            desired_sts = self.generate_statefulset(nb, resolved, slice_id=j)
+            live_sts = None
+            try:
+                live_sts = self.kube.get("statefulsets", sts_name,
+                                         namespace=req.namespace, group="apps")
+            except errors.NotFound:
+                pass
+            if live_sts is not None:
+                # podManagementPolicy is immutable; a single-host→multi-host
+                # tpu change needs Parallel or the gated gang deadlocks
+                # (OrderedReady waits for gated pod-0 to go Ready before
+                # creating pod-1) — recreate the STS, cascading its pods
+                want_policy = desired_sts["spec"].get(
+                    "podManagementPolicy", "OrderedReady"
                 )
-                self.kube.delete("statefulsets", req.name,
-                                 namespace=req.namespace, group="apps")
-                live_sts = None
-        fresh = live_sts is None
-        sts, sts_changed = helpers.ensure(
-            self.kube, "statefulsets", desired_sts, group="apps",
-            copy_fields=helpers.copy_statefulset_fields,
-        )
-        if fresh:
-            self.metrics.created.inc()
-            self.recorder.event(
-                nb, "Normal", "CreatedStatefulSet",
-                f"Created StatefulSet {req.namespace}/{req.name}",
+                have_policy = (live_sts.get("spec") or {}).get(
+                    "podManagementPolicy", "OrderedReady"
+                )
+                if want_policy != have_policy:
+                    self.recorder.event(
+                        nb, "Normal", "RecreatingStatefulSet",
+                        f"podManagementPolicy {have_policy} -> {want_policy} "
+                        "is immutable; recreating StatefulSet",
+                    )
+                    self.kube.delete("statefulsets", sts_name,
+                                     namespace=req.namespace, group="apps")
+                    live_sts = None
+            fresh = live_sts is None
+            sts, _ = helpers.ensure(
+                self.kube, "statefulsets", desired_sts, group="apps",
+                copy_fields=helpers.copy_statefulset_fields,
             )
+            all_sts.append(sts)
+            if fresh:
+                self.metrics.created.inc()
+                self.recorder.event(
+                    nb, "Normal", "CreatedStatefulSet",
+                    f"Created StatefulSet {req.namespace}/{sts_name}",
+                )
         helpers.ensure(
-            self.kube, "services", self.generate_service(nb),
+            self.kube, "services", self.generate_service(nb, resolved),
             copy_fields=helpers.copy_service_fields,
         )
         helpers.ensure(
-            self.kube, "services", self.generate_headless_service(nb),
+            self.kube, "services",
+            self.generate_headless_service(nb, resolved),
             copy_fields=helpers.copy_service_fields,
         )
         if self.use_istio:
@@ -328,17 +349,85 @@ class NotebookReconciler(Reconciler):
                 group="networking.istio.io",
             )
         gang_cond = None
-        if resolved and resolved.multi_host and not self._stopped(nb):
+        if resolved and (resolved.multi_host or resolved.multi_slice) \
+                and not self._stopped(nb):
             gang_cond = self._reconcile_gang(nb, resolved)
-        self.update_status(nb, sts, resolved, gang_cond)
+        self.update_status(nb, all_sts, resolved, gang_cond)
         return Result()
 
     # -------------------------------------------------------------- gang
 
     @staticmethod
+    def _sts_name(base: str, slice_id: int, num_slices: int) -> str:
+        """Single-slice keeps the bare CR name (the common case and the
+        reference's contract); slices get an -s<j> suffix."""
+        return base if num_slices == 1 else f"{base}-s{slice_id}"
+
+    def _owned_statefulsets(self, name: str, ns: str) -> list[dict]:
+        """STSes owned by Notebook ``name`` — matched on BOTH the
+        notebook-name label and an ownerReference to the Notebook, so a
+        user STS merely labeled to join the headless service is never
+        treated (or pruned) as ours. Served from the informer cache when
+        available (no apiserver LIST on the steady-state path)."""
+
+        def owned(o: dict) -> bool:
+            if (o["metadata"].get("labels") or {}).get(
+                    "notebook-name") != name:
+                return False
+            return any(
+                ref.get("kind") == "Notebook" and ref.get("name") == name
+                for ref in o["metadata"].get("ownerReferences") or []
+            )
+
+        if self._sts_informer is not None and self._sts_informer.has_synced():
+            return [
+                o for o in self._sts_informer.list()
+                if o["metadata"].get("namespace") == ns and owned(o)
+            ]
+        return [
+            o for o in self.kube.list(
+                "statefulsets", namespace=ns, group="apps",
+                label_selector=f"notebook-name={name}",
+            )["items"] if owned(o)
+        ]
+
+    def _prune_stale_statefulsets(self, nb: dict, keep: set[str]) -> None:
+        """Delete owned STSes whose name no longer matches the desired
+        slice layout (single↔multi-slice transitions, slices shrunk)."""
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
+        for sts in self._owned_statefulsets(name, ns):
+            sts_name = sts["metadata"]["name"]
+            if sts_name not in keep:
+                self.recorder.event(
+                    nb, "Normal", "PruningStatefulSet",
+                    f"slice layout changed; deleting StatefulSet {sts_name}",
+                )
+                try:
+                    self.kube.delete("statefulsets", sts_name, namespace=ns,
+                                     group="apps")
+                except errors.NotFound:
+                    pass  # informer cache lagging an already-gone STS
+
+    @staticmethod
     def _gate_names(pod: dict) -> list[str]:
         return [g.get("name")
                 for g in (pod.get("spec") or {}).get("schedulingGates") or []]
+
+    def _node_pool(self, node_name: str) -> str | None:
+        """Node-pool label of a node; None when unknown (node not found,
+        or a non-GKE node without the label). Cached: a node's pool is
+        immutable for its lifetime."""
+        if node_name in self._node_pool_cache:
+            return self._node_pool_cache[node_name]
+        try:
+            node = self.kube.get("nodes", node_name)
+        except errors.NotFound:
+            return None
+        pool = ((node["metadata"].get("labels") or {})
+                .get(tpu.SEL_NODEPOOL))
+        self._node_pool_cache[node_name] = pool
+        return pool
 
     def _reconcile_gang(self, nb: dict, resolved) -> dict:
         """Lift scheduling gates only when the whole gang can run.
@@ -352,23 +441,23 @@ class NotebookReconciler(Reconciler):
         """
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
-        want = resolved.num_hosts
-        if self._pods_informer is not None:
-            pods = [
-                p for p in (
-                    self._pods_informer.get(ns, f"{name}-{i}")
-                    for i in range(want)
-                ) if p is not None
-            ]
-        else:
-            expected = {f"{name}-{i}" for i in range(want)}
-            pods = [
-                p for p in self.kube.list(
-                    "pods", namespace=ns,
-                    label_selector=f"statefulset={name}",
-                )["items"]
-                if p["metadata"]["name"] in expected
-            ]
+        want = resolved.gang_size
+        expected: list[tuple[int, str]] = [
+            (j, f"{self._sts_name(name, j, resolved.num_slices)}-{i}")
+            for j in range(resolved.num_slices)
+            for i in range(resolved.num_hosts)
+        ]
+        pods: list[tuple[int, dict]] = []
+        for j, pod_name in expected:
+            if self._pods_informer is not None:
+                p = self._pods_informer.get(ns, pod_name)
+            else:
+                try:
+                    p = self.kube.get("pods", pod_name, namespace=ns)
+                except errors.NotFound:
+                    p = None
+            if p is not None:
+                pods.append((j, p))
         if len(pods) < want:
             msg = (f"waiting for slice hosts: {len(pods)}/{want} "
                    "pods created")
@@ -376,7 +465,7 @@ class NotebookReconciler(Reconciler):
             return {"type": "SliceIncomplete", "status": "True",
                     "reason": "WaitingForHosts", "message": msg}
         slice_id = f"{resolved.generation}:{resolved.topology}"
-        for p in pods:
+        for j, p in pods:
             sel = (p.get("spec") or {}).get("nodeSelector") or {}
             annot = (p["metadata"].get("annotations") or {})
             if any(sel.get(k) != v for k, v in resolved.selector.items()) \
@@ -388,8 +477,52 @@ class NotebookReconciler(Reconciler):
                 )
                 return {"type": "SlicePlacementConflict", "status": "True",
                         "reason": "InconsistentPlacement", "message": msg}
+        # Slice identity is the node POOL, not the label pair: verify the
+        # nodes the scheduler actually bound (spec.nodeName). Within one
+        # slice all pods must share a pool (two pools with identical TPU
+        # labels must not split a gang — the selector check above cannot
+        # see that), and no pool may host two different slices (a
+        # MULTI-HOST pool IS one slice's worth of hosts; single-host
+        # pools legitimately pack many independent slices, so both
+        # checks only apply when num_hosts > 1).
+        pool_of_pod: dict[str, tuple[int, str]] = {}
+        if resolved.multi_host:
+            for j, p in pods:
+                node_name = (p.get("spec") or {}).get("nodeName")
+                if not node_name:
+                    continue
+                pool = self._node_pool(node_name)
+                if pool is not None:
+                    pool_of_pod[p["metadata"]["name"]] = (j, pool)
+        slice_pools: dict[int, set[str]] = {}
+        pool_slices: dict[str, set[int]] = {}
+        for pod_name, (j, pool) in pool_of_pod.items():
+            slice_pools.setdefault(j, set()).add(pool)
+            pool_slices.setdefault(pool, set()).add(j)
+        split = {j: ps for j, ps in slice_pools.items() if len(ps) > 1}
+        shared = {pool: js for pool, js in pool_slices.items() if len(js) > 1}
+        if split or shared:
+            parts = []
+            for j, ps in sorted(split.items()):
+                members = sorted(
+                    pn for pn, (pj, _) in pool_of_pod.items() if pj == j
+                )
+                parts.append(
+                    f"slice {j} split across pools "
+                    f"{', '.join(sorted(ps))} ({', '.join(members)})"
+                )
+            for pool, js in sorted(shared.items()):
+                parts.append(
+                    f"pool {pool} hosts slices "
+                    f"{', '.join(str(j) for j in sorted(js))}"
+                )
+            msg = ("gang placement violates one-pool-one-slice: "
+                   + "; ".join(parts))
+            self.recorder.event(nb, WARNING, "SlicePlacementConflict", msg)
+            return {"type": "SlicePlacementConflict", "status": "True",
+                    "reason": "SplitAcrossSlices", "message": msg}
         lifted = 0
-        for p in pods:
+        for _, p in pods:
             gates = (p.get("spec") or {}).get("schedulingGates") or []
             if GANG_GATE not in [g.get("name") for g in gates]:
                 continue
@@ -411,7 +544,7 @@ class NotebookReconciler(Reconciler):
         return {"type": "GangScheduled", "status": "True",
                 "reason": "AllHostsPresent",
                 "message": f"{want}/{want} host pods admitted to "
-                           f"slice {slice_id}"}
+                           f"{resolved.num_slices} slice(s) of {slice_id}"}
 
     # --------------------------------------------------------- generators
 
@@ -419,9 +552,12 @@ class NotebookReconciler(Reconciler):
         annots = nb["metadata"].get("annotations") or {}
         return STOP_ANNOTATION in annots
 
-    def generate_statefulset(self, nb: dict, resolved) -> dict:
+    def generate_statefulset(self, nb: dict, resolved,
+                             slice_id: int = 0) -> dict:
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
+        num_slices = resolved.num_slices if resolved else 1
+        sts_name = self._sts_name(name, slice_id, num_slices)
         replicas = 0 if self._stopped(nb) else (
             resolved.num_hosts if resolved else 1
         )
@@ -431,7 +567,9 @@ class NotebookReconciler(Reconciler):
         pod_spec = template.setdefault("spec", {})
         meta = template.setdefault("metadata", {})
         labels = meta.setdefault("labels", {})
-        labels.update({"statefulset": name, "notebook-name": name})
+        labels.update({"statefulset": sts_name, "notebook-name": name})
+        if num_slices > 1:
+            labels[tpu.LABEL_SLICE_ID] = str(slice_id)
         # Copy CR labels/annotations onto the pod, minus volatile ones
         # (reference copies all but last-activity style annotations).
         for k, v in (nb["metadata"].get("labels") or {}).items():
@@ -458,18 +596,53 @@ class NotebookReconciler(Reconciler):
             requests[tpu.RESOURCE_TPU] = str(resolved.chips_per_host)
             pod_spec.setdefault("nodeSelector", {}).update(resolved.selector)
             for e in tpu.worker_env(
-                name, f"{name}-hl", ns, resolved
+                sts_name, f"{name}-hl", ns, resolved
             ):
                 self._set_env_obj(env, e)
+            if resolved.multi_slice:
+                # DCN rendezvous: the controller owns the MEGASCALE_* env
+                # end-to-end (coordinator = slice 0's rank-0 pod through
+                # the shared headless service) — not a hand-edited
+                # PodDefault (SURVEY §2b DCN bullet).
+                coord_pod = f"{self._sts_name(name, 0, num_slices)}-0"
+                for e in tpu.megascale_env(
+                    coord_pod, f"{name}-hl", ns, resolved, slice_id
+                ):
+                    self._set_env_obj(env, e)
             meta.setdefault("annotations", {})[tpu.ANNOTATION_SLICE] = (
                 f"{resolved.generation}:{resolved.topology}"
             )
-            if resolved.multi_host:
-                # every host pod is born gated; _reconcile_gang lifts the
-                # gates once the whole gang exists with consistent placement
+            if resolved.multi_host or resolved.multi_slice:
+                # every pod of the gang (all hosts of all slices) is born
+                # gated; _reconcile_gang lifts the gates once the whole
+                # gang exists with consistent placement
                 gates = pod_spec.setdefault("schedulingGates", [])
                 if GANG_GATE not in [g.get("name") for g in gates]:
                     gates.append({"name": GANG_GATE})
+            if resolved.multi_host:
+                # Slice-true placement: accelerator+topology selectors do
+                # not identify ONE slice — two node pools with identical
+                # TPU labels would let the scheduler split the gang across
+                # slices. Required self-affinity on the node-pool topology
+                # key forces every host pod of this SLICE into one pool
+                # (the scheduler's self-affinity bootstrap rule admits the
+                # first pod; a replacement pod is pulled to the incumbent
+                # pool). Keyed on the per-slice statefulset label so each
+                # slice of a multi-slice notebook lands in its OWN pool.
+                # _reconcile_gang additionally verifies the bound nodes.
+                terms = (pod_spec.setdefault("affinity", {})
+                         .setdefault("podAffinity", {})
+                         .setdefault(
+                             "requiredDuringSchedulingIgnoredDuringExecution",
+                             []))
+                if not any(t.get("topologyKey") == tpu.SEL_NODEPOOL
+                           for t in terms):
+                    terms.append({
+                        "labelSelector": {
+                            "matchLabels": {"statefulset": sts_name}
+                        },
+                        "topologyKey": tpu.SEL_NODEPOOL,
+                    })
         if self.add_fsgroup:
             pod_spec.setdefault("securityContext", {}).setdefault(
                 "fsGroup", 100
@@ -478,7 +651,7 @@ class NotebookReconciler(Reconciler):
             "apiVersion": "apps/v1",
             "kind": "StatefulSet",
             "metadata": {
-                "name": name,
+                "name": sts_name,
                 "namespace": ns,
                 "labels": {"notebook-name": name},
                 "ownerReferences": [helpers.owner_reference(nb)],
@@ -486,11 +659,11 @@ class NotebookReconciler(Reconciler):
             "spec": {
                 "replicas": replicas,
                 "serviceName": f"{name}-hl",
-                "selector": {"matchLabels": {"statefulset": name}},
+                "selector": {"matchLabels": {"statefulset": sts_name}},
                 "template": template,
             },
         }
-        if resolved and resolved.multi_host:
+        if resolved and (resolved.multi_host or resolved.multi_slice):
             # OrderedReady would deadlock the gang: the STS controller
             # waits for pod-0 Ready before creating pod-1, but a gated
             # pod-0 can never become Ready — all hosts must be created
@@ -515,9 +688,10 @@ class NotebookReconciler(Reconciler):
                 return
         env.append(item)
 
-    def generate_service(self, nb: dict) -> dict:
+    def generate_service(self, nb: dict, resolved=None) -> dict:
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
+        num_slices = resolved.num_slices if resolved else 1
         return {
             "apiVersion": "v1",
             "kind": "Service",
@@ -529,7 +703,11 @@ class NotebookReconciler(Reconciler):
             },
             "spec": {
                 "type": "ClusterIP",
-                "selector": {"statefulset": name},
+                # UI traffic goes to slice 0 (the coordinator slice); the
+                # headless service spans all slices for rendezvous DNS
+                "selector": {
+                    "statefulset": self._sts_name(name, 0, num_slices)
+                },
                 "ports": [{
                     "name": "http-" + name,
                     "port": SERVICE_PORT,
@@ -539,11 +717,13 @@ class NotebookReconciler(Reconciler):
             },
         }
 
-    def generate_headless_service(self, nb: dict) -> dict:
-        """Stable per-host DNS for slice rendezvous (multi-host ICI)."""
+    def generate_headless_service(self, nb: dict, resolved=None) -> dict:
+        """Stable per-host DNS for slice rendezvous (multi-host ICI and,
+        multi-slice, the DCN coordinator address)."""
         name = nb["metadata"]["name"]
-        svc = self.generate_service(nb)
+        svc = self.generate_service(nb, resolved)
         svc["metadata"]["name"] = f"{name}-hl"
+        svc["spec"]["selector"] = {"notebook-name": name}
         svc["spec"]["clusterIP"] = "None"
         svc["spec"].pop("type", None)
         return svc
@@ -595,12 +775,19 @@ class NotebookReconciler(Reconciler):
 
     # -------------------------------------------------------------- status
 
-    def update_status(self, nb: dict, sts: dict, resolved,
+    def update_status(self, nb: dict, sts_list, resolved,
                       gang_cond: dict | None = None) -> None:
+        if isinstance(sts_list, dict):  # single-STS convenience (tests)
+            sts_list = [sts_list]
         name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
+        # ready hosts across ALL slice StatefulSets (one for single-slice)
+        ready = sum(
+            (s.get("status") or {}).get("readyReplicas", 0) or 0
+            for s in sts_list
+        )
         status: dict = {
-            "readyReplicas": (sts.get("status") or {}).get("readyReplicas", 0),
+            "readyReplicas": ready,
             "containerState": {},
             "conditions": (nb.get("status") or {}).get("conditions") or [],
         }
@@ -611,8 +798,11 @@ class NotebookReconciler(Reconciler):
             c for c in status["conditions"]
             if c.get("type") not in GANG_CONDITION_TYPES
         ]
+        rank0 = self._sts_name(
+            name, 0, resolved.num_slices if resolved else 1
+        ) + "-0"
         try:
-            pod = self.kube.get("pods", f"{name}-0", namespace=ns)
+            pod = self.kube.get("pods", rank0, namespace=ns)
         except errors.NotFound:
             pod = None
         if pod:

@@ -65,6 +65,11 @@ TPU_SPEC = _obj(
                          "cloud.google.com/gke-tpu-topology"),
         "chips": _int("total chip count; alternative to topology for "
                       "single-host shapes"),
+        "nodePool": _str("optional explicit GKE node-pool pin "
+                         "(cloud.google.com/gke-nodepool); disambiguates "
+                         "pools that carry identical TPU labels"),
+        "slices": _int("DCN multi-slice: N slices of this topology joined "
+                       "via controller-injected MEGASCALE_* env (default 1)"),
     },
     desc="TPU attachment — the accelerator-aware replacement for the "
          "reference's opaque GPU limits key "

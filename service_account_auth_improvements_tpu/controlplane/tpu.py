@@ -22,8 +22,18 @@ import math
 RESOURCE_TPU = "google.com/tpu"
 SEL_ACCELERATOR = "cloud.google.com/gke-tpu-accelerator"
 SEL_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+# GKE stamps every node with its node pool; accelerator+topology labels do
+# NOT identify a slice (two v5e 4x4 pools carry identical labels), the
+# node pool does — gang placement pins and verifies against this key.
+SEL_NODEPOOL = "cloud.google.com/gke-nodepool"
 
 ANNOTATION_SLICE = "tpukf.dev/tpu-slice"
+LABEL_SLICE_ID = "tpukf.dev/slice-id"
+
+# DCN (multi-slice) rendezvous port for the MEGASCALE transport the
+# workload layer consumes (parallel/multihost.py). SURVEY §2b: inter-slice
+# DCN is env plumbing — the controller owns these values end to end.
+MEGASCALE_PORT = 8080
 
 # accelerator -> (gke accelerator label value, dims, single-host max chips,
 #                 multi-host chips per host)
@@ -68,17 +78,35 @@ class ResolvedTpu:
     total_chips: int
     num_hosts: int
     chips_per_host: int
+    # optional explicit node-pool pin (spec.tpu.nodePool): disambiguates
+    # between pools carrying identical accelerator+topology labels
+    node_pool: str | None = None
+    # DCN multi-slice: N independent slices (each num_hosts hosts) joined
+    # over the data-center network via MEGASCALE_* env (spec.tpu.slices)
+    num_slices: int = 1
 
     @property
     def selector(self) -> dict[str, str]:
-        return {
+        sel = {
             SEL_ACCELERATOR: GENERATIONS[self.generation]["selector"],
             SEL_TOPOLOGY: self.topology,
         }
+        if self.node_pool:
+            sel[SEL_NODEPOOL] = self.node_pool
+        return sel
 
     @property
     def multi_host(self) -> bool:
         return self.num_hosts > 1
+
+    @property
+    def multi_slice(self) -> bool:
+        return self.num_slices > 1
+
+    @property
+    def gang_size(self) -> int:
+        """Total pods that must co-start: hosts across all slices."""
+        return self.num_hosts * self.num_slices
 
 
 def resolve(spec: dict | None) -> ResolvedTpu | None:
@@ -123,9 +151,19 @@ def resolve(spec: dict | None) -> ResolvedTpu | None:
                 f"{per_host} chips/host"
             )
         hosts = total // per_host
+    slices = int(spec.get("slices", 1))
+    if slices < 1:
+        raise TpuValidationError(f"slices must be >= 1, got {slices}")
+    if slices > 1 and spec.get("nodePool"):
+        raise TpuValidationError(
+            "nodePool pins ONE pool but a multi-slice notebook needs one "
+            "pool per slice; drop nodePool or slices"
+        )
     return ResolvedTpu(
         generation=gen, topology=str(topology).lower(), total_chips=total,
         num_hosts=hosts, chips_per_host=per_host,
+        node_pool=(str(spec["nodePool"]) if spec.get("nodePool") else None),
+        num_slices=slices,
     )
 
 
@@ -166,4 +204,26 @@ def worker_env(name: str, service: str, namespace: str,
         {"name": "TPU_WORKER_HOSTNAMES", "value": hostnames},
         {"name": "TPU_TOPOLOGY", "value": resolved.topology},
         {"name": "TPU_CHIPS_PER_HOST", "value": str(resolved.chips_per_host)},
+    ]
+
+
+def megascale_env(coordinator_pod: str, service: str, namespace: str,
+                  resolved: ResolvedTpu, slice_id: int) -> list[dict]:
+    """DCN rendezvous env for one slice of a multi-slice notebook.
+
+    The coordinator is slice 0's rank-0 pod, addressed through the shared
+    headless service; every pod of every slice gets the same coordinator
+    address and slice count, plus its own slice id. Consumed by
+    parallel/multihost.py to form one global jax.distributed namespace
+    (intra-slice collectives ride ICI, inter-slice DCN). The reference has
+    no inter-accelerator story at all (SURVEY.md §2b) — this is the
+    PodDefault-style env surface promoted into the controller.
+    """
+    coord = (
+        f"{coordinator_pod}.{service}.{namespace}.svc:{MEGASCALE_PORT}"
+    )
+    return [
+        {"name": "MEGASCALE_COORDINATOR_ADDRESS", "value": coord},
+        {"name": "MEGASCALE_NUM_SLICES", "value": str(resolved.num_slices)},
+        {"name": "MEGASCALE_SLICE_ID", "value": str(slice_id)},
     ]

@@ -1,17 +1,25 @@
 """Flash attention for TPU: Pallas tiled online-softmax kernels + custom VJP.
 
-Forward and backward are hand-tiled Pallas kernels (MXU-shaped 128-blocks,
-fp32 accumulators in VMEM, logsumexp saved for the backward recompute), with
-a pure-JAX dense fallback for shapes/backends the kernel doesn't cover.
-Layout in-kernel is ``[batch, heads, seq, head_dim]``; the public wrapper
-takes the model's ``[batch, seq, heads, head_dim]``. GQA is handled by the
-kv-head index map (no KV repetition in memory).
+Forward and backward are hand-tiled Pallas kernels with a pure-JAX dense
+fallback for shapes/backends the kernel doesn't cover. Layout in-kernel is
+``[batch, heads, seq, head_dim]``; the public wrapper takes the model's
+``[batch, seq, heads, head_dim]``. GQA is handled by the kv-head index map
+(no KV repetition in memory).
 
-Mosaic lowering constraints shape two choices here: singleton block dims
-are squeezed with ``None`` (a literal 1 in the last two block dims fails
-the (8, 128) divisibility check on real TPUs), and causal inputs whose
-sequence is not a 128-multiple (the train step's seq-1!) are padded to the
-block size rather than silently falling back to dense.
+Performance-critical choices (v5e-measured):
+
+- **MXU dots run in the input dtype** (bf16 in training), accumulating in
+  f32 via ``preferred_element_type`` — upcasting operands to f32 before the
+  dot forces the ~8x-slower f32 MXU path and was worth ~3x end-to-end on
+  this kernel. The softmax statistics stay f32.
+- **K/V stream through a grid dimension** (innermost, double-buffered by
+  the Mosaic pipeline) instead of residing whole-sequence in VMEM; the
+  online-softmax state lives in f32 VMEM scratch across the KV grid steps.
+  VMEM residency is O(block), so long-context sequences (ring attention
+  shards) don't blow VMEM.
+- Block sizes adapt to the sequence: the largest of 512/256/128 that tiles
+  it. lse/delta are per-row scalars stored lane-replicated
+  ``[.., seq, LSE_LANES]`` (Mosaic wants (8, 128)-shaped trailing dims).
 
 Kernel playbook per /opt/skills/guides/pallas_guide.md. The reference repo
 has no kernels at all (its accelerator surface is a resource-limits string,
@@ -25,16 +33,24 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -2.0e38
 
-BLOCK_Q = 128
+# alignment unit: sequences are padded (causal) or required (non-causal) to
+# a multiple of this; actual block sizes are chosen per shape in _pick_block
+BLOCK_MIN = 128
+BLOCK_Q = 128   # kept as the public alignment contract (pad unit)
 BLOCK_K = 128
-# lse/delta are per-row scalars; Mosaic needs the last two block dims to be
-# (8k, 128)-shaped, so they are stored lane-replicated [.., seq, LSE_LANES]
-# (the same trick as upstream jax.experimental.pallas.ops.tpu.flash_attention
-# MIN_BLOCK_SIZE).
 LSE_LANES = 128
+
+
+def _pick_block(seq: int, want: int) -> int:
+    """Largest power-of-two block <= want that tiles ``seq``."""
+    b = want
+    while b > BLOCK_MIN and seq % b:
+        b //= 2
+    return b if seq % b == 0 else BLOCK_MIN
 
 
 def _use_pallas(q, k, causal: bool) -> bool:
@@ -59,86 +75,106 @@ def _use_pallas(q, k, causal: bool) -> bool:
         return False
 
 
+def _causal_mask(s, iq, ik, bq, bk):
+    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
 # ---------------------------------------------------------------- forward
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, sk):
-    """One (batch, head, q-block) program: online softmax over kv blocks.
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, causal, nk):
+    """One (batch, head, q-block, kv-block) program.
 
-    q_ref [1,1,bq,d]; k_ref/v_ref [1,1,sk,d]; o_ref [1,1,bq,d];
-    lse_ref [1,1,bq,LSE_LANES] (lane-replicated row scalars).
+    The kv-block axis is the innermost grid dim: Mosaic double-buffers the
+    K/V block fetches against compute, and the online-softmax state (acc,
+    m, l) carries across kv steps in f32 VMEM scratch. q_ref [1,1,bq,d];
+    k_ref/v_ref [1,1,bk,d]; o_ref [1,1,bq,d]; lse_ref [1,1,bq,LSE_LANES].
     """
-    iq = pl.program_id(2)
-    bq = q_ref.shape[2]
-    d = q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32) * scale
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
-    nkv_total = sk // BLOCK_K
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
     if causal:
-        nkv = jnp.minimum(((iq + 1) * bq + BLOCK_K - 1) // BLOCK_K, nkv_total)
+        needed = ik * bk < (iq + 1) * bq
+        last = jnp.minimum((((iq + 1) * bq + bk - 1) // bk), nk) - 1
     else:
-        nkv = nkv_total
+        needed = True
+        last = nk - 1
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]          # native dtype: bf16 dots hit the MXU fast path
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
+        ) * scale  # [bq, bk] f32
         if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 0
-            )
-            cols = j * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 1
-            )
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            s = _causal_mask(s, iq, ik, bq, bk)
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return acc_new, m_new, l_new
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nkv, body, (acc0, m0, l0))
-
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = jnp.broadcast_to(
-        (m + jnp.log(l)).astype(jnp.float32), (bq, LSE_LANES)
-    )
+    @pl.when(ik == last)
+    def _write():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l), lse_ref.shape[2:]
+        )
 
 
 def _flash_fwd(q, k, v, *, causal, interpret=False):
-    """q [b,h,sq,d]; k/v [b,hkv,sk,d] → (o [b,h,sq,d], lse [b,h,sq])."""
+    """q [b,h,sq,d]; k/v [b,hkv,sk,d] → (o [b,h,sq,d], lse [b,h,sq,LANES])."""
     b, h, sq, d = q.shape
     _, hkv, sk, _ = k.shape
     g = h // hkv
     scale = d ** -0.5
-    grid = (b, h, sq // BLOCK_Q)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, sk=sk)
+    bq = _pick_block(sq, 256)
+    bk = _pick_block(sk, 512)
+    nk = sk // bk
+    grid = (b, h, sq // bq, nk)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
-                         lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b, h, sq, LSE_LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, LSE_LANES), jnp.float32),
+            pltpu.VMEM((bq, LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -147,91 +183,87 @@ def _flash_fwd(q, k, v, *, causal, interpret=False):
 # ---------------------------------------------------------------- backward
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, scale, causal, sk):
-    iq = pl.program_id(2)
-    bq = q_ref.shape[2]
-    d = q_ref.shape[3]
-    q = q_ref[0, 0].astype(jnp.float32)
-    do = do_ref[0, 0].astype(jnp.float32)
-    lse = lse_ref[0, 0, :, :1]      # [bq, 1] (lanes are replicated)
-    delta = delta_ref[0, 0, :, :1]
+               acc_ref, *, scale, causal, nk):
+    """dq for one q-block, streaming kv blocks through the innermost grid
+    dim with an f32 scratch accumulator. The 1/scale fold: ds is
+    accumulated unscaled and dq multiplied by scale once at the end."""
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    bq, bk = q_ref.shape[2], k_ref.shape[2]
 
-    nkv_total = sk // BLOCK_K
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     if causal:
-        nkv = jnp.minimum(((iq + 1) * bq + BLOCK_K - 1) // BLOCK_K, nkv_total)
+        needed = ik * bk < (iq + 1) * bq
+        last = jnp.minimum((((iq + 1) * bq + bk - 1) // bk), nk) - 1
     else:
-        nkv = nkv_total
+        needed = True
+        last = nk - 1
 
-    def body(j, dq):
-        kb = k_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
-        vb = v_ref[0, 0, pl.ds(j * BLOCK_K, BLOCK_K), :].astype(jnp.float32)
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0]
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        do = do_ref[0, 0]
+        lse = lse_ref[0, 0, :, :1]
+        delta = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(
             q, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            rows = iq * bq + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 0
-            )
-            cols = j * BLOCK_K + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, BLOCK_K), 1
-            )
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, iq, ik, bq, bk)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        ds = (p * (dp - delta)).astype(kb.dtype)
+        acc_ref[...] += jax.lax.dot_general(
             ds, kb, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(0, nkv, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+    @pl.when(ik == last)
+    def _write():
+        dq_ref[0, 0] = (acc_ref[...] * scale).astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, causal, sq):
-    """One (batch, kv-head, k-block, group-head) program.
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, g, nq):
+    """dk/dv for one kv-block. Grid (b, hkv, kv-block, group-head, q-block):
+    the two innermost dims stream Q/dO blocks for every q-head sharing this
+    kv head, accumulating into f32 VMEM scratch; the single output write
+    happens on the final (head, q-block) step."""
+    ik, hg, iq = pl.program_id(2), pl.program_id(3), pl.program_id(4)
+    bk, d = k_ref.shape[2], k_ref.shape[3]
+    bq = q_ref.shape[2]
 
-    The group-head axis is the INNERMOST grid dim and revisits the same
-    dk/dv output block, accumulating across the q-heads that share this
-    kv head (TPU grids are sequential, so revisiting is a reduction).
-    Refs are squeezed: q/do [sq, d]; k/v [bk, d]; lse/delta
-    [sq, LSE_LANES] lane-replicated; dk/dv [bk, d] float32.
-    """
-    ik = pl.program_id(2)
-    hg = pl.program_id(3)
-    bk = k_ref.shape[0]
-    d = k_ref.shape[1]
-    kb = k_ref[...].astype(jnp.float32)
-    vb = v_ref[...].astype(jnp.float32)
+    @pl.when((hg == 0) & (iq == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    nq_total = sq // BLOCK_Q
-    iq0 = (ik * bk) // BLOCK_Q if causal else 0
+    needed = ((iq + 1) * bq > ik * bk) if causal else True
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        dob = do_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :].astype(jnp.float32)
-        lseb = lse_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :1]
-        deltab = delta_ref[pl.ds(i * BLOCK_Q, BLOCK_Q), :1]
+    @pl.when(needed)
+    def _compute():
+        kb = k_ref[0, 0]
+        vb = v_ref[0, 0]
+        qb = q_ref[0, 0]
+        dob = do_ref[0, 0]
+        lseb = lse_ref[0, 0, :, :1]
+        deltab = delta_ref[0, 0, :, :1]
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
         if causal:
-            rows = i * BLOCK_Q + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, bk), 0
-            )
-            cols = ik * bk + jax.lax.broadcasted_iota(
-                jnp.int32, (BLOCK_Q, bk), 1
-            )
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lseb)
-        dv2 = dv + jax.lax.dot_general(
+            s = _causal_mask(s, iq, ik, bq, bk)
+        p = jnp.exp(s - lseb).astype(dob.dtype)
+        dv_acc[...] += jax.lax.dot_general(
             p, dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -239,26 +271,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             dob, vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - deltab) * scale
-        dk2 = dk + jax.lax.dot_general(
+        ds = (p.astype(jnp.float32) * (dp - deltab)).astype(qb.dtype)
+        dk_acc[...] += jax.lax.dot_general(
             ds, qb, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk2, dv2
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(iq0, nq_total, body, (dk0, dv0))
-
-    @pl.when(hg == 0)
-    def _init():
-        dk_ref[...] = dk
-        dv_ref[...] = dv
-
-    @pl.when(hg != 0)
-    def _accumulate():
-        dk_ref[...] += dk
-        dv_ref[...] += dv
+    @pl.when((hg == g - 1) & (iq == nq - 1))
+    def _write():
+        dk_ref[0, 0] = (dk_acc[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
@@ -272,57 +294,71 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
         (b, h, sq, LSE_LANES),
     )
 
+    bq = _pick_block(sq, 256)
+    bk = _pick_block(sk, 512)
+    nk = sk // bk
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, sk=sk),
-        grid=(b, h, sq // BLOCK_Q),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
+        grid=(b, h, sq // bq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda ib, ih, iq: (ib, ih // g, 0, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
-                         lambda ib, ih, iq: (ib, ih, iq, 0)),
-            pl.BlockSpec((1, 1, BLOCK_Q, LSE_LANES),
-                         lambda ib, ih, iq: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda ib, ih, iq, ik: (ib, ih // g, ik, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
+            pl.BlockSpec((1, 1, bq, LSE_LANES),
+                         lambda ib, ih, iq, ik: (ib, ih, iq, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, BLOCK_Q, d), lambda ib, ih, iq: (ib, ih, iq, 0)
+            (1, 1, bq, d), lambda ib, ih, iq, ik: (ib, ih, iq, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
+    # dkv: kv-block stationary, Q/dO streaming. A smaller q block keeps the
+    # two streamed operands + two f32 accumulators comfortably in VMEM.
+    bkq = _pick_block(sq, 256)
+    bkk = _pick_block(sk, 256)
+    nq = sq // bkq
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, sq=sq),
-        grid=(b, hkv, sk // BLOCK_K, g),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, g=g, nq=nq),
+        grid=(b, hkv, sk // bkk, g, nq),
         in_specs=[
-            pl.BlockSpec((None, None, sq, d),
-                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
-            pl.BlockSpec((None, None, BLOCK_K, d),
-                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
-            pl.BlockSpec((None, None, BLOCK_K, d),
-                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
-            pl.BlockSpec((None, None, sq, d),
-                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
-            pl.BlockSpec((None, None, sq, LSE_LANES),
-                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
-            pl.BlockSpec((None, None, sq, LSE_LANES),
-                         lambda ib, ih, ik, hg: (ib, ih * g + hg, 0, 0)),
+            pl.BlockSpec((1, 1, bkq, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih * g + hg, iq, 0)),
+            pl.BlockSpec((1, 1, bkk, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bkk, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bkq, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih * g + hg, iq, 0)),
+            pl.BlockSpec((1, 1, bkq, LSE_LANES),
+                         lambda ib, ih, ik, hg, iq: (ib, ih * g + hg, iq, 0)),
+            pl.BlockSpec((1, 1, bkq, LSE_LANES),
+                         lambda ib, ih, ik, hg, iq: (ib, ih * g + hg, iq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((None, None, BLOCK_K, d),
-                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
-            pl.BlockSpec((None, None, BLOCK_K, d),
-                         lambda ib, ih, ik, hg: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bkk, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih, ik, 0)),
+            pl.BlockSpec((1, 1, bkk, d),
+                         lambda ib, ih, ik, hg, iq: (ib, ih, ik, 0)),
         ],
         out_shape=[
-            # f32 accumulation across the group-head revisits
-            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, hkv, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bkk, d), jnp.float32),
+            pltpu.VMEM((bkk, d), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk, dv
 
 
 # ----------------------------------------------------------- public entry

@@ -11,6 +11,7 @@ from service_account_auth_improvements_tpu.parallel.mesh import (  # noqa: F401
     MESH_AXES,
     MeshConfig,
     make_mesh,
+    make_multislice_mesh,
 )
 from service_account_auth_improvements_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,

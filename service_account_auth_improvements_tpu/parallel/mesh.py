@@ -86,6 +86,25 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def make_multislice_mesh(
+    num_slices: int,
+    config: MeshConfig | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Mesh for a multi-slice (DCN) job: ``dp`` spans the slices.
+
+    Under the controller's slice-major rank layout
+    (parallel/multihost.py rendezvous_plan) device enumeration groups
+    whole slices contiguously, so pinning ``dp = num_slices`` outermost
+    puts exactly one data-parallel replica per slice: the gradient
+    all-reduce is the only collective crossing DCN, everything else
+    (fsdp/sp/tp) stays on intra-slice ICI. ``config`` sizes the
+    intra-slice axes (its ``dp`` is overridden).
+    """
+    config = dataclasses.replace(config or MeshConfig(), dp=num_slices)
+    return make_mesh(config, devices)
+
+
 def single_device_mesh() -> Mesh:
     """A 1×1×1×1×1 mesh on the first device (bench / single-chip paths)."""
     return make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1])

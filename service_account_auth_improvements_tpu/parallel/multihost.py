@@ -1,16 +1,21 @@
-"""Multi-host bootstrap: from control-plane-injected env to jax.distributed.
+"""Multi-host / multi-slice bootstrap: controller-injected env → jax.distributed.
 
 The control plane (notebook-controller + PodDefaults webhook) injects
-``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES`` and (multi-slice) ``MEGASCALE_*``
-env into every pod of a multi-host slice — the TPU analog of the reference's
-``NB_PREFIX`` plumbing (reference: components/notebook-controller/controllers/
-notebook_controller.go:345-359). This module is the workload-side consumer:
-call ``maybe_initialize()`` first thing in a training script/notebook and the
-JAX runtime forms the slice.
+``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` into every pod of a multi-host
+slice, and — for ``spec.tpu.slices > 1`` — ``MEGASCALE_COORDINATOR_ADDRESS``
+/ ``MEGASCALE_NUM_SLICES`` / ``MEGASCALE_SLICE_ID`` for DCN rendezvous
+(controlplane/tpu.py worker_env/megascale_env; the TPU analog of the
+reference's ``NB_PREFIX`` plumbing, components/notebook-controller/
+controllers/notebook_controller.go:345-359). This module is the
+workload-side consumer: call ``maybe_initialize()`` first thing in a
+training script/notebook and the JAX runtime forms ONE global process
+namespace across all hosts of all slices — XLA then routes intra-slice
+collectives over ICI and inter-slice collectives over DCN.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import os
 
 import jax
@@ -27,19 +32,59 @@ def worker_env() -> tuple[int, list[str]]:
     return wid, hosts or ["localhost"]
 
 
+@dataclasses.dataclass(frozen=True)
+class RendezvousPlan:
+    """Global jax.distributed coordinates derived from the injected env."""
+
+    coordinator: str      # host:port for jax.distributed
+    num_processes: int    # hosts_per_slice * num_slices
+    process_id: int       # slice_id * hosts_per_slice + worker_id
+    num_slices: int
+    slice_id: int
+
+
+def rendezvous_plan() -> RendezvousPlan:
+    """Fold slice-local TPU_WORKER_* and MEGASCALE_* into one namespace.
+
+    Ranks are slice-major (slice 0 holds ranks 0..H-1, slice 1 holds
+    H..2H-1, ...) so a ``dp``-outermost mesh maps data-parallel replicas
+    onto slices and their gradient all-reduce onto DCN while everything
+    inner stays on ICI. The jax.distributed coordination service runs on
+    the global rank-0 host: slice 0's rank-0 pod — the same pod the
+    controller names in MEGASCALE_COORDINATOR_ADDRESS (its port is the
+    DCN transport's; coordination uses COORD_PORT).
+    """
+    wid, hosts = worker_env()
+    num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
+    slice_id = int(os.environ.get("MEGASCALE_SLICE_ID", "0"))
+    if num_slices > 1:
+        coord_raw = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+        coord_host = coord_raw.rsplit(":", 1)[0] if coord_raw else hosts[0]
+    else:
+        coord_host = hosts[0]
+    return RendezvousPlan(
+        coordinator=f"{coord_host}:{COORD_PORT}",
+        num_processes=len(hosts) * num_slices,
+        process_id=slice_id * len(hosts) + wid,
+        num_slices=num_slices,
+        slice_id=slice_id,
+    )
+
+
 def maybe_initialize() -> int:
-    """Initialize jax.distributed iff the env declares a multi-host slice.
+    """Initialize jax.distributed iff the env declares a multi-host or
+    multi-slice topology.
 
     Returns the process index. Idempotent; safe on single host and CPU.
     """
-    wid, hosts = worker_env()
-    if len(hosts) <= 1:
+    plan = rendezvous_plan()
+    if plan.num_processes <= 1:
         return 0
     try:
         jax.distributed.initialize(
-            coordinator_address=f"{hosts[0]}:{COORD_PORT}",
-            num_processes=len(hosts),
-            process_id=wid,
+            coordinator_address=plan.coordinator,
+            num_processes=plan.num_processes,
+            process_id=plan.process_id,
         )
     except RuntimeError as e:
         # Idempotency only: a second initialize in the same process is fine.

@@ -8,6 +8,7 @@ from service_account_auth_improvements_tpu.controlplane.controllers.culling impo
     CULLING_POLICY,
     LAST_ACTIVITY,
     LAST_CHECK,
+    PROBE_FAILURES,
     CullingReconciler,
 )
 from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
@@ -72,7 +73,8 @@ def test_idle_within_threshold_survives():
 
 def test_unreachable_probe_never_culls():
     # Even with ancient recorded activity, a failed probe must not cull
-    # (pod may be booting/crashed); only the check timestamp is stamped.
+    # immediately (pod may be booting/crashed); the check timestamp is
+    # stamped; no pod bound to a node means no counting either.
     old = (NOW - dt.timedelta(days=7)).strftime("%Y-%m-%dT%H:%M:%SZ")
     kube, rec = _world(None, annotations={LAST_ACTIVITY: old})
     rec.reconcile(Request("u", "nb"))
@@ -80,6 +82,88 @@ def test_unreachable_probe_never_culls():
     assert STOP_ANNOTATION not in a
     assert a[LAST_CHECK] == "2026-07-29T12:00:00Z"
     assert a[LAST_ACTIVITY] == old
+    assert PROBE_FAILURES not in a
+
+
+def _mk_pod(kube, ready=True, bound=True):
+    kube.create("pods", {
+        "metadata": {"name": "nb-0", "namespace": "u"},
+        "spec": {"nodeName": "node-1"} if bound else {},
+        "status": {"conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"},
+        ]},
+    })
+
+
+def test_unreachable_limit_culls_bound_not_ready_pod():
+    """VERDICT r3 #7: a crash-looping notebook must not hold a TPU slice
+    forever — after CULL_UNREACHABLE_LIMIT consecutive failed probes with
+    the rank-0 pod bound to a node but not Ready, the stop annotation
+    lands."""
+    kube, rec = _world(
+        None, annotations={PROBE_FAILURES: "2"},
+    )
+    rec.unreachable_limit = 3
+    _mk_pod(kube, ready=False)
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert a[PROBE_FAILURES] == "0"  # reset so a resume starts fresh
+
+
+def test_unreachable_ready_pod_is_never_culled():
+    # A Ready pod that doesn't answer the kernels probe (non-Jupyter image)
+    # must never be culled blind, and its failure count resets.
+    kube, rec = _world(None, annotations={PROBE_FAILURES: "99"})
+    rec.unreachable_limit = 3
+    _mk_pod(kube, ready=True)
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[PROBE_FAILURES] == "0"
+
+
+def test_unreachable_unbound_pod_is_never_counted():
+    # A gang-gated / Pending-on-capacity pod holds no chips; stopping it
+    # would kill a healthy still-starting workload no matter how long
+    # scheduling takes.
+    kube, rec = _world(None, annotations={PROBE_FAILURES: "500"})
+    rec.unreachable_limit = 3
+    _mk_pod(kube, ready=False, bound=False)
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[PROBE_FAILURES] == "500"  # untouched, not incremented
+
+
+def test_unreachable_below_limit_only_counts():
+    kube, rec = _world(None)
+    rec.unreachable_limit = 5
+    _mk_pod(kube, ready=False)
+    rec.reconcile(Request("u", "nb"))
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[PROBE_FAILURES] == "2"
+
+
+def test_unreachable_limit_zero_disables_reclaim():
+    kube, rec = _world(None, annotations={PROBE_FAILURES: "500"})
+    rec.unreachable_limit = 0
+    _mk_pod(kube, ready=False)
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[PROBE_FAILURES] == "501"
+
+
+def test_successful_probe_resets_failure_count():
+    kube, rec = _world([{"execution_state": "busy"}],
+                       annotations={PROBE_FAILURES: "7"})
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert a[PROBE_FAILURES] == "0"
 
 
 def test_training_policy_opts_out():

@@ -53,6 +53,54 @@ def test_flash_grads_match_dense():
         )
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_multiblock_streaming_matches_dense(causal, monkeypatch):
+    """The KV/Q grid streaming paths (nk>1, nq>1): scratch init at ik==0,
+    alpha-rescaled accumulation across kv steps, the causal last-block
+    write condition, and the dkv (hg, iq) accumulator carry. Blocks are
+    forced to 128 so a modest seq exercises several grid steps."""
+    import service_account_auth_improvements_tpu.ops.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_pick_block", lambda seq, want: 128)
+    q, k, v = _make_qkv(b=1, sq=384, sk=384, h=2, hkv=1, d=64)
+    want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=causal)
+    got = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=5e-5)
+
+    def loss_dense(q, k, v):
+        o = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, causal=causal, interpret=True)
+        return jnp.sum(o * jnp.cos(o))
+
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gd, gf, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, err_msg=f"d{name}"
+        )
+
+
+def test_flash_asymmetric_blocks_match_dense(monkeypatch):
+    """bq != bk (the production shape: q-block 256, kv-block 512)."""
+    import service_account_auth_improvements_tpu.ops.flash_attention as fa
+
+    picked = {}
+
+    def pick(seq, want):
+        picked[want] = True
+        return 128 if want == 256 else 256
+
+    monkeypatch.setattr(fa, "_pick_block", pick)
+    q, k, v = _make_qkv(b=1, sq=512, sk=512, h=2, hkv=2, d=64)
+    want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=True)
+    got = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=5e-5)
+    assert picked == {256: True, 512: True}
+
+
 def test_fallback_on_unaligned_shapes():
     # seq 100 is not block-aligned → dense fallback must engage, same result.
     q, k, v = _make_qkv(sq=100, sk=100)
