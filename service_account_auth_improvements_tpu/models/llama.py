@@ -53,6 +53,23 @@ class LlamaConfig:
     # per token (one lm_head), so: True for tp>1 slices, False for
     # single-chip where the local gather is free.
     iota_embed: bool = False
+    # Mixture-of-experts (switch-style top-1 routing). 0 = dense FFN.
+    # Experts shard over the ``ep`` mesh axis via the "expert" logical
+    # axis; dispatch/combine are one-hot einsum contractions so GSPMD
+    # lowers the token shuffle to all-to-alls over ep (static shapes, no
+    # per-token gather/scatter — the MXU-friendly formulation). Routing
+    # runs per group of ``moe_group_size`` tokens so the dispatch tensor
+    # is O(seq · E · cap_per_group) — linear in sequence length — instead
+    # of O(seq²·f/·) whole-row capacity.
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # Switch load-balance aux loss weight
+    moe_group_size: int = 1024    # routing/capacity group (<= seq uses seq)
+
+    def moe_cap(self, group: int) -> int:
+        """Per-group expert capacity."""
+        return max(1, int(self.moe_capacity_factor * group
+                          / self.moe_experts))
 
     @property
     def q_dim(self) -> int:
@@ -63,12 +80,19 @@ class LlamaConfig:
         return self.n_kv_heads * self.head_dim
 
     def param_count(self) -> int:
+        if self.moe_experts:
+            ffn = (
+                self.dim * self.moe_experts  # router
+                + 3 * self.moe_experts * self.dim * self.mlp_dim
+            )
+        else:
+            ffn = 3 * self.dim * self.mlp_dim  # gate, up, down
         per_layer = (
             2 * self.dim  # norms
             + self.dim * self.q_dim  # wq
             + 2 * self.dim * self.kv_dim  # wk, wv
             + self.q_dim * self.dim  # wo
-            + 3 * self.dim * self.mlp_dim  # gate, up, down
+            + ffn
         )
         return (
             self.vocab_size * self.dim  # tok_embed
@@ -83,13 +107,29 @@ class LlamaConfig:
         standard (PaLM-style) MFU accounting."""
         return self.param_count() - self.vocab_size * self.dim
 
+    def active_matmul_param_count(self) -> int:
+        """Matmul params a single token actually flows through: with
+        top-1 MoE only one of the E experts is active per token."""
+        total = self.matmul_param_count()
+        if self.moe_experts:
+            total -= (self.n_layers * 3 * (self.moe_experts - 1)
+                      * self.dim * self.mlp_dim)
+        return total
+
     def flops_per_token(self, seq_len: int | None = None) -> int:
-        """Approx training FLOPs/token: 6×(matmul params), plus the causal
-        attention-score term 12·L·s·H·d_head·(1/2) when ``seq_len`` given."""
-        flops = 6 * self.matmul_param_count()
+        """Approx training FLOPs/token: 6×(active matmul params), plus the
+        causal attention-score term 12·L·s·H·d_head·(1/2) when ``seq_len``
+        given, plus (MoE) the dispatch/combine contraction cost."""
+        flops = 6 * self.active_matmul_param_count()
         if seq_len:
             # qk^T + av, fwd+bwd (×3 fwd-equivalent ×2), causal halves it.
             flops += 6 * self.n_layers * self.n_heads * self.head_dim * seq_len
+        if self.moe_experts:
+            # dispatch + combine einsums: 2·E·cap_g·d FLOPs/token each in
+            # the forward pass (E·cap_g ≈ capacity_factor·group), ×3 train
+            group = min(self.moe_group_size, seq_len or self.moe_group_size)
+            flops += (3 * 2 * 2 * self.n_layers
+                      * self.moe_experts * self.moe_cap(group) * self.dim)
         return flops
 
 
@@ -118,6 +158,19 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=32_768, dim=1536, n_layers=20, n_heads=12, n_kv_heads=4,
         head_dim=128, mlp_dim=6144, max_seq_len=2048, attn_impl="flash",
     ),
+    # CI-sized switch MoE: 4 experts, top-1 routing — exercises the ep
+    # mesh axis (dispatch/combine all-to-alls) at test scale.
+    "moe_smoke": LlamaConfig(
+        vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=16, mlp_dim=256, max_seq_len=256, rope_theta=10_000.0,
+        moe_experts=4,
+    ),
+    # Switch-style 8-expert variant of the 1B geometry (7.1B total params,
+    # 1.2B matmul-active per token): the ep-axis flagship.
+    "moe_8x1b": LlamaConfig(
+        vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        head_dim=64, mlp_dim=8192, max_seq_len=8192, moe_experts=8,
+    ),
     "llama3_1b": LlamaConfig(
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         head_dim=64, mlp_dim=8192, max_seq_len=8192,
@@ -132,6 +185,19 @@ PRESETS: dict[str, LlamaConfig] = {
 
 def logical_axes(cfg: LlamaConfig):
     """Pytree (same structure as params) of logical-axis tuples."""
+    if cfg.moe_experts:
+        ffn = {
+            "router": ("layers", "embed", "expert"),
+            "moe_gate": ("layers", "expert", "embed", "mlp"),
+            "moe_up": ("layers", "expert", "embed", "mlp"),
+            "moe_down": ("layers", "expert", "mlp", "embed"),
+        }
+    else:
+        ffn = {
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        }
     return {
         "tok_embed": ("vocab", "embed"),
         "layers": {
@@ -141,9 +207,7 @@ def logical_axes(cfg: LlamaConfig):
             "wv": ("layers", "embed", "kv_heads"),
             "wo": ("layers", "heads", "embed"),
             "mlp_norm": ("layers", "norm"),
-            "w_gate": ("layers", "embed", "mlp"),
-            "w_up": ("layers", "embed", "mlp"),
-            "w_down": ("layers", "mlp", "embed"),
+            **ffn,
         },
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
@@ -160,8 +224,13 @@ def init(cfg: LlamaConfig, key: jax.Array):
         return (jax.random.normal(key, shape, jnp.float32) * std).astype(pdt)
 
     L = cfg.n_layers
+    E = cfg.moe_experts
     std = 0.02
     out_std = 0.02 / (2 * L) ** 0.5
+    # key-draw order matters for reproducibility: the dense stream
+    # (tok_embed, wq..wo, ffn, lm_head) is the historical order the
+    # recorded bench runs used — MoE draws its extra keys in the same slot
+    # the dense FFN keys occupied
     params = {
         "tok_embed": normal(next(keys), (cfg.vocab_size, cfg.dim), std),
         "layers": {
@@ -171,18 +240,99 @@ def init(cfg: LlamaConfig, key: jax.Array):
             "wv": normal(next(keys), (L, cfg.dim, cfg.kv_dim), std),
             "wo": normal(next(keys), (L, cfg.q_dim, cfg.dim), out_std),
             "mlp_norm": jnp.ones((L, cfg.dim), pdt),
-            "w_gate": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
-            "w_up": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
-            "w_down": normal(next(keys), (L, cfg.mlp_dim, cfg.dim), out_std),
         },
         "final_norm": jnp.ones((cfg.dim,), pdt),
-        "lm_head": normal(next(keys), (cfg.dim, cfg.vocab_size), std),
     }
+    if E:
+        params["layers"].update({
+            "router": normal(next(keys), (L, cfg.dim, E), std),
+            "moe_gate": normal(
+                next(keys), (L, E, cfg.dim, cfg.mlp_dim), std
+            ),
+            "moe_up": normal(next(keys), (L, E, cfg.dim, cfg.mlp_dim), std),
+            "moe_down": normal(
+                next(keys), (L, E, cfg.mlp_dim, cfg.dim), out_std
+            ),
+        })
+    else:
+        params["layers"].update({
+            "w_gate": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
+            "w_up": normal(next(keys), (L, cfg.dim, cfg.mlp_dim), std),
+            "w_down": normal(
+                next(keys), (L, cfg.mlp_dim, cfg.dim), out_std
+            ),
+        })
+    params["lm_head"] = normal(next(keys), (cfg.dim, cfg.vocab_size), std)
     return params
 
 
-def _layer(cfg: LlamaConfig, x, lp, cos, sin):
-    """One decoder block. x: [b, s, dim] in compute dtype."""
+def _moe_ffn(cfg: LlamaConfig, h, lp, token_mask=None):
+    """Switch-style top-1 MoE FFN: h [b, s, d] → (out [b, s, d], aux).
+
+    Capacity-based one-hot dispatch: every shape is static, the token
+    shuffle is an einsum contraction over the expert/capacity axes that
+    GSPMD lowers to all-to-alls when "expert" is sharded over ``ep``, and
+    the expert matmuls are a single batched [G, E, C, d] × [E, d, m]
+    einsum on the MXU. Routing and capacity are applied per group of
+    ``moe_group_size`` tokens so the dispatch tensor stays linear in
+    sequence length. Tokens overflowing an expert's capacity — and
+    masked (padding) tokens, which neither consume capacity nor enter the
+    load-balance statistics — fall through to the residual connection
+    (standard switch semantics). ``aux`` is the Switch load-balance loss
+    (density × router-probability dot, scaled by E); router math in f32.
+    """
+    b, s, d = h.shape
+    E = cfg.moe_experts
+    g = min(cfg.moe_group_size, s)
+    if s % g:
+        g = s  # non-divisible seq: one group (tests, odd shapes)
+    cap = cfg.moe_cap(g)
+    cdt = h.dtype
+    hg = h.reshape(b * (s // g), g, d)               # [G, g, d]
+    if token_mask is None:
+        tmask = jnp.ones(hg.shape[:2], jnp.float32)
+    else:
+        tmask = token_mask.astype(jnp.float32).reshape(b * (s // g), g)
+
+    logits = jnp.einsum(
+        "gsd,de->gse", hg.astype(jnp.float32),
+        lp["router"].astype(jnp.float32),
+    )
+    probs = jax.nn.softmax(logits, axis=-1)          # [G, g, E]
+    gate = jnp.max(probs, axis=-1) * tmask           # [G, g]
+    idx = jnp.argmax(probs, axis=-1)                 # [G, g]
+    # masked tokens route nowhere: no capacity use, no balance stats
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32) * tmask[..., None]
+    denom = jnp.maximum(tmask.sum(axis=1, keepdims=True), 1.0)
+    density = onehot.sum(axis=1) / denom             # routed fraction
+    density_proxy = (
+        (probs * tmask[..., None]).sum(axis=1) / denom
+    )                                                # mean router prob
+    aux = E * jnp.mean(jnp.sum(density * density_proxy, axis=-1))
+
+    # position of each token in its expert's queue (per group)
+    pos = jnp.cumsum(onehot, axis=1) - onehot        # [G, g, E]
+    pos_tok = jnp.sum(pos * onehot, axis=-1)         # [G, g]
+    keep = (pos_tok < cap).astype(jnp.float32) * tmask
+    disp = (onehot * keep[..., None])[..., None] * jax.nn.one_hot(
+        pos_tok.astype(jnp.int32), cap, dtype=jnp.float32
+    )[..., None, :]                                  # [G, g, E, C]
+
+    xin = jnp.einsum("gsec,gsd->gecd", disp.astype(cdt), hg)
+    xin = shard_constraint(xin, ("batch", "expert", None, None))
+    act = jax.nn.silu(
+        jnp.einsum("gecd,edm->gecm", xin, lp["moe_gate"].astype(cdt))
+    ) * jnp.einsum("gecd,edm->gecm", xin, lp["moe_up"].astype(cdt))
+    act = shard_constraint(act, ("batch", "expert", None, "mlp"))
+    xout = jnp.einsum("gecm,emd->gecd", act, lp["moe_down"].astype(cdt))
+    combine = (disp * (gate * keep)[..., None, None]).astype(cdt)
+    out = jnp.einsum("gsec,gecd->gsd", combine, xout)
+    return out.reshape(b, s, d), aux
+
+
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
+    """One decoder block. x: [b, s, dim] in compute dtype.
+    Returns (x, aux) — aux is the MoE load-balance term (0 for dense)."""
     b, s, _ = x.shape
     cdt = jnp.dtype(cfg.dtype)
 
@@ -197,15 +347,24 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin):
     x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"].astype(cdt)
 
     h = rms_norm(x, lp["mlp_norm"].astype(cdt), cfg.norm_eps)
-    gate = jax.nn.silu(h @ lp["w_gate"].astype(cdt))
-    up = h @ lp["w_up"].astype(cdt)
-    ff = shard_constraint(gate * up, ("batch", "seq", "mlp"))
-    x = x + ff @ lp["w_down"].astype(cdt)
-    return shard_constraint(x, ("batch", "seq", None))
+    if cfg.moe_experts:
+        ff, aux = _moe_ffn(cfg, h, lp, token_mask)
+        x = x + ff
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+        up = h @ lp["w_up"].astype(cdt)
+        ff = shard_constraint(gate * up, ("batch", "seq", "mlp"))
+        x = x + ff @ lp["w_down"].astype(cdt)
+        aux = jnp.zeros((), jnp.float32)
+    return shard_constraint(x, ("batch", "seq", None)), aux
 
 
-def apply(cfg: LlamaConfig, params, tokens: jax.Array) -> jax.Array:
-    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32."""
+def apply(cfg: LlamaConfig, params, tokens: jax.Array,
+          return_aux: bool = False, token_mask=None):
+    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32.
+    With ``return_aux`` also returns the summed MoE load-balance loss.
+    ``token_mask`` [b, s] (1.0 = real token) keeps padding out of MoE
+    routing capacity and balance statistics."""
     cdt = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
     if cfg.iota_embed:
@@ -231,22 +390,28 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array) -> jax.Array:
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, static_argnums=())
     if cfg.scan_layers:
-        x, _ = jax.lax.scan(
-            lambda carry, lp: (layer_fn(carry, lp, cos, sin), None),
+        x, aux_stack = jax.lax.scan(
+            lambda carry, lp: layer_fn(carry, lp, cos, sin, token_mask),
             x,
             params["layers"],
         )
+        aux = jnp.sum(aux_stack)
     else:
+        aux = jnp.zeros((), jnp.float32)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[i], params["layers"])
-            x = layer_fn(x, lp, cos, sin)
+            x, layer_aux = layer_fn(x, lp, cos, sin, token_mask)
+            aux = aux + layer_aux
 
     x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"].astype(cdt),
         preferred_element_type=jnp.float32,
     )
-    return shard_constraint(logits, ("batch", "seq", "vocab"))
+    logits = shard_constraint(logits, ("batch", "seq", "vocab"))
+    if return_aux:
+        return logits, aux
+    return logits
 
 
 def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
@@ -260,7 +425,10 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
     Contractions and logsumexp reduce over the sharded axis as ordinary
     psums, so the big tensor never materializes unsharded.
     """
-    logits = apply(cfg, params, tokens[:, :-1])
+    logits, aux = apply(
+        cfg, params, tokens[:, :-1], return_aux=True,
+        token_mask=None if mask is None else mask[:, :-1],
+    )
     # clip like the embedding path: an out-of-range target would one-hot
     # to all-zeros and make nll = logz instead of a real cross-entropy
     targets = jnp.clip(tokens[:, 1:], 0, cfg.vocab_size - 1)
@@ -269,6 +437,10 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
     target_logit = jnp.einsum("bsv,bsv->bs", logits, onehot)
     nll = logz - target_logit
     if mask is None:
-        return nll.mean()
-    m = mask[:, 1:].astype(nll.dtype)
-    return (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+        loss = nll.mean()
+    else:
+        m = mask[:, 1:].astype(nll.dtype)
+        loss = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    if cfg.moe_experts:
+        loss = loss + cfg.moe_aux_weight * aux
+    return loss
