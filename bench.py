@@ -1,11 +1,15 @@
 """Headline benchmark: Llama train-step MFU on one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "matrix": [...]}
 
 The reference publishes no performance numbers (BASELINE.md) — the baseline
 is this project's own north star: >=35% MFU on the Llama training workload.
 ``vs_baseline`` is achieved_MFU / 0.35, so 1.0 == target parity.
+
+``matrix`` records the non-headline configs (bench_400m, and the dense-
+attention fallback) so kernel regressions surface round to round
+(VERDICT r3 #8) — set SATPU_BENCH_MATRIX=0 to skip them.
 
 Runs on the default JAX backend (the tunneled v5e chip under the driver);
 set SATPU_BENCH_PRESET to override the model size, SATPU_BENCH_CPU=1 to
@@ -21,17 +25,11 @@ import sys
 import time
 
 
-def main() -> None:
-    if os.environ.get("SATPU_BENCH_CPU"):
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
-    else:
-        import jax
-
+def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
+    """One measured config → (tokens/sec, mfu, step_time)."""
+    import jax
     import jax.numpy as jnp
 
-    from service_account_auth_improvements_tpu.models import llama
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_mesh,
@@ -41,17 +39,10 @@ def main() -> None:
         init_train_state,
         make_train_step,
     )
-    from service_account_auth_improvements_tpu.train.step import state_shardings
-
-    on_accel = jax.default_backend() not in ("cpu",)
-    preset = os.environ.get(
-        "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
+    from service_account_auth_improvements_tpu.train.step import (
+        state_shardings,
     )
-    cfg = llama.PRESETS[preset]
-    batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
-    seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
 
-    n_dev = 1  # single-chip headline number
     mesh = make_mesh(
         MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1]
     )
@@ -63,9 +54,6 @@ def main() -> None:
         jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
     )
     mask = jnp.ones_like(tokens)
-
-    warmup = 2
-    iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
     with jax.set_mesh(mesh):
         for _ in range(warmup):
             state, m = step(state, tokens, mask)
@@ -79,14 +67,60 @@ def main() -> None:
             state, m = step(state, tokens, mask)
         loss = float(m["loss"])
         dt = (time.perf_counter() - t0) / iters
-        assert jnp.isfinite(loss), f"non-finite loss {loss}"
+    assert jnp.isfinite(loss), f"non-finite loss {loss}"
 
-    # The train step consumes seq-1 target positions per row.
     tokens_per_step = batch * (seq - 1)
     tok_per_sec = tokens_per_step / dt
-    flops_per_step = cfg.flops_per_token(seq) * tokens_per_step
     peak = chip_peak_flops()
-    mfu = flops_per_step / (dt * n_dev * peak) if peak else 0.0
+    flops_per_step = cfg.flops_per_token(seq) * tokens_per_step
+    mfu = flops_per_step / (dt * peak) if peak else 0.0
+    return tok_per_sec, mfu, dt
+
+
+def main() -> None:
+    if os.environ.get("SATPU_BENCH_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    from service_account_auth_improvements_tpu.models import llama
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    preset = os.environ.get(
+        "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
+    )
+    cfg = llama.PRESETS[preset]
+    batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
+    seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
+    iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
+
+    tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters)
+
+    matrix = []
+    want_matrix = (
+        on_accel and os.environ.get("SATPU_BENCH_MATRIX", "1") != "0"
+        and preset == "bench_800m"
+    )
+    if want_matrix:
+        for name, mcfg in [
+            ("bench_400m", llama.PRESETS["bench_400m"]),
+            ("bench_400m_dense",
+             dataclasses.replace(llama.PRESETS["bench_400m"],
+                                 attn_impl="dense")),
+        ]:
+            try:
+                m_tok, m_mfu, m_dt = _run_config(
+                    mcfg, batch, seq, max(3, iters - 2))
+                matrix.append({
+                    "preset": name, "attn": mcfg.attn_impl,
+                    "tokens_per_sec": round(m_tok, 1),
+                    "mfu": round(m_mfu, 4),
+                    "step_time_s": round(m_dt, 4),
+                })
+            except Exception as e:  # pragma: no cover - survive matrix rows
+                matrix.append({"preset": name, "error": str(e)[:200]})
 
     print(
         json.dumps(
@@ -94,7 +128,7 @@ def main() -> None:
                 "metric": "llama_train_tokens_per_sec_per_chip",
                 "value": round(tok_per_sec, 1),
                 "unit": "tokens/s/chip",
-                "vs_baseline": round(mfu / 0.35, 4) if peak else 0.0,
+                "vs_baseline": round(mfu / 0.35, 4),
                 "mfu": round(mfu, 4),
                 "preset": preset,
                 "batch": batch,
@@ -102,6 +136,7 @@ def main() -> None:
                 "step_time_s": round(dt, 4),
                 "backend": jax.default_backend(),
                 "device": getattr(jax.devices()[0], "device_kind", "?"),
+                "matrix": matrix,
             }
         )
     )

@@ -106,14 +106,26 @@ def kind_integration_steps(wait_selectors: list[str]) -> list[dict]:
 COMPONENT_WORKFLOWS: dict[str, dict] = {
     "unit_tests.yaml": workflow(
         "Unit Tests",
-        ["service_account_auth_improvements_tpu/**", "tests/**", "native/**"],
+        ["service_account_auth_improvements_tpu/**", "tests/**", "native/**",
+         "frontends/**"],
         {"pytest": job(
             [CHECKOUT, SETUP_PY, INSTALL_DEPS,
              {"name": "Build native components", "run": "make -C native"},
              {"name": "Run tests",
               "run": "python -m pytest tests/ -x -q"}],
             env=PY_TEST_ENV,
-        )},
+        ),
+         # the reference runs its Angular specs in a dedicated lane
+         # (jwa_frontend_tests.yaml:33-50); same tier here with the
+         # zero-dependency harness under frontends/tests/
+         "frontend_tests": job([
+            CHECKOUT,
+            {"name": "Set up Node",
+             "uses": "actions/setup-node@v4",
+             "with": {"node-version": "20"}},
+            {"name": "Run frontend unit tests",
+             "run": "node frontends/tests/run.js"},
+        ])},
     ),
     "manifests_validation.yaml": workflow(
         "Manifests Validation",

@@ -244,7 +244,17 @@
     if (value === null || value === undefined) return "null";
     if (typeof value !== "object") {
       if (typeof value === "string") {
-        return /^[\w./:@-]*$/.test(value) && value !== "" ?
+        // quote ambiguous scalars too: "true"/"on"/"123" unquoted would
+        // re-parse as bool/int if the YAML view is copied back out, but
+        // k8s labels/annotations are strings
+        const ambiguous =
+          /^(true|false|null|yes|no|on|off|~)$/i.test(value) ||
+          /^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$/.test(value) ||
+          // YAML 1.1 also reads sexagesimal ("1:30" -> 90) and
+          // hex/octal ints — kubectl's parser is 1.1
+          /^[-+]?\d+(:[0-5]?\d)+$/.test(value) ||
+          /^0[xXoO][0-9a-fA-F]+$/.test(value);
+        return /^[\w./:@-]*$/.test(value) && value !== "" && !ambiguous ?
           value : JSON.stringify(value);
       }
       return String(value);

@@ -109,20 +109,30 @@
     const { config } = await api("GET", "api/config");
     const form = el("div", { class: "card" });
 
+    // readOnly config sections (spawner_ui_config readOnly: true) are
+    // admin-fixed: the control renders disabled and the field is OMITTED
+    // from the POST body — the backend 400s on any readOnly key present
+    // in the request (form.py get_form_value)
+    const ro = (key) => !!((config[key] || {}).readOnly);
+
     const name = el("input", { placeholder: "my-notebook" });
     const image = el("select", {});
     for (const opt of config.image.options) {
       image.appendChild(el("option", { value: opt }, opt));
     }
     image.value = config.image.value;
+    image.disabled = ro("image");
     const customImage = el("input",
       { placeholder: "custom image (optional)" });
+    customImage.disabled = ro("image");
     const serverType = el("select", {});
     for (const t of ["jupyter", "group-one", "group-two"]) {
       serverType.appendChild(el("option", { value: t }, t));
     }
     const cpu = el("input", { value: config.cpu.value });
+    cpu.disabled = ro("cpu");
     const memory = el("input", { value: config.memory.value });
+    memory.disabled = ro("memory");
 
     // TPU picker (replaces the reference's GPU vendor dropdown)
     const tpuGen = el("select", {});
@@ -142,7 +152,132 @@
     });
 
     const wsSize = el("input", { value: "10Gi", style: "width:100px" });
+    wsSize.disabled = ro("workspaceVolume");
     const shm = el("input", { type: "checkbox", checked: "" });
+    shm.disabled = ro("shm");
+
+    // data volumes: new-PVC or existing-PVC attach rows (reference JWA
+    // form-data-volumes; backend: form.py volume_requests /
+    // app.py existingSource handling)
+    const dataVols = el("div", { class: "datavols" });
+    let existingPvcs = [];
+    if (ns) {
+      api("GET", `api/namespaces/${ns}/pvcs`).then(({ pvcs }) => {
+        existingPvcs = pvcs.map((p) => p.name);
+        for (const sel of dataVols.querySelectorAll("select.pvc-pick")) {
+          fillPvcOptions(sel);
+        }
+      }).catch((e) => snackbar(e.message, true));
+    }
+    function fillPvcOptions(sel) {
+      sel.replaceChildren();
+      if (!existingPvcs.length) {
+        sel.appendChild(el("option", { value: "" }, "no PVCs found"));
+      }
+      for (const name of existingPvcs) {
+        sel.appendChild(el("option", { value: name }, name));
+      }
+    }
+    function addVolumeRow() {
+      const type = el("select", { class: "vol-type" },
+        el("option", { value: "new" }, "new volume"),
+        el("option", { value: "existing" }, "existing volume"));
+      const mount = el("input", {
+        class: "vol-mount", value: `/mnt/vol-${dataVols.children.length + 1}`,
+      });
+      const size = el("input",
+        { class: "vol-size", value: "5Gi", style: "width:80px" });
+      const pvcPick = el("select",
+        { class: "pvc-pick", style: "display:none" });
+      fillPvcOptions(pvcPick);
+      type.addEventListener("change", () => {
+        const existing = type.value === "existing";
+        size.style.display = existing ? "none" : "";
+        pvcPick.style.display = existing ? "" : "none";
+      });
+      const remove = el("button", {
+        onclick: () => { row.remove(); },
+      }, "✕");
+      const row = el("div", { class: "row vol-row" },
+        type, el("span", { class: "muted" }, "mount"), mount,
+        size, pvcPick, remove);
+      dataVols.appendChild(row);
+    }
+    const addVolBtn = ro("dataVolumes")
+      ? el("span", { class: "muted" }, "fixed by your administrator")
+      : el("button", { onclick: addVolumeRow }, "+ add volume");
+
+    function collectDataVolumes() {
+      const vols = [];
+      for (const row of dataVols.querySelectorAll(".vol-row")) {
+        const type = row.querySelector(".vol-type").value;
+        const mount = row.querySelector(".vol-mount").value.trim();
+        if (type === "existing") {
+          const pvc = row.querySelector(".pvc-pick").value;
+          if (pvc) vols.push({ mount, existingSource: pvc });
+        } else {
+          vols.push({
+            mount,
+            newPvc: {
+              metadata: { name: `{notebook-name}-vol-${vols.length + 1}` },
+              spec: {
+                resources: { requests: {
+                  storage: row.querySelector(".vol-size").value,
+                } },
+                accessModes: ["ReadWriteOnce"],
+              },
+            },
+          });
+        }
+      }
+      return vols;
+    }
+
+    // affinity / tolerations: keyed option groups served by /api/config
+    // (reference form-affinity-tolerations; backend form.py:207-224)
+    const affinity = el("select", { class: "affinity" });
+    affinity.appendChild(el("option", { value: "none" }, "none"));
+    for (const opt of (config.affinityConfig || {}).options || []) {
+      affinity.appendChild(el("option", { value: opt.configKey },
+        opt.displayName || opt.configKey));
+    }
+    if ((config.affinityConfig || {}).value) {
+      affinity.value = config.affinityConfig.value;
+    }
+    affinity.disabled = ro("affinityConfig");
+    const tolerations = el("select", { class: "tolerations" });
+    tolerations.appendChild(el("option", { value: "none" }, "none"));
+    for (const opt of (config.tolerationGroup || {}).options || []) {
+      tolerations.appendChild(el("option", { value: opt.groupKey },
+        opt.displayName || opt.groupKey));
+    }
+    if ((config.tolerationGroup || {}).value) {
+      tolerations.value = config.tolerationGroup.value;
+    }
+    tolerations.disabled = ro("tolerationGroup");
+
+    // environment variables: key/value rows -> body.environment
+    // (backend form.py set_environment)
+    const envRows = el("div", { class: "env-rows" });
+    function addEnvRow() {
+      const row = el("div", { class: "row env-row" },
+        el("input", { class: "env-key", placeholder: "NAME" }),
+        el("input", { class: "env-value", placeholder: "value" }),
+        el("button", { onclick: () => { row.remove(); } }, "✕"));
+      envRows.appendChild(row);
+    }
+    const addEnvBtn = ro("environment")
+      ? el("span", { class: "muted" }, "fixed by your administrator")
+      : el("button", { onclick: addEnvRow }, "+ add variable");
+
+    function collectEnvironment() {
+      const env = {};
+      for (const row of envRows.querySelectorAll(".env-row")) {
+        const k = row.querySelector(".env-key").value.trim();
+        if (k) env[k] = row.querySelector(".env-value").value;
+      }
+      return env;
+    }
 
     // configurations = PodDefault labels (admission webhook matches them)
     const podDefaultsBox = el("div", {}, el("span", { class: "muted" },
@@ -171,22 +306,38 @@
       el("label", {}, "Memory"), memory,
       el("label", {}, "TPU"), el("div", { class: "row" }, tpuGen, tpuTopo),
       el("label", {}, "Workspace size"), wsSize,
+      el("label", {}, "Data volumes"), el("div", {}, dataVols, addVolBtn),
+      el("label", {}, "Affinity"), affinity,
+      el("label", {}, "Tolerations"), tolerations,
+      el("label", {}, "Environment"), el("div", {}, envRows, addEnvBtn),
       el("label", {}, "Shared memory"), el("div", {}, shm),
       el("label", {}, "Configurations"), podDefaultsBox,
     );
 
     const submit = el("button", { class: "primary" }, "Launch");
     submit.addEventListener("click", async () => {
+      // omit any readOnly-configured key: the backend takes its value
+      // from the config and rejects the key's presence in the body
       const body = {
         name: name.value.trim(),
-        image: image.value,
-        customImage: customImage.value.trim() || undefined,
         serverType: serverType.value,
-        cpu: cpu.value, memory: memory.value,
-        shm: shm.checked,
-        configurations: [...podDefaultsBox.querySelectorAll("input:checked")]
-          .map((c) => c.dataset.label).filter(Boolean),
-        workspace: {
+      };
+      if (!ro("image")) {
+        body.image = image.value;
+        if (customImage.value.trim()) {
+          body.customImage = customImage.value.trim();
+        }
+      }
+      if (!ro("cpu")) body.cpu = cpu.value;
+      if (!ro("memory")) body.memory = memory.value;
+      if (!ro("shm")) body.shm = shm.checked;
+      if (!ro("configurations")) {
+        body.configurations =
+          [...podDefaultsBox.querySelectorAll("input:checked")]
+            .map((c) => c.dataset.label).filter(Boolean);
+      }
+      if (!ro("workspaceVolume")) {
+        body.workspace = {
           mount: "/home/jovyan",
           newPvc: {
             metadata: { name: "{notebook-name}-workspace" },
@@ -195,9 +346,23 @@
               accessModes: ["ReadWriteOnce"],
             },
           },
-        },
-      };
-      if (tpuGen.value !== "none") {
+        };
+      }
+      if (!ro("dataVolumes")) {
+        const vols = collectDataVolumes();
+        if (vols.length) body.datavols = vols;
+      }
+      if (!ro("environment")) {
+        const env = collectEnvironment();
+        if (Object.keys(env).length) body.environment = env;
+      }
+      if (!ro("affinityConfig") && affinity.value !== "none") {
+        body.affinityConfig = affinity.value;
+      }
+      if (!ro("tolerationGroup") && tolerations.value !== "none") {
+        body.tolerationGroup = tolerations.value;
+      }
+      if (!ro("tpu") && tpuGen.value !== "none") {
         body.tpu = { generation: tpuGen.value, topology: tpuTopo.value };
       }
       submit.disabled = true;

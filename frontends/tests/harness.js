@@ -1,0 +1,430 @@
+/* Frontend test harness: micro test-runner + minimal DOM stub.
+ *
+ * The apps are vanilla-DOM IIFEs (frontends/common/tpukf.js et al), so the
+ * test double is a small DOM implementation covering exactly the surface
+ * they use (element tree, classList, dataset, events, table API, dialog,
+ * location/hash routing, localStorage, cookies) — the moral equivalent of
+ * the reference's jsdom+Karma tier (reference: kubeflow-common-lib
+ * *.spec.ts, .github/workflows/jwa_frontend_tests.yaml) without a
+ * node_modules tree. Dual-mode: runs under node (CI: frontends/tests/run.js)
+ * and in a browser page (frontends/tests/browser.html) — app sources are
+ * evaluated with `new Function`, so no module system is required of them.
+ */
+(function (root, factory) {
+  if (typeof module !== "undefined" && module.exports) {
+    module.exports = factory();
+  } else {
+    root.TpuKFHarness = factory();
+  }
+})(typeof self !== "undefined" ? self : this, function () {
+  "use strict";
+
+  // ------------------------------------------------------------ DOM stub
+
+  class StubNode {
+    constructor() {
+      this.childNodes = [];
+      this.parentNode = null;
+    }
+    get children() {
+      return this.childNodes.filter((n) => n instanceof StubElement);
+    }
+    appendChild(node) {
+      if (node.parentNode) node.parentNode.removeChild(node);
+      node.parentNode = this;
+      this.childNodes.push(node);
+      return node;
+    }
+    append(...nodes) {
+      for (const n of nodes) {
+        this.appendChild(
+          n instanceof StubNode ? n : new StubText(String(n))
+        );
+      }
+    }
+    removeChild(node) {
+      const i = this.childNodes.indexOf(node);
+      if (i >= 0) { this.childNodes.splice(i, 1); node.parentNode = null; }
+      return node;
+    }
+    replaceChildren(...nodes) {
+      for (const c of [...this.childNodes]) this.removeChild(c);
+      this.append(...nodes);
+    }
+    remove() { if (this.parentNode) this.parentNode.removeChild(this); }
+    contains(node) {
+      for (let n = node; n; n = n.parentNode) if (n === this) return true;
+      return false;
+    }
+    get textContent() {
+      return this.childNodes.map((c) => c.textContent).join("");
+    }
+    set textContent(v) {
+      this.replaceChildren();
+      if (v !== "") this.appendChild(new StubText(String(v)));
+    }
+    *walk() {
+      for (const c of this.childNodes) {
+        if (c instanceof StubElement) { yield c; yield* c.walk(); }
+      }
+    }
+  }
+
+  class StubText extends StubNode {
+    constructor(text) { super(); this.data = text; }
+    get textContent() { return this.data; }
+    set textContent(v) { this.data = String(v); }
+  }
+
+  function parseStyle(str) {
+    const out = {};
+    for (const part of String(str).split(";")) {
+      const [k, ...v] = part.split(":");
+      if (k.trim()) out[k.trim()] = v.join(":").trim();
+    }
+    return out;
+  }
+
+  class StubElement extends StubNode {
+    constructor(tag, doc) {
+      super();
+      this.tagName = tag.toUpperCase();
+      this.ownerDocument = doc;
+      this.attributes = {};
+      this.dataset = {};
+      this.style = {};
+      this._listeners = {};
+      this.value = "";
+      this.checked = false;
+      this.disabled = false;
+      this.scrollTop = 0;
+      this.scrollHeight = 0;
+      this.clientHeight = 0;
+      if (tag === "dialog") {
+        this.open = false;
+        this.returnValue = "";
+      }
+    }
+    get className() { return this.attributes.class || ""; }
+    set className(v) { this.attributes.class = v; }
+    get id() { return this.attributes.id || ""; }
+    set id(v) { this.attributes.id = v; }
+    get title() { return this.attributes.title || ""; }
+    set title(v) { this.attributes.title = v; }
+    get classList() {
+      const self = this;
+      const parts = () => (self.className || "").split(/\s+/).filter(Boolean);
+      return {
+        add(...cs) {
+          const p = parts();
+          for (const c of cs) if (!p.includes(c)) p.push(c);
+          self.className = p.join(" ");
+        },
+        remove(...cs) {
+          self.className = parts().filter((c) => !cs.includes(c)).join(" ");
+        },
+        toggle(c, force) {
+          const has = parts().includes(c);
+          const want = force === undefined ? !has : !!force;
+          if (want && !has) this.add(c);
+          if (!want && has) this.remove(c);
+          return want;
+        },
+        contains(c) { return parts().includes(c); },
+      };
+    }
+    setAttribute(k, v) {
+      this.attributes[k] = String(v);
+      if (k === "value") this.value = String(v);
+      if (k === "checked") this.checked = true;
+      if (k === "disabled") this.disabled = true;
+      if (k === "style") Object.assign(this.style, parseStyle(v));
+      if (k.startsWith("data-")) {
+        const prop = k.slice(5).replace(/-([a-z])/g, (_, c) =>
+          c.toUpperCase());
+        this.dataset[prop] = String(v);
+      }
+    }
+    getAttribute(k) {
+      return k in this.attributes ? this.attributes[k] : null;
+    }
+    addEventListener(type, fn) {
+      (this._listeners[type] = this._listeners[type] || []).push(fn);
+    }
+    removeEventListener(type, fn) {
+      this._listeners[type] =
+        (this._listeners[type] || []).filter((f) => f !== fn);
+    }
+    dispatchEvent(ev) {
+      ev.target = ev.target || this;
+      for (const fn of this._listeners[ev.type] || []) fn.call(this, ev);
+      return true;
+    }
+    click() { this.dispatchEvent({ type: "click", target: this }); }
+    // ----- selector engine: tag/.class compounds, :checked, and
+    // whitespace descendant combinators ("label.chip input")
+    _matchesCompound(part) {
+      const m = /^([a-zA-Z0-9]*)((?:\.[\w-]+)*)((?::checked)?)$/.exec(
+        part.trim());
+      if (!m) return false;
+      const [, tag, classes, pseudo] = m;
+      if (tag && this.tagName !== tag.toUpperCase()) return false;
+      const cls = classes.split(".").filter(Boolean);
+      if (!cls.every((c) => this.classList.contains(c))) return false;
+      if (pseudo === ":checked" && !this.checked) return false;
+      return true;
+    }
+    matches(selector) {
+      for (const alt of selector.split(",")) {
+        const compounds = alt.trim().split(/\s+/).filter(Boolean);
+        if (!compounds.length) continue;
+        if (!this._matchesCompound(compounds[compounds.length - 1])) {
+          continue;
+        }
+        // remaining compounds must match some ancestor chain, in order
+        let i = compounds.length - 2;
+        for (let n = this.parentNode; n && i >= 0; n = n.parentNode) {
+          if (n instanceof StubElement && n._matchesCompound(compounds[i])) {
+            i--;
+          }
+        }
+        if (i < 0) return true;
+      }
+      return false;
+    }
+    querySelectorAll(selector) {
+      return [...this.walk()].filter((n) => n.matches(selector));
+    }
+    querySelector(selector) {
+      return this.querySelectorAll(selector)[0] || null;
+    }
+    // ----- table API (used by resourceTable)
+    createTHead() {
+      let head = this.children.find((c) => c.tagName === "THEAD");
+      if (!head) {
+        head = this.ownerDocument.createElement("thead");
+        this.appendChild(head);
+      }
+      return head;
+    }
+    createTBody() {
+      const body = this.ownerDocument.createElement("tbody");
+      this.appendChild(body);
+      return body;
+    }
+    insertRow() {
+      const row = this.ownerDocument.createElement("tr");
+      this.appendChild(row);
+      return row;
+    }
+    insertCell() {
+      const cell = this.ownerDocument.createElement("td");
+      this.appendChild(cell);
+      return cell;
+    }
+    // ----- dialog API (used by confirmDialog)
+    showModal() { this.open = true; }
+    close(value) {
+      this.open = false;
+      if (value !== undefined) this.returnValue = value;
+      this.dispatchEvent({ type: "close", target: this });
+    }
+  }
+
+  function makeDocument() {
+    const doc = {
+      cookie: "",
+      createElement: (tag) => new StubElement(tag, doc),
+      createTextNode: (text) => new StubText(text),
+    };
+    doc.documentElement = new StubElement("html", doc);
+    doc.body = new StubElement("body", doc);
+    doc.documentElement.appendChild(doc.body);
+    doc.getElementById = (id) => {
+      for (const n of doc.documentElement.walk()) {
+        if (n.id === id) return n;
+      }
+      return null;
+    };
+    doc.querySelectorAll = (sel) =>
+      doc.documentElement.querySelectorAll(sel);
+    doc.querySelector = (sel) => doc.documentElement.querySelector(sel);
+    return doc;
+  }
+
+  // fake timers: poller/backoff tests advance time deterministically
+  function makeTimers() {
+    let nextId = 1;
+    const queue = new Map();
+    return {
+      pending() {
+        return [...queue.values()].map((t) => t.ms).sort((a, b) => a - b);
+      },
+      setTimeout(fn, ms) {
+        queue.set(nextId, { fn, ms: ms || 0 });
+        return nextId++;
+      },
+      clearTimeout(id) { queue.delete(id); },
+      async fire() {
+        // run the earliest-scheduled callback and drain microtasks
+        const entries = [...queue.entries()].sort(
+          (a, b) => a[1].ms - b[1].ms);
+        if (!entries.length) return false;
+        const [id, t] = entries[0];
+        queue.delete(id);
+        t.fn();
+        await drain();
+        return true;
+      },
+    };
+  }
+
+  async function drain(rounds) {
+    // settle promise chains: each await hop consumes one microtask round
+    for (let i = 0; i < (rounds || 20); i++) await Promise.resolve();
+  }
+
+  // The world: globals for one app instance under test.
+  function makeWorld(opts) {
+    opts = opts || {};
+    const document = makeDocument();
+    const timers = makeTimers();
+    const storage = new Map();
+    const world = {
+      document,
+      Node: StubNode,
+      Event: class Event { constructor(type) { this.type = type; } },
+      URLSearchParams,
+      console,
+      timers,
+      opened: [],
+      setTimeout: opts.realTimers ? setTimeout : timers.setTimeout,
+      clearTimeout: opts.realTimers ? clearTimeout : timers.clearTimeout,
+      localStorage: {
+        getItem: (k) => (storage.has(k) ? storage.get(k) : null),
+        setItem: (k, v) => storage.set(k, String(v)),
+        removeItem: (k) => storage.delete(k),
+      },
+      fetch: opts.fetch || (async () => {
+        throw new Error("no fetch stub installed");
+      }),
+      open: (url) => { world.opened.push(url); },
+      addEventListener: (type, fn) => {
+        (world._listeners[type] = world._listeners[type] || []).push(fn);
+      },
+      dispatch: (type) => {
+        for (const fn of world._listeners[type] || []) fn({ type });
+      },
+      _listeners: {},
+    };
+    world.location = {
+      search: opts.search || "",
+      _hash: "",
+      get hash() { return this._hash; },
+      set hash(v) {
+        this._hash = v;
+        world.dispatch("hashchange");
+      },
+    };
+    world.window = world;
+    world.globalThis = world;
+    return world;
+  }
+
+  // Evaluate an app source file (an IIFE over browser globals) in a world.
+  function runSource(world, source, name) {
+    const keys = [
+      "window", "document", "location", "localStorage", "fetch",
+      "setTimeout", "clearTimeout", "Node", "Event", "URLSearchParams",
+      "console", "open",
+    ];
+    const fn = new Function(
+      ...keys, `"use strict";\n${source}\n//# sourceURL=${name || "app"}`
+    );
+    fn.apply(world, keys.map((k) => world[k]));
+    return world;
+  }
+
+  // JSON-responding fetch stub with a call log.
+  function makeFetch(routes) {
+    const calls = [];
+    const stub = async (path, init) => {
+      init = init || {};
+      const method = init.method || "GET";
+      calls.push({
+        method, path,
+        headers: init.headers || {},
+        body: init.body === undefined ? undefined : JSON.parse(init.body),
+      });
+      const key = `${method} ${path}`;
+      let handler = routes[key];
+      if (handler === undefined) {
+        for (const [k, v] of Object.entries(routes)) {
+          const [m, pattern] = k.split(" ");
+          if (m === method && new RegExp(`^${pattern}$`).test(path)) {
+            handler = v;
+            break;
+          }
+        }
+      }
+      if (handler === undefined) {
+        return { ok: false, status: 404, json: async () => ({
+          error: `no route for ${key}` }) };
+      }
+      const data = typeof handler === "function"
+        ? await handler({ method, path, body: init.body &&
+            JSON.parse(init.body) })
+        : handler;
+      if (data && data.__status) {
+        return { ok: false, status: data.__status,
+                 json: async () => data };
+      }
+      return { ok: true, status: 200, json: async () => data };
+    };
+    stub.calls = calls;
+    return stub;
+  }
+
+  // --------------------------------------------------------- test runner
+
+  const tests = [];
+  function test(name, fn) { tests.push({ name, fn }); }
+
+  function assert(cond, msg) {
+    if (!cond) throw new Error(msg || "assertion failed");
+  }
+  assert.equal = (got, want, msg) => {
+    if (got !== want) {
+      throw new Error(`${msg || "equal"}: got ${JSON.stringify(got)}, ` +
+        `want ${JSON.stringify(want)}`);
+    }
+  };
+  assert.deepEqual = (got, want, msg) => {
+    const g = JSON.stringify(got);
+    const w = JSON.stringify(want);
+    if (g !== w) {
+      throw new Error(`${msg || "deepEqual"}: got ${g}, want ${w}`);
+    }
+  };
+
+  async function runAll(report) {
+    let failed = 0;
+    for (const t of tests) {
+      try {
+        await t.fn();
+        report(`ok   ${t.name}`);
+      } catch (e) {
+        failed++;
+        report(`FAIL ${t.name}: ${e.message}`);
+      }
+    }
+    report(`${tests.length - failed}/${tests.length} passed`);
+    return failed;
+  }
+
+  return {
+    makeWorld, runSource, makeFetch, makeTimers, drain,
+    test, tests, assert, runAll,
+    StubNode, StubElement,
+  };
+});

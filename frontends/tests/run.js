@@ -1,0 +1,31 @@
+#!/usr/bin/env node
+/* Node entry point for the frontend unit tests (CI: unit_tests.yaml).
+ * Usage: node frontends/tests/run.js
+ */
+"use strict";
+
+const fs = require("fs");
+const path = require("path");
+
+const harness = require("./harness.js");
+
+const ROOT = path.resolve(__dirname, "..");
+const SOURCES = {
+  tpukf: fs.readFileSync(path.join(ROOT, "common", "tpukf.js"), "utf8"),
+  jupyter: fs.readFileSync(path.join(ROOT, "jupyter", "app.js"), "utf8"),
+  volumes: fs.readFileSync(path.join(ROOT, "volumes", "app.js"), "utf8"),
+  tensorboards: fs.readFileSync(
+    path.join(ROOT, "tensorboards", "app.js"), "utf8"),
+  dashboard: fs.readFileSync(
+    path.join(ROOT, "dashboard", "app.js"), "utf8"),
+};
+
+global.TpuKFHarness = harness;
+global.TpuKFSources = SOURCES;
+
+require("./test_tpukf.js");
+require("./test_jupyter_app.js");
+
+harness.runAll((line) => console.log(line)).then((failed) => {
+  process.exit(failed ? 1 : 0);
+});

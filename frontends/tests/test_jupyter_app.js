@@ -1,0 +1,309 @@
+/* Tests for frontends/jupyter/app.js: router, list rendering + actions,
+ * and the spawner form — including data volumes (new + existing-PVC),
+ * affinity/toleration groups, environment variables, TPU picker and
+ * PodDefault configurations (reference: jupyter frontend form-new
+ * sections + cypress form-page.cy.ts). */
+(function () {
+  "use strict";
+  const H = (typeof TpuKFHarness !== "undefined")
+    ? TpuKFHarness : window.TpuKFHarness;
+  const SRC = (typeof TpuKFSources !== "undefined")
+    ? TpuKFSources : window.TpuKFSources;
+  const { makeWorld, runSource, makeFetch, drain, test, assert } = H;
+
+  const CONFIG = {
+    config: {
+      image: { value: "img-b", options: ["img-a", "img-b"] },
+      cpu: { value: "0.5" },
+      memory: { value: "1Gi" },
+      tpu: { generations: [
+        { key: "v5e", uiName: "TPU v5e", topologies: ["2x2", "2x4", "4x4"] },
+        { key: "v4", uiName: "TPU v4", topologies: ["2x2x2"] },
+      ] },
+      affinityConfig: { value: "none", options: [
+        { configKey: "tpu-pool", displayName: "TPU pool" },
+      ] },
+      tolerationGroup: { value: "none", options: [
+        { groupKey: "preemptible", displayName: "Preemptible" },
+      ] },
+    },
+  };
+
+  const NB_LIST = { notebooks: [{
+    name: "nb1", serverType: "jupyter", shortImage: "img-a",
+    cpu: "0.5", memory: "1Gi", tpu: { generation: "v5e", topology: "2x4" },
+    status: { phase: "ready", message: "Running" },
+  }, {
+    name: "nb2", serverType: "jupyter", shortImage: "img-b",
+    cpu: "1", memory: "2Gi", tpu: null,
+    status: { phase: "stopped", message: "" },
+  }] };
+
+  function routes(extra) {
+    return Object.assign({
+      "GET api/config": CONFIG,
+      "GET api/namespaces/u1/notebooks": NB_LIST,
+      "GET api/namespaces/u1/poddefaults": { poddefaults: [
+        { label: "multislice-dcn", desc: "Join a multi-slice job" },
+        { label: "jax-cache", desc: "Persistent JAX compile cache" },
+      ] },
+      "GET api/namespaces/u1/pvcs": { pvcs: [
+        { name: "datasets" }, { name: "models" },
+      ] },
+    }, extra || {});
+  }
+
+  function app(fetchStub) {
+    const world = makeWorld({ fetch: fetchStub, search: "?ns=u1" });
+    const { document } = world;
+    const main = document.createElement("div");
+    main.id = "main";
+    const nsSlot = document.createElement("div");
+    nsSlot.id = "ns-slot";
+    const newBtn = document.createElement("button");
+    newBtn.id = "new-btn";
+    document.body.append(main, nsSlot, newBtn);
+    runSource(world, SRC.tpukf, "tpukf.js");
+    runSource(world, SRC.jupyter, "jupyter/app.js");
+    return world;
+  }
+
+  test("list view renders notebooks with status and TPU labels",
+    async () => {
+      const fetchStub = makeFetch(routes());
+      const world = app(fetchStub);
+      await drain();
+      const main = world.document.getElementById("main");
+      assert(main.textContent.includes("nb1"));
+      assert(main.textContent.includes("v5e 2x4"));
+      assert(main.textContent.includes("—"), "no-TPU shows a dash");
+      const stopBtns = main.querySelectorAll("button")
+        .filter((b) => b.textContent === "Stop");
+      const startBtns = main.querySelectorAll("button")
+        .filter((b) => b.textContent === "Start");
+      assert.equal(stopBtns.length, 1, "ready row offers Stop");
+      assert.equal(startBtns.length, 1, "stopped row offers Start");
+    });
+
+  test("stop button PATCHes stopped:true and resets the poller",
+    async () => {
+      const fetchStub = makeFetch(routes({
+        "PATCH api/namespaces/u1/notebooks/nb1": { ok: 1 },
+      }));
+      const world = app(fetchStub);
+      await drain();
+      const main = world.document.getElementById("main");
+      main.querySelectorAll("button")
+        .filter((b) => b.textContent === "Stop")[0].click();
+      await drain();
+      const patch = fetchStub.calls.find((c) => c.method === "PATCH");
+      assert(patch, "PATCH sent");
+      assert.deepEqual(patch.body, { stopped: true });
+    });
+
+  test("delete asks for confirmation before DELETE", async () => {
+    const fetchStub = makeFetch(routes({
+      "DELETE api/namespaces/u1/notebooks/nb1": { ok: 1 },
+    }));
+    const world = app(fetchStub);
+    await drain();
+    const main = world.document.getElementById("main");
+    main.querySelectorAll("button.danger")[0].click();
+    await drain();
+    assert(!fetchStub.calls.some((c) => c.method === "DELETE"),
+      "no DELETE before the dialog is answered");
+    const dlg = world.document.querySelectorAll("dialog")[0];
+    assert(dlg, "confirm dialog shown");
+    dlg.querySelectorAll("button.danger")[0].click();
+    await drain();
+    assert(fetchStub.calls.some((c) => c.method === "DELETE" &&
+      c.path === "api/namespaces/u1/notebooks/nb1"));
+  });
+
+  test("form submits every section: volumes, affinity, tolerations, env, " +
+       "TPU, configurations", async () => {
+    const fetchStub = makeFetch(routes({
+      "POST api/namespaces/u1/notebooks": { ok: 1 },
+    }));
+    const world = app(fetchStub);
+    await drain();
+    world.location.hash = "#/new";
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("New notebook in u1"));
+
+    // name + image
+    const inputs = main.querySelectorAll("input");
+    const name = inputs.find((i) =>
+      i.getAttribute("placeholder") === "my-notebook");
+    name.value = "test-nb";
+
+    // TPU picker: generation enables topologies
+    const selects = main.querySelectorAll("select");
+    const tpuGen = selects.find((s) =>
+      s.children.some((o) => o.value === "v5e"));
+    tpuGen.value = "v5e";
+    tpuGen.dispatchEvent(new world.Event("change"));
+    const tpuTopo = selects[selects.indexOf(tpuGen) + 1];
+    assert(!tpuTopo.disabled, "topology enabled after picking a generation");
+    assert.deepEqual(tpuTopo.children.map((o) => o.value),
+      ["2x2", "2x4", "4x4"]);
+    tpuTopo.value = "4x4";
+
+    // data volumes: one new, one existing
+    const addVol = main.querySelectorAll("button")
+      .filter((b) => b.textContent === "+ add volume")[0];
+    addVol.click();
+    addVol.click();
+    const volRows = main.querySelectorAll(".vol-row");
+    assert.equal(volRows.length, 2);
+    volRows[0].querySelector(".vol-mount").value = "/data";
+    volRows[0].querySelector(".vol-size").value = "20Gi";
+    const type1 = volRows[1].querySelector(".vol-type");
+    type1.value = "existing";
+    type1.dispatchEvent(new world.Event("change"));
+    const pick = volRows[1].querySelector(".pvc-pick");
+    assert.deepEqual(pick.children.map((o) => o.value),
+      ["datasets", "models"], "existing PVCs listed from the API");
+    pick.value = "datasets";
+    volRows[1].querySelector(".vol-mount").value = "/datasets";
+
+    // affinity + tolerations from config options
+    const affinity = main.querySelectorAll("select.affinity")[0];
+    assert.deepEqual(affinity.children.map((o) => o.value),
+      ["none", "tpu-pool"]);
+    affinity.value = "tpu-pool";
+    const tol = main.querySelectorAll("select.tolerations")[0];
+    tol.value = "preemptible";
+
+    // environment variables
+    const addEnv = main.querySelectorAll("button")
+      .filter((b) => b.textContent === "+ add variable")[0];
+    addEnv.click();
+    addEnv.click();
+    const envRows = main.querySelectorAll(".env-row");
+    envRows[0].querySelector(".env-key").value = "JAX_CACHE";
+    envRows[0].querySelector(".env-value").value = "/cache";
+    envRows[1].querySelector(".env-key").value = "  ";  // blank: dropped
+
+    // configurations (PodDefault labels)
+    const chips = main.querySelectorAll("label.chip input");
+    assert.equal(chips.length, 2, "poddefaults listed");
+    chips[0].checked = true;
+
+    main.querySelectorAll("button.primary")
+      .filter((b) => b.textContent === "Launch")[0].click();
+    await drain();
+
+    const post = fetchStub.calls.find((c) => c.method === "POST");
+    assert(post, "POST sent");
+    const body = post.body;
+    assert.equal(body.name, "test-nb");
+    assert.deepEqual(body.tpu, { generation: "v5e", topology: "4x4" });
+    assert.equal(body.affinityConfig, "tpu-pool");
+    assert.equal(body.tolerationGroup, "preemptible");
+    assert.deepEqual(body.environment, { JAX_CACHE: "/cache" });
+    assert.deepEqual(body.configurations, ["multislice-dcn"]);
+    assert.equal(body.datavols.length, 2);
+    assert.equal(body.datavols[0].mount, "/data");
+    assert.equal(
+      body.datavols[0].newPvc.spec.resources.requests.storage, "20Gi");
+    assert.deepEqual(body.datavols[1],
+      { mount: "/datasets", existingSource: "datasets" });
+    assert.equal(body.workspace.mount, "/home/jovyan");
+    assert.equal(world.location.hash, "#/", "returns to the list on success");
+  });
+
+  test("form without TPU or extras posts a minimal body", async () => {
+    const fetchStub = makeFetch(routes({
+      "POST api/namespaces/u1/notebooks": { ok: 1 },
+    }));
+    const world = app(fetchStub);
+    await drain();
+    world.location.hash = "#/new";
+    await drain();
+    const main = world.document.getElementById("main");
+    main.querySelectorAll("input")
+      .find((i) => i.getAttribute("placeholder") === "my-notebook")
+      .value = "cpu-nb";
+    main.querySelectorAll("button.primary")
+      .filter((b) => b.textContent === "Launch")[0].click();
+    await drain();
+    const body = fetchStub.calls.find((c) => c.method === "POST").body;
+    assert.equal(body.tpu, undefined);
+    assert.equal(body.affinityConfig, undefined);
+    assert.equal(body.tolerationGroup, undefined);
+    assert.equal(body.datavols, undefined, "empty sections are omitted");
+    assert.equal(body.environment, undefined);
+  });
+
+  test("readOnly config sections render disabled and stay out of the " +
+       "POST body", async () => {
+    const roConfig = JSON.parse(JSON.stringify(CONFIG));
+    roConfig.config.cpu.readOnly = true;
+    roConfig.config.dataVolumes = { value: [], readOnly: true };
+    roConfig.config.environment = { value: {}, readOnly: true };
+    roConfig.config.shm = { value: true, readOnly: true };
+    const fetchStub = makeFetch(routes({
+      "GET api/config": roConfig,
+      "POST api/namespaces/u1/notebooks": { ok: 1 },
+    }));
+    const world = app(fetchStub);
+    await drain();
+    world.location.hash = "#/new";
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("fixed by your administrator"));
+    assert.equal(main.querySelectorAll("button")
+      .filter((b) => b.textContent === "+ add volume").length, 0,
+      "readOnly data volumes offer no add button");
+    main.querySelectorAll("input")
+      .find((i) => i.getAttribute("placeholder") === "my-notebook")
+      .value = "ro-nb";
+    main.querySelectorAll("button.primary")
+      .filter((b) => b.textContent === "Launch")[0].click();
+    await drain();
+    const body = fetchStub.calls.find((c) => c.method === "POST").body;
+    // readOnly keys absent: their presence would 400 in the backend
+    assert.equal(body.cpu, undefined);
+    assert.equal(body.shm, undefined);
+    assert.equal(body.datavols, undefined);
+    assert.equal(body.environment, undefined);
+    assert.equal(body.memory, "1Gi", "writable keys still sent");
+  });
+
+  test("a failing launch keeps the form and re-enables submit",
+    async () => {
+      const fetchStub = makeFetch(routes({
+        "POST api/namespaces/u1/notebooks":
+          { __status: 400, log: "name taken" },
+      }));
+      const world = app(fetchStub);
+      await drain();
+      world.location.hash = "#/new";
+      await drain();
+      const main = world.document.getElementById("main");
+      const launch = main.querySelectorAll("button.primary")
+        .filter((b) => b.textContent === "Launch")[0];
+      launch.click();
+      await drain();
+      assert.equal(world.location.hash, "#/new", "stays on the form");
+      assert.equal(launch.disabled, false, "submit re-enabled for retry");
+      const bar = world.document.querySelectorAll(".snackbar")[0];
+      assert(bar && bar.textContent.includes("name taken"));
+    });
+
+  test("list API errors render in the card and the poller backs off",
+    async () => {
+      const fetchStub = makeFetch({
+        "GET api/namespaces/u1/notebooks":
+          { __status: 403, log: "no access" },
+      });
+      const world = app(fetchStub);
+      await drain();
+      const main = world.document.getElementById("main");
+      assert(main.textContent.includes("no access"));
+      assert.deepEqual(world.timers.pending(), [6000],
+        "3s base doubled after the failure");
+    });
+})();

@@ -1,0 +1,214 @@
+/* Unit tests for frontends/common/tpukf.js (the kubeflow-common-lib
+ * analog): poller backoff/reset/staleness, toYaml quoting, table render,
+ * namespace persistence, api() CSRF echo, status widgets. */
+(function () {
+  "use strict";
+  const H = (typeof TpuKFHarness !== "undefined")
+    ? TpuKFHarness : window.TpuKFHarness;
+  const SRC = (typeof TpuKFSources !== "undefined")
+    ? TpuKFSources : window.TpuKFSources;
+  const { makeWorld, runSource, makeFetch, drain, test, assert } = H;
+
+  function lib(opts) {
+    const world = makeWorld(opts);
+    runSource(world, SRC.tpukf, "tpukf.js");
+    return world;
+  }
+
+  test("toYaml quotes ambiguous scalars and leaves plain ones bare",
+    () => {
+      const { TpuKF } = lib();
+      assert.equal(TpuKF.toYaml("plain-value", 0), "plain-value");
+      assert.equal(TpuKF.toYaml("a/b.c:d", 0), "a/b.c:d");
+      // strings that would re-parse as bool/int/float must be quoted
+      assert.equal(TpuKF.toYaml("true", 0), '"true"');
+      assert.equal(TpuKF.toYaml("on", 0), '"on"');
+      assert.equal(TpuKF.toYaml("123", 0), '"123"');
+      assert.equal(TpuKF.toYaml("1.5e3", 0), '"1.5e3"');
+      assert.equal(TpuKF.toYaml("null", 0), '"null"');
+      // YAML 1.1 sexagesimal + hex int forms (kubectl parses 1.1)
+      assert.equal(TpuKF.toYaml("1:30", 0), '"1:30"');
+      assert.equal(TpuKF.toYaml("0x1A", 0), '"0x1A"');
+      assert.equal(TpuKF.toYaml("10:99", 0), "10:99",
+        "99 is not a valid sexagesimal digit pair: stays bare");
+      // while real booleans/numbers stay bare
+      assert.equal(TpuKF.toYaml(true, 0), "true");
+      assert.equal(TpuKF.toYaml(42, 0), "42");
+      assert.equal(TpuKF.toYaml("has spaces", 0), '"has spaces"');
+    });
+
+  test("toYaml renders nested objects and lists", () => {
+    const { TpuKF } = lib();
+    const out = TpuKF.toYaml({
+      metadata: { name: "nb", labels: { app: "x" } },
+      list: ["a", "b"],
+      empty: [],
+    }, 0);
+    assert(out.includes("metadata:\n  name: nb"), out);
+    assert(out.includes("labels:\n    app: x"), out);
+    assert(out.includes("list:\n  - a\n  - b"), out);
+    assert(out.includes("empty: []"), out);
+  });
+
+  test("poller backs off exponentially on failure and resets on success",
+    async () => {
+      const world = lib();
+      const { TpuKF } = world;
+      let fail = true;
+      let calls = 0;
+      TpuKF.poller(async () => {
+        calls++;
+        if (fail) throw new Error("boom");
+      }, 1000);
+      await drain();
+      assert.equal(calls, 1, "first tick runs immediately");
+      assert.deepEqual(world.timers.pending(), [2000],
+        "failure doubles the base delay");
+      await world.timers.fire();
+      assert.deepEqual(world.timers.pending(), [4000], "keeps doubling");
+      await world.timers.fire();
+      assert.deepEqual(world.timers.pending(), [8000]);
+      fail = false;
+      await world.timers.fire();
+      assert.deepEqual(world.timers.pending(), [1000],
+        "success resets to base");
+      assert.equal(calls, 4);
+    });
+
+  test("poller backoff is capped at 30s", async () => {
+    const world = lib();
+    world.TpuKF.poller(async () => { throw new Error("x"); }, 20000);
+    await drain();
+    assert.deepEqual(world.timers.pending(), [30000]);
+    await world.timers.fire();
+    assert.deepEqual(world.timers.pending(), [30000], "stays capped");
+  });
+
+  test("poller reset() bumps the generation: stale in-flight runs don't " +
+       "reschedule", async () => {
+    const world = lib();
+    let release;
+    const gate = new Promise((r) => { release = r; });
+    let calls = 0;
+    const p = world.TpuKF.poller(async () => { calls++; await gate; }, 1000);
+    await drain(2);
+    assert.equal(calls, 1);
+    p.reset();           // while call 1 is still in flight
+    release();
+    await drain();
+    // exactly ONE chain must be live: the reset's (call 2 ran), and the
+    // stale run must not have scheduled a competing timer
+    assert.equal(calls, 2, "reset chain ran");
+    assert.equal(world.timers.pending().length, 1,
+      "stale chain must not reschedule");
+    p.stop();
+    assert.equal(world.timers.pending().length, 0, "stop clears the timer");
+  });
+
+  test("api() echoes the CSRF cookie on mutating methods only",
+    async () => {
+      const fetchStub = makeFetch({
+        "GET api/x": { ok: 1 },
+        "POST api/x": { ok: 1 },
+      });
+      const world = lib({ fetch: fetchStub });
+      world.document.cookie = "other=1; XSRF-TOKEN=tok-123";
+      await world.TpuKF.api("GET", "api/x");
+      await world.TpuKF.api("POST", "api/x", { a: 1 });
+      assert.equal(fetchStub.calls[0].headers["X-XSRF-TOKEN"], undefined,
+        "GET must not send the token");
+      assert.equal(fetchStub.calls[1].headers["X-XSRF-TOKEN"], "tok-123");
+      assert.deepEqual(fetchStub.calls[1].body, { a: 1 });
+    });
+
+  test("api() surfaces the backend log message on error", async () => {
+    const world = lib({ fetch: makeFetch({
+      "GET api/bad": { __status: 403, log: "no access to namespace" },
+    }) });
+    let err = null;
+    try { await world.TpuKF.api("GET", "api/bad"); }
+    catch (e) { err = e; }
+    assert(err && err.message === "no access to namespace", err);
+  });
+
+  test("currentNamespace prefers ?ns= and persists it", () => {
+    const world = lib({ search: "?ns=team-a" });
+    assert.equal(world.TpuKF.currentNamespace(), "team-a");
+    assert.equal(world.localStorage.getItem("tpukf.namespace"), "team-a");
+    // a later visit without the param falls back to the stored value
+    world.location.search = "";
+    assert.equal(world.TpuKF.currentNamespace(), "team-a");
+  });
+
+  test("resourceTable renders rows, node cells and the empty state", () => {
+    const world = lib();
+    const { TpuKF } = world;
+    const cols = [
+      { title: "Name", render: (x) => x.name },
+      { title: "Status", render: (x) => TpuKF.statusIcon("ready", "ok") },
+    ];
+    const table = TpuKF.resourceTable(cols, [{ name: "a" }, { name: "b" }]);
+    const headers = table.querySelectorAll("th").map((h) => h.textContent);
+    assert.deepEqual(headers, ["Name", "Status"]);
+    const tbody = table.children.find((c) => c.tagName === "TBODY");
+    assert.equal(tbody.children.length, 2, "two data rows");
+    assert.equal(tbody.children[0].children[0].textContent, "a");
+    assert(tbody.children[0].children[1].querySelector(".status"),
+      "node-valued cells are appended, not stringified");
+    const empty = TpuKF.resourceTable(cols, [], "nothing!");
+    assert(empty.textContent.includes("nothing!"));
+  });
+
+  test("statusIcon carries phase class and tooltip", () => {
+    const { TpuKF } = lib();
+    const icon = TpuKF.statusIcon("warning", "slice incomplete");
+    assert(icon.classList.contains("status"));
+    assert(icon.classList.contains("warning"));
+    assert.equal(icon.title, "slice incomplete");
+    assert(icon.textContent.includes("warning"));
+  });
+
+  test("conditionsTable and eventsTable render their columns", () => {
+    const { TpuKF } = lib();
+    const ct = TpuKF.conditionsTable([
+      { type: "GangScheduled", status: "True", reason: "AllHostsPresent",
+        message: "4/4", lastTransitionTime: "t1" },
+    ]);
+    assert(ct.textContent.includes("GangScheduled"));
+    assert(ct.textContent.includes("AllHostsPresent"));
+    const et = TpuKF.eventsTable([
+      { type: "Warning", reason: "SliceIncomplete", message: "3/4",
+        count: 7, lastTimestamp: "t2" },
+    ]);
+    assert(et.textContent.includes("SliceIncomplete"));
+    assert(et.textContent.includes("7"));
+  });
+
+  test("snackbar reuses one element and flags errors", () => {
+    const world = lib({ realTimers: true });
+    world.TpuKF.snackbar("hello");
+    world.TpuKF.snackbar("bad thing", true);
+    const bars = world.document.querySelectorAll(".snackbar");
+    assert.equal(bars.length, 1, "one snackbar element");
+    assert.equal(bars[0].textContent, "bad thing");
+    assert(bars[0].classList.contains("error"));
+    assert(bars[0].classList.contains("show"));
+  });
+
+  test("confirmDialog resolves true on Delete, false on Cancel",
+    async () => {
+      const world = lib();
+      const p1 = world.TpuKF.confirmDialog("Delete x", "really?");
+      const dlg1 = world.document.querySelectorAll("dialog")[0];
+      assert(dlg1.open, "dialog opened");
+      assert(dlg1.textContent.includes("really?"));
+      dlg1.querySelectorAll("button.danger")[0].click();
+      assert.equal(await p1, true);
+      const p2 = world.TpuKF.confirmDialog("Delete y", "?");
+      const dlg2 = world.document.querySelectorAll("dialog")[0];
+      dlg2.querySelectorAll("button")[0].click();  // Cancel
+      assert.equal(await p2, false);
+      assert.equal(world.document.querySelectorAll("dialog").length, 0,
+        "dialogs remove themselves");
+    });
+})();

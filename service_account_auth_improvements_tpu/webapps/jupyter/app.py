@@ -34,10 +34,20 @@ def _now() -> str:
     return dt.datetime.now(dt.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
-def app_container_name(pod: dict) -> str | None:
-    """The notebook container to read logs from (first container, the
-    template's main — reference uses the notebook name as container)."""
+def app_container_name(pod: dict, notebook: str | None = None) -> str | None:
+    """The notebook container to read logs from.
+
+    Sidecar injection (Istio with holdApplicationUntilProxyStarts) can
+    reorder containers, so containers[0] is a last resort: prefer the
+    container named after the notebook (the spawner's convention and the
+    reference's — its JWA uses the notebook name as the container name),
+    then the 'notebook' default the controller stamps on bare CRs."""
     containers = (pod.get("spec") or {}).get("containers") or []
+    for want in (notebook, "notebook"):
+        if want:
+            for c in containers:
+                if c.get("name") == want:
+                    return want
     return containers[0].get("name") if containers else None
 
 
@@ -169,7 +179,7 @@ def build_app(kube, static_dir: str | None = None,
         except ValueError:
             raise HttpError(400, "tailLines must be an integer")
         logs = api.pod_logs(ns, pod_name,
-                            container=app_container_name(pod),
+                            container=app_container_name(pod, name),
                             tail_lines=tail)
         return {"logs": logs.split("\n")}
 

@@ -337,3 +337,24 @@ def test_notebook_events_route(world):
     assert out["code"] == 200
     evs = out["body"]["events"]
     assert any(e["reason"] == "SliceIncomplete" for e in evs)
+
+
+def test_app_container_name_prefers_notebook_over_sidecars():
+    """Sidecar injection can put istio-proxy first: the Logs tab must
+    still stream the notebook container (ADVICE r3: prefer the container
+    named after the notebook, then 'notebook', then containers[0])."""
+    from service_account_auth_improvements_tpu.webapps.jupyter.app import (
+        app_container_name,
+    )
+
+    pod = {"spec": {"containers": [
+        {"name": "istio-proxy"}, {"name": "my-nb"},
+    ]}}
+    assert app_container_name(pod, "my-nb") == "my-nb"
+    pod = {"spec": {"containers": [
+        {"name": "istio-proxy"}, {"name": "notebook"},
+    ]}}
+    assert app_container_name(pod, "other") == "notebook"
+    pod = {"spec": {"containers": [{"name": "main"}]}}
+    assert app_container_name(pod, "nb") == "main"
+    assert app_container_name({}, "nb") is None
