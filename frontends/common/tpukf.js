@@ -284,6 +284,134 @@
     return el("pre", { class: "object-view" }, toYaml(obj, 0));
   }
 
+  // Parser for the exact YAML subset toYaml emits (2-space block indent,
+  // JSON-quoted ambiguous scalars, [] / {} literals) — enough to
+  // round-trip a k8s object through the editor without shipping a
+  // megabyte YAML library (the reference ships Monaco for this:
+  // kubeflow-common-lib `editor` component).
+  function fromYaml(text) {
+    const lines = [];
+    for (const raw of text.split("\n")) {
+      if (raw.trim() && !raw.trim().startsWith("#")) lines.push(raw);
+    }
+    let i = 0;
+    const indentOf = (line) => /^ */.exec(line)[0].length;
+    function scalar(s) {
+      s = s.trim();
+      if (s === "null" || s === "~") return null;
+      if (s === "true") return true;
+      if (s === "false") return false;
+      if (s === "[]") return [];
+      if (s === "{}") return {};
+      if (s.startsWith('"')) return JSON.parse(s);
+      if (/^[+-]?(\d+\.?\d*|\.\d+)([eE][+-]?\d+)?$/.test(s)) {
+        return Number(s);
+      }
+      return s;
+    }
+    // a mapping key needs ": " or colon-at-EOL (YAML spec) — bare colons
+    // inside scalars ("ghcr.io/img:tag") must NOT read as keys
+    const KEY_RE = /^("(?:[^"\\]|\\.)*"|[^:]+):(\s.*)?$/;
+    function block() {
+      const t = lines[i].trim();
+      return (t === "-" || t.startsWith("- ")) ? list() : map();
+    }
+    // one "key: value" (or "key:" + nested block) into out; keyIndent is
+    // the column the key starts at (nested blocks must sit deeper)
+    function mapPair(out, text, keyIndent) {
+      const m = KEY_RE.exec(text);
+      if (!m) throw new Error(`unparseable line: ${text}`);
+      const key = m[1].startsWith('"') ? JSON.parse(m[1]) : m[1].trim();
+      const rest = (m[2] || "").trim();
+      if (rest === "") {
+        out[key] = (i < lines.length && indentOf(lines[i]) > keyIndent)
+          ? block() : null;
+      } else {
+        out[key] = scalar(rest);
+      }
+    }
+    function list() {
+      const indent = indentOf(lines[i]);
+      const out = [];
+      while (i < lines.length && indentOf(lines[i]) === indent) {
+        const t = lines[i].trim();
+        if (t !== "-" && !t.startsWith("- ")) break;
+        const rest = t.slice(1).trim();
+        i++;
+        if (rest === "") {
+          out.push(i < lines.length && indentOf(lines[i]) > indent
+            ? block() : null);
+        } else if (KEY_RE.test(rest)) {
+          // inline map item ("- key: value"): canonical k8s style; the
+          // item's keys sit at the dash + 2 column
+          const keyIndent = indent + 2;
+          const obj = {};
+          mapPair(obj, rest, keyIndent);
+          while (i < lines.length && indentOf(lines[i]) === keyIndent) {
+            const cont = lines[i].trim();
+            if (cont === "-" || cont.startsWith("- ")) break;
+            i++;
+            mapPair(obj, cont, keyIndent);
+          }
+          out.push(obj);
+        } else {
+          out.push(scalar(rest));
+        }
+      }
+      return out;
+    }
+    function map() {
+      const indent = indentOf(lines[i]);
+      const out = {};
+      while (i < lines.length && indentOf(lines[i]) === indent) {
+        const t = lines[i].trim();
+        if (t === "-" || t.startsWith("- ")) break;
+        i++;
+        mapPair(out, t, indent);
+      }
+      return out;
+    }
+    if (!lines.length) return null;
+    const value = block();
+    if (i < lines.length) {
+      throw new Error(`unparseable line: ${lines[i].trim()}`);
+    }
+    return value;
+  }
+
+  // Editable YAML pane (the reference's Monaco editor role): textarea +
+  // Save/Cancel; onSave(parsedObject) may return a promise. Parse errors
+  // surface inline and keep the buffer.
+  function yamlEditor(obj, onSave, onCancel) {
+    const area = el("textarea", { class: "yaml-editor", spellcheck: "false" });
+    area.value = toYaml(obj, 0);
+    const err = el("div", { class: "muted error-text" });
+    const save = el("button", { class: "primary" }, "Save");
+    const cancel = el("button", {}, "Cancel");
+    save.addEventListener("click", async () => {
+      let parsed;
+      try {
+        parsed = fromYaml(area.value);
+      } catch (e) {
+        err.textContent = e.message;
+        return;
+      }
+      save.disabled = true;
+      try {
+        await onSave(parsed);
+      } catch (e) {
+        err.textContent = e.message;
+        save.disabled = false;
+      }
+    });
+    cancel.addEventListener("click", () => { if (onCancel) onCancel(); });
+    return {
+      node: el("div", { class: "yaml-editor-wrap" },
+        area, err, el("div", { class: "row" }, save, cancel)),
+      area,
+    };
+  }
+
   // fetchLines: async () => string[]; returns {node, poller}
   function logsViewer(fetchLines, pollMs) {
     const pre = el("pre", { class: "logs-view" }, "loading…");
@@ -306,6 +434,7 @@
   window.TpuKF = {
     api, currentNamespace, namespaceInput, snackbar, confirmDialog,
     statusIcon, resourceTable, poller, el,
-    conditionsTable, eventsTable, objectView, logsViewer, toYaml,
+    conditionsTable, eventsTable, objectView, logsViewer,
+    toYaml, fromYaml, yamlEditor,
   };
 })();

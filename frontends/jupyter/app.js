@@ -6,8 +6,8 @@
   "use strict";
   const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
           statusIcon, resourceTable, poller, el,
-          conditionsTable, eventsTable, objectView, logsViewer } =
-    window.TpuKF;
+          conditionsTable, eventsTable, objectView, logsViewer,
+          yamlEditor } = window.TpuKF;
 
   const main = document.getElementById("main");
   let ns = currentNamespace();
@@ -140,6 +140,7 @@
     for (const g of config.tpu.generations) {
       tpuGen.appendChild(el("option", { value: g.key }, g.uiName));
     }
+    tpuGen.disabled = ro("tpu");
     const tpuTopo = el("select", { disabled: "" });
     tpuGen.addEventListener("change", () => {
       tpuTopo.replaceChildren();
@@ -479,7 +480,28 @@
       const epoch = tabEpoch;
       const data = await api("GET", `api/namespaces/${ns}/notebooks/${name}`);
       if (epoch !== tabEpoch) return;
-      pane.replaceChildren(objectView(data.notebook));
+      function readView(nb) {
+        const editBtn = el("button", {
+          class: "edit-yaml",
+          onclick: () => { editView(nb); },
+        }, "Edit");
+        pane.replaceChildren(editBtn, objectView(nb));
+      }
+      function editView(nb) {
+        // the in-UI editor (reference ships Monaco for this role): edit
+        // the CR as YAML, PUT the whole object back
+        const editor = yamlEditor(nb, async (parsed) => {
+          await api("PUT",
+            `api/namespaces/${ns}/notebooks/${name}`, parsed);
+          snackbar("Notebook updated");
+          const fresh = await api(
+            "GET", `api/namespaces/${ns}/notebooks/${name}`);
+          if (epoch !== tabEpoch) return;
+          readView(fresh.notebook);
+        }, () => { readView(nb); });
+        pane.replaceChildren(editor.node);
+      }
+      readView(data.notebook);
     }
 
     const tabs = [

@@ -293,6 +293,44 @@
       assert(bar && bar.textContent.includes("name taken"));
     });
 
+  test("details YAML tab edits the CR and PUTs the whole object",
+    async () => {
+      const nbObj = {
+        metadata: { name: "nb1", namespace: "u1" },
+        spec: { tpu: { generation: "v5e", topology: "2x4" } },
+        status: { conditions: [] },
+      };
+      const fetchStub = makeFetch(routes({
+        "GET api/namespaces/u1/notebooks/nb1": {
+          notebook: nbObj,
+          summary: { status: { phase: "ready", message: "Running" } },
+          events: [],
+        },
+        "PUT api/namespaces/u1/notebooks/nb1": { ok: 1 },
+      }));
+      const world = app(fetchStub);
+      await drain();
+      world.location.hash = "#/details/nb1";
+      await drain();
+      const main = world.document.getElementById("main");
+      const yamlBtn = main.querySelectorAll("button")
+        .filter((b) => b.textContent === "YAML")[0];
+      yamlBtn.click();
+      await drain();
+      main.querySelectorAll("button.edit-yaml")[0].click();
+      await drain();
+      const area = main.querySelectorAll("textarea.yaml-editor")[0];
+      assert(area, "editor textarea rendered");
+      area.value = area.value.replace("topology: 2x4", "topology: 4x4");
+      main.querySelectorAll("button.primary")
+        .filter((b) => b.textContent === "Save")[0].click();
+      await drain();
+      const put = fetchStub.calls.find((c) => c.method === "PUT");
+      assert(put, "PUT sent");
+      assert.equal(put.body.spec.tpu.topology, "4x4");
+      assert.equal(put.body.metadata.name, "nb1");
+    });
+
   test("list API errors render in the card and the poller backs off",
     async () => {
       const fetchStub = makeFetch({

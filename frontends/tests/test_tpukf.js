@@ -50,6 +50,89 @@
     assert(out.includes("empty: []"), out);
   });
 
+  test("fromYaml round-trips everything toYaml emits", () => {
+    const { TpuKF } = lib();
+    const obj = {
+      apiVersion: "tpukf.dev/v1beta1",
+      kind: "Notebook",
+      metadata: {
+        name: "nb", namespace: "u1",
+        labels: { "app.kubernetes.io/name": "nb", ver: "123" },
+        annotations: { note: "has spaces", flag: "true" },
+      },
+      spec: {
+        tpu: { generation: "v5e", topology: "2x4", slices: 2 },
+        template: { spec: { containers: [
+          { name: "nb", image: "ghcr.io/x:y",
+            env: [{ name: "A", value: "1" }] },
+        ], tolerations: [] } },
+      },
+      count: 4, ratio: 0.5, on: true, off: false, nothing: null,
+      emptyMap: {}, emptyList: [],
+    };
+    const round = TpuKF.fromYaml(TpuKF.toYaml(obj, 0));
+    assert.deepEqual(round, JSON.parse(JSON.stringify(obj)));
+  });
+
+  test("fromYaml parses canonical k8s inline list-item maps", () => {
+    // users type '- key: value' style in the editor even though toYaml
+    // emits the dash on its own line — both forms must parse identically
+    const { TpuKF } = lib();
+    const text = [
+      "tolerations:",
+      "  - key: tpu",
+      "    operator: Exists",
+      "  - key: spot",
+      '    value: "true"',
+      "env:",
+      "  - name: FOO",
+      "    valueFrom:",
+      "      fieldRef:",
+      "        fieldPath: metadata.name",
+      "images:",
+      "  - ghcr.io/x:y",
+    ].join("\n");
+    assert.deepEqual(TpuKF.fromYaml(text), {
+      tolerations: [
+        { key: "tpu", operator: "Exists" },
+        { key: "spot", value: "true" },
+      ],
+      env: [{ name: "FOO", valueFrom: {
+        fieldRef: { fieldPath: "metadata.name" } } }],
+      images: ["ghcr.io/x:y"],
+    }, "colon-no-space stays a scalar; colon-space opens a map");
+  });
+
+  test("fromYaml rejects garbage instead of guessing", () => {
+    const { TpuKF } = lib();
+    let err = null;
+    try { TpuKF.fromYaml("a: 1\n}{nonsense"); } catch (e) { err = e; }
+    assert(err && err.message.includes("unparseable"), err);
+    assert.equal(TpuKF.fromYaml(""), null);
+  });
+
+  test("yamlEditor saves the parsed object and surfaces parse errors",
+    async () => {
+      const world = lib();
+      const saved = [];
+      const ed = world.TpuKF.yamlEditor(
+        { metadata: { name: "nb" } }, async (o) => { saved.push(o); });
+      const area = ed.area;
+      assert(area.value.includes("name: nb"));
+      const saveBtn = ed.node.querySelectorAll("button.primary")[0];
+      area.value = "metadata:\n  }{broken";
+      saveBtn.click();
+      await drain();
+      assert.equal(saved.length, 0, "broken YAML must not save");
+      assert(ed.node.textContent.includes("unparseable"));
+      assert.equal(saveBtn.disabled, false);
+      area.value = "metadata:\n  name: nb2\nspec:\n  tpu:\n    chips: 4";
+      saveBtn.click();
+      await drain();
+      assert.deepEqual(saved[0],
+        { metadata: { name: "nb2" }, spec: { tpu: { chips: 4 } } });
+    });
+
   test("poller backs off exponentially on failure and resets on success",
     async () => {
       const world = lib();

@@ -293,6 +293,7 @@ class NotebookReconciler(Reconciler):
         ]
         self._prune_stale_statefulsets(nb, keep=set(slice_names))
         all_sts = []
+        requeue_after = 0.0
         for j, sts_name in enumerate(slice_names):
             desired_sts = self.generate_statefulset(nb, resolved, slice_id=j)
             live_sts = None
@@ -301,11 +302,20 @@ class NotebookReconciler(Reconciler):
                                          namespace=req.namespace, group="apps")
             except errors.NotFound:
                 pass
+            if live_sts is not None and live_sts["metadata"].get(
+                    "deletionTimestamp"):
+                # a real apiserver deletes asynchronously: ensure() on a
+                # still-terminating STS would "update" a corpse and lose
+                # the recreate — wait for the delete to finish
+                requeue_after = 1.0
+                continue
             if live_sts is not None:
                 # podManagementPolicy is immutable; a single-host→multi-host
                 # tpu change needs Parallel or the gated gang deadlocks
                 # (OrderedReady waits for gated pod-0 to go Ready before
-                # creating pod-1) — recreate the STS, cascading its pods
+                # creating pod-1) — recreate the STS, cascading its pods.
+                # Recreation is two reconcile passes: delete now, create
+                # once the next pass GETs NotFound (see above).
                 want_policy = desired_sts["spec"].get(
                     "podManagementPolicy", "OrderedReady"
                 )
@@ -318,9 +328,14 @@ class NotebookReconciler(Reconciler):
                         f"podManagementPolicy {have_policy} -> {want_policy} "
                         "is immutable; recreating StatefulSet",
                     )
-                    self.kube.delete("statefulsets", sts_name,
-                                     namespace=req.namespace, group="apps")
-                    live_sts = None
+                    try:
+                        self.kube.delete("statefulsets", sts_name,
+                                         namespace=req.namespace,
+                                         group="apps")
+                    except errors.NotFound:
+                        pass
+                    requeue_after = 1.0
+                    continue
             fresh = live_sts is None
             sts, _ = helpers.ensure(
                 self.kube, "statefulsets", desired_sts, group="apps",
@@ -353,7 +368,7 @@ class NotebookReconciler(Reconciler):
                 and not self._stopped(nb):
             gang_cond = self._reconcile_gang(nb, resolved)
         self.update_status(nb, all_sts, resolved, gang_cond)
-        return Result()
+        return Result(requeue_after=requeue_after)
 
     # -------------------------------------------------------------- gang
 

@@ -105,7 +105,11 @@ class EventRecorder:
             }, namespace=namespace)
         except errors.AlreadyExists:
             # lost a create race with another worker — re-read the winner's
-            # count so occurrences aren't undercounted, then fold into a bump
+            # count so occurrences aren't undercounted, then fold into a
+            # bump. Two workers can still read N concurrently and both
+            # write N+1 (get-then-patch): acceptable for events, which are
+            # best-effort counters; exactness would need a server-side
+            # increment k8s doesn't offer for event counts.
             try:
                 existing = self.kube.get("events", name, namespace=namespace)
                 count = int(existing.get("count") or 1) + 1

@@ -20,11 +20,13 @@ def _build_dashboard(kube, static_dir=None, mode=None):
         build_app,
     )
     from service_account_auth_improvements_tpu.webapps.dashboard.metrics \
-        import PrometheusMetricsService
+        import PrometheusMetricsService, metrics_service_from_env
 
-    metrics = None
+    # METRICS_BACKEND picks the driver (prometheus | stackdriver); a bare
+    # PROMETHEUS_URL keeps working as the legacy spelling
+    metrics = metrics_service_from_env()
     prom = os.environ.get("PROMETHEUS_URL")
-    if prom:
+    if metrics is None and prom:
         metrics = PrometheusMetricsService(prom)
     return build_app(kube, KfamApp(kube), metrics=metrics,
                      static_dir=static_dir, mode=mode)

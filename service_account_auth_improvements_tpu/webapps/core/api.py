@@ -99,6 +99,13 @@ class KubeApi:
                                group=kind.group or None,
                                patch_type=patch_type)
 
+    def update(self, plural: str, obj: dict,
+               namespace: str | None = None) -> dict:
+        kind = self._kind(plural)
+        self._ensure("update", kind, namespace)
+        return self.kube.update(kind.plural, obj, namespace=namespace,
+                                group=kind.group or None)
+
     # --------------------------------------------------------- shortcuts
 
     def events_for(self, namespace: str, kind: str, name: str) -> list:

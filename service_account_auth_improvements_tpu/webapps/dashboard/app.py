@@ -81,12 +81,18 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
     def all_bindings() -> list[dict]:
         with bindings_lock:
             now = time.monotonic()
-            if (bindings_cache["value"] is None
-                    or now - bindings_cache["at"] > bindings_ttl):
-                bindings_cache["value"] = kfam.list_bindings(None).get(
-                    "bindings", [])
-                bindings_cache["at"] = now
-            return bindings_cache["value"]
+            fresh = (bindings_cache["value"] is not None
+                     and now - bindings_cache["at"] <= bindings_ttl)
+            if fresh:
+                return bindings_cache["value"]
+        # fetch OUTSIDE the lock: the O(cluster) walk must not stall every
+        # concurrent request behind one slow apiserver call (a rare
+        # duplicate fetch on simultaneous expiry is the cheaper failure)
+        value = kfam.list_bindings(None).get("bindings", [])
+        with bindings_lock:
+            bindings_cache["value"] = value
+            bindings_cache["at"] = time.monotonic()
+        return value
 
     def invalidate_bindings() -> None:
         with bindings_lock:

@@ -10,6 +10,7 @@ from the shell UI.
 
 from __future__ import annotations
 
+import calendar
 import json
 import time
 import urllib.parse
@@ -30,7 +31,16 @@ QUERIES = {
 }
 
 
-class PrometheusMetricsService:
+class MetricsService:
+    """Driver interface: ``series(metric, interval) -> [{timestamp,
+    value, label}]`` (reference: centraldashboard app/metrics_service.ts:26
+    — implemented by Prometheus and Stackdriver drivers)."""
+
+    def series(self, metric: str, interval: str = "Last15m") -> list[dict]:
+        raise NotImplementedError
+
+
+class PrometheusMetricsService(MetricsService):
     """range-query driver; ``query_fn`` is injectable for tests and
     alternative backends (the reference's Stackdriver driver analog)."""
 
@@ -70,3 +80,107 @@ class PrometheusMetricsService:
                     "label": label,
                 })
         return out
+
+
+# Cloud Monitoring (Stackdriver) metric types for the same logical series
+# (reference: centraldashboard app/stackdriver_metrics_service.ts pairs
+# its MetricsService with Stackdriver queries; the TPU entries use the
+# public GKE TPU metric types).
+STACKDRIVER_METRICS = {
+    "node": "compute.googleapis.com/instance/cpu/utilization",
+    "podcpu": "kubernetes.io/container/cpu/core_usage_time",
+    "podmem": "kubernetes.io/container/memory/used_bytes",
+    "tpu": "kubernetes.io/node/accelerator/duty_cycle",
+    "tpumem": "kubernetes.io/node/accelerator/memory_used",
+}
+
+
+class CloudMonitoringMetricsService(MetricsService):
+    """Cloud Monitoring (Stackdriver) driver: same ``series`` contract as
+    the Prometheus driver, backed by the ``projects.timeSeries.list`` REST
+    API. ``list_fn(metric_type, start, end) -> timeSeries[]`` is
+    injectable for tests and for callers that already hold an
+    authenticated client; the default uses the instance metadata token
+    (GKE workload identity) with zero extra dependencies."""
+
+    def __init__(self, project: str, list_fn=None, token_fn=None):
+        self.project = project
+        self.list_fn = list_fn or self._http_list
+        self.token_fn = token_fn or self._metadata_token
+
+    @staticmethod
+    def _metadata_token() -> str:
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/instance/"
+            "service-accounts/default/token",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read()).get("access_token", "")
+
+    @staticmethod
+    def _rfc3339(ts: float) -> str:
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+    def _http_list(self, metric_type: str, start: float, end: float) -> list:
+        params = urllib.parse.urlencode({
+            "filter": f'metric.type = "{metric_type}"',
+            "interval.startTime": self._rfc3339(start),
+            "interval.endTime": self._rfc3339(end),
+            "view": "FULL",
+        })
+        url = (f"https://monitoring.googleapis.com/v3/projects/"
+               f"{self.project}/timeSeries?{params}")
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {self.token_fn()}"}
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            payload = json.loads(resp.read())
+        return payload.get("timeSeries", [])
+
+    def series(self, metric: str, interval: str = "Last15m") -> list[dict]:
+        if metric not in STACKDRIVER_METRICS:
+            raise KeyError(metric)
+        minutes = INTERVALS.get(interval, 15)
+        end = time.time()
+        start = end - minutes * 60
+        out = []
+        for ts_obj in self.list_fn(STACKDRIVER_METRICS[metric], start, end):
+            labels = dict((ts_obj.get("metric") or {}).get("labels") or {})
+            labels.update(
+                (ts_obj.get("resource") or {}).get("labels") or {}
+            )
+            label = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+            for point in ts_obj.get("points") or []:
+                raw = (point.get("value") or {})
+                value = raw.get("doubleValue")
+                if value is None:
+                    value = raw.get("int64Value", 0)
+                stamp = ((point.get("interval") or {}).get("endTime")
+                         or "1970-01-01T00:00:00Z")
+                # timegm, not mktime-minus-timezone: the stamp is UTC and
+                # mktime's DST guess would shift it an hour on DST hosts
+                out.append({
+                    "timestamp": int(calendar.timegm(time.strptime(
+                        stamp.split(".")[0].rstrip("Z"),
+                        "%Y-%m-%dT%H:%M:%S"))),
+                    "value": float(value),
+                    "label": label,
+                })
+        return out
+
+
+def metrics_service_from_env(environ=None) -> MetricsService | None:
+    """Driver selection (reference: centraldashboard picks its metrics
+    backend at boot): METRICS_BACKEND=prometheus needs PROMETHEUS_URL;
+    METRICS_BACKEND=stackdriver needs GCP_PROJECT; unset -> None (the
+    /api/metrics route answers 405)."""
+    import os
+
+    env = environ if environ is not None else os.environ
+    backend = (env.get("METRICS_BACKEND") or "").lower()
+    if backend == "prometheus" and env.get("PROMETHEUS_URL"):
+        return PrometheusMetricsService(env["PROMETHEUS_URL"])
+    if backend == "stackdriver" and env.get("GCP_PROJECT"):
+        return CloudMonitoringMetricsService(env["GCP_PROJECT"])
+    return None

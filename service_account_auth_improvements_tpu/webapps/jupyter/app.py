@@ -252,6 +252,41 @@ def build_app(kube, static_dir: str | None = None,
         api.patch("notebooks", name, patch, ns)
         return {"message": "ok"}
 
+    @app.route("PUT", "/api/namespaces/<namespace>/notebooks/<name>")
+    def put_notebook(req):
+        """Whole-object update from the YAML editor (SAR-gated 'update');
+        the reference's Monaco editor submits the same shape. The CR's
+        identity and status are server-owned: name/namespace must match
+        the URL and any submitted status is dropped."""
+        ns, name = req.params["namespace"], req.params["name"]
+        body = req.json()
+        if not isinstance(body, dict) or "metadata" not in body:
+            raise HttpError(400, "Request body must be a Notebook object")
+        meta = body.get("metadata") or {}
+        if meta.get("name", name) != name or \
+                meta.get("namespace", ns) != ns:
+            raise HttpError(
+                400, "metadata.name/namespace must match the URL"
+            )
+        api = api_for(req)
+        live = api.get("notebooks", name, ns)
+        updated = dict(body)
+        updated.pop("status", None)
+        updated["apiVersion"] = live.get("apiVersion")
+        updated["kind"] = live.get("kind")
+        meta = dict(updated.get("metadata") or {})
+        meta["name"] = name
+        meta["namespace"] = ns
+        # concurrency: honor the client's resourceVersion when provided
+        # (stale edits 409), else overwrite on the live version
+        meta.setdefault(
+            "resourceVersion", live["metadata"].get("resourceVersion")
+        )
+        meta.setdefault("uid", live["metadata"].get("uid"))
+        updated["metadata"] = meta
+        api.update("notebooks", updated, ns)
+        return {"message": f"Notebook {name} updated."}
+
     @app.route("DELETE", "/api/namespaces/<namespace>/notebooks/<name>")
     def delete_notebook(req):
         ns, name = req.params["namespace"], req.params["name"]

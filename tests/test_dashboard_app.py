@@ -203,3 +203,51 @@ def test_env_info_binding_lookup_is_cached(monkeypatch):
     assert out["code"] == 200
     assert calls["n"] == 2
     assert "team-a" in json.dumps(out["body"])
+
+
+def test_cloud_monitoring_metrics_driver():
+    """Second MetricsService driver (reference ships Prometheus AND
+    Stackdriver: app/metrics_service.ts:26): same series() contract,
+    injectable timeSeries lister."""
+    from service_account_auth_improvements_tpu.webapps.dashboard.metrics import (
+        STACKDRIVER_METRICS,
+        CloudMonitoringMetricsService,
+        metrics_service_from_env,
+        PrometheusMetricsService,
+    )
+
+    seen = {}
+
+    def fake_list(metric_type, start, end):
+        seen["type"] = metric_type
+        assert end > start
+        return [{
+            "metric": {"labels": {"accelerator_id": "tpu-0"}},
+            "resource": {"labels": {"node_name": "n1"}},
+            "points": [
+                {"interval": {"endTime": "2026-07-29T12:00:00Z"},
+                 "value": {"doubleValue": 0.93}},
+                {"interval": {"endTime": "2026-07-29T12:01:00.5Z"},
+                 "value": {"int64Value": "2"}},
+            ],
+        }]
+
+    svc = CloudMonitoringMetricsService("my-proj", list_fn=fake_list)
+    out = svc.series("tpu", "Last5m")
+    assert seen["type"] == STACKDRIVER_METRICS["tpu"]
+    assert len(out) == 2
+    assert out[0]["value"] == 0.93
+    assert out[0]["label"] == "accelerator_id=tpu-0,node_name=n1"
+    assert out[1]["value"] == 2.0
+    assert all(isinstance(p["timestamp"], int) for p in out)
+    with pytest.raises(KeyError):
+        svc.series("nope")
+
+    # env-driven driver selection
+    assert metrics_service_from_env({}) is None
+    svc2 = metrics_service_from_env(
+        {"METRICS_BACKEND": "stackdriver", "GCP_PROJECT": "p"})
+    assert isinstance(svc2, CloudMonitoringMetricsService)
+    svc3 = metrics_service_from_env(
+        {"METRICS_BACKEND": "prometheus", "PROMETHEUS_URL": "http://x"})
+    assert isinstance(svc3, PrometheusMetricsService)

@@ -166,67 +166,10 @@ def test_dashboard_js_calls_match_backend():
         )
 
 
-# ---------------------------------------------------- structural JS lint
-# No JS engine is available in the image, so catch the common breakages
-# statically: unbalanced delimiters and use of shared-lib symbols that
-# tpukf.js does not export.
-
-
-def _strip_js_literals(text):
-    """Remove string/template/comment contents so delimiter counting sees
-    only code structure."""
-    out = []
-    i, n = 0, len(text)
-    while i < n:
-        c = text[i]
-        if c in "'\"`":
-            quote = c
-            i += 1
-            while i < n and text[i] != quote:
-                i += 2 if text[i] == "\\" else 1
-                # template interpolation may contain nested code; keep it
-                if quote == "`" and text[i - 1: i + 1] == "${":
-                    depth = 1
-                    out.append("(")
-                    i += 1
-                    while i < n and depth:
-                        if text[i] == "{":
-                            depth += 1
-                        elif text[i] == "}":
-                            depth -= 1
-                        elif text[i] == "\\":
-                            i += 1
-                        i += 1
-                    out.append(")")
-            i += 1
-        elif text[i:i + 2] == "//":
-            while i < n and text[i] != "\n":
-                i += 1
-        elif text[i:i + 2] == "/*":
-            end = text.find("*/", i + 2)
-            i = n if end < 0 else end + 2
-        else:
-            out.append(c)
-            i += 1
-    return "".join(out)
-
-
-@pytest.mark.parametrize("js", sorted(
-    p.relative_to(FRONTENDS).as_posix() for p in FRONTENDS.rglob("*.js")
-))
-def test_js_delimiters_balanced(js):
-    code = _strip_js_literals((FRONTENDS / js).read_text())
-    pairs = {"(": ")", "[": "]", "{": "}"}
-    stack = []
-    for idx, ch in enumerate(code):
-        if ch in pairs:
-            stack.append((ch, idx))
-        elif ch in pairs.values():
-            assert stack and pairs[stack[-1][0]] == ch, (
-                f"{js}: unbalanced {ch!r} near stripped offset {idx}"
-            )
-            stack.pop()
-    assert not stack, f"{js}: unclosed {stack[-1][0]!r}"
+# The structural JS lint (balanced delimiters with full string/template/
+# regex-literal awareness) lives in tests/test_frontend_js.py — it
+# supersedes the earlier stripper here, which could not tokenize regex
+# literals containing quote characters.
 
 
 def test_shared_lib_exports_cover_app_usage():

@@ -358,3 +358,63 @@ def test_app_container_name_prefers_notebook_over_sidecars():
     pod = {"spec": {"containers": [{"name": "main"}]}}
     assert app_container_name(pod, "nb") == "main"
     assert app_container_name({}, "nb") is None
+
+
+def test_put_notebook_updates_whole_object(world):
+    """YAML-editor save path: PUT replaces the CR (SAR-gated 'update'),
+    identity fields are pinned to the URL and submitted status dropped."""
+    kube, app = world
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1",
+                     "labels": {"keep": "me"}},
+        "spec": {"tpu": {"generation": "v5e", "topology": "2x4"}},
+    }, group="tpukf.dev")
+
+    live = kube.get("notebooks", "nb1", namespace="u1", group="tpukf.dev")
+    edited = {
+        "metadata": {"name": "nb1", "namespace": "u1",
+                     "labels": {"keep": "me", "new": "label"}},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"}},
+        "status": {"hacked": True},
+    }
+    out = call(app, "PUT", "/api/namespaces/u1/notebooks/nb1", edited)
+    assert out["code"] == 200, out
+    nb = kube.get("notebooks", "nb1", namespace="u1", group="tpukf.dev")
+    assert nb["spec"]["tpu"]["topology"] == "4x4"
+    assert nb["metadata"]["labels"]["new"] == "label"
+    assert nb.get("status") != {"hacked": True}, "client status dropped"
+    assert nb["metadata"]["uid"] == live["metadata"]["uid"]
+
+    # identity mismatch rejected
+    bad = dict(edited, metadata={"name": "other", "namespace": "u1"})
+    out = call(app, "PUT", "/api/namespaces/u1/notebooks/nb1", bad)
+    assert out["code"] == 400
+
+    # stale resourceVersion conflicts
+    stale = dict(edited)
+    stale["metadata"] = dict(edited["metadata"],
+                             resourceVersion="1")
+    out = call(app, "PUT", "/api/namespaces/u1/notebooks/nb1", stale)
+    assert out["code"] == 409
+
+
+def test_put_notebook_requires_update_rbac(world):
+    kube, app = world
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"}, "spec": {},
+    }, group="tpukf.dev")
+    denied = []
+
+    def sar_hook(spec):
+        attrs = spec.get("resourceAttributes") or {}
+        if attrs.get("verb") == "update":
+            denied.append(attrs)
+            return False
+        return True
+
+    kube.sar_hook = sar_hook
+    out = call(app, "PUT", "/api/namespaces/u1/notebooks/nb1",
+               {"metadata": {"name": "nb1", "namespace": "u1"},
+                "spec": {}})
+    assert out["code"] == 403
+    assert denied and denied[0]["resource"] == "notebooks"
