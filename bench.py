@@ -14,6 +14,13 @@ attention fallback) so kernel regressions surface round to round
 Runs on the default JAX backend (the tunneled v5e chip under the driver);
 set SATPU_BENCH_PRESET to override the model size, SATPU_BENCH_CPU=1 to
 force the tiny CPU configuration for a smoke run.
+
+Robustness (VERDICT r4 #1): the parent process never imports jax — a wedged
+TPU runtime makes backend init HANG (not raise), which in round 4 turned the
+bench record into an unparsed traceback. The measured run happens in a child
+process (SATPU_BENCH_CHILD=1) under a hard timeout with bounded retries; if
+the backend stays unavailable the parent emits ONE structured JSON line
+({"error": "tpu_unavailable", ...}) instead of a raw traceback, rc 0.
 """
 
 from __future__ import annotations
@@ -21,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -77,7 +85,7 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
     return tok_per_sec, mfu, dt
 
 
-def main() -> None:
+def _child_main() -> None:
     if os.environ.get("SATPU_BENCH_CPU"):
         import jax
 
@@ -92,6 +100,15 @@ def main() -> None:
         "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
     )
     cfg = llama.PRESETS[preset]
+    # sweep knobs: remat policy and CE chunk size without editing presets
+    if os.environ.get("SATPU_BENCH_REMAT_POLICY"):
+        cfg = dataclasses.replace(
+            cfg, remat_policy=os.environ["SATPU_BENCH_REMAT_POLICY"]
+        )
+    if os.environ.get("SATPU_BENCH_LOSS_CHUNK"):
+        cfg = dataclasses.replace(
+            cfg, loss_chunk=int(os.environ["SATPU_BENCH_LOSS_CHUNK"])
+        )
     batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
     seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
     iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
@@ -109,6 +126,13 @@ def main() -> None:
             ("bench_400m_dense",
              dataclasses.replace(llama.PRESETS["bench_400m"],
                                  attn_impl="dense")),
+            # unchunked-CE control: isolates what loss_chunk is worth
+            ("bench_400m_nochunk",
+             dataclasses.replace(llama.PRESETS["bench_400m"],
+                                 loss_chunk=0)),
+            # switch-MoE preset: routing + dispatch/combine overhead on one
+            # chip; MFU uses active_matmul_param_count (top-1 experts)
+            ("bench_moe", llama.PRESETS["bench_moe"]),
         ]:
             try:
                 m_tok, m_mfu, m_dt = _run_config(
@@ -140,6 +164,113 @@ def main() -> None:
             }
         )
     )
+
+
+def _classify_failure(tail: str, timed_out: bool) -> str:
+    if timed_out:
+        return "tpu_timeout"
+    t = tail.lower()
+    # backend-init signatures only — a generic traceback that merely
+    # mentions "backend" is a code bug and must be recorded as one
+    if ("unavailable" in t or "failed to connect" in t
+            or "unable to initialize backend" in t):
+        return "tpu_unavailable"
+    return "bench_error"
+
+
+def main() -> int:
+    """Parent orchestrator: run the measured bench in a child under a hard
+    timeout, retry once, and always end with exactly one parseable JSON
+    line on stdout."""
+    if os.environ.get("SATPU_BENCH_CHILD"):
+        _child_main()
+        return 0
+
+    attempts = int(os.environ.get("SATPU_BENCH_ATTEMPTS", "2"))
+    timeout = float(os.environ.get("SATPU_BENCH_TIMEOUT_S", "1500"))
+    env = dict(os.environ, SATPU_BENCH_CHILD="1")
+    if env.get("SATPU_BENCH_CPU"):
+        # keep the probe off the accelerator too (the child pins cpu via
+        # jax.config). Site customizations may register accelerator PJRT
+        # plugins keyed off env knobs that beat JAX_PLATFORMS — scrub them,
+        # same as __graft_entry__._reexec_dryrun_on_virtual_cpu.
+        env["JAX_PLATFORMS"] = "cpu"
+        for knob in ("JAX_PLATFORM_NAME", "PALLAS_AXON_POOL_IPS", "TPU_NAME"):
+            env.pop(knob, None)
+    here = os.path.dirname(os.path.abspath(__file__))
+
+    # Fast probe: backend init on a wedged TPU runtime *hangs*, so committing
+    # straight to the full-bench timeout would burn attempts×25min. A tiny
+    # child that only touches jax.default_backend() bounds that to ~2min.
+    probe_timeout = float(os.environ.get("SATPU_BENCH_PROBE_TIMEOUT_S", "120"))
+    probe_tail, probe_timed_out = "", False
+    for attempt in range(attempts):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.default_backend())"],
+                env=env, cwd=here, capture_output=True, text=True,
+                timeout=probe_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            probe_timed_out = True
+            probe_tail = "backend init did not return within probe timeout"
+        else:
+            probe_timed_out = False
+            if probe.returncode == 0:
+                break
+            probe_tail = (probe.stderr or probe.stdout)[-2000:]
+        if attempt < attempts - 1:
+            time.sleep(float(os.environ.get("SATPU_BENCH_RETRY_S", "20")))
+    else:
+        print(json.dumps({
+            "metric": "llama_train_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/s/chip",
+            "vs_baseline": 0.0,
+            "error": _classify_failure(probe_tail, probe_timed_out),
+            "detail": probe_tail[-600:],
+            "attempts": attempts,
+            "stage": "backend_probe",
+        }))
+        return 0
+
+    tail, timed_out = "", False
+    for attempt in range(attempts):
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, cwd=here, capture_output=True, text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as e:
+            timed_out = True
+            tail = ((e.stderr or b"").decode("utf-8", "replace")
+                    if isinstance(e.stderr, bytes) else (e.stderr or ""))[-2000:]
+        else:
+            timed_out = False
+            if proc.returncode == 0:
+                # relay the child's final JSON line verbatim
+                lines = [l for l in proc.stdout.splitlines() if l.strip()]
+                if lines and lines[-1].lstrip().startswith("{"):
+                    print(lines[-1])
+                    return 0
+                tail = (proc.stdout + proc.stderr)[-2000:]
+            else:
+                tail = (proc.stderr or proc.stdout)[-2000:]
+        if attempt < attempts - 1:
+            time.sleep(float(os.environ.get("SATPU_BENCH_RETRY_S", "20")))
+
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/s/chip",
+        "vs_baseline": 0.0,
+        "error": _classify_failure(tail, timed_out),
+        "detail": tail[-600:],
+        "attempts": attempts,
+    }))
+    return 0
 
 
 if __name__ == "__main__":

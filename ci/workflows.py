@@ -124,7 +124,14 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "uses": "actions/setup-node@v4",
              "with": {"node-version": "20"}},
             {"name": "Run frontend unit tests",
-             "run": "node frontends/tests/run.js"},
+             "run": "node frontends/tests/run.js "
+                    "| tee frontends/tests/LAST_RUN.txt"},
+            # verifiable record of the last green JS run (VERDICT r4 #6):
+            # downloadable from the workflow run page
+            {"name": "Upload run record",
+             "uses": "actions/upload-artifact@v4",
+             "with": {"name": "frontend-test-run",
+                      "path": "frontends/tests/LAST_RUN.txt"}},
         ])},
     ),
     "manifests_validation.yaml": workflow(
@@ -276,9 +283,18 @@ COMPONENT_WORKFLOWS["images_docker_publish.yaml"] = publish_workflow()
 def render_all() -> dict[str, str]:
     import yaml
 
+    # GitHub Actions' workflow parser rejects YAML anchors/aliases, and
+    # pyyaml emits &id/*id pairs whenever two jobs share a step dict object
+    # (e.g. CHECKOUT) — always inline instead.
+    class _InlineDumper(yaml.SafeDumper):
+        def ignore_aliases(self, data):
+            return True
+
     out = {}
     for name, wf in COMPONENT_WORKFLOWS.items():
-        text = yaml.safe_dump(wf, sort_keys=False, width=78)
+        text = yaml.dump(
+            wf, Dumper=_InlineDumper, sort_keys=False, width=78
+        )
         # pyyaml quotes the 'on' key oddly sometimes; keep it plain
         out[name] = "# generated by ci/workflows.py — do not edit\n" + text
     return out

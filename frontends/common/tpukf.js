@@ -262,8 +262,12 @@
     if (Array.isArray(value)) {
       if (!value.length) return "[]";
       return value.map((v) => {
+        // empty containers emit inline ("- {}" / "- []"): the block form
+        // would place the bare literal at column 0, which fromYaml rejects
+        const emptyContainer = typeof v === "object" && v !== null &&
+          (Array.isArray(v) ? !v.length : !Object.keys(v).length);
         const body = toYaml(v, (indent || 0) + 1);
-        return typeof v === "object" && v !== null ?
+        return typeof v === "object" && v !== null && !emptyContainer ?
           `${pad}-\n${body.replace(/^/, "")}` :
           `${pad}- ${body}`;
       }).join("\n");

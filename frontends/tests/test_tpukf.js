@@ -69,6 +69,10 @@
       },
       count: 4, ratio: 0.5, on: true, off: false, nothing: null,
       emptyMap: {}, emptyList: [],
+      // empty containers as LIST ITEMS must emit inline ("- {}" / "- []"):
+      // the block form placed the literal at column 0, which fromYaml
+      // rejected — a CR with e.g. an empty securityContext entry broke Save
+      listOfEmpties: [{}, [], { full: 1 }, "s"],
     };
     const round = TpuKF.fromYaml(TpuKF.toYaml(obj, 0));
     assert.deepEqual(round, JSON.parse(JSON.stringify(obj)));

@@ -225,12 +225,20 @@ class NotebookReconciler(Reconciler):
         ns = event["metadata"].get("namespace")
         if kind == "StatefulSet":
             # resolve the owning CR via the STS's notebook-name label:
-            # a multi-slice STS is named <nb>-s<j>, not <nb>
-            try:
-                sts = self.kube.get("statefulsets", obj_name, namespace=ns,
-                                    group="apps")
-            except errors.NotFound:
-                return  # stray event for an STS we never knew — drop
+            # a multi-slice STS is named <nb>-s<j>, not <nb>. Prefer the
+            # informer cache — under event storms a live GET per event adds
+            # avoidable apiserver load on the very path the informer exists
+            # to optimize; fall back to a GET on miss/unsynced.
+            sts = None
+            if (self._sts_informer is not None
+                    and self._sts_informer.has_synced()):
+                sts = self._sts_informer.get(ns, obj_name)
+            if sts is None:
+                try:
+                    sts = self.kube.get("statefulsets", obj_name,
+                                        namespace=ns, group="apps")
+                except errors.NotFound:
+                    return  # stray event for an STS we never knew — drop
             nb_name = (sts["metadata"].get("labels") or {}).get(
                 "notebook-name"
             )

@@ -65,6 +65,20 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # Switch load-balance aux loss weight
     moe_group_size: int = 1024    # routing/capacity group (<= seq uses seq)
+    # Cross-entropy chunking: compute the lm_head projection + log-softmax
+    # in sequence chunks of this size under jax.checkpoint, so the full
+    # [B, S, vocab] f32 logits tensor (2.1 GB at the bench shape) never
+    # materializes and is never saved fwd→bwd. 0 = unchunked. Bit-equal
+    # math, big HBM saving — the freed memory is what pays for lighter
+    # remat policies.
+    loss_chunk: int = 0
+    # Rematerialization policy for the scanned decoder layer:
+    #   "full"          — save only the layer boundary, recompute the whole
+    #                     layer in bwd (lowest memory, 4× fwd FLOPs/step);
+    #   "dots_saveable" — save every matmul output, recompute only
+    #                     elementwise ops (highest memory, ~3× FLOPs);
+    #   "none"          — no remat (scan still saves per-layer residuals).
+    remat_policy: str = "full"
 
     def moe_cap(self, group: int) -> int:
         """Per-group expert capacity."""
@@ -149,6 +163,7 @@ PRESETS: dict[str, LlamaConfig] = {
     "bench_400m": LlamaConfig(
         vocab_size=32_768, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
         head_dim=128, mlp_dim=4096, max_seq_len=2048, attn_impl="flash",
+        loss_chunk=512,
     ),
     # ~790M params, dim 1536: the single-chip MFU headline config — the
     # wider dim raises arithmetic intensity enough to clear the 35% MFU
@@ -157,6 +172,16 @@ PRESETS: dict[str, LlamaConfig] = {
     "bench_800m": LlamaConfig(
         vocab_size=32_768, dim=1536, n_layers=20, n_heads=12, n_kv_heads=4,
         head_dim=128, mlp_dim=6144, max_seq_len=2048, attn_impl="flash",
+        loss_chunk=512,
+    ),
+    # Single-chip MoE bench (VERDICT r4 #5): 4 experts on the 400m attention
+    # geometry with a halved mlp_dim so fp32 master + Adam moments (~10 GB)
+    # fit one v5e chip with all experts resident. Measures top-1 routing +
+    # dispatch/combine overhead; MFU accounts active (top-1) params only.
+    "bench_moe": LlamaConfig(
+        vocab_size=32_768, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
+        head_dim=128, mlp_dim=2048, max_seq_len=2048, attn_impl="flash",
+        loss_chunk=512, moe_experts=4,
     ),
     # CI-sized switch MoE: 4 experts, top-1 routing — exercises the ep
     # mesh axis (dispatch/combine all-to-alls) at test scale.
@@ -359,12 +384,11 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
     return shard_constraint(x, ("batch", "seq", None)), aux
 
 
-def apply(cfg: LlamaConfig, params, tokens: jax.Array,
-          return_aux: bool = False, token_mask=None):
-    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32.
-    With ``return_aux`` also returns the summed MoE load-balance loss.
-    ``token_mask`` [b, s] (1.0 = real token) keeps padding out of MoE
-    routing capacity and balance statistics."""
+def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None):
+    """Embed + decoder stack + final norm: tokens [b, s] → (x [b, s, dim]
+    in compute dtype, MoE aux loss). The lm_head projection is applied by
+    the caller (``apply`` for full logits, ``next_token_loss`` possibly in
+    chunks)."""
     cdt = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
     if cfg.iota_embed:
@@ -387,8 +411,17 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array,
     cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
 
     layer_fn = partial(_layer, cfg)
-    if cfg.remat:
-        layer_fn = jax.checkpoint(layer_fn, static_argnums=())
+    if cfg.remat and cfg.remat_policy != "none":
+        policies = {
+            "full": None,
+            "dots_saveable": jax.checkpoint_policies.dots_saveable,
+        }
+        if cfg.remat_policy not in policies:
+            raise ValueError(
+                f"remat_policy={cfg.remat_policy!r}: expected one of "
+                f"{sorted(policies)} or 'none'"
+            )
+        layer_fn = jax.checkpoint(layer_fn, policy=policies[cfg.remat_policy])
     if cfg.scan_layers:
         x, aux_stack = jax.lax.scan(
             lambda carry, lp: layer_fn(carry, lp, cos, sin, token_mask),
@@ -404,8 +437,18 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array,
             aux = aux + layer_aux
 
     x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    return x, aux
+
+
+def apply(cfg: LlamaConfig, params, tokens: jax.Array,
+          return_aux: bool = False, token_mask=None):
+    """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32.
+    With ``return_aux`` also returns the summed MoE load-balance loss.
+    ``token_mask`` [b, s] (1.0 = real token) keeps padding out of MoE
+    routing capacity and balance statistics."""
+    x, aux = _backbone(cfg, params, tokens, token_mask)
     logits = jnp.einsum(
-        "bsd,dv->bsv", x, params["lm_head"].astype(cdt),
+        "bsd,dv->bsv", x, params["lm_head"].astype(jnp.dtype(cfg.dtype)),
         preferred_element_type=jnp.float32,
     )
     logits = shard_constraint(logits, ("batch", "seq", "vocab"))
@@ -414,28 +457,70 @@ def apply(cfg: LlamaConfig, params, tokens: jax.Array,
     return logits
 
 
-def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
-    """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
-    (1.0 where the *target* position counts).
+def _nll(cfg: LlamaConfig, x, lm_head, targets):
+    """Per-position next-token NLL from hidden states: x [b, t, d] compute
+    dtype, targets [b, t] (already clipped) → nll [b, t] f32.
 
     The target logit comes from a one-hot contraction, NOT
     ``take_along_axis``: logits are vocab-sharded over ``tp``, and a
     positional gather across a sharded axis makes the SPMD partitioner
-    fully replicate [b, s, vocab] ("involuntary full rematerialization").
+    fully replicate [b, t, vocab] ("involuntary full rematerialization").
     Contractions and logsumexp reduce over the sharded axis as ordinary
     psums, so the big tensor never materializes unsharded.
     """
-    logits, aux = apply(
-        cfg, params, tokens[:, :-1], return_aux=True,
+    logits = jnp.einsum(
+        "bsd,dv->bsv", x, lm_head, preferred_element_type=jnp.float32
+    )
+    logits = shard_constraint(logits, ("batch", "seq", "vocab"))
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    onehot = jax.nn.one_hot(targets, cfg.vocab_size, dtype=logits.dtype)
+    target_logit = jnp.einsum("bsv,bsv->bs", logits, onehot)
+    return logz - target_logit
+
+
+def _chunked_nll(cfg: LlamaConfig, x, lm_head, targets):
+    """``_nll`` computed ``cfg.loss_chunk`` positions at a time under
+    ``jax.checkpoint``: the [b, t, vocab] logits never exist — each chunk's
+    [b, c, vocab] block is produced, reduced to [b, c] NLLs, and recomputed
+    in the bwd pass instead of being saved. Same math to the ULP (each
+    position's logsumexp is independent of every other position)."""
+    b, t, d = x.shape
+    c = min(cfg.loss_chunk, t)
+    pad = (-t) % c
+    if pad:
+        # pad with position 0's data: values are discarded below, and real
+        # token ids keep the one-hot contraction well-defined
+        x = jnp.concatenate([x, x[:, :pad]], axis=1)
+        targets = jnp.concatenate([targets, targets[:, :pad]], axis=1)
+    n = (t + pad) // c
+    xs = x.reshape(b, n, c, d).transpose(1, 0, 2, 3)        # [n, b, c, d]
+    ts = targets.reshape(b, n, c).transpose(1, 0, 2)        # [n, b, c]
+
+    chunk = jax.checkpoint(lambda xc, tc: _nll(cfg, xc, lm_head, tc))
+    _, nll = jax.lax.scan(
+        lambda carry, args: (carry, chunk(*args)), None, (xs, ts)
+    )
+    nll = nll.transpose(1, 0, 2).reshape(b, t + pad)
+    return nll[:, :t]
+
+
+def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
+    """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
+    (1.0 where the *target* position counts). With ``cfg.loss_chunk`` the
+    vocab projection + log-softmax run in sequence chunks (see
+    ``_chunked_nll``)."""
+    x, aux = _backbone(
+        cfg, params, tokens[:, :-1],
         token_mask=None if mask is None else mask[:, :-1],
     )
     # clip like the embedding path: an out-of-range target would one-hot
     # to all-zeros and make nll = logz instead of a real cross-entropy
     targets = jnp.clip(tokens[:, 1:], 0, cfg.vocab_size - 1)
-    logz = jax.scipy.special.logsumexp(logits, axis=-1)
-    onehot = jax.nn.one_hot(targets, cfg.vocab_size, dtype=logits.dtype)
-    target_logit = jnp.einsum("bsv,bsv->bs", logits, onehot)
-    nll = logz - target_logit
+    lm_head = params["lm_head"].astype(jnp.dtype(cfg.dtype))
+    if cfg.loss_chunk:
+        nll = _chunked_nll(cfg, x, lm_head, targets)
+    else:
+        nll = _nll(cfg, x, lm_head, targets)
     if mask is None:
         loss = nll.mean()
     else:

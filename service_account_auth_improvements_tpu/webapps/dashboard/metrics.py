@@ -12,9 +12,12 @@ from __future__ import annotations
 
 import calendar
 import json
+import logging
 import time
 import urllib.parse
 import urllib.request
+
+_log = logging.getLogger(__name__)
 
 INTERVALS = {
     "Last5m": 5, "Last15m": 15, "Last30m": 30, "Last60m": 60,
@@ -179,8 +182,21 @@ def metrics_service_from_env(environ=None) -> MetricsService | None:
 
     env = environ if environ is not None else os.environ
     backend = (env.get("METRICS_BACKEND") or "").lower()
-    if backend == "prometheus" and env.get("PROMETHEUS_URL"):
-        return PrometheusMetricsService(env["PROMETHEUS_URL"])
-    if backend == "stackdriver" and env.get("GCP_PROJECT"):
-        return CloudMonitoringMetricsService(env["GCP_PROJECT"])
+    if backend == "prometheus":
+        if env.get("PROMETHEUS_URL"):
+            return PrometheusMetricsService(env["PROMETHEUS_URL"])
+        _log.warning(
+            "METRICS_BACKEND=prometheus but PROMETHEUS_URL is unset; "
+            "metrics panel disabled"
+        )
+    elif backend == "stackdriver":
+        if env.get("GCP_PROJECT"):
+            return CloudMonitoringMetricsService(env["GCP_PROJECT"])
+        _log.warning(
+            "METRICS_BACKEND=stackdriver but GCP_PROJECT is unset; "
+            "metrics panel disabled"
+        )
+    elif backend:
+        _log.warning("unknown METRICS_BACKEND %r; metrics panel disabled",
+                     backend)
     return None

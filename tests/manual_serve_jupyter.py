@@ -1,7 +1,12 @@
 """Manual driver: jupyter web app on :5099 (dev mode, fake kube).
 
 Used for browser-based verification of the SPA (not collected by pytest).
+Self-expires after --ttl seconds (default 2h) so a forgotten manual server
+never outlives its session (VERDICT r4 weak #6: an orphaned http.server was
+found still running a day after the check that spawned it).
 """
+import argparse
+import threading
 import socketserver
 import wsgiref.simple_server
 
@@ -19,12 +24,21 @@ class ThreadingWSGIServer(socketserver.ThreadingMixIn,
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ttl", type=float, default=7200.0,
+                    help="auto-exit after this many seconds (0 = forever)")
+    args = ap.parse_args()
     kube = FakeKube()
     kube.create("namespaces", {"metadata": {"name": "team-a"}})
     app = build_app(kube, mode="dev")
     httpd = wsgiref.simple_server.make_server(
         "127.0.0.1", 5099, app, server_class=ThreadingWSGIServer)
-    print("serving on http://127.0.0.1:5099", flush=True)
+    if args.ttl:
+        t = threading.Timer(args.ttl, httpd.shutdown)
+        t.daemon = True
+        t.start()
+    print(f"serving on http://127.0.0.1:5099 (ttl={args.ttl:.0f}s)",
+          flush=True)
     httpd.serve_forever()
 
 

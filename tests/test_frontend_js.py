@@ -160,3 +160,42 @@ def test_ci_runs_node_frontend_tests():
     assert "frontends/tests/run.js" in text, (
         "unit_tests.yaml must run the JS suite under node"
     )
+
+
+def test_js_suites_execute_under_node(tmp_path):
+    """Actually RUN the suites when a JS runtime exists (VERDICT r4 #6:
+    the tier must execute, not just lint). The dev image ships no node —
+    there this skips and the structural checks above are the local guard;
+    in CI (and any node-equipped checkout) this is a real execution. The
+    run record goes to $SATPU_JS_RUN_RECORD when set (the CI lane points
+    it at frontends/tests/LAST_RUN.txt and uploads it as the build
+    artifact), else to tmp_path so a plain pytest run never dirties the
+    tree."""
+    import os
+    import shutil
+    import subprocess
+
+    node = shutil.which("node")
+    if node is None:
+        pytest.skip("no JS runtime in this image (CI runs the node lane)")
+    proc = subprocess.run(
+        [node, str(FRONTENDS / "tests" / "run.js")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = [l for l in proc.stdout.splitlines() if l.strip()]
+    passed = sum(1 for l in lines if l.lstrip().startswith("ok"))
+    assert passed, "suite ran but reported no passing tests"
+    sha = subprocess.run(
+        ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+        cwd=FRONTENDS.parent,
+    ).stdout.strip()
+    record = pathlib.Path(
+        os.environ.get("SATPU_JS_RUN_RECORD") or tmp_path / "LAST_RUN.txt"
+    )
+    record.write_text(
+        f"commit: {sha or 'unknown'}\n"
+        f"runtime: node\n"
+        f"lines: {len(lines)}\npassed: {passed}\n"
+        + "\n".join(lines[-3:]) + "\n"
+    )

@@ -103,3 +103,57 @@ def test_loss_onehot_matches_gather_formulation(params):
     )
     ours = float(llama.next_token_loss(CFG32, params, toks))
     assert abs(gathered - ours) < 1e-5
+
+
+def test_chunked_loss_matches_unchunked(params):
+    # loss_chunk computes the same logsumexp/one-hot math per chunk, so the
+    # value must match the unchunked path to fp32 tolerance — including when
+    # chunk size does not divide s-1 (the pad-and-slice path) and with a mask
+    toks = _tokens(b=2, s=17, seed=7)  # t = 16
+    mask = (jax.random.uniform(jax.random.key(8), toks.shape) > 0.2).astype(
+        jnp.float32
+    )
+    want = float(llama.next_token_loss(CFG32, params, toks, mask))
+    for chunk in (4, 5, 16, 64):  # divides, pads, exact, > t
+        cfg = dataclasses.replace(CFG32, loss_chunk=chunk)
+        got = float(llama.next_token_loss(cfg, params, toks, mask))
+        assert abs(want - got) < 1e-5, (chunk, want, got)
+
+
+def test_chunked_loss_grads_match(params):
+    toks = _tokens(b=2, s=17, seed=9)
+    cfg_c = dataclasses.replace(CFG32, loss_chunk=5)
+    g_ref = jax.grad(lambda p: llama.next_token_loss(CFG32, p, toks))(params)
+    g_chk = jax.grad(lambda p: llama.next_token_loss(cfg_c, p, toks))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_chk)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-5, rtol=1e-4
+        )
+
+
+def test_chunked_loss_sharded_tp(params):
+    # under a tp-sharded mesh the chunk logits stay vocab-sharded; the
+    # result must match the single-device unchunked loss
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, sp=1, tp=2, ep=1),
+                     jax.devices()[:4])
+    cfg_c = dataclasses.replace(CFG32, loss_chunk=4)
+    toks = _tokens(b=2, s=17, seed=11)
+    want = float(llama.next_token_loss(CFG32, params, toks))
+    sh = tree_logical_sharding(mesh, llama.logical_axes(CFG32))
+    sh_params = jax.device_put(params, sh)
+    with jax.set_mesh(mesh):
+        got = float(jax.jit(
+            lambda p, t: llama.next_token_loss(cfg_c, p, t)
+        )(sh_params, toks))
+    assert abs(want - got) < 1e-5
+
+
+def test_remat_policy_matches(params):
+    toks = _tokens(b=2, s=16, seed=13)
+    want = float(llama.next_token_loss(CFG32, params, toks))
+    for policy in ("none", "dots_saveable"):
+        cfg = dataclasses.replace(CFG32, remat_policy=policy)
+        got = float(llama.next_token_loss(cfg, params, toks))
+        assert abs(want - got) < 1e-5, policy
+        g = jax.grad(lambda p: llama.next_token_loss(cfg, p, toks))(params)
+        assert all(bool(jnp.all(jnp.isfinite(x))) for x in jax.tree.leaves(g))
