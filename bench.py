@@ -51,12 +51,19 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
         state_shardings,
     )
 
+    from service_account_auth_improvements_tpu.train.step import (
+        make_optimizer,
+    )
+
     mesh = make_mesh(
         MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1]
     )
-    state = init_train_state(cfg, jax.random.key(0))
+    opt = make_optimizer(
+        mu_dtype=os.environ.get("SATPU_BENCH_MU_DTYPE") or None
+    )
+    state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
-    step = make_train_step(cfg, mesh=mesh)
+    step = make_train_step(cfg, optimizer=opt, mesh=mesh)
 
     tokens = jax.random.randint(
         jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
@@ -85,6 +92,82 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
     return tok_per_sec, mfu, dt
 
 
+def _breakdown(cfg, batch: int, seq: int):
+    """Where does the step time go? Times fwd-only, fwd+bwd, and the full
+    step (loss+grads+adamw) at the bench shape so the optimizer and remat
+    shares are visible round to round (VERDICT r4 #2: attack the gap with
+    evidence). Returns a dict of seconds."""
+    import jax
+    import jax.numpy as jnp
+
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.models import llama
+    from service_account_auth_improvements_tpu.train import (
+        init_train_state,
+        make_train_step,
+    )
+    from service_account_auth_improvements_tpu.train.step import (
+        make_optimizer,
+        state_shardings,
+    )
+
+    mesh = make_mesh(
+        MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1]
+    )
+    # same optimizer as _run_config: the breakdown must describe the
+    # configuration the headline number measured
+    opt = make_optimizer(
+        mu_dtype=os.environ.get("SATPU_BENCH_MU_DTYPE") or None
+    )
+    state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
+    )
+    mask = jnp.ones_like(tokens)
+
+    fwd = jax.jit(lambda p, t: llama.apply(cfg, p, t))
+    loss_grad = jax.jit(jax.value_and_grad(
+        lambda p, t, m: llama.next_token_loss(cfg, p, t, m)
+    ))
+    step = make_train_step(cfg, optimizer=opt, mesh=mesh)
+
+    def timed(fn, *args, iters=3, fetch):
+        with jax.set_mesh(mesh):
+            out = fn(*args)
+            float(fetch(out))  # compile + sync (device->host can't be early)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = fn(*args)
+            float(fetch(out))
+            return (time.perf_counter() - t0) / iters
+
+    res = {}
+    res["fwd_s"] = timed(fwd, state.params, tokens,
+                         fetch=lambda o: o[0, 0, 0])
+    res["fwd_bwd_s"] = timed(loss_grad, state.params, tokens, mask,
+                             fetch=lambda o: o[0])
+    # full step donates state; rebuild it fresh so the timing loop can
+    # keep reusing the returned state instead
+    state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    with jax.set_mesh(mesh):
+        state, m = step(state, tokens, mask)
+        float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(3):
+            state, m = step(state, tokens, mask)
+        float(m["loss"])
+        res["step_s"] = (time.perf_counter() - t0) / 3
+    res["bwd_share"] = round(
+        (res["fwd_bwd_s"] - res["fwd_s"]) / res["fwd_bwd_s"], 3)
+    res["optimizer_s"] = round(res["step_s"] - res["fwd_bwd_s"], 4)
+    return {k: round(v, 4) for k, v in res.items()}
+
+
 def _child_main() -> None:
     if os.environ.get("SATPU_BENCH_CPU"):
         import jax
@@ -100,7 +183,8 @@ def _child_main() -> None:
         "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
     )
     cfg = llama.PRESETS[preset]
-    # sweep knobs: remat policy and CE chunk size without editing presets
+    # sweep knobs: remat policy, CE chunk, and master-param dtype without
+    # editing presets (the perf search space of VERDICT r4 #2)
     if os.environ.get("SATPU_BENCH_REMAT_POLICY"):
         cfg = dataclasses.replace(
             cfg, remat_policy=os.environ["SATPU_BENCH_REMAT_POLICY"]
@@ -109,11 +193,22 @@ def _child_main() -> None:
         cfg = dataclasses.replace(
             cfg, loss_chunk=int(os.environ["SATPU_BENCH_LOSS_CHUNK"])
         )
+    if os.environ.get("SATPU_BENCH_PARAM_DTYPE"):
+        cfg = dataclasses.replace(
+            cfg, param_dtype=os.environ["SATPU_BENCH_PARAM_DTYPE"]
+        )
     batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
     seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
     iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
 
     tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters)
+
+    breakdown = None
+    if os.environ.get("SATPU_BENCH_BREAKDOWN"):
+        try:
+            breakdown = _breakdown(cfg, batch, seq)
+        except Exception as e:  # pragma: no cover - diagnostics must not
+            breakdown = {"error": str(e)[:200]}  # sink the headline number
 
     matrix = []
     want_matrix = (
@@ -161,6 +256,7 @@ def _child_main() -> None:
                 "backend": jax.default_backend(),
                 "device": getattr(jax.devices()[0], "device_kind", "?"),
                 "matrix": matrix,
+                **({"breakdown": breakdown} if breakdown else {}),
             }
         )
     )

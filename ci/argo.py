@@ -1,0 +1,202 @@
+"""Legacy Prow/Argo CI tier: Argo Workflow builders + trigger config.
+
+The reference's older CI ran per-component e2e Workflows on an Argo
+cluster, triggered by Prow according to ``prow_config.yaml``
+(reference: py/kubeflow/kubeflow/ci/workflow_utils.py ArgoTestBuilder,
+prow_config.yaml:1-40; one ``<component>_tests.py::create_workflow`` per
+component). GitHub Actions (ci/workflows.py) superseded it upstream and
+here, but the surface is kept for parity: some deployments still drive
+test fleets through Argo, and the DAG shape (artifacts dir → checkout →
+fan-out tests → exit-handler upload) is the part worth keeping.
+
+Everything is plain dicts — render with ``python ci/argo.py`` to get the
+YAML the way ci/workflows.py renders the GH-Actions tier.
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+MOUNT_PATH = "/mnt/test-data-volume"
+DATA_VOLUME = "tpukf-test-volume"
+NFS_CLAIM = "nfs-external"
+E2E_DAG = "e2e"
+EXIT_DAG = "exit-handler"
+WORKER_IMAGE = "python:3.12-slim"
+
+# The prow_config analog: which workflow runs for which touched paths
+# (reference prow_config.yaml "workflows:" entries). job_types mirrors the
+# reference's presubmit-only triggering.
+TRIGGERS: list[dict] = [
+    {"name": "common-ui", "component": "frontends-common",
+     "include_dirs": ["frontends/common/*", "frontends/tests/*"],
+     "command": "node frontends/tests/run.js"},
+    {"name": "ac-mgr-tests", "component": "access-management",
+     "include_dirs": ["service_account_auth_improvements_tpu/controlplane/kfam.py"],
+     "command": "python -m pytest tests/test_kfam.py -q"},
+    {"name": "adm-wh-tests", "component": "admission-webhook",
+     "include_dirs": ["service_account_auth_improvements_tpu/webhook/*",
+                      "native/poddefault/*"],
+     "command": "python -m pytest tests/test_webhook.py -q"},
+    {"name": "cdash-test", "component": "centraldashboard",
+     "include_dirs": ["service_account_auth_improvements_tpu/webapps/dashboard/*",
+                      "frontends/dashboard/*"],
+     "command": "python -m pytest tests/test_dashboard_app.py "
+                "tests/test_e2e_dashboard.py -q"},
+    {"name": "jwa-tests", "component": "jupyter-web-app",
+     "include_dirs": ["service_account_auth_improvements_tpu/webapps/jupyter/*",
+                      "frontends/jupyter/*"],
+     "command": "python -m pytest tests/test_jupyter_app.py "
+                "tests/test_e2e_jupyter.py -q"},
+    {"name": "vwa-tests", "component": "volumes-web-app",
+     "include_dirs": ["service_account_auth_improvements_tpu/webapps/volumes/*",
+                      "frontends/volumes/*"],
+     "command": "python -m pytest tests/test_volumes_tensorboards_apps.py "
+                "tests/test_e2e_volumes.py -q"},
+    {"name": "twa-tests", "component": "tensorboards-web-app",
+     "include_dirs": ["service_account_auth_improvements_tpu/webapps/tensorboards/*",
+                      "frontends/tensorboards/*"],
+     "command": "python -m pytest tests/test_volumes_tensorboards_apps.py "
+                "tests/test_e2e_tensorboards.py -q"},
+    {"name": "nb-ctrl-tests", "component": "notebook-controller",
+     "include_dirs": ["service_account_auth_improvements_tpu/controlplane/controllers/*"],
+     "command": "python -m pytest tests/test_notebook_controller.py "
+                "tests/test_gang.py tests/test_multislice.py -q"},
+    {"name": "profile-ctrl-tests", "component": "profile-controller",
+     "include_dirs": ["service_account_auth_improvements_tpu/controlplane/controllers/profile.py"],
+     "command": "python -m pytest tests/test_profile_controller.py -q"},
+    {"name": "tb-ctrl-tests", "component": "tensorboard-controller",
+     "include_dirs": ["service_account_auth_improvements_tpu/controlplane/controllers/tensorboard.py"],
+     "command": "python -m pytest tests/test_tensorboard_controller.py -q"},
+]
+
+
+class ArgoTestBuilder:
+    """One component's e2e Workflow (reference ArgoTestBuilder).
+
+    The DAG: make-artifacts-dir → checkout → run the component's test
+    command; an exit-handler DAG uploads artifacts regardless of outcome.
+    """
+
+    def __init__(self, name: str, namespace: str = "tpukf-test-infra",
+                 bucket: str = "tpukf-ci-artifacts",
+                 repo: str = "https://example.invalid/repo.git"):
+        self.name = name
+        self.namespace = namespace
+        self.bucket = bucket
+        self.repo = repo
+        self.test_dir = f"{MOUNT_PATH}/{name}"
+        self.output_dir = f"{self.test_dir}/output"
+        self.artifacts_dir = f"{self.output_dir}/artifacts/junit_{name}"
+        self.src_dir = f"{self.test_dir}/src"
+
+    def _task(self, name: str, deps: list[str]) -> dict:
+        return {
+            "name": name,
+            "template": name,
+            "dependencies": deps,
+        }
+
+    def _template(self, name: str, command: str) -> dict:
+        return {
+            "name": name,
+            "container": {
+                "image": WORKER_IMAGE,
+                "command": ["bash", "-c"],
+                "args": [command],
+                "workingDir": self.src_dir,
+                "volumeMounts": [
+                    {"name": DATA_VOLUME, "mountPath": MOUNT_PATH},
+                ],
+            },
+        }
+
+    def build(self, test_command: str) -> dict:
+        mkdir = f"mkdir -p {self.artifacts_dir}"
+        checkout = (f"git clone {self.repo} {self.src_dir} && "
+                    f"cd {self.src_dir}")
+        upload = (f"echo uploading {self.output_dir} to "
+                  f"gs://{self.bucket}/{self.name}")
+        tasks = [
+            self._task("make-artifacts-dir", []),
+            self._task("checkout", ["make-artifacts-dir"]),
+            self._task("run-tests", ["checkout"]),
+        ]
+        templates = [
+            {"name": E2E_DAG, "dag": {"tasks": tasks}},
+            {"name": EXIT_DAG, "dag": {"tasks": [
+                self._task("copy-artifacts", []),
+            ]}},
+            self._template("make-artifacts-dir", mkdir),
+            self._template("checkout", checkout),
+            self._template("run-tests", test_command),
+            self._template("copy-artifacts", upload),
+        ]
+        return {
+            "apiVersion": "argoproj.io/v1alpha1",
+            "kind": "Workflow",
+            "metadata": {
+                "name": self.name,
+                "namespace": self.namespace,
+                "labels": {"workflow_template": "argo_test"},
+            },
+            "spec": {
+                "entrypoint": E2E_DAG,
+                "onExit": EXIT_DAG,
+                "volumes": [{
+                    "name": DATA_VOLUME,
+                    "persistentVolumeClaim": {"claimName": NFS_CLAIM},
+                }],
+                "templates": templates,
+            },
+        }
+
+
+def create_workflow(trigger: dict, **kwargs) -> dict:
+    """The reference's per-component ``create_workflow`` entry point."""
+    return ArgoTestBuilder(trigger["name"], **kwargs).build(
+        trigger["command"]
+    )
+
+
+def prow_config() -> dict:
+    """The prow_config.yaml analog (reference prow_config.yaml)."""
+    return {
+        "python_paths": ["ci"],
+        "workflows": [
+            {
+                "py_func": "ci.argo.create_workflow",
+                "name": t["name"],
+                "job_types": ["presubmit"],
+                "include_dirs": ["releasing/VERSION", *t["include_dirs"]],
+                "kwargs": {},
+            }
+            for t in TRIGGERS
+        ],
+    }
+
+
+def main() -> None:
+    import yaml
+
+    class _InlineDumper(yaml.SafeDumper):
+        def ignore_aliases(self, data):
+            return True
+
+    out = pathlib.Path(__file__).resolve().parent / "argo"
+    out.mkdir(exist_ok=True)
+    (out / "prow_config.yaml").write_text(
+        "# generated by ci/argo.py — do not edit\n"
+        + yaml.dump(prow_config(), Dumper=_InlineDumper, sort_keys=False)
+    )
+    for t in TRIGGERS:
+        wf = create_workflow(t)
+        (out / f"{t['name']}.yaml").write_text(
+            "# generated by ci/argo.py — do not edit\n"
+            + yaml.dump(wf, Dumper=_InlineDumper, sort_keys=False)
+        )
+    print(f"wrote {len(TRIGGERS) + 1} files under {out}")
+
+
+if __name__ == "__main__":
+    main()

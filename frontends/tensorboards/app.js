@@ -1,18 +1,21 @@
-/* Tensorboards web app — Tensorboard CR table + create dialog.
+/* Tensorboards web app — Tensorboard CR table, create dialog, and a
+ * details drawer (overview / conditions / events / YAML) matching the
+ * reference TWA Angular details surface (tensorboards/frontend/src/app).
  * API surface: webapps/tensorboards/app.py. The logs path is either a
  * PVC (pvc://name/subpath) or an object-store URL (gs://...).
  */
 (function () {
   "use strict";
   const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
-          statusIcon, resourceTable, poller, el } = window.TpuKF;
+          statusIcon, resourceTable, conditionsTable, eventsTable,
+          objectView, poller, el } = window.TpuKF;
 
   const main = document.getElementById("main");
   let ns = currentNamespace();
   let listPoller = null;
 
   document.getElementById("ns-slot").appendChild(
-    namespaceInput((value) => { ns = value; render(); })
+    namespaceInput((value) => { ns = value; location.hash = "#/"; route(); })
   );
   document.getElementById("new-btn").addEventListener("click", newDialog);
 
@@ -89,7 +92,9 @@
       const columns = [
         { title: "Status", render: (t) =>
             statusIcon(t.status.phase, t.status.message) },
-        { title: "Name", render: (t) => t.name },
+        { title: "Name", render: (t) => el("a", {
+            href: `#/details/${encodeURIComponent(t.name)}`,
+          }, t.name) },
         { title: "Logs path", render: (t) => t.logspath },
         { title: "Age", render: (t) => t.age },
         { title: "", render: (t) => actions(t) },
@@ -124,5 +129,108 @@
     listPoller = poller(refresh, 3000);
   }
 
-  render();
+  // ----------------------------------------------------------- details
+  // (reference TWA details: conditions mirror the Deployment's state via
+  // status.conditions; events come from the tensorboard-controller)
+  let detailPollers = [];
+
+  function stopDetailPollers() {
+    for (const p of detailPollers) p.stop();
+    detailPollers = [];
+  }
+
+  async function renderDetails(name) {
+    if (listPoller) listPoller.stop();
+    stopDetailPollers();
+    const card = el("div", { class: "card" });
+    const tabBar = el("div", { class: "row tabs" });
+    const pane = el("div", { class: "tab-pane" });
+    card.append(
+      el("div", { class: "row", style: "justify-content:space-between" },
+        el("h3", { style: "margin-top:0" }, `${ns}/${name}`),
+        el("button", { onclick: () => { location.hash = "#/"; } }, "Back")),
+      tabBar, pane);
+    main.replaceChildren(card);
+
+    function overviewTab() {
+      stopDetailPollers();
+      const box = el("div", {});
+      pane.replaceChildren(box);
+      detailPollers.push(poller(async () => {
+        // list first: once the CR is gone the per-name GET 404s, and the
+        // "deleted" state must render instead of a rejected Promise.all
+        const summary = await api(
+          "GET", `api/namespaces/${ns}/tensorboards`).then((d) =>
+          (d.tensorboards || []).find((t) => t.name === name));
+        if (!summary) {
+          box.replaceChildren(el("div", { class: "muted" }, "deleted"));
+          return;
+        }
+        const data = await api(
+          "GET", `api/namespaces/${ns}/tensorboards/${name}`);
+        const st = (data.tensorboard.status || {});
+        box.replaceChildren(
+          el("div", { class: "row" },
+            statusIcon(summary.status.phase, summary.status.message),
+            el("span", { class: "muted" }, summary.status.message || "")),
+          el("div", { class: "form-grid", style: "margin-top:10px" },
+            el("label", {}, "Logs path"),
+            el("span", {}, summary.logspath || "?"),
+            el("label", {}, "Ready replicas"),
+            el("span", {}, String(st.readyReplicas || 0)),
+            el("label", {}, "Address"),
+            el("a", { href: `/tensorboard/${ns}/${name}/`,
+                      target: "_blank" },
+              `/tensorboard/${ns}/${name}/`)),
+          el("h4", {}, "Conditions"),
+          conditionsTable((st.conditions || []).map((c) => ({
+            type: c.deploymentState, status: "True",
+            lastTransitionTime: c.lastProbeTime,
+          }))),
+          el("h4", {}, "Events"), eventsTable(data.events),
+        );
+      }, 4000));
+    }
+
+    async function yamlTab() {
+      stopDetailPollers();
+      pane.replaceChildren(el("span", { class: "muted" }, "loading…"));
+      try {
+        const data = await api(
+          "GET", `api/namespaces/${ns}/tensorboards/${name}`);
+        pane.replaceChildren(objectView(data.tensorboard));
+      } catch (e) {
+        pane.replaceChildren(el("div", { class: "muted" }, e.message));
+      }
+    }
+
+    const tabs = [["Overview", overviewTab], ["YAML", yamlTab]];
+    for (const [label, show] of tabs) {
+      tabBar.appendChild(el("button", { onclick: () => {
+        for (const b of tabBar.children) b.classList.remove("primary");
+        btnFor(label).classList.add("primary");
+        show();
+      } }, label));
+    }
+    function btnFor(label) {
+      return Array.from(tabBar.children).find(
+        (b) => b.textContent === label);
+    }
+    btnFor("Overview").classList.add("primary");
+    overviewTab();
+  }
+
+  function route() {
+    stopDetailPollers();
+    const details = location.hash.match(/^#\/details\/([^/]+)$/);
+    if (details && ns) {
+      renderDetails(decodeURIComponent(details[1])).catch(
+        (e) => snackbar(e.message, true));
+    } else {
+      render();
+    }
+  }
+
+  window.addEventListener("hashchange", route);
+  route();
 })();

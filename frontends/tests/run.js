@@ -25,6 +25,8 @@ global.TpuKFSources = SOURCES;
 
 require("./test_tpukf.js");
 require("./test_jupyter_app.js");
+require("./test_volumes_app.js");
+require("./test_tensorboards_app.js");
 
 harness.runAll((line) => console.log(line)).then((failed) => {
   process.exit(failed ? 1 : 0);

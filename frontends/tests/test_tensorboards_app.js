@@ -1,0 +1,99 @@
+/* Tests for frontends/tensorboards/app.js: list rendering and the details
+ * drawer (overview with conditions + events, YAML) — reference surface:
+ * TWA Angular pages + cypress
+ * (components/crud-web-apps/tensorboards/frontend/). */
+(function () {
+  "use strict";
+  const H = (typeof TpuKFHarness !== "undefined")
+    ? TpuKFHarness : window.TpuKFHarness;
+  const SRC = (typeof TpuKFSources !== "undefined")
+    ? TpuKFSources : window.TpuKFSources;
+  const { makeWorld, runSource, makeFetch, drain, test, assert } = H;
+
+  const LIST = { tensorboards: [{
+    name: "tb1", namespace: "u1", logspath: "pvc://logs-pvc/train",
+    age: "2026-07-30T00:00:00Z",
+    status: { phase: "ready", message: "Running" },
+  }] };
+
+  const DETAILS = {
+    tensorboard: {
+      apiVersion: "tpukf.dev/v1alpha1", kind: "Tensorboard",
+      metadata: { name: "tb1", namespace: "u1" },
+      spec: { logspath: "pvc://logs-pvc/train" },
+      status: {
+        readyReplicas: 1,
+        conditions: [
+          { deploymentState: "Progressing",
+            lastProbeTime: "2026-07-30T00:00:00Z" },
+          { deploymentState: "Available",
+            lastProbeTime: "2026-07-30T00:01:00Z" },
+        ],
+      },
+    },
+    events: [{
+      type: "Normal", reason: "CreatedDeployment",
+      message: "Created Deployment u1/tb1",
+      lastTimestamp: "2026-07-30T00:00:00Z",
+    }],
+  };
+
+  function routes(extra) {
+    return Object.assign({
+      "GET api/namespaces/u1/tensorboards": LIST,
+      "GET api/namespaces/u1/tensorboards/tb1": DETAILS,
+    }, extra || {});
+  }
+
+  function app(fetchStub) {
+    const world = makeWorld({ fetch: fetchStub, search: "?ns=u1" });
+    const { document } = world;
+    const main = document.createElement("div");
+    main.id = "main";
+    const nsSlot = document.createElement("div");
+    nsSlot.id = "ns-slot";
+    const newBtn = document.createElement("button");
+    newBtn.id = "new-btn";
+    document.body.append(main, nsSlot, newBtn);
+    runSource(world, SRC.tpukf, "tpukf.js");
+    runSource(world, SRC.tensorboards, "tensorboards/app.js");
+    return world;
+  }
+
+  test("tensorboards list renders status and logspath", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("tb1"));
+    assert(main.textContent.includes("pvc://logs-pvc/train"));
+    assert(main.textContent.includes("Connect"));
+  });
+
+  test("tensorboard details shows conditions and events", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/tb1";
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("u1/tb1"), "title");
+    assert(main.textContent.includes("Available"),
+      "deployment conditions surfaced");
+    assert(main.textContent.includes("Progressing"));
+    assert(main.textContent.includes("CreatedDeployment"),
+      "controller events surfaced");
+    assert(main.textContent.includes("Ready replicas"));
+  });
+
+  test("tensorboard YAML tab renders the raw CR", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/tb1";
+    await drain();
+    const main = world.document.getElementById("main");
+    Array.from(main.querySelectorAll("button")).find(
+      (b) => b.textContent === "YAML").click();
+    await drain();
+    assert(main.textContent.includes("Tensorboard"), "kind in YAML view");
+    assert(main.textContent.includes("logspath"));
+  });
+})();

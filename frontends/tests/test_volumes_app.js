@@ -1,0 +1,137 @@
+/* Tests for frontends/volumes/app.js: list rendering, viewer launch,
+ * delete guard, and the details drawer (overview + events, pods, YAML) —
+ * reference surface: VWA Angular pages + cypress
+ * (components/crud-web-apps/volumes/frontend/). */
+(function () {
+  "use strict";
+  const H = (typeof TpuKFHarness !== "undefined")
+    ? TpuKFHarness : window.TpuKFHarness;
+  const SRC = (typeof TpuKFSources !== "undefined")
+    ? TpuKFSources : window.TpuKFSources;
+  const { makeWorld, runSource, makeFetch, drain, test, assert } = H;
+
+  const PVCS = { pvcs: [{
+    name: "vol1", namespace: "u1",
+    status: { phase: "ready", message: "Bound" },
+    capacity: "5Gi", modes: ["ReadWriteOnce"], class: "standard",
+    notebooks: ["nb1"],
+    viewer: { status: "ready", url: "/pvcviewer/u1/vol1/" },
+  }, {
+    name: "vol2", namespace: "u1",
+    status: { phase: "waiting", message: "Provisioning Volume..." },
+    capacity: "1Gi", modes: ["ReadWriteMany"], class: null,
+    notebooks: [],
+    viewer: { status: "uninitialized", url: null },
+  }] };
+
+  const EVENTS = { events: [{
+    type: "Normal", reason: "ProvisioningSucceeded",
+    message: "provisioned ok", lastTimestamp: "2026-07-30T00:00:00Z",
+  }] };
+
+  const PODS = { pods: [{
+    metadata: { name: "nb1-0" },
+    status: { phase: "Running" },
+    spec: { volumes: [
+      { name: "data", persistentVolumeClaim: { claimName: "vol1" } },
+    ] },
+  }] };
+
+  const RAW = { pvc: {
+    apiVersion: "v1", kind: "PersistentVolumeClaim",
+    metadata: { name: "vol1", namespace: "u1" },
+    spec: { accessModes: ["ReadWriteOnce"] },
+  } };
+
+  function routes(extra) {
+    return Object.assign({
+      "GET api/namespaces/u1/pvcs": PVCS,
+      "GET api/namespaces/u1/pvcs/vol1/events": EVENTS,
+      "GET api/namespaces/u1/pvcs/vol1/pods": PODS,
+      "GET api/namespaces/u1/pvcs/vol1": RAW,
+    }, extra || {});
+  }
+
+  function app(fetchStub) {
+    const world = makeWorld({ fetch: fetchStub, search: "?ns=u1" });
+    const { document } = world;
+    const main = document.createElement("div");
+    main.id = "main";
+    const nsSlot = document.createElement("div");
+    nsSlot.id = "ns-slot";
+    const newBtn = document.createElement("button");
+    newBtn.id = "new-btn";
+    document.body.append(main, nsSlot, newBtn);
+    runSource(world, SRC.tpukf, "tpukf.js");
+    runSource(world, SRC.volumes, "volumes/app.js");
+    return world;
+  }
+
+  test("volumes list renders status, usage and viewer state", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("vol1"));
+    assert(main.textContent.includes("5Gi"));
+    assert(main.textContent.includes("nb1"), "used-by notebooks shown");
+    assert(main.textContent.includes("Browse"),
+      "ready viewer offers Browse");
+    assert(main.textContent.includes("Launch browser"),
+      "uninitialized viewer offers Launch");
+  });
+
+  test("volume details overview shows events and viewer URL", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/vol1";
+    await drain();
+    const main = world.document.getElementById("main");
+    assert(main.textContent.includes("u1/vol1"), "title");
+    assert(main.textContent.includes("ProvisioningSucceeded"),
+      "events table populated");
+    assert(main.textContent.includes("/pvcviewer/u1/vol1/"),
+      "viewer URL surfaced");
+    assert(main.textContent.includes("ReadWriteOnce"));
+  });
+
+  test("volume details pods tab lists mounting pods", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/vol1";
+    await drain();
+    const main = world.document.getElementById("main");
+    const podsBtn = Array.from(main.querySelectorAll("button")).find(
+      (b) => b.textContent === "Pods");
+    assert(podsBtn, "Pods tab exists");
+    podsBtn.click();
+    await drain();
+    assert(main.textContent.includes("nb1-0"), "mounting pod listed");
+    assert(main.textContent.includes("Running"));
+  });
+
+  test("volume details YAML tab renders the raw object", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/vol1";
+    await drain();
+    const main = world.document.getElementById("main");
+    Array.from(main.querySelectorAll("button")).find(
+      (b) => b.textContent === "YAML").click();
+    await drain();
+    assert(main.textContent.includes("PersistentVolumeClaim"),
+      "kind in YAML view");
+  });
+
+  test("back link returns to the list", async () => {
+    const world = app(makeFetch(routes()));
+    await drain();
+    world.location.hash = "#/details/vol1";
+    await drain();
+    const main = world.document.getElementById("main");
+    Array.from(main.querySelectorAll("button")).find(
+      (b) => b.textContent === "Back").click();
+    await drain();
+    assert(world.location.hash === "#/");
+    assert(main.textContent.includes("vol2"), "list restored");
+  });
+})();

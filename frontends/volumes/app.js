@@ -1,17 +1,20 @@
-/* Volumes web app — PVC table, create dialog, PVCViewer launch.
+/* Volumes web app — PVC table, create dialog, PVCViewer launch, and a
+ * details drawer (overview / events / pods / YAML) matching the reference
+ * VWA Angular details page (volumes/frontend/src/app/pages/details).
  * API surface: webapps/volumes/app.py.
  */
 (function () {
   "use strict";
   const { api, currentNamespace, namespaceInput, snackbar, confirmDialog,
-          statusIcon, resourceTable, poller, el } = window.TpuKF;
+          statusIcon, resourceTable, eventsTable, objectView, poller,
+          el } = window.TpuKF;
 
   const main = document.getElementById("main");
   let ns = currentNamespace();
   let listPoller = null;
 
   document.getElementById("ns-slot").appendChild(
-    namespaceInput((value) => { ns = value; render(); })
+    namespaceInput((value) => { ns = value; location.hash = "#/"; route(); })
   );
   document.getElementById("new-btn").addEventListener("click", newPvcDialog);
 
@@ -74,7 +77,9 @@
       const columns = [
         { title: "Status", render: (p) =>
             statusIcon(p.status.phase, p.status.message) },
-        { title: "Name", render: (p) => p.name },
+        { title: "Name", render: (p) => el("a", {
+            href: `#/details/${encodeURIComponent(p.name)}`,
+          }, p.name) },
         { title: "Size", render: (p) => p.capacity },
         { title: "Modes", render: (p) => (p.modes || []).join(", ") },
         { title: "Class", render: (p) => p.class },
@@ -121,5 +126,119 @@
     listPoller = poller(refresh, 3000);
   }
 
-  render();
+  // ----------------------------------------------------------- details
+  // (reference VWA details page: overview + events + used-by pods + YAML)
+  let detailPollers = [];
+
+  function stopDetailPollers() {
+    for (const p of detailPollers) p.stop();
+    detailPollers = [];
+  }
+
+  async function renderDetails(name) {
+    if (listPoller) listPoller.stop();
+    stopDetailPollers();
+    const card = el("div", { class: "card" });
+    const tabBar = el("div", { class: "row tabs" });
+    const pane = el("div", { class: "tab-pane" });
+    card.append(
+      el("div", { class: "row", style: "justify-content:space-between" },
+        el("h3", { style: "margin-top:0" }, `${ns}/${name}`),
+        el("button", { onclick: () => { location.hash = "#/"; } }, "Back")),
+      tabBar, pane);
+    main.replaceChildren(card);
+
+    function overviewTab() {
+      stopDetailPollers();
+      const box = el("div", {});
+      pane.replaceChildren(box);
+      detailPollers.push(poller(async () => {
+        const [row, evs] = await Promise.all([
+          api("GET", `api/namespaces/${ns}/pvcs`).then((d) =>
+            (d.pvcs || []).find((p) => p.name === name)),
+          api("GET", `api/namespaces/${ns}/pvcs/${name}/events`),
+        ]);
+        if (!row) {
+          box.replaceChildren(el("div", { class: "muted" }, "deleted"));
+          return;
+        }
+        box.replaceChildren(
+          el("div", { class: "row" },
+            statusIcon(row.status.phase, row.status.message),
+            el("span", { class: "muted" }, row.status.message || "")),
+          el("div", { class: "form-grid", style: "margin-top:10px" },
+            el("label", {}, "Size"), el("span", {}, row.capacity || "?"),
+            el("label", {}, "Modes"),
+            el("span", {}, (row.modes || []).join(", ")),
+            el("label", {}, "Class"), el("span", {}, row.class || "default"),
+            el("label", {}, "Used by"),
+            el("span", {}, (row.notebooks || []).join(", ") || "—"),
+            el("label", {}, "File browser"),
+            el("span", {}, row.viewer.status +
+              (row.viewer.url ? ` (${row.viewer.url})` : ""))),
+          el("h4", {}, "Events"), eventsTable(evs.events),
+        );
+      }, 4000));
+    }
+
+    function podsTab() {
+      stopDetailPollers();
+      const box = el("div", {});
+      pane.replaceChildren(box);
+      detailPollers.push(poller(async () => {
+        const data = await api(
+          "GET", `api/namespaces/${ns}/pvcs/${name}/pods`);
+        box.replaceChildren(resourceTable([
+          { title: "Pod", render: (p) => p.metadata.name },
+          { title: "Phase", render: (p) => (p.status || {}).phase || "?" },
+          { title: "Mounted as", render: (p) => {
+              const vol = ((p.spec || {}).volumes || []).find((v) =>
+                (v.persistentVolumeClaim || {}).claimName === name);
+              return vol ? vol.name : "?";
+            } },
+        ], data.pods, "no pods mount this volume"));
+      }, 4000));
+    }
+
+    async function yamlTab() {
+      stopDetailPollers();
+      pane.replaceChildren(el("span", { class: "muted" }, "loading…"));
+      try {
+        const data = await api("GET", `api/namespaces/${ns}/pvcs/${name}`);
+        pane.replaceChildren(objectView(data.pvc));
+      } catch (e) {
+        pane.replaceChildren(el("div", { class: "muted" }, e.message));
+      }
+    }
+
+    const tabs = [["Overview", overviewTab], ["Pods", podsTab],
+                  ["YAML", yamlTab]];
+    for (const [label, show] of tabs) {
+      tabBar.appendChild(el("button", { onclick: () => {
+        for (const b of tabBar.children) b.classList.remove("primary");
+        btnFor(label).classList.add("primary");
+        show();
+      } }, label));
+    }
+    function btnFor(label) {
+      return Array.from(tabBar.children).find(
+        (b) => b.textContent === label);
+    }
+    btnFor("Overview").classList.add("primary");
+    overviewTab();
+  }
+
+  function route() {
+    stopDetailPollers();
+    const details = location.hash.match(/^#\/details\/([^/]+)$/);
+    if (details && ns) {
+      renderDetails(decodeURIComponent(details[1])).catch(
+        (e) => snackbar(e.message, true));
+    } else {
+      render();
+    }
+  }
+
+  window.addEventListener("hashchange", route);
+  route();
 })();

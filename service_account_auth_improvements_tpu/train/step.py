@@ -31,10 +31,16 @@ class TrainState(NamedTuple):
 
 
 def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
-                   b1: float = 0.9, b2: float = 0.95, grad_clip: float = 1.0):
+                   b1: float = 0.9, b2: float = 0.95, grad_clip: float = 1.0,
+                   mu_dtype=None):
+    """AdamW with global-norm clipping. ``mu_dtype="bfloat16"`` stores the
+    first moment in bf16 (optax casts on read/write) — halves mu's HBM at
+    ~no accuracy cost (the first moment is a smoothed gradient; the second
+    moment, which sets the preconditioner scale, stays f32)."""
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
-        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay),
+        optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay,
+                    mu_dtype=mu_dtype),
     )
 
 

@@ -98,9 +98,18 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
         with bindings_lock:
             bindings_cache["value"] = None
 
+    def is_contributor_binding(b: dict) -> bool:
+        # contributor-role bindings only (any grantable KFAM role — edit,
+        # view): the profile controller also writes an admin RoleBinding
+        # for the owner, and counting it would double-list owned
+        # namespaces (reference api_workgroup.ts maps role admin→owner,
+        # everything else→contributor)
+        return (b.get("roleRef") or {}).get("name") != "admin"
+
     def contributed_namespaces(user: str) -> list[str]:
         return [b["referredNamespace"] for b in all_bindings()
-                if (b.get("user") or {}).get("name") == user]
+                if (b.get("user") or {}).get("name") == user
+                and is_contributor_binding(b)]
 
     # ----------------------------------------------------------- shell API
 
@@ -235,6 +244,8 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
             )
             by_ns[name] = [owner] if owner else []
         for b in bindings:
+            if not is_contributor_binding(b):
+                continue  # owners come from the profile spec, not bindings
             by_ns.setdefault(b["referredNamespace"], []).append(
                 (b.get("user") or {}).get("name")
             )
@@ -250,6 +261,9 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
         bindings = kfam.list_bindings(ns).get("bindings", [])
         return {"contributors": [
             (b.get("user") or {}).get("name") for b in bindings
+            # the owner's admin binding is not a contributor (reference
+            # api_workgroup.ts getContributors: role === 'contributor')
+            if is_contributor_binding(b)
         ]}
 
     def _require_binding_rights(req, ns: str) -> None:

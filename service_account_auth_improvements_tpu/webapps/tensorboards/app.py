@@ -68,6 +68,18 @@ def build_app(kube, static_dir: str | None = None,
             for p in api_for(req).list("persistentvolumeclaims", ns)
         ]}
 
+    @app.route("GET", "/api/namespaces/<namespace>/tensorboards/<name>")
+    def get_tensorboard(req):
+        """Raw CR + events for the details drawer (reference TWA details:
+        conditions come from status.conditions, events from the
+        tensorboard-controller's emissions)."""
+        ns, name = req.params["namespace"], req.params["name"]
+        api = api_for(req)
+        return {
+            "tensorboard": api.get("tensorboards", name, ns),
+            "events": api.events_for(ns, "Tensorboard", name),
+        }
+
     @app.route("POST", "/api/namespaces/<namespace>/tensorboards")
     def post_tensorboard(req):
         ns = req.params["namespace"]

@@ -174,6 +174,13 @@ def build_app(kube, static_dir: str | None = None,
             })
         return {"pvcs": rows}
 
+    @app.route("GET", "/api/namespaces/<namespace>/pvcs/<name>")
+    def get_pvc(req):
+        """Raw PVC for the details drawer (reference VWA routes/get.py
+        get_pvc — the Angular details page's YAML/overview source)."""
+        ns, name = req.params["namespace"], req.params["name"]
+        return {"pvc": api_for(req).get("persistentvolumeclaims", name, ns)}
+
     @app.route("GET", "/api/namespaces/<namespace>/pvcs/<name>/pods")
     def get_pvc_pods(req):
         ns, name = req.params["namespace"], req.params["name"]

@@ -1,0 +1,90 @@
+"""Legacy Prow/Argo CI tier (reference py/kubeflow/kubeflow/ci/
+workflow_utils.py + prow_config.yaml): workflow DAG shape and trigger
+hygiene."""
+
+import pathlib
+
+from ci.argo import (
+    E2E_DAG,
+    EXIT_DAG,
+    TRIGGERS,
+    create_workflow,
+    prow_config,
+)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _dag(wf, name):
+    for t in wf["spec"]["templates"]:
+        if t["name"] == name and "dag" in t:
+            return t["dag"]
+    raise AssertionError(f"no dag template {name!r}")
+
+
+def test_workflow_dag_shape():
+    wf = create_workflow(TRIGGERS[0])
+    assert wf["kind"] == "Workflow"
+    assert wf["spec"]["entrypoint"] == E2E_DAG
+    assert wf["spec"]["onExit"] == EXIT_DAG
+
+    tasks = {t["name"]: t for t in _dag(wf, E2E_DAG)["tasks"]}
+    assert tasks["checkout"]["dependencies"] == ["make-artifacts-dir"]
+    assert tasks["run-tests"]["dependencies"] == ["checkout"]
+    # exit handler runs unconditionally (no deps into the e2e DAG)
+    exit_tasks = _dag(wf, EXIT_DAG)["tasks"]
+    assert [t["name"] for t in exit_tasks] == ["copy-artifacts"]
+
+    # every DAG task has a container template backing it
+    names = {t["name"] for t in wf["spec"]["templates"]}
+    for task in list(tasks) + ["copy-artifacts"]:
+        assert task in names, f"task {task} has no template"
+
+
+def test_every_workflow_builds_and_mounts_test_volume():
+    for trig in TRIGGERS:
+        wf = create_workflow(trig)
+        run = next(t for t in wf["spec"]["templates"]
+                   if t["name"] == "run-tests")
+        assert trig["command"] in run["container"]["args"][0]
+        mounts = run["container"]["volumeMounts"]
+        assert any(m["mountPath"].startswith("/mnt/") for m in mounts)
+
+
+def test_triggers_point_at_real_paths():
+    """include_dirs must reference paths that exist (a renamed component
+    would silently stop triggering its lane — the reference's prow config
+    rotted exactly this way)."""
+    for trig in TRIGGERS:
+        for pattern in trig["include_dirs"]:
+            base = pattern.split("*")[0].rstrip("/")
+            assert (ROOT / base).exists(), (trig["name"], pattern)
+        # the command's pytest files must exist too
+        for token in trig["command"].split():
+            if token.startswith("tests/"):
+                assert (ROOT / token).exists(), (trig["name"], token)
+
+
+def test_prow_config_covers_all_triggers():
+    cfg = prow_config()
+    assert {w["name"] for w in cfg["workflows"]} == {
+        t["name"] for t in TRIGGERS
+    }
+    for w in cfg["workflows"]:
+        assert w["job_types"] == ["presubmit"]
+        assert "releasing/VERSION" in w["include_dirs"]
+
+
+def test_generated_files_current(tmp_path):
+    """ci/argo/ rendered YAML matches the builders (same check
+    test_ci.py applies to the GH-Actions tier)."""
+    import yaml
+
+    gen = ROOT / "ci" / "argo"
+    assert (gen / "prow_config.yaml").exists(), "run python ci/argo.py"
+    on_disk = yaml.safe_load((gen / "prow_config.yaml").read_text())
+    assert on_disk == prow_config()
+    for trig in TRIGGERS:
+        path = gen / f"{trig['name']}.yaml"
+        assert path.exists(), f"run python ci/argo.py ({path} missing)"
+        assert yaml.safe_load(path.read_text()) == create_workflow(trig)

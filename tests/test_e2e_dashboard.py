@@ -1,0 +1,128 @@
+"""E2E lane: the REAL central dashboard BFF over HTTP with the Profile
+controller live — workgroup create → profile reconciled into a namespace →
+env-info reflects ownership → add/remove contributor round-trip (KFAM
+bindings + AuthorizationPolicies) → namespaces list. Mirrors the
+reference's centraldashboard Cypress coverage
+(components/centraldashboard-angular/frontend/cypress/).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.profile import (
+    ProfileReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kfam import KfamApp
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.dashboard import (
+    build_app,
+)
+
+from e2e_common import Browser, serve, wait
+
+ADMIN = "root@example.com"
+ALICE = "alice@example.com"
+BOB = "bob@example.com"
+
+
+@pytest.fixture()
+def world(monkeypatch):
+    monkeypatch.setenv("CLUSTER_ADMIN", ADMIN)
+    kube = FakeKube()
+    mgr = Manager(kube)
+    ProfileReconciler(kube).register(mgr)
+    mgr.start()
+    kfam = KfamApp(kube, cluster_admin=ADMIN)
+    httpd, base = serve(build_app(kube, kfam, mode="dev"))
+    yield kube, base
+    httpd.shutdown()
+    mgr.stop()
+
+
+def _ns_exists(kube, name):
+    try:
+        kube.get("namespaces", name)
+        return True
+    except errors.NotFound:
+        return False
+
+
+def test_workgroup_and_contributors_over_http(world):
+    kube, base = world
+    alice = Browser(base, user=ALICE)
+    bob = Browser(base, user=BOB)
+
+    # fresh user: authenticated but no workgroup yet
+    out = alice.request("GET", "/api/workgroup/exists")
+    assert {k: out[k] for k in
+            ("hasAuth", "user", "hasWorkgroup",
+             "registrationFlowAllowed")} == {
+        "hasAuth": True, "user": ALICE, "hasWorkgroup": False,
+        "registrationFlowAllowed": True,
+    }
+
+    # registration → profile CR → live reconciler creates the namespace
+    alice.request("POST", "/api/workgroup/create", {"namespace": "alice"})
+    assert wait(lambda: _ns_exists(kube, "alice")), (
+        "profile controller never created the namespace"
+    )
+    info = alice.request("GET", "/api/workgroup/env-info")
+    assert info["namespaces"] == [
+        {"namespace": "alice", "role": "owner", "user": ALICE}
+    ]
+    assert info["isClusterAdmin"] is False
+
+    # owner adds a contributor; the contributor sees the namespace
+    alice.request("POST", "/api/workgroup/add-contributor/alice",
+                  {"contributor": BOB})
+    got = alice.request("GET", "/api/workgroup/get-contributors/alice")
+    assert got["contributors"] == [BOB]
+    info = bob.request("GET", "/api/workgroup/env-info")
+    assert info["namespaces"] == [
+        {"namespace": "alice", "role": "contributor", "user": BOB}
+    ]
+    # the binding materialized an AuthorizationPolicy for bob
+    pols = kube.list("authorizationpolicies", namespace="alice",
+                     group="security.istio.io")["items"]
+    assert any(BOB in str(p) for p in pols), pols
+
+    # a non-owner cannot manage someone else's contributors
+    bob.request("POST", "/api/workgroup/add-contributor/alice",
+                {"contributor": "mallory@example.com"}, expect=403)
+
+    # remove flows back out
+    alice.request("DELETE", "/api/workgroup/remove-contributor/alice",
+                  {"contributor": BOB})
+    got = alice.request("GET", "/api/workgroup/get-contributors/alice")
+    assert got["contributors"] == []
+    info = bob.request("GET", "/api/workgroup/env-info")
+    assert info["namespaces"] == []
+
+    # admin surfaces: all namespaces with contributors
+    admin = Browser(base, user=ADMIN)
+    allns = admin.request("GET", "/api/workgroup/get-all-namespaces")
+    assert {"namespace": "alice", "contributors": [ALICE]} in (
+        allns["namespaces"]
+    )
+    # non-admin is refused
+    alice.request("GET", "/api/workgroup/get-all-namespaces", expect=403)
+
+    # the dashboard shell lists the namespace for pickers
+    names = admin.request("GET", "/api/namespaces")
+    assert "alice" in names["namespaces"]
+
+
+def test_nuke_self_removes_profile_and_namespace(world):
+    kube, base = world
+    alice = Browser(base, user=ALICE)
+    alice.request("POST", "/api/workgroup/create", {})
+    assert wait(lambda: _ns_exists(kube, "alice"))
+    alice.request("DELETE", "/api/workgroup/nuke-self")
+    # the live reconciler must run the finalizer before the CR disappears
+    assert wait(lambda: not kube.list("profiles",
+                                      group="tpukf.dev")["items"])

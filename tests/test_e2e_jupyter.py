@@ -9,10 +9,7 @@ a backend mock.
 
 from __future__ import annotations
 
-import json
-import socketserver
 import threading
-import time
 import urllib.error
 import urllib.request
 import wsgiref.simple_server
@@ -28,56 +25,13 @@ from service_account_auth_improvements_tpu.webapps.jupyter.app import (
     build_app,
 )
 
-
-class _ThreadingWSGIServer(socketserver.ThreadingMixIn,
-                           wsgiref.simple_server.WSGIServer):
-    daemon_threads = True
-
-
-class _QuietHandler(wsgiref.simple_server.WSGIRequestHandler):
-    def log_message(self, *args):  # noqa: D102 - silence per-request lines
-        pass
-
-
-class Browser:
-    """Tiny cookie-holding HTTP client (CSRF double-submit aware)."""
-
-    def __init__(self, base: str):
-        self.base = base
-        self.cookies: dict[str, str] = {}
-
-    def request(self, method: str, path: str, body=None, expect=200):
-        req = urllib.request.Request(
-            self.base + path, method=method,
-            data=None if body is None else json.dumps(body).encode(),
-        )
-        if self.cookies:
-            req.add_header("Cookie", "; ".join(
-                f"{k}={v}" for k, v in self.cookies.items()))
-        if method not in ("GET", "HEAD", "OPTIONS"):
-            req.add_header("X-XSRF-TOKEN", self.cookies.get("XSRF-TOKEN", ""))
-            req.add_header("Content-Type", "application/json")
-        try:
-            with urllib.request.urlopen(req, timeout=10) as resp:
-                self._eat_cookies(resp)
-                status = resp.status
-                raw = resp.read()
-        except urllib.error.HTTPError as e:
-            self._eat_cookies(e)
-            status = e.code
-            raw = e.read()
-        assert status == expect, (method, path, status, raw[:300])
-        if raw[:1] in (b"{", b"["):
-            return json.loads(raw)
-        return raw
-
-    def _eat_cookies(self, resp):
-        for header, value in resp.headers.items():
-            if header.lower() == "set-cookie":
-                first = value.split(";", 1)[0]
-                if "=" in first:
-                    k, v = first.split("=", 1)
-                    self.cookies[k.strip()] = v.strip()
+from e2e_common import (
+    Browser,
+    QuietHandler as _QuietHandler,
+    ThreadingWSGIServer as _ThreadingWSGIServer,
+    serve,
+    wait as _wait,
+)
 
 
 @pytest.fixture()
@@ -87,24 +41,11 @@ def world():
     mgr = Manager(kube)
     NotebookReconciler(kube).register(mgr)
     mgr.start()
-    httpd = wsgiref.simple_server.make_server(
-        "127.0.0.1", 0, build_app(kube, mode="dev"),
-        server_class=_ThreadingWSGIServer, handler_class=_QuietHandler,
-    )
-    threading.Thread(target=httpd.serve_forever, daemon=True).start()
-    browser = Browser(f"http://127.0.0.1:{httpd.server_address[1]}")
+    httpd, base = serve(build_app(kube, mode="dev"))
+    browser = Browser(base)
     yield kube, browser
     httpd.shutdown()
     mgr.stop()
-
-
-def _wait(pred, timeout=8.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
 
 
 def test_full_notebook_lifecycle_over_http(world):

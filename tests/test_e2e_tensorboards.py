@@ -1,0 +1,111 @@
+"""E2E lane: the REAL tensorboards web app over HTTP with the Tensorboard
+controller live — create (pvc:// logspath) → Deployment materialized →
+ready mirrored onto the CR → delete cascades. Mirrors the reference's TWA
+Cypress flow (components/crud-web-apps/tensorboards/frontend/cypress/).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.tensorboard import (
+    TensorboardReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.tensorboards.app import (
+    build_app,
+)
+
+from e2e_common import Browser, serve, wait
+
+NS = "team-a"
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    kube.create("namespaces", {"metadata": {"name": NS}})
+    mgr = Manager(kube)
+    TensorboardReconciler(kube).register(mgr)
+    mgr.start()
+    httpd, base = serve(build_app(kube, mode="dev"))
+    yield kube, Browser(base)
+    httpd.shutdown()
+    mgr.stop()
+
+
+def _row(browser, name):
+    rows = browser.request(
+        "GET", f"/api/namespaces/{NS}/tensorboards")["tensorboards"]
+    for row in rows:
+        if row["name"] == name:
+            return row
+    return None
+
+
+def _deployment(kube, name):
+    try:
+        return kube.get("deployments", name, namespace=NS, group="apps")
+    except errors.NotFound:
+        return None
+
+
+def test_full_tensorboard_lifecycle_over_http(world):
+    kube, browser = world
+
+    index = browser.request("GET", "/")
+    assert b"<!doctype html" in index[:200].lower()
+    assert "XSRF-TOKEN" in browser.cookies
+
+    # the form's PVC picker lists claims in the namespace
+    kube.create("persistentvolumeclaims", {
+        "metadata": {"name": "logs-pvc", "namespace": NS},
+        "spec": {"accessModes": ["ReadWriteOnce"],
+                 "resources": {"requests": {"storage": "1Gi"}}},
+    })
+    pvcs = browser.request("GET", f"/api/namespaces/{NS}/pvcs")["pvcs"]
+    assert pvcs == ["logs-pvc"]
+
+    # create → live controller materializes the Deployment
+    browser.request("POST", f"/api/namespaces/{NS}/tensorboards", {
+        "name": "e2e-tb", "logspath": "pvc://logs-pvc/train",
+    })
+    row = _row(browser, "e2e-tb")
+    assert row["logspath"] == "pvc://logs-pvc/train"
+    assert row["status"]["phase"] == "waiting"
+    assert wait(lambda: _deployment(kube, "e2e-tb") is not None), (
+        "controller never materialized the Deployment"
+    )
+    dep = _deployment(kube, "e2e-tb")
+    vols = dep["spec"]["template"]["spec"]["volumes"]
+    assert any((v.get("persistentVolumeClaim") or {}).get("claimName")
+               == "logs-pvc" for v in vols), "logspath PVC must be mounted"
+
+    # play the deployment controller → CR status mirrors ready
+    dep.setdefault("status", {}).update({
+        "replicas": 1, "readyReplicas": 1,
+        "conditions": [{"type": "Available",
+                        "lastUpdateTime": "2026-07-30T00:00:00Z"}],
+    })
+    kube.update_status("deployments", dep, group="apps")
+    assert wait(lambda: _row(browser, "e2e-tb")["status"]["phase"]
+                == "ready"), _row(browser, "e2e-tb")
+
+    # delete: CR gone, Deployment cascades via owner refs
+    browser.request("DELETE", f"/api/namespaces/{NS}/tensorboards/e2e-tb")
+    assert _row(browser, "e2e-tb") is None
+    assert wait(lambda: _deployment(kube, "e2e-tb") is None), (
+        "Deployment must cascade with the CR"
+    )
+
+
+def test_create_validates_fields_over_http(world):
+    _, browser = world
+    browser.request("GET", "/")  # csrf
+    browser.request("POST", f"/api/namespaces/{NS}/tensorboards",
+                    {"name": "no-logspath"}, expect=400)
+    assert _row(browser, "no-logspath") is None

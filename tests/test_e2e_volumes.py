@@ -1,0 +1,179 @@
+"""E2E lane: the REAL volumes web app over HTTP with the PVCViewer
+controller live — create PVC → bound → launch viewer → viewer ready (full
+CR → reconcile → Deployment → status loop) → delete blocked while a
+non-viewer pod mounts the PVC → viewer-only → delete cascades. Mirrors the
+reference's VWA Cypress flow (components/crud-web-apps/volumes/frontend/
+cypress/) with urllib playing the browser.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.pvcviewer import (
+    PVCViewerReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import Manager
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webapps.volumes.app import (
+    build_app,
+)
+
+from e2e_common import Browser, serve, wait
+
+NS = "team-a"
+VIEWER_PREFIX = "pvcviewer-"
+
+
+@pytest.fixture()
+def world():
+    kube = FakeKube()
+    kube.create("namespaces", {"metadata": {"name": NS}})
+    mgr = Manager(kube)
+    PVCViewerReconciler(kube).register(mgr)
+    mgr.start()
+    httpd, base = serve(build_app(kube, mode="dev"))
+    yield kube, Browser(base)
+    httpd.shutdown()
+    mgr.stop()
+
+
+def _row(browser, name):
+    rows = browser.request("GET", f"/api/namespaces/{NS}/pvcs")["pvcs"]
+    for row in rows:
+        if row["name"] == name:
+            return row
+    return None
+
+
+def _bind(kube, name):
+    pvc = kube.get("persistentvolumeclaims", name, namespace=NS)
+    pvc.setdefault("status", {})["phase"] = "Bound"
+    kube.update_status("persistentvolumeclaims", pvc)
+
+
+def _viewer_deployment(kube, name):
+    try:
+        return kube.get("deployments", VIEWER_PREFIX + name, namespace=NS,
+                        group="apps")
+    except errors.NotFound:
+        return None
+
+
+def _mk_pod(kube, pod_name, pvc, labels=None):
+    kube.create("pods", {
+        "metadata": {"name": pod_name, "namespace": NS,
+                     "labels": labels or {}},
+        "spec": {
+            "containers": [{"name": "main", "image": "img"}],
+            "volumes": [{"name": "data",
+                         "persistentVolumeClaim": {"claimName": pvc}}],
+        },
+        "status": {"phase": "Running"},
+    })
+
+
+def test_full_volume_lifecycle_over_http(world):
+    kube, browser = world
+
+    # SPA boots and sets the CSRF cookie
+    index = browser.request("GET", "/")
+    assert b"<!doctype html" in index[:200].lower()
+    assert "XSRF-TOKEN" in browser.cookies
+
+    # create from the form
+    browser.request("POST", f"/api/namespaces/{NS}/pvcs", {
+        "name": "e2e-vol", "mode": "ReadWriteOnce", "size": "5Gi",
+        "class": "{empty}",
+    })
+    row = _row(browser, "e2e-vol")
+    assert row["capacity"] == "5Gi"
+    assert row["status"]["phase"] == "waiting"  # unbound yet
+    assert row["viewer"]["status"] == "uninitialized"
+
+    # storage controller binds it → ready
+    _bind(kube, "e2e-vol")
+    assert wait(lambda: _row(browser, "e2e-vol")["status"]["phase"]
+                == "ready")
+
+    # launch a viewer: the live controller materializes the Deployment
+    browser.request("POST", f"/api/namespaces/{NS}/viewers",
+                    {"name": "e2e-vol"})
+    assert wait(lambda: _viewer_deployment(kube, "e2e-vol") is not None), (
+        "controller never materialized the viewer Deployment"
+    )
+    assert wait(lambda: _row(browser, "e2e-vol")["viewer"]["status"]
+                == "waiting")
+
+    # play the deployment controller: ready replicas → viewer ready + URL
+    dep = _viewer_deployment(kube, "e2e-vol")
+    dep.setdefault("status", {}).update(
+        {"replicas": 1, "readyReplicas": 1}
+    )
+    kube.update_status("deployments", dep, group="apps")
+    assert wait(lambda: _row(browser, "e2e-vol")["viewer"]["status"]
+                == "ready")
+    assert _row(browser, "e2e-vol")["viewer"]["url"].endswith(
+        f"/{NS}/e2e-vol/"
+    )
+
+    # events for the PVC surface over the events route
+    kube.create("events", {
+        "metadata": {"name": "ev1", "namespace": NS},
+        "involvedObject": {"kind": "PersistentVolumeClaim",
+                           "name": "e2e-vol"},
+        "reason": "ProvisioningSucceeded", "type": "Normal",
+        "message": "ok", "lastTimestamp": "2026-07-30T00:00:00Z",
+    })
+    evs = browser.request(
+        "GET", f"/api/namespaces/{NS}/pvcs/e2e-vol/events")["events"]
+    assert [e["reason"] for e in evs] == ["ProvisioningSucceeded"]
+
+    # a notebook pod mounts the PVC → delete must refuse (409) and show it
+    _mk_pod(kube, "nb-0", "e2e-vol", labels={"notebook-name": "nb"})
+    pods = browser.request(
+        "GET", f"/api/namespaces/{NS}/pvcs/e2e-vol/pods")["pods"]
+    assert {p["metadata"]["name"] for p in pods} == {"nb-0"}
+    browser.request("DELETE", f"/api/namespaces/{NS}/pvcs/e2e-vol",
+                    expect=409)
+    assert _row(browser, "e2e-vol") is not None, "PVC must survive the 409"
+
+    # only the viewer pod left → delete tears down viewer then the PVC
+    kube.delete("pods", "nb-0", namespace=NS)
+    _mk_pod(kube, "pvcviewer-e2e-vol-0", "e2e-vol", labels={
+        "app.kubernetes.io/part-of": "pvcviewer",
+        "app.kubernetes.io/name": "e2e-vol",
+    })
+    browser.request("DELETE", f"/api/namespaces/{NS}/pvcs/e2e-vol")
+    assert _row(browser, "e2e-vol") is None
+    assert wait(lambda: not _viewer_exists(kube, "e2e-vol")), (
+        "PVCViewer CR must be deleted with the PVC"
+    )
+
+
+def _viewer_exists(kube, name):
+    try:
+        kube.get("pvcviewers", name, namespace=NS, group="tpukf.dev")
+        return True
+    except errors.NotFound:
+        return False
+
+
+def test_viewer_delete_over_http(world):
+    kube, browser = world
+    browser.request("GET", "/")  # csrf
+    browser.request("POST", f"/api/namespaces/{NS}/pvcs", {
+        "name": "v2", "mode": "ReadWriteOnce", "size": "1Gi",
+    })
+    _bind(kube, "v2")
+    browser.request("POST", f"/api/namespaces/{NS}/viewers", {"name": "v2"})
+    assert wait(lambda: _viewer_deployment(kube, "v2") is not None)
+    browser.request("DELETE", f"/api/namespaces/{NS}/viewers/v2")
+    assert wait(lambda: not _viewer_exists(kube, "v2"))
+    # Deployment cascades via owner refs (FakeKube GC)
+    assert wait(lambda: _viewer_deployment(kube, "v2") is None), (
+        "viewer Deployment must cascade with the CR"
+    )

@@ -43,3 +43,33 @@ def test_opt_state_sharding_mirrors_params():
     p_sh = sh.params["layers"]["wq"]
     mu_sh = sh.opt_state[1][0].mu["layers"]["wq"]
     assert p_sh.spec == mu_sh.spec
+
+
+def test_mixed_precision_state_descends():
+    """bf16 master params + bf16 first moment (make_optimizer mu_dtype):
+    the memory-lean configuration must still train — loss drops on a
+    repeated batch and the moments actually live in bf16."""
+    from service_account_auth_improvements_tpu.train.step import (
+        make_optimizer,
+    )
+
+    cfg = dataclasses.replace(CFG, param_dtype="bfloat16", loss_chunk=8)
+    opt = make_optimizer(mu_dtype="bfloat16")
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, optimizer=opt, mesh=mesh)
+
+    mus = [x for x in jax.tree.leaves(state.opt_state)
+           if hasattr(x, "dtype") and x.dtype == jnp.bfloat16 and x.ndim > 0]
+    assert mus, "first moment must be stored bf16"
+
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                cfg.vocab_size)
+    mask = jnp.ones_like(tokens)
+    with jax.set_mesh(mesh):
+        state, m0 = step(state, tokens, mask)
+        for _ in range(5):
+            state, m = step(state, tokens, mask)
+    assert bool(jnp.isfinite(m["loss"]))
+    assert float(m["loss"]) < float(m0["loss"])

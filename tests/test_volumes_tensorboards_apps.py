@@ -160,3 +160,41 @@ def test_twa_lifecycle(kube):
     out = call(app, "POST", "/api/namespaces/u1/tensorboards",
                {"name": "bad"})
     assert out["code"] == 400
+
+
+def test_vwa_single_pvc_route(kube):
+    """Details drawer source: raw PVC via GET (reference VWA
+    routes/get.py get_pvc)."""
+    app = build_vwa(kube, mode="prod")
+    call(app, "POST", "/api/namespaces/u1/pvcs", {
+        "name": "v1", "mode": "ReadWriteOnce", "size": "2Gi",
+    })
+    out = call(app, "GET", "/api/namespaces/u1/pvcs/v1")
+    assert out["code"] == 200
+    assert out["body"]["pvc"]["metadata"]["name"] == "v1"
+    assert out["body"]["pvc"]["spec"]["accessModes"] == ["ReadWriteOnce"]
+    assert call(app, "GET",
+                "/api/namespaces/u1/pvcs/ghost")["code"] == 404
+
+
+def test_twa_details_route(kube):
+    """Details drawer source: raw CR + controller events."""
+    app = build_twa(kube, mode="prod")
+    call(app, "POST", "/api/namespaces/u1/tensorboards", {
+        "name": "tb1", "logspath": "pvc://logs/run1",
+    })
+    kube.create("events", {
+        "metadata": {"name": "e1", "namespace": "u1"},
+        "involvedObject": {"kind": "Tensorboard", "name": "tb1"},
+        "reason": "CreatedDeployment", "type": "Normal",
+        "message": "Created Deployment u1/tb1",
+        "lastTimestamp": "2026-07-30T00:00:00Z",
+    })
+    out = call(app, "GET", "/api/namespaces/u1/tensorboards/tb1")
+    assert out["code"] == 200
+    assert out["body"]["tensorboard"]["spec"]["logspath"] == "pvc://logs/run1"
+    assert [e["reason"] for e in out["body"]["events"]] == [
+        "CreatedDeployment"
+    ]
+    assert call(app, "GET",
+                "/api/namespaces/u1/tensorboards/ghost")["code"] == 404
