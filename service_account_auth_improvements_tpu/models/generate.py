@@ -1,0 +1,213 @@
+"""Autoregressive generation with a static-shape KV cache, TPU-first.
+
+Design for XLA, not for Python: the whole decode loop is ONE jitted
+``lax.scan`` over token positions — no per-token retracing, no dynamic
+shapes. The KV cache is preallocated ``[L, b, max_len, kv_heads, hd]``
+and written in place with ``dynamic_update_slice``; attention at decode
+time is a masked dense read over the cache (one [b, h, max_len] row per
+step — at decode shapes the mask trick is cheaper than any gather, and
+GQA means the cache holds kv_heads, not heads).
+
+Prefill reuses the training forward: ``_backbone(return_layer_inputs=...)``
+yields every layer's input hidden states, and each layer's K/V for the
+whole prompt comes from one batched ``[L,b,s,d]×[L,d,kv]`` einsum — the
+MXU-shaped formulation — instead of threading cache plumbing through the
+training code path.
+
+The reference has no inference surface at all (SURVEY.md §2b: its
+accelerator story is a resource-limits string); this is net-new TPU
+surface completing the model family's lifecycle (train → checkpoint →
+serve from a notebook).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+import dataclasses
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.ops.norms import rms_norm
+from service_account_auth_improvements_tpu.ops.rotary import apply_rope, rope_table
+
+
+def _inference_cfg(cfg: llama.LlamaConfig) -> llama.LlamaConfig:
+    """Inference uses DROPLESS MoE routing (capacity = group size, so no
+    token ever falls through to the residual). Training's capacity drops
+    are not prefix-stable — a token kept at sequence length s can be
+    dropped at s+1 because capacity grows with the group — so a KV cache
+    cannot reproduce them incrementally; dropless routing is both
+    causally consistent and the standard serving choice."""
+    if not cfg.moe_experts:
+        return cfg
+    return dataclasses.replace(
+        cfg, moe_capacity_factor=float(cfg.moe_experts)
+    )
+
+
+class KVCache(NamedTuple):
+    k: jax.Array      # [L, b, max_len, kv_heads, head_dim]
+    v: jax.Array      # [L, b, max_len, kv_heads, head_dim]
+    length: jax.Array  # [] int32 — filled positions (same for the batch)
+
+
+def _rope_at(x, cos, sin, pos):
+    """apply_rope for one dynamic position: x [b, 1, h, d]; pos scalar.
+    Delegates to ops.rotary.apply_rope on 1-row table slices so any
+    convention change there propagates to decode."""
+    return apply_rope(
+        x,
+        jax.lax.dynamic_slice_in_dim(cos, pos, 1),
+        jax.lax.dynamic_slice_in_dim(sin, pos, 1),
+    )
+
+
+def prefill(cfg: llama.LlamaConfig, params, tokens, max_len: int):
+    """Run the prompt through the model once; returns (cache, last_logits).
+
+    tokens [b, s] int32 (no padding — pad/left-trim upstream); the cache
+    is sized ``max_len`` and holds the prompt's K/V in [:s].
+    """
+    cfg = _inference_cfg(cfg)
+    b, s = tokens.shape
+    assert s <= max_len, (s, max_len)
+    cdt = jnp.dtype(cfg.dtype)
+    x, _, layer_inputs = llama._backbone(
+        cfg, params, tokens, return_layer_inputs=True
+    )
+    # every layer's k/v from the saved layer inputs, one einsum each
+    lp = params["layers"]
+    h = jax.vmap(
+        lambda xi, g: rms_norm(xi, g.astype(cdt), cfg.norm_eps)
+    )(layer_inputs, lp["attn_norm"])
+    k = jnp.einsum("lbsd,ldk->lbsk", h, lp["wk"].astype(cdt)).reshape(
+        cfg.n_layers, b, s, cfg.n_kv_heads, cfg.head_dim
+    )
+    v = jnp.einsum("lbsd,ldk->lbsk", h, lp["wv"].astype(cdt)).reshape(
+        cfg.n_layers, b, s, cfg.n_kv_heads, cfg.head_dim
+    )
+    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
+    k = jax.vmap(lambda kl: llama.apply_rope(kl, cos, sin))(k)
+
+    pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
+    cache = KVCache(
+        k=jnp.pad(k, pad), v=jnp.pad(v, pad),
+        length=jnp.asarray(s, jnp.int32),
+    )
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, -1], params["lm_head"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return cache, logits
+
+
+def _decode_layer(cfg, x, lp, ck, cv, pos, cos, sin):
+    """One layer, one position: x [b, 1, d]; ck/cv [b, max_len, kvh, hd].
+    Returns (x, new_ck, new_cv)."""
+    b = x.shape[0]
+    cdt = jnp.dtype(cfg.dtype)
+    max_len = ck.shape[1]
+
+    h = rms_norm(x, lp["attn_norm"].astype(cdt), cfg.norm_eps)
+    q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads,
+                                           cfg.head_dim)
+    v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads,
+                                           cfg.head_dim)
+    q = _rope_at(q, cos, sin, pos)
+    k = _rope_at(k, cos, sin, pos)
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+
+    g = cfg.n_heads // cfg.n_kv_heads
+    qg = q[:, 0].reshape(b, cfg.n_kv_heads, g, cfg.head_dim)
+    scores = jnp.einsum(
+        "bkgd,bskd->bkgs", qg.astype(cdt), ck,
+        preferred_element_type=jnp.float32,
+    ) * (cfg.head_dim ** -0.5)                    # [b, kvh, g, max_len]
+    mask = jnp.arange(max_len) <= pos
+    scores = jnp.where(mask[None, None, None, :], scores, -2.0e38)
+    probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
+    attn = jnp.einsum("bkgs,bskd->bkgd", probs, cv)   # [b, kvh, g, hd]
+    attn = attn.reshape(b, 1, cfg.q_dim)
+    x = x + attn @ lp["wo"].astype(cdt)
+
+    h = rms_norm(x, lp["mlp_norm"].astype(cdt), cfg.norm_eps)
+    if cfg.moe_experts:
+        ff, _ = llama._moe_ffn(cfg, h, lp)
+        x = x + ff
+    else:
+        gate = jax.nn.silu(h @ lp["w_gate"].astype(cdt))
+        up = h @ lp["w_up"].astype(cdt)
+        x = x + (gate * up) @ lp["w_down"].astype(cdt)
+    return x, ck, cv
+
+
+def _decode_step(cfg, params, cache: KVCache, token, cos, sin):
+    """token [b] int32 at position cache.length → (cache', logits [b,V])."""
+    cdt = jnp.dtype(cfg.dtype)
+    pos = cache.length
+    x = jnp.take(params["tok_embed"], token[:, None], axis=0,
+                 mode="clip").astype(cdt)
+
+    def body(x, layer):
+        lp, ck, cv = layer
+        x, ck, cv = _decode_layer(cfg, x, lp, ck, cv, pos, cos, sin)
+        return x, (ck, cv)
+
+    x, (k, v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    logits = jnp.einsum(
+        "bd,dv->bv", x[:, 0], params["lm_head"].astype(cdt),
+        preferred_element_type=jnp.float32,
+    )
+    return KVCache(k=k, v=v, length=pos + 1), logits
+
+
+def _sample(logits, key, temperature: float, top_k: int):
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        thresh = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < thresh, -2.0e38, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
+                                   "top_k"))
+def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
+             key=None, temperature: float = 0.0, top_k: int = 0):
+    """prompt [b, s] → [b, s + max_new_tokens]. Greedy when temperature=0.
+
+    One compile per (shape, cfg): prefill + a single scan over the new
+    positions. EOS handling is left to the caller (slice at the first
+    eos id) — keeping the loop free of data-dependent control flow is
+    what keeps it one fused XLA while-loop on TPU. MoE models route
+    dropless at inference (see ``_inference_cfg``).
+    """
+    cfg = _inference_cfg(cfg)
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    if key is None:
+        key = jax.random.key(0)
+    cache, logits = prefill(cfg, params, prompt, max_len)
+    cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta)
+    first = _sample(logits, key, temperature, top_k)
+
+    def body(carry, step_key):
+        cache, token = carry
+        cache, logits = _decode_step(cfg, params, cache, token, cos, sin)
+        nxt = _sample(logits, step_key, temperature, top_k)
+        return (cache, nxt), nxt
+
+    # max_new_tokens - 1 decode steps: `first` came from prefill, and the
+    # final position's logits are never consumed, so a full-length scan
+    # would run one L-layer decode whose output is discarded
+    keys = jax.random.split(key, max_new_tokens - 1)
+    _, toks = jax.lax.scan(body, (cache, first), keys)
+    return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)

@@ -384,11 +384,16 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
     return shard_constraint(x, ("batch", "seq", None)), aux
 
 
-def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None):
+def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
+              return_layer_inputs: bool = False):
     """Embed + decoder stack + final norm: tokens [b, s] → (x [b, s, dim]
     in compute dtype, MoE aux loss). The lm_head projection is applied by
     the caller (``apply`` for full logits, ``next_token_loss`` possibly in
-    chunks)."""
+    chunks). With ``return_layer_inputs`` also returns the per-layer
+    input hidden states [L, b, s, dim] — the KV-cache prefill source
+    (models/generate.py recomputes each layer's k/v from them with one
+    batched einsum instead of threading cache plumbing through the
+    training forward)."""
     cdt = jnp.dtype(cfg.dtype)
     s = tokens.shape[1]
     if cfg.iota_embed:
@@ -422,21 +427,33 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None):
                 f"{sorted(policies)} or 'none'"
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policies[cfg.remat_policy])
+    layer_inputs = None
     if cfg.scan_layers:
-        x, aux_stack = jax.lax.scan(
-            lambda carry, lp: layer_fn(carry, lp, cos, sin, token_mask),
-            x,
-            params["layers"],
-        )
+        def body(carry, lp):
+            new_x, aux = layer_fn(carry, lp, cos, sin, token_mask)
+            ys = (aux, carry) if return_layer_inputs else aux
+            return new_x, ys
+        x, ys = jax.lax.scan(body, x, params["layers"])
+        if return_layer_inputs:
+            aux_stack, layer_inputs = ys
+        else:
+            aux_stack = ys
         aux = jnp.sum(aux_stack)
     else:
         aux = jnp.zeros((), jnp.float32)
+        inputs = []
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda a: a[i], params["layers"])
+            if return_layer_inputs:
+                inputs.append(x)
             x, layer_aux = layer_fn(x, lp, cos, sin, token_mask)
             aux = aux + layer_aux
+        if return_layer_inputs:
+            layer_inputs = jnp.stack(inputs)
 
     x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
+    if return_layer_inputs:
+        return x, aux, layer_inputs
     return x, aux
 
 

@@ -1,4 +1,5 @@
-"""Training loop machinery: sharded train step, optimizer, MFU accounting."""
+"""Training loop machinery: sharded train step, optimizer, MFU accounting,
+sharding-aware checkpoint/resume (train.checkpoint)."""
 
 from service_account_auth_improvements_tpu.train.step import (  # noqa: F401
     TrainState,

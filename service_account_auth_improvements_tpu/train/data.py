@@ -1,0 +1,97 @@
+"""Input pipeline: deterministic sharded token batches.
+
+A flat token array (memmap-friendly: pass ``np.memmap`` for corpora
+bigger than RAM) is cut into fixed ``[batch, seq]`` windows; each host
+materializes ONLY its slice of the global batch (per-process slicing by
+``jax.process_index``), and ``device_put`` lays the shards onto the mesh
+with the same ("dp","fsdp") batch sharding the train step expects — no
+host ever holds the global batch, which is what lets the pipeline scale
+to multi-host DCN topologies.
+
+Determinism: batch order is a pure function of (epoch seed, step), so a
+restored checkpoint resumes mid-epoch on the exact batch sequence it
+would have seen uninterrupted (pairs with train.checkpoint).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    batch: int          # GLOBAL batch (all hosts, all dp*fsdp shards);
+    seq: int            # the trailing sub-batch epoch remainder is dropped
+    shuffle: bool = True
+    seed: int = 0
+
+
+class TokenBatches:
+    """Iterable over sharded [batch, seq] int32 device arrays (+ mask)."""
+
+    def __init__(self, tokens, cfg: DataConfig, mesh: Mesh,
+                 process_index: int | None = None,
+                 process_count: int | None = None):
+        self.tokens = tokens
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pi = (jax.process_index() if process_index is None
+                   else process_index)
+        self.pc = (jax.process_count() if process_count is None
+                   else process_count)
+        if cfg.batch % self.pc:
+            raise ValueError(
+                f"global batch {cfg.batch} must divide over "
+                f"{self.pc} processes"
+            )
+        self.n_windows = len(tokens) // cfg.seq
+        self.steps_per_epoch = self.n_windows // cfg.batch
+        if not self.steps_per_epoch:
+            raise ValueError(
+                f"{len(tokens)} tokens < one global batch "
+                f"({cfg.batch}×{cfg.seq})"
+            )
+        self._sharding = NamedSharding(mesh, P(("dp", "fsdp"), None))
+        self._order_cache: tuple[int, np.ndarray] | None = None
+
+    def _order(self, epoch: int) -> np.ndarray:
+        """Epoch permutation, cached: O(n_windows) once per epoch, not per
+        step (a memmap-scale corpus has millions of windows)."""
+        if not self.cfg.shuffle:
+            return np.arange(self.n_windows)
+        if self._order_cache is None or self._order_cache[0] != epoch:
+            rng = np.random.default_rng((self.cfg.seed, epoch))
+            self._order_cache = (epoch, rng.permutation(self.n_windows))
+        return self._order_cache[1]
+
+    def batch_at(self, step: int) -> jax.Array:
+        """The global step's batch, this process's shard, device-put with
+        the train step's batch sharding. Pure in ``step`` — the resume
+        contract."""
+        epoch, within = divmod(step, self.steps_per_epoch)
+        order = self._order(epoch)
+        window_ids = order[within * self.cfg.batch:
+                           (within + 1) * self.cfg.batch]
+        per_proc = self.cfg.batch // self.pc
+        mine = window_ids[self.pi * per_proc:(self.pi + 1) * per_proc]
+        rows = np.stack([
+            np.asarray(self.tokens[w * self.cfg.seq:
+                                   (w + 1) * self.cfg.seq])
+            for w in mine
+        ]).astype(np.int32)
+        if self.pc == 1:
+            return jax.device_put(rows, self._sharding)
+        # multi-host: assemble the global logical array from local shards
+        return jax.make_array_from_process_local_data(
+            self._sharding, rows, (self.cfg.batch, self.cfg.seq)
+        )
+
+    def __iter__(self):
+        step = 0
+        while True:
+            yield self.batch_at(step)
+            step += 1

@@ -1,0 +1,111 @@
+"""Perf sweep for the MFU search (VERDICT r4 #2): runs bench.py's child
+across {remat_policy × loss_chunk × batch × mu/param dtype} points and
+prints one result line per point plus the best configuration.
+
+Usage (on the TPU box):
+    python tools/sweep.py                 # default grid, bench_800m
+    python tools/sweep.py --preset bench_400m --points quick
+
+Each point runs in a fresh subprocess (the TPU runtime wants one client,
+and a crashed point must not take the sweep down). Results also land in
+SWEEP.json for BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# (remat_policy, loss_chunk, batch, mu_dtype, param_dtype)
+GRIDS = {
+    # the axes most likely to move MFU, one at a time from the r4 baseline
+    "quick": [
+        ("full", 512, 8, "", ""),            # r5 default (chunked CE)
+        ("full", 0, 8, "", ""),              # r4 baseline control
+        ("full", 512, 12, "", ""),           # bigger batch w/ freed HBM
+        ("full", 512, 16, "", ""),
+        ("full", 512, 8, "bfloat16", ""),    # lean first moment
+        ("dots_saveable", 512, 4, "bfloat16", "bfloat16"),  # no-recompute
+        ("dots_saveable", 512, 8, "bfloat16", "bfloat16"),
+    ],
+    "full": [
+        (rp, lc, b, mu, pd)
+        for rp in ("full", "dots_saveable")
+        for lc in (0, 256, 512, 1024)
+        for b in (8, 12, 16)
+        for mu in ("", "bfloat16")
+        for pd in ("",)
+    ],
+}
+
+
+def run_point(preset, rp, lc, batch, mu, pd, timeout):
+    env = dict(
+        os.environ,
+        SATPU_BENCH_CHILD="1",
+        SATPU_BENCH_PRESET=preset,
+        SATPU_BENCH_MATRIX="0",
+        SATPU_BENCH_REMAT_POLICY=rp,
+        SATPU_BENCH_LOSS_CHUNK=str(lc),
+        SATPU_BENCH_BATCH=str(batch),
+    )
+    if mu:
+        env["SATPU_BENCH_MU_DTYPE"] = mu
+    if pd:
+        env["SATPU_BENCH_PARAM_DTYPE"] = pd
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "bench.py")],
+            env=env, cwd=ROOT, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout"}
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or proc.stdout)[-300:]}
+    lines = [l for l in proc.stdout.splitlines() if l.lstrip().startswith("{")]
+    return json.loads(lines[-1]) if lines else {"error": "no output"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="bench_800m")
+    ap.add_argument("--points", default="quick", choices=sorted(GRIDS))
+    ap.add_argument("--timeout", type=float, default=900.0)
+    args = ap.parse_args()
+
+    results = []
+    for rp, lc, batch, mu, pd in GRIDS[args.points]:
+        tag = (f"remat={rp} chunk={lc} b={batch} "
+               f"mu={mu or 'f32'} pdt={pd or 'f32'}")
+        out = run_point(args.preset, rp, lc, batch, mu, pd, args.timeout)
+        row = {"remat": rp, "loss_chunk": lc, "batch": batch,
+               "mu_dtype": mu or "float32",
+               "param_dtype": pd or "float32", **out}
+        results.append(row)
+        if "error" in out:
+            print(f"{tag:55s} ERROR {out['error'][:80]}")
+        else:
+            print(f"{tag:55s} {out['value']:>9.1f} tok/s  "
+                  f"mfu={out['mfu']:.4f}")
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["mfu"])
+        print(f"\nbest: mfu={best['mfu']:.4f} "
+              f"remat={best['remat']} chunk={best['loss_chunk']} "
+              f"b={best['batch']} mu={best['mu_dtype']} "
+              f"pdt={best['param_dtype']}")
+    (ROOT / "SWEEP.json").write_text(json.dumps(
+        {"preset": args.preset, "results": results}, indent=1))
+    print(f"wrote {ROOT / 'SWEEP.json'}")
+
+
+if __name__ == "__main__":
+    main()
