@@ -175,7 +175,10 @@ def test_js_suites_execute_under_node(tmp_path):
     import shutil
     import subprocess
 
-    node = shutil.which("node")
+    # any CommonJS-capable runtime will do (run.js uses require())
+    node = next(
+        (p for b in ("node", "bun") if (p := shutil.which(b))), None
+    )
     if node is None:
         pytest.skip("no JS runtime in this image (CI runs the node lane)")
     proc = subprocess.run(
@@ -195,7 +198,7 @@ def test_js_suites_execute_under_node(tmp_path):
     )
     record.write_text(
         f"commit: {sha or 'unknown'}\n"
-        f"runtime: node\n"
+        f"runtime: {os.path.basename(node)}\n"
         f"lines: {len(lines)}\npassed: {passed}\n"
         + "\n".join(lines[-3:]) + "\n"
     )
