@@ -25,6 +25,10 @@ import jax.numpy as jnp
 from service_account_auth_improvements_tpu.ops.attention import multi_head_attention
 from service_account_auth_improvements_tpu.ops.norms import rms_norm
 from service_account_auth_improvements_tpu.ops.rotary import apply_rope, rope_table
+from service_account_auth_improvements_tpu.parallel.pipeline import (
+    pipeline_layers,
+    pipeline_stages,
+)
 from service_account_auth_improvements_tpu.parallel.sharding import shard_constraint
 
 
@@ -79,6 +83,10 @@ class LlamaConfig:
     #                     elementwise ops (highest memory, ~3× FLOPs);
     #   "none"          — no remat (scan still saves per-layer residuals).
     remat_policy: str = "full"
+    # Pipeline parallelism: when the ambient mesh has pp > 1, the decoder
+    # stack runs through parallel/pipeline.py with this many microbatches
+    # (0 = 2·pp, clamped to batch). Ignored on pp=1 meshes.
+    pp_microbatches: int = 0
 
     def moe_cap(self, group: int) -> int:
         """Per-group expert capacity."""
@@ -428,7 +436,29 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
             )
         layer_fn = jax.checkpoint(layer_fn, policy=policies[cfg.remat_policy])
     layer_inputs = None
-    if cfg.scan_layers:
+    if pipeline_stages() > 1:
+        # pp>1 mesh: the stacked layers are stage-sharded over pp (rule
+        # "layers": "pp"); the plain scan would force an all-gather of
+        # every stage's slab onto every device. Route through the
+        # microbatched ppermute pipeline instead.
+        if return_layer_inputs:
+            raise ValueError(
+                "KV-cache prefill (return_layer_inputs) is not supported "
+                "under pipeline parallelism; run generation on a pp=1 mesh"
+            )
+        # cos/sin are position tables (no batch dim) — plain consts; the
+        # token mask is per-token and must follow its microbatch through
+        # the stages. _layer's trailing arg order matches the
+        # (*consts, *batched_consts) call convention.
+        if token_mask is None:
+            consts, batched = (cos, sin, None), ()
+        else:
+            consts, batched = (cos, sin), (token_mask,)
+        x, aux = pipeline_layers(
+            layer_fn, params["layers"], x, consts, batched,
+            n_micro=cfg.pp_microbatches,
+        )
+    elif cfg.scan_layers:
         def body(carry, lp):
             new_x, aux = layer_fn(carry, lp, cos, sin, token_mask)
             ys = (aux, carry) if return_layer_inputs else aux

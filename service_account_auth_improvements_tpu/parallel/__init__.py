@@ -2,9 +2,10 @@
 
 The reference contains no ML parallelism machinery (SURVEY.md §2b) — this
 subpackage is the net-new TPU-native surface: a ``jax.sharding.Mesh`` with
-dp/fsdp/tp/sp/ep axes, logical-axis sharding rules resolved to
-``PartitionSpec``s, ring attention for sequence/context parallelism, and
-multi-host bootstrap from the ``TPU_WORKER_*`` env the control plane injects.
+dp/pp/fsdp/tp/sp/ep axes, logical-axis sharding rules resolved to
+``PartitionSpec``s, ring attention for sequence/context parallelism, a
+GPipe-style layer pipeline over ``pp``, and multi-host bootstrap from the
+``TPU_WORKER_*`` env the control plane injects.
 """
 
 from service_account_auth_improvements_tpu.parallel.mesh import (  # noqa: F401
@@ -12,6 +13,10 @@ from service_account_auth_improvements_tpu.parallel.mesh import (  # noqa: F401
     MeshConfig,
     make_mesh,
     make_multislice_mesh,
+)
+from service_account_auth_improvements_tpu.parallel.pipeline import (  # noqa: F401
+    pipeline_layers,
+    pipeline_stages,
 )
 from service_account_auth_improvements_tpu.parallel.sharding import (  # noqa: F401
     DEFAULT_RULES,

@@ -10,6 +10,7 @@ mesh layout recipe from the public scaling literature.
 
 Axes:
   dp    pure data parallel (gradient all-reduce; DCN-friendly across slices)
+  pp    pipeline parallel (layer-stage ppermute ring, `parallel/pipeline.py`)
   fsdp  data parallel with parameter/optimizer sharding (ZeRO-3 style)
   tp    tensor (megatron-style) parallel over heads / mlp dim
   sp    sequence/context parallel (ring attention, `parallel/ring.py`)
@@ -25,8 +26,10 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-# Canonical axis order, outermost first.
-MESH_AXES: tuple[str, ...] = ("dp", "fsdp", "sp", "tp", "ep")
+# Canonical axis order, outermost first. pp sits between dp and fsdp:
+# stage handoffs are one activation per tick (latency-tolerant, fine on
+# slower links), while fsdp/tp all-gathers want the innermost ICI.
+MESH_AXES: tuple[str, ...] = ("dp", "pp", "fsdp", "sp", "tp", "ep")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,10 +41,12 @@ class MeshConfig:
     tp: int = 1
     sp: int = 1
     ep: int = 1
+    pp: int = 1
 
     def sizes(self) -> dict[str, int]:
         return {
             "dp": self.dp,
+            "pp": self.pp,
             "fsdp": self.fsdp,
             "sp": self.sp,
             "tp": self.tp,
@@ -106,5 +111,7 @@ def make_multislice_mesh(
 
 
 def single_device_mesh() -> Mesh:
-    """A 1×1×1×1×1 mesh on the first device (bench / single-chip paths)."""
-    return make_mesh(MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1])
+    """An all-ones mesh on the first device (bench / single-chip paths)."""
+    return make_mesh(
+        MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1, pp=1), jax.devices()[:1]
+    )

@@ -1,0 +1,180 @@
+"""GPipe-style pipeline parallelism over the ``pp`` mesh axis.
+
+Each pipeline stage owns a contiguous slab of the stacked decoder layers
+(the layer stack is sharded over ``pp`` on its leading axis — a rule-table
+entry, not a model change). The global batch is split into microbatches;
+every tick each stage applies its slab to its resident microbatch and
+hands the activation to the next stage with a single ``ppermute`` hop —
+on a real slice that hop is one ICI neighbour transfer. The whole
+schedule is one traced ``lax.scan`` of ``n_micro + n_stages - 1`` ticks
+(static shapes, no data-dependent control flow), and the backward pass
+falls out of AD: reverse-mode turns each ``ppermute`` into its inverse
+permute, so the 1F1B-ish reverse schedule needs no hand scheduling.
+
+Composition with the other axes is free: the ``shard_map`` is *manual
+only over pp* (``axis_names={'pp'}``), so dp/fsdp batch sharding, tp
+head/mlp sharding, and ep expert all-to-alls inside the layer body keep
+partitioning automatically around the pipeline. (sp ring attention uses
+its own fully-manual shard_map and is exercised on a separate mesh pass —
+see ``__graft_entry__._dryrun_gate_impl``.)
+
+Bubble fraction is ``(P-1)/(M+P-1)`` for ``P`` stages and ``M``
+microbatches; pick ``M ≥ 2P`` to keep it under a third. Net-new TPU
+surface: the reference has no pipeline machinery at all (SURVEY.md §2b —
+its "distribution" is the K8s scheduler); this is the in-image analog of
+what its multi-pod workloads would need.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_stages(axis_name: str = "pp") -> int:
+    """Size of the pipeline axis in the ambient mesh (1 = no pipeline)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        return 1
+    return dict(mesh.shape).get(axis_name, 1)
+
+
+def pipeline_layers(layer_fn, stacked_params, x, consts=(),
+                    batched_consts=(), *,
+                    n_micro: int = 0, axis_name: str = "pp"):
+    """Run ``x`` through a pipelined stack of layers.
+
+    Args:
+      layer_fn: ``layer_fn(h, layer_params, *consts, *batched_consts)
+        -> (h, aux)`` — one decoder layer on a microbatch
+        ``h [mb, s, d]``; ``aux`` a scalar (MoE load-balance loss;
+        return 0.0 for dense layers). Apply ``jax.checkpoint`` to it
+        *before* passing if remat is wanted.
+      stacked_params: pytree of arrays stacked on axis 0 with
+        ``L = n_stages * layers_per_stage`` — must be sharded over
+        ``axis_name`` on that leading axis (rule ``"layers": "pp"``).
+      x: global activations ``[b, s, d]`` (embedded tokens), batch
+        sharded over the data axes, replicated over ``axis_name``.
+      consts: pytree of per-call constants passed to every layer
+        (rope tables) — replicated over ``axis_name``.
+      batched_consts: pytree of per-token constants with leading batch
+        dim ``b`` (token mask): each stage receives the slice for the
+        microbatch it is *currently* processing (``m = tick - stage``),
+        matching the activation that arrived over the ppermute ring.
+      n_micro: microbatch count ``M`` (must divide ``b``); 0 picks
+        ``2 * n_stages``, clamped to ``b``.
+
+    Returns ``(y [b, s, d], aux_total)`` — the stack output and the
+    per-layer aux summed over layers and *averaged* over microbatches:
+    ``aux`` must be a batch-mean statistic (the MoE load-balance loss
+    is a mean over token groups), so the microbatch average reproduces
+    the full-batch value exactly — group statistics never span
+    microbatches.
+    """
+    n_stages = pipeline_stages(axis_name)
+    if n_stages == 1:
+        raise ValueError("pipeline_layers needs a mesh with pp > 1 in "
+                         "scope; use the plain scan path otherwise")
+    n_layers = jax.tree.leaves(stacked_params)[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pp={n_stages}")
+    b = x.shape[0]
+    if not n_micro:
+        # largest divisor of b that is <= 2*n_stages (bubble under 1/3
+        # when b allows; any batch has divisor 1 so this never fails)
+        n_micro = max(
+            m for m in range(1, min(b, 2 * n_stages) + 1) if b % m == 0
+        )
+    if b % n_micro:
+        raise ValueError(f"batch={b} not divisible by n_micro={n_micro}")
+    n_ticks = n_micro + n_stages - 1
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    dtype = x.dtype
+    # The f32 boundary (see shard_map call) works around an XLA:CPU-only
+    # compiler crash; TPU keeps the native-width boundary.
+    boundary_dtype = (
+        jnp.float32 if jax.default_backend() == "cpu" else dtype
+    )
+
+    def body(params_local, x_full, consts, bconsts):
+        # params_local leaves: [L/P, ...] — this stage's slab. x arrives
+        # in boundary_dtype (see the shard_map call); compute runs in
+        # the model dtype.
+        sidx = jax.lax.axis_index(axis_name)
+        x_full = x_full.astype(dtype)
+        micro = x_full.reshape(n_micro, b // n_micro, *x_full.shape[1:])
+        bmicro = jax.tree.map(
+            lambda a: a.reshape(n_micro, b // n_micro, *a.shape[1:]),
+            bconsts,
+        )
+
+        def stage_apply(h, bc):
+            def step(c, lp):
+                h2, aux = layer_fn(c, lp, *consts, *bc)
+                return h2, aux
+            h, auxs = jax.lax.scan(step, h, params_local)
+            return h, jnp.sum(auxs.astype(jnp.float32))
+
+        def tick(carry, t):
+            state, outs, aux_acc = carry
+            # stage s processes microbatch m = t - s at tick t; anything
+            # else is bubble warmup/drain whose aux must not count.
+            m = t - sidx
+            valid = (m >= 0) & (m < n_micro)
+            m_clip = jnp.clip(m, 0, n_micro - 1)
+            # stage 0 injects microbatch t (clamped during drain ticks —
+            # drain outputs are never collected, see validity above);
+            # later stages consume the activation ppermuted in last tick.
+            mb_in = jax.lax.dynamic_index_in_dim(
+                micro, m_clip, 0, keepdims=False)
+            h = jnp.where(sidx == 0, mb_in, state)
+            bc = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, m_clip, 0, keepdims=False),
+                bmicro,
+            )
+            y, aux = stage_apply(h, bc)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
+            # collect at the LAST stage: its microbatch m lands at tick
+            # t = m + P - 1. Early garbage writes clamp to slot 0 and are
+            # overwritten by the valid m=0 write at t = P-1 (ticks are
+            # monotone), so no predicated write is needed. Other stages'
+            # buffers are dead — out_specs stacks over pp and the caller
+            # slices the last stage.
+            outs = jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(t - (n_stages - 1), 0, n_micro - 1), 0)
+            state = jax.lax.ppermute(y, axis_name, ring)
+            return (state, outs, aux_acc), None
+
+        state0 = jnp.zeros_like(micro[0])
+        outs0 = jnp.zeros_like(micro)
+        aux0 = jnp.zeros((), jnp.float32)
+        (_, outs, aux_acc), _ = jax.lax.scan(
+            tick, (state0, outs0, aux0), jnp.arange(n_ticks))
+        # sum over stages (each layer's aux lives on one stage), mean
+        # over microbatches (aux is a batch-mean statistic — docstring)
+        aux_total = jax.lax.psum(aux_acc, axis_name) / n_micro
+        return outs[None], aux_total
+
+    # check_vma=False: the VMA (varying-manual-axes) system would insert
+    # pbroadcast/psum_invariant ops at every invariant→varying mixing
+    # point (the microbatch injection, the scan seeds), each demanding a
+    # seed annotation; the classic semantics need none. On CPU the
+    # boundary crosses in f32: AD must psum the replicated-in x's
+    # cotangent over pp, and a bf16 psum reducer (Shardy-annotated)
+    # crashes XLA:CPU's AllReducePromotion pass ("Invalid binary
+    # instruction opcode copy"). TPU keeps the native bf16 boundary.
+    outs, aux = jax.shard_map(
+        body,
+        in_specs=(P(axis_name), P(), P(), P()),
+        out_specs=(P(axis_name), P()),
+        axis_names={axis_name},
+        check_vma=False,
+    )(stacked_params, x.astype(boundary_dtype), consts, batched_consts)
+    # [P, M, mb, s, d] stacked over pp — only the last stage's buffer is
+    # the pipeline output; slicing it lowers to one pp-axis broadcast.
+    y = outs[-1].reshape(x.shape)
+    return y, aux

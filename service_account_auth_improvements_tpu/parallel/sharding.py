@@ -27,7 +27,10 @@ DEFAULT_RULES: dict[str, str | tuple[str, ...] | None] = {
     "mlp": "tp",
     "vocab": "tp",
     "expert": "ep",
-    "layers": None,
+    # the stacked-layers axis is the pipeline-stage shard: each pp rank
+    # holds a contiguous slab of layers (parallel/pipeline.py). pp=1
+    # meshes make this a no-op.
+    "layers": "pp",
     "norm": None,
 }
 

@@ -18,12 +18,16 @@ def test_eight_virtual_devices():
 def test_mesh_resolve_wildcard():
     cfg = MeshConfig(dp=2, fsdp=-1, tp=2)
     sizes = cfg.resolve(8)
-    assert sizes == {"dp": 2, "fsdp": 2, "sp": 1, "tp": 2, "ep": 1}
+    assert sizes == {
+        "dp": 2, "pp": 1, "fsdp": 2, "sp": 1, "tp": 2, "ep": 1
+    }
 
 
 def test_mesh_shape():
     mesh = make_mesh(MeshConfig(dp=1, fsdp=4, tp=2))
-    assert mesh.shape == {"dp": 1, "fsdp": 4, "sp": 1, "tp": 2, "ep": 1}
+    assert mesh.shape == {
+        "dp": 1, "pp": 1, "fsdp": 4, "sp": 1, "tp": 2, "ep": 1
+    }
 
 
 def test_mesh_rejects_bad_product():

@@ -1,0 +1,230 @@
+"""Pipeline parallelism (parallel/pipeline.py): the pp>1 decoder pipeline
+must be numerically equivalent to the plain scanned stack — same loss,
+same grads — and train end-to-end on a pp mesh.
+
+Runs on the 8-device virtual CPU mesh (conftest). Reference shape for the
+equivalence checks is the pp=1 path of the SAME config on a mesh without
+pipelining.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel import (
+    MeshConfig,
+    make_mesh,
+    pipeline_layers,
+)
+from service_account_auth_improvements_tpu.train import (
+    init_train_state,
+    make_train_step,
+)
+from service_account_auth_improvements_tpu.train.step import state_shardings
+
+CFG = dataclasses.replace(
+    llama.PRESETS["tiny"], n_layers=4, dtype="float32",
+    param_dtype="float32", remat=False,
+)
+
+
+def _loss_fn(cfg, params, tokens, mask):
+    return llama.next_token_loss(cfg, params, tokens, mask)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init(CFG, jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (8, 32), 0, CFG.vocab_size, dtype="int32"
+    )
+    mask = jnp.ones_like(tokens)
+    ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
+    with jax.set_mesh(ref_mesh):
+        ref_loss, ref_grads = jax.jit(jax.value_and_grad(
+            lambda p: _loss_fn(CFG, p, tokens, mask)
+        ))(params)
+    return params, tokens, mask, float(ref_loss), ref_grads
+
+
+def _pp_mesh(pp, **kw):
+    return make_mesh(MeshConfig(pp=pp, fsdp=1, **kw),
+                     jax.devices()[: pp * kw.get("dp", 1) * kw.get("tp", 1)])
+
+
+@pytest.mark.parametrize("n_micro", [2, 4, 8])
+def test_pipeline_loss_matches_scan(setup, n_micro):
+    params, tokens, mask, ref_loss, _ = setup
+    cfg = dataclasses.replace(CFG, pp_microbatches=n_micro)
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        loss = jax.jit(
+            lambda p: _loss_fn(cfg, p, tokens, mask)
+        )(params)
+    assert abs(float(loss) - ref_loss) < 1e-4, (float(loss), ref_loss)
+
+
+def test_pipeline_grads_match_scan(setup):
+    params, tokens, mask, _, ref_grads = setup
+    cfg = dataclasses.replace(CFG, pp_microbatches=4)
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        grads = jax.jit(jax.grad(
+            lambda p: _loss_fn(cfg, p, tokens, mask)
+        ))(params)
+    import numpy as np
+
+    flat_ref = jax.tree.leaves(ref_grads)
+    flat_pp = jax.tree.leaves(grads)
+    for r, g in zip(flat_ref, flat_pp):
+        r, g = np.asarray(r), np.asarray(g)
+        assert np.allclose(r, g, atol=2e-4, rtol=2e-3), (
+            float(np.max(np.abs(r - g)))
+        )
+
+
+def test_pipeline_four_stages(setup):
+    params, tokens, mask, ref_loss, _ = setup
+    mesh = _pp_mesh(4)
+    with jax.set_mesh(mesh):
+        loss = jax.jit(
+            lambda p: _loss_fn(CFG, p, tokens, mask)
+        )(params)
+    assert abs(float(loss) - ref_loss) < 1e-4
+
+
+def test_pipeline_composes_with_tp(setup):
+    """pp=2 × tp=2 × dp=2: the shard_map is manual only over pp, so tp
+    head/mlp sharding and dp batch sharding partition automatically
+    around the pipeline body."""
+    params, tokens, mask, ref_loss, _ = setup
+    cfg = dataclasses.replace(CFG, iota_embed=True)
+    mesh = _pp_mesh(2, tp=2, dp=2)
+    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    toks = jax.device_put(tokens, batch_sh)
+    m = jax.device_put(mask, batch_sh)
+    with jax.set_mesh(mesh):
+        loss = jax.jit(
+            lambda p: _loss_fn(cfg, p, toks, m)
+        )(params)
+    assert abs(float(loss) - ref_loss) < 1e-4
+
+
+def test_pipeline_train_step_descends():
+    """Full jitted train step (loss+grads+adamw) on a pp=2 mesh: the copy
+    task must learn, proving backward + optimizer run through the
+    pipeline (remat on, bf16 compute — the production configuration)."""
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], n_layers=4)
+    mesh = _pp_mesh(2)
+    state = init_train_state(cfg, jax.random.key(0))
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, mesh=mesh)
+    toks = jax.random.randint(
+        jax.random.key(7), (8, 32), 0, cfg.vocab_size, dtype="int32"
+    )
+    toks = toks.at[:, 16:].set(toks[:, :16])
+    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    toks = jax.device_put(toks, batch_sh)
+    mask = jax.device_put(jnp.ones_like(toks), batch_sh)
+    with jax.set_mesh(mesh):
+        state, m0 = step(state, toks, mask)
+        for _ in range(25):
+            state, m = step(state, toks, mask)
+    assert jnp.isfinite(m["loss"])
+    assert float(m["loss"]) < float(m0["loss"]) - 0.5, (
+        float(m0["loss"]), float(m["loss"])
+    )
+
+
+def test_pipeline_layer_params_stage_sharded():
+    """state_shardings puts the stacked-layers axis on pp, so each stage
+    holds only its slab (the rule-table edit that makes pp real)."""
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], n_layers=4)
+    mesh = _pp_mesh(2)
+    state = init_train_state(cfg, jax.random.key(0))
+    sh = state_shardings(mesh, cfg, state)
+    spec = sh.params["layers"]["wq"].spec
+    assert spec[0] == "pp", spec
+
+
+def test_pipeline_rejects_bad_shapes():
+    cfg = dataclasses.replace(CFG, n_layers=3)  # 3 % 2 != 0
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible by pp"):
+            jax.jit(lambda p: llama.apply(cfg, p, tokens))(params)
+
+
+def test_pipeline_microbatch_must_divide_batch():
+    cfg = dataclasses.replace(CFG, pp_microbatches=3)
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        with pytest.raises(ValueError, match="not divisible by n_micro"):
+            jax.jit(lambda p: llama.apply(cfg, p, tokens))(params)
+
+
+def test_pipeline_requires_pp_mesh():
+    params = llama.init(CFG, jax.random.key(0))
+    x = jnp.zeros((4, 8, CFG.dim), jnp.float32)
+    with pytest.raises(ValueError, match="pp > 1"):
+        pipeline_layers(lambda h, lp: (h, 0.0), params["layers"], x)
+
+
+def test_pipeline_moe_aux_counted_once():
+    """Switch-MoE under pp: the aux (load-balance) loss must equal the
+    pp=1 value — bubble ticks must not contribute phantom aux."""
+    cfg = dataclasses.replace(
+        llama.PRESETS["moe_smoke"], dtype="float32", param_dtype="float32",
+        remat=False,
+    )
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(2), (8, 32), 0, cfg.vocab_size, dtype="int32"
+    )
+    ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
+    with jax.set_mesh(ref_mesh):
+        _, ref_aux = jax.jit(
+            lambda p: llama.apply(cfg, p, tokens, return_aux=True)
+        )(params)
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        _, aux = jax.jit(
+            lambda p: llama.apply(cfg, p, tokens, return_aux=True)
+        )(params)
+    assert abs(float(ref_aux) - float(aux)) < 1e-4 * max(
+        1.0, abs(float(ref_aux))
+    ), (float(ref_aux), float(aux))
+
+
+def test_pipeline_moe_with_token_mask():
+    """MoE + token mask + pp (the gate-crash regression): the mask is a
+    batch-shaped const that must follow its microbatch through the
+    stages — loss must match the pp=1 value with padding masked."""
+    cfg = dataclasses.replace(
+        llama.PRESETS["moe_smoke"], dtype="float32", param_dtype="float32",
+        remat=False,
+    )
+    params = llama.init(cfg, jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(3), (8, 32), 0, cfg.vocab_size, dtype="int32"
+    )
+    mask = jnp.ones_like(tokens).at[:, 24:].set(0)  # padded tail
+    ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
+    with jax.set_mesh(ref_mesh):
+        ref = float(jax.jit(
+            lambda p: _loss_fn(cfg, p, tokens, mask)
+        )(params))
+    mesh = _pp_mesh(2)
+    with jax.set_mesh(mesh):
+        loss = float(jax.jit(
+            lambda p: _loss_fn(cfg, p, tokens, mask)
+        )(params))
+    assert abs(loss - ref) < 1e-4 * max(1.0, abs(ref)), (loss, ref)
