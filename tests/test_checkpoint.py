@@ -19,12 +19,12 @@ from service_account_auth_improvements_tpu.train.step import state_shardings
 CFG = llama.PRESETS["tiny"]
 
 
-def _trained_state(mesh, steps=3):
-    state = init_train_state(CFG, jax.random.key(0))
-    state = jax.device_put(state, state_shardings(mesh, CFG, state))
-    step = make_train_step(CFG, mesh=mesh)
+def _trained_state(mesh, steps=3, cfg=CFG):
+    state = init_train_state(cfg, jax.random.key(0))
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, mesh=mesh)
     tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
-                                CFG.vocab_size)
+                                cfg.vocab_size)
     mask = jnp.ones_like(tokens)
     with jax.set_mesh(mesh):
         for _ in range(steps):
@@ -85,3 +85,25 @@ def test_max_to_keep_gc(tmp_path):
     import os
     kept = sorted(d for d in os.listdir(tmp_path / "ck") if d.isdigit())
     assert kept == ["3", "4"], kept
+
+def test_restore_onto_pipeline_mesh(tmp_path):
+    """A checkpoint trained on an fsdp/tp mesh restores onto a pp mesh:
+    the layer stack re-lands stage-sharded over pp (rule "layers": "pp")
+    and a pipelined step continues from it with finite loss."""
+    cfg = dataclasses.replace(CFG, n_layers=4)
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    state, step, tokens, mask, _ = _trained_state(mesh, steps=1, cfg=cfg)
+    ckpt.save(tmp_path / "ck", state)
+
+    pp_mesh = make_mesh(MeshConfig(pp=2, fsdp=2, tp=2))
+    like = jax.eval_shape(lambda: init_train_state(cfg, jax.random.key(0)))
+    got = ckpt.restore(tmp_path / "ck", pp_mesh, cfg, like)
+    p = got.params["layers"]["wq"]
+    assert p.sharding.mesh.shape["pp"] == 2
+    assert p.sharding.spec[0] == "pp", p.sharding.spec
+    for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    pp_step = make_train_step(cfg, mesh=pp_mesh)
+    with jax.set_mesh(pp_mesh):
+        got, m = pp_step(got, tokens, mask)
+    assert jnp.isfinite(m["loss"])
