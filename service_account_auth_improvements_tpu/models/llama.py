@@ -48,7 +48,8 @@ class LlamaConfig:
     param_dtype: str = "float32"     # master parameter dtype
     remat: bool = True
     scan_layers: bool = True
-    attn_impl: str = "dense"         # dense | flash | ring (ring needs a mesh)
+    # dense | flash | ring | ulysses (ring/ulysses need an sp mesh)
+    attn_impl: str = "dense"
     # Embedding lookup strategy. The table is (vocab→tp, embed→fsdp)
     # sharded; a positional gather across the tp-sharded vocab axis makes
     # the SPMD partitioner replicate ("involuntary full
@@ -556,10 +557,15 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
     (1.0 where the *target* position counts). With ``cfg.loss_chunk`` the
     vocab projection + log-softmax run in sequence chunks (see
     ``_chunked_nll``)."""
-    x, aux = _backbone(
-        cfg, params, tokens[:, :-1],
-        token_mask=None if mask is None else mask[:, :-1],
-    )
+    # Run the backbone on the FULL sequence and drop the last hidden
+    # state after: causality makes positions 0..s-2 identical either
+    # way, while keeping the in-model sequence length divisible by the
+    # sp axis (ring/ulysses shard the sequence manually and cannot pad
+    # an s-1 length; truncating before the forward broke seq % sp == 0).
+    # The last (real) token also now participates in MoE routing
+    # statistics, which is the more faithful accounting.
+    x, aux = _backbone(cfg, params, tokens, token_mask=mask)
+    x = x[:, :-1]
     # clip like the embedding path: an out-of-range target would one-hot
     # to all-zeros and make nll = logz instead of a real cross-entropy
     targets = jnp.clip(tokens[:, 1:], 0, cfg.vocab_size - 1)

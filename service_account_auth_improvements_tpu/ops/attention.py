@@ -7,6 +7,10 @@
            falls back to dense off-TPU.
   "ring"   ring attention over the ``sp`` mesh axis (parallel/ring.py);
            requires a mesh context with dp/fsdp/sp/tp axes (shard_map).
+  "ulysses" all-to-all sequence parallelism over ``sp``
+           (parallel/ulysses.py): two all-to-alls re-partition seq→heads
+           so the flash kernel runs on full sequences; needs
+           local heads divisible by the sp size.
 
 All impls take q/k/v shaped ``[batch, seq, heads, head_dim]`` (kv may have
 fewer heads — GQA is handled here by logical head-group broadcast, not by
@@ -59,6 +63,12 @@ def multi_head_attention(q, k, v, *, impl: str = "dense", causal: bool = True):
         )
 
         return ring_attention(q, k, v, causal=causal)
+    if impl == "ulysses":
+        from service_account_auth_improvements_tpu.parallel.ulysses import (
+            ulysses_attention,
+        )
+
+        return ulysses_attention(q, k, v, causal=causal)
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}")
     return _dense_attention(q, k, v, scale, causal=causal)

@@ -398,8 +398,9 @@ def flash_attention(q, k, v, *, causal: bool = True, interpret: bool | None = No
     """Public wrapper: q [b,sq,h,d], k/v [b,sk,hkv,d] → [b,sq,h,d].
 
     Uses the Pallas kernels when the backend is TPU; falls back to the
-    fused dense path otherwise. Non-block-aligned causal sequences (the
-    train step's seq-1 shape) are zero-padded: padded KEYS are in every
+    fused dense path otherwise. Non-block-aligned causal sequences
+    (e.g. generation prefills at arbitrary prompt lengths) are
+    zero-padded: padded KEYS are in every
     real row's causal future, so they are masked; padded QUERY rows are
     sliced off, and their cotangents are zero by construction of
     pad/slice under autodiff. Set ``interpret=True`` to force the kernels

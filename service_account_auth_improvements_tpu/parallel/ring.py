@@ -20,7 +20,6 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
 
 NEG_INF = -2.0e38
 
@@ -120,14 +119,14 @@ def ring_attention(q, k, v, *, causal: bool = True, axis_name: str = "sp",
     """Sharded entry: wraps ``ring_attention_local`` in shard_map over the
     context mesh. q [b,s,hq,d], k/v [b,s,hkv,d] with seq sharded on
     ``axis_name``; batch on ``batch_axes``; heads on ``head_axis``."""
-    kv_head_axis = kv_head_axis or head_axis
-    spec_q = P(tuple(batch_axes), axis_name, head_axis, None)
-    spec_kv = P(tuple(batch_axes), axis_name, kv_head_axis, None)
+    from service_account_auth_improvements_tpu.parallel.sharding import (
+        sp_attention_shard_map,
+    )
+
     fn = functools.partial(
         ring_attention_local, axis_name=axis_name, causal=causal
     )
-    return jax.shard_map(
-        fn,
-        in_specs=(spec_q, spec_kv, spec_kv),
-        out_specs=spec_q,
-    )(q, k, v)
+    return sp_attention_shard_map(
+        fn, q, k, v, axis_name=axis_name, batch_axes=batch_axes,
+        head_axis=head_axis, kv_head_axis=kv_head_axis,
+    )

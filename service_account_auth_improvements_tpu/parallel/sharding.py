@@ -81,6 +81,23 @@ def shard_constraint(x, axes: tuple[str | None, ...], rules: dict | None = None)
     return jax.lax.with_sharding_constraint(x, logical_to_mesh(axes, rules))
 
 
+def sp_attention_shard_map(local_fn, q, k, v, *, axis_name: str,
+                           batch_axes, head_axis: str,
+                           kv_head_axis: str | None = None):
+    """Shared sharded-entry wrapper for sequence-parallel attention
+    bodies (ring, ulysses): q [b,s,hq,d], k/v [b,s,hkv,d] with seq on
+    ``axis_name``, batch on ``batch_axes``, heads on ``head_axis`` —
+    one source of truth for the sp-mesh spec convention."""
+    kv_head_axis = kv_head_axis or head_axis
+    spec_q = P(tuple(batch_axes), axis_name, head_axis, None)
+    spec_kv = P(tuple(batch_axes), axis_name, kv_head_axis, None)
+    return jax.shard_map(
+        local_fn,
+        in_specs=(spec_q, spec_kv, spec_kv),
+        out_specs=spec_q,
+    )(q, k, v)
+
+
 def tree_logical_sharding(mesh: Mesh, axes_tree, rules: dict | None = None):
     """Map a pytree of logical-axes tuples to a pytree of NamedShardings."""
     return jax.tree.map(

@@ -1,0 +1,81 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second of the two long-context strategies (the task's "ring attention
+or all-to-all sequence parallelism"; ring is `parallel/ring.py`). Each
+device holds a contiguous *sequence* chunk of q/k/v. One all-to-all over
+the ``sp`` axis re-partitions them so every device holds the FULL
+sequence for ``heads/sp`` of its local heads; attention then runs
+unmodified — including the Pallas flash kernel, which sees an ordinary
+dense-layout [b, s, h_local, d] problem — and a second all-to-all
+restores sequence sharding. Four all-to-alls total per attention call
+(q/k/v in, output back; vs ``2·sp`` ppermute steps for ring's k/v
+rotation), at the cost of requiring
+``local_heads % sp == 0`` (ring has no head constraint and O(s/sp) peak
+memory; Ulysses materializes the full-sequence scores per local head —
+pick ring for extreme lengths, Ulysses when the flash kernel should run
+untouched).
+
+Public reference points for the pattern: DeepSpeed-Ulysses
+(arXiv:2309.14509); the reference repo itself has no sequence
+parallelism of any kind (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = "sp",
+                            causal: bool = True, inner_impl: str = "flash"):
+    """All-to-all attention body — call INSIDE shard_map on local chunks.
+
+    q [b, s_local, hq_local, d]; k/v [b, s_local, hkv_local, d]. The
+    local head counts must divide by the ``axis_name`` axis size.
+    Returns the local output chunk [b, s_local, hq_local, d] in q.dtype.
+    """
+    from service_account_auth_improvements_tpu.ops.attention import (
+        multi_head_attention,
+    )
+
+    n = jax.lax.axis_size(axis_name)
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % n or hkv % n:
+        raise ValueError(
+            f"ulysses needs local head counts divisible by sp={n}; got "
+            f"q heads {hq}, kv heads {hkv} (lower tp or sp, or use ring)"
+        )
+    # seq-sharded → head-sharded: split heads, gather sequence.
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, tiled=True
+    )
+    q = a2a(q, split_axis=2, concat_axis=1)   # [b, s, hq/n, d]
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    out = multi_head_attention(q, k, v, impl=inner_impl, causal=causal)
+    # head-sharded → seq-sharded: split sequence, gather heads.
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(q, k, v, *, causal: bool = True,
+                      axis_name: str = "sp", inner_impl: str = "flash",
+                      batch_axes=("dp", "fsdp"), head_axis: str = "tp",
+                      kv_head_axis: str | None = None):
+    """Sharded entry: wraps the local body in shard_map over the context
+    mesh (same calling convention as ``ring_attention``): q [b,s,hq,d],
+    k/v [b,s,hkv,d] with seq sharded on ``axis_name``, heads on
+    ``head_axis``. ``inner_impl`` picks the per-device kernel ("flash"
+    falls back to dense off-TPU)."""
+    from service_account_auth_improvements_tpu.parallel.sharding import (
+        sp_attention_shard_map,
+    )
+
+    fn = functools.partial(
+        ulysses_attention_local, axis_name=axis_name, causal=causal,
+        inner_impl=inner_impl,
+    )
+    return sp_attention_shard_map(
+        fn, q, k, v, axis_name=axis_name, batch_axes=batch_axes,
+        head_axis=head_axis, kv_head_axis=kv_head_axis,
+    )
