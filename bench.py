@@ -33,7 +33,8 @@ import sys
 import time
 
 
-def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
+def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
+                grad_accum: int = 1):
     """One measured config → (tokens/sec, mfu, step_time)."""
     import jax
     import jax.numpy as jnp
@@ -63,7 +64,8 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2):
     )
     state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
-    step = make_train_step(cfg, optimizer=opt, mesh=mesh)
+    step = make_train_step(cfg, optimizer=opt, mesh=mesh,
+                           grad_accum=grad_accum)
 
     tokens = jax.random.randint(
         jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
@@ -133,7 +135,12 @@ def _breakdown(cfg, batch: int, seq: int):
     loss_grad = jax.jit(jax.value_and_grad(
         lambda p, t, m: llama.next_token_loss(cfg, p, t, m)
     ))
-    step = make_train_step(cfg, optimizer=opt, mesh=mesh)
+    # same grad_accum as _run_config: the breakdown must describe the
+    # program the headline number measured
+    step = make_train_step(
+        cfg, optimizer=opt, mesh=mesh,
+        grad_accum=int(os.environ.get("SATPU_BENCH_GRAD_ACCUM", "1")),
+    )
 
     def timed(fn, *args, iters=3, fetch):
         with jax.set_mesh(mesh):
@@ -200,8 +207,10 @@ def _child_main() -> None:
     batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
     seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
     iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
+    grad_accum = int(os.environ.get("SATPU_BENCH_GRAD_ACCUM", "1"))
 
-    tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters)
+    tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters,
+                                       grad_accum=grad_accum)
 
     headline = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -215,6 +224,7 @@ def _child_main() -> None:
         "step_time_s": round(dt, 4),
         "backend": jax.default_backend(),
         "device": getattr(jax.devices()[0], "device_kind", "?"),
+        **({"grad_accum": grad_accum} if grad_accum > 1 else {}),
     }
     # Emit the headline as soon as it exists (flushed): if the flaky TPU
     # runtime wedges during the matrix/breakdown extras, the parent

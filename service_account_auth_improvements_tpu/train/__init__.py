@@ -3,6 +3,8 @@ sharding-aware checkpoint/resume (train.checkpoint)."""
 
 from service_account_auth_improvements_tpu.train.step import (  # noqa: F401
     TrainState,
+    make_lr_schedule,
+    make_optimizer,
     make_train_step,
     init_train_state,
 )

@@ -104,13 +104,13 @@ def main(argv=None) -> None:
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--ckpt-every", type=int, default=0)
-    for axis in ("dp", "fsdp", "sp", "tp", "ep"):
+    for axis in ("dp", "pp", "fsdp", "sp", "tp", "ep"):
         ap.add_argument(f"--{axis}", type=int, default=1)
     args = ap.parse_args(argv)
 
     cfg = llama.PRESETS[args.preset]
-    mesh = make_mesh(MeshConfig(dp=args.dp, fsdp=args.fsdp, sp=args.sp,
-                                tp=args.tp, ep=args.ep))
+    mesh = make_mesh(MeshConfig(dp=args.dp, pp=args.pp, fsdp=args.fsdp,
+                                sp=args.sp, tp=args.tp, ep=args.ep))
     # synthetic corpus sized for the run (real jobs pass a memmap)
     rng = np.random.default_rng(0)
     n = max(args.batch * args.seq * 4,

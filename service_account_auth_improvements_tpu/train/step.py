@@ -30,13 +30,38 @@ class TrainState(NamedTuple):
     opt_state: Any
 
 
-def make_optimizer(learning_rate: float = 3e-4, weight_decay: float = 0.1,
+def make_lr_schedule(peak_lr: float = 3e-4, warmup_steps: int = 0,
+                     decay_steps: int = 0, min_lr_ratio: float = 0.1):
+    """Linear warmup → cosine decay → ``peak_lr * min_lr_ratio`` floor —
+    the standard LLM pretraining shape. With no ``decay_steps``:
+    warmup-then-constant (fine-tuning), or the constant ``peak_lr`` when
+    neither is given."""
+    if not decay_steps:
+        if warmup_steps:
+            return optax.join_schedules(
+                [optax.linear_schedule(0.0, peak_lr, warmup_steps),
+                 optax.constant_schedule(peak_lr)],
+                boundaries=[warmup_steps],
+            )
+        return peak_lr
+    return optax.warmup_cosine_decay_schedule(
+        init_value=0.0 if warmup_steps else peak_lr,
+        peak_value=peak_lr,
+        warmup_steps=warmup_steps,
+        decay_steps=decay_steps,
+        end_value=peak_lr * min_lr_ratio,
+    )
+
+
+def make_optimizer(learning_rate=3e-4, weight_decay: float = 0.1,
                    b1: float = 0.9, b2: float = 0.95, grad_clip: float = 1.0,
                    mu_dtype=None):
-    """AdamW with global-norm clipping. ``mu_dtype="bfloat16"`` stores the
-    first moment in bf16 (optax casts on read/write) — halves mu's HBM at
-    ~no accuracy cost (the first moment is a smoothed gradient; the second
-    moment, which sets the preconditioner scale, stays f32)."""
+    """AdamW with global-norm clipping. ``learning_rate`` may be a float
+    or an optax schedule (``make_lr_schedule``). ``mu_dtype="bfloat16"``
+    stores the first moment in bf16 (optax casts on read/write) — halves
+    mu's HBM at ~no accuracy cost (the first moment is a smoothed
+    gradient; the second moment, which sets the preconditioner scale,
+    stays f32)."""
     return optax.chain(
         optax.clip_by_global_norm(grad_clip),
         optax.adamw(learning_rate, b1=b1, b2=b2, weight_decay=weight_decay,
@@ -83,19 +108,67 @@ def state_shardings(mesh, cfg: llama.LlamaConfig, state: TrainState,
 
 
 def make_train_step(cfg: llama.LlamaConfig, optimizer=None, mesh=None,
-                    rules=None):
+                    rules=None, grad_accum: int = 1):
     """Return jitted ``step(state, tokens, mask) -> (state, metrics)``.
 
     When ``mesh`` is given the function is partitioned: batch over
     (dp, fsdp), state by logical rules, donated in place.
+
+    ``grad_accum > 1`` splits the batch into that many sequential
+    micro-steps inside the jitted step (``lax.scan``), accumulating
+    gradients before one optimizer update — activation memory drops to
+    one micro-batch's worth, the HBM lever when the global batch won't
+    fit. Loss and grads are the mean over micro-steps (identical to the
+    single-pass values when the token mask is uniform; with ragged
+    padding, per-micro-batch means are averaged, the standard
+    accumulation semantics). Requires ``batch % grad_accum == 0``.
     """
     optimizer = optimizer or make_optimizer()
 
-    def step_fn(state: TrainState, tokens, mask):
-        def loss_fn(params):
-            return llama.next_token_loss(cfg, params, tokens, mask)
+    def loss_fn(params, tokens, mask):
+        return llama.next_token_loss(cfg, params, tokens, mask)
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    def step_fn(state: TrainState, tokens, mask):
+        if grad_accum == 1:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state.params, tokens, mask
+            )
+        else:
+            b = tokens.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch={b} not divisible by grad_accum={grad_accum}"
+                )
+            # STRIDED micro-batches (rows i, i+A, i+2A, …): with the batch
+            # sharded over (dp, fsdp), a contiguous split would hand each
+            # micro-batch to one device subset and idle the rest; strided
+            # rows keep every micro-batch spread over all devices.
+            tks = tokens.reshape(b // grad_accum, grad_accum, -1)
+            tks = tks.transpose(1, 0, 2)
+            mks = mask.reshape(b // grad_accum, grad_accum, -1)
+            mks = mks.transpose(1, 0, 2)
+
+            def micro(carry, tm):
+                loss_acc, grads_acc = carry
+                l, g = jax.value_and_grad(loss_fn)(state.params, *tm)
+                # accumulate in f32: bf16 master params would otherwise
+                # sum same-sign gradients in 8 mantissa bits
+                return (loss_acc + l, jax.tree.map(
+                    lambda a, x: a + x.astype(jnp.float32), grads_acc, g
+                )), None
+
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+            )
+            (loss, grads), _ = jax.lax.scan(
+                micro, (jnp.zeros((), jnp.float32), zeros), (tks, mks)
+            )
+            loss = loss / grad_accum
+            grads = jax.tree.map(
+                lambda g, p: (g / grad_accum).astype(p.dtype),
+                grads, state.params,
+            )
+
         updates, opt_state = optimizer.update(
             grads, state.opt_state, state.params
         )

@@ -77,3 +77,28 @@ def test_sampling_is_reproducible_and_in_vocab():
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert a.shape == (2, 11)
     assert int(a.max()) < CFG.vocab_size and int(a.min()) >= 0
+
+
+def test_generate_on_tp_mesh_matches_single_device():
+    """Generation with tp-sharded params produces the same tokens as
+    single-device decode — inference under the serving mesh layout."""
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.parallel.sharding import (
+        tree_logical_sharding,
+    )
+
+    cfg = dataclasses.replace(CFG, iota_embed=True)
+    params = llama.init(cfg, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(1), (2, 7), 0,
+                                cfg.vocab_size)
+    want = generate.generate(cfg, params, prompt, 8)
+
+    mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=2), jax.devices()[:4])
+    sh = tree_logical_sharding(mesh, llama.logical_axes(cfg))
+    sh_params = jax.device_put(params, sh)
+    with jax.set_mesh(mesh):
+        got = generate.generate(cfg, sh_params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -23,30 +23,35 @@ import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-# (remat_policy, loss_chunk, batch, mu_dtype, param_dtype)
+# (remat_policy, loss_chunk, batch, mu_dtype, param_dtype, grad_accum)
 GRIDS = {
     # the axes most likely to move MFU, one at a time from the r4 baseline
     "quick": [
-        ("full", 512, 8, "", ""),            # r5 default (chunked CE)
-        ("full", 0, 8, "", ""),              # r4 baseline control
-        ("full", 512, 12, "", ""),           # bigger batch w/ freed HBM
-        ("full", 512, 16, "", ""),
-        ("full", 512, 8, "bfloat16", ""),    # lean first moment
-        ("dots_saveable", 512, 4, "bfloat16", "bfloat16"),  # no-recompute
-        ("dots_saveable", 512, 8, "bfloat16", "bfloat16"),
+        ("full", 512, 8, "", "", 1),         # r5 default (chunked CE)
+        ("full", 0, 8, "", "", 1),           # r4 baseline control
+        ("full", 512, 12, "", "", 1),        # bigger batch w/ freed HBM
+        ("full", 512, 16, "", "", 1),
+        ("full", 512, 8, "bfloat16", "", 1),  # lean first moment
+        ("dots_saveable", 512, 4, "bfloat16", "bfloat16", 1),  # no-recompute
+        ("dots_saveable", 512, 8, "bfloat16", "bfloat16", 1),
+        # grad accumulation: micro-batch activations pay for the lighter
+        # remat policy at full global batch
+        ("dots_saveable", 512, 16, "bfloat16", "", 2),
+        ("dots_saveable", 512, 16, "bfloat16", "", 4),
     ],
     "full": [
-        (rp, lc, b, mu, pd)
+        (rp, lc, b, mu, pd, ga)
         for rp in ("full", "dots_saveable")
         for lc in (0, 256, 512, 1024)
         for b in (8, 12, 16)
         for mu in ("", "bfloat16")
         for pd in ("",)
+        for ga in (1, 2)
     ],
 }
 
 
-def run_point(preset, rp, lc, batch, mu, pd, timeout):
+def run_point(preset, rp, lc, batch, mu, pd, ga, timeout):
     env = dict(
         os.environ,
         SATPU_BENCH_CHILD="1",
@@ -55,6 +60,7 @@ def run_point(preset, rp, lc, batch, mu, pd, timeout):
         SATPU_BENCH_REMAT_POLICY=rp,
         SATPU_BENCH_LOSS_CHUNK=str(lc),
         SATPU_BENCH_BATCH=str(batch),
+        SATPU_BENCH_GRAD_ACCUM=str(ga),
     )
     if mu:
         env["SATPU_BENCH_MU_DTYPE"] = mu
@@ -82,13 +88,14 @@ def main():
     args = ap.parse_args()
 
     results = []
-    for rp, lc, batch, mu, pd in GRIDS[args.points]:
+    for rp, lc, batch, mu, pd, ga in GRIDS[args.points]:
         tag = (f"remat={rp} chunk={lc} b={batch} "
-               f"mu={mu or 'f32'} pdt={pd or 'f32'}")
-        out = run_point(args.preset, rp, lc, batch, mu, pd, args.timeout)
+               f"mu={mu or 'f32'} pdt={pd or 'f32'} ga={ga}")
+        out = run_point(args.preset, rp, lc, batch, mu, pd, ga,
+                        args.timeout)
         row = {"remat": rp, "loss_chunk": lc, "batch": batch,
                "mu_dtype": mu or "float32",
-               "param_dtype": pd or "float32", **out}
+               "param_dtype": pd or "float32", "grad_accum": ga, **out}
         results.append(row)
         if "error" in out:
             print(f"{tag:55s} ERROR {out['error'][:80]}")
@@ -101,7 +108,7 @@ def main():
         print(f"\nbest: mfu={best['mfu']:.4f} "
               f"remat={best['remat']} chunk={best['loss_chunk']} "
               f"b={best['batch']} mu={best['mu_dtype']} "
-              f"pdt={best['param_dtype']}")
+              f"pdt={best['param_dtype']} ga={best['grad_accum']}")
     (ROOT / "SWEEP.json").write_text(json.dumps(
         {"preset": args.preset, "results": results}, indent=1))
     print(f"wrote {ROOT / 'SWEEP.json'}")
