@@ -1,0 +1,146 @@
+"""HuggingFace Llama checkpoint → native param tree conversion.
+
+The migration path for users switching from the reference's GPU stack:
+any HF-format Llama (Llama-2/3 family — `LlamaForCausalLM`) loads
+directly into `models/llama.py`'s pytree, after which every mesh layout
+in `docs/parallelism.md` applies unchanged. Conventions line up
+one-to-one: HF's LlamaModel uses the same rotate-half RoPE as
+`ops/rotary.py` (no q/k lane permutation needed — that permutation is
+only required when converting *Meta*-format weights, which HF's own
+converter already applied), same RMSNorm placement, same SiLU
+gate·up MLP. Logit parity against `transformers` is asserted in
+`tests/test_convert_hf.py`.
+
+Core functions take a plain ``{name: array}`` mapping + config dict so
+no torch import is required on the hot path; ``from_hf`` is the
+convenience wrapper for an in-memory ``transformers`` model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from service_account_auth_improvements_tpu.models import llama
+
+
+def config_from_hf(hf_cfg: Any) -> llama.LlamaConfig:
+    """Map a ``transformers.LlamaConfig`` (or any object/dict with the
+    same field names) to a :class:`llama.LlamaConfig`."""
+    get = (hf_cfg.get if isinstance(hf_cfg, Mapping)
+           else lambda k, d=None: getattr(hf_cfg, k, d))
+    heads = get("num_attention_heads")
+    hidden = get("hidden_size")
+    scaling = get("rope_scaling") or {}
+    rope_kw = {}
+    if scaling:
+        # HF aliases the type key; Llama-3.1+ checkpoints use "llama3".
+        rope_type = scaling.get("rope_type") or scaling.get("type")
+        if rope_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rope_type!r}: only the "
+                "Llama-3.1 'llama3' rule is implemented "
+                "(ops/rotary.llama3_scale_freqs); dropping it silently "
+                "would corrupt long-context logits"
+            )
+        rope_kw = {
+            "rope_scaling_factor": float(scaling["factor"]),
+            "rope_low_freq_factor": float(
+                scaling.get("low_freq_factor", 1.0)),
+            "rope_high_freq_factor": float(
+                scaling.get("high_freq_factor", 4.0)),
+            "rope_original_max_seq": int(
+                scaling.get("original_max_position_embeddings", 8192)),
+        }
+    return llama.LlamaConfig(
+        vocab_size=get("vocab_size"),
+        dim=hidden,
+        n_layers=get("num_hidden_layers"),
+        n_heads=heads,
+        n_kv_heads=get("num_key_value_heads") or heads,
+        head_dim=get("head_dim") or hidden // heads,
+        mlp_dim=get("intermediate_size"),
+        rope_theta=float(get("rope_theta") or 10_000.0),
+        norm_eps=float(get("rms_norm_eps") or 1e-5),
+        max_seq_len=get("max_position_embeddings") or 8192,
+        **rope_kw,
+    )
+
+
+def params_from_hf_state_dict(
+    cfg: llama.LlamaConfig, sd: Mapping[str, np.ndarray],
+) -> dict:
+    """Build the native param tree from an HF Llama state dict.
+
+    ``sd`` values are numpy (or numpy-convertible) arrays with torch
+    Linear layout ``[out_features, in_features]`` — transposed here
+    because the native model right-multiplies (``h @ w``). Layer arrays
+    are stacked on a leading axis (the `lax.scan`/pipeline layout).
+    Missing ``lm_head.weight`` means tied embeddings: the output head
+    reuses the token embedding.
+    """
+    pdt = jnp.dtype(cfg.param_dtype)
+    consumed = set()
+
+    def a(name):
+        consumed.add(name)
+        arr = sd[name]
+        return np.asarray(arr, dtype=np.float32)
+
+    def linear(name):
+        return a(name).T  # [out, in] -> [in, out]
+
+    def stack(fmt, transform):
+        return jnp.asarray(
+            np.stack([transform(fmt.format(i))
+                      for i in range(cfg.n_layers)]), pdt
+        )
+
+    prefix = "model."
+    if f"{prefix}embed_tokens.weight" not in sd and "embed_tokens.weight" in sd:
+        prefix = ""
+    layer = prefix + "layers.{0}."
+    params = {
+        "tok_embed": jnp.asarray(a(f"{prefix}embed_tokens.weight"), pdt),
+        "layers": {
+            "attn_norm": stack(layer + "input_layernorm.weight", a),
+            "wq": stack(layer + "self_attn.q_proj.weight", linear),
+            "wk": stack(layer + "self_attn.k_proj.weight", linear),
+            "wv": stack(layer + "self_attn.v_proj.weight", linear),
+            "wo": stack(layer + "self_attn.o_proj.weight", linear),
+            "mlp_norm": stack(layer + "post_attention_layernorm.weight", a),
+            "w_gate": stack(layer + "mlp.gate_proj.weight", linear),
+            "w_up": stack(layer + "mlp.up_proj.weight", linear),
+            "w_down": stack(layer + "mlp.down_proj.weight", linear),
+        },
+        "final_norm": jnp.asarray(a(f"{prefix}norm.weight"), pdt),
+    }
+    head = "lm_head.weight"
+    if head in sd:
+        params["lm_head"] = jnp.asarray(linear(head), pdt)
+    else:  # tied embeddings (Llama-3.2-1B/3B style)
+        params["lm_head"] = params["tok_embed"].T
+    # every weight must have landed somewhere: a checkpoint with e.g.
+    # attention biases (attention_bias=True variants) would otherwise
+    # convert silently to wrong logits. Non-weight buffers are exempt.
+    leftovers = {
+        k for k in sd
+        if k not in consumed and not k.endswith(".inv_freq")
+    }
+    if leftovers:
+        raise ValueError(
+            "unconverted weights in state dict (unsupported Llama "
+            f"variant?): {sorted(leftovers)[:8]}"
+        )
+    return params
+
+
+def from_hf(model) -> tuple[llama.LlamaConfig, dict]:
+    """Convert an in-memory ``transformers.LlamaForCausalLM``."""
+    cfg = config_from_hf(model.config)
+    sd = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    return cfg, params_from_hf_state_dict(cfg, sd)

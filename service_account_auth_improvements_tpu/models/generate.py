@@ -90,7 +90,8 @@ def prefill(cfg: llama.LlamaConfig, params, tokens, max_len: int):
     v = jnp.einsum("lbsd,ldk->lbsk", h, lp["wv"].astype(cdt)).reshape(
         cfg.n_layers, b, s, cfg.n_kv_heads, cfg.head_dim
     )
-    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling())
     k = jax.vmap(lambda kl: llama.apply_rope(kl, cos, sin))(k)
 
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
@@ -196,7 +197,8 @@ def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
     if key is None:
         key = jax.random.key(0)
     cache, logits = prefill(cfg, params, prompt, max_len)
-    cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling())
     first = _sample(logits, key, temperature, top_k)
 
     def body(carry, step_key):

@@ -42,6 +42,12 @@ class LlamaConfig:
     head_dim: int = 128
     mlp_dim: int = 14_336
     rope_theta: float = 500_000.0
+    # Llama-3.1-style RoPE context-extension ("rope_type": "llama3").
+    # factor 0 = off; see ops/rotary.llama3_scale_freqs.
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_seq: int = 8192
     norm_eps: float = 1e-5
     max_seq_len: int = 8192
     dtype: str = "bfloat16"          # activation/compute dtype
@@ -88,6 +94,17 @@ class LlamaConfig:
     # stack runs through parallel/pipeline.py with this many microbatches
     # (0 = 2·pp, clamped to batch). Ignored on pp=1 meshes.
     pp_microbatches: int = 0
+
+    def rope_scaling(self) -> dict | None:
+        """kwargs for ``rope_table(scaling=...)``; None when unscaled."""
+        if not self.rope_scaling_factor:
+            return None
+        return {
+            "factor": self.rope_scaling_factor,
+            "low_freq_factor": self.rope_low_freq_factor,
+            "high_freq_factor": self.rope_high_freq_factor,
+            "original_max_seq": self.rope_original_max_seq,
+        }
 
     def moe_cap(self, group: int) -> int:
         """Per-group expert capacity."""
@@ -422,7 +439,8 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
         x = jnp.take(params["tok_embed"], tokens, axis=0,
                      mode="clip").astype(cdt)
     x = shard_constraint(x, ("batch", "seq", None))
-    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_table(s, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling())
 
     layer_fn = partial(_layer, cfg)
     if cfg.remat and cfg.remat_policy != "none":

@@ -5,11 +5,47 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rope_table(seq_len: int, head_dim: int, theta: float = 500_000.0):
-    """Precompute (cos, sin) tables, each ``[seq_len, head_dim // 2]`` fp32."""
+def llama3_scale_freqs(freqs, *, factor: float, low_freq_factor: float,
+                       high_freq_factor: float, original_max_seq: int):
+    """Llama-3.1 frequency rescaling for context extension (the public
+    ``rope_type="llama3"`` rule): wavelengths shorter than the
+    high-frequency cutoff keep their frequency, wavelengths longer than
+    the low-frequency cutoff are slowed by ``factor``, and the band in
+    between interpolates smoothly."""
+    import numpy as np
+
+    two_pi = 2.0 * np.pi
+    wavelen = two_pi / freqs
+    low_wavelen = original_max_seq / low_freq_factor
+    high_wavelen = original_max_seq / high_freq_factor
+    smooth = (original_max_seq / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    scaled = jnp.where(
+        wavelen < high_wavelen,
+        freqs,
+        jnp.where(
+            wavelen > low_wavelen,
+            freqs / factor,
+            (1.0 - smooth) * freqs / factor + smooth * freqs,
+        ),
+    )
+    return scaled
+
+
+def rope_table(seq_len: int, head_dim: int, theta: float = 500_000.0,
+               scaling: dict | None = None):
+    """Precompute (cos, sin) tables, each ``[seq_len, head_dim // 2]`` fp32.
+
+    ``scaling``: optional Llama-3.1-style context-extension parameters —
+    ``{"factor", "low_freq_factor", "high_freq_factor",
+    "original_max_seq"}`` (see :func:`llama3_scale_freqs`).
+    """
     freqs = 1.0 / (
         theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     )
+    if scaling:
+        freqs = llama3_scale_freqs(freqs, **scaling)
     pos = jnp.arange(seq_len, dtype=jnp.float32)
     angles = jnp.outer(pos, freqs)
     return jnp.cos(angles), jnp.sin(angles)
