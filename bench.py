@@ -262,12 +262,22 @@ def _child_main() -> None:
             # switch-MoE preset: routing + dispatch/combine overhead on one
             # chip; MFU uses active_matmul_param_count (top-1 experts)
             ("bench_moe", llama.PRESETS["bench_moe"]),
+            # long-context: 4x the sequence at 1/4 the batch (same token
+            # budget) — tracks the flash kernel + chunked-CE behavior as
+            # the attention share grows
+            ("bench_400m_long",
+             dataclasses.replace(llama.PRESETS["bench_400m"],
+                                 max_seq_len=8192)),
         ]:
+            row_batch, row_seq = batch, seq
+            if name == "bench_400m_long":
+                row_batch, row_seq = max(1, batch // 4), seq * 4
             try:
                 m_tok, m_mfu, m_dt = _run_config(
-                    mcfg, batch, seq, max(3, iters - 2))
+                    mcfg, row_batch, row_seq, max(3, iters - 2))
                 matrix.append({
                     "preset": name, "attn": mcfg.attn_impl,
+                    "batch": row_batch, "seq": row_seq,
                     "tokens_per_sec": round(m_tok, 1),
                     "mfu": round(m_mfu, 4),
                     "step_time_s": round(m_dt, 4),

@@ -222,14 +222,21 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         head_dim=64, mlp_dim=8192, max_seq_len=8192, moe_experts=8,
     ),
+    # Llama-3.2-1B geometry; ships with the 'llama3' context-extension
+    # rule (factor 32 over an 8k original window — public HF config).
     "llama3_1b": LlamaConfig(
         vocab_size=128_256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         head_dim=64, mlp_dim=8192, max_seq_len=8192,
+        rope_scaling_factor=32.0, rope_original_max_seq=8192,
     ),
-    "llama3_8b": LlamaConfig(),
+    # Llama-3.1-8B/70B: rope_scaling factor 8 (public HF configs).
+    "llama3_8b": LlamaConfig(
+        rope_scaling_factor=8.0, rope_original_max_seq=8192,
+    ),
     "llama3_70b": LlamaConfig(
         dim=8192, n_layers=80, n_heads=64, n_kv_heads=8, head_dim=128,
         mlp_dim=28_672,
+        rope_scaling_factor=8.0, rope_original_max_seq=8192,
     ),
 }
 
@@ -570,11 +577,13 @@ def _chunked_nll(cfg: LlamaConfig, x, lm_head, targets):
     return nll[:, :t]
 
 
-def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
+def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None,
+                    include_aux: bool = True):
     """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
     (1.0 where the *target* position counts). With ``cfg.loss_chunk`` the
     vocab projection + log-softmax run in sequence chunks (see
-    ``_chunked_nll``)."""
+    ``_chunked_nll``). ``include_aux=False`` returns the pure CE without
+    the MoE load-balance regularizer (evaluation/perplexity)."""
     # Run the backbone on the FULL sequence and drop the last hidden
     # state after: causality makes positions 0..s-2 identical either
     # way, while keeping the in-model sequence length divisible by the
@@ -597,6 +606,6 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None):
     else:
         m = mask[:, 1:].astype(nll.dtype)
         loss = (nll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    if cfg.moe_experts:
+    if cfg.moe_experts and include_aux:
         loss = loss + cfg.moe_aux_weight * aux
     return loss

@@ -12,3 +12,9 @@ from service_account_auth_improvements_tpu.train.mfu import (  # noqa: F401
     chip_peak_flops,
     mfu,
 )
+# NOTE: the `evaluate` *function* is deliberately not re-exported here —
+# it would shadow the `train.evaluate` submodule attribute. Use
+# `train.evaluate.evaluate(...)` or this step factory.
+from service_account_auth_improvements_tpu.train.evaluate import (  # noqa: F401
+    make_eval_step,
+)

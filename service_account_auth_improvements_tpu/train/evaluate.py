@@ -1,0 +1,86 @@
+"""Held-out evaluation: masked next-token loss and perplexity.
+
+One jitted forward per batch (no grads, no optimizer state), sharded by
+the same mesh/logical rules as training — the lifecycle step between
+``train.loop.fit`` and ``models.generate``. Token-weighted accounting:
+batches contribute by their real (unmasked) token counts, so ragged
+final batches and padding don't skew the mean.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from service_account_auth_improvements_tpu.models import llama
+
+
+def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None):
+    """Return jitted ``eval_step(params, tokens, mask) -> (nll_sum, n)``:
+    summed next-token NLL over unmasked target positions and the count —
+    the caller aggregates across batches."""
+    from jax.sharding import NamedSharding
+    from service_account_auth_improvements_tpu.parallel.sharding import (
+        DEFAULT_RULES,
+        logical_to_mesh,
+    )
+
+    def step(params, tokens, mask):
+        m = mask[:, 1:].astype(jnp.float32)
+        n = m.sum()
+        # pure CE: the MoE load-balance term is a training regularizer
+        # and does not belong in perplexity
+        loss = llama.next_token_loss(
+            cfg, params, tokens, mask, include_aux=False
+        )
+        return loss * n, n
+
+    if mesh is None:
+        return jax.jit(step)
+    batch_sh = NamedSharding(
+        mesh, logical_to_mesh(("batch", None), rules or DEFAULT_RULES)
+    )
+    return jax.jit(step, in_shardings=(None, batch_sh, batch_sh))
+
+
+def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
+             rules=None, step=None) -> dict:
+    """Aggregate eval over an iterable of ``(tokens, mask)`` (or bare
+    ``tokens``) batches → ``{"loss", "perplexity", "tokens"}``.
+
+    Pass a prebuilt ``step`` (from :func:`make_eval_step`) when calling
+    periodically from a training loop — otherwise each call builds a
+    fresh jitted closure and pays a full recompile.
+    Raises on an empty/exhausted ``batches`` iterable rather than
+    reporting a perfect-looking 0-token score."""
+    step = step or make_eval_step(cfg, mesh=mesh, rules=rules)
+    total, count = 0.0, 0.0
+
+    def run(tokens, mask):
+        nonlocal total, count
+        s, n = step(params, tokens, mask)
+        total += float(s)
+        count += float(n)
+
+    for batch in batches:
+        if isinstance(batch, (tuple, list)):
+            tokens, mask = batch
+        else:
+            tokens, mask = batch, jnp.ones_like(batch)
+        if mesh is not None:
+            with jax.set_mesh(mesh):
+                run(tokens, mask)
+        else:
+            run(tokens, mask)
+    if count == 0:
+        raise ValueError(
+            "evaluate() saw no tokens — empty or already-exhausted "
+            "batches iterable?"
+        )
+    loss = total / count
+    return {
+        "loss": loss,
+        "perplexity": float(np.exp(min(loss, 80.0))),
+        "tokens": int(count),
+    }
