@@ -577,13 +577,24 @@ def _chunked_nll(cfg: LlamaConfig, x, lm_head, targets):
     return nll[:, :t]
 
 
+_SAME_AS_MASK = object()
+
+
 def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None,
-                    include_aux: bool = True):
+                    include_aux: bool = True,
+                    token_mask=_SAME_AS_MASK):
     """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
     (1.0 where the *target* position counts). With ``cfg.loss_chunk`` the
     vocab projection + log-softmax run in sequence chunks (see
     ``_chunked_nll``). ``include_aux=False`` returns the pure CE without
-    the MoE load-balance regularizer (evaluation/perplexity)."""
+    the MoE load-balance regularizer (evaluation/perplexity).
+
+    ``token_mask`` is the *validity* mask fed to the backbone (MoE
+    routing/capacity: 0 = padding, not a real token). By default it
+    follows ``mask`` — the right-padding interpretation. For PACKED
+    corpora pass ``token_mask=None``: every position is a real token
+    that must route/attend normally, and ``mask`` only zeroes the
+    cross-document loss targets."""
     # Run the backbone on the FULL sequence and drop the last hidden
     # state after: causality makes positions 0..s-2 identical either
     # way, while keeping the in-model sequence length divisible by the
@@ -591,7 +602,9 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None,
     # an s-1 length; truncating before the forward broke seq % sp == 0).
     # The last (real) token also now participates in MoE routing
     # statistics, which is the more faithful accounting.
-    x, aux = _backbone(cfg, params, tokens, token_mask=mask)
+    if token_mask is _SAME_AS_MASK:
+        token_mask = mask
+    x, aux = _backbone(cfg, params, tokens, token_mask=token_mask)
     x = x[:, :-1]
     # clip like the embedding path: an out-of-range target would one-hot
     # to all-zeros and make nll = logz instead of a real cross-entropy

@@ -28,6 +28,36 @@ class DataConfig:
     seq: int            # the trailing sub-batch epoch remainder is dropped
     shuffle: bool = True
     seed: int = 0
+    # Document separator id for packed corpora (``pack_documents``).
+    # When set, batches come with a loss mask that zeroes the
+    # cross-document target (predicting a new document's first token
+    # from the previous document is noise, the standard packing rule).
+    eos_id: int | None = None
+
+
+def pack_documents(docs, eos_id: int, dtype=np.int32) -> np.ndarray:
+    """Concatenate token sequences into one flat stream with ``eos_id``
+    after each document — the packed-pretraining layout ``TokenBatches``
+    windows over. Pairs with ``DataConfig(eos_id=...)`` so the loss mask
+    stops gradients flowing across document boundaries."""
+    out = np.empty(sum(len(d) + 1 for d in docs), dtype=dtype)
+    i = 0
+    for d in docs:
+        n = len(d)
+        out[i:i + n] = np.asarray(d, dtype=dtype)
+        out[i + n] = eos_id
+        i += n + 1
+    return out
+
+
+def boundary_mask(tokens: np.ndarray, eos_id: int) -> np.ndarray:
+    """Loss mask for packed windows: a position whose PREVIOUS token is
+    ``eos_id`` starts a new document — predicting it is masked out.
+    (The EOS targets themselves stay on: the model should learn to end
+    documents.) Shape-preserving, float32 in {0, 1}."""
+    mask = np.ones_like(tokens, dtype=np.float32)
+    mask[:, 1:] = np.where(tokens[:, :-1] == eos_id, 0.0, 1.0)
+    return mask
 
 
 class TokenBatches:
@@ -90,8 +120,32 @@ class TokenBatches:
             self._sharding, rows, (self.cfg.batch, self.cfg.seq)
         )
 
+    def masked_batch_at(self, step: int) -> tuple[jax.Array, jax.Array]:
+        """``(tokens, loss_mask)`` — all-ones mask unless ``eos_id`` is
+        configured, in which case cross-document targets are zeroed
+        (the on-device equivalent of ``boundary_mask``; elementwise, so
+        the mask inherits the tokens' batch sharding on any host
+        layout). Same purity contract as ``batch_at``."""
+        import jax.numpy as jnp
+
+        tokens = self.batch_at(step)
+        if self.cfg.eos_id is None:
+            return tokens, jnp.ones_like(tokens)
+        prev_is_eos = jnp.pad(
+            tokens[:, :-1] == self.cfg.eos_id, ((0, 0), (1, 0)),
+            constant_values=False,
+        )
+        return tokens, (~prev_is_eos).astype(jnp.int32)
+
     def __iter__(self):
+        """Yields bare token batches, or ``(tokens, loss_mask)`` pairs
+        when ``eos_id`` is configured — so downstream consumers
+        (``train.evaluate``) score packed corpora with the same
+        boundary masking training used."""
         step = 0
         while True:
-            yield self.batch_at(step)
+            if self.cfg.eos_id is None:
+                yield self.batch_at(step)
+            else:
+                yield self.masked_batch_at(step)
             step += 1

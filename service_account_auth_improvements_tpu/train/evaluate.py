@@ -16,10 +16,13 @@ import numpy as np
 from service_account_auth_improvements_tpu.models import llama
 
 
-def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None):
+def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None,
+                   packed: bool = False):
     """Return jitted ``eval_step(params, tokens, mask) -> (nll_sum, n)``:
     summed next-token NLL over unmasked target positions and the count —
-    the caller aggregates across batches."""
+    the caller aggregates across batches. ``packed=True`` treats the
+    mask as a pure loss mask (packed corpus: every token routes/attends;
+    see ``make_train_step``)."""
     from jax.sharding import NamedSharding
     from service_account_auth_improvements_tpu.parallel.sharding import (
         DEFAULT_RULES,
@@ -32,7 +35,8 @@ def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None):
         # pure CE: the MoE load-balance term is a training regularizer
         # and does not belong in perplexity
         loss = llama.next_token_loss(
-            cfg, params, tokens, mask, include_aux=False
+            cfg, params, tokens, mask, include_aux=False,
+            token_mask=None if packed else mask,
         )
         return loss * n, n
 
@@ -45,7 +49,7 @@ def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None):
 
 
 def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
-             rules=None, step=None) -> dict:
+             rules=None, step=None, packed: bool = False) -> dict:
     """Aggregate eval over an iterable of ``(tokens, mask)`` (or bare
     ``tokens``) batches → ``{"loss", "perplexity", "tokens"}``.
 
@@ -54,7 +58,8 @@ def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
     fresh jitted closure and pays a full recompile.
     Raises on an empty/exhausted ``batches`` iterable rather than
     reporting a perfect-looking 0-token score."""
-    step = step or make_eval_step(cfg, mesh=mesh, rules=rules)
+    step = step or make_eval_step(cfg, mesh=mesh, rules=rules,
+                                  packed=packed)
     total, count = 0.0, 0.0
 
     def run(tokens, mask):

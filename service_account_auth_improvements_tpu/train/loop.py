@@ -73,13 +73,16 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
         state = init_train_state(cfg, jax.random.key(0), optimizer=optimizer)
         state = jax.device_put(state, state_shardings(mesh, cfg, state))
 
-    step_fn = make_train_step(cfg, optimizer=optimizer, mesh=mesh)
+    step_fn = make_train_step(
+        cfg, optimizer=optimizer, mesh=mesh,
+        packed=data_cfg.eos_id is not None,
+    )
     history = []
     t0 = time.perf_counter()
     with jax.set_mesh(mesh):
         for i in range(start, loop.steps):
-            batch = data.batch_at(i)
-            state, metrics = step_fn(state, batch, jnp.ones_like(batch))
+            batch, mask = data.masked_batch_at(i)
+            state, metrics = step_fn(state, batch, mask)
             if loop.log_every and (i + 1) % loop.log_every == 0:
                 loss = float(metrics["loss"])
                 history.append({"step": i + 1, "loss": loss})

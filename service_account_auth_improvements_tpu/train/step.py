@@ -108,7 +108,8 @@ def state_shardings(mesh, cfg: llama.LlamaConfig, state: TrainState,
 
 
 def make_train_step(cfg: llama.LlamaConfig, optimizer=None, mesh=None,
-                    rules=None, grad_accum: int = 1):
+                    rules=None, grad_accum: int = 1,
+                    packed: bool = False):
     """Return jitted ``step(state, tokens, mask) -> (state, metrics)``.
 
     When ``mesh`` is given the function is partitioned: batch over
@@ -122,11 +123,18 @@ def make_train_step(cfg: llama.LlamaConfig, optimizer=None, mesh=None,
     single-pass values when the token mask is uniform; with ragged
     padding, per-micro-batch means are averaged, the standard
     accumulation semantics). Requires ``batch % grad_accum == 0``.
+
+    ``packed=True`` declares the mask a pure LOSS mask over a packed
+    corpus (every token is real): MoE routing/capacity then sees all
+    tokens instead of treating document-initial positions as padding.
     """
     optimizer = optimizer or make_optimizer()
 
     def loss_fn(params, tokens, mask):
-        return llama.next_token_loss(cfg, params, tokens, mask)
+        return llama.next_token_loss(
+            cfg, params, tokens, mask,
+            token_mask=None if packed else mask,
+        )
 
     def step_fn(state: TrainState, tokens, mask):
         if grad_accum == 1:

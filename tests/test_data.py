@@ -102,3 +102,89 @@ def test_indivisible_process_split_raises(mesh):
     with pytest.raises(ValueError):
         TokenBatches(TOKENS, DataConfig(batch=10, seq=64), mesh,
                      process_index=0, process_count=4)
+
+
+def test_pack_documents_and_boundary_mask():
+    from service_account_auth_improvements_tpu.train.data import (
+        boundary_mask,
+        pack_documents,
+    )
+
+    docs = [[1, 2, 3], [4, 5], [6]]
+    flat = pack_documents(docs, eos_id=0)
+    np.testing.assert_array_equal(flat, [1, 2, 3, 0, 4, 5, 0, 6, 0])
+    window = flat[:8].reshape(1, 8)
+    mask = boundary_mask(window, eos_id=0)
+    # positions after an EOS (new-document starts: indices 4 and 7) are
+    # masked; EOS targets themselves stay on
+    np.testing.assert_array_equal(mask, [[1, 1, 1, 1, 0, 1, 1, 0]])
+
+
+def test_masked_batch_at_zeroes_cross_document_targets():
+    from service_account_auth_improvements_tpu.train.data import (
+        pack_documents,
+    )
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, tp=1), jax.devices()[:2])
+    docs = [list(range(1, 6))] * 20
+    flat = pack_documents(docs, eos_id=0)
+    cfg = DataConfig(batch=2, seq=12, shuffle=False, eos_id=0)
+    data = TokenBatches(flat, cfg, mesh)
+    toks, mask = data.masked_batch_at(0)
+    toks, mask = np.asarray(toks), np.asarray(mask)
+    # every position right after a 0 (EOS) must be masked out
+    want = np.ones_like(toks)
+    want[:, 1:] = (toks[:, :-1] != 0)
+    np.testing.assert_array_equal(mask, want)
+    assert (mask == 0).any()  # the packing actually produced boundaries
+
+
+def test_masked_batch_at_without_eos_is_all_ones():
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, tp=1), jax.devices()[:2])
+    tokens = np.arange(4 * 64, dtype=np.int32) % 100
+    data = TokenBatches(tokens, DataConfig(batch=2, seq=16), mesh)
+    _, mask = data.masked_batch_at(0)
+    assert np.asarray(mask).all()
+
+
+def test_packed_mask_does_not_starve_moe_routing():
+    """packed=True must route document-initial tokens through the MoE
+    FFN: loss with (packed loss-mask + full routing) differs from the
+    padding interpretation where those tokens skip their expert."""
+    import dataclasses as dc
+
+    import jax.numpy as jnp
+
+    from service_account_auth_improvements_tpu.models import llama
+
+    cfg = dc.replace(llama.PRESETS["moe_smoke"], dtype="float32",
+                     param_dtype="float32", remat=False)
+    params = llama.init(cfg, jax.random.key(0))
+    toks = jnp.asarray(
+        np.random.default_rng(3).integers(1, cfg.vocab_size, size=(2, 32)),
+        jnp.int32,
+    )
+    mask = jnp.ones_like(toks).at[:, 5].set(0).at[:, 20].set(0)
+    as_padding = float(llama.next_token_loss(cfg, params, toks, mask))
+    as_packed = float(llama.next_token_loss(
+        cfg, params, toks, mask, token_mask=None))
+    assert as_padding != as_packed
+    # and the packed interpretation equals hand-passing a ones validity
+    explicit = float(llama.next_token_loss(
+        cfg, params, toks, mask, token_mask=jnp.ones_like(toks)))
+    assert abs(as_packed - explicit) < 1e-6
+
+
+def test_iterator_yields_masked_pairs_for_packed_config():
+    from service_account_auth_improvements_tpu.train.data import (
+        pack_documents,
+    )
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=1, tp=1), jax.devices()[:2])
+    flat = pack_documents([list(range(1, 6))] * 20, eos_id=0)
+    it = iter(TokenBatches(flat, DataConfig(batch=2, seq=12, eos_id=0),
+                           mesh))
+    first = next(it)
+    assert isinstance(first, tuple) and len(first) == 2
+    toks, mask = first
+    assert toks.shape == mask.shape == (2, 12)
