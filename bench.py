@@ -213,6 +213,14 @@ def _child_main() -> None:
     iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
     grad_accum = int(os.environ.get("SATPU_BENCH_GRAD_ACCUM", "1"))
 
+    profile_dir = os.environ.get("SATPU_BENCH_PROFILE")
+    if profile_dir:
+        # capture an XLA trace of a few measured steps (open with
+        # tensorboard / xprof) — the step-level evidence behind the
+        # breakdown numbers
+        with jax.profiler.trace(profile_dir):
+            tok_per_sec, mfu, dt = _run_config(
+                cfg, batch, seq, min(iters, 3), grad_accum=grad_accum)
     tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters,
                                        grad_accum=grad_accum)
 

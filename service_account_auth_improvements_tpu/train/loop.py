@@ -29,6 +29,7 @@ from service_account_auth_improvements_tpu.parallel import (
     make_mesh,
 )
 from service_account_auth_improvements_tpu.train import checkpoint as ckpt
+from service_account_auth_improvements_tpu.train.mfu import mfu
 from service_account_auth_improvements_tpu.train.data import (
     DataConfig,
     TokenBatches,
@@ -78,17 +79,34 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
         packed=data_cfg.eos_id is not None,
     )
     history = []
-    t0 = time.perf_counter()
+    tokens_per_step = data_cfg.batch * (data_cfg.seq - 1)
+    t0 = timed_from = None
     with jax.set_mesh(mesh):
         for i in range(start, loop.steps):
             batch, mask = data.masked_batch_at(i)
             state, metrics = step_fn(state, batch, mask)
+            if t0 is None:
+                # the first executed step carries JIT compilation; start
+                # the throughput clock after it so history records real
+                # step time, not amortized compile
+                jax.block_until_ready(metrics["loss"])
+                t0, timed_from = time.perf_counter(), i + 1
             if loop.log_every and (i + 1) % loop.log_every == 0:
                 loss = float(metrics["loss"])
-                history.append({"step": i + 1, "loss": loss})
-                dt = time.perf_counter() - t0
+                steps_timed = max(1, i + 1 - timed_from)
+                step_s = (time.perf_counter() - t0) / steps_timed
+                tok_s = tokens_per_step / step_s
+                rec = {"step": i + 1, "loss": loss,
+                       "tokens_per_sec": round(tok_s, 1)}
+                util = mfu(cfg.flops_per_token(data_cfg.seq)
+                           * tokens_per_step, step_s, mesh.size)
+                if util:
+                    rec["mfu"] = round(util, 4)
+                history.append(rec)
                 log(f"step {i + 1}/{loop.steps} loss={loss:.4f} "
-                    f"({dt / max(1, i + 1 - start):.2f}s/step)")
+                    f"({step_s:.2f}s/step, {tok_s:,.0f} tok/s"
+                    + (f", mfu={rec['mfu']:.3f}" if "mfu" in rec else "")
+                    + ")")
             if (loop.workdir is not None and loop.ckpt_every
                     and (i + 1) % loop.ckpt_every == 0):
                 ckpt.save(loop.workdir, state)
