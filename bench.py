@@ -179,6 +179,41 @@ def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1):
     return {k: round(v, 4) for k, v in res.items()}
 
 
+def _decode_row(dcfg, batch_d=8, prompt_len=128, new_tokens=128):
+    """KV-cache decode throughput: generated tokens/sec/chip at bf16
+    params (the serving configuration)."""
+    import jax
+
+    from service_account_auth_improvements_tpu.models import generate, llama
+
+    cfg_d = dataclasses.replace(dcfg, param_dtype="bfloat16")
+    params = llama.init(cfg_d, jax.random.key(0))
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch_d, prompt_len), 0, cfg_d.vocab_size,
+        dtype="int32",
+    )
+    def timed(n):
+        out = generate.generate(cfg_d, params, prompt, n)
+        _ = int(out[0, -1])  # compile + sync
+        t0 = time.perf_counter()
+        out = generate.generate(cfg_d, params, prompt, n)
+        _ = int(out[0, -1])
+        return time.perf_counter() - t0
+
+    # a 1-new-token run is prefill + sampling only; subtracting it
+    # isolates the decode-scan window so this row tracks the decode
+    # kernels, not the prefill einsum
+    t_prefill = timed(1)
+    dt = timed(new_tokens) - t_prefill
+    return {
+        "preset": "decode_bf16", "batch": batch_d,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "prefill_s": round(t_prefill, 4),
+        "decode_tokens_per_sec": round(
+            batch_d * (new_tokens - 1) / max(dt, 1e-9), 1),
+    }
+
+
 def _child_main() -> None:
     if os.environ.get("SATPU_BENCH_CPU"):
         import jax
@@ -292,6 +327,12 @@ def _child_main() -> None:
                 })
             except Exception as e:  # pragma: no cover - survive matrix rows
                 matrix.append({"preset": name, "error": str(e)[:200]})
+        try:
+            # serving-side metric: KV-cache decode throughput on the
+            # 400m geometry (bf16 params)
+            matrix.append(_decode_row(llama.PRESETS["bench_400m"]))
+        except Exception as e:  # pragma: no cover - survive matrix rows
+            matrix.append({"preset": "decode_bf16", "error": str(e)[:200]})
 
     print(
         json.dumps(

@@ -360,3 +360,46 @@ def test_multislice_mesh_dp_spans_slices():
     second = devs[1].ravel()
     ids = [d.id for d in first] + [d.id for d in second]
     assert ids == sorted(ids)
+
+
+def test_multislice_with_pipeline_inside_slice():
+    """2 DCN slices (dp) × pipeline (pp=2) × tp=2 inside each slice: the
+    layer pipeline's ppermute ring stays intra-slice while the gradient
+    all-reduce crosses slices — one full train step, finite loss."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from service_account_auth_improvements_tpu.models import llama
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_multislice_mesh,
+    )
+    from service_account_auth_improvements_tpu.train import (
+        init_train_state,
+        make_train_step,
+    )
+    from service_account_auth_improvements_tpu.train.step import (
+        state_shardings,
+    )
+
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], n_layers=4)
+    mesh = make_multislice_mesh(
+        2, MeshConfig(pp=2, fsdp=1, tp=2, sp=1, ep=1), jax.devices()[:8]
+    )
+    assert mesh.shape["dp"] == 2 and mesh.shape["pp"] == 2
+    state = init_train_state(cfg, jax.random.key(0))
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, mesh=mesh)
+    toks = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                              cfg.vocab_size, dtype="int32")
+    sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    toks = jax.device_put(toks, sh)
+    mask = jax.device_put(jnp.ones_like(toks), sh)
+    with jax.set_mesh(mesh):
+        state, m = step(state, toks, mask)
+        state, m = step(state, toks, mask)
+    assert jnp.isfinite(m["loss"])
+    assert int(state.step) == 2
