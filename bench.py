@@ -205,12 +205,17 @@ def _decode_row(dcfg, batch_d=8, prompt_len=128, new_tokens=128):
     # kernels, not the prefill einsum
     t_prefill = timed(1)
     dt = timed(new_tokens) - t_prefill
+    if dt <= 0:
+        # a timing anomaly (flaky remote runtime) — record it as such
+        # rather than an astronomical-looking throughput number
+        return {"preset": "decode_bf16",
+                "error": f"non-positive decode window ({dt:.4f}s)"}
     return {
         "preset": "decode_bf16", "batch": batch_d,
         "prompt_len": prompt_len, "new_tokens": new_tokens,
         "prefill_s": round(t_prefill, 4),
         "decode_tokens_per_sec": round(
-            batch_d * (new_tokens - 1) / max(dt, 1e-9), 1),
+            batch_d * (new_tokens - 1) / dt, 1),
     }
 
 

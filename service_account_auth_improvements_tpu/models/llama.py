@@ -388,9 +388,12 @@ def _moe_ffn(cfg: LlamaConfig, h, lp, token_mask=None):
     return out.reshape(b, s, d), aux
 
 
-def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
+def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None,
+           segment_ids=None):
     """One decoder block. x: [b, s, dim] in compute dtype.
-    Returns (x, aux) — aux is the MoE load-balance term (0 for dense)."""
+    Returns (x, aux) — aux is the MoE load-balance term (0 for dense).
+    ``segment_ids`` [b, s] adds block-diagonal (packed-document)
+    attention masking — dense impl only (ops/attention.py)."""
     b, s, _ = x.shape
     cdt = jnp.dtype(cfg.dtype)
 
@@ -401,7 +404,8 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
     q = shard_constraint(q, ("batch", "seq", "heads", None))
     k = shard_constraint(k, ("batch", "seq", "kv_heads", None))
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    attn = multi_head_attention(q, k, v, impl=cfg.attn_impl)
+    attn = multi_head_attention(q, k, v, impl=cfg.attn_impl,
+                                segment_ids=segment_ids)
     x = x + attn.reshape(b, s, cfg.q_dim) @ lp["wo"].astype(cdt)
 
     h = rms_norm(x, lp["mlp_norm"].astype(cdt), cfg.norm_eps)
@@ -418,7 +422,7 @@ def _layer(cfg: LlamaConfig, x, lp, cos, sin, token_mask=None):
 
 
 def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
-              return_layer_inputs: bool = False):
+              return_layer_inputs: bool = False, segment_ids=None):
     """Embed + decoder stack + final norm: tokens [b, s] → (x [b, s, dim]
     in compute dtype, MoE aux loss). The lm_head projection is applied by
     the caller (``apply`` for full logits, ``next_token_loss`` possibly in
@@ -473,20 +477,29 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
                 "under pipeline parallelism; run generation on a pp=1 mesh"
             )
         # cos/sin are position tables (no batch dim) — plain consts; the
-        # token mask is per-token and must follow its microbatch through
-        # the stages. _layer's trailing arg order matches the
+        # token mask and segment ids are per-token and must follow their
+        # microbatch through the stages. _layer's trailing arg order is
+        # (cos, sin, token_mask, segment_ids), so None placeholders go
+        # into consts and batch-shaped arrays into batched_consts,
+        # preserving positional alignment under the
         # (*consts, *batched_consts) call convention.
-        if token_mask is None:
-            consts, batched = (cos, sin, None), ()
-        else:
-            consts, batched = (cos, sin), (token_mask,)
+        tail = [token_mask, segment_ids]
+        while tail and tail[-1] is None:
+            tail.pop()  # trailing Nones: _layer defaults cover them
+        batched = tuple(
+            # a None before a later batched arg must hold its position;
+            # the all-ones validity mask is the identity token_mask
+            jnp.ones(x.shape[:2], jnp.int32) if arg is None else arg
+            for arg in tail
+        )
         x, aux = pipeline_layers(
-            layer_fn, params["layers"], x, consts, batched,
+            layer_fn, params["layers"], x, (cos, sin), batched,
             n_micro=cfg.pp_microbatches,
         )
     elif cfg.scan_layers:
         def body(carry, lp):
-            new_x, aux = layer_fn(carry, lp, cos, sin, token_mask)
+            new_x, aux = layer_fn(carry, lp, cos, sin, token_mask,
+                                  segment_ids)
             ys = (aux, carry) if return_layer_inputs else aux
             return new_x, ys
         x, ys = jax.lax.scan(body, x, params["layers"])
@@ -502,7 +515,8 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
             lp = jax.tree.map(lambda a: a[i], params["layers"])
             if return_layer_inputs:
                 inputs.append(x)
-            x, layer_aux = layer_fn(x, lp, cos, sin, token_mask)
+            x, layer_aux = layer_fn(x, lp, cos, sin, token_mask,
+                                    segment_ids)
             aux = aux + layer_aux
         if return_layer_inputs:
             layer_inputs = jnp.stack(inputs)
@@ -514,12 +528,14 @@ def _backbone(cfg: LlamaConfig, params, tokens: jax.Array, token_mask=None,
 
 
 def apply(cfg: LlamaConfig, params, tokens: jax.Array,
-          return_aux: bool = False, token_mask=None):
+          return_aux: bool = False, token_mask=None, segment_ids=None):
     """Forward pass: tokens [b, s] int32 → logits [b, s, vocab] fp32.
     With ``return_aux`` also returns the summed MoE load-balance loss.
     ``token_mask`` [b, s] (1.0 = real token) keeps padding out of MoE
-    routing capacity and balance statistics."""
-    x, aux = _backbone(cfg, params, tokens, token_mask)
+    routing capacity and balance statistics. ``segment_ids`` [b, s]
+    blocks attention across packed-document boundaries (dense impl)."""
+    x, aux = _backbone(cfg, params, tokens, token_mask,
+                       segment_ids=segment_ids)
     logits = jnp.einsum(
         "bsd,dv->bsv", x, params["lm_head"].astype(jnp.dtype(cfg.dtype)),
         preferred_element_type=jnp.float32,
@@ -582,7 +598,7 @@ _SAME_AS_MASK = object()
 
 def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None,
                     include_aux: bool = True,
-                    token_mask=_SAME_AS_MASK):
+                    token_mask=_SAME_AS_MASK, segment_ids=None):
     """Mean next-token cross-entropy. tokens [b, s]; mask [b, s] optional
     (1.0 where the *target* position counts). With ``cfg.loss_chunk`` the
     vocab projection + log-softmax run in sequence chunks (see
@@ -604,7 +620,8 @@ def next_token_loss(cfg: LlamaConfig, params, tokens, mask=None,
     # statistics, which is the more faithful accounting.
     if token_mask is _SAME_AS_MASK:
         token_mask = mask
-    x, aux = _backbone(cfg, params, tokens, token_mask=token_mask)
+    x, aux = _backbone(cfg, params, tokens, token_mask=token_mask,
+                       segment_ids=segment_ids)
     x = x[:, :-1]
     # clip like the embedding path: an out-of-range target would one-hot
     # to all-zeros and make nll = logz instead of a real cross-entropy

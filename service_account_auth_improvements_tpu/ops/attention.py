@@ -25,11 +25,16 @@ import jax.numpy as jnp
 NEG_INF = -2.0e38
 
 
-def _dense_attention(q, k, v, scale: float, causal: bool = True):
+def _dense_attention(q, k, v, scale: float, causal: bool = True,
+                     segment_ids=None):
     """Causal softmax attention with GQA via head-group einsum.
 
     q: [b, sq, hq, d]; k/v: [b, sk, hkv, d]; hq = hkv * g.
     Softmax in fp32; logits never materialized in bf16.
+    ``segment_ids`` [b, s] (packed corpora): attention is additionally
+    blocked across segment boundaries, so tokens of one document never
+    attend into a neighbouring document in the same window. Requires
+    sq == sk (training shapes).
     """
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
@@ -43,14 +48,34 @@ def _dense_attention(q, k, v, scale: float, causal: bool = True):
         # Supports sk >= sq (kv prefix longer than queries, e.g. ring steps).
         mask = q_pos + (sk - sq) >= k_pos
         logits = jnp.where(mask[None, None, None], logits, NEG_INF)
+    if segment_ids is not None:
+        if sq != sk:
+            raise ValueError("segment_ids need sq == sk")
+        same = segment_ids[:, :, None] == segment_ids[:, None, :]
+        # [b, sq, sk] -> broadcast over (hkv, g): logits are [b,h,g,q,k]
+        logits = jnp.where(same[:, None, None], logits, NEG_INF)
+        # a fully-masked row would softmax over -inf only; the causal
+        # diagonal (self) is always same-segment, so rows stay finite
     probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
     return out.reshape(b, sq, hq, d)
 
 
-def multi_head_attention(q, k, v, *, impl: str = "dense", causal: bool = True):
-    """Dispatch attention. Returns ``[b, sq, hq, d]`` in q.dtype."""
+def multi_head_attention(q, k, v, *, impl: str = "dense",
+                         causal: bool = True, segment_ids=None):
+    """Dispatch attention. Returns ``[b, sq, hq, d]`` in q.dtype.
+
+    ``segment_ids`` (packed-sequence block-diagonal masking) is a
+    dense-path feature: the flash/ring/ulysses kernels do not thread a
+    segment mask, so passing it with those impls raises rather than
+    silently attending across documents."""
     scale = q.shape[-1] ** -0.5
+    if segment_ids is not None and impl != "dense":
+        raise ValueError(
+            f"segment_ids requires attn_impl='dense' (got {impl!r}); "
+            "packed windows under flash/ring/ulysses train with the "
+            "boundary loss mask only"
+        )
     if impl == "flash":
         from service_account_auth_improvements_tpu.ops.flash_attention import (
             flash_attention,
@@ -71,4 +96,5 @@ def multi_head_attention(q, k, v, *, impl: str = "dense", causal: bool = True):
         return ulysses_attention(q, k, v, causal=causal)
     if impl != "dense":
         raise ValueError(f"unknown attention impl {impl!r}")
-    return _dense_attention(q, k, v, scale, causal=causal)
+    return _dense_attention(q, k, v, scale, causal=causal,
+                            segment_ids=segment_ids)

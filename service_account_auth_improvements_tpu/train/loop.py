@@ -74,9 +74,13 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
         state = init_train_state(cfg, jax.random.key(0), optimizer=optimizer)
         state = jax.device_put(state, state_shardings(mesh, cfg, state))
 
+    packed = data_cfg.eos_id is not None
     step_fn = make_train_step(
-        cfg, optimizer=optimizer, mesh=mesh,
-        packed=data_cfg.eos_id is not None,
+        cfg, optimizer=optimizer, mesh=mesh, packed=packed,
+        # segment-masked attention is a dense-impl feature; flash/ring/
+        # ulysses windows train with the boundary loss mask only
+        segment_eos_id=(data_cfg.eos_id
+                        if packed and cfg.attn_impl == "dense" else None),
     )
     history = []
     tokens_per_step = data_cfg.batch * (data_cfg.seq - 1)

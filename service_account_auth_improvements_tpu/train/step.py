@@ -109,7 +109,8 @@ def state_shardings(mesh, cfg: llama.LlamaConfig, state: TrainState,
 
 def make_train_step(cfg: llama.LlamaConfig, optimizer=None, mesh=None,
                     rules=None, grad_accum: int = 1,
-                    packed: bool = False):
+                    packed: bool = False,
+                    segment_eos_id: int | None = None):
     """Return jitted ``step(state, tokens, mask) -> (state, metrics)``.
 
     When ``mesh`` is given the function is partitioned: batch over
@@ -127,13 +128,28 @@ def make_train_step(cfg: llama.LlamaConfig, optimizer=None, mesh=None,
     ``packed=True`` declares the mask a pure LOSS mask over a packed
     corpus (every token is real): MoE routing/capacity then sees all
     tokens instead of treating document-initial positions as padding.
+
+    ``segment_eos_id`` additionally derives per-window segment ids from
+    the tokens (cumulative count of EOS separators, computed inside the
+    jitted step) and blocks attention across document boundaries —
+    dense attention only (ops/attention.py raises otherwise).
     """
     optimizer = optimizer or make_optimizer()
 
     def loss_fn(params, tokens, mask):
+        segment_ids = None
+        if segment_eos_id is not None:
+            # segment = number of EOS tokens strictly before a position:
+            # every document (and its trailing EOS) gets one id
+            prev_eos = jnp.pad(
+                tokens[:, :-1] == segment_eos_id, ((0, 0), (1, 0)),
+                constant_values=False,
+            )
+            segment_ids = jnp.cumsum(prev_eos.astype(jnp.int32), axis=1)
         return llama.next_token_loss(
             cfg, params, tokens, mask,
             token_mask=None if packed else mask,
+            segment_ids=segment_ids,
         )
 
     def step_fn(state: TrainState, tokens, mask):

@@ -1,0 +1,109 @@
+"""Segment-masked (block-diagonal) attention for packed sequences:
+a packed window must behave as if each document ran alone."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.ops.attention import (
+    multi_head_attention,
+)
+
+CFG = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32",
+                          param_dtype="float32", remat=False)
+
+
+def test_packed_window_matches_separate_documents():
+    """The strongest packing check: logits of two documents packed into
+    one window with segment ids equal the logits of each document run
+    in its own forward pass (positions restart per document? No — RoPE
+    positions are absolute within the window, so compare against the
+    same-position slice of a window containing ONLY that document)."""
+    params = llama.init(CFG, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    doc_a = rng.integers(1, CFG.vocab_size, size=8)
+    doc_b = rng.integers(1, CFG.vocab_size, size=7)
+    eos = 0
+    packed = np.concatenate([doc_a, [eos], doc_b, [eos]])[None].astype(
+        np.int32
+    )  # [1, 17]
+    seg = np.zeros_like(packed)
+    seg[0, 9:] = 1  # doc_b + its EOS
+    got = np.asarray(llama.apply(CFG, params, packed,
+                                 segment_ids=jnp.asarray(seg)))
+    # doc_a alone occupies the same absolute positions 0..8
+    alone_a = np.asarray(llama.apply(CFG, params, packed[:, :9]))
+    np.testing.assert_allclose(got[:, :9], alone_a, atol=2e-5)
+    # doc_b: to hold absolute positions fixed, run it with doc_a's span
+    # replaced by a DIFFERENT prefix — if segments isolate, logits over
+    # doc_b's span must be unchanged
+    other = packed.copy()
+    other[0, :9] = rng.integers(1, CFG.vocab_size, size=9)
+    got_other = np.asarray(llama.apply(CFG, params, other,
+                                       segment_ids=jnp.asarray(seg)))
+    np.testing.assert_allclose(got[:, 9:], got_other[:, 9:], atol=2e-5)
+
+
+def test_without_segments_documents_leak():
+    """Control: WITHOUT segment ids, changing the first document changes
+    the second document's logits (attention leaks across) — proving the
+    previous test's isolation comes from the segment mask."""
+    params = llama.init(CFG, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    packed = rng.integers(1, CFG.vocab_size, size=(1, 17)).astype(np.int32)
+    other = packed.copy()
+    other[0, :9] = rng.integers(1, CFG.vocab_size, size=9)
+    a = np.asarray(llama.apply(CFG, params, packed))
+    b = np.asarray(llama.apply(CFG, params, other))
+    assert not np.allclose(a[:, 9:], b[:, 9:], atol=1e-4)
+
+
+def test_segment_ids_rejected_for_flash():
+    q = jnp.zeros((1, 8, 4, 16))
+    kv = jnp.zeros((1, 8, 2, 16))
+    seg = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="requires attn_impl='dense'"):
+        multi_head_attention(q, kv, kv, impl="flash", segment_ids=seg)
+
+
+def test_train_step_with_segment_attention_descends():
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.train import (
+        init_train_state,
+        make_train_step,
+    )
+    from service_account_auth_improvements_tpu.train.data import (
+        pack_documents,
+    )
+    from service_account_auth_improvements_tpu.train.step import (
+        state_shardings,
+    )
+
+    cfg = llama.PRESETS["tiny"]
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    state = init_train_state(cfg, jax.random.key(0))
+    state = jax.device_put(state, state_shardings(mesh, cfg, state))
+    step = make_train_step(cfg, mesh=mesh, packed=True, segment_eos_id=0)
+    rng = np.random.default_rng(1)
+    flat = pack_documents(
+        [rng.integers(1, cfg.vocab_size, size=7).tolist()] * 64, eos_id=0
+    )
+    toks = jnp.asarray(flat[: 8 * 32].reshape(8, 32))
+    sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    toks = jax.device_put(toks, sh)
+    mask = jax.device_put(jnp.ones_like(toks), sh)
+    with jax.set_mesh(mesh):
+        state, m0 = step(state, toks, mask)
+        for _ in range(15):
+            state, m = step(state, toks, mask)
+    assert jnp.isfinite(m["loss"])
+    assert float(m["loss"]) < float(m0["loss"]) - 0.5
