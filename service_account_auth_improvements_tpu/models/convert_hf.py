@@ -144,3 +144,58 @@ def from_hf(model) -> tuple[llama.LlamaConfig, dict]:
         k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
     }
     return cfg, params_from_hf_state_dict(cfg, sd)
+
+
+def to_hf_state_dict(cfg: llama.LlamaConfig, params,
+                     tie_word_embeddings: bool = False) -> dict:
+    """Inverse of :func:`params_from_hf_state_dict`: native param tree →
+    HF Llama state dict (numpy float32, torch Linear ``[out, in]``
+    layout) — export a fine-tuned model back into the HF ecosystem.
+    Round-trip identity is asserted in ``tests/test_convert_hf.py``.
+    MoE trees have no HF Llama layout and are refused."""
+    if cfg.moe_experts:
+        raise ValueError(
+            "HF LlamaForCausalLM has no MoE layout; export applies to "
+            "dense configs only"
+        )
+
+    def t(x):  # [in, out] -> torch Linear [out, in]
+        return np.asarray(x, dtype=np.float32).T
+
+    def plain(x):
+        return np.asarray(x, dtype=np.float32)
+
+    L = params["layers"]
+    sd = {"model.embed_tokens.weight": plain(params["tok_embed"]),
+          "model.norm.weight": plain(params["final_norm"])}
+    per_layer = {
+        "input_layernorm.weight": (L["attn_norm"], plain),
+        "self_attn.q_proj.weight": (L["wq"], t),
+        "self_attn.k_proj.weight": (L["wk"], t),
+        "self_attn.v_proj.weight": (L["wv"], t),
+        "self_attn.o_proj.weight": (L["wo"], t),
+        "post_attention_layernorm.weight": (L["mlp_norm"], plain),
+        "mlp.gate_proj.weight": (L["w_gate"], t),
+        "mlp.up_proj.weight": (L["w_up"], t),
+        "mlp.down_proj.weight": (L["w_down"], t),
+    }
+    for i in range(cfg.n_layers):
+        for name, (stacked, transform) in per_layer.items():
+            sd[f"model.layers.{i}.{name}"] = transform(stacked[i])
+    if tie_word_embeddings:
+        # lm_head and tok_embed are separate leaves in the native tree,
+        # so fine-tuning unties them — dropping a head that diverged
+        # from the embedding would silently corrupt the exported model
+        if not np.allclose(
+            np.asarray(params["lm_head"]),
+            np.asarray(params["tok_embed"]).T,
+            atol=1e-6,
+        ):
+            raise ValueError(
+                "tie_word_embeddings=True but lm_head no longer equals "
+                "tok_embed.T (fine-tuning untied them); export with "
+                "tie_word_embeddings=False"
+            )
+    else:
+        sd["lm_head.weight"] = t(params["lm_head"])
+    return sd

@@ -152,3 +152,62 @@ def test_unconverted_weights_raise():
     sd = {k: v.numpy() for k, v in model.state_dict().items()}
     with pytest.raises(ValueError, match="unconverted weights"):
         convert_hf.params_from_hf_state_dict(cfg, sd)
+
+
+def test_export_roundtrip_identity():
+    """native → HF state dict → native must be bit-identical."""
+    model = _tiny_hf()
+    cfg, params = convert_hf.from_hf(model)
+    sd = convert_hf.to_hf_state_dict(cfg, params)
+    back = convert_hf.params_from_hf_state_dict(cfg, sd)
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(back))
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_export_loads_into_transformers_with_matching_logits():
+    """The exported state dict loads into a fresh LlamaForCausalLM and
+    reproduces the native model's logits — the full migration cycle."""
+    model = _tiny_hf()
+    cfg, params = convert_hf.from_hf(model)
+    # perturb so we're not just comparing the original weights
+    params = jax.tree.map(lambda a: a * 1.01, params)
+    sd = convert_hf.to_hf_state_dict(cfg, params)
+    fresh = transformers.LlamaForCausalLM(model.config)
+    missing, unexpected = fresh.load_state_dict(
+        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+    )
+    assert not unexpected, unexpected
+    assert all("rotary" in m or "inv_freq" in m for m in missing), missing
+    fresh.eval()
+    _compare_params(fresh, cfg, params)
+
+
+def _compare_params(model, cfg, params, atol=2e-4):
+    import dataclasses as dc
+
+    cfg = dc.replace(cfg, dtype="float32", param_dtype="float32",
+                     remat=False)
+    toks = np.random.default_rng(9).integers(
+        0, cfg.vocab_size, size=(2, 11), dtype=np.int32
+    )
+    with torch.no_grad():
+        want = model(torch.from_numpy(toks).long()).logits.numpy()
+    got = np.asarray(llama.apply(cfg, params, toks))
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+
+
+def test_export_refuses_moe():
+    cfg = convert_hf.config_from_hf(_tiny_hf().config)
+    cfg = dataclasses.replace(cfg, moe_experts=4)
+    with pytest.raises(ValueError, match="no MoE layout"):
+        convert_hf.to_hf_state_dict(cfg, {})
+
+
+def test_export_refuses_stale_tied_head():
+    model = _tiny_hf()
+    cfg, params = convert_hf.from_hf(model)
+    params = dict(params, lm_head=params["lm_head"] * 1.5)  # untied
+    with pytest.raises(ValueError, match="no longer equals"):
+        convert_hf.to_hf_state_dict(cfg, params, tie_word_embeddings=True)
