@@ -1,12 +1,16 @@
 """Model zoo for the TPU workload layer.
 
 Flagship: Llama-3 family (``llama.py``) — the BASELINE.md north-star
-workload (Llama-3-8B SPMD fine-tune at >=35% MFU). ``resnet.py`` covers
-the data-parallel vision config (#3 in BASELINE.json, ResNet-50 on a
-v5e-8 slice) and ``mnist.py`` the CPU/1-chip smoke configs (#1/#2).
+workload (Llama-3-8B SPMD fine-tune at >=35% MFU), with KV-cache
+generation (``generate.py``) and bidirectional HuggingFace checkpoint
+conversion (``convert_hf.py``, logit-parity-tested). ``resnet.py``
+covers the data-parallel vision config (#3 in BASELINE.json, ResNet-50
+on a v5e-8 slice) and ``mnist.py`` the CPU/1-chip smoke configs (#1/#2).
 """
 
 from service_account_auth_improvements_tpu.models import (  # noqa: F401
+    convert_hf,
+    generate,
     llama,
     mnist,
     resnet,

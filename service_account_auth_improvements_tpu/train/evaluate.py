@@ -72,7 +72,10 @@ def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
         if isinstance(batch, (tuple, list)):
             tokens, mask = batch
         else:
-            tokens, mask = batch, jnp.ones_like(batch)
+            # host-side ones: an uncommitted array lets jit lay the mask
+            # out per the step's in_shardings (jnp.ones_like would commit
+            # it to the default device and conflict on a mesh)
+            tokens, mask = batch, np.ones(np.shape(batch), np.int32)
         if mesh is not None:
             with jax.set_mesh(mesh):
                 run(tokens, mask)

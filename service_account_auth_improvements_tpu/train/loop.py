@@ -48,15 +48,21 @@ class LoopConfig:
     ckpt_every: int = 0          # 0 = only at the end
     log_every: int = 10
     workdir: str | None = None   # None = no checkpointing
+    eval_every: int = 0          # 0 = no periodic eval (needs eval_data)
 
 
 def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
-        loop: LoopConfig, optimizer=None, log=print):
+        loop: LoopConfig, optimizer=None, log=print, eval_data=None):
     """Train for ``loop.steps`` optimizer steps; returns (state, history).
 
     Resume: if ``loop.workdir`` holds a checkpoint, training continues
     from its step — the data pipeline's pure-in-step batches make the
     run identical to one that never stopped.
+
+    ``eval_data``: held-out batches (list of tokens or (tokens, mask)
+    pairs); with ``loop.eval_every`` set, a perplexity eval runs on that
+    cadence (one prebuilt jitted eval step — no per-eval recompiles) and
+    lands in history as ``eval_loss``/``eval_perplexity`` records.
     """
     optimizer = optimizer or make_optimizer()
     data = TokenBatches(tokens, data_cfg, mesh)
@@ -82,6 +88,14 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
         segment_eos_id=(data_cfg.eos_id
                         if packed and cfg.attn_impl == "dense" else None),
     )
+    eval_step = None
+    if loop.eval_every and eval_data is not None:
+        from service_account_auth_improvements_tpu.train import evaluate
+
+        eval_step = evaluate.make_eval_step(cfg, mesh=mesh, packed=packed)
+        # materialize once: the eval set is re-iterated every cadence,
+        # and a generator would be exhausted after the first eval
+        eval_data = list(eval_data)
     history = []
     tokens_per_step = data_cfg.batch * (data_cfg.seq - 1)
     t0 = timed_from = None
@@ -111,6 +125,20 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
                     f"({step_s:.2f}s/step, {tok_s:,.0f} tok/s"
                     + (f", mfu={rec['mfu']:.3f}" if "mfu" in rec else "")
                     + ")")
+            if eval_step is not None and (i + 1) % loop.eval_every == 0:
+                t_ev = time.perf_counter()
+                ev = evaluate.evaluate(cfg, state.params, eval_data,
+                                       step=eval_step)
+                history.append({"step": i + 1,
+                                "eval_loss": round(ev["loss"], 4),
+                                "eval_perplexity": ev["perplexity"],
+                                "eval_tokens": ev["tokens"]})
+                log(f"step {i + 1}/{loop.steps} eval "
+                    f"loss={ev['loss']:.4f} ppl={ev['perplexity']:.1f}")
+                if t0 is not None:
+                    # keep eval wall time out of the training-throughput
+                    # clock — tok/s and MFU must describe train steps
+                    t0 += time.perf_counter() - t_ev
             if (loop.workdir is not None and loop.ckpt_every
                     and (i + 1) % loop.ckpt_every == 0):
                 ckpt.save(loop.workdir, state)

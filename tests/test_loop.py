@@ -2,6 +2,7 @@
 uninterrupted run (train/loop.py)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from service_account_auth_improvements_tpu.models import llama
@@ -48,3 +49,27 @@ def test_interrupted_run_resumes_identically(tmp_path):
                     jax.tree.leaves(resumed.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-6, atol=2e-7)
+
+
+def test_fit_periodic_eval(tmp_path):
+    import numpy as np
+
+    cfg = llama.PRESETS["tiny"]
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=8 * 32 * 6,
+                          dtype=np.int32)
+    # host arrays: jit lays them out per the eval step's in_shardings
+    # (a device array committed elsewhere would conflict)
+    held_out = [rng.integers(0, cfg.vocab_size, size=(4, 32)).astype(
+        np.int32)]
+    state, hist = fit(
+        cfg, mesh, tokens, DataConfig(batch=8, seq=32),
+        LoopConfig(steps=6, eval_every=3, log_every=0),
+        log=lambda *a: None, eval_data=held_out,
+    )
+    evals = [h for h in hist if "eval_loss" in h]
+    assert len(evals) == 2 and evals[0]["step"] == 3
+    assert all(e["eval_tokens"] == 4 * 31 for e in evals)
+    import math
+    assert all(math.isfinite(e["eval_loss"]) for e in evals)
