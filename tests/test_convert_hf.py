@@ -175,8 +175,10 @@ def test_export_loads_into_transformers_with_matching_logits():
     params = jax.tree.map(lambda a: a * 1.01, params)
     sd = convert_hf.to_hf_state_dict(cfg, params)
     fresh = transformers.LlamaForCausalLM(model.config)
+    # copy: jax-backed numpy views are read-only and torch warns
     missing, unexpected = fresh.load_state_dict(
-        {k: torch.from_numpy(v) for k, v in sd.items()}, strict=False
+        {k: torch.from_numpy(np.array(v)) for k, v in sd.items()},
+        strict=False,
     )
     assert not unexpected, unexpected
     assert all("rotary" in m or "inv_freq" in m for m in missing), missing
