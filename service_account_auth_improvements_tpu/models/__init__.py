@@ -13,5 +13,6 @@ from service_account_auth_improvements_tpu.models import (  # noqa: F401
     generate,
     llama,
     mnist,
+    quantize,
     resnet,
 )

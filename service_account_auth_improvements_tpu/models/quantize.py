@@ -1,0 +1,123 @@
+"""Weight-only int8 quantization for inference.
+
+Serving-side compression: matmul weights are stored as int8 with a
+per-output-channel f32 scale (symmetric absmax), halving (vs bf16) or
+quartering (vs f32) the HBM-resident model size — the KV-cache decode
+loop is weight-bandwidth-bound, so on TPU the narrower weight reads are
+where the win lives. Accuracy cost is the usual weight-only budget:
+|w - dequant(w)| <= scale/2 per element (asserted in tests), logits
+shift at the 1e-2 level on tiny models.
+
+Zero model-code changes: :class:`QuantizedArray` is a pytree node whose
+``.astype(dtype)`` returns the dequantized array, and every weight use
+in ``models/llama.py`` / ``models/generate.py`` already goes through
+``.astype(compute_dtype)`` — XLA fuses the dequant (convert + per-column
+multiply) into the consuming matmul, so the int8 tensor is what lives
+in (and streams from) HBM. Norm weights and the token embedding (a
+gather, not a matmul) stay in full precision.
+
+Quantized trees are for INFERENCE: they drop into ``llama.apply`` /
+``generate.generate`` as-is. Training state (optimizer moments, grads)
+stays full precision — quantize after training, before serving.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedArray:
+    """int8 values + per-output-channel scale; ``astype`` dequantizes.
+
+    ``values``: int8 with the native weight layout ``[..., in, out]``;
+    ``scale``: f32 with the contraction (``in``) axis dropped —
+    ``[..., out]``. Keeping every leading (stacked-layer / expert) axis
+    on the scale means ``lax.scan`` and ``tree.map(lambda a: a[i], …)``
+    slice values and scale coherently, and the pipeline's ``P('pp')``
+    leading-axis sharding applies to both leaves.
+    """
+
+    def __init__(self, values, scale):
+        self.values = values
+        self.scale = scale
+
+    # --- the model's universal access point -------------------------
+    def astype(self, dtype):
+        return self.values.astype(dtype) * jnp.expand_dims(
+            self.scale, -2
+        ).astype(dtype)
+
+    # --- array-protocol conveniences --------------------------------
+    @property
+    def shape(self):
+        return self.values.shape
+
+    @property
+    def ndim(self):
+        return self.values.ndim
+
+    def __getitem__(self, idx):
+        # slicing leading (stacked-layer/expert) axes keeps the
+        # quantized representation; scale carries the same leading axes
+        # as values (only the in-axis is dropped), so both slice
+        return QuantizedArray(self.values[idx], self.scale[idx])
+
+    def __repr__(self):
+        return (f"QuantizedArray(int8 {self.values.shape}, "
+                f"scale {self.scale.shape})")
+
+    # --- pytree protocol --------------------------------------------
+    def tree_flatten(self):
+        return (self.values, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _, children):
+        return cls(*children)
+
+
+def quantize_array(w) -> QuantizedArray:
+    """Symmetric absmax int8 quantization, per-channel over the
+    contraction axis (``axis=-2`` of the ``[..., in, out]`` layout)."""
+    w = jnp.asarray(w, jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=-2)
+    scale = jnp.maximum(absmax, 1e-12) / 127.0
+    q = jnp.clip(
+        jnp.round(w / jnp.expand_dims(scale, -2)), -127, 127
+    ).astype(jnp.int8)
+    return QuantizedArray(q, scale)
+
+
+# matmul weights (native layout [..., in, out] / [L, E, in, out]); norms
+# and tok_embed (gather) stay full precision. The MoE router also stays
+# full precision: it is tiny, and its hard top-1 argmax would let an
+# int8 perturbation flip near-tie tokens to a different expert — a
+# discrete output change, not a small logit shift.
+_QUANT_KEYS = frozenset({
+    "wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+    "moe_gate", "moe_up", "moe_down",
+})
+
+
+def quantize_params(params) -> dict:
+    """Quantize every matmul weight of a Llama param tree to int8; the
+    result drops into ``llama.apply`` / ``generate.generate``."""
+    out = {
+        "tok_embed": params["tok_embed"],
+        "final_norm": params["final_norm"],
+        "lm_head": quantize_array(params["lm_head"]),
+        "layers": {
+            k: (quantize_array(v) if k in _QUANT_KEYS else v)
+            for k, v in params["layers"].items()
+        },
+    }
+    return out
+
+
+def quantized_bytes(params) -> int:
+    """HBM-resident bytes of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -1,0 +1,102 @@
+"""Weight-only int8 inference quantization (models/quantize.py)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from service_account_auth_improvements_tpu.models import (
+    generate,
+    llama,
+    quantize,
+)
+
+CFG = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32",
+                          param_dtype="float32", remat=False)
+
+
+def test_quantize_error_bound():
+    """Symmetric absmax: |w - dequant(w)| <= scale/2 element-wise."""
+    w = jax.random.normal(jax.random.key(0), (3, 16, 8))
+    qa = quantize.quantize_array(w)
+    deq = qa.astype(jnp.float32)
+    bound = jnp.expand_dims(qa.scale, -2) / 2 + 1e-7
+    assert jnp.all(jnp.abs(w - deq) <= bound)
+    assert qa.values.dtype == jnp.int8
+    assert qa.scale.shape == (3, 8)  # leading axes kept, in-axis dropped
+
+
+def test_quantized_logits_close():
+    params = llama.init(CFG, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                              CFG.vocab_size)
+    want = np.asarray(llama.apply(CFG, params, toks))
+    got = np.asarray(llama.apply(CFG, qparams, toks))
+    # weight-only int8 budget: small relative logit shift
+    denom = np.maximum(np.abs(want).max(), 1.0)
+    assert np.abs(got - want).max() / denom < 0.05
+
+
+def test_quantized_generation_runs_under_jit():
+    params = llama.init(CFG, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    prompt = jnp.zeros((2, 5), jnp.int32)
+    out = generate.generate(CFG, qparams, prompt, 8)
+    assert out.shape == (2, 13)
+    assert int(out.max()) < CFG.vocab_size
+
+
+def test_quantized_moe_forward():
+    cfg = dataclasses.replace(llama.PRESETS["moe_smoke"], dtype="float32",
+                              param_dtype="float32", remat=False)
+    params = llama.init(cfg, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    toks = jax.random.randint(jax.random.key(2), (2, 16), 0,
+                              cfg.vocab_size)
+    out = llama.apply(cfg, qparams, toks)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_quantized_bytes_shrink():
+    params = llama.init(CFG, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    full = quantize.quantized_bytes(params)
+    small = quantize.quantized_bytes(qparams)
+    # f32 matmul weights -> int8 (+tiny scales); embed/norms stay f32
+    assert small < 0.5 * full
+
+
+def test_non_scan_layer_indexing_consistent():
+    """The non-scan path indexes layers via tree.map(a[i]) — values and
+    scale must slice coherently (same logits as the scan path)."""
+    cfg = dataclasses.replace(CFG, scan_layers=False)
+    params = llama.init(CFG, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    toks = jax.random.randint(jax.random.key(3), (2, 12), 0,
+                              CFG.vocab_size)
+    scan = np.asarray(llama.apply(CFG, qparams, toks))
+    unrolled = np.asarray(llama.apply(cfg, qparams, toks))
+    np.testing.assert_allclose(scan, unrolled, atol=2e-5)
+
+
+def test_getitem_slices_scale_with_values():
+    w = jax.random.normal(jax.random.key(4), (3, 16, 8))
+    qa = quantize.quantize_array(w)
+    sliced = qa[1]
+    assert sliced.values.shape == (16, 8) and sliced.scale.shape == (8,)
+    np.testing.assert_allclose(
+        np.asarray(sliced.astype(jnp.float32)),
+        np.asarray(qa.astype(jnp.float32))[1],
+    )
+
+
+def test_moe_router_stays_full_precision():
+    cfg = dataclasses.replace(llama.PRESETS["moe_smoke"])
+    params = llama.init(cfg, jax.random.key(0))
+    qparams = quantize.quantize_params(params)
+    assert not isinstance(qparams["layers"]["router"],
+                          quantize.QuantizedArray)
+    assert isinstance(qparams["layers"]["moe_gate"],
+                      quantize.QuantizedArray)
