@@ -1,4 +1,4 @@
-"""Standalone flash-attention kernel benchmark for iteration (not shipped).
+"""Standalone flash-attention kernel benchmark for kernel iteration.
 
 Times fwd and fwd+bwd of ops.flash_attention at the bench_800m shape vs the
 dense fallback, prints achieved TFLOP/s.
