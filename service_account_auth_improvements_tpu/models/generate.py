@@ -169,27 +169,43 @@ def _decode_step(cfg, params, cache: KVCache, token, cos, sin):
     return KVCache(k=k, v=v, length=pos + 1), logits
 
 
-def _sample(logits, key, temperature: float, top_k: int):
+def _sample(logits, key, temperature: float, top_k: int, top_p: float = 0.0):
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k:
         thresh = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < thresh, -2.0e38, logits)
+    if top_p and top_p < 1.0:
+        # nucleus filter as a threshold, not a scatter: the smallest
+        # logit inside the top-p mass bounds the kept set, so one sort +
+        # one compare keeps the step free of gather/scatter (ties at the
+        # boundary are all kept — the inclusive choice)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p  # exclusive prefix: rank-0 always kept
+        thresh = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < thresh, -2.0e38, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                   "top_k"))
+                                   "top_k", "top_p", "eos_id"))
 def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
-             key=None, temperature: float = 0.0, top_k: int = 0):
-    """prompt [b, s] → [b, s + max_new_tokens]. Greedy when temperature=0.
+             key=None, temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0, eos_id: int | None = None):
+    """prompt [b, s] → [b, s + max_new_tokens]. Greedy when temperature=0;
+    ``top_k``/``top_p`` (nucleus) filters compose when temperature > 0.
 
     One compile per (shape, cfg): prefill + a single scan over the new
-    positions. EOS handling is left to the caller (slice at the first
-    eos id) — keeping the loop free of data-dependent control flow is
-    what keeps it one fused XLA while-loop on TPU. MoE models route
-    dropless at inference (see ``_inference_cfg``).
+    positions. With ``eos_id`` set, rows that have emitted it keep their
+    static shape but are padded with ``eos_id`` from that point on — the
+    scan stays one fused XLA while-loop (no data-dependent trip count),
+    which is what serving on TPU wants; callers slice at the first eos.
+    MoE models route dropless at inference (see ``_inference_cfg``).
     """
     cfg = _inference_cfg(cfg)
     b, s = prompt.shape
@@ -199,17 +215,23 @@ def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
     cache, logits = prefill(cfg, params, prompt, max_len)
     cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta,
                           scaling=cfg.rope_scaling())
-    first = _sample(logits, key, temperature, top_k)
+    first_key, key = jax.random.split(key)
+    first = _sample(logits, first_key, temperature, top_k, top_p)
+    done = (first == eos_id) if eos_id is not None else jnp.zeros(
+        (b,), bool)
 
     def body(carry, step_key):
-        cache, token = carry
+        cache, token, done = carry
         cache, logits = _decode_step(cfg, params, cache, token, cos, sin)
-        nxt = _sample(logits, step_key, temperature, top_k)
-        return (cache, nxt), nxt
+        nxt = _sample(logits, step_key, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
 
     # max_new_tokens - 1 decode steps: `first` came from prefill, and the
     # final position's logits are never consumed, so a full-length scan
     # would run one L-layer decode whose output is discarded
     keys = jax.random.split(key, max_new_tokens - 1)
-    _, toks = jax.lax.scan(body, (cache, first), keys)
+    _, toks = jax.lax.scan(body, (cache, first, done), keys)
     return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)

@@ -79,6 +79,54 @@ def test_sampling_is_reproducible_and_in_vocab():
     assert int(a.max()) < CFG.vocab_size and int(a.min()) >= 0
 
 
+def test_top_p_tiny_nucleus_is_greedy():
+    """A near-zero top_p keeps only the highest-probability token, so
+    nucleus sampling at any temperature degenerates to greedy decode."""
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(5), (2, 5), 0,
+                                CFG.vocab_size)
+    greedy = generate.generate(CFG, params, prompt, 6)
+    nucleus = generate.generate(CFG, params, prompt, 6,
+                                key=jax.random.key(11),
+                                temperature=0.9, top_p=1e-6)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(nucleus))
+
+
+def test_top_p_mask_keeps_nucleus_only():
+    """Direct check of the nucleus threshold: with p=0.6 over a known
+    distribution only the top tokens whose exclusive prefix mass < p
+    survive; everything else must never be sampled."""
+    # probs ~ [0.5, 0.25, 0.125, ...]: nucleus(0.6) = {0, 1}
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.125, 0.0625, 0.0625]]))
+    draws = jax.vmap(
+        lambda k: generate._sample(logits, k, 1.0, 0, 0.6)[0]
+    )(jax.random.split(jax.random.key(0), 200))
+    assert set(np.asarray(draws).tolist()) == {0, 1}
+
+
+def test_eos_pads_after_first_hit():
+    """With eos_id set, each row matches the unconstrained decode up
+    through its first eos emission and is eos-padded afterwards."""
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(6), (2, 5), 0,
+                                CFG.vocab_size)
+    free = np.asarray(generate.generate(CFG, params, prompt, 8))
+    s = prompt.shape[1]
+    eos = int(free[0, s])  # row 0's first generated token
+    out = np.asarray(
+        generate.generate(CFG, params, prompt, 8, eos_id=eos))
+    for row_free, row_out in zip(free, out):
+        gen_free, gen_out = row_free[s:], row_out[s:]
+        hits = np.flatnonzero(gen_free == eos)
+        if hits.size:
+            j = hits[0]
+            np.testing.assert_array_equal(gen_out[: j + 1],
+                                          gen_free[: j + 1])
+            assert (gen_out[j + 1:] == eos).all()
+        else:
+            np.testing.assert_array_equal(gen_out, gen_free)
+
+
 def test_generate_on_tp_mesh_matches_single_device():
     """Generation with tp-sharded params produces the same tokens as
     single-device decode — inference under the serving mesh layout."""
