@@ -44,9 +44,7 @@ def _inference_cfg(cfg: llama.LlamaConfig) -> llama.LlamaConfig:
     causally consistent and the standard serving choice."""
     if not cfg.moe_experts:
         return cfg
-    return dataclasses.replace(
-        cfg, moe_capacity_factor=float(cfg.moe_experts)
-    )
+    return dataclasses.replace(cfg, moe_dropless=True)
 
 
 class KVCache(NamedTuple):

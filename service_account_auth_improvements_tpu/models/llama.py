@@ -64,8 +64,8 @@ class LlamaConfig:
     # per token (one lm_head), so: True for tp>1 slices, False for
     # single-chip where the local gather is free.
     iota_embed: bool = False
-    # Mixture-of-experts (switch-style top-1 routing). 0 = dense FFN.
-    # Experts shard over the ``ep`` mesh axis via the "expert" logical
+    # Mixture-of-experts (switch top-1 / Mixtral top-k routing). 0 = dense
+    # FFN. Experts shard over the ``ep`` mesh axis via the "expert" logical
     # axis; dispatch/combine are one-hot einsum contractions so GSPMD
     # lowers the token shuffle to all-to-alls over ep (static shapes, no
     # per-token gather/scatter — the MXU-friendly formulation). Routing
@@ -73,7 +73,16 @@ class LlamaConfig:
     # is O(seq · E · cap_per_group) — linear in sequence length — instead
     # of O(seq²·f/·) whole-row capacity.
     moe_experts: int = 0
+    # Experts per token. 1 = switch semantics (gate is the raw router
+    # probability); k > 1 = Mixtral semantics (gates renormalized over the
+    # selected experts). Capacity scales with k (see ``moe_cap``).
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
+    # Dropless routing: capacity = the full routing group, so every one
+    # of a token's k (distinct) expert choices always has a slot. Set by
+    # inference (generate._inference_cfg) — exact, unlike encoding it
+    # through a float capacity factor.
+    moe_dropless: bool = False
     moe_aux_weight: float = 0.01  # Switch load-balance aux loss weight
     moe_group_size: int = 1024    # routing/capacity group (<= seq uses seq)
     # Cross-entropy chunking: compute the lm_head projection + log-softmax
@@ -107,9 +116,13 @@ class LlamaConfig:
         }
 
     def moe_cap(self, group: int) -> int:
-        """Per-group expert capacity."""
-        return max(1, int(self.moe_capacity_factor * group
-                          / self.moe_experts))
+        """Per-group expert capacity: each token places ``moe_top_k``
+        copies, so capacity scales with k (GShard convention). Dropless
+        mode uses the whole group — no float round-trip."""
+        if self.moe_dropless:
+            return group
+        return max(1, int(self.moe_capacity_factor * self.moe_top_k
+                          * group / self.moe_experts))
 
     @property
     def q_dim(self) -> int:
@@ -149,10 +162,11 @@ class LlamaConfig:
 
     def active_matmul_param_count(self) -> int:
         """Matmul params a single token actually flows through: with
-        top-1 MoE only one of the E experts is active per token."""
+        top-k MoE only k of the E experts are active per token."""
         total = self.matmul_param_count()
         if self.moe_experts:
-            total -= (self.n_layers * 3 * (self.moe_experts - 1)
+            total -= (self.n_layers * 3
+                      * (self.moe_experts - self.moe_top_k)
                       * self.dim * self.mlp_dim)
         return total
 
@@ -166,7 +180,7 @@ class LlamaConfig:
             flops += 6 * self.n_layers * self.n_heads * self.head_dim * seq_len
         if self.moe_experts:
             # dispatch + combine einsums: 2·E·cap_g·d FLOPs/token each in
-            # the forward pass (E·cap_g ≈ capacity_factor·group), ×3 train
+            # the forward pass (E·cap_g ≈ k·capacity_factor·group), ×3 train
             group = min(self.moe_group_size, seq_len or self.moe_group_size)
             flops += (3 * 2 * 2 * self.n_layers
                       * self.moe_experts * self.moe_cap(group) * self.dim)
@@ -215,6 +229,19 @@ PRESETS: dict[str, LlamaConfig] = {
         vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
         head_dim=16, mlp_dim=256, max_seq_len=256, rope_theta=10_000.0,
         moe_experts=4,
+    ),
+    # CI-sized Mixtral-style top-2 variant of the same geometry.
+    "moe2_smoke": LlamaConfig(
+        vocab_size=512, dim=128, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=16, mlp_dim=256, max_seq_len=256, rope_theta=10_000.0,
+        moe_experts=4, moe_top_k=2,
+    ),
+    # Mixtral-8x7B geometry (public HF config): 8 experts, top-2 routing,
+    # 47B total / 12.9B active params.
+    "mixtral_8x7b": LlamaConfig(
+        vocab_size=32_000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        head_dim=128, mlp_dim=14_336, max_seq_len=32_768,
+        rope_theta=1_000_000.0, moe_experts=8, moe_top_k=2,
     ),
     # Switch-style 8-expert variant of the 1B geometry (7.1B total params,
     # 1.2B matmul-active per token): the ep-axis flagship.
@@ -325,7 +352,9 @@ def init(cfg: LlamaConfig, key: jax.Array):
 
 
 def _moe_ffn(cfg: LlamaConfig, h, lp, token_mask=None):
-    """Switch-style top-1 MoE FFN: h [b, s, d] → (out [b, s, d], aux).
+    """Top-k MoE FFN: h [b, s, d] → (out [b, s, d], aux). k=1 is switch
+    semantics (gate = raw router probability); k>1 is Mixtral semantics
+    (gates renormalized over the selected experts).
 
     Capacity-based one-hot dispatch: every shape is static, the token
     shuffle is an einsum contraction over the expert/capacity axes that
@@ -333,48 +362,60 @@ def _moe_ffn(cfg: LlamaConfig, h, lp, token_mask=None):
     the expert matmuls are a single batched [G, E, C, d] × [E, d, m]
     einsum on the MXU. Routing and capacity are applied per group of
     ``moe_group_size`` tokens so the dispatch tensor stays linear in
-    sequence length. Tokens overflowing an expert's capacity — and
-    masked (padding) tokens, which neither consume capacity nor enter the
-    load-balance statistics — fall through to the residual connection
-    (standard switch semantics). ``aux`` is the Switch load-balance loss
-    (density × router-probability dot, scaled by E); router math in f32.
+    sequence length. Capacity slots are claimed choice-major (GShard
+    ordering): every token's rank-0 choice is placed before any rank-1
+    choice, so a token's primary expert wins over another token's
+    secondary. Expert copies overflowing capacity — and masked (padding)
+    tokens, which neither consume capacity nor enter the load-balance
+    statistics — fall through to the residual connection. ``aux`` is the
+    load-balance loss (density × router-probability dot, scaled by E,
+    density normalized over the k choices); router math in f32.
     """
     b, s, d = h.shape
-    E = cfg.moe_experts
+    E, K = cfg.moe_experts, cfg.moe_top_k
     g = min(cfg.moe_group_size, s)
     if s % g:
         g = s  # non-divisible seq: one group (tests, odd shapes)
     cap = cfg.moe_cap(g)
     cdt = h.dtype
-    hg = h.reshape(b * (s // g), g, d)               # [G, g, d]
+    G = b * (s // g)
+    hg = h.reshape(G, g, d)                          # [G, g, d]
     if token_mask is None:
         tmask = jnp.ones(hg.shape[:2], jnp.float32)
     else:
-        tmask = token_mask.astype(jnp.float32).reshape(b * (s // g), g)
+        tmask = token_mask.astype(jnp.float32).reshape(G, g)
 
     logits = jnp.einsum(
         "gsd,de->gse", hg.astype(jnp.float32),
         lp["router"].astype(jnp.float32),
     )
     probs = jax.nn.softmax(logits, axis=-1)          # [G, g, E]
-    gate = jnp.max(probs, axis=-1) * tmask           # [G, g]
-    idx = jnp.argmax(probs, axis=-1)                 # [G, g]
+    gate, idx = jax.lax.top_k(probs, K)              # [G, g, K]
+    if K > 1:
+        gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
     # masked tokens route nowhere: no capacity use, no balance stats
-    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32) * tmask[..., None]
+    gate = gate * tmask[..., None]
+    onehot = (jax.nn.one_hot(idx, E, dtype=jnp.float32)
+              * tmask[..., None, None])              # [G, g, K, E]
     denom = jnp.maximum(tmask.sum(axis=1, keepdims=True), 1.0)
-    density = onehot.sum(axis=1) / denom             # routed fraction
+    density = onehot.sum(axis=(1, 2)) / (denom * K)  # routed fraction
     density_proxy = (
         (probs * tmask[..., None]).sum(axis=1) / denom
     )                                                # mean router prob
     aux = E * jnp.mean(jnp.sum(density * density_proxy, axis=-1))
 
-    # position of each token in its expert's queue (per group)
-    pos = jnp.cumsum(onehot, axis=1) - onehot        # [G, g, E]
-    pos_tok = jnp.sum(pos * onehot, axis=-1)         # [G, g]
-    keep = (pos_tok < cap).astype(jnp.float32) * tmask
-    disp = (onehot * keep[..., None])[..., None] * jax.nn.one_hot(
+    # queue position of each (token, choice) in its expert, choice-major:
+    # flatten [K, g] so rank-0 claims precede every rank-1 claim
+    oh_cm = onehot.transpose(0, 2, 1, 3).reshape(G, K * g, E)
+    pos_cm = jnp.cumsum(oh_cm, axis=1) - oh_cm
+    pos = pos_cm.reshape(G, K, g, E).transpose(0, 2, 1, 3)
+    pos_tok = jnp.sum(pos * onehot, axis=-1)         # [G, g, K]
+    keep = (pos_tok < cap).astype(jnp.float32) * tmask[..., None]
+    sel = onehot * keep[..., None]                   # [G, g, K, E]
+    posoh = jax.nn.one_hot(
         pos_tok.astype(jnp.int32), cap, dtype=jnp.float32
-    )[..., None, :]                                  # [G, g, E, C]
+    )                                                # [G, g, K, C]
+    disp = jnp.einsum("gske,gskc->gsec", sel, posoh)  # [G, g, E, C]
 
     xin = jnp.einsum("gsec,gsd->gecd", disp.astype(cdt), hg)
     xin = shard_constraint(xin, ("batch", "expert", None, None))
@@ -383,7 +424,9 @@ def _moe_ffn(cfg: LlamaConfig, h, lp, token_mask=None):
     ) * jnp.einsum("gecd,edm->gecm", xin, lp["moe_up"].astype(cdt))
     act = shard_constraint(act, ("batch", "expert", None, "mlp"))
     xout = jnp.einsum("gecm,emd->gecd", act, lp["moe_down"].astype(cdt))
-    combine = (disp * (gate * keep)[..., None, None]).astype(cdt)
+    combine = jnp.einsum(
+        "gske,gskc->gsec", sel * gate[..., None], posoh
+    ).astype(cdt)
     out = jnp.einsum("gsec,gecd->gsd", combine, xout)
     return out.reshape(b, s, d), aux
 

@@ -12,6 +12,7 @@ from service_account_auth_improvements_tpu.models import generate, llama
 
 CFG = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
 MOE = dataclasses.replace(llama.PRESETS["moe_smoke"], dtype="float32")
+MOE2 = dataclasses.replace(llama.PRESETS["moe2_smoke"], dtype="float32")
 
 
 def _naive_greedy(cfg, params, prompt, n):
@@ -27,7 +28,8 @@ def _naive_greedy(cfg, params, prompt, n):
     return toks
 
 
-@pytest.mark.parametrize("cfg", [CFG, MOE], ids=["dense", "moe"])
+@pytest.mark.parametrize("cfg", [CFG, MOE, MOE2],
+                         ids=["dense", "moe", "moe_top2"])
 def test_greedy_matches_naive_decode(cfg):
     params = llama.init(cfg, jax.random.key(0))
     prompt = jax.random.randint(jax.random.key(1), (2, 7), 0,

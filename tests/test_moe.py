@@ -123,6 +123,107 @@ def test_moe_masked_tokens_do_not_route():
     )
 
 
+def test_moe_top2_matches_per_token_reference():
+    """Mixtral semantics: with ample capacity each token's output is the
+    gate-weighted sum of its top-2 experts, gates renormalized over the
+    selected pair."""
+    cfg = _cfg(moe_top_k=2, moe_capacity_factor=4.0)  # nothing dropped
+    key = jax.random.key(5)
+    E, d, m = cfg.moe_experts, cfg.dim, cfg.mlp_dim
+    b, s = 2, 16
+    ks = jax.random.split(key, 5)
+    h = jax.random.normal(ks[0], (b, s, d), jnp.float32)
+    lp = {
+        "router": jax.random.normal(ks[1], (d, E), jnp.float32) * 0.5,
+        "moe_gate": jax.random.normal(ks[2], (E, d, m), jnp.float32) * 0.1,
+        "moe_up": jax.random.normal(ks[3], (E, d, m), jnp.float32) * 0.1,
+        "moe_down": jax.random.normal(ks[4], (E, m, d), jnp.float32) * 0.1,
+    }
+    out, aux = _moe_ffn(cfg, h, lp)
+
+    probs = np.asarray(jax.nn.softmax(h @ lp["router"], axis=-1))
+    want = np.zeros((b, s, d), np.float32)
+    for bi in range(b):
+        for si in range(s):
+            top2 = np.argsort(probs[bi, si])[::-1][:2]
+            gates = probs[bi, si, top2]
+            gates = gates / gates.sum()
+            x = np.asarray(h[bi, si])
+            for e, gt in zip(top2, gates):
+                act = (np.asarray(jax.nn.silu(x @ lp["moe_gate"][e]))
+                       * (x @ np.asarray(lp["moe_up"][e])))
+                want[bi, si] += gt * (act @ np.asarray(lp["moe_down"][e]))
+    np.testing.assert_allclose(np.asarray(out), want, atol=1e-4)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_top2_choice_major_capacity_priority():
+    """With capacity 1 per expert, a token's PRIMARY claim must beat
+    another token's SECONDARY claim on the same expert (GShard choice-
+    major ordering), regardless of token order in the sequence."""
+    cfg = _cfg(moe_top_k=2, moe_capacity_factor=1.0)  # cap = 2·2/4 = 1
+    E, d, m = cfg.moe_experts, cfg.dim, cfg.mlp_dim
+    b, s = 1, 2
+    # router reads logits straight off the first E dims of h
+    router = jnp.zeros((d, E)).at[jnp.arange(E), jnp.arange(E)].set(1.0)
+    # token0: top1=E0, top2=E1 (secondary claim on E1, placed SECOND)
+    # token1: top1=E1 (primary claim on E1 — must win despite coming
+    # later in the sequence)
+    h = jnp.zeros((b, s, d))
+    h = h.at[0, 0, 0].set(3.0).at[0, 0, 1].set(2.0)
+    h = h.at[0, 1, 1].set(3.0).at[0, 1, 2].set(2.0)
+    key = jax.random.key(7)
+    ks = jax.random.split(key, 3)
+    lp = {
+        "router": router,
+        "moe_gate": jax.random.normal(ks[0], (E, d, m)) * 0.1,
+        "moe_up": jax.random.normal(ks[1], (E, d, m)) * 0.1,
+        "moe_down": jax.random.normal(ks[2], (E, m, d)) * 0.1,
+    }
+    out = np.asarray(_moe_ffn(cfg, h, lp)[0])
+
+    probs = np.asarray(jax.nn.softmax(h @ router, axis=-1))
+
+    def expert_out(x, e):
+        act = (np.asarray(jax.nn.silu(x @ lp["moe_gate"][e]))
+               * (x @ np.asarray(lp["moe_up"][e])))
+        return act @ np.asarray(lp["moe_down"][e])
+
+    # token0 keeps only E0 (its E1 claim lost to token1's primary);
+    # token1 keeps E1 and E2 (both uncontested)
+    x0, x1 = np.asarray(h[0, 0]), np.asarray(h[0, 1])
+    g0 = probs[0, 0, [0, 1]] / probs[0, 0, [0, 1]].sum()
+    want0 = g0[0] * expert_out(x0, 0)
+    g1 = probs[0, 1, [1, 2]] / probs[0, 1, [1, 2]].sum()
+    want1 = g1[0] * expert_out(x1, 1) + g1[1] * expert_out(x1, 2)
+    np.testing.assert_allclose(out[0, 0], want0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 1], want1, atol=1e-5)
+
+
+def test_moe_dropless_capacity_is_exact():
+    """Dropless capacity must be the full group even where the float
+    factor·k·g/E round-trip would truncate (E=61, k=7 loses a slot)."""
+    from service_account_auth_improvements_tpu.models import generate
+
+    cfg = _cfg(moe_experts=61, moe_top_k=7)
+    icfg = generate._inference_cfg(cfg)
+    assert icfg.moe_cap(1024) == 1024
+    # the float encoding this replaces really does truncate
+    assert int((61 / 7) * 7 * 1024 / 61) == 1023
+
+
+def test_moe_top2_accounting():
+    cfg = _cfg(moe_top_k=2)
+    # two of E experts active per token
+    inactive = (cfg.n_layers * 3 * (cfg.moe_experts - 2)
+                * cfg.dim * cfg.mlp_dim)
+    assert cfg.active_matmul_param_count() == (
+        cfg.matmul_param_count() - inactive
+    )
+    # capacity doubles with k at fixed factor
+    assert cfg.moe_cap(64) == 2 * _cfg().moe_cap(64)
+
+
 def test_moe_param_and_flops_accounting():
     cfg = _cfg()
     params = llama.init(cfg, jax.random.key(0))
