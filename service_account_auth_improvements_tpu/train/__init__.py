@@ -18,3 +18,10 @@ from service_account_auth_improvements_tpu.train.mfu import (  # noqa: F401
 from service_account_auth_improvements_tpu.train.evaluate import (  # noqa: F401
     make_eval_step,
 )
+from service_account_auth_improvements_tpu.train.lora import (  # noqa: F401
+    LoraConfig,
+    init_lora_state,
+    lora_state_shardings,
+    make_lora_train_step,
+    merge_lora,
+)

@@ -1,0 +1,184 @@
+"""LoRA fine-tuning: low-rank adapters over frozen base weights, TPU-first.
+
+The adapter pair (A: [..., in, r], B: [..., r, out], B zero-initialized)
+is MERGED into the base weight inside the jitted step — ``W + (α/r)·A@B``
+is one broadcast matmul per target (leading layer/expert axes ride along),
+then the unmodified training forward runs on the merged tree. On TPU this
+beats threading per-target side-computations through the model: the merge
+is a tiny fraction of step FLOPs, XLA fuses it, and the forward stays the
+single well-sharded program the MFU work tuned. The transient merged
+copy costs one extra weight-set of HBM — the regime LoRA targets (big
+model, small batch) has exactly that headroom, because the optimizer
+state that normally owns it (fp32 master + Adam moments over all params)
+shrinks to the adapters.
+
+Only the adapters are trained: the optimizer sees the adapter tree alone
+(its state is O(rank) of the base), base params enter the step as a
+donated-nothing argument, and checkpoints are just the adapter pytree
+(train/checkpoint.py handles any pytree).
+
+The reference has no fine-tuning surface (its workload layer is a Docker
+image tree, SURVEY.md §2 example-notebook-servers); this extends the
+in-notebook workload family the control plane schedules onto slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_to_mesh,
+)
+from service_account_auth_improvements_tpu.train.step import (
+    TrainState,
+    make_optimizer,
+    tree_state_shardings,
+)
+from jax.sharding import NamedSharding
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    # layer-stack param names to adapt; any matmul weight under
+    # params["layers"] works (attention, dense mlp, or moe_* — leading
+    # layer/expert axes broadcast through the merge)
+    targets: tuple[str, ...] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _target_shapes(cfg: llama.LlamaConfig, lcfg: LoraConfig):
+    """{target: base weight shape} without materializing params."""
+    shapes = jax.eval_shape(lambda: llama.init(cfg, jax.random.key(0)))
+    out = {}
+    for t in lcfg.targets:
+        if t not in shapes["layers"]:
+            raise ValueError(
+                f"LoRA target {t!r} not in layer params "
+                f"{sorted(shapes['layers'])}"
+            )
+        shape = shapes["layers"][t].shape
+        if len(shape) < 3:
+            # stacked per-layer matmul weights are >=3-D ([L, in, out]);
+            # a 2-D target (a norm vector stack) would silently bind the
+            # layer axis as the matmul input dim
+            raise ValueError(
+                f"LoRA target {t!r} is not a matmul weight "
+                f"(shape {shape})"
+            )
+        out[t] = shape
+    return out
+
+
+def init_lora(cfg: llama.LlamaConfig, lcfg: LoraConfig, key) -> Any:
+    """Adapter tree {target: {"a", "b"}}; A ~ N(0, 1/√d_in) (kaiming-
+    style, the HF PEFT convention), B = 0 so the merged model starts
+    exactly at the base model."""
+    tree = {}
+    for t, shape in _target_shapes(cfg, lcfg).items():
+        *lead, d_in, d_out = shape
+        key, ka = jax.random.split(key)
+        tree[t] = {
+            "a": d_in ** -0.5 * jax.random.normal(
+                ka, (*lead, d_in, lcfg.rank), jnp.float32
+            ),
+            "b": jnp.zeros((*lead, lcfg.rank, d_out), jnp.float32),
+        }
+    return tree
+
+
+def lora_logical_axes(cfg: llama.LlamaConfig, lcfg: LoraConfig) -> Any:
+    """Sharding axes for the adapter tree, derived from each target's base
+    axes: A inherits the input axis (fsdp), B the output axis (tp); the
+    rank axis replicates (it is tiny)."""
+    base = llama.logical_axes(cfg)["layers"]
+    return {
+        t: {
+            "a": (*base[t][:-1], None),
+            "b": (*base[t][:-2], None, base[t][-1]),
+        }
+        for t in lcfg.targets
+    }
+
+
+def merge_lora(params, lora, lcfg: LoraConfig):
+    """Base params + scaled adapter products, in the base dtype."""
+    layers = dict(params["layers"])
+    for t, ab in lora.items():
+        w = layers[t]
+        layers[t] = (
+            w + (lcfg.scale * (ab["a"] @ ab["b"])).astype(w.dtype)
+        )
+    return {**params, "layers": layers}
+
+
+def init_lora_state(cfg, lcfg: LoraConfig, key, optimizer=None) -> TrainState:
+    """TrainState whose ``params`` are the adapters only. Default
+    optimizer: AdamW without weight decay (decaying B away from the
+    just-learned direction is the usual LoRA convention)."""
+    optimizer = optimizer or make_optimizer(weight_decay=0.0)
+    lora = init_lora(cfg, lcfg, key)
+    return TrainState(jnp.zeros((), jnp.int32), lora, optimizer.init(lora))
+
+
+def lora_state_shardings(mesh, cfg, lcfg: LoraConfig, state: TrainState,
+                         rules=None) -> TrainState:
+    return tree_state_shardings(
+        mesh, lora_logical_axes(cfg, lcfg), state, rules
+    )
+
+
+def make_lora_train_step(cfg: llama.LlamaConfig, lcfg: LoraConfig,
+                         optimizer=None, mesh=None, rules=None):
+    """Return jitted ``step(state, base_params, tokens, mask)`` →
+    ``(state, metrics)``. Gradients flow through the merge into the
+    adapters only; ``base_params`` is a plain argument (not a closure
+    constant — XLA handles donated/sharded arguments far better than
+    giant baked-in constants) and comes back untouched."""
+    optimizer = optimizer or make_optimizer(weight_decay=0.0)
+
+    def loss_fn(lora, base_params, tokens, mask):
+        merged = merge_lora(base_params, lora, lcfg)
+        return llama.next_token_loss(cfg, merged, tokens, mask)
+
+    def step_fn(state: TrainState, base_params, tokens, mask):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state.params, base_params, tokens, mask
+        )
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        new_lora = optax.apply_updates(state.params, updates)
+        new_state = TrainState(state.step + 1, new_lora, opt_state)
+        return new_state, {
+            "loss": loss, "grad_norm": optax.global_norm(grads)
+        }
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    rules = rules or DEFAULT_RULES
+    batch_sh = NamedSharding(mesh, logical_to_mesh(("batch", None), rules))
+    return jax.jit(
+        step_fn,
+        in_shardings=(None, None, batch_sh, batch_sh),
+        donate_argnums=(0,),
+    )
+
+
+def lora_param_count(cfg: llama.LlamaConfig, lcfg: LoraConfig) -> int:
+    return sum(
+        math.prod(s[:-2]) * (s[-2] + s[-1]) * lcfg.rank
+        for s in _target_shapes(cfg, lcfg).values()
+    )

@@ -81,8 +81,15 @@ def state_shardings(mesh, cfg: llama.LlamaConfig, state: TrainState,
     """Shardings for a TrainState: params by logical axes; optimizer state by
     matching each leaf to the param tree by shape (adam mu/nu mirror params;
     scalars replicate)."""
+    return tree_state_shardings(mesh, llama.logical_axes(cfg), state, rules)
+
+
+def tree_state_shardings(mesh, axes_tree, state: TrainState,
+                         rules=None) -> TrainState:
+    """``state_shardings`` for any params tree + its logical-axes tree
+    (the generic core — LoRA adapter states reuse it, train/lora.py)."""
     rules = rules or DEFAULT_RULES
-    p_shardings = tree_logical_sharding(mesh, llama.logical_axes(cfg), rules)
+    p_shardings = tree_logical_sharding(mesh, axes_tree, rules)
     flat_p = {
         id_path: s
         for id_path, s in zip(
