@@ -29,6 +29,7 @@ SURVEY.md §2b) — this file is net-new TPU surface.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -51,6 +52,32 @@ def _pick_block(seq: int, want: int) -> int:
     while b > BLOCK_MIN and seq % b:
         b //= 2
     return b if seq % b == 0 else BLOCK_MIN
+
+
+def _block_pref(seq: int, name: str, default: int) -> int:
+    """Block size for one kernel axis: ``_pick_block`` of the default,
+    or the ``SATPU_FLASH_<NAME>`` override for on-hardware tuning
+    (tools/ksweep.py). An override that would not be used EXACTLY
+    (non-power-of-two, or not tiling ``seq``) raises — a sweep must
+    never record a block size the kernel silently replaced. Read at
+    trace time — sweep points run in fresh processes, the jit cache
+    does not key on env."""
+    v = os.environ.get(f"SATPU_FLASH_{name}")
+    if not v:
+        return _pick_block(seq, default)
+    try:
+        b = int(v)
+    except ValueError:
+        raise ValueError(
+            f"SATPU_FLASH_{name}={v!r}: not an integer"
+        ) from None
+    if b < BLOCK_MIN or b & (b - 1) or _pick_block(seq, b) != b:
+        raise ValueError(
+            f"SATPU_FLASH_{name}={v}: must be a power of two >= "
+            f"{BLOCK_MIN} that tiles seq={seq} (effective block would "
+            f"be {_pick_block(seq, max(b, 1))})"
+        )
+    return b
 
 
 def _use_pallas(q, k, causal: bool) -> bool:
@@ -147,8 +174,8 @@ def _flash_fwd(q, k, v, *, causal, interpret=False):
     _, hkv, sk, _ = k.shape
     g = h // hkv
     scale = d ** -0.5
-    bq = _pick_block(sq, 256)
-    bk = _pick_block(sk, 512)
+    bq = _block_pref(sq, "FWD_BQ", 256)
+    bk = _block_pref(sk, "FWD_BK", 512)
     nk = sk // bk
     grid = (b, h, sq // bq, nk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal, nk=nk)
@@ -294,8 +321,8 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
         (b, h, sq, LSE_LANES),
     )
 
-    bq = _pick_block(sq, 256)
-    bk = _pick_block(sk, 512)
+    bq = _block_pref(sq, "DQ_BQ", 256)
+    bk = _block_pref(sk, "DQ_BK", 512)
     nk = sk // bk
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, nk=nk),
@@ -322,8 +349,8 @@ def _flash_bwd(q, k, v, o, lse, do, *, causal, interpret=False):
 
     # dkv: kv-block stationary, Q/dO streaming. A smaller q block keeps the
     # two streamed operands + two f32 accumulators comfortably in VMEM.
-    bkq = _pick_block(sq, 256)
-    bkk = _pick_block(sk, 256)
+    bkq = _block_pref(sq, "DKV_BQ", 256)
+    bkk = _block_pref(sk, "DKV_BK", 256)
     nq = sq // bkq
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, g=g, nq=nq),

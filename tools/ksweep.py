@@ -1,0 +1,93 @@
+"""Flash-attention block-size sweep (run on the TPU box).
+
+Each point re-runs kbench.py in a fresh process with SATPU_FLASH_* block
+preferences (the kernels read them at trace time — in-process sweeping
+would hit the jit cache). Prints achieved TFLOP/s per point and the best
+combination; results land in KSWEEP.json.
+
+Usage:
+    python tools/ksweep.py                # fwd+bwd grid at kbench shapes
+    python tools/ksweep.py --timeout 300
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import re
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+# (fwd_bq, fwd_bk, dq_bq, dq_bk, dkv_bq, dkv_bk)
+POINTS = [
+    (256, 512, 256, 512, 256, 256),   # current defaults
+    (128, 512, 256, 512, 256, 256),
+    (512, 512, 256, 512, 256, 256),
+    (256, 256, 256, 512, 256, 256),
+    (256, 1024, 256, 512, 256, 256),
+    (256, 512, 128, 512, 256, 256),
+    (256, 512, 512, 512, 256, 256),
+    (256, 512, 256, 512, 128, 256),
+    (256, 512, 256, 512, 512, 256),
+    (256, 512, 256, 512, 256, 128),
+    (256, 512, 256, 512, 256, 512),
+]
+
+FLOAT = r"([0-9]+\.?[0-9]*)"
+
+
+def run_point(point, timeout):
+    names = ("FWD_BQ", "FWD_BK", "DQ_BQ", "DQ_BK", "DKV_BQ", "DKV_BK")
+    env = dict(os.environ)
+    for n, v in zip(names, point):
+        env[f"SATPU_FLASH_{n}"] = str(v)
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(ROOT / "kbench.py")],
+            env=env, cwd=ROOT, capture_output=True, text=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"error": "timeout"}
+    if proc.returncode != 0:
+        return {"error": (proc.stderr or proc.stdout)[-300:]}
+    out = {}
+    m = re.search(rf"flash fwd\s+{FLOAT} ms\s+{FLOAT} TF", proc.stdout)
+    if m:
+        out["fwd_ms"], out["fwd_tflops"] = float(m[1]), float(m[2])
+    m = re.search(rf"flash fwd\+bwd\s+{FLOAT} ms\s+{FLOAT} TF", proc.stdout)
+    if m:
+        out["fwdbwd_ms"], out["fwdbwd_tflops"] = float(m[1]), float(m[2])
+    return out or {"error": f"unparsed: {proc.stdout[-200:]}"}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=420.0)
+    args = ap.parse_args()
+    results = []
+    for point in POINTS:
+        out = run_point(point, args.timeout)
+        row = dict(zip(("fwd_bq", "fwd_bk", "dq_bq", "dq_bk",
+                        "dkv_bq", "dkv_bk"), point), **out)
+        results.append(row)
+        tag = "/".join(map(str, point))
+        if "error" in out:
+            print(f"{tag:30s} ERROR {out['error'][:80]}")
+        else:
+            print(f"{tag:30s} fwd {out.get('fwd_ms', 0):7.2f} ms   "
+                  f"fwd+bwd {out.get('fwdbwd_ms', 0):7.2f} ms")
+    ok = [r for r in results if "fwdbwd_ms" in r]
+    if ok:
+        best = min(ok, key=lambda r: r["fwdbwd_ms"])
+        print("\nbest fwd+bwd:", json.dumps(best))
+    (ROOT / "KSWEEP.json").write_text(json.dumps(results, indent=1))
+    print(f"wrote {ROOT / 'KSWEEP.json'}")
+
+
+if __name__ == "__main__":
+    main()
