@@ -167,14 +167,19 @@ def _decode_step(cfg, params, cache: KVCache, token, cos, sin):
     return KVCache(k=k, v=v, length=pos + 1), logits
 
 
-def _sample(logits, key, temperature: float, top_k: int, top_p: float = 0.0):
-    if temperature == 0.0:
+def _sample(logits, key, temperature, top_k: int, top_p, *,
+            greedy: bool, use_top_p: bool):
+    """``temperature``/``top_p`` are TRACED scalars — distinct values
+    reuse one compile (a serving endpoint must not let client floats
+    mint XLA executables); ``greedy``/``top_k``/``use_top_p`` are the
+    static structure."""
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k:
         thresh = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < thresh, -2.0e38, logits)
-    if top_p and top_p < 1.0:
+    if use_top_p:
         # nucleus filter as a threshold, not a scatter: the smallest
         # logit inside the top-p mass bounds the kept set, so one sort +
         # one compare keeps the step free of gather/scatter (ties at the
@@ -190,40 +195,28 @@ def _sample(logits, key, temperature: float, top_k: int, top_p: float = 0.0):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
-@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "temperature",
-                                   "top_k", "top_p", "eos_id"))
-def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
-             key=None, temperature: float = 0.0, top_k: int = 0,
-             top_p: float = 0.0, eos_id: int | None = None):
-    """prompt [b, s] → [b, s + max_new_tokens]. Greedy when temperature=0;
-    ``top_k``/``top_p`` (nucleus) filters compose when temperature > 0.
-
-    One compile per (shape, cfg): prefill + a single scan over the new
-    positions. With ``eos_id`` set, rows that have emitted it keep their
-    static shape but are padded with ``eos_id`` from that point on — the
-    scan stays one fused XLA while-loop (no data-dependent trip count),
-    which is what serving on TPU wants; callers slice at the first eos.
-    MoE models route dropless at inference (see ``_inference_cfg``).
-    """
-    cfg = _inference_cfg(cfg)
+@partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k",
+                                   "greedy", "use_top_p", "use_eos"))
+def _generate_jit(cfg: llama.LlamaConfig, params, prompt, temperature,
+                  top_p, eos_id, key, *, max_new_tokens: int, top_k: int,
+                  greedy: bool, use_top_p: bool, use_eos: bool):
     b, s = prompt.shape
     max_len = s + max_new_tokens
-    if key is None:
-        key = jax.random.key(0)
     cache, logits = prefill(cfg, params, prompt, max_len)
     cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta,
                           scaling=cfg.rope_scaling())
     first_key, key = jax.random.split(key)
-    first = _sample(logits, first_key, temperature, top_k, top_p)
-    done = (first == eos_id) if eos_id is not None else jnp.zeros(
-        (b,), bool)
+    sample = partial(_sample, temperature=temperature, top_k=top_k,
+                     top_p=top_p, greedy=greedy, use_top_p=use_top_p)
+    first = sample(logits, first_key)
+    done = (first == eos_id) if use_eos else jnp.zeros((b,), bool)
 
     def body(carry, step_key):
         cache, token, done = carry
         cache, logits = _decode_step(cfg, params, cache, token, cos, sin)
-        nxt = _sample(logits, step_key, temperature, top_k, top_p)
-        if eos_id is not None:
-            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+        nxt = sample(logits, step_key)
+        if use_eos:
+            nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)
         return (cache, nxt, done), nxt
 
@@ -233,3 +226,42 @@ def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
     keys = jax.random.split(key, max_new_tokens - 1)
     _, toks = jax.lax.scan(body, (cache, first, done), keys)
     return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
+
+
+def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
+             key=None, temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0, eos_id: int | None = None):
+    """prompt [b, s] → [b, s + max_new_tokens]. Greedy when temperature=0;
+    ``top_k``/``top_p`` (nucleus) filters compose when temperature > 0.
+
+    Compiles per (shape, cfg, max_new_tokens, top_k, sampling structure):
+    ``temperature``, ``top_p``, and ``eos_id`` are traced dynamically, so
+    a serving endpoint fielding arbitrary client values reuses one
+    executable (only their presence/absence switches programs). The
+    decode loop is prefill + a single scan over the new positions. With
+    ``eos_id`` set, rows that have emitted it keep their static shape but
+    are padded with ``eos_id`` from that point on — the scan stays one
+    fused XLA while-loop (no data-dependent trip count), which is what
+    serving on TPU wants; callers slice at the first eos. MoE models
+    route dropless at inference (see ``_inference_cfg``).
+    """
+    if key is None:
+        key = jax.random.key(0)
+    temperature = float(temperature)
+    top_p = float(top_p)
+    greedy = temperature == 0.0
+    if greedy:
+        # argmax ignores the filters: normalize them out of the static
+        # cache key so greedy clients sending top_k/top_p don't mint
+        # byte-identical executables
+        top_k, top_p = 0, 0.0
+    return _generate_jit(
+        _inference_cfg(cfg), params, prompt,
+        jnp.float32(1.0 if greedy else temperature),
+        jnp.float32(top_p),
+        jnp.int32(-1 if eos_id is None else eos_id),
+        key,
+        max_new_tokens=max_new_tokens, top_k=int(top_k), greedy=greedy,
+        use_top_p=bool(top_p) and top_p < 1.0,
+        use_eos=eos_id is not None,
+    )

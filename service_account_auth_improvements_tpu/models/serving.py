@@ -1,0 +1,264 @@
+"""Minimal generation server: the KV-cache decode path over HTTP.
+
+Serves ``POST /v1/completions`` (ids in → ids out, OpenAI-shaped body)
+plus ``/healthz`` and ``/v1/models`` from a stdlib ThreadingHTTPServer —
+the serving story for a notebook pod: load a checkpoint (optionally
+int8-quantized, models/quantize.py), bind a port, and the control
+plane's per-notebook VirtualService already routes to it. Ids-only by
+design: tokenization is a vocab-specific concern the caller owns
+(transformers tokenizers work offline in the image), and it keeps the
+server dependency-free.
+
+Generation is serialized under a lock (one chip, one jit cache) and
+jitted per (prompt shape, max_new_tokens bucket, top_k, sampling
+structure); temperature/top_p/eos_id are traced dynamically so
+arbitrary client values reuse one executable, batch size is bounded,
+and max_new_tokens and top_k run at the next power of two (completions
+truncated to the requested n; the top-k set marginally wider) — every
+client-controlled compile key except prompt length is finite.
+Production callers should bucket prompt lengths. The
+reference has no serving surface at all (SURVEY.md §2b); this completes
+the train → checkpoint → serve lifecycle the workload layer provides.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+
+from service_account_auth_improvements_tpu.models import generate, llama
+
+
+class BadRequest(ValueError):
+    pass
+
+
+def _scalar(body: dict, name: str, cast, default, lo=None, hi=None):
+    """Coerce and range-check an optional scalar field; malformed or
+    out-of-range input is the CLIENT's error (400), never a 500. An
+    explicit JSON null only stands for "absent" when the default itself
+    is None (eos_id)."""
+    v = body.get(name, default)
+    if v is None:
+        if default is None:
+            return None
+        raise BadRequest(f"{name} must be a {cast.__name__}, not null")
+    try:
+        v = cast(v)
+    except (TypeError, ValueError, OverflowError):
+        raise BadRequest(f"{name} must be a {cast.__name__}")
+    if not math.isfinite(v):
+        raise BadRequest(f"{name} must be finite")
+    if (lo is not None and v < lo) or (hi is not None and v > hi):
+        raise BadRequest(f"{name} must be in [{lo}, {hi}]")
+    return v
+
+
+class GenerationService:
+    """Validates requests and runs the jitted decode; thread-safe."""
+
+    def __init__(self, cfg: llama.LlamaConfig, params,
+                 max_new_cap: int = 512, max_batch: int = 8,
+                 name: str = "llama"):
+        self.cfg = cfg
+        self.params = params
+        self.max_new_cap = max_new_cap
+        self.max_batch = max_batch
+        self.name = name
+        self._lock = threading.Lock()
+
+    def info(self) -> dict:
+        return {
+            "id": self.name,
+            "vocab_size": self.cfg.vocab_size,
+            "max_seq_len": self.cfg.max_seq_len,
+            "params": self.cfg.param_count(),
+            "max_new_tokens_cap": self.max_new_cap,
+            "max_batch": self.max_batch,
+        }
+
+    def complete(self, body: dict) -> dict:
+        prompts = body.get("prompt_ids")
+        if isinstance(prompts, list) and prompts and isinstance(
+                prompts[0], int):
+            prompts = [prompts]
+        if (not isinstance(prompts, list) or not prompts
+                or not all(isinstance(p, list) and p for p in prompts)):
+            raise BadRequest("prompt_ids must be a non-empty id list "
+                             "or list of id lists")
+        if len(prompts) > self.max_batch:
+            # batch size is a jit compile key: bound it, or clients mint
+            # executables (and KV caches) without limit
+            raise BadRequest(f"at most {self.max_batch} prompts "
+                             f"per request")
+        s = len(prompts[0])
+        if any(len(p) != s for p in prompts):
+            raise BadRequest("all prompts must have equal length "
+                             "(bucket or pad upstream)")
+        flat = [t for p in prompts for t in p]
+        if not all(isinstance(t, int) and 0 <= t < self.cfg.vocab_size
+                   for t in flat):
+            raise BadRequest(f"token ids must be ints in "
+                             f"[0, {self.cfg.vocab_size})")
+        n = _scalar(body, "max_new_tokens", int, 16,
+                    lo=1, hi=self.max_new_cap)
+        if s + n > self.cfg.max_seq_len:
+            raise BadRequest(f"prompt+completion exceeds max_seq_len "
+                             f"{self.cfg.max_seq_len}")
+        # temperature/top_p/eos_id are traced dynamically by generate()
+        # (arbitrary client values share one executable); top_k is a
+        # static jit arg, so bound it to keep the compile cache finite
+        # (and <= vocab, or lax.top_k fails at trace time)
+        temperature = _scalar(body, "temperature", float, 0.0,
+                              lo=0.0, hi=100.0)
+        top_k = _scalar(body, "top_k", int, 0,
+                        lo=0, hi=min(1024, self.cfg.vocab_size))
+        if top_k:
+            # top_k is a static compile key: bucket it to the next power
+            # of two (~10 executables instead of ~1024; the nucleus set
+            # is marginally wider — the serving tradeoff, documented)
+            top_k = min(1 << (top_k - 1).bit_length(),
+                        self.cfg.vocab_size)
+        top_p = _scalar(body, "top_p", float, 0.0, lo=0.0, hi=1.0)
+        eos_id = _scalar(body, "eos_id", int, None,
+                         lo=0, hi=self.cfg.vocab_size - 1)
+        key = jax.random.key(
+            _scalar(body, "seed", int, 0, lo=0, hi=2**32 - 1)
+        )
+
+        # max_new_tokens is a compile key too: run the next power of two
+        # and truncate, so the cap admits ~log2(cap) executables, not
+        # cap. Near the context limit, clamp to the remaining window —
+        # a function of s (already a compile key), not a new one.
+        n_run = min(1 << (n - 1).bit_length(),
+                    self.cfg.max_seq_len - s)
+        toks = jnp.asarray(prompts, jnp.int32)
+        with self._lock:
+            out = generate.generate(
+                self.cfg, self.params, toks, n_run, key=key,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id,
+            )
+        completion = [[int(t) for t in row[s:s + n]] for row in out]
+        if eos_id is not None:
+            # eos-padded rows truncate at (and include) the first eos
+            completion = [
+                row[: row.index(eos_id) + 1] if eos_id in row else row
+                for row in completion
+            ]
+        return {
+            "model": self.name,
+            "completion_ids": completion,
+            "usage": {
+                "prompt_tokens": len(prompts) * s,
+                "completion_tokens": sum(len(r) for r in completion),
+            },
+        }
+
+
+def make_server(service: GenerationService, host: str = "127.0.0.1",
+                port: int = 0) -> ThreadingHTTPServer:
+    """Bind (but do not serve) an HTTP server for ``service``; callers
+    run ``serve_forever()`` and MUST ``shutdown()``/``server_close()``
+    when done (no orphan listeners)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, obj: dict):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                self._reply(200, {"ok": True})
+            elif self.path == "/v1/models":
+                self._reply(200, {"data": [service.info()]})
+            else:
+                self._reply(404, {"error": "not found"})
+
+        def do_POST(self):
+            if self.path != "/v1/completions":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                except (TypeError, ValueError):
+                    raise BadRequest("invalid Content-Length")
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(body, dict):
+                    raise BadRequest("body must be a JSON object")
+                self._reply(200, service.complete(body))
+            except BadRequest as e:
+                self._reply(400, {"error": str(e)})
+            except json.JSONDecodeError:
+                self._reply(400, {"error": "invalid JSON"})
+            except Exception as e:  # surface, don't kill the thread
+                self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def log_message(self, *a):  # tests/notebooks: no stderr spam
+            pass
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--preset", default="llama3_1b")
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=8000)
+    ap.add_argument("--checkpoint-dir",
+                    help="orbax dir from train/checkpoint.py; random "
+                         "init when omitted (demo mode)")
+    ap.add_argument("--int8", action="store_true",
+                    help="weight-only int8 (models/quantize.py)")
+    ap.add_argument("--max-new-cap", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        llama.PRESETS[args.preset], param_dtype="bfloat16"
+    )
+    if args.checkpoint_dir:
+        from service_account_auth_improvements_tpu.parallel import (
+            MeshConfig, make_mesh,
+        )
+        from service_account_auth_improvements_tpu.train import checkpoint
+
+        # params-only restore: optimizer moments are never read or
+        # allocated, and the writing optimizer never needs
+        # reconstructing
+        mesh = make_mesh(MeshConfig(), jax.devices()[:1])
+        params = checkpoint.restore_params(args.checkpoint_dir, mesh, cfg)
+    else:
+        params = llama.init(cfg, jax.random.key(0))
+    if args.int8:
+        from service_account_auth_improvements_tpu.models import quantize
+
+        params = quantize.quantize_params(params)
+
+    service = GenerationService(cfg, params, max_new_cap=args.max_new_cap,
+                                name=args.preset)
+    httpd = make_server(service, args.host, args.port)
+    print(f"serving {args.preset} on {httpd.server_address}")
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        httpd.server_close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -19,10 +19,16 @@ from __future__ import annotations
 import pathlib
 
 import jax
+import jax.tree_util as jtu
 import orbax.checkpoint as ocp
 
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel.sharding import (
+    tree_logical_sharding,
+)
 from service_account_auth_improvements_tpu.train.step import (
     TrainState,
+    flat_path_shardings,
     state_shardings,
 )
 
@@ -56,6 +62,66 @@ def latest_step(directory) -> int | None:
     mgr = _manager(directory)
     try:
         return mgr.latest_step()
+    finally:
+        mgr.close()
+
+
+def restore_params(directory, mesh, cfg, step: int | None = None,
+                   rules=None):
+    """Restore ONLY the params subtree — the inference/serving path.
+
+    The target tree comes from the checkpoint's own metadata, so the
+    optimizer that wrote the state never has to be reconstructed (any
+    chain/mu_dtype works), and non-param leaves (Adam moments — 3-4x the
+    params' bytes) are skipped outright (``ocp.PLACEHOLDER``): never
+    read from disk, never allocated."""
+    flat_p = flat_path_shardings(
+        tree_logical_sharding(mesh, llama.logical_axes(cfg), rules)
+    )
+
+    def to_target(kp, leaf):
+        path = jtu.keystr(kp)
+        if "params" in path:
+            for p_path, s in flat_p.items():
+                if path.endswith(p_path):
+                    return jax.ShapeDtypeStruct(
+                        tuple(leaf.shape), leaf.dtype, sharding=s
+                    )
+            # a params leaf the cfg doesn't know is a cfg/checkpoint
+            # mismatch — fail here with the path, not later with a
+            # baffling committed-to-CPU device error in generate()
+            raise ValueError(
+                f"checkpoint params leaf {path} matches no param of "
+                f"the given config — wrong --preset for this checkpoint?"
+            )
+        return ocp.PLACEHOLDER
+
+    mgr = _manager(directory)
+    try:
+        use = mgr.latest_step() if step is None else step
+        if use is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        # the manager's item_metadata needs a handler registry; the
+        # StandardCheckpointer reads the same layout directly
+        ckptr = ocp.StandardCheckpointer()
+        try:
+            meta = ckptr.metadata(
+                pathlib.Path(directory).absolute() / str(use) / "default"
+            )
+        finally:
+            ckptr.close()
+        meta = getattr(meta, "item_metadata", meta)
+        target = jtu.tree_map_with_path(to_target, meta)
+        # PyTreeRestore, not StandardRestore: only the PyTree handler
+        # honors PLACEHOLDER leaves (skip read + allocation)
+        restored = mgr.restore(use, args=ocp.args.PyTreeRestore(
+            item=target,
+            restore_args=ocp.checkpoint_utils.construct_restore_args(
+                target
+            ),
+        ))
+        return (restored["params"] if isinstance(restored, dict)
+                else restored.params)
     finally:
         mgr.close()
 

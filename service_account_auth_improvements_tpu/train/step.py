@@ -84,21 +84,27 @@ def state_shardings(mesh, cfg: llama.LlamaConfig, state: TrainState,
     return tree_state_shardings(mesh, llama.logical_axes(cfg), state, rules)
 
 
+def flat_path_shardings(shardings_tree) -> dict:
+    """{keystr(path): sharding} — the suffix-matching table used to lay
+    non-param leaves (Adam moments, checkpoint targets) onto their
+    param's sharding. Shared by ``tree_state_shardings`` and
+    ``checkpoint.restore_params`` so the matching invariant lives once."""
+    return {
+        jax.tree_util.keystr(kp): s
+        for kp, s in jax.tree_util.tree_flatten_with_path(
+            shardings_tree,
+            is_leaf=lambda x: isinstance(x, NamedSharding),
+        )[0]
+    }
+
+
 def tree_state_shardings(mesh, axes_tree, state: TrainState,
                          rules=None) -> TrainState:
     """``state_shardings`` for any params tree + its logical-axes tree
     (the generic core — LoRA adapter states reuse it, train/lora.py)."""
     rules = rules or DEFAULT_RULES
     p_shardings = tree_logical_sharding(mesh, axes_tree, rules)
-    flat_p = {
-        id_path: s
-        for id_path, s in zip(
-            [jax.tree_util.keystr(kp) for kp, _ in
-             jax.tree_util.tree_flatten_with_path(state.params)[0]],
-            jax.tree.leaves(p_shardings,
-                            is_leaf=lambda x: isinstance(x, NamedSharding)),
-        )
-    }
+    flat_p = flat_path_shardings(p_shardings)
     replicated = NamedSharding(mesh, P())
 
     def opt_leaf(kp, leaf):

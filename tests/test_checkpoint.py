@@ -74,6 +74,40 @@ def test_resume_training_matches_uninterrupted(tmp_path):
     )
 
 
+def test_restore_params_only_any_optimizer(tmp_path):
+    """The serving path: params restored from the checkpoint's own
+    metadata — no optimizer reconstruction — and bit-equal to the saved
+    params even when the writer used a non-default optimizer."""
+    from service_account_auth_improvements_tpu.train import make_optimizer
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    opt = make_optimizer(mu_dtype="bfloat16")  # non-default chain state
+    state = init_train_state(CFG, jax.random.key(0), optimizer=opt)
+    state = jax.device_put(state, state_shardings(mesh, CFG, state))
+    ckpt.save(tmp_path / "ck", state)
+
+    params = ckpt.restore_params(tmp_path / "ck", mesh, CFG)
+    for want, got in zip(jax.tree.leaves(state.params),
+                         jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+    # params really land mesh-sharded (not the host fallback — that
+    # would mean the metadata path matching silently failed)
+    wq_sh = params["layers"]["wq"].sharding
+    assert isinstance(wq_sh, jax.sharding.NamedSharding), wq_sh
+    assert wq_sh.mesh.shape == mesh.shape
+    # tree structure matches the live params exactly
+    assert (jax.tree_util.tree_structure(params)
+            == jax.tree_util.tree_structure(state.params))
+
+    # a config that doesn't know the checkpoint's params must fail loud
+    # (with the offending path), not restore onto host silently
+    import pytest
+
+    wrong = dataclasses.replace(CFG, moe_experts=4)
+    with pytest.raises(ValueError, match="matches no param"):
+        ckpt.restore_params(tmp_path / "ck", mesh, wrong)
+
+
 def test_max_to_keep_gc(tmp_path):
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
     state = init_train_state(CFG, jax.random.key(0))

@@ -101,7 +101,8 @@ def test_top_p_mask_keeps_nucleus_only():
     # probs ~ [0.5, 0.25, 0.125, ...]: nucleus(0.6) = {0, 1}
     logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.125, 0.0625, 0.0625]]))
     draws = jax.vmap(
-        lambda k: generate._sample(logits, k, 1.0, 0, 0.6)[0]
+        lambda k: generate._sample(logits, k, 1.0, 0, 0.6,
+                                   greedy=False, use_top_p=True)[0]
     )(jax.random.split(jax.random.key(0), 200))
     assert set(np.asarray(draws).tolist()) == {0, 1}
 
@@ -127,6 +128,25 @@ def test_eos_pads_after_first_hit():
             assert (gen_out[j + 1:] == eos).all()
         else:
             np.testing.assert_array_equal(gen_out, gen_free)
+
+
+def test_sampling_values_do_not_recompile():
+    """temperature/top_p/eos_id are dynamic: distinct values must share
+    one executable (a serving endpoint can't let client floats mint XLA
+    compiles)."""
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(8), (1, 4), 0,
+                                CFG.vocab_size)
+    before = generate._generate_jit._cache_size()
+    for t, p, e in [(0.7, 0.9, 1), (0.8, 0.95, 2), (1.3, 0.5, 7)]:
+        generate.generate(CFG, params, prompt, 4, key=jax.random.key(1),
+                          temperature=t, top_p=p, eos_id=e)
+    assert generate._generate_jit._cache_size() == before + 1
+    # greedy ignores the filters: varying top_k/top_p at temperature=0
+    # must all share ONE more executable (the no-filter greedy program)
+    for k, p in [(0, 0.0), (16, 0.9), (32, 0.5)]:
+        generate.generate(CFG, params, prompt, 4, top_k=k, top_p=p)
+    assert generate._generate_jit._cache_size() == before + 2
 
 
 def test_generate_on_tp_mesh_matches_single_device():

@@ -1,0 +1,157 @@
+"""Generation server over live HTTP (models/serving.py): completions
+parity with direct generate(), validation, eos truncation, lifecycle."""
+
+import dataclasses
+import json
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from service_account_auth_improvements_tpu.models import (
+    generate,
+    llama,
+    serving,
+)
+
+CFG = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def server():
+    params = llama.init(CFG, jax.random.key(0))
+    svc = serving.GenerationService(CFG, params, max_new_cap=32,
+                                    name="tiny")
+    httpd = serving.make_server(svc)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    host, port = httpd.server_address
+    try:
+        yield f"http://{host}:{port}", params
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        t.join(timeout=5)
+
+
+def _req(base, path, body=None):
+    if body is None:
+        r = urllib.request.urlopen(base + path, timeout=30)
+        return r.status, json.loads(r.read())
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        base + path, data=data,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        r = urllib.request.urlopen(req, timeout=120)
+        return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz_and_models(server):
+    base, _ = server
+    assert _req(base, "/healthz")[1] == {"ok": True}
+    code, models = _req(base, "/v1/models")
+    assert code == 200
+    assert models["data"][0]["vocab_size"] == CFG.vocab_size
+    assert models["data"][0]["params"] == CFG.param_count()
+
+
+def test_completions_match_direct_generate(server):
+    base, params = server
+    prompts = np.random.RandomState(0).randint(
+        0, CFG.vocab_size, (2, 6)).tolist()
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": prompts, "max_new_tokens": 8,
+    })
+    assert code == 200, out
+    want = generate.generate(CFG, params, jnp.asarray(prompts, jnp.int32), 8)
+    assert out["completion_ids"] == np.asarray(want)[:, 6:].tolist()
+    assert out["usage"] == {"prompt_tokens": 12, "completion_tokens": 16}
+
+
+def test_single_prompt_and_sampling_reproducible(server):
+    base, _ = server
+    body = {"prompt_ids": [5, 9, 2], "max_new_tokens": 6,
+            "temperature": 0.8, "top_k": 16, "top_p": 0.9, "seed": 3}
+    a = _req(base, "/v1/completions", body)[1]
+    b = _req(base, "/v1/completions", body)[1]
+    assert a == b
+    assert len(a["completion_ids"]) == 1
+    assert len(a["completion_ids"][0]) == 6
+
+
+def test_eos_truncates_completion(server):
+    base, params = server
+    prompt = [[1, 2, 3, 4]]
+    free = _req(base, "/v1/completions", {
+        "prompt_ids": prompt, "max_new_tokens": 8})[1]["completion_ids"][0]
+    eos = free[0]
+    out = _req(base, "/v1/completions", {
+        "prompt_ids": prompt, "max_new_tokens": 8, "eos_id": eos,
+    })[1]["completion_ids"][0]
+    assert out == [eos]
+
+
+def test_batch_bound_and_n_bucketing(server):
+    base, params = server
+    # batch size is a compile key: bounded server-side
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1, 2]] * 9, "max_new_tokens": 4})
+    assert code == 400 and "prompts" in out["error"]
+    # a non-power-of-two n runs the bucketed length but returns exactly n
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[7, 8, 9]], "max_new_tokens": 5})
+    assert code == 200
+    assert len(out["completion_ids"][0]) == 5
+    assert out["usage"]["completion_tokens"] == 5
+    # greedy: the 5 tokens equal the prefix of the direct 8-token run
+    want = generate.generate(CFG, params,
+                             jnp.asarray([[7, 8, 9]], jnp.int32), 8)
+    assert out["completion_ids"][0] == np.asarray(want)[0, 3:8].tolist()
+
+
+def test_validation_errors(server):
+    base, _ = server
+    cases = [
+        ({"prompt_ids": [[1, 2], [3]]}, "equal length"),
+        ({"prompt_ids": []}, "non-empty"),
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": 0}, "max_new_tokens"),
+        ({"prompt_ids": [[CFG.vocab_size]]}, "token ids"),
+        ({"prompt_ids": [[1]], "max_new_tokens": 31 + CFG.max_seq_len},
+         "max_new_tokens"),
+    ]
+    cases += [
+        # malformed scalars are client errors (400), never 500
+        ({"prompt_ids": [[1, 2]], "temperature": "hot"}, "temperature"),
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": "lots"},
+         "max_new_tokens"),
+        ({"prompt_ids": [[1, 2]], "seed": [1]}, "seed"),
+        ({"prompt_ids": [[1, 2]], "top_k": 4096}, "top_k"),
+        # explicit null is not "absent" for non-None defaults
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": None},
+         "max_new_tokens"),
+        # out-of-range / non-finite values are 400s, not garbage or 500s
+        ({"prompt_ids": [[1, 2]], "temperature": 0.5, "top_p": -0.5},
+         "top_p"),
+        ({"prompt_ids": [[1, 2]], "temperature": float("nan")},
+         "temperature"),
+        # top_k is bounded by the model's vocab (tiny: 256), not just 1024
+        ({"prompt_ids": [[1, 2]], "top_k": 512}, "top_k"),
+        ({"prompt_ids": [[1, 2]], "eos_id": 2**40}, "eos_id"),
+        ({"prompt_ids": [[1, 2]], "seed": None}, "seed"),
+    ]
+    for body, msg in cases:
+        code, out = _req(base, "/v1/completions", body)
+        assert code == 400 and msg in out["error"], (body, out)
+    # over the seq limit but under the cap
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1] * (CFG.max_seq_len - 4)], "max_new_tokens": 8,
+    })
+    assert code == 400 and "max_seq_len" in out["error"]
+    assert _req(base, "/nope", {})[0] == 404
