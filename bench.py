@@ -310,6 +310,10 @@ def _child_main() -> None:
             # switch-MoE preset: routing + dispatch/combine overhead on one
             # chip; MFU uses active_matmul_param_count (top-1 experts)
             ("bench_moe", llama.PRESETS["bench_moe"]),
+            # Mixtral-style top-2 on the same geometry: doubled dispatch
+            # capacity + renormalized gates — the top-k routing cost row
+            ("bench_moe_top2",
+             dataclasses.replace(llama.PRESETS["bench_moe"], moe_top_k=2)),
             # long-context: 4x the sequence at 1/4 the batch (same token
             # budget) — tracks the flash kernel + chunked-CE behavior as
             # the attention share grows
