@@ -195,6 +195,27 @@ def _sample(logits, key, temperature, top_k: int, top_p, *,
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def _decode_scan(cfg, params, cache, token, done, keys, sample, eos_id,
+                 use_eos, cos, sin):
+    """The decode loop shared by the one-shot and chunked paths — ONE
+    copy of the step/sample/eos-masking semantics, so chunked greedy
+    decode provably equals one-shot decode."""
+
+    def body(carry, step_key):
+        cache, token, done = carry
+        cache, logits = _decode_step(cfg, params, cache, token, cos, sin)
+        nxt = sample(logits, step_key)
+        if use_eos:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done), nxt
+
+    (cache, token, done), toks = jax.lax.scan(
+        body, (cache, token, done), keys
+    )
+    return cache, token, done, toks.T
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_new_tokens", "top_k",
                                    "greedy", "use_top_p", "use_eos"))
 def _generate_jit(cfg: llama.LlamaConfig, params, prompt, temperature,
@@ -211,21 +232,115 @@ def _generate_jit(cfg: llama.LlamaConfig, params, prompt, temperature,
     first = sample(logits, first_key)
     done = (first == eos_id) if use_eos else jnp.zeros((b,), bool)
 
-    def body(carry, step_key):
-        cache, token, done = carry
-        cache, logits = _decode_step(cfg, params, cache, token, cos, sin)
-        nxt = sample(logits, step_key)
-        if use_eos:
-            nxt = jnp.where(done, eos_id, nxt)
-            done = done | (nxt == eos_id)
-        return (cache, nxt, done), nxt
-
     # max_new_tokens - 1 decode steps: `first` came from prefill, and the
     # final position's logits are never consumed, so a full-length scan
     # would run one L-layer decode whose output is discarded
     keys = jax.random.split(key, max_new_tokens - 1)
-    _, toks = jax.lax.scan(body, (cache, first, done), keys)
-    return jnp.concatenate([prompt, first[:, None], toks.T], axis=1)
+    _, _, _, toks = _decode_scan(cfg, params, cache, first, done, keys,
+                                 sample, eos_id, use_eos, cos, sin)
+    return jnp.concatenate([prompt, first[:, None], toks], axis=1)
+
+
+class StreamState(NamedTuple):
+    """Carry between ``stream_decode`` chunks. ``token`` is the newest
+    sampled token (already emitted); ``done`` marks rows past their
+    eos."""
+    cache: KVCache
+    token: jax.Array   # [b] int32
+    done: jax.Array    # [b] bool
+    key: jax.Array
+
+
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def _prefill_jit(cfg, params, prompt, max_len):
+    return prefill(cfg, params, prompt, max_len)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n", "top_k", "greedy",
+                                   "use_top_p", "use_eos"))
+def _decode_chunk_jit(cfg, params, cache, token, done, temperature, top_p,
+                      eos_id, key, *, n, top_k, greedy, use_top_p,
+                      use_eos):
+    max_len = cache.k.shape[2]
+    cos, sin = rope_table(max_len, cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling())
+    sample = partial(_sample, temperature=temperature, top_k=top_k,
+                     top_p=top_p, greedy=greedy, use_top_p=use_top_p)
+    keys = jax.random.split(key, n)
+    return _decode_scan(cfg, params, cache, token, done, keys, sample,
+                        eos_id, use_eos, cos, sin)
+
+
+@partial(jax.jit, static_argnames=("top_k", "greedy", "use_top_p"))
+def _sample_jit(logits, key, temperature, top_p, *, top_k, greedy,
+                use_top_p):
+    """Jitted one-off sample (the streaming first token) — the decode
+    paths sample inside their own jits."""
+    return _sample(logits, key, temperature, top_k, top_p, greedy=greedy,
+                   use_top_p=use_top_p)
+
+
+def _sampling_statics(temperature: float, top_k: int, top_p: float):
+    temperature, top_p = float(temperature), float(top_p)
+    greedy = temperature == 0.0
+    if greedy:
+        top_k, top_p = 0, 0.0
+    return (jnp.float32(1.0 if greedy else temperature),
+            jnp.float32(top_p), int(top_k), greedy,
+            bool(top_p) and top_p < 1.0)
+
+
+def start_stream(cfg: llama.LlamaConfig, params, prompt,
+                 max_new_tokens: int, key=None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 eos_id: int | None = None):
+    """Begin chunked decoding: returns (StreamState, first_token [b]).
+
+    Streaming exists for two reasons the one-shot ``generate`` scan
+    cannot serve: emitting tokens as they decode (SSE), and HOST-side
+    early stop — once every row's ``done`` flag is set the caller just
+    stops issuing chunks, cutting compute that the fixed-trip-count
+    scan would burn. Keys are split per chunk, so a streamed sequence
+    reproduces for a given (seed, chunk size) but is a different (still
+    valid) draw than the one-shot ``generate``'s."""
+    cfg = _inference_cfg(cfg)
+    b, s = prompt.shape
+    if key is None:
+        key = jax.random.key(0)
+    t, p, k_, greedy, use_top_p = _sampling_statics(temperature, top_k,
+                                                    top_p)
+    cache, logits = _prefill_jit(cfg, params, prompt, s + max_new_tokens)
+    first_key, key = jax.random.split(key)
+    first = _sample_jit(logits, first_key, t, p, top_k=k_, greedy=greedy,
+                        use_top_p=use_top_p)
+    done = (first == eos_id) if eos_id is not None else jnp.zeros(
+        (b,), bool)
+    return StreamState(cache, first, done, key), first
+
+
+def stream_decode(cfg: llama.LlamaConfig, params, state: StreamState,
+                  n: int, temperature: float = 0.0, top_k: int = 0,
+                  top_p: float = 0.0, eos_id: int | None = None):
+    """Decode ``n`` more tokens: (StreamState, tokens [b, n]). Pass the
+    same sampling args as ``start_stream``. Check
+    ``bool(state.done.all())`` between chunks to stop early."""
+    cfg = _inference_cfg(cfg)
+    max_len = state.cache.k.shape[2]
+    if int(state.cache.length) + n > max_len:
+        raise ValueError(
+            f"chunk of {n} exceeds the stream's token budget "
+            f"(cache {max_len}, used {int(state.cache.length)})"
+        )
+    t, p, k_, greedy, use_top_p = _sampling_statics(temperature, top_k,
+                                                    top_p)
+    key, sub = jax.random.split(state.key)
+    cache, token, done, toks = _decode_chunk_jit(
+        cfg, params, state.cache, state.token, state.done, t, p,
+        jnp.int32(-1 if eos_id is None else eos_id), sub,
+        n=n, top_k=k_, greedy=greedy, use_top_p=use_top_p,
+        use_eos=eos_id is not None,
+    )
+    return StreamState(cache, token, done, key), toks
 
 
 def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
@@ -247,21 +362,12 @@ def generate(cfg: llama.LlamaConfig, params, prompt, max_new_tokens: int,
     """
     if key is None:
         key = jax.random.key(0)
-    temperature = float(temperature)
-    top_p = float(top_p)
-    greedy = temperature == 0.0
-    if greedy:
-        # argmax ignores the filters: normalize them out of the static
-        # cache key so greedy clients sending top_k/top_p don't mint
-        # byte-identical executables
-        top_k, top_p = 0, 0.0
+    t, p, k_, greedy, use_top_p = _sampling_statics(temperature, top_k,
+                                                    top_p)
     return _generate_jit(
-        _inference_cfg(cfg), params, prompt,
-        jnp.float32(1.0 if greedy else temperature),
-        jnp.float32(top_p),
+        _inference_cfg(cfg), params, prompt, t, p,
         jnp.int32(-1 if eos_id is None else eos_id),
         key,
-        max_new_tokens=max_new_tokens, top_k=int(top_k), greedy=greedy,
-        use_top_p=bool(top_p) and top_p < 1.0,
-        use_eos=eos_id is not None,
+        max_new_tokens=max_new_tokens, top_k=k_, greedy=greedy,
+        use_top_p=use_top_p, use_eos=eos_id is not None,
     )

@@ -31,12 +31,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from service_account_auth_improvements_tpu.models import generate, llama
 
 
 class BadRequest(ValueError):
     pass
+
+
+class TooBusy(RuntimeError):
+    """Concurrent-stream cap reached → HTTP 429."""
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << (x - 1).bit_length()
 
 
 def _scalar(body: dict, name: str, cast, default, lo=None, hi=None):
@@ -65,13 +74,17 @@ class GenerationService:
 
     def __init__(self, cfg: llama.LlamaConfig, params,
                  max_new_cap: int = 512, max_batch: int = 8,
-                 name: str = "llama"):
+                 max_streams: int = 4, name: str = "llama"):
         self.cfg = cfg
         self.params = params
         self.max_new_cap = max_new_cap
         self.max_batch = max_batch
         self.name = name
         self._lock = threading.Lock()
+        # each open stream pins a device KV cache between chunks (the
+        # lock wraps only the decodes) — bound them or slow SSE readers
+        # accumulate caches until the chip OOMs
+        self._streams = threading.Semaphore(max_streams)
 
     def info(self) -> dict:
         return {
@@ -83,7 +96,10 @@ class GenerationService:
             "max_batch": self.max_batch,
         }
 
-    def complete(self, body: dict) -> dict:
+    def _parse(self, body: dict):
+        """Validate a completions request → (toks, s, n, n_run, sampling
+        kwargs, key). Raises BadRequest; shared by the one-shot and
+        streaming paths."""
         prompts = body.get("prompt_ids")
         if isinstance(prompts, list) and prompts and isinstance(
                 prompts[0], int):
@@ -123,27 +139,28 @@ class GenerationService:
             # top_k is a static compile key: bucket it to the next power
             # of two (~10 executables instead of ~1024; the nucleus set
             # is marginally wider — the serving tradeoff, documented)
-            top_k = min(1 << (top_k - 1).bit_length(),
-                        self.cfg.vocab_size)
+            top_k = min(_next_pow2(top_k), self.cfg.vocab_size)
         top_p = _scalar(body, "top_p", float, 0.0, lo=0.0, hi=1.0)
         eos_id = _scalar(body, "eos_id", int, None,
                          lo=0, hi=self.cfg.vocab_size - 1)
         key = jax.random.key(
             _scalar(body, "seed", int, 0, lo=0, hi=2**32 - 1)
         )
-
         # max_new_tokens is a compile key too: run the next power of two
         # and truncate, so the cap admits ~log2(cap) executables, not
         # cap. Near the context limit, clamp to the remaining window —
         # a function of s (already a compile key), not a new one.
-        n_run = min(1 << (n - 1).bit_length(),
-                    self.cfg.max_seq_len - s)
-        toks = jnp.asarray(prompts, jnp.int32)
+        n_run = min(_next_pow2(n), self.cfg.max_seq_len - s)
+        sampling = {"temperature": temperature, "top_k": top_k,
+                    "top_p": top_p, "eos_id": eos_id}
+        return jnp.asarray(prompts, jnp.int32), s, n, n_run, sampling, key
+
+    def complete(self, body: dict) -> dict:
+        toks, s, n, n_run, sampling, key = self._parse(body)
+        eos_id = sampling["eos_id"]
         with self._lock:
             out = generate.generate(
-                self.cfg, self.params, toks, n_run, key=key,
-                temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id,
+                self.cfg, self.params, toks, n_run, key=key, **sampling
             )
         completion = [[int(t) for t in row[s:s + n]] for row in out]
         if eos_id is not None:
@@ -156,10 +173,82 @@ class GenerationService:
             "model": self.name,
             "completion_ids": completion,
             "usage": {
-                "prompt_tokens": len(prompts) * s,
+                "prompt_tokens": int(toks.shape[0]) * s,
                 "completion_tokens": sum(len(r) for r in completion),
             },
         }
+
+    STREAM_CHUNK = 16
+
+    def stream_events(self, body: dict):
+        """Validate eagerly, then return an iterator of per-chunk token
+        lists (``[rows][tokens]``) for SSE. Early-stops once every row
+        has emitted its eos — compute the one-shot scan would burn.
+        Raises TooBusy (429) at the concurrent-stream cap."""
+        toks, s, n, n_run, sampling, key = self._parse(body)
+        gen = self._stream_iter(toks, n, n_run, sampling, key)
+        # prime to the sentinel: TooBusy raises HERE (before any HTTP
+        # headers go out), and — crucially — the generator is now
+        # STARTED, so gen.close() is guaranteed to run its finally and
+        # release the stream slot. An unstarted generator's close()
+        # skips finally, which would leak the permit on a client that
+        # disconnects before the first chunk.
+        next(gen)
+        return gen
+
+    def _stream_iter(self, toks, n, n_run, sampling, key):
+        if not self._streams.acquire(blocking=False):
+            raise TooBusy("too many concurrent streams; retry")
+        try:
+            yield None  # primed sentinel (consumed by stream_events)
+            yield from self._stream_chunks(toks, n, n_run, sampling, key)
+        finally:
+            # runs on exhaustion AND on generator close (client gone)
+            self._streams.release()
+
+    def _stream_chunks(self, toks, n, n_run, sampling, key):
+        # the lock wraps each DECODE, never a client write: a slow SSE
+        # consumer must not starve other requests (streams interleave)
+        eos_id = sampling["eos_id"]
+        with self._lock:
+            state, first = generate.start_stream(
+                self.cfg, self.params, toks, n_run, key=key, **sampling
+            )
+        # rows past their eos emit nothing further — concatenated SSE
+        # chunks equal the non-streaming (eos-truncated) completion
+        first = np.asarray(first)  # one bulk transfer, not per-token
+        row_done = [False] * first.shape[0]
+        yield [[int(t)] for t in first]
+        if eos_id is not None:
+            row_done = [int(t) == eos_id for t in first]
+        remaining, produced = n - 1, 0
+        # the done check is a device->host sync: skip it entirely when
+        # no eos is set (done is statically all-False then)
+        while remaining > 0 and not (
+                eos_id is not None and bool(state.done.all())):
+            # bucket the tail chunk by remaining's power of two: reuses
+            # the already-minted executables instead of burning a full
+            # STREAM_CHUNK of L-layer steps to emit a few tokens
+            c = min(self.STREAM_CHUNK, n_run - produced,
+                    _next_pow2(remaining))
+            with self._lock:
+                state, out = generate.stream_decode(
+                    self.cfg, self.params, state, c, **sampling
+                )
+            produced += c
+            emit = min(c, remaining)
+            out = np.asarray(out)  # bulk transfer per chunk
+            chunk = []
+            for i, row in enumerate(out):
+                ids = [] if row_done[i] else [
+                    int(t) for t in row[:emit]
+                ]
+                if eos_id is not None and eos_id in ids:
+                    ids = ids[: ids.index(eos_id) + 1]
+                    row_done[i] = True
+                chunk.append(ids)
+            yield chunk
+            remaining -= emit
 
 
 def make_server(service: GenerationService, host: str = "127.0.0.1",
@@ -197,13 +286,56 @@ def make_server(service: GenerationService, host: str = "127.0.0.1",
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if not isinstance(body, dict):
                     raise BadRequest("body must be a JSON object")
-                self._reply(200, service.complete(body))
+                stream = body.get("stream", False)
+                if not isinstance(stream, bool):
+                    # strict like every other field: "false" is not False
+                    raise BadRequest("stream must be a boolean")
+                if stream:
+                    # validation happens BEFORE the 200 goes out —
+                    # stream_events raises BadRequest eagerly
+                    self._stream(service.stream_events(body))
+                else:
+                    self._reply(200, service.complete(body))
             except BadRequest as e:
                 self._reply(400, {"error": str(e)})
+            except TooBusy as e:
+                self._reply(429, {"error": str(e)})
             except json.JSONDecodeError:
                 self._reply(400, {"error": "invalid JSON"})
             except Exception as e:  # surface, don't kill the thread
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _stream(self, events):
+            """SSE: one `data:` event per decode chunk, then [DONE].
+            Once the 200 is out, errors can only be signalled in-band."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-store")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for chunk in events:
+                    self.wfile.write(
+                        b"data: " + json.dumps({"ids": chunk}).encode()
+                        + b"\n\n"
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"data: [DONE]\n\n")
+            except BrokenPipeError:
+                pass  # client went away mid-stream
+            except Exception as e:
+                try:
+                    self.wfile.write(
+                        b"data: " + json.dumps(
+                            {"error": f"{type(e).__name__}: {e}"}
+                        ).encode() + b"\n\n"
+                    )
+                except OSError:
+                    pass
+            finally:
+                # deterministic stream-slot release on every exit path
+                # (not just when GC collects the generator)
+                events.close()
 
         def log_message(self, *a):  # tests/notebooks: no stderr spam
             pass

@@ -149,6 +149,40 @@ def test_sampling_values_do_not_recompile():
     assert generate._generate_jit._cache_size() == before + 2
 
 
+def test_stream_decode_greedy_matches_one_shot():
+    """Chunked streaming decode (any chunk split) must equal the
+    one-shot generate under greedy decoding."""
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(12), (2, 6), 0,
+                                CFG.vocab_size)
+    want = np.asarray(generate.generate(CFG, params, prompt, 9))[:, 6:]
+
+    state, first = generate.start_stream(CFG, params, prompt, 9)
+    got = [np.asarray(first)[:, None]]
+    for c in (3, 1, 4):  # 1 + 3 + 1 + 4 = 9
+        state, toks = generate.stream_decode(CFG, params, state, c)
+        got.append(np.asarray(toks))
+    np.testing.assert_array_equal(np.concatenate(got, axis=1), want)
+    # the budget guard refuses to decode past the cache (one spare
+    # slot remains: the one-shot path never writes K/V for the final
+    # sampled token, the stream may)
+    with pytest.raises(ValueError, match="budget"):
+        generate.stream_decode(CFG, params, state, 2)
+
+
+def test_stream_done_flags_track_eos():
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(13), (2, 5), 0,
+                                CFG.vocab_size)
+    free = np.asarray(generate.generate(CFG, params, prompt, 8))
+    eos = int(free[0, 5])  # row 0 finishes immediately
+    state, first = generate.start_stream(CFG, params, prompt, 8,
+                                         eos_id=eos)
+    assert bool(state.done[0]) == (int(first[0]) == eos)
+    state, _ = generate.stream_decode(CFG, params, state, 7, eos_id=eos)
+    assert bool(state.done[0])
+
+
 def test_generate_on_tp_mesh_matches_single_device():
     """Generation with tp-sharded params produces the same tokens as
     single-device decode — inference under the serving mesh layout."""

@@ -116,6 +116,97 @@ def test_batch_bound_and_n_bucketing(server):
     assert out["completion_ids"][0] == np.asarray(want)[0, 3:8].tolist()
 
 
+def _sse_events(base, body):
+    req = urllib.request.Request(
+        base + "/v1/completions", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    r = urllib.request.urlopen(req, timeout=120)
+    assert r.headers["Content-Type"] == "text/event-stream"
+    events = []
+    for line in r:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            events.append(line[len("data: "):])
+    return events
+
+
+def test_streaming_matches_one_shot(server):
+    base, _ = server
+    body = {"prompt_ids": [[3, 1, 4], [1, 5, 9]], "max_new_tokens": 21}
+    oneshot = _req(base, "/v1/completions", body)[1]["completion_ids"]
+    events = _sse_events(base, {**body, "stream": True})
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e)["ids"] for e in events[:-1]]
+    rows = [sum((c[i] for c in chunks), []) for i in range(2)]
+    assert rows == oneshot
+    # chunked transfer: first event carries exactly one token per row
+    assert all(len(c) == 1 for c in chunks[0])
+
+
+def test_streaming_early_stops_on_eos(server):
+    base, _ = server
+    prompt = [[2, 7, 1]]
+    free = _req(base, "/v1/completions", {
+        "prompt_ids": prompt, "max_new_tokens": 8})[1]["completion_ids"][0]
+    eos = free[0]
+    events = _sse_events(base, {"prompt_ids": prompt, "max_new_tokens": 30,
+                                "eos_id": eos, "stream": True})
+    chunks = [json.loads(e)["ids"] for e in events[:-1]]
+    total = sum(len(c[0]) for c in chunks)
+    # the first token IS the eos: the stream stops right there instead
+    # of burning 63 more decode steps
+    assert total == 1 and chunks[0][0] == [eos]
+
+
+def test_streaming_eos_rows_match_one_shot(server):
+    """Per-transport parity with eos: concatenated SSE rows equal the
+    eos-truncated non-streaming completion exactly."""
+    base, _ = server
+    prompts = [[2, 7, 1], [6, 6, 6]]
+    free = _req(base, "/v1/completions", {
+        "prompt_ids": prompts, "max_new_tokens": 12})[1]["completion_ids"]
+    eos = free[0][2]  # row 0 hits it mid-stream (position 3 of 12)
+    body = {"prompt_ids": prompts, "max_new_tokens": 12, "eos_id": eos}
+    oneshot = _req(base, "/v1/completions", body)[1]["completion_ids"]
+    chunks = [json.loads(e)["ids"]
+              for e in _sse_events(base, {**body, "stream": True})[:-1]]
+    rows = [sum((c[i] for c in chunks), []) for i in range(2)]
+    assert rows == oneshot
+
+
+def test_streaming_validation_still_400(server):
+    base, _ = server
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1, 2], [3]], "stream": True})
+    assert code == 400 and "equal length" in out["error"]
+    # stream must be a real boolean, not a truthy string
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1, 2]], "stream": "false"})
+    assert code == 400 and "boolean" in out["error"]
+
+
+def test_stream_cap_gives_429_and_releases():
+    params = llama.init(CFG, jax.random.key(0))
+    svc = serving.GenerationService(CFG, params, max_new_cap=32,
+                                    max_streams=1, name="tiny")
+    body = {"prompt_ids": [[1, 2, 3]], "max_new_tokens": 4}
+    first = svc.stream_events(dict(body))
+    next(first)  # stream open, slot taken
+    with pytest.raises(serving.TooBusy):
+        svc.stream_events(dict(body))
+    first.close()  # client disconnect → slot released
+    again = svc.stream_events(dict(body))
+    assert next(again)  # slot available again
+    again.close()
+    # a stream closed before ANY iteration must release too (the
+    # primed-generator guarantee: close() always reaches the finally)
+    svc.stream_events(dict(body)).close()
+    ok = svc.stream_events(dict(body))
+    assert next(ok)
+    ok.close()
+
+
 def test_validation_errors(server):
     base, _ = server
     cases = [
