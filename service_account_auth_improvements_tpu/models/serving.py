@@ -74,17 +74,27 @@ class GenerationService:
 
     def __init__(self, cfg: llama.LlamaConfig, params,
                  max_new_cap: int = 512, max_batch: int = 8,
-                 max_streams: int = 4, name: str = "llama"):
+                 max_streams: int = 4, name: str = "llama", mesh=None):
         self.cfg = cfg
         self.params = params
         self.max_new_cap = max_new_cap
         self.max_batch = max_batch
         self.name = name
+        # serving a sharded model (tp/fsdp over a Mesh): decodes run
+        # under the mesh context; params must already be device_put by
+        # the caller (see main's --tp/--fsdp)
+        self.mesh = mesh
         self._lock = threading.Lock()
         # each open stream pins a device KV cache between chunks (the
         # lock wraps only the decodes) — bound them or slow SSE readers
         # accumulate caches until the chip OOMs
         self._streams = threading.Semaphore(max_streams)
+
+    def _mesh_ctx(self):
+        import contextlib
+
+        return (jax.set_mesh(self.mesh) if self.mesh is not None
+                else contextlib.nullcontext())
 
     def info(self) -> dict:
         return {
@@ -158,7 +168,7 @@ class GenerationService:
     def complete(self, body: dict) -> dict:
         toks, s, n, n_run, sampling, key = self._parse(body)
         eos_id = sampling["eos_id"]
-        with self._lock:
+        with self._lock, self._mesh_ctx():
             out = generate.generate(
                 self.cfg, self.params, toks, n_run, key=key, **sampling
             )
@@ -210,7 +220,7 @@ class GenerationService:
         # the lock wraps each DECODE, never a client write: a slow SSE
         # consumer must not starve other requests (streams interleave)
         eos_id = sampling["eos_id"]
-        with self._lock:
+        with self._lock, self._mesh_ctx():
             state, first = generate.start_stream(
                 self.cfg, self.params, toks, n_run, key=key, **sampling
             )
@@ -231,7 +241,7 @@ class GenerationService:
             # STREAM_CHUNK of L-layer steps to emit a few tokens
             c = min(self.STREAM_CHUNK, n_run - produced,
                     _next_pow2(remaining))
-            with self._lock:
+            with self._lock, self._mesh_ctx():
                 state, out = generate.stream_decode(
                     self.cfg, self.params, state, c, **sampling
                 )
@@ -354,33 +364,57 @@ def main(argv=None) -> int:
     ap.add_argument("--int8", action="store_true",
                     help="weight-only int8 (models/quantize.py)")
     ap.add_argument("--max-new-cap", type=int, default=512)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel ways: shard the model over a "
+                         "tp mesh (models too big for one chip)")
+    ap.add_argument("--fsdp", type=int, default=1,
+                    help="fsdp ways composed with --tp")
     args = ap.parse_args(argv)
+    if args.tp < 1 or args.fsdp < 1:
+        # MeshConfig's -1 "absorb the rest" wildcard and 0-device meshes
+        # must not leak through a serving flag typo
+        ap.error("--tp and --fsdp must be >= 1")
 
     import dataclasses
 
-    cfg = dataclasses.replace(
-        llama.PRESETS[args.preset], param_dtype="bfloat16"
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig, make_mesh,
     )
+
+    cfg = dataclasses.replace(
+        llama.PRESETS[args.preset], param_dtype="bfloat16",
+        # the embedding gather over a tp-sharded vocab axis forces a
+        # full replicate; the iota one-hot contraction reduces cleanly
+        **({"iota_embed": True} if args.tp > 1 else {}),
+    )
+    n_dev = args.tp * args.fsdp
+    mesh = make_mesh(MeshConfig(tp=args.tp, fsdp=args.fsdp),
+                     jax.devices()[:n_dev])
+    serve_mesh = mesh if n_dev > 1 else None
     if args.checkpoint_dir:
-        from service_account_auth_improvements_tpu.parallel import (
-            MeshConfig, make_mesh,
-        )
         from service_account_auth_improvements_tpu.train import checkpoint
 
-        # params-only restore: optimizer moments are never read or
-        # allocated, and the writing optimizer never needs
-        # reconstructing
-        mesh = make_mesh(MeshConfig(), jax.devices()[:1])
+        # params-only restore straight onto the serving mesh: optimizer
+        # moments are never read or allocated, and the writing
+        # optimizer never needs reconstructing
         params = checkpoint.restore_params(args.checkpoint_dir, mesh, cfg)
     else:
+        from service_account_auth_improvements_tpu.parallel.sharding import (
+            tree_logical_sharding,
+        )
+
         params = llama.init(cfg, jax.random.key(0))
+        if serve_mesh is not None:
+            params = jax.device_put(
+                params, tree_logical_sharding(mesh, llama.logical_axes(cfg))
+            )
     if args.int8:
         from service_account_auth_improvements_tpu.models import quantize
 
         params = quantize.quantize_params(params)
 
     service = GenerationService(cfg, params, max_new_cap=args.max_new_cap,
-                                name=args.preset)
+                                name=args.preset, mesh=serve_mesh)
     httpd = make_server(service, args.host, args.port)
     print(f"serving {args.preset} on {httpd.server_address}")
     try:
