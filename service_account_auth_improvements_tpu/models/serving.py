@@ -24,6 +24,7 @@ the train → checkpoint → serve lifecycle the workload layer provides.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import math
 import threading
@@ -91,8 +92,6 @@ class GenerationService:
         self._streams = threading.Semaphore(max_streams)
 
     def _mesh_ctx(self):
-        import contextlib
-
         return (jax.set_mesh(self.mesh) if self.mesh is not None
                 else contextlib.nullcontext())
 

@@ -30,6 +30,7 @@ from service_account_auth_improvements_tpu.train.step import (
     TrainState,
     flat_path_shardings,
     state_shardings,
+    tree_state_shardings,
 )
 
 
@@ -127,13 +128,18 @@ def restore_params(directory, mesh, cfg, step: int | None = None,
 
 
 def restore(directory, mesh, cfg, state_like: TrainState,
-            step: int | None = None, rules=None) -> TrainState:
+            step: int | None = None, rules=None,
+            axes_tree=None) -> TrainState:
     """Restore onto ``mesh``: ``state_like`` supplies the tree structure
     and leaf shapes/dtypes (an abstract ``init_train_state`` result is
     fine — ``jax.eval_shape`` output works), and the logical sharding
     rules lay every leaf back onto the mesh without an unsharded
-    intermediate."""
-    sh = state_shardings(mesh, cfg, state_like, rules=rules)
+    intermediate. ``axes_tree`` overrides the params' logical axes for
+    non-model states (LoRA adapters: ``lora_logical_axes``)."""
+    if axes_tree is None:
+        sh = state_shardings(mesh, cfg, state_like, rules=rules)
+    else:
+        sh = tree_state_shardings(mesh, axes_tree, state_like, rules)
     target = jax.tree.map(
         lambda leaf, s: jax.ShapeDtypeStruct(
             leaf.shape, leaf.dtype, sharding=s

@@ -52,7 +52,8 @@ class LoopConfig:
 
 
 def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
-        loop: LoopConfig, optimizer=None, log=print, eval_data=None):
+        loop: LoopConfig, optimizer=None, log=print, eval_data=None,
+        lora=None, base_params=None):
     """Train for ``loop.steps`` optimizer steps; returns (state, history).
 
     Resume: if ``loop.workdir`` holds a checkpoint, training continues
@@ -63,31 +64,69 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
     pairs); with ``loop.eval_every`` set, a perplexity eval runs on that
     cadence (one prebuilt jitted eval step — no per-eval recompiles) and
     lands in history as ``eval_loss``/``eval_perplexity`` records.
+
+    ``lora`` (a ``train.lora.LoraConfig``) switches to adapter-only
+    fine-tuning over frozen ``base_params`` (already sharded on the
+    mesh): the checkpointed/resumed state is the tiny adapter tree, so
+    a culled notebook resumes a fine-tune from a few-MB checkpoint.
+    History omits MFU in this mode (frozen-weight backprop skips the dW
+    FLOPs the estimate assumes).
     """
-    optimizer = optimizer or make_optimizer()
+    from service_account_auth_improvements_tpu.train import lora as lora_mod
+
+    if lora is not None and base_params is None:
+        raise ValueError("lora fit requires base_params")
+    if optimizer is None:
+        optimizer = (make_optimizer(weight_decay=0.0) if lora is not None
+                     else make_optimizer())
     data = TokenBatches(tokens, data_cfg, mesh)
     start = 0
     if loop.workdir is not None and ckpt.latest_step(loop.workdir) is not None:
         # resume path never materializes an unsharded state: restore lays
         # each leaf straight onto the mesh from the abstract template
-        like = jax.eval_shape(
-            lambda: init_train_state(cfg, jax.random.key(0), optimizer)
-        )
-        state = ckpt.restore(loop.workdir, mesh, cfg, like)
+        if lora is not None:
+            like = jax.eval_shape(lambda: lora_mod.init_lora_state(
+                cfg, lora, jax.random.key(0), optimizer))
+            state = ckpt.restore(
+                loop.workdir, mesh, cfg, like,
+                axes_tree=lora_mod.lora_logical_axes(cfg, lora),
+            )
+        else:
+            like = jax.eval_shape(
+                lambda: init_train_state(cfg, jax.random.key(0), optimizer)
+            )
+            state = ckpt.restore(loop.workdir, mesh, cfg, like)
         start = int(state.step)
         log(f"resumed from step {start}")
+    elif lora is not None:
+        state = lora_mod.init_lora_state(cfg, lora, jax.random.key(0),
+                                         optimizer)
+        state = jax.device_put(
+            state, lora_mod.lora_state_shardings(mesh, cfg, lora, state)
+        )
     else:
         state = init_train_state(cfg, jax.random.key(0), optimizer=optimizer)
         state = jax.device_put(state, state_shardings(mesh, cfg, state))
 
     packed = data_cfg.eos_id is not None
-    step_fn = make_train_step(
-        cfg, optimizer=optimizer, mesh=mesh, packed=packed,
-        # segment-masked attention is a dense-impl feature; flash/ring/
-        # ulysses windows train with the boundary loss mask only
-        segment_eos_id=(data_cfg.eos_id
-                        if packed and cfg.attn_impl == "dense" else None),
-    )
+    if lora is not None:
+        # packed corpora train with the boundary loss mask only (the
+        # adapter step has no segment-masked attention path)
+        raw_step = lora_mod.make_lora_train_step(
+            cfg, lora, optimizer=optimizer, mesh=mesh, packed=packed
+        )
+
+        def step_fn(state, batch, mask):
+            return raw_step(state, base_params, batch, mask)
+    else:
+        step_fn = make_train_step(
+            cfg, optimizer=optimizer, mesh=mesh, packed=packed,
+            # segment-masked attention is a dense-impl feature; flash/
+            # ring/ulysses windows train with the boundary loss mask only
+            segment_eos_id=(data_cfg.eos_id
+                            if packed and cfg.attn_impl == "dense"
+                            else None),
+        )
     eval_step = None
     if loop.eval_every and eval_data is not None:
         from service_account_auth_improvements_tpu.train import evaluate
@@ -116,8 +155,9 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
                 tok_s = tokens_per_step / step_s
                 rec = {"step": i + 1, "loss": loss,
                        "tokens_per_sec": round(tok_s, 1)}
-                util = mfu(cfg.flops_per_token(data_cfg.seq)
-                           * tokens_per_step, step_s, mesh.size)
+                util = (None if lora is not None else mfu(
+                    cfg.flops_per_token(data_cfg.seq) * tokens_per_step,
+                    step_s, mesh.size))
                 if util:
                     rec["mfu"] = round(util, 4)
                 history.append(rec)
@@ -127,7 +167,11 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
                     + ")")
             if eval_step is not None and (i + 1) % loop.eval_every == 0:
                 t_ev = time.perf_counter()
-                ev = evaluate.evaluate(cfg, state.params, eval_data,
+                eval_params = (
+                    lora_mod.merge_lora(base_params, state.params, lora)
+                    if lora is not None else state.params
+                )
+                ev = evaluate.evaluate(cfg, eval_params, eval_data,
                                        step=eval_step)
                 history.append({"step": i + 1,
                                 "eval_loss": round(ev["loss"], 4),

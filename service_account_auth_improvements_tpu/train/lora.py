@@ -141,17 +141,23 @@ def lora_state_shardings(mesh, cfg, lcfg: LoraConfig, state: TrainState,
 
 
 def make_lora_train_step(cfg: llama.LlamaConfig, lcfg: LoraConfig,
-                         optimizer=None, mesh=None, rules=None):
+                         optimizer=None, mesh=None, rules=None,
+                         packed: bool = False):
     """Return jitted ``step(state, base_params, tokens, mask)`` →
     ``(state, metrics)``. Gradients flow through the merge into the
     adapters only; ``base_params`` is a plain argument (not a closure
     constant — XLA handles donated/sharded arguments far better than
-    giant baked-in constants) and comes back untouched."""
+    giant baked-in constants) and comes back untouched. ``packed``
+    declares the mask a pure LOSS mask over a packed corpus (every
+    token real), same semantics as ``make_train_step``."""
     optimizer = optimizer or make_optimizer(weight_decay=0.0)
 
     def loss_fn(lora, base_params, tokens, mask):
         merged = merge_lora(base_params, lora, lcfg)
-        return llama.next_token_loss(cfg, merged, tokens, mask)
+        return llama.next_token_loss(
+            cfg, merged, tokens, mask,
+            token_mask=None if packed else mask,
+        )
 
     def step_fn(state: TrainState, base_params, tokens, mask):
         loss, grads = jax.value_and_grad(loss_fn)(

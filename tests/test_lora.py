@@ -129,6 +129,64 @@ def test_lora_axes_and_moe_targets():
     )
 
 
+def test_lora_fit_checkpoints_and_resumes(tmp_path):
+    """The managed loop fine-tunes adapters with checkpoint/resume: a
+    second fit() picks up from the saved adapter state and reaches the
+    same final state as an uninterrupted run."""
+    import numpy as onp
+
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.parallel.sharding import (
+        tree_logical_sharding,
+    )
+    from service_account_auth_improvements_tpu.train.data import DataConfig
+    from service_account_auth_improvements_tpu.train.loop import (
+        LoopConfig,
+        fit,
+    )
+
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], iota_embed=True)
+    lcfg = LoraConfig(rank=4)
+    mesh = make_mesh(MeshConfig(fsdp=2, tp=2), jax.devices()[:4])
+    base = llama.init(cfg, jax.random.key(0))
+    base = jax.device_put(
+        base, tree_logical_sharding(mesh, llama.logical_axes(cfg))
+    )
+    rng = onp.random.default_rng(0)
+    corpus = rng.integers(0, cfg.vocab_size, size=4096, dtype=onp.int32)
+    dc = DataConfig(batch=4, seq=32)
+
+    # interrupted: 3 steps (checkpointed), then resume to 6
+    wd = str(tmp_path / "run")
+    state_a, _ = fit(cfg, mesh, corpus, dc, LoopConfig(steps=3, workdir=wd),
+                     lora=lcfg, base_params=base)
+    assert int(state_a.step) == 3
+    logs = []
+    state_b, _ = fit(cfg, mesh, corpus, dc,
+                     LoopConfig(steps=6, workdir=wd), lora=lcfg,
+                     base_params=base, log=logs.append)
+    assert any("resumed from step 3" in str(x) for x in logs)
+    assert int(state_b.step) == 6
+
+    # uninterrupted control run matches bit-for-bit
+    state_c, _ = fit(cfg, mesh, corpus, dc,
+                     LoopConfig(steps=6, workdir=None),
+                     lora=lcfg, base_params=base)
+    for want, got in zip(jax.tree.leaves(state_c.params),
+                         jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+    # packed (eos-delimited) corpora fine-tune too: the mask becomes a
+    # pure loss mask in the adapter step, same as make_train_step
+    state_p, _ = fit(cfg, mesh, corpus, DataConfig(batch=4, seq=32,
+                                                   eos_id=1),
+                     LoopConfig(steps=2), lora=lcfg, base_params=base)
+    assert int(state_p.step) == 2
+
+
 def test_lora_unknown_target_raises():
     import pytest
 
