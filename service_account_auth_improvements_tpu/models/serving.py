@@ -28,12 +28,19 @@ import contextlib
 import json
 import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from service_account_auth_improvements_tpu.controlplane.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
 from service_account_auth_improvements_tpu.models import generate, llama
 
 
@@ -90,6 +97,22 @@ class GenerationService:
         # lock wraps only the decodes) — bound them or slow SSE readers
         # accumulate caches until the chip OOMs
         self._streams = threading.Semaphore(max_streams)
+        # same metrics stack as the control plane (SURVEY.md §5:
+        # Prometheus everywhere); per-service registry so several
+        # services can coexist in one process (tests)
+        self.registry = Registry()
+        self.m_requests = Counter(
+            "serving_requests_total", "completion requests by outcome",
+            labels=("mode", "code"), registry=self.registry)
+        self.m_tokens = Counter(
+            "serving_completion_tokens_total", "tokens generated",
+            registry=self.registry)
+        self.m_latency = Histogram(
+            "serving_request_seconds", "one-shot completion latency",
+            registry=self.registry)
+        self.m_streams = Gauge(
+            "serving_streams_active", "open SSE streams",
+            registry=self.registry)
 
     def _mesh_ctx(self):
         return (jax.set_mesh(self.mesh) if self.mesh is not None
@@ -167,6 +190,7 @@ class GenerationService:
     def complete(self, body: dict) -> dict:
         toks, s, n, n_run, sampling, key = self._parse(body)
         eos_id = sampling["eos_id"]
+        t0 = time.perf_counter()
         with self._lock, self._mesh_ctx():
             out = generate.generate(
                 self.cfg, self.params, toks, n_run, key=key, **sampling
@@ -178,12 +202,15 @@ class GenerationService:
                 row[: row.index(eos_id) + 1] if eos_id in row else row
                 for row in completion
             ]
+        n_tokens = sum(len(r) for r in completion)
+        self.m_latency.observe(time.perf_counter() - t0)
+        self.m_tokens.inc(n_tokens)
         return {
             "model": self.name,
             "completion_ids": completion,
             "usage": {
                 "prompt_tokens": int(toks.shape[0]) * s,
-                "completion_tokens": sum(len(r) for r in completion),
+                "completion_tokens": n_tokens,
             },
         }
 
@@ -208,12 +235,17 @@ class GenerationService:
     def _stream_iter(self, toks, n, n_run, sampling, key):
         if not self._streams.acquire(blocking=False):
             raise TooBusy("too many concurrent streams; retry")
+        self.m_streams.inc()
         try:
             yield None  # primed sentinel (consumed by stream_events)
-            yield from self._stream_chunks(toks, n, n_run, sampling, key)
+            for chunk in self._stream_chunks(toks, n, n_run, sampling,
+                                             key):
+                self.m_tokens.inc(sum(len(r) for r in chunk))
+                yield chunk
         finally:
             # runs on exhaustion AND on generator close (client gone)
             self._streams.release()
+            self.m_streams.inc(-1)
 
     def _stream_chunks(self, toks, n, n_run, sampling, key):
         # the lock wraps each DECODE, never a client write: a slow SSE
@@ -280,6 +312,14 @@ def make_server(service: GenerationService, host: str = "127.0.0.1",
                 self._reply(200, {"ok": True})
             elif self.path == "/v1/models":
                 self._reply(200, {"data": [service.info()]})
+            elif self.path == "/metrics":
+                data = service.registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
             else:
                 self._reply(404, {"error": "not found"})
 
@@ -287,6 +327,7 @@ def make_server(service: GenerationService, host: str = "127.0.0.1",
             if self.path != "/v1/completions":
                 self._reply(404, {"error": "not found"})
                 return
+            mode = "oneshot"  # until the stream flag parses
             try:
                 try:
                     length = int(self.headers.get("Content-Length", 0))
@@ -299,19 +340,30 @@ def make_server(service: GenerationService, host: str = "127.0.0.1",
                 if not isinstance(stream, bool):
                     # strict like every other field: "false" is not False
                     raise BadRequest("stream must be a boolean")
+                mode = "stream" if stream else "oneshot"
                 if stream:
                     # validation happens BEFORE the 200 goes out —
                     # stream_events raises BadRequest eagerly
                     self._stream(service.stream_events(body))
+                    service.m_requests.labels(mode, 200).inc()
                 else:
-                    self._reply(200, service.complete(body))
+                    out = service.complete(body)
+                    self._reply(200, out)
+                    # count only after the reply went out: a write that
+                    # fails must not record a phantom 200 next to the
+                    # 500 the except path records
+                    service.m_requests.labels(mode, 200).inc()
             except BadRequest as e:
+                service.m_requests.labels(mode, 400).inc()
                 self._reply(400, {"error": str(e)})
             except TooBusy as e:
+                service.m_requests.labels(mode, 429).inc()
                 self._reply(429, {"error": str(e)})
             except json.JSONDecodeError:
+                service.m_requests.labels(mode, 400).inc()
                 self._reply(400, {"error": "invalid JSON"})
             except Exception as e:  # surface, don't kill the thread
+                service.m_requests.labels(mode, 500).inc()
                 self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
         def _stream(self, events):

@@ -186,6 +186,23 @@ def test_streaming_validation_still_400(server):
     assert code == 400 and "boolean" in out["error"]
 
 
+def test_metrics_endpoint(server):
+    """Prometheus surface, same stack as the control plane: request
+    counters by mode/code, token counter, latency histogram."""
+    base, _ = server
+    _req(base, "/v1/completions", {"prompt_ids": [[1, 2]],
+                                   "max_new_tokens": 4})
+    _req(base, "/v1/completions", {"prompt_ids": []})  # a 400
+    r = urllib.request.urlopen(base + "/metrics", timeout=10)
+    assert r.headers["Content-Type"].startswith("text/plain")
+    text = r.read().decode()
+    assert 'serving_requests_total{mode="oneshot",code="200"}' in text
+    assert 'serving_requests_total{mode="oneshot",code="400"}' in text
+    assert "serving_completion_tokens_total" in text
+    assert "serving_request_seconds_bucket" in text
+    assert "serving_streams_active" in text
+
+
 def test_sharded_service_matches_single_device():
     """Serving a tp×fsdp-sharded model returns the same completions as
     the single-device service — the models-too-big-for-one-chip path."""
