@@ -53,17 +53,6 @@ class KVCache(NamedTuple):
     length: jax.Array  # [] int32 — filled positions (same for the batch)
 
 
-def _rope_at(x, cos, sin, pos):
-    """apply_rope for one dynamic position: x [b, 1, h, d]; pos scalar.
-    Delegates to ops.rotary.apply_rope on 1-row table slices so any
-    convention change there propagates to decode."""
-    return apply_rope(
-        x,
-        jax.lax.dynamic_slice_in_dim(cos, pos, 1),
-        jax.lax.dynamic_slice_in_dim(sin, pos, 1),
-    )
-
-
 def prefill(cfg: llama.LlamaConfig, params, tokens, max_len: int):
     """Run the prompt through the model once; returns (cache, last_logits).
 
@@ -104,35 +93,40 @@ def prefill(cfg: llama.LlamaConfig, params, tokens, max_len: int):
     return cache, logits
 
 
-def _decode_layer(cfg, x, lp, ck, cv, pos, cos, sin):
-    """One layer, one position: x [b, 1, d]; ck/cv [b, max_len, kvh, hd].
+def _extend_layer(cfg, x, lp, ck, cv, pos0, cos_w, sin_w):
+    """One layer over an m-token window: x [b, m, d] at positions
+    pos0..pos0+m-1; ck/cv [b, max_len, kvh, hd]. Causal within the
+    window, full visibility of the cache. m=1 is the decode hot path;
+    m>1 is chunked prefill / speculative verification.
     Returns (x, new_ck, new_cv)."""
-    b = x.shape[0]
+    b, m, _ = x.shape
     cdt = jnp.dtype(cfg.dtype)
     max_len = ck.shape[1]
 
     h = rms_norm(x, lp["attn_norm"].astype(cdt), cfg.norm_eps)
-    q = (h @ lp["wq"].astype(cdt)).reshape(b, 1, cfg.n_heads, cfg.head_dim)
-    k = (h @ lp["wk"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads,
+    q = (h @ lp["wq"].astype(cdt)).reshape(b, m, cfg.n_heads, cfg.head_dim)
+    k = (h @ lp["wk"].astype(cdt)).reshape(b, m, cfg.n_kv_heads,
                                            cfg.head_dim)
-    v = (h @ lp["wv"].astype(cdt)).reshape(b, 1, cfg.n_kv_heads,
+    v = (h @ lp["wv"].astype(cdt)).reshape(b, m, cfg.n_kv_heads,
                                            cfg.head_dim)
-    q = _rope_at(q, cos, sin, pos)
-    k = _rope_at(k, cos, sin, pos)
-    ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos, axis=1)
-    cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos, axis=1)
+    q = apply_rope(q, cos_w, sin_w)
+    k = apply_rope(k, cos_w, sin_w)
+    ck = jax.lax.dynamic_update_slice_in_dim(ck, k, pos0, axis=1)
+    cv = jax.lax.dynamic_update_slice_in_dim(cv, v, pos0, axis=1)
 
     g = cfg.n_heads // cfg.n_kv_heads
-    qg = q[:, 0].reshape(b, cfg.n_kv_heads, g, cfg.head_dim)
+    qg = q.reshape(b, m, cfg.n_kv_heads, g, cfg.head_dim)
     scores = jnp.einsum(
-        "bkgd,bskd->bkgs", qg.astype(cdt), ck,
+        "bmkgd,bskd->bkgms", qg.astype(cdt), ck,
         preferred_element_type=jnp.float32,
-    ) * (cfg.head_dim ** -0.5)                    # [b, kvh, g, max_len]
-    mask = jnp.arange(max_len) <= pos
-    scores = jnp.where(mask[None, None, None, :], scores, -2.0e38)
+    ) * (cfg.head_dim ** -0.5)              # [b, kvh, g, m, max_len]
+    cols = jnp.arange(max_len)
+    rows = pos0 + jnp.arange(m)
+    mask = cols[None, :] <= rows[:, None]   # [m, max_len]
+    scores = jnp.where(mask[None, None, None], scores, -2.0e38)
     probs = jax.nn.softmax(scores, axis=-1).astype(cdt)
-    attn = jnp.einsum("bkgs,bskd->bkgd", probs, cv)   # [b, kvh, g, hd]
-    attn = attn.reshape(b, 1, cfg.q_dim)
+    attn = jnp.einsum("bkgms,bskd->bmkgd", probs, cv)  # [b, m, kvh, g, hd]
+    attn = attn.reshape(b, m, cfg.q_dim)
     x = x + attn @ lp["wo"].astype(cdt)
 
     h = rms_norm(x, lp["mlp_norm"].astype(cdt), cfg.norm_eps)
@@ -146,25 +140,42 @@ def _decode_layer(cfg, x, lp, ck, cv, pos, cos, sin):
     return x, ck, cv
 
 
-def _decode_step(cfg, params, cache: KVCache, token, cos, sin):
-    """token [b] int32 at position cache.length → (cache', logits [b,V])."""
+def extend_cache(cfg, params, cache: KVCache, tokens, cos, sin):
+    """Continue the sequence with an m-token window: tokens [b, m] at
+    positions cache.length.. → (cache', logits [b, m, V]).
+
+    The chunked-prefill / speculative-verification primitive: one
+    forward scores every window position against cache + window prefix
+    (causal) and appends the window's K/V. ``cos``/``sin`` are the
+    full-length rope tables."""
     cdt = jnp.dtype(cfg.dtype)
-    pos = cache.length
-    x = jnp.take(params["tok_embed"], token[:, None], axis=0,
+    b, m = tokens.shape
+    pos0 = cache.length
+    x = jnp.take(params["tok_embed"], tokens, axis=0,
                  mode="clip").astype(cdt)
+    cos_w = jax.lax.dynamic_slice_in_dim(cos, pos0, m)
+    sin_w = jax.lax.dynamic_slice_in_dim(sin, pos0, m)
 
     def body(x, layer):
         lp, ck, cv = layer
-        x, ck, cv = _decode_layer(cfg, x, lp, ck, cv, pos, cos, sin)
+        x, ck, cv = _extend_layer(cfg, x, lp, ck, cv, pos0, cos_w, sin_w)
         return x, (ck, cv)
 
     x, (k, v) = jax.lax.scan(body, x, (params["layers"], cache.k, cache.v))
     x = rms_norm(x, params["final_norm"].astype(cdt), cfg.norm_eps)
     logits = jnp.einsum(
-        "bd,dv->bv", x[:, 0], params["lm_head"].astype(cdt),
+        "bmd,dv->bmv", x, params["lm_head"].astype(cdt),
         preferred_element_type=jnp.float32,
     )
-    return KVCache(k=k, v=v, length=pos + 1), logits
+    return KVCache(k=k, v=v, length=pos0 + m), logits
+
+
+def _decode_step(cfg, params, cache: KVCache, token, cos, sin):
+    """token [b] int32 at position cache.length → (cache', logits [b,V]).
+    The m=1 window of ``extend_cache``."""
+    cache, logits = extend_cache(cfg, params, cache, token[:, None],
+                                 cos, sin)
+    return cache, logits[:, 0]
 
 
 def _sample(logits, key, temperature, top_k: int, top_p, *,

@@ -82,9 +82,17 @@ class GenerationService:
 
     def __init__(self, cfg: llama.LlamaConfig, params,
                  max_new_cap: int = 512, max_batch: int = 8,
-                 max_streams: int = 4, name: str = "llama", mesh=None):
+                 max_streams: int = 4, name: str = "llama", mesh=None,
+                 draft: tuple | None = None, gamma: int = 4):
         self.cfg = cfg
         self.params = params
+        # (draft_cfg, draft_params): single-prompt one-shot requests
+        # decode speculatively — same output distribution, fewer target
+        # forwards (models/speculative.py)
+        if draft is not None and draft[0].vocab_size != cfg.vocab_size:
+            raise ValueError("draft vocab must match the target's")
+        self.draft = draft
+        self.gamma = gamma
         self.max_new_cap = max_new_cap
         self.max_batch = max_batch
         self.name = name
@@ -191,10 +199,28 @@ class GenerationService:
         toks, s, n, n_run, sampling, key = self._parse(body)
         eos_id = sampling["eos_id"]
         t0 = time.perf_counter()
-        with self._lock, self._mesh_ctx():
-            out = generate.generate(
-                self.cfg, self.params, toks, n_run, key=key, **sampling
+        spec_stats = None
+        use_spec = (self.draft is not None and toks.shape[0] == 1
+                    and not sampling["top_k"] and not sampling["top_p"])
+        if use_spec:
+            from service_account_auth_improvements_tpu.models import (
+                speculative,
             )
+
+            dcfg, dparams = self.draft
+            with self._lock, self._mesh_ctx():
+                out, spec_stats = speculative.spec_generate(
+                    self.cfg, self.params, dcfg, dparams, toks, n_run,
+                    gamma=self.gamma, key=key,
+                    temperature=sampling["temperature"],
+                    eos_id=eos_id,
+                )
+        else:
+            with self._lock, self._mesh_ctx():
+                out = generate.generate(
+                    self.cfg, self.params, toks, n_run, key=key,
+                    **sampling
+                )
         completion = [[int(t) for t in row[s:s + n]] for row in out]
         if eos_id is not None:
             # eos-padded rows truncate at (and include) the first eos
@@ -212,6 +238,7 @@ class GenerationService:
                 "prompt_tokens": int(toks.shape[0]) * s,
                 "completion_tokens": n_tokens,
             },
+            **({"speculative": spec_stats} if spec_stats else {}),
         }
 
     STREAM_CHUNK = 16
@@ -420,11 +447,22 @@ def main(argv=None) -> int:
                          "tp mesh (models too big for one chip)")
     ap.add_argument("--fsdp", type=int, default=1,
                     help="fsdp ways composed with --tp")
+    ap.add_argument("--draft-preset",
+                    help="enable speculative decoding with this draft "
+                         "model (same vocab) for single-prompt requests")
+    ap.add_argument("--draft-checkpoint-dir",
+                    help="orbax checkpoint for the draft model (random "
+                         "init without it — demo only: a random draft "
+                         "accepts ~nothing and SLOWS serving down)")
+    ap.add_argument("--gamma", type=int, default=4,
+                    help="draft tokens proposed per verify round")
     args = ap.parse_args(argv)
     if args.tp < 1 or args.fsdp < 1:
         # MeshConfig's -1 "absorb the rest" wildcard and 0-device meshes
         # must not leak through a serving flag typo
         ap.error("--tp and --fsdp must be >= 1")
+    if args.gamma < 1:
+        ap.error("--gamma must be >= 1")
 
     import dataclasses
 
@@ -464,8 +502,43 @@ def main(argv=None) -> int:
 
         params = quantize.quantize_params(params)
 
+    draft = None
+    if args.draft_preset:
+        dcfg = dataclasses.replace(
+            llama.PRESETS[args.draft_preset], param_dtype="bfloat16",
+            **({"iota_embed": True} if args.tp > 1 else {}),
+        )
+        # same loading/placement/quantization treatment as the target:
+        # an off-mesh or random draft defeats the latency win it exists
+        # for
+        if args.draft_checkpoint_dir:
+            from service_account_auth_improvements_tpu.train import (
+                checkpoint,
+            )
+
+            dparams = checkpoint.restore_params(
+                args.draft_checkpoint_dir, mesh, dcfg
+            )
+        else:
+            print("WARNING: random-init draft (no --draft-checkpoint-"
+                  "dir) — demo only, acceptance will be ~0")
+            dparams = llama.init(dcfg, jax.random.key(1))
+            if serve_mesh is not None:
+                from service_account_auth_improvements_tpu.parallel.sharding import (  # noqa: E501
+                    tree_logical_sharding,
+                )
+
+                dparams = jax.device_put(
+                    dparams,
+                    tree_logical_sharding(mesh, llama.logical_axes(dcfg)),
+                )
+        if args.int8:
+            dparams = quantize.quantize_params(dparams)
+        draft = (dcfg, dparams)
+
     service = GenerationService(cfg, params, max_new_cap=args.max_new_cap,
-                                name=args.preset, mesh=serve_mesh)
+                                name=args.preset, mesh=serve_mesh,
+                                draft=draft, gamma=args.gamma)
     httpd = make_server(service, args.host, args.port)
     print(f"serving {args.preset} on {httpd.server_address}")
     try:

@@ -1,0 +1,186 @@
+"""Speculative decoding: a small draft model proposes, the target
+verifies — γ tokens per target forward instead of one.
+
+TPU-shaped: every round is ONE jitted program of static shape — the
+draft runs γ+1 single-token decode steps (its own KV cache), the target
+scores the whole proposal window with ONE ``generate.extend_cache``
+forward (the m-token window primitive), and acceptance/correction is
+computed on-device. Only the per-round host sync (how many tokens were
+emitted) is dynamic — the same sync cadence the streaming API already
+has. Both caches roll back by bookkeeping alone: stale entries past
+``length`` are masked by position and overwritten by later writes.
+
+Sampling semantics follow Leviathan et al. / Chen et al. rejection
+sampling, so the output distribution equals the target model's exactly;
+greedy speculative decode is verified token-identical to plain greedy
+decode in tests. Batch 1 (the latency use-case speculation exists for —
+rows accepting different counts would need per-row cache lengths).
+
+The reference has no inference surface at all (SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from service_account_auth_improvements_tpu.models import generate, llama
+from service_account_auth_improvements_tpu.ops.rotary import rope_table
+
+
+def _rope(cfg, max_len):
+    return rope_table(max_len, cfg.head_dim, cfg.rope_theta,
+                      scaling=cfg.rope_scaling())
+
+
+@partial(jax.jit, static_argnames=("cfg_t", "cfg_d", "gamma", "greedy"))
+def _spec_round(cfg_t, cfg_d, params_t, params_d, cache_t, cache_d,
+                token, temperature, key, *, gamma: int, greedy: bool):
+    """One propose-verify round from the last emitted ``token`` [1].
+
+    Returns (cache_t', cache_d', out [gamma+1], n_emit, n_accepted):
+    ``out[:n_emit]`` are the newly emitted tokens (n_emit = accepted
+    prefix + 1 correction/bonus token, so 1..gamma+1).
+    """
+    cos_t, sin_t = _rope(cfg_t, cache_t.k.shape[2])
+    cos_d, sin_d = _rope(cfg_d, cache_d.k.shape[2])
+    L = cache_t.length
+
+    # --- draft: gamma proposals + one cache-only step so the draft
+    # cache holds K/V for every token that might be accepted
+    def draft_step(carry, step_key):
+        cache_d, tok = carry
+        cache_d, logits = generate._decode_step(
+            cfg_d, params_d, cache_d, tok, cos_d, sin_d
+        )
+        logits = logits[0] / jnp.where(greedy, 1.0, temperature)
+        p = jax.nn.softmax(logits)
+        nxt = jnp.where(
+            greedy,
+            jnp.argmax(logits).astype(jnp.int32),
+            jax.random.categorical(step_key, logits).astype(jnp.int32),
+        )
+        return (cache_d, nxt[None]), (nxt, p)
+
+    key, dkey = jax.random.split(key)
+    (cache_d, _), (q, p_d) = jax.lax.scan(
+        draft_step, (cache_d, token), jax.random.split(dkey, gamma + 1)
+    )
+    q, p_d = q[:gamma], p_d[:gamma]        # [gamma], [gamma, V]
+
+    # --- target: score the whole window (x, q_0..q_{gamma-1}) at once
+    window = jnp.concatenate([token, q], axis=0)[None]  # [1, gamma+1]
+    cache_t, logits_t = generate.extend_cache(
+        cfg_t, params_t, cache_t, window, cos_t, sin_t
+    )
+    logits_t = logits_t[0] / jnp.where(greedy, 1.0, temperature)
+    p_t = jax.nn.softmax(logits_t, axis=-1)  # [gamma+1, V]
+
+    # --- accept the longest prefix
+    idx = jnp.arange(gamma)
+    if greedy:
+        accept = q == jnp.argmax(logits_t[:gamma], axis=-1)
+    else:
+        key, ukey = jax.random.split(key)
+        u = jax.random.uniform(ukey, (gamma,))
+        pt_q = p_t[idx, q]
+        pd_q = jnp.maximum(p_d[idx, q], 1e-20)
+        accept = u < jnp.minimum(1.0, pt_q / pd_q)
+    n = jnp.sum(jnp.cumprod(accept.astype(jnp.int32)))   # 0..gamma
+
+    # --- correction token at the rejection point (or bonus at the end)
+    if greedy:
+        corr = jnp.argmax(logits_t, axis=-1).astype(jnp.int32)  # [gamma+1]
+        extra = corr[n]
+    else:
+        resid = jnp.maximum(p_t[:gamma] - p_d, 0.0)      # [gamma, V]
+        mass = resid.sum(axis=-1, keepdims=True)
+        # degenerate residual (p_t <= p_d everywhere) falls back to p_t
+        resid = jnp.where(mass > 1e-9, resid / jnp.maximum(mass, 1e-9),
+                          p_t[:gamma])
+        key, rkey, bkey = jax.random.split(key, 3)
+        r = jax.vmap(
+            lambda pk, pr: jax.random.categorical(pk, jnp.log(pr + 1e-30))
+        )(jax.random.split(rkey, gamma), resid).astype(jnp.int32)
+        bonus = jax.random.categorical(
+            bkey, logits_t[gamma]).astype(jnp.int32)
+        extra = jnp.where(n < gamma, r[jnp.minimum(n, gamma - 1)], bonus)
+
+    out = jnp.where(jnp.arange(gamma + 1) < n,
+                    jnp.concatenate([q, jnp.zeros((1,), jnp.int32)]),
+                    extra)
+    n_emit = n + 1
+
+    # roll both caches back to the verified history: L + x + n accepts
+    new_len = L + 1 + n
+    cache_t = cache_t._replace(length=new_len)
+    cache_d = cache_d._replace(length=new_len)
+    return cache_t, cache_d, out, n_emit, n
+
+
+def spec_generate(cfg_t: llama.LlamaConfig, params_t,
+                  cfg_d: llama.LlamaConfig, params_d, prompt,
+                  max_new_tokens: int, gamma: int = 4, key=None,
+                  temperature: float = 0.0, eos_id: int | None = None):
+    """Speculative generation: prompt [1, s] → ([1, s + ≤max_new_tokens],
+    stats). Greedy output is token-identical to ``generate.generate`` on
+    the target alone; temperature>0 samples from the exact target
+    distribution via rejection sampling. ``stats`` reports the
+    acceptance rate (the speedup driver: tokens/target-forward ≈
+    1 + rate·gamma).
+    """
+    assert prompt.shape[0] == 1, "speculative decoding is batch-1"
+    assert cfg_t.vocab_size == cfg_d.vocab_size, "vocabularies must match"
+    cfg_t = generate._inference_cfg(cfg_t)
+    cfg_d = generate._inference_cfg(cfg_d)
+    if key is None:
+        key = jax.random.key(0)
+    greedy = temperature == 0.0
+    s = prompt.shape[1]
+    # +gamma+1 slack: the final round's window may write past the budget
+    max_len = s + max_new_tokens + gamma + 1
+
+    cache_t, logits = generate._prefill_jit(cfg_t, params_t, prompt,
+                                            max_len)
+    key, fkey = jax.random.split(key)
+    first = generate._sample_jit(
+        logits, fkey, jnp.float32(1.0 if greedy else temperature),
+        jnp.float32(0.0), top_k=0, greedy=greedy, use_top_p=False,
+    )
+    cache_d, _ = generate._prefill_jit(cfg_d, params_d, prompt, max_len)
+
+    emitted = [int(first[0])]
+    proposed = accepted = 0
+    token = first
+    t_scalar = jnp.float32(1.0 if greedy else temperature)
+    while len(emitted) < max_new_tokens and (
+            eos_id is None or emitted[-1] != eos_id):
+        key, rkey = jax.random.split(key)
+        cache_t, cache_d, out, n_emit, n_acc = _spec_round(
+            cfg_t, cfg_d, params_t, params_d, cache_t, cache_d, token,
+            t_scalar, rkey, gamma=gamma, greedy=greedy,
+        )
+        n_emit = int(n_emit)
+        proposed += gamma
+        accepted += int(n_acc)
+        new = [int(t) for t in out[:n_emit]]
+        if eos_id is not None and eos_id in new:
+            new = new[: new.index(eos_id) + 1]
+        emitted.extend(new)
+        token = jnp.asarray([emitted[-1]], jnp.int32)
+        if eos_id is not None and emitted[-1] == eos_id:
+            break
+
+    emitted = emitted[:max_new_tokens]
+    toks = jnp.concatenate(
+        [prompt, jnp.asarray(emitted, jnp.int32)[None]], axis=1
+    )
+    stats = {
+        "proposed": proposed,
+        "accepted": accepted,
+        "acceptance_rate": round(accepted / proposed, 4) if proposed
+        else 0.0,
+    }
+    return toks, stats

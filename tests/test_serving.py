@@ -234,6 +234,30 @@ def test_sharded_service_matches_single_device():
     assert rows[0] == want["completion_ids"][0]
 
 
+def test_speculative_service_matches_plain():
+    """With a draft model wired in, single-prompt greedy completions are
+    token-identical to the plain service (the speculative guarantee) and
+    the response reports acceptance stats."""
+    import dataclasses as dc
+
+    params = llama.init(CFG, jax.random.key(0))
+    dcfg = dc.replace(CFG, n_layers=1, dim=32, n_heads=2, n_kv_heads=2,
+                      head_dim=16, mlp_dim=64)
+    dparams = llama.init(dcfg, jax.random.key(9))
+    plain = serving.GenerationService(CFG, params)
+    spec = serving.GenerationService(CFG, params, draft=(dcfg, dparams),
+                                     gamma=3)
+    body = {"prompt_ids": [[3, 1, 4, 1]], "max_new_tokens": 8}
+    a = plain.complete(dict(body))
+    b = spec.complete(dict(body))
+    assert a["completion_ids"] == b["completion_ids"]
+    assert 0.0 <= b["speculative"]["acceptance_rate"] <= 1.0
+    # batch>1 falls back to the plain path (no stats)
+    multi = spec.complete({"prompt_ids": [[1, 2], [3, 4]],
+                           "max_new_tokens": 4})
+    assert "speculative" not in multi
+
+
 def test_stream_cap_gives_429_and_releases():
     params = llama.init(CFG, jax.random.key(0))
     svc = serving.GenerationService(CFG, params, max_new_cap=32,

@@ -1,0 +1,85 @@
+"""Speculative decoding (models/speculative.py): greedy equivalence
+with plain target decode, self-draft full acceptance, sampling sanity."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from service_account_auth_improvements_tpu.models import (
+    generate,
+    llama,
+    speculative,
+)
+
+TGT = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
+# a *different* (smaller) draft model with the same vocab
+DRAFT = dataclasses.replace(
+    llama.PRESETS["tiny"], dtype="float32", n_layers=1, dim=32,
+    n_heads=2, n_kv_heads=2, head_dim=16, mlp_dim=64,
+)
+
+
+def _models():
+    return (llama.init(TGT, jax.random.key(0)),
+            llama.init(DRAFT, jax.random.key(99)))
+
+
+def test_greedy_speculative_equals_plain_greedy():
+    """The speculative guarantee: greedy output is token-identical to
+    decoding the target alone, for any draft model."""
+    pt, pd = _models()
+    prompt = jax.random.randint(jax.random.key(1), (1, 6), 0,
+                                TGT.vocab_size)
+    want = np.asarray(generate.generate(TGT, pt, prompt, 12))
+    got, stats = speculative.spec_generate(TGT, pt, DRAFT, pd, prompt,
+                                           12, gamma=3)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert 0.0 <= stats["acceptance_rate"] <= 1.0
+    assert stats["proposed"] > 0
+
+
+def test_self_draft_accepts_everything():
+    """Drafting with the target itself must accept every proposal
+    (greedy): the acceptance machinery, caches, and rope positions all
+    agree between the two code paths."""
+    pt, _ = _models()
+    prompt = jax.random.randint(jax.random.key(2), (1, 5), 0,
+                                TGT.vocab_size)
+    got, stats = speculative.spec_generate(TGT, pt, TGT, pt, prompt,
+                                           12, gamma=4)
+    want = np.asarray(generate.generate(TGT, pt, prompt, 12))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["acceptance_rate"] == 1.0
+
+
+def test_sampled_speculative_reproducible_and_valid():
+    pt, pd = _models()
+    prompt = jax.random.randint(jax.random.key(3), (1, 5), 0,
+                                TGT.vocab_size)
+    a, sa = speculative.spec_generate(TGT, pt, DRAFT, pd, prompt, 10,
+                                      gamma=3, key=jax.random.key(7),
+                                      temperature=0.8)
+    b, sb = speculative.spec_generate(TGT, pt, DRAFT, pd, prompt, 10,
+                                      gamma=3, key=jax.random.key(7),
+                                      temperature=0.8)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert sa == sb
+    assert a.shape == (1, 15)
+    assert 0 <= int(a.min()) and int(a.max()) < TGT.vocab_size
+
+
+def test_eos_stops_early():
+    pt, pd = _models()
+    prompt = jax.random.randint(jax.random.key(4), (1, 5), 0,
+                                TGT.vocab_size)
+    free = np.asarray(generate.generate(TGT, pt, prompt, 12))[0, 5:]
+    eos = int(free[2])  # third generated token
+    got, _ = speculative.spec_generate(TGT, pt, DRAFT, pd, prompt, 12,
+                                       gamma=3, eos_id=eos)
+    out = np.asarray(got)[0, 5:]
+    # matches plain greedy up to and including the first eos, then ends
+    j = np.flatnonzero(free == eos)[0]
+    np.testing.assert_array_equal(out[: j + 1], free[: j + 1])
+    assert out.shape[0] == j + 1
