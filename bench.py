@@ -34,7 +34,7 @@ import time
 
 
 def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
-                grad_accum: int = 1):
+                grad_accum: int = 1, mu_dtype=None):
     """One measured config → (tokens/sec, mfu, step_time)."""
     import jax
     import jax.numpy as jnp
@@ -59,9 +59,7 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
     mesh = make_mesh(
         MeshConfig(dp=1, fsdp=1, tp=1, sp=1, ep=1), jax.devices()[:1]
     )
-    opt = make_optimizer(
-        mu_dtype=os.environ.get("SATPU_BENCH_MU_DTYPE") or None
-    )
+    opt = make_optimizer(mu_dtype=mu_dtype)
     state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
     step = make_train_step(cfg, optimizer=opt, mesh=mesh,
@@ -94,7 +92,8 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
     return tok_per_sec, mfu, dt
 
 
-def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1):
+def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1,
+               mu_dtype=None):
     """Where does the step time go? Times fwd-only, fwd+bwd, and the full
     step (loss+grads+adamw) at the bench shape so the optimizer and remat
     shares are visible round to round (VERDICT r4 #2: attack the gap with
@@ -124,9 +123,7 @@ def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1):
     )
     # same optimizer as _run_config: the breakdown must describe the
     # configuration the headline number measured
-    opt = make_optimizer(
-        mu_dtype=os.environ.get("SATPU_BENCH_MU_DTYPE") or None
-    )
+    opt = make_optimizer(mu_dtype=mu_dtype)
     state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
     tokens = jax.random.randint(
@@ -219,6 +216,25 @@ def _decode_row(dcfg, batch_d=8, prompt_len=128, new_tokens=128):
     }
 
 
+def _best_sweep_point(preset: str):
+    """The measured-best config from a committed SWEEP.json (written by
+    tools/sweep.py on live hardware), or None. Lets the headline bench
+    adopt the sweep winner automatically — the driver's end-of-round run
+    then measures the best-known configuration, not a conservative
+    default — while env knobs still override per key."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "SWEEP.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if data.get("preset") != preset:
+        return None
+    ok = [r for r in data.get("results", []) if "mfu" in r]
+    return max(ok, key=lambda r: r["mfu"]) if ok else None
+
+
 def _child_main() -> None:
     if os.environ.get("SATPU_BENCH_CPU"):
         import jax
@@ -234,24 +250,40 @@ def _child_main() -> None:
         "SATPU_BENCH_PRESET", "bench_800m" if on_accel else "tiny"
     )
     cfg = llama.PRESETS[preset]
-    # sweep knobs: remat policy, CE chunk, and master-param dtype without
-    # editing presets (the perf search space of VERDICT r4 #2)
-    if os.environ.get("SATPU_BENCH_REMAT_POLICY"):
-        cfg = dataclasses.replace(
-            cfg, remat_policy=os.environ["SATPU_BENCH_REMAT_POLICY"]
-        )
-    if os.environ.get("SATPU_BENCH_LOSS_CHUNK"):
-        cfg = dataclasses.replace(
-            cfg, loss_chunk=int(os.environ["SATPU_BENCH_LOSS_CHUNK"])
-        )
-    if os.environ.get("SATPU_BENCH_PARAM_DTYPE"):
-        cfg = dataclasses.replace(
-            cfg, param_dtype=os.environ["SATPU_BENCH_PARAM_DTYPE"]
-        )
-    batch = int(os.environ.get("SATPU_BENCH_BATCH", "8" if on_accel else "2"))
+    # Headline knob resolution: env > committed sweep winner > default.
+    # Resolved into VALUES (never back into env), so the matrix rows
+    # below and any tools/sweep.py run (SATPU_BENCH_SWEEPING=1 disables
+    # adoption entirely) stay on their own stable configurations.
+    best = (None if os.environ.get("SATPU_BENCH_SWEEPING")
+            else _best_sweep_point(preset) if on_accel else None)
+    adopted = []
+
+    def knob(env, key, default):
+        v = os.environ.get(env)
+        if v:
+            return v
+        # .get: tolerate winner rows from older sweep formats
+        if best is not None and best.get(key) is not None:
+            adopted.append(key)
+            return best[key]
+        return default
+
+    default_batch = 8 if on_accel else 2
+    cfg = dataclasses.replace(
+        cfg,
+        remat_policy=str(knob("SATPU_BENCH_REMAT_POLICY", "remat",
+                              cfg.remat_policy)),
+        loss_chunk=int(knob("SATPU_BENCH_LOSS_CHUNK", "loss_chunk",
+                            cfg.loss_chunk)),
+        param_dtype=str(knob("SATPU_BENCH_PARAM_DTYPE", "param_dtype",
+                             cfg.param_dtype)),
+    )
+    mu_dtype = str(knob("SATPU_BENCH_MU_DTYPE", "mu_dtype", "float32"))
+    mu_dtype = None if mu_dtype == "float32" else mu_dtype
+    batch = int(knob("SATPU_BENCH_BATCH", "batch", default_batch))
+    grad_accum = int(knob("SATPU_BENCH_GRAD_ACCUM", "grad_accum", 1))
     seq = int(os.environ.get("SATPU_BENCH_SEQ", "2048" if on_accel else "128"))
     iters = int(os.environ.get("SATPU_BENCH_ITERS", "5"))
-    grad_accum = int(os.environ.get("SATPU_BENCH_GRAD_ACCUM", "1"))
 
     profile_dir = os.environ.get("SATPU_BENCH_PROFILE")
     if profile_dir:
@@ -260,9 +292,11 @@ def _child_main() -> None:
         # breakdown numbers
         with jax.profiler.trace(profile_dir):
             tok_per_sec, mfu, dt = _run_config(
-                cfg, batch, seq, min(iters, 3), grad_accum=grad_accum)
+                cfg, batch, seq, min(iters, 3), grad_accum=grad_accum,
+                mu_dtype=mu_dtype)
     tok_per_sec, mfu, dt = _run_config(cfg, batch, seq, iters,
-                                       grad_accum=grad_accum)
+                                       grad_accum=grad_accum,
+                                       mu_dtype=mu_dtype)
 
     headline = {
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -277,6 +311,14 @@ def _child_main() -> None:
         "backend": jax.default_backend(),
         "device": getattr(jax.devices()[0], "device_kind", "?"),
         **({"grad_accum": grad_accum} if grad_accum > 1 else {}),
+        # the RESOLVED knobs the run actually used; sweep_adopted only
+        # when at least one knob really came from the sweep winner
+        # (env overrides can displace all of them)
+        **({"knobs": {
+            "remat": cfg.remat_policy, "loss_chunk": cfg.loss_chunk,
+            "mu_dtype": mu_dtype or "float32",
+            "param_dtype": cfg.param_dtype,
+        }, "sweep_adopted": sorted(set(adopted))} if adopted else {}),
     }
     # Emit the headline as soon as it exists (flushed): if the flaky TPU
     # runtime wedges during the matrix/breakdown extras, the parent
@@ -288,7 +330,8 @@ def _child_main() -> None:
     breakdown = None
     if os.environ.get("SATPU_BENCH_BREAKDOWN"):
         try:
-            breakdown = _breakdown(cfg, batch, seq, grad_accum)
+            breakdown = _breakdown(cfg, batch, seq, grad_accum,
+                                   mu_dtype=mu_dtype)
         except Exception as e:  # pragma: no cover - diagnostics must not
             breakdown = {"error": str(e)[:200]}  # sink the headline number
 
@@ -329,12 +372,17 @@ def _child_main() -> None:
              dataclasses.replace(llama.PRESETS["bench_800m"],
                                  remat_policy="dots_saveable")),
         ]:
-            row_batch, row_seq = batch, seq
+            # matrix rows are the round-to-round regression record:
+            # they honor an explicit env override (an operator dodging
+            # an OOM) but never the sweep winner
+            env_batch = int(os.environ.get("SATPU_BENCH_BATCH")
+                            or default_batch)
+            row_batch, row_seq = env_batch, seq
             row_accum = 1
             if name == "bench_400m_long":
-                row_batch, row_seq = max(1, batch // 4), seq * 4
+                row_batch, row_seq = max(1, env_batch // 4), seq * 4
             elif name == "bench_800m_ds_ga2":
-                row_batch, row_accum = batch * 2, 2
+                row_batch, row_accum = env_batch * 2, 2
             try:
                 m_tok, m_mfu, m_dt = _run_config(
                     mcfg, row_batch, row_seq, max(3, iters - 2),
@@ -453,8 +501,11 @@ def main() -> int:
     for attempt in range(attempts):
         if attempt > 0:
             # lean retry: a runtime that wedged once is likelier to finish
-            # the headline config alone than the full matrix sweep
+            # the headline config alone than the full matrix sweep — and
+            # on the conservative default config, in case the sweep
+            # winner itself is what failed (OOM after a code change)
             env["SATPU_BENCH_MATRIX"] = "0"
+            env["SATPU_BENCH_SWEEPING"] = "1"
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

@@ -57,6 +57,9 @@ def run_point(preset, rp, lc, batch, mu, pd, ga, timeout):
         SATPU_BENCH_CHILD="1",
         SATPU_BENCH_PRESET=preset,
         SATPU_BENCH_MATRIX="0",
+        # never let a previously committed SWEEP.json winner leak into
+        # the grid points (float32 rows leave the dtype envs unset)
+        SATPU_BENCH_SWEEPING="1",
         SATPU_BENCH_REMAT_POLICY=rp,
         SATPU_BENCH_LOSS_CHUNK=str(lc),
         SATPU_BENCH_BATCH=str(batch),
