@@ -377,6 +377,7 @@ def _child_main() -> None:
             # an OOM) but never the sweep winner
             env_batch = int(os.environ.get("SATPU_BENCH_BATCH")
                             or default_batch)
+            env_mu = os.environ.get("SATPU_BENCH_MU_DTYPE") or None
             row_batch, row_seq = env_batch, seq
             row_accum = 1
             if name == "bench_400m_long":
@@ -386,7 +387,7 @@ def _child_main() -> None:
             try:
                 m_tok, m_mfu, m_dt = _run_config(
                     mcfg, row_batch, row_seq, max(3, iters - 2),
-                    grad_accum=row_accum)
+                    grad_accum=row_accum, mu_dtype=env_mu)
                 matrix.append({
                     "preset": name, "attn": mcfg.attn_impl,
                     "batch": row_batch, "seq": row_seq,

@@ -252,6 +252,59 @@ def _generate_jit(cfg: llama.LlamaConfig, params, prompt, temperature,
     return jnp.concatenate([prompt, first[:, None], toks], axis=1)
 
 
+@partial(jax.jit, static_argnames=("cfg", "window"))
+def _prefill_window_jit(cfg, params, cache, tokens, n_real, *, window):
+    """One fixed-size prefill window: tokens [b, window] (tail windows
+    zero-padded), of which the first ``n_real`` are real. K/V beyond
+    ``n_real`` are garbage — masked by the rolled-back length and
+    overwritten by the next window's writes."""
+    cos, sin = rope_table(cache.k.shape[2], cfg.head_dim, cfg.rope_theta,
+                          scaling=cfg.rope_scaling())
+    cache, logits = extend_cache(cfg, params, cache, tokens, cos, sin)
+    cache = cache._replace(length=cache.length - window + n_real)
+    # logits at the last REAL position (the next-token distribution)
+    return cache, logits[jnp.arange(tokens.shape[0]), n_real - 1]
+
+
+def prefill_chunked(cfg: llama.LlamaConfig, params, prompt, max_len: int,
+                    window: int = 512):
+    """``prefill`` in fixed-size windows: (cache, last_logits).
+
+    ONE executable covers any prompt length (the tail window is padded
+    and rolled back), so a server fielding arbitrary prompt lengths
+    stops minting per-length XLA programs — and activation memory is
+    bounded by the window instead of the whole prompt. Costs a host
+    loop of ceil(s/window) device calls; the one-shot ``prefill`` stays
+    the better choice for short, shape-bucketed prompts."""
+    cfg = _inference_cfg(cfg)
+    b, s = prompt.shape
+    assert s <= max_len, (s, max_len)
+    cdt = jnp.dtype(cfg.dtype)
+    # max_len rounded up to whole windows: the padded tail window never
+    # clamps its cache write (a clamped dynamic_update_slice would
+    # silently overwrite earlier positions — max_len >= s is asserted
+    # above), and prompts in the same bucket share one prefill
+    # executable (cache shape is a compile key too)
+    alloc = -(-max_len // window) * window
+    cache = KVCache(
+        k=jnp.zeros((cfg.n_layers, b, alloc, cfg.n_kv_heads,
+                     cfg.head_dim), cdt),
+        v=jnp.zeros((cfg.n_layers, b, alloc, cfg.n_kv_heads,
+                     cfg.head_dim), cdt),
+        length=jnp.asarray(0, jnp.int32),
+    )
+    logits = None
+    for start in range(0, s, window):
+        chunk = prompt[:, start:start + window]
+        n_real = chunk.shape[1]
+        if n_real < window:
+            chunk = jnp.pad(chunk, ((0, 0), (0, window - n_real)))
+        cache, logits = _prefill_window_jit(
+            cfg, params, cache, chunk, jnp.int32(n_real), window=window
+        )
+    return cache, logits
+
+
 class StreamState(NamedTuple):
     """Carry between ``stream_decode`` chunks. ``token`` is the newest
     sampled token (already emitted); ``done`` marks rows past their
@@ -304,7 +357,8 @@ def _sampling_statics(temperature: float, top_k: int, top_p: float):
 def start_stream(cfg: llama.LlamaConfig, params, prompt,
                  max_new_tokens: int, key=None, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
-                 eos_id: int | None = None):
+                 eos_id: int | None = None,
+                 prefill_window: int | None = None):
     """Begin chunked decoding: returns (StreamState, first_token [b]).
 
     Streaming exists for two reasons the one-shot ``generate`` scan
@@ -320,7 +374,16 @@ def start_stream(cfg: llama.LlamaConfig, params, prompt,
         key = jax.random.key(0)
     t, p, k_, greedy, use_top_p = _sampling_statics(temperature, top_k,
                                                     top_p)
-    cache, logits = _prefill_jit(cfg, params, prompt, s + max_new_tokens)
+    if prefill_window:
+        # fixed-window prefill: one executable for ANY prompt length
+        # (and activation memory bounded by the window)
+        cache, logits = prefill_chunked(
+            cfg, params, prompt, s + max_new_tokens,
+            window=prefill_window,
+        )
+    else:
+        cache, logits = _prefill_jit(cfg, params, prompt,
+                                     s + max_new_tokens)
     first_key, key = jax.random.split(key)
     first = _sample_jit(logits, first_key, t, p, top_k=k_, greedy=greedy,
                         use_top_p=use_top_p)

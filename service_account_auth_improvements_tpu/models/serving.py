@@ -83,7 +83,8 @@ class GenerationService:
     def __init__(self, cfg: llama.LlamaConfig, params,
                  max_new_cap: int = 512, max_batch: int = 8,
                  max_streams: int = 4, name: str = "llama", mesh=None,
-                 draft: tuple | None = None, gamma: int = 4):
+                 draft: tuple | None = None, gamma: int = 4,
+                 prefill_window: int | None = None):
         self.cfg = cfg
         self.params = params
         # (draft_cfg, draft_params): single-prompt one-shot requests
@@ -93,6 +94,9 @@ class GenerationService:
             raise ValueError("draft vocab must match the target's")
         self.draft = draft
         self.gamma = gamma
+        # fixed-window prefill for streams: one prefill executable per
+        # cache bucket instead of one per prompt length
+        self.prefill_window = prefill_window
         self.max_new_cap = max_new_cap
         self.max_batch = max_batch
         self.name = name
@@ -280,7 +284,8 @@ class GenerationService:
         eos_id = sampling["eos_id"]
         with self._lock, self._mesh_ctx():
             state, first = generate.start_stream(
-                self.cfg, self.params, toks, n_run, key=key, **sampling
+                self.cfg, self.params, toks, n_run, key=key,
+                prefill_window=self.prefill_window, **sampling
             )
         # rows past their eos emit nothing further — concatenated SSE
         # chunks equal the non-streaming (eos-truncated) completion
@@ -456,6 +461,10 @@ def main(argv=None) -> int:
                          "accepts ~nothing and SLOWS serving down)")
     ap.add_argument("--gamma", type=int, default=4,
                     help="draft tokens proposed per verify round")
+    ap.add_argument("--prefill-window", type=int,
+                    help="fixed-window chunked prefill for streams: one "
+                         "prefill executable per cache bucket instead of "
+                         "one per prompt length")
     args = ap.parse_args(argv)
     if args.tp < 1 or args.fsdp < 1:
         # MeshConfig's -1 "absorb the rest" wildcard and 0-device meshes
@@ -463,6 +472,8 @@ def main(argv=None) -> int:
         ap.error("--tp and --fsdp must be >= 1")
     if args.gamma < 1:
         ap.error("--gamma must be >= 1")
+    if args.prefill_window is not None and args.prefill_window < 1:
+        ap.error("--prefill-window must be >= 1")
 
     import dataclasses
 
@@ -538,7 +549,8 @@ def main(argv=None) -> int:
 
     service = GenerationService(cfg, params, max_new_cap=args.max_new_cap,
                                 name=args.preset, mesh=serve_mesh,
-                                draft=draft, gamma=args.gamma)
+                                draft=draft, gamma=args.gamma,
+                                prefill_window=args.prefill_window)
     httpd = make_server(service, args.host, args.port)
     print(f"serving {args.preset} on {httpd.server_address}")
     try:

@@ -170,6 +170,49 @@ def test_stream_decode_greedy_matches_one_shot():
         generate.stream_decode(CFG, params, state, 2)
 
 
+def test_chunked_prefill_matches_one_shot():
+    """Fixed-window prefill produces the same cache contents and
+    next-token logits as the one-shot prefill, for window sizes that
+    divide the prompt and ones that leave a padded tail."""
+    params = llama.init(CFG, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(20), (2, 13), 0,
+                                CFG.vocab_size)
+    cache_ref, logits_ref = generate.prefill(CFG, params, prompt, 24)
+    for window in (4, 5, 13, 16):
+        cache, logits = generate.prefill_chunked(CFG, params, prompt, 24,
+                                                 window=window)
+        assert int(cache.length) == 13
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(logits_ref), atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(cache.k[:, :, :13]),
+            np.asarray(cache_ref.k[:, :, :13]), atol=2e-5,
+        )
+
+
+def test_stream_with_chunked_prefill_matches_plain():
+    """Greedy streaming with fixed-window prefill equals the one-shot
+    generate — and the prefill executable is shared across prompt
+    lengths (the serving compile-key win)."""
+    params = llama.init(CFG, jax.random.key(0))
+    before = generate._prefill_window_jit._cache_size()
+    # s+6 rounds to the same 16-entry cache bucket for all three
+    for s in (5, 7, 9):
+        prompt = jax.random.randint(jax.random.key(s), (1, s), 0,
+                                    CFG.vocab_size)
+        want = np.asarray(generate.generate(CFG, params, prompt, 6))
+        state, first = generate.start_stream(CFG, params, prompt, 6,
+                                             prefill_window=8)
+        state, toks = generate.stream_decode(CFG, params, state, 5)
+        got = np.concatenate(
+            [np.asarray(prompt), np.asarray(first)[:, None],
+             np.asarray(toks)], axis=1,
+        )
+        np.testing.assert_array_equal(got, want)
+    # all three prompt lengths shared one window executable
+    assert generate._prefill_window_jit._cache_size() == before + 1
+
+
 def test_stream_done_flags_track_eos():
     params = llama.init(CFG, jax.random.key(0))
     prompt = jax.random.randint(jax.random.key(13), (2, 5), 0,

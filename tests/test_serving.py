@@ -234,6 +234,20 @@ def test_sharded_service_matches_single_device():
     assert rows[0] == want["completion_ids"][0]
 
 
+def test_prefill_window_streams_match_plain():
+    """Streams with fixed-window prefill return identical tokens to the
+    default service (greedy), across prompt lengths."""
+    params = llama.init(CFG, jax.random.key(0))
+    plain = serving.GenerationService(CFG, params)
+    windowed = serving.GenerationService(CFG, params, prefill_window=8)
+    for s in (3, 9, 17):
+        body = {"prompt_ids": [list(range(1, s + 1))],
+                "max_new_tokens": 6, "stream": True}
+        a = [c for c in plain.stream_events(dict(body))]
+        b = [c for c in windowed.stream_events(dict(body))]
+        assert a == b, s
+
+
 def test_speculative_service_matches_plain():
     """With a draft model wired in, single-prompt greedy completions are
     token-identical to the plain service (the speculative guarantee) and
