@@ -610,30 +610,43 @@ def _nll(cfg: LlamaConfig, x, lm_head, targets):
     return logz - target_logit
 
 
-def _chunked_nll(cfg: LlamaConfig, x, lm_head, targets):
-    """``_nll`` computed ``cfg.loss_chunk`` positions at a time under
-    ``jax.checkpoint``: the [b, t, vocab] logits never exist — each chunk's
-    [b, c, vocab] block is produced, reduced to [b, c] NLLs, and recomputed
-    in the bwd pass instead of being saved. Same math to the ULP (each
-    position's logsumexp is independent of every other position)."""
-    b, t, d = x.shape
-    c = min(cfg.loss_chunk, t)
+def scan_seq_chunks(fn, c: int, *arrays):
+    """Run ``fn`` over ``c``-position sequence chunks of [b, t, ...]
+    ``arrays`` under ``jax.checkpoint``: per-chunk intermediates (the
+    [b, c, vocab] logits blocks) are produced, reduced, and recomputed
+    in the bwd pass instead of being saved. The tail chunk is padded
+    with each array's own prefix — the padded outputs are sliced off,
+    and real data keeps one-hot contractions well-defined. ``fn`` maps
+    chunk views to a pytree of [b, c] leaves; returns the same pytree
+    with [b, t] leaves. Shared by ``_chunked_nll`` and the distillation
+    loss (train/distill.py) — ONE copy of the pad/remat invariants."""
+    b, t = arrays[0].shape[:2]
     pad = (-t) % c
     if pad:
-        # pad with position 0's data: values are discarded below, and real
-        # token ids keep the one-hot contraction well-defined
-        x = jnp.concatenate([x, x[:, :pad]], axis=1)
-        targets = jnp.concatenate([targets, targets[:, :pad]], axis=1)
+        arrays = tuple(
+            jnp.concatenate([a, a[:, :pad]], axis=1) for a in arrays
+        )
     n = (t + pad) // c
-    xs = x.reshape(b, n, c, d).transpose(1, 0, 2, 3)        # [n, b, c, d]
-    ts = targets.reshape(b, n, c).transpose(1, 0, 2)        # [n, b, c]
-
-    chunk = jax.checkpoint(lambda xc, tc: _nll(cfg, xc, lm_head, tc))
-    _, nll = jax.lax.scan(
-        lambda carry, args: (carry, chunk(*args)), None, (xs, ts)
+    split = tuple(
+        a.reshape(b, n, c, *a.shape[2:]).swapaxes(0, 1) for a in arrays
     )
-    nll = nll.transpose(1, 0, 2).reshape(b, t + pad)
-    return nll[:, :t]
+    chunk = jax.checkpoint(fn)
+    _, out = jax.lax.scan(
+        lambda carry, args: (carry, chunk(*args)), None, split
+    )
+    return jax.tree.map(
+        lambda o: o.swapaxes(0, 1).reshape(b, t + pad)[:, :t], out
+    )
+
+
+def _chunked_nll(cfg: LlamaConfig, x, lm_head, targets):
+    """``_nll`` computed ``cfg.loss_chunk`` positions at a time — the
+    [b, t, vocab] logits never exist (see ``scan_seq_chunks``). Same
+    math to the ULP (each position's logsumexp is independent)."""
+    c = min(cfg.loss_chunk, x.shape[1])
+    return scan_seq_chunks(
+        lambda xc, tc: _nll(cfg, xc, lm_head, tc), c, x, targets
+    )
 
 
 _SAME_AS_MASK = object()

@@ -25,3 +25,7 @@ from service_account_auth_improvements_tpu.train.lora import (  # noqa: F401
     make_lora_train_step,
     merge_lora,
 )
+from service_account_auth_improvements_tpu.train.distill import (  # noqa: F401,E501
+    distill_loss,
+    make_distill_step,
+)

@@ -1,0 +1,147 @@
+"""Knowledge distillation: train a student against a frozen teacher.
+
+The loss mixes soft targets with hard labels (Hinton et al.):
+``alpha · T² · KL(p_T^T ‖ p_S^T) + (1-alpha) · CE(student, labels)`` —
+the T² factor keeps soft-target gradient magnitudes comparable across
+temperatures. The teacher forward runs under ``stop_gradient`` inside
+the same jitted step, so XLA schedules both forwards together and the
+teacher's logits never round-trip through HBM as a separate pass.
+
+This is how the draft models speculative decoding wants
+(models/speculative.py) get made: distill the big target into a small
+student with matching vocab, then serve with
+``--draft-checkpoint-dir``. The reference has no training surface at
+all (SURVEY.md §2b).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    logical_to_mesh,
+    shard_constraint,
+)
+from service_account_auth_improvements_tpu.train.step import (
+    TrainState,
+    make_optimizer,
+)
+
+
+def _distill_chunk(cfg_s, x_s, x_t, head_s, head_t, targets,
+                   temperature: float):
+    """(ce [b, c], kl [b, c]) for one sequence chunk. Everything is a
+    contraction or an axis reduction — never a positional gather — so
+    the vocab axis stays tp-sharded (the ``_nll`` rationale,
+    models/llama.py): logsumexp/sum reduce over it as psums instead of
+    forcing an involuntary full replication."""
+    logits_s = jnp.einsum("bsd,dv->bsv", x_s, head_s,
+                          preferred_element_type=jnp.float32)
+    logits_s = shard_constraint(logits_s, ("batch", "seq", "vocab"))
+    logits_t = jnp.einsum("bsd,dv->bsv", x_t, head_t,
+                          preferred_element_type=jnp.float32)
+    logits_t = shard_constraint(logits_t, ("batch", "seq", "vocab"))
+
+    logz = jax.scipy.special.logsumexp(logits_s, axis=-1)
+    onehot = jax.nn.one_hot(targets, cfg_s.vocab_size,
+                            dtype=logits_s.dtype)
+    ce = logz - jnp.einsum("bsv,bsv->bs", logits_s, onehot)
+
+    lsT = logits_s / temperature
+    lsT = lsT - jax.scipy.special.logsumexp(lsT, axis=-1, keepdims=True)
+    ltT = logits_t / temperature
+    ltT = ltT - jax.scipy.special.logsumexp(ltT, axis=-1, keepdims=True)
+    kl = jnp.sum(jnp.exp(ltT) * (ltT - lsT), axis=-1)
+    return ce, kl
+
+
+def distill_loss(cfg_s: llama.LlamaConfig, cfg_t: llama.LlamaConfig,
+                 student_params, teacher_params, tokens, mask,
+                 temperature: float = 2.0, alpha: float = 0.5):
+    """Mixed soft/hard next-token loss; returns (loss, metrics).
+
+    Mirrors ``next_token_loss``'s contracts: ``mask`` doubles as the
+    backbone validity mask (padding neither routes through MoE experts
+    nor counts in the loss), the student's MoE load-balance aux is
+    included, and with ``cfg_s.loss_chunk`` the vocab projections +
+    soft/hard terms run ``loss_chunk`` positions at a time under
+    ``jax.checkpoint`` — the full [b, s, vocab] f32 tensors never
+    materialize."""
+    if cfg_s.vocab_size != cfg_t.vocab_size:
+        # fail clearly here too — the KL runs over the shared vocab axis
+        raise ValueError("student/teacher vocabularies must match")
+    cdt_s, cdt_t = jnp.dtype(cfg_s.dtype), jnp.dtype(cfg_t.dtype)
+    x_s, aux_s = llama._backbone(cfg_s, student_params, tokens,
+                                 token_mask=mask)
+    x_t, _ = llama._backbone(cfg_t, teacher_params, tokens,
+                             token_mask=mask)
+    x_s = x_s[:, :-1]
+    x_t = jax.lax.stop_gradient(x_t[:, :-1])
+    targets = jnp.clip(tokens[:, 1:], 0, cfg_s.vocab_size - 1)
+    head_s = student_params["lm_head"].astype(cdt_s)
+    head_t = jax.lax.stop_gradient(teacher_params["lm_head"].astype(cdt_t))
+
+    def chunk_fn(a, bb, tc):
+        return _distill_chunk(cfg_s, a, bb, head_s, head_t, tc,
+                              temperature)
+
+    if cfg_s.loss_chunk:
+        ce, kl = llama.scan_seq_chunks(
+            chunk_fn, min(cfg_s.loss_chunk, x_s.shape[1]), x_s, x_t,
+            targets,
+        )
+    else:
+        # unchunked: one whole-sequence pass with residuals saved (no
+        # checkpoint recompute), matching next_token_loss's branch
+        ce, kl = chunk_fn(x_s, x_t, targets)
+
+    w = mask[:, 1:].astype(jnp.float32)
+    denom = jnp.maximum(w.sum(), 1.0)
+    hard = jnp.sum(ce * w) / denom
+    soft = jnp.sum(kl * w) / denom
+    loss = alpha * temperature**2 * soft + (1.0 - alpha) * hard
+    if cfg_s.moe_experts:
+        loss = loss + cfg_s.moe_aux_weight * aux_s
+    return loss, {"loss": loss, "hard_loss": hard, "kl": soft}
+
+
+def make_distill_step(cfg_s: llama.LlamaConfig, cfg_t: llama.LlamaConfig,
+                      optimizer=None, mesh=None, rules=None,
+                      temperature: float = 2.0, alpha: float = 0.5):
+    """Return jitted ``step(state, teacher_params, tokens, mask)`` →
+    ``(state, metrics)``. ``state`` holds the student; the teacher is a
+    plain (sharded) argument that comes back untouched. Vocabularies
+    must match (the KL runs over the shared vocab axis)."""
+    if cfg_s.vocab_size != cfg_t.vocab_size:
+        raise ValueError("student/teacher vocabularies must match")
+    optimizer = optimizer or make_optimizer()
+
+    def loss_fn(student_params, teacher_params, tokens, mask):
+        return distill_loss(cfg_s, cfg_t, student_params, teacher_params,
+                            tokens, mask, temperature, alpha)
+
+    def step_fn(state: TrainState, teacher_params, tokens, mask):
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params, teacher_params, tokens, mask)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        metrics["grad_norm"] = optax.global_norm(grads)
+        return TrainState(state.step + 1, params, opt_state), metrics
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    rules = rules or DEFAULT_RULES
+    batch_sh = NamedSharding(mesh, logical_to_mesh(("batch", None), rules))
+    return jax.jit(
+        step_fn,
+        in_shardings=(None, None, batch_sh, batch_sh),
+        donate_argnums=(0,),
+    )

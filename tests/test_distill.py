@@ -1,0 +1,135 @@
+"""Distillation (train/distill.py): student tracks the teacher, teacher
+stays frozen, loss components behave."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel import (
+    MeshConfig,
+    make_mesh,
+)
+from service_account_auth_improvements_tpu.train import (
+    init_train_state,
+    make_optimizer,
+)
+from service_account_auth_improvements_tpu.train.distill import (
+    distill_loss,
+    make_distill_step,
+)
+from service_account_auth_improvements_tpu.train.step import state_shardings
+
+TEACHER = dataclasses.replace(llama.PRESETS["smoke"], iota_embed=True)
+STUDENT = dataclasses.replace(
+    llama.PRESETS["smoke"], iota_embed=True, n_layers=2, dim=64,
+    n_heads=4, n_kv_heads=2, head_dim=16, mlp_dim=128,
+)
+
+
+def test_identical_models_have_zero_kl():
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
+    params = llama.init(cfg, jax.random.key(0))
+    toks = jax.random.randint(jax.random.key(1), (2, 12), 0,
+                              cfg.vocab_size)
+    _, m = distill_loss(cfg, cfg, params, params, toks,
+                        jnp.ones_like(toks))
+    assert abs(float(m["kl"])) < 1e-5
+    # and the hard term equals the plain next-token loss
+    want = float(llama.next_token_loss(cfg, params, toks,
+                                       jnp.ones_like(toks)))
+    np.testing.assert_allclose(float(m["hard_loss"]), want, rtol=1e-5)
+
+
+def test_distill_step_descends_and_freezes_teacher():
+    """Distilling a copy-task-trained teacher into a smaller student
+    (pure KL) closes the student→teacher gap AND transfers the task
+    (student's hard loss drops too, with no label gradient at all);
+    the teacher comes back bit-identical."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from service_account_auth_improvements_tpu.train import (
+        make_train_step,
+    )
+
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    bsh = NamedSharding(mesh, P(("dp", "fsdp"), None))
+    toks = jax.random.randint(jax.random.key(7), (16, 64), 0,
+                              STUDENT.vocab_size)
+    toks = jax.device_put(toks.at[:, 32:].set(toks[:, :32]), bsh)
+    mask = jax.device_put(jnp.ones((16, 64), jnp.int32), bsh)
+
+    # a teacher that actually knows something: 25 steps on the copy task
+    tstate = init_train_state(TEACHER, jax.random.key(0))
+    tstate = jax.device_put(tstate, state_shardings(mesh, TEACHER, tstate))
+    tstep = make_train_step(TEACHER, mesh=mesh)
+    with jax.set_mesh(mesh):
+        for _ in range(25):
+            tstate, _ = tstep(tstate, toks, mask)
+    teacher = tstate.params
+    teacher_copy = jax.tree.map(np.asarray, teacher)
+
+    opt = make_optimizer(learning_rate=1e-2)
+    state = init_train_state(STUDENT, jax.random.key(1), optimizer=opt)
+    state = jax.device_put(state, state_shardings(mesh, STUDENT, state))
+    step = make_distill_step(STUDENT, TEACHER, optimizer=opt, mesh=mesh,
+                             alpha=1.0)  # soft targets ONLY
+    with jax.set_mesh(mesh):
+        state, m0 = step(state, teacher, toks, mask)
+        kl0, hard0 = float(m0["kl"]), float(m0["hard_loss"])
+        for _ in range(44):
+            state, m = step(state, teacher, toks, mask)
+    assert np.isfinite(float(m["loss"]))
+    assert float(m["kl"]) < kl0 * 0.7, (kl0, float(m["kl"]))
+    # task transfer through soft targets alone
+    assert float(m["hard_loss"]) < hard0 - 0.15, (hard0,
+                                                  float(m["hard_loss"]))
+    for want, got in zip(jax.tree.leaves(teacher_copy),
+                         jax.tree.leaves(jax.tree.map(np.asarray,
+                                                      teacher))):
+        np.testing.assert_array_equal(want, got)
+
+
+def test_chunked_distill_matches_unchunked():
+    """loss_chunk changes memory, not math."""
+    cfg = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
+    teacher = llama.init(cfg, jax.random.key(0))
+    student_cfg = dataclasses.replace(cfg, n_layers=1)
+    student = llama.init(student_cfg, jax.random.key(1))
+    toks = jax.random.randint(jax.random.key(2), (2, 24), 0,
+                              cfg.vocab_size)
+    mask = jnp.ones_like(toks).at[:, 20:].set(0)
+    full, mf = distill_loss(student_cfg, cfg, student, teacher, toks, mask)
+    chunked_cfg = dataclasses.replace(student_cfg, loss_chunk=7)
+    chunked, mc = distill_loss(chunked_cfg, cfg, student, teacher, toks,
+                               mask)
+    np.testing.assert_allclose(float(full), float(chunked), rtol=1e-5)
+    np.testing.assert_allclose(float(mf["kl"]), float(mc["kl"]),
+                               rtol=1e-5)
+
+
+def test_moe_student_includes_aux():
+    """An MoE student's load-balance regularizer is part of the distill
+    loss (it would silently vanish with a bare apply())."""
+    cfg_t = dataclasses.replace(llama.PRESETS["tiny"], dtype="float32")
+    cfg_s = dataclasses.replace(llama.PRESETS["moe_smoke"],
+                                dtype="float32", vocab_size=256, dim=64,
+                                n_layers=2, n_heads=4, n_kv_heads=2,
+                                head_dim=16, mlp_dim=128)
+    teacher = llama.init(cfg_t, jax.random.key(0))
+    student = llama.init(cfg_s, jax.random.key(1))
+    toks = jax.random.randint(jax.random.key(2), (2, 16), 0, 256)
+    mask = jnp.ones_like(toks)
+    loss, m = distill_loss(cfg_s, cfg_t, student, teacher, toks, mask)
+    base = (0.5 * 2.0**2 * float(m["kl"])
+            + 0.5 * float(m["hard_loss"]))
+    assert float(loss) > base + 1e-6  # aux term really added
+
+
+def test_vocab_mismatch_rejected():
+    bad = dataclasses.replace(STUDENT, vocab_size=STUDENT.vocab_size * 2)
+    with pytest.raises(ValueError, match="vocab"):
+        make_distill_step(bad, TEACHER)
