@@ -42,6 +42,7 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_mesh,
+        use_mesh,
     )
     from service_account_auth_improvements_tpu.train import (
         chip_peak_flops,
@@ -69,7 +70,7 @@ def _run_config(cfg, batch: int, seq: int, iters: int, warmup: int = 2,
         jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype="int32"
     )
     mask = jnp.ones_like(tokens)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for _ in range(warmup):
             state, m = step(state, tokens, mask)
         # host fetch, not block_until_ready: the remote-TPU PJRT plugin
@@ -107,6 +108,7 @@ def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1,
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_mesh,
+        use_mesh,
     )
     from service_account_auth_improvements_tpu.models import llama
     from service_account_auth_improvements_tpu.train import (
@@ -143,7 +145,7 @@ def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1,
     micro_mask = mask[:: max(1, grad_accum)]
 
     def timed(fn, *args, iters=3, fetch):
-        with jax.set_mesh(mesh):
+        with use_mesh(mesh):
             out = fn(*args)
             float(fetch(out))  # compile + sync (device->host can't be early)
             t0 = time.perf_counter()
@@ -162,7 +164,7 @@ def _breakdown(cfg, batch: int, seq: int, grad_accum: int = 1,
     # keep reusing the returned state instead
     state = init_train_state(cfg, jax.random.key(0), optimizer=opt)
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m = step(state, tokens, mask)
         float(m["loss"])
         t0 = time.perf_counter()

@@ -188,6 +188,35 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
               "run": "SATPU_BENCH_CPU=1 python bench.py"}],
         )},
     ),
+    # control-plane latency bench: every PR gets cpbench --smoke (pure
+    # stdlib — no jax/flax install needed) and fails on malformed JSON
+    # output; the full run behind BASELINE.md is manual/--full
+    "controlplane_bench.yaml": workflow(
+        "Control Plane Bench Smoke",
+        ["service_account_auth_improvements_tpu/controlplane/**",
+         "service_account_auth_improvements_tpu/webhook/**",
+         "tests/test_cpbench.py"],
+        {"cpbench": job([
+            CHECKOUT, SETUP_PY,
+            {"name": "Run cpbench --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke "
+                    "--out CONTROLPLANE_BENCH.json"},
+            {"name": "Validate bench JSON",
+             "run": "python -c \"import json; d = json.load(open("
+                    "'CONTROLPLANE_BENCH.json')); "
+                    "assert d['schema'] == 'cpbench/v1' and d['ok'], d; "
+                    "s = d['scenarios']; "
+                    "assert set(s) == {'notebook_ready', 'gang_ready', "
+                    "'churn', 'profile_fanout', 'webhook_inject'}; "
+                    "[s[k]['phases_ms']['create_to_ready']['p99'] "
+                    "for k in s]\""},
+            {"name": "Upload bench record",
+             "uses": "actions/upload-artifact@v4",
+             "with": {"name": "controlplane-bench",
+                      "path": "CONTROLPLANE_BENCH.json"}},
+        ])},
+    ),
     "images_multi_arch_test.yaml": workflow(
         "Images Multi-Arch Build Test",
         ["images/**", "native/**",

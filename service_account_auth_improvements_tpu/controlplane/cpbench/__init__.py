@@ -1,0 +1,48 @@
+"""cpbench: control-plane latency & load benchmark subsystem.
+
+BASELINE.md's #1 control-plane target — "Notebook-CR → pod-Ready p50:
+measure and record" — needs a harness before it can have a number. This
+package drives the REAL reconcile stack (engine/manager.py +
+engine/informer.py + controllers/*) against ``kube/fake.py`` as a live
+in-process apiserver, and measures it:
+
+- ``actuator``: a fake StatefulSet-controller + scheduler + kubelet that
+  creates pods from STS templates, binds them to (pool-consistent) nodes,
+  and flips them Ready after a tunable latency distribution — so
+  controller overhead is separable from actuation latency.
+- ``tracker``: per-CR timelines (create → first reconcile → STS created →
+  Ready) with p50/p95/p99 aggregation, wired through
+  ``controlplane/metrics/registry.py`` histograms.
+- ``loadgen``: configurable concurrency and arrival pattern (burst vs.
+  constant-rate).
+- ``scenarios``: the registry — ``notebook_ready``, ``gang_ready``,
+  ``churn``, ``profile_fanout``, ``webhook_inject``.
+- ``__main__``: the CLI. ``python -m
+  service_account_auth_improvements_tpu.controlplane.cpbench --smoke``
+  emits ``CONTROLPLANE_BENCH.json`` in ≤30 s on CPU with no JAX import
+  anywhere on the path (the control plane is pure stdlib).
+
+The reference's only control-plane performance artifact is a 300 s CI
+pod-Ready ceiling (nb_controller_intergration_test.yaml:64); this gives
+the rebuild measured percentiles future scheduling/HA PRs can regress
+against (see docs/controlplane_bench.md).
+"""
+
+from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import (  # noqa: F401
+    FakeKubelet,
+    LatencyDist,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (  # noqa: F401
+    LoadGenerator,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: F401
+    SCENARIOS,
+    BenchConfig,
+    ScenarioResult,
+    run_scenario,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: F401
+    Timeline,
+    Tracker,
+    percentiles,
+)

@@ -1,0 +1,158 @@
+"""cpbench CLI: run scenarios, emit CONTROLPLANE_BENCH.json.
+
+``python -m service_account_auth_improvements_tpu.controlplane.cpbench
+--smoke`` is the CI lane: every scenario at reduced scale, ≤30 s on a
+laptop CPU, no JAX/TPU anywhere on the import path. ``--full`` is the
+record-setting run (≥100 CRs per scenario) behind BASELINE.md's
+control-plane row.
+
+The JSON is the regression artifact: per-scenario p50/p95/p99 for each
+lifecycle phase, reconcile/requeue/backoff totals, and the
+actuation-vs-controller-overhead split (docs/controlplane_bench.md
+explains how to read it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+import time
+
+from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import (  # noqa: E501
+    LatencyDist,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
+    SCENARIOS,
+    BenchConfig,
+    run_scenario,
+)
+
+SCHEMA = "cpbench/v1"
+
+#: CRs per scenario. Smoke is sized to finish well inside the 30 s CI
+#: budget; full is the ≥100-CRs-per-scenario record run.
+SMOKE_N = {
+    "notebook_ready": 24,
+    "gang_ready": 8,          # 8 gangs × 4 host pods
+    "churn": 16,              # per run, split over cycles
+    "profile_fanout": 24,
+    "webhook_inject": 200,
+}
+FULL_N = {
+    "notebook_ready": 150,
+    "gang_ready": 100,        # 100 gangs × 4 host pods
+    "churn": 100,
+    "profile_fanout": 120,
+    "webhook_inject": 1000,
+}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="cpbench", description=__doc__.splitlines()[0],
+    )
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--smoke", action="store_true",
+                      help="reduced scale, <=30s, the CI lane (default)")
+    mode.add_argument("--full", action="store_true",
+                      help=">=100 CRs per scenario, the record run")
+    ap.add_argument("--scenario", action="append", choices=sorted(SCENARIOS),
+                    help="run only these (repeatable; default: all)")
+    ap.add_argument("--n", type=int,
+                    help="override CRs per scenario (all scenarios)")
+    ap.add_argument("--concurrency", type=int, default=8,
+                    help="concurrent apiserver writers")
+    ap.add_argument("--pattern", choices=("burst", "rate"),
+                    default="burst", help="arrival pattern")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="creates/second for --pattern rate")
+    ap.add_argument("--actuation", default="uniform:5,15",
+                    help="fake-kubelet latency dist (ms): const:X | "
+                         "uniform:A,B | lognormal:MEDIAN,SIGMA")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--timeout", type=float, default=30.0,
+                    help="per-scenario ready deadline (seconds)")
+    ap.add_argument("--out", default="CONTROLPLANE_BENCH.json",
+                    help="output path ('-' for stdout only)")
+    ap.add_argument("--verbose", action="store_true",
+                    help="keep controller logs (expected transient "
+                         "NotFound backoffs during churn are noisy)")
+    return ap
+
+
+def run(args) -> dict:
+    LatencyDist(args.actuation)  # fail fast on a malformed spec
+    mode = "full" if args.full else "smoke"
+    sizes = FULL_N if args.full else SMOKE_N
+    wanted = args.scenario or sorted(SCENARIOS)
+    started = time.monotonic()
+    report: dict = {
+        "schema": SCHEMA,
+        "mode": mode,
+        "generated_unix": time.time(),
+        "config": {
+            "concurrency": args.concurrency,
+            "pattern": args.pattern,
+            "rate": args.rate,
+            "actuation": args.actuation,
+            "seed": args.seed,
+        },
+        "scenarios": {},
+    }
+    for name in wanted:
+        cfg = BenchConfig(
+            n=args.n or sizes[name],
+            concurrency=args.concurrency,
+            pattern=args.pattern,
+            rate=args.rate,
+            actuation=args.actuation,
+            seed=args.seed,
+            timeout=args.timeout,
+        )
+        t0 = time.monotonic()
+        result = run_scenario(name, cfg)
+        entry = dict(result.summary)
+        entry["ok"] = result.ok
+        entry["elapsed_s"] = round(result.elapsed_s, 3)
+        report["scenarios"][name] = entry
+        ready = (entry.get("phases_ms") or {}).get("create_to_ready") or {}
+        print(
+            f"{name:16s} {'ok' if result.ok else 'FAIL':4s} "
+            f"n={entry['n']:<5d} "
+            f"p50={ready.get('p50', float('nan')):8.2f}ms "
+            f"p95={ready.get('p95', float('nan')):8.2f}ms "
+            f"p99={ready.get('p99', float('nan')):8.2f}ms "
+            f"reconciles={entry['reconciles']:<6d} "
+            f"({time.monotonic() - t0:.1f}s)",
+            file=sys.stderr,
+        )
+    report["elapsed_s"] = round(time.monotonic() - started, 3)
+    report["ok"] = all(
+        s["ok"] for s in report["scenarios"].values()
+    ) and bool(report["scenarios"])
+    return report
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if not args.verbose:
+        # churn legitimately races deletes against in-flight reconciles;
+        # the backoff counter records them — the tracebacks are noise
+        logging.getLogger(
+            "service_account_auth_improvements_tpu"
+        ).setLevel(logging.CRITICAL)
+    report = run(args)
+    text = json.dumps(report, indent=2, sort_keys=True)
+    if args.out == "-":
+        print(text)
+    else:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,343 @@
+"""Fake-kubelet actuator: plays the cluster around the control plane.
+
+The system under test is the reconcile stack; everything a real cluster
+would do around it is played here, against the same FakeKube apiserver:
+
+- **StatefulSet controller**: creates ``<sts>-<i>`` pods from the STS
+  template (scheduling gates and all), deletes pods past
+  ``spec.replicas`` on scale-down — the role tests play by hand in
+  tests/test_gang.py ``_mk_pod``.
+- **Scheduler**: binds ungated pods to nodes. Every STS gets its own
+  node pool (one node per ordinal, labeled ``cloud.google.com/
+  gke-nodepool``) so a multi-host gang lands pool-consistent — the
+  placement the notebook controller's one-pool-one-slice check verifies
+  against the bound nodes. Gated pods are NEVER bound: the gang gates
+  must be lifted by the controller first, exactly as kube-scheduler
+  honors schedulingGates.
+- **Kubelet**: flips bound pods Ready after a latency sampled from a
+  tunable distribution, then maintains ``sts.status.readyReplicas``.
+  Every sample is recorded per pod, so a scenario can subtract actuation
+  from the end-to-end number and report pure controller overhead.
+"""
+
+from __future__ import annotations
+
+import copy
+import heapq
+import logging
+import math
+import random
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Informer,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.tpu import (
+    SEL_NODEPOOL,
+)
+
+log = logging.getLogger(__name__)
+
+
+class LatencyDist:
+    """Tunable actuation-latency distribution.
+
+    Spec strings (milliseconds):
+
+    - ``const:20``          — every pod takes 20 ms to go Ready
+    - ``uniform:5,15``      — uniform in [5, 15] ms
+    - ``lognormal:20,0.5``  — median 20 ms, sigma 0.5 (long tail — the
+      realistic image-pull/container-start shape)
+    """
+
+    def __init__(self, spec: str = "uniform:5,15"):
+        kind, _, args = spec.partition(":")
+        self.kind = kind.strip().lower()
+        try:
+            vals = [float(a) for a in args.split(",")] if args else []
+        except ValueError:
+            raise ValueError(f"malformed latency spec {spec!r}")
+        if self.kind == "const" and len(vals) == 1:
+            self.a, self.b = vals[0], vals[0]
+        elif self.kind == "uniform" and len(vals) == 2 and vals[0] <= vals[1]:
+            self.a, self.b = vals
+        elif self.kind == "lognormal" and len(vals) == 2 and vals[0] > 0:
+            self.a, self.b = vals
+        else:
+            raise ValueError(f"malformed latency spec {spec!r}")
+        if self.a < 0:
+            raise ValueError(f"latency must be >= 0 in {spec!r}")
+        self.spec = spec
+
+    def sample(self, rng: random.Random) -> float:
+        """One draw, in seconds."""
+        if self.kind == "const":
+            ms = self.a
+        elif self.kind == "uniform":
+            ms = rng.uniform(self.a, self.b)
+        else:  # lognormal: a = median ms, b = sigma
+            ms = rng.lognormvariate(math.log(self.a), self.b)
+        return ms / 1000.0
+
+
+class _Flipper(threading.Thread):
+    """Delayed-call scheduler (the kubelet's 'container is starting')."""
+
+    def __init__(self):
+        super().__init__(name="cpbench-flipper", daemon=True)
+        self._cond = threading.Condition()
+        self._heap: list = []
+        self._seq = 0
+        self._stop = False
+
+    def call_later(self, delay: float, fn) -> None:
+        with self._cond:
+            self._seq += 1
+            heapq.heappush(
+                self._heap, (time.monotonic() + delay, self._seq, fn)
+            )
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+
+    def run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._stop and (
+                        not self._heap
+                        or self._heap[0][0] > time.monotonic()):
+                    wait = 0.2
+                    if self._heap:
+                        wait = min(
+                            wait, max(self._heap[0][0] - time.monotonic(),
+                                      0.001),
+                        )
+                    self._cond.wait(wait)
+                if self._stop:
+                    return
+                _, _, fn = heapq.heappop(self._heap)
+            try:
+                fn()
+            except Exception:  # a lost flip must not kill the kubelet
+                log.exception("cpbench flip failed")
+
+
+class FakeKubelet:
+    """STS-controller + scheduler + kubelet against a FakeKube."""
+
+    def __init__(self, kube, latency: LatencyDist | str = "uniform:5,15",
+                 seed: int = 0):
+        self.kube = kube
+        self.latency = (latency if isinstance(latency, LatencyDist)
+                        else LatencyDist(latency))
+        self._rng = random.Random(seed)
+        self._rng_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._scheduled: set[str] = set()      # pod uids with a flip queued
+        self.samples: dict[tuple[str, str], float] = {}  # (ns, pod) -> s
+        self.gate_violations = 0   # pods seen bound/Ready while still gated
+        self.pods_created = 0
+        self.pods_ready = 0
+        self._flipper = _Flipper()
+        self._sts_inf = Informer(kube, "statefulsets", group="apps")
+        self._sts_inf.add_handler(self._on_sts)
+        self._pod_inf = Informer(kube, "pods")
+        self._pod_inf.add_handler(self._on_pod)
+
+    def start(self) -> None:
+        self._flipper.start()
+        self._sts_inf.start()
+        self._pod_inf.start()
+        self._sts_inf.wait_for_sync(10)
+        self._pod_inf.wait_for_sync(10)
+
+    def stop(self) -> None:
+        self._sts_inf.stop()
+        self._pod_inf.stop()
+        self._flipper.stop()
+
+    def actuation_for(self, namespace: str, name: str) -> float:
+        """Max actuation sample (seconds) over ``<name>-*`` pods — the
+        component of this CR's ready latency the kubelet injected (pods
+        start in parallel, so the max is the gang's critical path)."""
+        prefix = f"{name}-"
+        with self._lock:
+            vals = [v for (ns, pod), v in self.samples.items()
+                    if ns == namespace and pod.startswith(prefix)]
+        return max(vals, default=0.0)
+
+    # ------------------------------------------------- StatefulSet control
+
+    def _on_sts(self, ev_type: str, sts: dict) -> None:
+        if ev_type == "DELETED":
+            return  # ownerReference cascade deletes the pods
+        meta = sts["metadata"]
+        ns, name = meta.get("namespace"), meta["name"]
+        replicas = int((sts.get("spec") or {}).get("replicas") or 0)
+        template = (sts.get("spec") or {}).get("template") or {}
+        for i in range(replicas):
+            pod_name = f"{name}-{i}"
+            if self._pod_inf.get(ns, pod_name) is not None:
+                continue
+            try:
+                self.kube.create("pods", self._pod_from_template(
+                    sts, template, pod_name, i))
+                with self._lock:
+                    self.pods_created += 1
+            except errors.AlreadyExists:
+                pass  # informer cache lagging a pod we already made
+        # scale-down (stop annotation → replicas=0): delete extra ordinals
+        for pod in self._pod_inf.list():
+            m = pod["metadata"]
+            if m.get("namespace") != ns:
+                continue
+            if (m.get("labels") or {}).get("statefulset") != name:
+                continue
+            ordinal = m["name"].rsplit("-", 1)[-1]
+            if ordinal.isdigit() and int(ordinal) >= replicas:
+                try:
+                    self.kube.delete("pods", m["name"], namespace=ns)
+                except errors.NotFound:
+                    pass
+        self._sync_sts_status(ns, name, replicas)
+
+    @staticmethod
+    def _pod_from_template(sts: dict, template: dict, pod_name: str,
+                           ordinal: int) -> dict:
+        tmeta = template.get("metadata") or {}
+        return {
+            "metadata": {
+                "name": pod_name,
+                "namespace": sts["metadata"].get("namespace"),
+                "labels": {
+                    **(tmeta.get("labels") or {}),
+                    "apps.kubernetes.io/pod-index": str(ordinal),
+                },
+                "annotations": dict(tmeta.get("annotations") or {}),
+                "ownerReferences": [{
+                    "apiVersion": "apps/v1", "kind": "StatefulSet",
+                    "name": sts["metadata"]["name"],
+                    "uid": sts["metadata"]["uid"], "controller": True,
+                }],
+            },
+            "spec": copy.deepcopy(template.get("spec") or {}),
+            "status": {"phase": "Pending"},
+        }
+
+    def _sync_sts_status(self, ns: str, name: str,
+                         replicas: int | None = None) -> None:
+        """Maintain status.readyReplicas — what the notebook controller's
+        update_status reads."""
+        try:
+            sts = self.kube.get("statefulsets", name, namespace=ns,
+                                group="apps")
+        except errors.NotFound:
+            return
+        if replicas is None:
+            replicas = int((sts.get("spec") or {}).get("replicas") or 0)
+        ready = 0
+        for pod in self.kube.list(
+                "pods", namespace=ns,
+                label_selector=f"statefulset={name}")["items"]:
+            for cond in (pod.get("status") or {}).get("conditions") or []:
+                if cond.get("type") == "Ready" and \
+                        cond.get("status") == "True":
+                    ready += 1
+        cur = sts.get("status") or {}
+        if (cur.get("readyReplicas"), cur.get("replicas")) == (ready,
+                                                               replicas):
+            return
+        try:
+            self.kube.patch("statefulsets", name, {"status": {
+                "replicas": replicas, "readyReplicas": ready,
+            }}, namespace=ns, group="apps")
+        except errors.NotFound:
+            pass
+
+    # --------------------------------------------------- scheduler/kubelet
+
+    def _on_pod(self, ev_type: str, pod: dict) -> None:
+        if ev_type == "DELETED":
+            return
+        spec = pod.get("spec") or {}
+        if spec.get("schedulingGates"):
+            # kube-scheduler semantics: a gated pod is invisible to
+            # binding. The gang controller lifts the gate; the MODIFIED
+            # event brings the pod back here.
+            return
+        meta = pod["metadata"]
+        ns, name, uid = meta.get("namespace"), meta["name"], meta["uid"]
+        if not spec.get("nodeName"):
+            try:
+                self._bind(pod)
+            except errors.NotFound:
+                return  # deleted mid-flight (churn)
+        with self._lock:
+            if uid in self._scheduled:
+                return
+            self._scheduled.add(uid)
+        with self._rng_lock:
+            delay = self.latency.sample(self._rng)
+        with self._lock:
+            self.samples[(ns or "", name)] = delay
+        self._flipper.call_later(delay, lambda: self._flip_ready(ns, name,
+                                                                 uid))
+
+    def _bind(self, pod: dict) -> None:
+        """Assign a node from the pod's STS pool (one pool per STS, one
+        node per ordinal — pool-consistent within a slice by
+        construction, never shared across slices)."""
+        meta = pod["metadata"]
+        ns, name = meta.get("namespace"), meta["name"]
+        sts = (meta.get("labels") or {}).get("statefulset") or "solo"
+        ordinal = name.rsplit("-", 1)[-1]
+        pool = f"{ns}-{sts}"
+        node_name = f"node-{pool}-{ordinal}"
+        try:
+            self.kube.create("nodes", {
+                "metadata": {"name": node_name,
+                             "labels": {SEL_NODEPOOL: pool}},
+            })
+        except errors.AlreadyExists:
+            pass
+        self.kube.patch("pods", name, {"spec": {"nodeName": node_name}},
+                        namespace=ns)
+
+    def _flip_ready(self, ns: str, name: str, uid: str) -> None:
+        try:
+            pod = self.kube.get("pods", name, namespace=ns)
+        except errors.NotFound:
+            return  # deleted before it came up (churn / culling)
+        if pod["metadata"].get("uid") != uid:
+            return  # recreated under the same name; the new pod rebinds
+        if (pod.get("spec") or {}).get("schedulingGates"):
+            with self._lock:
+                self.gate_violations += 1
+            return
+        container = "notebook"
+        for c in (pod.get("spec") or {}).get("containers") or []:
+            container = c.get("name") or container
+            break
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        try:
+            self.kube.patch("pods", name, {"status": {
+                "phase": "Running",
+                "conditions": [{"type": "Ready", "status": "True",
+                                "lastTransitionTime": now}],
+                "containerStatuses": [{
+                    "name": container, "ready": True,
+                    "state": {"running": {"startedAt": now}},
+                }],
+            }}, namespace=ns)
+        except errors.NotFound:
+            return
+        with self._lock:
+            self.pods_ready += 1
+        sts = (pod["metadata"].get("labels") or {}).get("statefulset")
+        if sts:
+            self._sync_sts_status(ns, sts)

@@ -1,0 +1,63 @@
+"""Load generator: concurrency + arrival pattern for apiserver writes.
+
+Two arrival patterns, the shapes that stress a control plane
+differently (NotebookOS, arXiv:2503.20591 — spawn storms at lecture
+start vs. steady drip):
+
+- ``burst``: all jobs handed to the worker pool at once; effective
+  arrival rate = pool drain rate. The thundering-herd case (a class of
+  students clicking "launch" together) — stresses workqueue dedup and
+  informer fan-out.
+- ``rate``: submissions paced at a constant ``rate``/second (a Poisson
+  mean would wander between runs; constant spacing keeps runs
+  comparable). The steady-state case — stresses the per-CR critical
+  path with the system otherwise quiet.
+
+Jobs run on a bounded thread pool either way: ``concurrency`` models
+how many clients write the apiserver at once, not how many CRs exist.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+class LoadGenerator:
+    def __init__(self, concurrency: int = 8, pattern: str = "burst",
+                 rate: float = 50.0):
+        if pattern not in ("burst", "rate"):
+            raise ValueError(f"unknown arrival pattern {pattern!r}")
+        if concurrency < 1:
+            raise ValueError("concurrency must be >= 1")
+        if pattern == "rate" and rate <= 0:
+            raise ValueError("rate must be > 0")
+        self.concurrency = concurrency
+        self.pattern = pattern
+        self.rate = rate
+
+    def run(self, jobs) -> list:
+        """Execute callables under the arrival pattern; returns each
+        job's result, with raised exceptions returned in place (one bad
+        CR must not sink the measurement of the other N-1)."""
+        results = [None] * len(jobs)
+
+        def call(i, job):
+            try:
+                results[i] = job()
+            except Exception as e:
+                results[i] = e
+
+        with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+            start = time.monotonic()
+            futures = []
+            for i, job in enumerate(jobs):
+                if self.pattern == "rate":
+                    due = start + i / self.rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                futures.append(pool.submit(call, i, job))
+            for f in futures:
+                f.result()
+        return results

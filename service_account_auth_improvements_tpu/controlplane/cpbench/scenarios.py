@@ -1,0 +1,508 @@
+"""Scenario registry: what the bench measures, end to end.
+
+Every scenario drives the REAL reconcile stack — ``engine.Manager`` +
+informers + the production reconcilers — against a fresh ``FakeKube``
+as a live in-process apiserver, with the ``FakeKubelet`` playing the
+cluster around it. Nothing is stubbed between the CR create and the
+status the user would ``kubectl wait`` on.
+
+=================  =====================================================
+``notebook_ready``  CR create → status Ready, single-host TPU notebook
+                    (STS + services + status mirroring).
+``gang_ready``      multi-host v4-16 gang (4 host pods born gated; the
+                    controller lifts the gates only when the whole gang
+                    exists with a consistent slice-pool identity).
+``churn``           create/delete cycling with the culling controller
+                    active: busy kernels keep most notebooks alive,
+                    every 5th goes idle once Ready and must be culled
+                    (stop annotation → replicas 0) before the cycle
+                    deletes the rest.
+``profile_fanout``  N Profiles → namespaces, TPU resource quotas, RBAC,
+                    service accounts, cloud-IAM plugins.
+``webhook_inject``  PodDefault admission latency through the production
+                    merge engine (webhook/engine.py) with the
+                    PodDefault list served by the apiserver per review.
+=================  =====================================================
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.culling import (  # noqa: E501
+    CullingReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+    STOP_ANNOTATION,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.profile import (  # noqa: E501
+    ProfileReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import (  # noqa: E501
+    FakeKubelet,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (  # noqa: E501
+    LoadGenerator,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: E501
+    Tracker,
+    percentiles,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Informer,
+    Manager,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.webhook.server import (
+    review_response,
+)
+
+
+@dataclasses.dataclass
+class BenchConfig:
+    """One knob set shared by every scenario."""
+
+    n: int = 20                      # CRs per scenario
+    concurrency: int = 8             # concurrent apiserver writers
+    pattern: str = "burst"           # arrival: "burst" | "rate"
+    rate: float = 50.0               # creates/second for pattern="rate"
+    actuation: str = "uniform:5,15"  # fake-kubelet latency (ms spec)
+    seed: int = 0
+    timeout: float = 30.0            # per-scenario ready deadline (s)
+    churn_cycles: int = 2
+    cull_period_minutes: float = 0.01   # culling probe cadence (36 s/60)
+
+
+@dataclasses.dataclass
+class ScenarioResult:
+    name: str
+    elapsed_s: float
+    records: list                    # Timelines (tests assert monotone)
+    summary: dict                    # tracker.summary() + "extra"
+    ok: bool
+
+
+# --------------------------------------------------------------- fixtures
+
+def _nb(name: str, ns: str, tpu: dict | None) -> dict:
+    spec: dict = {
+        "template": {"spec": {"containers": [{
+            "name": "notebook", "image": "ghcr.io/tpukf/jax:bench",
+        }]}},
+    }
+    if tpu:
+        spec["tpu"] = tpu
+    return {"metadata": {"name": name, "namespace": ns}, "spec": spec}
+
+
+class _NotebookWorld:
+    """FakeKube + Manager + NotebookReconciler (+ optional culler) +
+    FakeKubelet + a ready-watch, instrumented for one scenario."""
+
+    def __init__(self, cfg: BenchConfig, scenario: str,
+                 fetch_kernels=None):
+        self.kube = FakeKube()
+        self.tracker = Tracker(scenario)
+        self.tracker.instrument_kube(self.kube)
+        self.mgr = Manager(self.kube)
+        self.reconciler = NotebookReconciler(self.kube)
+        self.tracker.instrument_reconciler(self.reconciler)
+        self.reconciler.register(self.mgr)
+        self.culler = None
+        if fetch_kernels is not None:
+            self.culler = CullingReconciler(
+                self.kube, fetch_kernels=fetch_kernels
+            )
+            self.culler.check_period_minutes = cfg.cull_period_minutes
+            self.tracker.instrument_reconciler(self.culler)
+            self.culler.register(self.mgr)
+        self.actuator = FakeKubelet(self.kube, cfg.actuation,
+                                    seed=cfg.seed)
+        self.tracker.actuation_fn = self.actuator.actuation_for
+        self._want: dict[tuple[str, str], int] = {}
+        self._ready_inf = Informer(self.kube, "notebooks", group=GROUP)
+        self._ready_inf.add_handler(self._on_notebook)
+
+    def _on_notebook(self, ev_type: str, nb: dict) -> None:
+        if ev_type == "DELETED":
+            return
+        m = nb["metadata"]
+        key = (m.get("namespace") or "", m["name"])
+        want = self._want.get(key)
+        ready = (nb.get("status") or {}).get("readyReplicas") or 0
+        if want and ready >= want:
+            self.tracker.note_ready(*key)
+
+    def start(self) -> None:
+        self.mgr.start()
+        self.actuator.start()
+        self._ready_inf.start()
+        self._ready_inf.wait_for_sync(10)
+
+    def stop(self) -> None:
+        self._ready_inf.stop()
+        self.actuator.stop()
+        self.mgr.stop()
+
+    def create_jobs(self, names: list[str], ns: str, tpu: dict | None,
+                    want_ready: int):
+        """One callable per CR: stamp the timeline, then POST."""
+
+        def job(name):
+            def run():
+                self.tracker.expect(ns, name)
+                self._want[(ns, name)] = want_ready
+                self.kube.create("notebooks", _nb(name, ns, tpu))
+            return run
+
+        return [job(n) for n in names]
+
+
+def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
+            started: float, extra: dict) -> ScenarioResult:
+    keys = [(ns, n) for n in names]
+    ok = world.tracker.wait_ready(keys, cfg.timeout)
+    world.stop()
+    summary = world.tracker.summary()
+    extra.setdefault("gate_violations", world.actuator.gate_violations)
+    extra.setdefault("pods_created", world.actuator.pods_created)
+    extra.setdefault("pods_ready", world.actuator.pods_ready)
+    summary["extra"] = extra
+    return ScenarioResult(
+        name=world.tracker.scenario,
+        elapsed_s=time.monotonic() - started,
+        records=world.tracker.records(),
+        summary=summary,
+        ok=ok and summary["failed"] == 0,
+    )
+
+
+# -------------------------------------------------------------- scenarios
+
+def scenario_notebook_ready(cfg: BenchConfig) -> ScenarioResult:
+    """Single-host TPU notebook: create → STS → pod Ready → status Ready.
+    The BASELINE.md headline number."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "notebook_ready")
+    world.start()
+    ns = "bench"
+    names = [f"nb-{i}" for i in range(cfg.n)]
+    tpu = {"generation": "v5e", "topology": "2x2"}   # 4 chips, 1 host
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(names, ns, tpu, want_ready=1)
+    )
+    return _finish(world, cfg, names, ns, started, {})
+
+
+def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
+    """Multi-host v4-16 gang: 4 host pods born with scheduling gates;
+    Ready requires the controller's gate-lift handshake (all pods exist,
+    slice placement consistent, one pool per slice)."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "gang_ready")
+    world.start()
+    ns = "bench"
+    names = [f"gang-{i}" for i in range(cfg.n)]
+    tpu = {"generation": "v4", "topology": "2x2x4"}  # 16 chips, 4 hosts
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(names, ns, tpu, want_ready=4)
+    )
+    keys = [(ns, n) for n in names]
+    ok = world.tracker.wait_ready(keys, cfg.timeout)
+    # gang correctness, checked while the world is still live
+    gang_scheduled = conflicts = gated_left = 0
+    for name in names:
+        try:
+            nb = world.kube.get("notebooks", name, namespace=ns,
+                                group=GROUP)
+        except errors.NotFound:
+            continue
+        conds = {c.get("type") for c in
+                 (nb.get("status") or {}).get("conditions") or []}
+        gang_scheduled += "GangScheduled" in conds
+        conflicts += "SlicePlacementConflict" in conds
+        for pod in world.kube.list(
+                "pods", namespace=ns,
+                label_selector=f"notebook-name={name}")["items"]:
+            if (pod.get("spec") or {}).get("schedulingGates"):
+                gated_left += 1
+    world.stop()
+    summary = world.tracker.summary()
+    summary["extra"] = {
+        "hosts_per_gang": 4,
+        "gang_scheduled": gang_scheduled,
+        "placement_conflicts": conflicts,
+        "pods_still_gated": gated_left,
+        "gate_violations": world.actuator.gate_violations,
+        "pods_created": world.actuator.pods_created,
+        "pods_ready": world.actuator.pods_ready,
+    }
+    return ScenarioResult(
+        name="gang_ready", elapsed_s=time.monotonic() - started,
+        records=world.tracker.records(), summary=summary,
+        ok=ok and summary["failed"] == 0 and gated_left == 0,
+    )
+
+
+_KERNELS_URL = re.compile(r"/notebook/([^/]+)/([^/]+)/api/kernels")
+
+
+def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
+    """Create/delete cycling with culling active. Every 5th notebook
+    turns idle once Ready and must be CULLED (probe → stop annotation →
+    replicas 0); the rest stay busy under periodic kernel probes and are
+    deleted at cycle end (cascade through ownerReferences)."""
+    started = time.monotonic()
+    ns = "bench"
+
+    def fetch_kernels(url: str):
+        m = _KERNELS_URL.search(url)
+        if not m or m.group(1) != ns:
+            return None
+        name = m.group(2)
+        idx = name.rsplit("-", 1)[-1]
+        try:
+            nb = world.kube.get("notebooks", name, namespace=ns,
+                                group=GROUP)
+        except errors.NotFound:
+            return None
+        ready = (nb.get("status") or {}).get("readyReplicas") or 0
+        if not ready:
+            # booting: unreachable (a busy answer here would stamp
+            # last-activity=now, which only moves forward — the idle
+            # timestamp below could then never win)
+            return None
+        if idx.isdigit() and int(idx) % 5 == 0:
+            # idle since long ago → culled on the next probe
+            return [{"execution_state": "idle",
+                     "last_activity": "2000-01-01T00:00:00Z"}]
+        return [{"execution_state": "busy"}]
+
+    world = _NotebookWorld(cfg, "churn", fetch_kernels=fetch_kernels)
+    world.start()
+    gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+    cycles = max(1, cfg.churn_cycles)
+    per_cycle = max(1, cfg.n // cycles)
+    tpu = {"generation": "v5e", "topology": "2x2"}
+    culled_total = 0
+    delete_ms: list[float] = []
+    ok = True
+    all_names: list[str] = []
+    for c in range(cycles):
+        names = [f"churn-c{c}-{i}" for i in range(per_cycle)]
+        all_names += names
+        gen.run(world.create_jobs(names, ns, tpu, want_ready=1))
+        keys = [(ns, n) for n in names]
+        ok = world.tracker.wait_ready(keys, cfg.timeout) and ok
+        # the idle subset must get culled before the cycle tears down
+        idle = [n for n in names if int(n.rsplit("-", 1)[-1]) % 5 == 0]
+        deadline = time.monotonic() + cfg.timeout
+        while idle and time.monotonic() < deadline:
+            idle = [
+                n for n in idle
+                if STOP_ANNOTATION not in (
+                    world.kube.get("notebooks", n, namespace=ns,
+                                   group=GROUP)["metadata"]
+                    .get("annotations") or {})
+            ]
+            if idle:
+                time.sleep(0.02)
+        ok = ok and not idle
+        culled_total += len(
+            [n for n in names if int(n.rsplit("-", 1)[-1]) % 5 == 0]
+        ) - len(idle)
+
+        def delete(name):
+            def run():
+                t0 = time.monotonic()
+                world.kube.delete("notebooks", name, namespace=ns,
+                                  group=GROUP)
+                delete_ms.append((time.monotonic() - t0) * 1000.0)
+            return run
+
+        gen.run([delete(n) for n in names])
+        deadline = time.monotonic() + cfg.timeout
+        while time.monotonic() < deadline:
+            if not world.kube.list("pods", namespace=ns)["items"]:
+                break
+            time.sleep(0.02)
+        else:
+            ok = False
+    world.stop()
+    summary = world.tracker.summary()
+    summary["extra"] = {
+        "cycles": cycles,
+        "culled": culled_total,
+        "delete_cascade_ms": percentiles(delete_ms),
+        "gate_violations": world.actuator.gate_violations,
+        "pods_created": world.actuator.pods_created,
+    }
+    return ScenarioResult(
+        name="churn", elapsed_s=time.monotonic() - started,
+        records=world.tracker.records(), summary=summary,
+        ok=ok and summary["failed"] == 0,
+    )
+
+
+def scenario_profile_fanout(cfg: BenchConfig) -> ScenarioResult:
+    """N Profiles → tenant namespaces with TPU chip quotas, RBAC,
+    service accounts, Istio ACLs, and cloud-IAM plugin binds."""
+    started = time.monotonic()
+    kube = FakeKube()
+    tracker = Tracker("profile_fanout")
+    tracker.instrument_kube(kube)
+    mgr = Manager(kube)
+    rec = ProfileReconciler(kube)
+    tracker.instrument_reconciler(rec)
+    rec.register(mgr)
+
+    def on_profile(ev_type, obj):
+        if ev_type == "DELETED":
+            return
+        cond = helpers.get_condition(obj, "Ready")
+        if cond and cond.get("status") == "True":
+            tracker.note_ready(None, obj["metadata"]["name"])
+
+    ready_inf = Informer(kube, "profiles", group=GROUP)
+    ready_inf.add_handler(on_profile)
+    mgr.start()
+    ready_inf.start()
+    ready_inf.wait_for_sync(10)
+
+    names = [f"cpb-user-{i}" for i in range(cfg.n)]
+
+    def job(i, name):
+        def run():
+            tracker.expect(None, name)
+            profile = {
+                "metadata": {"name": name},
+                "spec": {
+                    "owner": {"kind": "User",
+                              "name": f"user{i}@example.com"},
+                    "resourceQuotaSpec": {"hard": {
+                        "requests.google.com/tpu": "16",
+                    }},
+                },
+            }
+            if i % 2 == 0:
+                profile["spec"]["plugins"] = [{
+                    "kind": "WorkloadIdentity",
+                    "spec": {"gcpServiceAccount":
+                             f"bench-{i}@proj.iam.gserviceaccount.com"},
+                }]
+            kube.create("profiles", profile)
+        return run
+
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        [job(i, n) for i, n in enumerate(names)]
+    )
+    ok = tracker.wait_ready([(None, n) for n in names], cfg.timeout)
+    ready_inf.stop()
+    mgr.stop()
+    summary = tracker.summary()
+    summary["extra"] = {
+        "namespaces": len(kube.list("namespaces")["items"]),
+        "quotas": len(kube.list("resourcequotas")["items"]),
+        "rolebindings": len(kube.list(
+            "rolebindings", group="rbac.authorization.k8s.io")["items"]),
+        "serviceaccounts": len(kube.list("serviceaccounts")["items"]),
+    }
+    return ScenarioResult(
+        name="profile_fanout", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary,
+        ok=ok and summary["failed"] == 0,
+    )
+
+
+def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
+    """PodDefault admission latency: the AdmissionReview round through
+    the production merge engine, PodDefaults listed from the apiserver
+    per review (what the real webhook does per pod CREATE)."""
+    started = time.monotonic()
+    kube = FakeKube()
+    tracker = Tracker("webhook_inject")
+    namespaces = [f"wh-{i}" for i in range(min(8, max(1, cfg.n // 4)))]
+    for ns in namespaces:
+        for pd_name, labels in (("tpu-env", {"inject-tpu": "true"}),
+                                ("proxy", {"inject-proxy": "true"})):
+            kube.create("poddefaults", {
+                "metadata": {"name": pd_name, "namespace": ns},
+                "spec": {
+                    "selector": {"matchLabels": labels},
+                    "env": [{"name": f"CPB_{pd_name.upper()}",
+                             "value": "1"}],
+                    "volumeMounts": [{"name": pd_name,
+                                      "mountPath": f"/mnt/{pd_name}"}],
+                    "volumes": [{"name": pd_name, "emptyDir": {}}],
+                },
+            }, namespace=ns)
+
+    def list_pds(ns):
+        return kube.list("poddefaults", namespace=ns)["items"]
+
+    mutated = [0]
+    mutated_lock = threading.Lock()
+
+    def job(i):
+        ns = namespaces[i % len(namespaces)]
+        name = f"pod-{i}"
+
+        def run():
+            rec = tracker.expect(ns, name)
+            review = {"request": {
+                "uid": f"uid-{i}",
+                "namespace": ns,
+                "object": {
+                    "metadata": {"name": name, "namespace": ns,
+                                 "labels": {"inject-tpu": "true",
+                                            "inject-proxy": "true"}},
+                    "spec": {"containers": [{"name": "notebook",
+                                             "image": "jax"}]},
+                },
+            }}
+            rec.first_reconcile = time.monotonic()
+            resp = review_response(review, list_pds)["response"]
+            if resp.get("patch"):
+                with mutated_lock:
+                    mutated[0] += 1
+            tracker.note_ready(ns, name)
+        return run
+
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        [job(i) for i in range(cfg.n)]
+    )
+    summary = tracker.summary()
+    summary["extra"] = {
+        "namespaces": len(namespaces),
+        "poddefaults_per_namespace": 2,
+        "mutated": mutated[0],
+    }
+    return ScenarioResult(
+        name="webhook_inject", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary,
+        ok=summary["failed"] == 0 and mutated[0] == cfg.n,
+    )
+
+
+SCENARIOS = {
+    "notebook_ready": scenario_notebook_ready,
+    "gang_ready": scenario_gang_ready,
+    "churn": scenario_churn,
+    "profile_fanout": scenario_profile_fanout,
+    "webhook_inject": scenario_webhook_inject,
+}
+
+
+def run_scenario(name: str, cfg: BenchConfig) -> ScenarioResult:
+    return SCENARIOS[name](cfg)

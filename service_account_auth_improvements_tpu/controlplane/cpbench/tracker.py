@@ -1,0 +1,251 @@
+"""Latency tracker: per-CR timelines + percentile aggregation.
+
+One ``Timeline`` per CR records the monotonic instants of the lifecycle
+the bench measures: **create** (stamped by the load generator just
+before the POST, so create ≤ first-reconcile is monotone by
+construction) → **first reconcile** (stamped by wrapping the
+reconciler's ``reconcile`` — the instrumentation point controller-
+runtime exposes as ``controller_runtime_reconcile_time_seconds``) →
+**STS created** (stamped by wrapping ``FakeKube.create``, the exact
+apiserver write) → **Ready** (stamped by a watch on the primary
+resource, the same observation path a user's ``kubectl wait`` has).
+
+Durations are observed into a ``metrics/registry.py`` Histogram
+(``cpbench_phase_seconds{scenario,phase}``) — the Prometheus surface a
+deployed bench would scrape — while raw samples are kept for EXACT
+percentiles in the JSON report (bucketed histograms can only
+interpolate; a regression gate wants the real p99).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Histogram,
+    Registry,
+)
+
+#: histogram buckets shaped for control-plane latencies (5 ms .. 60 s)
+PHASE_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5,
+                 10, 30, 60)
+
+
+def percentiles(samples, qs=(50, 95, 99)) -> dict:
+    """Exact percentiles (linear interpolation) of raw samples, plus
+    mean/max. Returns {} for no samples."""
+    if not samples:
+        return {}
+    xs = sorted(samples)
+    out = {}
+    for q in qs:
+        pos = (q / 100.0) * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        out[f"p{q}"] = xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+    out["mean"] = sum(xs) / len(xs)
+    out["max"] = xs[-1]
+    out["n"] = len(xs)
+    return out
+
+
+@dataclasses.dataclass
+class Timeline:
+    """Per-CR lifecycle instants (time.monotonic seconds)."""
+
+    namespace: str
+    name: str
+    created: float | None = None
+    first_reconcile: float | None = None
+    sts_created: float | None = None
+    ready: float | None = None
+    actuation: float = 0.0     # kubelet-injected seconds (critical path)
+    #: internal: a ready observation is in flight (claimed before the
+    #: actuation lookup so `ready` only becomes visible fully attributed)
+    claimed: bool = False
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+    def phase_ms(self) -> dict:
+        """Durations from create, in milliseconds (None where the phase
+        never happened)."""
+
+        def d(t):
+            return ((t - self.created) * 1000.0
+                    if t is not None and self.created is not None else None)
+
+        out = {
+            "create_to_first_reconcile": d(self.first_reconcile),
+            "create_to_sts_created": d(self.sts_created),
+            "create_to_ready": d(self.ready),
+        }
+        if out["create_to_ready"] is not None:
+            out["actuation"] = self.actuation * 1000.0
+            out["controller_overhead"] = max(
+                out["create_to_ready"] - out["actuation"], 0.0
+            )
+        return out
+
+
+class Tracker:
+    """Collects timelines and reconcile-loop counters for one scenario."""
+
+    def __init__(self, scenario: str, registry: Registry | None = None):
+        self.scenario = scenario
+        self.registry = registry or Registry()
+        self.hist = Histogram(
+            "cpbench_phase_seconds",
+            "control-plane bench phase latency",
+            labels=("scenario", "phase"), buckets=PHASE_BUCKETS,
+            registry=self.registry,
+        )
+        self.m_reconciles = Counter(
+            "cpbench_reconciles_total", "reconcile calls observed",
+            labels=("scenario",), registry=self.registry,
+        )
+        self._lock = threading.Condition()
+        self._records: dict[tuple[str, str], Timeline] = {}
+        self.reconciles = 0
+        self.requeues = 0
+        self.backoffs = 0
+        #: optional (ns, name) -> seconds of kubelet-injected latency;
+        #: scenarios point this at FakeKubelet.actuation_for so ready
+        #: observations can split actuation from controller overhead
+        self.actuation_fn = None
+
+    # ------------------------------------------------------------- records
+
+    def expect(self, namespace: str | None, name: str) -> Timeline:
+        """Register a CR about to be created; call BEFORE the create so
+        the timeline is monotone by construction."""
+        rec = Timeline(namespace or "", name, created=time.monotonic())
+        with self._lock:
+            self._records[rec.key] = rec
+        return rec
+
+    def records(self) -> list[Timeline]:
+        with self._lock:
+            return list(self._records.values())
+
+    def record(self, namespace: str | None, name: str) -> Timeline | None:
+        with self._lock:
+            return self._records.get((namespace or "", name))
+
+    # ----------------------------------------------------- instrumentation
+
+    def instrument_reconciler(self, reconciler) -> None:
+        """Wrap ``reconcile`` to stamp first-reconcile and count
+        reconciles / requeues / backoff-retries (the queue's
+        add_rate_limited path is entered exactly when reconcile raises)."""
+        orig = reconciler.reconcile
+
+        def wrapped(req):
+            now = time.monotonic()
+            with self._lock:
+                self.reconciles += 1
+                rec = self._records.get((req.namespace or "", req.name))
+                if rec is not None and rec.first_reconcile is None:
+                    rec.first_reconcile = now
+            self.m_reconciles.labels(self.scenario).inc()
+            try:
+                result = orig(req)
+            except Exception:
+                with self._lock:
+                    self.backoffs += 1
+                raise
+            if result is not None and (result.requeue
+                                       or result.requeue_after):
+                with self._lock:
+                    self.requeues += 1
+            return result
+
+        reconciler.reconcile = wrapped
+
+    def instrument_kube(self, kube) -> None:
+        """Wrap ``FakeKube.create`` to stamp the first owned-STS create
+        per CR at the apiserver write itself (no watch-dispatch skew)."""
+        orig = kube.create
+
+        def create(plural, obj, namespace=None, group=None):
+            out = orig(plural, obj, namespace=namespace, group=group)
+            if plural == "statefulsets":
+                meta = out.get("metadata") or {}
+                nb = (meta.get("labels") or {}).get("notebook-name")
+                if nb:
+                    now = time.monotonic()
+                    with self._lock:
+                        rec = self._records.get(
+                            (meta.get("namespace") or "", nb))
+                        if rec is not None and rec.sts_created is None:
+                            rec.sts_created = now
+            return out
+
+        kube.create = create
+
+    # -------------------------------------------------------------- ready
+
+    def note_ready(self, namespace: str | None, name: str) -> None:
+        """Idempotent: the first observation wins (watch handlers fire
+        for every later status refresh too)."""
+        now = time.monotonic()
+        with self._lock:
+            rec = self._records.get((namespace or "", name))
+            if rec is None or rec.claimed:
+                return
+            rec.claimed = True
+        # attribute actuation BEFORE publishing readiness: a waiter that
+        # wakes from wait_ready and summarizes immediately must never
+        # see ready set with actuation still 0.0 (it would book the
+        # whole kubelet latency as controller overhead)
+        actuation = (self.actuation_fn(rec.namespace, rec.name)
+                     if self.actuation_fn is not None else 0.0)
+        with self._lock:
+            rec.actuation = actuation
+            rec.ready = now
+            self._lock.notify_all()
+        for phase, ms in rec.phase_ms().items():
+            if ms is not None:
+                self.hist.labels(self.scenario, phase).observe(ms / 1000.0)
+
+    def wait_ready(self, keys, timeout: float) -> bool:
+        """Block until every (ns, name) in ``keys`` has a ready stamp."""
+        keys = [(ns or "", name) for ns, name in keys]
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                missing = [
+                    k for k in keys
+                    if (r := self._records.get(k)) is None
+                    or r.ready is None
+                ]
+                if not missing:
+                    return True
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    return False
+                self._lock.wait(min(left, 0.2))
+
+    # ------------------------------------------------------------- summary
+
+    def summary(self) -> dict:
+        recs = self.records()
+        phases: dict[str, list] = {}
+        for rec in recs:
+            for phase, ms in rec.phase_ms().items():
+                if ms is not None:
+                    phases.setdefault(phase, []).append(ms)
+        completed = sum(1 for r in recs if r.ready is not None)
+        return {
+            "n": len(recs),
+            "completed": completed,
+            "failed": len(recs) - completed,
+            "phases_ms": {p: percentiles(v) for p, v in phases.items()},
+            "reconciles": self.reconciles,
+            "requeues": self.requeues,
+            "backoffs": self.backoffs,
+        }

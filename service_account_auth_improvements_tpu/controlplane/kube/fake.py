@@ -130,6 +130,7 @@ class FakeKube:
         self.registry = registry or DEFAULT_REGISTRY
         self._lock = threading.RLock()
         self._store: dict[tuple, dict] = {}     # (group,plural,ns,name) -> obj
+        self._uids: set[str] = set()            # live uids (owner-GC check)
         self._rv = 0
         self._history: dict[tuple, list] = {}   # (group,plural) -> [(rv, ev)]
         self._pruned: dict[tuple, int] = {}     # (group,plural) -> last rv dropped
@@ -199,7 +200,25 @@ class FakeKube:
             meta["resourceVersion"] = str(self._bump())
             meta.setdefault("generation", 1)
             self._store[key] = obj
+            self._uids.add(meta["uid"])
             self._emit(res, "ADDED", obj)
+            # uid-less refs (which a real apiserver would reject at
+            # validation) can never match an owner — they must not count
+            # as "dangling" and get the object silently collected
+            ref_uids = [r.get("uid")
+                        for r in meta.get("ownerReferences") or []
+                        if r.get("uid")]
+            if ref_uids:
+                if not any(u in self._uids for u in ref_uids):
+                    # Every owner is already gone: the garbage collector
+                    # would collect this object. The race is real — a
+                    # reconciler that GETs its CR just before the CR's
+                    # delete cascades will re-create children right after
+                    # the cascade removed them; real clusters rely on the
+                    # GC to mop these orphans up, so the fake must too
+                    # (watchers see ADDED then DELETED, as they would
+                    # from a fast GC).
+                    self._finish_delete(res, key)
             return copy.deepcopy(obj)
 
     def _evaluate_sar(self, sar: dict) -> dict:
@@ -298,13 +317,22 @@ class FakeKube:
                 if new.get("spec") != cur.get("spec"):
                     gen = int(cur["metadata"].get("generation", 1))
                     new.setdefault("metadata", {})["generation"] = gen + 1
-                new["status"] = cur.get("status") if "status" not in new else new["status"]
+                if "status" not in new and "status" in cur:
+                    new["status"] = cur["status"]
             nm = new.setdefault("metadata", {})
             for field in ("uid", "creationTimestamp"):
                 nm[field] = cur["metadata"].get(field)
             nm.setdefault("generation", cur["metadata"].get("generation", 1))
             if "deletionTimestamp" in cur["metadata"]:
                 nm["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
+            # No-op write: a real apiserver leaves resourceVersion
+            # unchanged and emits no watch event. Without this, a
+            # write-per-check controller (culling stamps an annotation
+            # every probe) self-triggers through its own watch — the
+            # hot loop cpbench's churn scenario exposed.
+            nm["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            if new == cur:
+                return copy.deepcopy(cur)
             nm["resourceVersion"] = str(self._bump())
             self._store[key] = new
             self._emit(res, "MODIFIED", new)
@@ -334,6 +362,11 @@ class FakeKube:
                 raise errors.BadRequest(f"unsupported patch type {patch_type}")
             new["metadata"]["name"] = name
             new["metadata"]["uid"] = cur["metadata"]["uid"]
+            new["metadata"]["resourceVersion"] = cur["metadata"][
+                "resourceVersion"]
+            if new == cur:
+                # no-op patch: same RV, no watch event (kube semantics)
+                return copy.deepcopy(cur)
             new["metadata"]["resourceVersion"] = str(self._bump())
             self._store[key] = new
             self._emit(res, "MODIFIED", new)
@@ -364,10 +397,15 @@ class FakeKube:
         obj = self._store.pop(key, None)
         if obj is None:
             return
+        self._uids.discard(obj["metadata"].get("uid"))
         # a real apiserver bumps the RV on delete; emitting the stale
         # pre-delete RV would make a resume-from-last-RV watcher (the
         # informer) drop the DELETED event from its backlog — or regress
-        # its tracked RV and replay newer events
+        # its tracked RV and replay newer events. Bump a COPY: when the
+        # orphan GC fires inside create(), the caller's response must
+        # keep the creation RV (the delete is a later event), not the
+        # delete's.
+        obj = copy.deepcopy(obj)
         obj["metadata"]["resourceVersion"] = str(self._bump())
         self._emit(res, "DELETED", obj)
         # ownerReference cascade (synchronous; foreground-ish for tests).

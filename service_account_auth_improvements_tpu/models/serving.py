@@ -10,15 +10,17 @@ design: tokenization is a vocab-specific concern the caller owns
 server dependency-free.
 
 Generation is serialized under a lock (one chip, one jit cache) and
-jitted per (prompt shape, max_new_tokens bucket, top_k, sampling
-structure); temperature/top_p/eos_id are traced dynamically so
-arbitrary client values reuse one executable, batch size is bounded,
-and max_new_tokens and top_k run at the next power of two (completions
-truncated to the requested n; the top-k set marginally wider) — every
-client-controlled compile key except prompt length is finite.
-Production callers should bucket prompt lengths. The
-reference has no serving surface at all (SURVEY.md §2b); this completes
-the train → checkpoint → serve lifecycle the workload layer provides.
+jitted per (max_new_tokens bucket, top_k, sampling structure);
+temperature/top_p/eos_id are traced dynamically so arbitrary client
+values reuse one executable, batch size is bounded, max_new_tokens and
+top_k run at the next power of two (completions truncated to the
+requested n; the top-k set marginally wider), and prompt length is
+bucketed BY DEFAULT through fixed-window chunked prefill (one prefill
+executable per cache bucket, not one per prompt length) — every
+client-controlled compile key is finite. See docs/serving.md for the
+limits. The reference has no serving surface at all (SURVEY.md §2b);
+this completes the train → checkpoint → serve lifecycle the workload
+layer provides.
 """
 
 from __future__ import annotations
@@ -56,16 +58,33 @@ def _next_pow2(x: int) -> int:
     return 1 << (x - 1).bit_length()
 
 
+#: prompt-length bucket for the default chunked prefill: prompts whose
+#: (prompt + completion) lands in the same 512-window cache bucket share
+#: ONE prefill executable regardless of exact length
+DEFAULT_PREFILL_WINDOW = 512
+
+
 def _scalar(body: dict, name: str, cast, default, lo=None, hi=None):
     """Coerce and range-check an optional scalar field; malformed or
     out-of-range input is the CLIENT's error (400), never a 500. An
     explicit JSON null only stands for "absent" when the default itself
-    is None (eos_id)."""
+    is None (eos_id). JSON booleans are never numbers (json.loads maps
+    true → Python bool, which int()/float() would silently coerce), and
+    a fractional float is not an int (int(2.5) would silently truncate
+    to a different request than the client made)."""
     v = body.get(name, default)
     if v is None:
         if default is None:
             return None
         raise BadRequest(f"{name} must be a {cast.__name__}, not null")
+    if isinstance(v, bool):
+        raise BadRequest(f"{name} must be a {cast.__name__}, not a "
+                         f"boolean")
+    if not isinstance(v, (int, float)):
+        # JSON numbers only: int("8") would silently accept the string
+        raise BadRequest(f"{name} must be a {cast.__name__}")
+    if cast is int and isinstance(v, float) and not v.is_integer():
+        raise BadRequest(f"{name} must be an integer")
     try:
         v = cast(v)
     except (TypeError, ValueError, OverflowError):
@@ -84,7 +103,7 @@ class GenerationService:
                  max_new_cap: int = 512, max_batch: int = 8,
                  max_streams: int = 4, name: str = "llama", mesh=None,
                  draft: tuple | None = None, gamma: int = 4,
-                 prefill_window: int | None = None):
+                 prefill_window: int | None = DEFAULT_PREFILL_WINDOW):
         self.cfg = cfg
         self.params = params
         # (draft_cfg, draft_params): single-prompt one-shot requests
@@ -94,9 +113,13 @@ class GenerationService:
             raise ValueError("draft vocab must match the target's")
         self.draft = draft
         self.gamma = gamma
-        # fixed-window prefill for streams: one prefill executable per
-        # cache bucket instead of one per prompt length
-        self.prefill_window = prefill_window
+        # fixed-window chunked prefill, DEFAULT-ON for both the one-shot
+        # and streaming paths: one prefill executable per cache bucket
+        # instead of one per prompt length — without it, arbitrary client
+        # prompt lengths mint XLA executables without bound (the last
+        # unbounded compile key). None/0 restores per-length prefill
+        # (benchmarks, shape-bucketed callers).
+        self.prefill_window = prefill_window or None
         self.max_new_cap = max_new_cap
         self.max_batch = max_batch
         self.name = name
@@ -127,8 +150,13 @@ class GenerationService:
             registry=self.registry)
 
     def _mesh_ctx(self):
-        return (jax.set_mesh(self.mesh) if self.mesh is not None
-                else contextlib.nullcontext())
+        if self.mesh is None:
+            return contextlib.nullcontext()
+        from service_account_auth_improvements_tpu.parallel import (
+            use_mesh,
+        )
+
+        return use_mesh(self.mesh)
 
     def info(self) -> dict:
         return {
@@ -212,32 +240,47 @@ class GenerationService:
             )
 
             dcfg, dparams = self.draft
+            # the requested n, NOT the pow-2-bucketed n_run, bounds the
+            # host loop: bucketing the loop would burn up to ~2× the
+            # requested decode work under the service lock. The CACHE
+            # allocation still gets the bucket (alloc_tokens=n_run) —
+            # cache length is a compile key for the prefills and every
+            # verify round, so raw n there would let clients mint
+            # executables per distinct max_new_tokens
             with self._lock, self._mesh_ctx():
                 out, spec_stats = speculative.spec_generate(
-                    self.cfg, self.params, dcfg, dparams, toks, n_run,
+                    self.cfg, self.params, dcfg, dparams, toks, n,
                     gamma=self.gamma, key=key,
                     temperature=sampling["temperature"],
-                    eos_id=eos_id,
+                    eos_id=eos_id, alloc_tokens=n_run,
+                    prefill_window=self.prefill_window,
                 )
+            # spec_generate already stops at (and includes) the first
+            # eos, so the rows need no re-truncation here
+            completion = [[int(t) for t in row[s:s + n]] for row in out]
         else:
-            with self._lock, self._mesh_ctx():
-                out = generate.generate(
-                    self.cfg, self.params, toks, n_run, key=key,
-                    **sampling
-                )
-        completion = [[int(t) for t in row[s:s + n]] for row in out]
-        if eos_id is not None:
-            # eos-padded rows truncate at (and include) the first eos
-            completion = [
-                row[: row.index(eos_id) + 1] if eos_id in row else row
-                for row in completion
-            ]
+            # the chunked decode path — the same executables the SSE
+            # streams use (prompt length bucketed by the chunked prefill,
+            # chunk sizes pow-2 bucketed), so one-shot and streaming
+            # share one finite compile cache; chunks already truncate at
+            # eos and early-stop once every row is done
+            completion = [[] for _ in range(int(toks.shape[0]))]
+            for chunk in self._stream_chunks(toks, n, n_run, sampling,
+                                             key):
+                for row, ids in zip(completion, chunk):
+                    row.extend(ids)
         n_tokens = sum(len(r) for r in completion)
         self.m_latency.observe(time.perf_counter() - t0)
         self.m_tokens.inc(n_tokens)
         return {
             "model": self.name,
             "completion_ids": completion,
+            # the EFFECTIVE top_k: pow-2 bucketed server-side, and 0 for
+            # greedy requests (temperature 0 is pure argmax — no top-k
+            # filter runs at all); clients must see the value actually
+            # sampled with, not the one sent
+            "top_k": (0 if sampling["temperature"] == 0.0
+                      else sampling["top_k"]),
             "usage": {
                 "prompt_tokens": int(toks.shape[0]) * s,
                 "completion_tokens": n_tokens,
@@ -462,9 +505,11 @@ def main(argv=None) -> int:
     ap.add_argument("--gamma", type=int, default=4,
                     help="draft tokens proposed per verify round")
     ap.add_argument("--prefill-window", type=int,
-                    help="fixed-window chunked prefill for streams: one "
-                         "prefill executable per cache bucket instead of "
-                         "one per prompt length")
+                    default=DEFAULT_PREFILL_WINDOW,
+                    help="prompt-length bucket (fixed-window chunked "
+                         "prefill): one prefill executable per cache "
+                         "bucket instead of one per prompt length; 0 "
+                         "restores per-length prefill")
     args = ap.parse_args(argv)
     if args.tp < 1 or args.fsdp < 1:
         # MeshConfig's -1 "absorb the rest" wildcard and 0-device meshes
@@ -472,8 +517,8 @@ def main(argv=None) -> int:
         ap.error("--tp and --fsdp must be >= 1")
     if args.gamma < 1:
         ap.error("--gamma must be >= 1")
-    if args.prefill_window is not None and args.prefill_window < 1:
-        ap.error("--prefill-window must be >= 1")
+    if args.prefill_window < 0:
+        ap.error("--prefill-window must be >= 0 (0 disables)")
 
     import dataclasses
 

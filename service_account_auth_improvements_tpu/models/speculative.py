@@ -123,13 +123,26 @@ def _spec_round(cfg_t, cfg_d, params_t, params_d, cache_t, cache_d,
 def spec_generate(cfg_t: llama.LlamaConfig, params_t,
                   cfg_d: llama.LlamaConfig, params_d, prompt,
                   max_new_tokens: int, gamma: int = 4, key=None,
-                  temperature: float = 0.0, eos_id: int | None = None):
+                  temperature: float = 0.0, eos_id: int | None = None,
+                  alloc_tokens: int | None = None,
+                  prefill_window: int | None = None):
     """Speculative generation: prompt [1, s] → ([1, s + ≤max_new_tokens],
     stats). Greedy output is token-identical to ``generate.generate`` on
     the target alone; temperature>0 samples from the exact target
     distribution via rejection sampling. ``stats`` reports the
     acceptance rate (the speedup driver: tokens/target-forward ≈
     1 + rate·gamma).
+
+    ``alloc_tokens`` (≥ max_new_tokens) sizes the KV caches without
+    changing how many tokens are generated. The cache length is a jit
+    compile key for the prefills and every verify round — a server
+    passes its pow-2 token bucket here so arbitrary client
+    ``max_new_tokens`` values share executables while the host loop
+    still stops at exactly the work requested. ``prefill_window``
+    additionally buckets PROMPT length: both prefills run chunked
+    (``generate.prefill_chunked``) and the caches round up to whole
+    windows, so any prompt in the same window bucket reuses the same
+    prefill and verify-round executables.
     """
     assert prompt.shape[0] == 1, "speculative decoding is batch-1"
     assert cfg_t.vocab_size == cfg_d.vocab_size, "vocabularies must match"
@@ -140,16 +153,23 @@ def spec_generate(cfg_t: llama.LlamaConfig, params_t,
     greedy = temperature == 0.0
     s = prompt.shape[1]
     # +gamma+1 slack: the final round's window may write past the budget
-    max_len = s + max_new_tokens + gamma + 1
+    max_len = s + max(alloc_tokens or 0, max_new_tokens) + gamma + 1
 
-    cache_t, logits = generate._prefill_jit(cfg_t, params_t, prompt,
-                                            max_len)
+    if prefill_window:
+        cache_t, logits = generate.prefill_chunked(
+            cfg_t, params_t, prompt, max_len, window=prefill_window)
+        cache_d, _ = generate.prefill_chunked(
+            cfg_d, params_d, prompt, max_len, window=prefill_window)
+    else:
+        cache_t, logits = generate._prefill_jit(cfg_t, params_t, prompt,
+                                                max_len)
+        cache_d, _ = generate._prefill_jit(cfg_d, params_d, prompt,
+                                           max_len)
     key, fkey = jax.random.split(key)
     first = generate._sample_jit(
         logits, fkey, jnp.float32(1.0 if greedy else temperature),
         jnp.float32(0.0), top_k=0, greedy=greedy, use_top_p=False,
     )
-    cache_d, _ = generate._prefill_jit(cfg_d, params_d, prompt, max_len)
 
     emitted = [int(first[0])]
     proposed = accepted = 0

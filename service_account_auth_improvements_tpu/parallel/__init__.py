@@ -11,8 +11,10 @@ GPipe-style layer pipeline over ``pp``, and multi-host bootstrap from the
 from service_account_auth_improvements_tpu.parallel.mesh import (  # noqa: F401
     MESH_AXES,
     MeshConfig,
+    ambient_mesh,
     make_mesh,
     make_multislice_mesh,
+    use_mesh,
 )
 from service_account_auth_improvements_tpu.parallel.pipeline import (  # noqa: F401
     pipeline_layers,

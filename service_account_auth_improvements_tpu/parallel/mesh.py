@@ -91,6 +91,38 @@ def make_mesh(
     return Mesh(dev_array, MESH_AXES)
 
 
+def use_mesh(mesh: Mesh):
+    """Enter ``mesh`` as the ambient mesh, portably.
+
+    ``jax.set_mesh`` on modern jax; on old jax (which predates it) the
+    legacy ``with mesh:`` thread-local context — the mechanism
+    ``ambient_mesh`` reads back. One helper so the train loop, serving,
+    benches, and tests don't each hard-code an API that whole jax
+    generations lack.
+    """
+    set_mesh = getattr(jax, "set_mesh", None)
+    return set_mesh(mesh) if set_mesh is not None else mesh
+
+
+def ambient_mesh():
+    """The mesh currently in scope, else None — the read side of
+    ``use_mesh``: ``jax.sharding.get_abstract_mesh()`` on modern jax,
+    the legacy thread-local physical mesh on old jax. One probe shared
+    by ``shard_constraint`` and ``pipeline_stages`` so a jax-compat fix
+    lands in both."""
+    get_abstract_mesh = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract_mesh is not None:
+        mesh = get_abstract_mesh()
+    else:
+        try:
+            mesh = jax.interpreters.pxla.thread_resources.env.physical_mesh
+        except AttributeError:
+            return None
+    if mesh is None or mesh.empty:
+        return None
+    return mesh
+
+
 def make_multislice_mesh(
     num_slices: int,
     config: MeshConfig | None = None,

@@ -34,8 +34,12 @@ from jax.sharding import PartitionSpec as P
 
 def pipeline_stages(axis_name: str = "pp") -> int:
     """Size of the pipeline axis in the ambient mesh (1 = no pipeline)."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh.empty:
+    from service_account_auth_improvements_tpu.parallel.mesh import (
+        ambient_mesh,
+    )
+
+    mesh = ambient_mesh()
+    if mesh is None:
         return 1
     return dict(mesh.shape).get(axis_name, 1)
 

@@ -75,8 +75,13 @@ def logical_sharding(
 
 def shard_constraint(x, axes: tuple[str | None, ...], rules: dict | None = None):
     """``with_sharding_constraint`` by logical axes; no-op outside jit/mesh."""
-    if jax.sharding.get_abstract_mesh().empty:
-        # No mesh in scope (e.g. pure-eager unit tests) — leave unconstrained.
+    from service_account_auth_improvements_tpu.parallel.mesh import (
+        ambient_mesh,
+    )
+
+    if ambient_mesh() is None:
+        # No mesh in scope (pure-eager unit tests; old jax with no
+        # legacy `with mesh:` entered) — leave unconstrained.
         return x
     return jax.lax.with_sharding_constraint(x, logical_to_mesh(axes, rules))
 

@@ -14,6 +14,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from service_account_auth_improvements_tpu.models import llama
+from service_account_auth_improvements_tpu.parallel import use_mesh
 
 
 def make_eval_step(cfg: llama.LlamaConfig, mesh=None, rules=None,
@@ -77,7 +78,7 @@ def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
             # it to the default device and conflict on a mesh)
             tokens, mask = batch, np.ones(np.shape(batch), np.int32)
         if mesh is not None:
-            with jax.set_mesh(mesh):
+            with use_mesh(mesh):
                 run(tokens, mask)
         else:
             run(tokens, mask)

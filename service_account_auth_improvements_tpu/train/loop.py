@@ -27,6 +27,7 @@ from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import (
     MeshConfig,
     make_mesh,
+    use_mesh,
 )
 from service_account_auth_improvements_tpu.train import checkpoint as ckpt
 from service_account_auth_improvements_tpu.train.mfu import mfu
@@ -138,7 +139,7 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
     history = []
     tokens_per_step = data_cfg.batch * (data_cfg.seq - 1)
     t0 = timed_from = None
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for i in range(start, loop.steps):
             batch, mask = data.masked_batch_at(i)
             state, metrics = step_fn(state, batch, mask)

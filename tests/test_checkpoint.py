@@ -9,6 +9,7 @@ import numpy as np
 
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import use_mesh
 from service_account_auth_improvements_tpu.train import (
     init_train_state,
     make_train_step,
@@ -26,7 +27,7 @@ def _trained_state(mesh, steps=3, cfg=CFG):
     tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
                                 cfg.vocab_size)
     mask = jnp.ones_like(tokens)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for _ in range(steps):
             state, m = step(state, tokens, mask)
     return state, step, tokens, mask, m
@@ -57,7 +58,7 @@ def test_resume_training_matches_uninterrupted(tmp_path):
     state3, step, tokens, mask, _ = _trained_state(mesh, steps=3)
     ckpt.save(tmp_path / "ck", state3)
 
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         s = state3
         for _ in range(2):
             s, m5 = step(s, tokens, mask)
@@ -65,7 +66,7 @@ def test_resume_training_matches_uninterrupted(tmp_path):
     like = jax.eval_shape(lambda: init_train_state(CFG, jax.random.key(0)))
     resumed = ckpt.restore(tmp_path / "ck", mesh, CFG, like)
     assert int(resumed.step) == 3
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for _ in range(2):
             resumed, mr = step(resumed, tokens, mask)
     assert int(resumed.step) == 5
@@ -138,6 +139,6 @@ def test_restore_onto_pipeline_mesh(tmp_path):
     for a, b in zip(jax.tree.leaves(state), jax.tree.leaves(got)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     pp_step = make_train_step(cfg, mesh=pp_mesh)
-    with jax.set_mesh(pp_mesh):
+    with use_mesh(pp_mesh):
         got, m = pp_step(got, tokens, mask)
     assert jnp.isfinite(m["loss"])

@@ -1,0 +1,185 @@
+"""cpbench: the control-plane latency/load bench (controlplane/cpbench).
+
+Asserts the three contracts the subsystem must keep to be a regression
+instrument: the JSON schema (CI parses it), monotone per-CR timelines
+(create ≤ first-reconcile ≤ Ready — a tracker that can reorder phases
+measures nothing), and gang-scenario correctness (the bench drives the
+REAL gate-lift handshake; Ready without lifted gates would mean the
+fake kubelet cheated)."""
+
+import json
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.cpbench import (
+    BenchConfig,
+    LatencyDist,
+    LoadGenerator,
+    percentiles,
+    run_scenario,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.__main__ import (  # noqa: E501
+    SCHEMA,
+    main,
+)
+
+CFG = dict(concurrency=6, timeout=25.0)
+
+
+def _assert_monotone(records, want_sts=True):
+    assert records
+    for rec in records:
+        assert rec.created is not None
+        assert rec.first_reconcile is not None, rec.name
+        assert rec.ready is not None, rec.name
+        assert rec.created <= rec.first_reconcile <= rec.ready, rec.name
+        if want_sts:
+            assert rec.sts_created is not None, rec.name
+            assert rec.created <= rec.sts_created <= rec.ready, rec.name
+
+
+# ------------------------------------------------------------- scenarios
+
+def test_notebook_ready_timelines_monotone():
+    res = run_scenario("notebook_ready", BenchConfig(n=6, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records)
+    s = res.summary
+    assert s["completed"] == 6 and s["failed"] == 0
+    assert s["reconciles"] > 0
+    phases = s["phases_ms"]
+    # actuation is separable: the kubelet injected 5-15 ms per pod, and
+    # overhead = total - actuation stays non-negative
+    assert 5.0 <= phases["actuation"]["p50"] <= 15.0
+    assert phases["controller_overhead"]["p50"] >= 0.0
+    assert s["extra"]["gate_violations"] == 0
+
+
+def test_gang_ready_correctness():
+    res = run_scenario("gang_ready", BenchConfig(n=3, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records)
+    extra = res.summary["extra"]
+    assert extra["gang_scheduled"] == 3, (
+        "every gang must reach the GangScheduled condition"
+    )
+    assert extra["pods_still_gated"] == 0
+    assert extra["gate_violations"] == 0, (
+        "a pod must never go Ready while still gated"
+    )
+    assert extra["placement_conflicts"] == 0
+    assert extra["pods_created"] == 3 * 4 == extra["pods_ready"]
+
+
+def test_churn_culls_and_drains():
+    res = run_scenario("churn", BenchConfig(n=10, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records)
+    extra = res.summary["extra"]
+    assert extra["cycles"] == 2
+    # every 5th CR per cycle turns idle after Ready and must be culled
+    assert extra["culled"] == 2
+    assert extra["delete_cascade_ms"]["n"] == 10
+
+
+def test_profile_fanout_provisions_tenants():
+    res = run_scenario("profile_fanout", BenchConfig(n=5, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records, want_sts=False)
+    extra = res.summary["extra"]
+    assert extra["namespaces"] == 5
+    assert extra["quotas"] == 5
+    assert extra["serviceaccounts"] == 10  # default-editor + default-viewer
+
+
+def test_webhook_inject_mutates_every_pod():
+    res = run_scenario("webhook_inject", BenchConfig(n=20, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records, want_sts=False)
+    assert res.summary["extra"]["mutated"] == 20
+
+
+# ------------------------------------------------------------------- CLI
+
+def test_cli_smoke_emits_parseable_schema(tmp_path):
+    out = tmp_path / "CONTROLPLANE_BENCH.json"
+    rc = main(["--smoke", "--n", "4", "--timeout", "25",
+               "--out", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["schema"] == SCHEMA
+    assert report["mode"] == "smoke"
+    assert report["ok"] is True
+    assert set(report["scenarios"]) == {
+        "notebook_ready", "gang_ready", "churn", "profile_fanout",
+        "webhook_inject",
+    }
+    for name, s in report["scenarios"].items():
+        assert s["ok"], name
+        ready = s["phases_ms"]["create_to_ready"]
+        for q in ("p50", "p95", "p99"):
+            assert isinstance(ready[q], float), (name, q)
+        assert ready["p50"] <= ready["p95"] <= ready["p99"]
+        for counter in ("reconciles", "requeues", "backoffs"):
+            assert isinstance(s[counter], int)
+
+
+def test_cli_scenario_filter(tmp_path):
+    out = tmp_path / "bench.json"
+    rc = main(["--scenario", "webhook_inject", "--n", "8",
+               "--out", str(out)])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert list(report["scenarios"]) == ["webhook_inject"]
+
+
+# ------------------------------------------------------------ primitives
+
+def test_percentiles_exact():
+    xs = list(range(1, 101))  # 1..100
+    p = percentiles(xs)
+    assert p["p50"] == pytest.approx(50.5)
+    assert p["p95"] == pytest.approx(95.05)
+    assert p["p99"] == pytest.approx(99.01)
+    assert p["max"] == 100 and p["n"] == 100
+    assert percentiles([]) == {}
+
+
+def test_latency_dist_parse_and_sample():
+    import random
+
+    rng = random.Random(0)
+    assert LatencyDist("const:20").sample(rng) == pytest.approx(0.020)
+    for _ in range(100):
+        assert 0.005 <= LatencyDist("uniform:5,15").sample(rng) <= 0.015
+    assert LatencyDist("lognormal:20,0.5").sample(rng) > 0
+    for bad in ("nope:1", "uniform:9", "uniform:5,1", "const:x",
+                "const:-3"):
+        with pytest.raises(ValueError):
+            LatencyDist(bad)
+
+
+def test_loadgen_patterns():
+    import time
+
+    ran = []
+    jobs = [lambda i=i: ran.append(i) for i in range(10)]
+    LoadGenerator(concurrency=4, pattern="burst").run(jobs)
+    assert sorted(ran) == list(range(10))
+
+    t0 = time.monotonic()
+    results = LoadGenerator(concurrency=2, pattern="rate", rate=100).run(
+        [lambda: 1] * 10
+    )
+    assert results == [1] * 10
+    assert time.monotonic() - t0 >= 0.09  # 10 jobs at 100/s ≈ 90ms spacing
+
+    # a raising job is returned in place, not raised
+    def boom():
+        raise RuntimeError("x")
+
+    out = LoadGenerator(concurrency=2).run([boom, lambda: "ok"])
+    assert isinstance(out[0], RuntimeError) and out[1] == "ok"
+
+    with pytest.raises(ValueError):
+        LoadGenerator(pattern="poisson")

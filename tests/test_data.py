@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import pytest
 
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import use_mesh
 from service_account_auth_improvements_tpu.train.data import (
     DataConfig,
     TokenBatches,
@@ -84,7 +85,7 @@ def test_iterates_and_feeds_train_step(mesh):
     state = init_train_state(cfg, jax.random.key(0))
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
     step = make_train_step(cfg, mesh=mesh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for _ in range(2):
             tokens = next(data)
             state, m = step(state, tokens, jnp.ones_like(tokens))

@@ -12,6 +12,7 @@ from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import (
     MeshConfig,
     make_mesh,
+    use_mesh,
 )
 from service_account_auth_improvements_tpu.train import (
     init_train_state,
@@ -66,7 +67,7 @@ def test_distill_step_descends_and_freezes_teacher():
     tstate = init_train_state(TEACHER, jax.random.key(0))
     tstate = jax.device_put(tstate, state_shardings(mesh, TEACHER, tstate))
     tstep = make_train_step(TEACHER, mesh=mesh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         for _ in range(25):
             tstate, _ = tstep(tstate, toks, mask)
     teacher = tstate.params
@@ -77,7 +78,7 @@ def test_distill_step_descends_and_freezes_teacher():
     state = jax.device_put(state, state_shardings(mesh, STUDENT, state))
     step = make_distill_step(STUDENT, TEACHER, optimizer=opt, mesh=mesh,
                              alpha=1.0)  # soft targets ONLY
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, teacher, toks, mask)
         kl0, hard0 = float(m0["kl"]), float(m0["hard_loss"])
         for _ in range(44):

@@ -153,3 +153,147 @@ def test_cluster_scoped_profile(kube):
     })
     got = kube.get("profiles", "alice")
     assert "namespace" not in got["metadata"]
+
+
+# ---------------------------------------------------------- cpbench scale
+# The bench (controlplane/cpbench) drives this fake with hundreds of
+# concurrent CRs; verify the substrate itself at that scale: watch-replay
+# ordering, per-object event ordering, resourceVersion optimistic
+# concurrency, no-op write suppression, and orphan GC.
+
+
+def test_watch_replay_ordering_at_scale(kube):
+    """≥100 CRs created+updated from concurrent writers: a replay-from-0
+    watch delivers strictly increasing RVs and, per object, ADDED before
+    MODIFIED."""
+    n = 120
+
+    def writer(i):
+        obj = kube.create("notebooks", _nb(f"nb-{i:03d}"))
+        obj["status"] = {"readyReplicas": 1}
+        kube.update_status("notebooks", obj)
+
+    threads = [threading.Thread(target=writer, args=(i,))
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    events = list(kube.watch("notebooks", resource_version=0, timeout=0.2))
+    assert len(events) == 2 * n
+    rvs = [int(e["object"]["metadata"]["resourceVersion"]) for e in events]
+    assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs), (
+        "watch replay must be strictly RV-ordered"
+    )
+    first_seen = {}
+    for e in events:
+        name = e["object"]["metadata"]["name"]
+        first_seen.setdefault(name, e["type"])
+    assert len(first_seen) == n
+    assert all(t == "ADDED" for t in first_seen.values())
+
+    # resume-from-midpoint replays exactly the suffix, in the same order
+    mid = rvs[len(rvs) // 2]
+    suffix = list(kube.watch("notebooks", resource_version=mid,
+                             timeout=0.2))
+    assert [int(e["object"]["metadata"]["resourceVersion"])
+            for e in suffix] == [rv for rv in rvs if rv > mid]
+
+
+def test_rv_conflict_behavior_under_concurrent_updates(kube):
+    """Optimistic concurrency at cpbench scale: stale writers Conflict,
+    retry-with-fresh-read serializes, and no increment is lost."""
+    kube.create("notebooks", _nb("shared"))
+
+    # deterministic two-writers-one-RV case: the loser gets 409
+    a = kube.get("notebooks", "shared", namespace="user1")
+    b = kube.get("notebooks", "shared", namespace="user1")
+    a["spec"]["count"] = 1
+    kube.update("notebooks", a)
+    b["spec"]["count"] = 99
+    with pytest.raises(errors.Conflict):
+        kube.update("notebooks", b)
+
+    conflicts = [0]
+    lock = threading.Lock()
+    per_thread, n_threads = 5, 20
+
+    def bump():
+        for _ in range(per_thread):
+            while True:
+                cur = kube.get("notebooks", "shared", namespace="user1")
+                cur["spec"]["count"] = int(cur["spec"].get("count", 0)) + 1
+                try:
+                    kube.update("notebooks", cur)
+                    break
+                except errors.Conflict:
+                    with lock:
+                        conflicts[0] += 1
+
+    threads = [threading.Thread(target=bump) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    final = kube.get("notebooks", "shared", namespace="user1")
+    assert final["spec"]["count"] == 1 + per_thread * n_threads
+
+
+def test_noop_update_and_patch_do_not_bump_rv(kube):
+    """A write that changes nothing keeps the RV and emits no watch event
+    (real-apiserver semantics; without it, a write-per-check controller
+    self-triggers through its own watch — the churn-scenario hot loop)."""
+    obj = kube.create("notebooks", _nb())
+    rv0 = obj["metadata"]["resourceVersion"]
+
+    same = kube.update("notebooks", kube.get("notebooks", "nb1",
+                                             namespace="user1"))
+    assert same["metadata"]["resourceVersion"] == rv0
+    same = kube.patch("notebooks", "nb1",
+                      {"metadata": {"labels": {}}}, namespace="user1")
+    assert same["metadata"]["resourceVersion"] == rv0
+    events = list(kube.watch("notebooks", resource_version=int(rv0),
+                             timeout=0.2))
+    assert events == [], "no-op writes must not wake watchers"
+
+    changed = kube.patch("notebooks", "nb1",
+                         {"metadata": {"labels": {"x": "1"}}},
+                         namespace="user1")
+    assert changed["metadata"]["resourceVersion"] != rv0
+
+
+def test_orphan_create_is_garbage_collected(kube):
+    """A child created after its owner's delete cascade (the in-flight
+    reconciler race) is collected like the kube GC would; watchers see
+    ADDED then DELETED."""
+    nb = kube.create("notebooks", _nb())
+    uid = nb["metadata"]["uid"]
+    kube.delete("notebooks", "nb1", namespace="user1")
+    orphan = kube.create("statefulsets", {
+        "metadata": {
+            "name": "nb1", "namespace": "user1",
+            "ownerReferences": [{"kind": "Notebook", "name": "nb1",
+                                 "uid": uid, "controller": True}],
+        },
+        "spec": {"replicas": 1},
+    }, group="apps")
+    assert orphan["metadata"]["name"] == "nb1"
+    with pytest.raises(errors.NotFound):
+        kube.get("statefulsets", "nb1", namespace="user1", group="apps")
+    types = [e["type"] for e in kube.watch(
+        "statefulsets", resource_version=0, group="apps", timeout=0.2)]
+    assert types == ["ADDED", "DELETED"]
+
+    # a uid-LESS ownerReference can never match an owner — it must not
+    # count as dangling (the object survives; a real apiserver would
+    # have rejected the ref at validation, never silently collected it)
+    kube.create("statefulsets", {
+        "metadata": {
+            "name": "uidless", "namespace": "user1",
+            "ownerReferences": [{"kind": "Notebook", "name": "nb1"}],
+        },
+        "spec": {},
+    }, group="apps")
+    assert kube.get("statefulsets", "uidless", namespace="user1",
+                    group="apps")

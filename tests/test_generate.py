@@ -4,6 +4,8 @@
 import dataclasses
 
 import jax
+
+from service_account_auth_improvements_tpu.parallel import use_mesh
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -246,6 +248,6 @@ def test_generate_on_tp_mesh_matches_single_device():
     mesh = make_mesh(MeshConfig(dp=1, fsdp=2, tp=2), jax.devices()[:4])
     sh = tree_logical_sharding(mesh, llama.logical_axes(cfg))
     sh_params = jax.device_put(params, sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = generate.generate(cfg, sh_params, prompt, 8)
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))

@@ -7,6 +7,7 @@ import pytest
 
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import use_mesh
 from service_account_auth_improvements_tpu.parallel.sharding import (
     tree_logical_sharding,
 )
@@ -73,7 +74,7 @@ def test_sharded_forward_matches_single_device(params):
     mesh = make_mesh(MeshConfig(dp=1, fsdp=2, sp=2, tp=2))
     shardings = tree_logical_sharding(mesh, llama.logical_axes(CFG32))
     sh_params = jax.device_put(params, shardings)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = jax.jit(lambda p, x: llama.apply(CFG32, p, x))(sh_params, t)
     np.testing.assert_allclose(want, np.asarray(got), atol=3e-5)
 
@@ -141,7 +142,7 @@ def test_chunked_loss_sharded_tp(params):
     want = float(llama.next_token_loss(CFG32, params, toks))
     sh = tree_logical_sharding(mesh, llama.logical_axes(CFG32))
     sh_params = jax.device_put(params, sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = float(jax.jit(
             lambda p, t: llama.next_token_loss(cfg_c, p, t)
         )(sh_params, toks))

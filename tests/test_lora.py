@@ -4,6 +4,8 @@ mesh, accounting (train/lora.py)."""
 import dataclasses
 
 import jax
+
+from service_account_auth_improvements_tpu.parallel import use_mesh
 import jax.numpy as jnp
 import numpy as np
 
@@ -82,7 +84,7 @@ def test_lora_train_descends_and_freezes_base():
     bsh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, bsh)
     mask = jax.device_put(jnp.ones_like(toks), bsh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, base, toks, mask)
         first = float(m0["loss"])
         for _ in range(24):

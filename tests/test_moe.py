@@ -8,6 +8,8 @@ logical axis and the one-hot dispatch/combine einsums become all-to-alls.
 import dataclasses
 
 import jax
+
+from service_account_auth_improvements_tpu.parallel import use_mesh
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -296,7 +298,7 @@ def test_moe_train_step_ep2_loss_descends():
     batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, batch_sh)
     mask = jax.device_put(jnp.ones_like(toks), batch_sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, toks, mask)
         first = float(m0["loss"])
         for _ in range(14):

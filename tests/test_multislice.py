@@ -345,6 +345,7 @@ def test_multislice_mesh_dp_spans_slices():
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_multislice_mesh,
+        use_mesh,
     )
 
     mesh = make_multislice_mesh(
@@ -376,6 +377,7 @@ def test_multislice_with_pipeline_inside_slice():
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_multislice_mesh,
+        use_mesh,
     )
     from service_account_auth_improvements_tpu.train import (
         init_train_state,
@@ -398,7 +400,7 @@ def test_multislice_with_pipeline_inside_slice():
     sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, sh)
     mask = jax.device_put(jnp.ones_like(toks), sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m = step(state, toks, mask)
         state, m = step(state, toks, mask)
     assert jnp.isfinite(m["loss"])

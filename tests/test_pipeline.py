@@ -19,6 +19,7 @@ from service_account_auth_improvements_tpu.parallel import (
     MeshConfig,
     make_mesh,
     pipeline_layers,
+    use_mesh,
 )
 from service_account_auth_improvements_tpu.train import (
     init_train_state,
@@ -44,7 +45,7 @@ def setup():
     )
     mask = jnp.ones_like(tokens)
     ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
-    with jax.set_mesh(ref_mesh):
+    with use_mesh(ref_mesh):
         ref_loss, ref_grads = jax.jit(jax.value_and_grad(
             lambda p: _loss_fn(CFG, p, tokens, mask)
         ))(params)
@@ -61,7 +62,7 @@ def test_pipeline_loss_matches_scan(setup, n_micro):
     params, tokens, mask, ref_loss, _ = setup
     cfg = dataclasses.replace(CFG, pp_microbatches=n_micro)
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         loss = jax.jit(
             lambda p: _loss_fn(cfg, p, tokens, mask)
         )(params)
@@ -72,7 +73,7 @@ def test_pipeline_grads_match_scan(setup):
     params, tokens, mask, _, ref_grads = setup
     cfg = dataclasses.replace(CFG, pp_microbatches=4)
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         grads = jax.jit(jax.grad(
             lambda p: _loss_fn(cfg, p, tokens, mask)
         ))(params)
@@ -90,7 +91,7 @@ def test_pipeline_grads_match_scan(setup):
 def test_pipeline_four_stages(setup):
     params, tokens, mask, ref_loss, _ = setup
     mesh = _pp_mesh(4)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         loss = jax.jit(
             lambda p: _loss_fn(CFG, p, tokens, mask)
         )(params)
@@ -107,7 +108,7 @@ def test_pipeline_composes_with_tp(setup):
     batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(tokens, batch_sh)
     m = jax.device_put(mask, batch_sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         loss = jax.jit(
             lambda p: _loss_fn(cfg, p, toks, m)
         )(params)
@@ -130,7 +131,7 @@ def test_pipeline_train_step_descends():
     batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, batch_sh)
     mask = jax.device_put(jnp.ones_like(toks), batch_sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, toks, mask)
         for _ in range(25):
             state, m = step(state, toks, mask)
@@ -156,7 +157,7 @@ def test_pipeline_rejects_bad_shapes():
     params = llama.init(cfg, jax.random.key(0))
     tokens = jnp.zeros((4, 16), jnp.int32)
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         with pytest.raises(ValueError, match="not divisible by pp"):
             jax.jit(lambda p: llama.apply(cfg, p, tokens))(params)
 
@@ -166,7 +167,7 @@ def test_pipeline_microbatch_must_divide_batch():
     params = llama.init(cfg, jax.random.key(0))
     tokens = jnp.zeros((4, 16), jnp.int32)
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         with pytest.raises(ValueError, match="not divisible by n_micro"):
             jax.jit(lambda p: llama.apply(cfg, p, tokens))(params)
 
@@ -190,12 +191,12 @@ def test_pipeline_moe_aux_counted_once():
         jax.random.key(2), (8, 32), 0, cfg.vocab_size, dtype="int32"
     )
     ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
-    with jax.set_mesh(ref_mesh):
+    with use_mesh(ref_mesh):
         _, ref_aux = jax.jit(
             lambda p: llama.apply(cfg, p, tokens, return_aux=True)
         )(params)
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         _, aux = jax.jit(
             lambda p: llama.apply(cfg, p, tokens, return_aux=True)
         )(params)
@@ -218,12 +219,12 @@ def test_pipeline_moe_with_token_mask():
     )
     mask = jnp.ones_like(tokens).at[:, 24:].set(0)  # padded tail
     ref_mesh = make_mesh(MeshConfig(dp=1, fsdp=1), jax.devices()[:1])
-    with jax.set_mesh(ref_mesh):
+    with use_mesh(ref_mesh):
         ref = float(jax.jit(
             lambda p: _loss_fn(cfg, p, tokens, mask)
         )(params))
     mesh = _pp_mesh(2)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         loss = float(jax.jit(
             lambda p: _loss_fn(cfg, p, tokens, mask)
         )(params))

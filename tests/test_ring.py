@@ -10,7 +10,7 @@ import pytest
 
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.ops.attention import _dense_attention
-from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh, use_mesh
 from service_account_auth_improvements_tpu.parallel.ring import ring_attention
 from service_account_auth_improvements_tpu.parallel.sharding import (
     tree_logical_sharding,
@@ -34,7 +34,7 @@ def mesh():
 def test_ring_matches_dense(mesh, causal):
     q, k, v = _make_qkv()
     want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=causal)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = jax.jit(
             functools.partial(ring_attention, causal=causal)
         )(q, k, v)
@@ -55,7 +55,7 @@ def test_ring_grads_match_dense(mesh):
         ),
         argnums=(0, 1, 2),
     )(q, k, v)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         gr = jax.jit(
             jax.grad(
                 lambda q, k, v: loss(ring_attention, q, k, v),
@@ -76,7 +76,7 @@ def test_llama_ring_matches_dense(mesh):
     want = llama.apply(cfg_d, params, tokens)
     shardings = tree_logical_sharding(mesh, llama.logical_axes(cfg_r))
     sh_params = jax.device_put(params, shardings)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = jax.jit(lambda p, t: llama.apply(cfg_r, p, t))(sh_params, tokens)
     np.testing.assert_allclose(
         np.asarray(want), np.asarray(got), atol=3e-5

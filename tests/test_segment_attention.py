@@ -76,6 +76,7 @@ def test_train_step_with_segment_attention_descends():
     from service_account_auth_improvements_tpu.parallel import (
         MeshConfig,
         make_mesh,
+        use_mesh,
     )
     from service_account_auth_improvements_tpu.train import (
         init_train_state,
@@ -101,7 +102,7 @@ def test_train_step_with_segment_attention_descends():
     sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, sh)
     mask = jax.device_put(jnp.ones_like(toks), sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, toks, mask)
         for _ in range(15):
             state, m = step(state, toks, mask)

@@ -332,3 +332,121 @@ def test_validation_errors(server):
     })
     assert code == 400 and "max_seq_len" in out["error"]
     assert _req(base, "/nope", {})[0] == 404
+
+
+# ---------------------------------------------------- strict scalar types
+
+def test_int_fields_reject_bools_and_fractions(server):
+    """JSON booleans are not numbers (int(True) would silently sample
+    top_k=1) and fractional floats are not ints (int(2.5) would silently
+    run a different request than the client sent) — 400s, never
+    coercions."""
+    base, _ = server
+    cases = [
+        ({"prompt_ids": [[1, 2]], "top_k": True}, "boolean"),
+        ({"prompt_ids": [[1, 2]], "seed": False}, "boolean"),
+        ({"prompt_ids": [[1, 2]], "temperature": True}, "boolean"),
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": True}, "boolean"),
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": 2.5}, "integer"),
+        ({"prompt_ids": [[1, 2]], "eos_id": 1.5}, "integer"),
+        ({"prompt_ids": [[1, 2]], "top_k": 3.7}, "integer"),
+        # numeric strings are not numbers either (int("8") coerces)
+        ({"prompt_ids": [[1, 2]], "top_k": "8"}, "top_k"),
+        ({"prompt_ids": [[1, 2]], "max_new_tokens": "2"},
+         "max_new_tokens"),
+        ({"prompt_ids": [[1, 2]], "temperature": "0.5"}, "temperature"),
+    ]
+    for body, msg in cases:
+        code, out = _req(base, "/v1/completions", body)
+        assert code == 400 and msg in out["error"], (body, out)
+
+
+def test_effective_top_k_echoed(server):
+    """The server buckets top_k to the next power of two; the response
+    must echo the value actually used, not the one sent."""
+    base, _ = server
+    body = {"prompt_ids": [[1, 2, 3]], "max_new_tokens": 4,
+            "temperature": 0.7, "top_k": 10, "seed": 1}
+    code, out = _req(base, "/v1/completions", body)
+    assert code == 200
+    assert out["top_k"] == 16
+    # integral floats are fine for int fields (JSON "4.0")
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1, 2, 3]], "max_new_tokens": 4.0})
+    assert code == 200
+    assert out["top_k"] == 0  # greedy default: no top-k filter ran
+    assert len(out["completion_ids"][0]) == 4
+    # greedy + top_k: argmax ignores top_k entirely — echo 0, not 16
+    code, out = _req(base, "/v1/completions", {
+        "prompt_ids": [[1, 2, 3]], "max_new_tokens": 4, "top_k": 10})
+    assert code == 200 and out["top_k"] == 0
+
+
+def test_prompt_length_sweep_holds_executable_count():
+    """Prompt-length bucketing is default-on for ONE-SHOT completions
+    (not just SSE): with the fixed 512-token prefill window, arbitrary
+    prompt lengths in one cache bucket reuse the same executables —
+    the compiled-program count stays constant across a sweep."""
+    params = llama.init(CFG, jax.random.key(0))
+    svc = serving.GenerationService(CFG, params, name="tiny")
+    assert svc.prefill_window == serving.DEFAULT_PREFILL_WINDOW
+
+    def counts():
+        return (generate._prefill_window_jit._cache_size(),
+                generate._decode_chunk_jit._cache_size(),
+                generate._sample_jit._cache_size())
+
+    warm = svc.complete({"prompt_ids": [[7, 8, 9, 1]],
+                         "max_new_tokens": 6})
+    assert len(warm["completion_ids"][0]) == 6
+    before = counts()
+    outs = {}
+    for s in (3, 5, 9, 17, 33):
+        out = svc.complete({"prompt_ids": [list(range(1, s + 1))],
+                            "max_new_tokens": 6})
+        outs[s] = out["completion_ids"]
+        assert len(out["completion_ids"][0]) == 6
+    assert counts() == before, (
+        "client prompt lengths must not mint new executables"
+    )
+    # and the per-length prefill path never ran (it would have compiled)
+    assert all(len(v[0]) == 6 for v in outs.values())
+
+
+def test_bucketing_optout_still_serves():
+    """prefill_window=None restores per-length prefill (shape-bucketed
+    callers, benchmarks) — same tokens, greedy."""
+    params = llama.init(CFG, jax.random.key(0))
+    body = {"prompt_ids": [[5, 9, 2]], "max_new_tokens": 5}
+    bucketed = serving.GenerationService(CFG, params).complete(dict(body))
+    plain = serving.GenerationService(
+        CFG, params, prefill_window=None).complete(dict(body))
+    assert plain["completion_ids"] == bucketed["completion_ids"]
+
+
+def test_speculative_prompt_length_sweep_holds_executables():
+    """With a draft configured, prompt lengths in one window bucket must
+    also share the speculative executables (chunked prefill + bucketed
+    cache alloc) — a draft server is not an executable-minting hole."""
+    import dataclasses as dc
+
+    from service_account_auth_improvements_tpu.models import speculative
+
+    params = llama.init(CFG, jax.random.key(0))
+    dcfg = dc.replace(CFG, n_layers=1, dim=32, n_heads=2, n_kv_heads=2,
+                      head_dim=16, mlp_dim=64)
+    svc = serving.GenerationService(
+        CFG, params, draft=(dcfg, llama.init(dcfg, jax.random.key(9))),
+        gamma=3)
+    warm = svc.complete({"prompt_ids": [[1, 2, 3, 4]],
+                         "max_new_tokens": 5})
+    assert "speculative" in warm
+    before = (speculative._spec_round._cache_size(),
+              generate._prefill_window_jit._cache_size())
+    for s in (3, 7, 17, 33):
+        out = svc.complete({"prompt_ids": [list(range(1, s + 1))],
+                            "max_new_tokens": 5})
+        assert "speculative" in out
+        assert len(out["completion_ids"][0]) == 5
+    assert (speculative._spec_round._cache_size(),
+            generate._prefill_window_jit._cache_size()) == before

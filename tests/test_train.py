@@ -6,7 +6,7 @@ import jax
 import jax.numpy as jnp
 
 from service_account_auth_improvements_tpu.models import llama
-from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh, use_mesh
 from service_account_auth_improvements_tpu.train import (
     init_train_state,
     make_train_step,
@@ -25,7 +25,7 @@ def test_train_step_descends():
 
     tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, CFG.vocab_size)
     mask = jnp.ones_like(tokens)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, tokens, mask)
         for _ in range(5):
             state, m = step(state, tokens, mask)
@@ -67,7 +67,7 @@ def test_mixed_precision_state_descends():
     tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
                                 cfg.vocab_size)
     mask = jnp.ones_like(tokens)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, tokens, mask)
         for _ in range(5):
             state, m = step(state, tokens, mask)
@@ -95,7 +95,7 @@ def test_grad_accum_matches_single_pass():
         state = init_train_state(cfg, jax.random.key(0))
         state = jax.device_put(state, state_shardings(mesh, cfg, state))
         step = make_train_step(cfg, mesh=mesh, grad_accum=accum)
-        with jax.set_mesh(mesh):
+        with use_mesh(mesh):
             state, m = step(state, toks, mask)
         outs[accum] = (float(m["loss"]), state.params)
     assert abs(outs[1][0] - outs[2][0]) < 1e-5, (outs[1][0], outs[2][0])
@@ -114,7 +114,7 @@ def test_grad_accum_rejects_bad_batch():
     state = jax.device_put(state, state_shardings(mesh, cfg, state))
     step = make_train_step(cfg, mesh=mesh, grad_accum=3)
     toks = jnp.zeros((8, 32), jnp.int32)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         with pytest.raises(ValueError, match="not divisible by grad_accum"):
             step(state, toks, jnp.ones_like(toks))
 
@@ -161,7 +161,7 @@ def test_scheduled_optimizer_trains():
     sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, sh)
     mask = jax.device_put(jnp.ones_like(toks), sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, toks, mask)
         for _ in range(25):
             state, m = step(state, toks, mask)

@@ -11,7 +11,7 @@ import pytest
 
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.ops.attention import _dense_attention
-from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
+from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh, use_mesh
 from service_account_auth_improvements_tpu.parallel.ulysses import (
     ulysses_attention,
 )
@@ -40,7 +40,7 @@ def mesh():
 def test_ulysses_matches_dense(mesh, causal):
     q, k, v = _make_qkv()
     want = _dense_attention(q, k, v, q.shape[-1] ** -0.5, causal=causal)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = jax.jit(
             functools.partial(ulysses_attention, causal=causal)
         )(q, k, v)
@@ -61,7 +61,7 @@ def test_ulysses_grads_match_dense(mesh):
         ),
         argnums=(0, 1, 2),
     )(q, k, v)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         gu = jax.jit(
             jax.grad(
                 lambda q, k, v: loss(ulysses_attention, q, k, v),
@@ -84,7 +84,7 @@ def test_llama_ulysses_matches_dense(mesh):
     want = llama.apply(cfg_d, params, tokens)
     shardings = tree_logical_sharding(mesh, llama.logical_axes(cfg_u))
     sh_params = jax.device_put(params, shardings)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         got = jax.jit(lambda p, t: llama.apply(cfg_u, p, t))(sh_params, tokens)
     np.testing.assert_allclose(np.asarray(want), np.asarray(got), atol=3e-5)
 
@@ -94,7 +94,7 @@ def test_ulysses_rejects_indivisible_heads():
     not silently mis-shard."""
     mesh = make_mesh(MeshConfig(dp=1, fsdp=1, sp=4, tp=2))
     q, k, v = _make_qkv()
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         with pytest.raises(ValueError, match="divisible by sp"):
             jax.jit(ulysses_attention)(q, k, v)
 
@@ -123,7 +123,7 @@ def test_ulysses_trains_on_sp_mesh():
     batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), None))
     toks = jax.device_put(toks, batch_sh)
     mask = jax.device_put(jnp.ones_like(toks), batch_sh)
-    with jax.set_mesh(mesh):
+    with use_mesh(mesh):
         state, m0 = step(state, toks, mask)
         for _ in range(20):
             state, m = step(state, toks, mask)
