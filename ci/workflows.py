@@ -208,9 +208,13 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "assert d['schema'] == 'cpbench/v1' and d['ok'], d; "
                     "s = d['scenarios']; "
                     "assert set(s) == {'notebook_ready', 'gang_ready', "
-                    "'churn', 'profile_fanout', 'webhook_inject'}; "
+                    "'churn', 'profile_fanout', 'webhook_inject', "
+                    "'sched_contention'}; "
                     "[s[k]['phases_ms']['create_to_ready']['p99'] "
-                    "for k in s]\""},
+                    "for k in s]; "
+                    "sc = s['sched_contention']['extra']; "
+                    "assert sc['double_bookings'] == 0, sc; "
+                    "sc['time_to_placement_ms']['p99']\""},
             {"name": "Upload bench record",
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",

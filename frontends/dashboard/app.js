@@ -113,6 +113,25 @@
       el("span", { class: "muted" }, "loading…"));
     main.replaceChildren(quick, activitiesCard);
 
+    // tpusched admission queue: surfaced on the shell so "why isn't my
+    // notebook up" is answered before the user even opens the JWA
+    try {
+      const { queued } = await api("GET", `api/tpu-queue/${namespace}`);
+      if (queued && queued.length) {
+        const columns = [
+          { title: "Notebook", render: (q) => q.name },
+          { title: "Position", render: (q) =>
+              q.position ? `${q.position}/${q.of}` : "—" },
+          { title: "Reason", render: (q) => q.reason },
+          { title: "Detail", render: (q) => q.message },
+        ];
+        main.insertBefore(el("div", { class: "card" },
+          el("h3", { style: "margin-top:0" },
+            `TPU queue in ${namespace}`),
+          resourceTable(columns, queued, "")), activitiesCard);
+      }
+    } catch (e) { /* queue view is best-effort; activities still render */ }
+
     try {
       const { activities } = await api("GET",
         `api/activities/${namespace}`);

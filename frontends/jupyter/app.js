@@ -48,8 +48,16 @@
         throw e;
       }
       const columns = [
-        { title: "Status", render: (nb) =>
-            statusIcon(nb.status.phase, nb.status.message) },
+        { title: "Status", render: (nb) => {
+            const icon = statusIcon(nb.status.phase, nb.status.message);
+            if (nb.queue && nb.queue.position) {
+              // tpusched parking: show where the notebook stands instead
+              // of an unexplained Pending (reason lives in the tooltip)
+              icon.appendChild(document.createTextNode(
+                ` (queued ${nb.queue.position}/${nb.queue.of})`));
+            }
+            return icon;
+          } },
         { title: "Name", render: (nb) => nb.name },
         { title: "Type", render: (nb) => nb.serverType || "jupyter" },
         { title: "Image", render: (nb) => nb.shortImage },

@@ -2,7 +2,9 @@
 
 Process shape mirrors the reference manager startup (components/
 notebook-controller/main.go:57-146). Culling is an opt-in side reconciler
-(ENABLE_CULLING — reference main.go:110).
+(ENABLE_CULLING — reference main.go:110); tpusched (ENABLE_SCHEDULER,
+docs/scheduler.md) runs in the same manager so placement shares the
+notebook informer, with preemption behind its own ENABLE_PREEMPTION flag.
 """
 
 from __future__ import annotations
@@ -17,6 +19,10 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
     NotebookMetrics,
     NotebookReconciler,
 )
+from service_account_auth_improvements_tpu.controlplane.scheduler import (
+    SchedulerMetrics,
+    SchedulerReconciler,
+)
 from service_account_auth_improvements_tpu.utils.env import get_env_bool
 
 
@@ -25,6 +31,10 @@ def _register(client, manager, args):
     NotebookReconciler(client, metrics).register(manager)
     if get_env_bool("ENABLE_CULLING", False):
         CullingReconciler(client, metrics).register(manager)
+    if get_env_bool("ENABLE_SCHEDULER", False):
+        # metrics on the global REGISTRY so the ops endpoint exports the
+        # queue depth / time-to-placement / preemption series
+        SchedulerReconciler(client, SchedulerMetrics()).register(manager)
 
 
 def main(argv=None) -> int:

@@ -24,7 +24,11 @@ TPU additions (proposals/20260729-tpu-aware-culling.md):
   indefinitely. Here consecutive probe failures are counted in an
   annotation; after CULL_UNREACHABLE_LIMIT failures *with the rank-0 pod
   not Ready* the notebook is stopped. A Ready pod is never culled blind —
-  it may simply not be serving the Jupyter kernels API.
+  it may simply not be serving the Jupyter kernels API;
+- tpusched interop: a notebook parked in the admission queue
+  (``Scheduled=False`` — controlplane/scheduler) is skipped entirely. It
+  has no kernels and looks idle, but it holds no chips, and stamping the
+  stop annotation would silently drop it out of the queue it waits in.
 
 Env knobs (reference :30-40, :405): CULL_IDLE_TIME (minutes, default 1440),
 IDLENESS_CHECK_PERIOD (minutes, default 1), CLUSTER_DOMAIN, DEV,
@@ -134,6 +138,13 @@ class CullingReconciler(Reconciler):
             return Result()  # already stopped; resume clears and re-enqueues
         if annots.get(CULLING_POLICY) in ("training", "disabled"):
             return Result(requeue_after=period.total_seconds())
+        if self._is_queued(nb):
+            # Parked by tpusched (Scheduled=False): the notebook has no
+            # pods, no kernels, and looks maximally idle — but it holds
+            # ZERO chips and is waiting in a queue. Culling it would stamp
+            # the stop annotation and silently drop it out of the very
+            # queue it is waiting in. Skip until it schedules.
+            return Result(requeue_after=period.total_seconds())
 
         now = self.now()
         kernels = self.fetch_kernels(
@@ -220,6 +231,23 @@ class CullingReconciler(Reconciler):
         self.kube.patch("notebooks", req.name, patch,
                         namespace=req.namespace, group=GROUP)
         return Result(requeue_after=period.total_seconds())
+
+    @staticmethod
+    def _is_queued(nb: dict) -> bool:
+        """Parked in the tpusched admission queue: Scheduled=False AND no
+        sign of pods. The readyReplicas / containerState guards keep a
+        STALE condition (scheduler disabled after parking) from exempting
+        a chip-holding notebook from culling forever — a crash-looping
+        rank-0 pod sets containerState even at zero readyReplicas, so the
+        unreachable-reclaim path still bounds it."""
+        status = nb.get("status") or {}
+        if (status.get("readyReplicas") or 0) > 0 or \
+                status.get("containerState"):
+            return False
+        for cond in status.get("conditions") or []:
+            if cond.get("type") == "Scheduled":
+                return cond.get("status") == "False"
+        return False
 
     @staticmethod
     def _any_busy(kernels) -> bool:

@@ -20,6 +20,7 @@ components/notebook-controller/controllers/notebook_controller.go:89-225):
 from __future__ import annotations
 
 import copy
+import dataclasses
 import datetime
 import json
 import logging
@@ -123,6 +124,12 @@ class NotebookReconciler(Reconciler):
         )
         self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
         self.add_fsgroup = get_env_bool("ADD_FSGROUP", True)
+        # tpusched hand-off (controlplane/scheduler): when enabled, a
+        # single-slice TPU notebook gets NO children until the scheduler
+        # stamps its node-pool annotation — admission happens before pods,
+        # not after (a gang born poolless would bind wherever and then
+        # fight the one-pool-one-slice verification).
+        self.use_scheduler = get_env_bool("ENABLE_SCHEDULER", False)
         # Re-emission work queue: the events-informer watch thread only
         # enqueues; API round-trips happen on a dedicated worker so a busy
         # cluster can't head-of-line-block event delivery (the reference
@@ -294,6 +301,37 @@ class NotebookReconciler(Reconciler):
             except errors.ApiError:
                 pass
             return Result()
+
+        if resolved and not resolved.multi_slice:
+            # Fold tpusched's placement into the resolved selector — the
+            # same shape as an explicit spec.tpu.nodePool pin, so the gang
+            # controller verifies the scheduler's choice against the
+            # bound nodes with zero extra machinery.
+            assigned_pool = (nb["metadata"].get("annotations") or {}).get(
+                tpu.ANNOTATION_NODEPOOL
+            )
+            if assigned_pool and assigned_pool != resolved.node_pool:
+                # The stamped placement WINS over a live spec.tpu.nodePool
+                # edit: placement is sticky until stop/resume (tpusched
+                # clears the annotation on stop, and re-admission honors
+                # the new pin). Rolling pods onto an edited pin while the
+                # scheduler's booking points at the stamped pool would
+                # split selector from inventory — double-booking by
+                # divergence.
+                resolved = dataclasses.replace(
+                    resolved, node_pool=assigned_pool
+                )
+            if self.use_scheduler and not assigned_pool \
+                    and not self._stopped(nb):
+                # Unplaced and not stopping: park until tpusched stamps a
+                # pool (its Scheduled=False condition tells the user
+                # why). This holds for spec.tpu.nodePool pins too — a pin
+                # picks the pool but must still pass admission (quota),
+                # or one spec field would bypass the whole queue. A
+                # stopped notebook falls through so scale-to-zero still
+                # runs — preemption/culling must release chips even when
+                # the placement annotation is already cleared.
+                return Result()
 
         num_slices = resolved.num_slices if resolved else 1
         slice_names = [
@@ -866,8 +904,11 @@ class NotebookReconciler(Reconciler):
             nb["status"] = status
             try:
                 self.kube.update_status("notebooks", nb, group=GROUP)
-            except errors.Conflict:
-                pass  # next event re-levels
+            except (errors.Conflict, errors.NotFound):
+                # Conflict: next event re-levels. NotFound: the CR was
+                # deleted mid-reconcile (queue-drain deletes race the
+                # status write) — backing off to retry a corpse is noise.
+                pass
 
     def _main_container_name(self, nb: dict) -> str:
         containers = (

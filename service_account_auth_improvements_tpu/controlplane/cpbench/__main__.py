@@ -39,6 +39,7 @@ SMOKE_N = {
     "churn": 16,              # per run, split over cycles
     "profile_fanout": 24,
     "webhook_inject": 200,
+    "sched_contention": 12,   # 12 gangs contending for 4 slice pools
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -46,6 +47,7 @@ FULL_N = {
     "churn": 100,
     "profile_fanout": 120,
     "webhook_inject": 1000,
+    "sched_contention": 48,   # 12 drain waves over the 4 pools
 }
 
 

@@ -180,10 +180,27 @@ class FakeKubelet:
         ns, name = meta.get("namespace"), meta["name"]
         replicas = int((sts.get("spec") or {}).get("replicas") or 0)
         template = (sts.get("spec") or {}).get("template") or {}
+        want_sel = ((template.get("spec") or {}).get("nodeSelector")
+                    or {})
         for i in range(replicas):
             pod_name = f"{name}-{i}"
-            if self._pod_inf.get(ns, pod_name) is not None:
-                continue
+            existing = self._pod_inf.get(ns, pod_name)
+            if existing is not None:
+                have_sel = ((existing.get("spec") or {}).get(
+                    "nodeSelector") or {})
+                if have_sel == want_sel:
+                    continue
+                # rolling update on placement change: a real STS
+                # controller replaces pods whose template changed —
+                # without this, a notebook re-placed onto a different
+                # pool (preempt → resume → new placement, reconciles
+                # coalesced so the scale-to-zero never ran) keeps its
+                # old-pool pods and the gang wedges on
+                # SlicePlacementConflict forever
+                try:
+                    self.kube.delete("pods", pod_name, namespace=ns)
+                except errors.NotFound:
+                    pass
             try:
                 self.kube.create("pods", self._pod_from_template(
                     sts, template, pod_name, i))
@@ -274,7 +291,10 @@ class FakeKubelet:
         ns, name, uid = meta.get("namespace"), meta["name"], meta["uid"]
         if not spec.get("nodeName"):
             try:
-                self._bind(pod)
+                if not self._bind(pod):
+                    # unbindable (pinned pool has no nodes): the pod
+                    # stays Pending — it must never flip Ready unbound
+                    return
             except errors.NotFound:
                 return  # deleted mid-flight (churn)
         with self._lock:
@@ -288,14 +308,39 @@ class FakeKubelet:
         self._flipper.call_later(delay, lambda: self._flip_ready(ns, name,
                                                                  uid))
 
-    def _bind(self, pod: dict) -> None:
-        """Assign a node from the pod's STS pool (one pool per STS, one
-        node per ordinal — pool-consistent within a slice by
-        construction, never shared across slices)."""
+    def _bind(self, pod: dict) -> bool:
+        """Assign a node; False when the pod is unbindable (it must stay
+        Pending and NOT be flipped Ready). A pod whose nodeSelector names
+        a pool (user pin or a tpusched placement) binds into that pool's
+        EXISTING nodes, one host per ordinal — the placement
+        kube-scheduler would make. Otherwise every STS gets its own
+        synthetic pool (one node per ordinal) so a multi-host gang lands
+        pool-consistent by construction."""
         meta = pod["metadata"]
         ns, name = meta.get("namespace"), meta["name"]
-        sts = (meta.get("labels") or {}).get("statefulset") or "solo"
         ordinal = name.rsplit("-", 1)[-1]
+        want_pool = ((pod.get("spec") or {}).get("nodeSelector") or {}).get(
+            SEL_NODEPOOL
+        )
+        if want_pool:
+            nodes = sorted(
+                n["metadata"]["name"]
+                for n in self.kube.list(
+                    "nodes",
+                    label_selector=f"{SEL_NODEPOOL}={want_pool}")["items"]
+            )
+            if not nodes:
+                # pinned pool has no nodes: stay Pending, like the real
+                # scheduler would leave an unsatisfiable nodeSelector
+                return False
+            idx = int(ordinal) if ordinal.isdigit() else 0
+            self.kube.patch(
+                "pods", name,
+                {"spec": {"nodeName": nodes[idx % len(nodes)]}},
+                namespace=ns,
+            )
+            return True
+        sts = (meta.get("labels") or {}).get("statefulset") or "solo"
         pool = f"{ns}-{sts}"
         node_name = f"node-{pool}-{ordinal}"
         try:
@@ -307,6 +352,7 @@ class FakeKubelet:
             pass
         self.kube.patch("pods", name, {"spec": {"nodeName": node_name}},
                         namespace=ns)
+        return True
 
     def _flip_ready(self, ns: str, name: str, uid: str) -> None:
         try:

@@ -22,6 +22,12 @@ status the user would ``kubectl wait`` on.
 ``webhook_inject``  PodDefault admission latency through the production
                     merge engine (webhook/engine.py) with the
                     PodDefault list served by the apiserver per review.
+``sched_contention`` N 4x4 gangs vs 4 one-slice pools through tpusched:
+                    admission queue, priority preemption (every 5th
+                    notebook is priority 100), placement as capacity
+                    frees. Reports time-to-placement p50/p95/p99,
+                    preemption count, and double-booking violations
+                    (must be 0).
 =================  =====================================================
 """
 
@@ -64,6 +70,11 @@ from service_account_auth_improvements_tpu.controlplane.kube import (
     FakeKube,
     errors,
 )
+from service_account_auth_improvements_tpu.controlplane.scheduler import (
+    PRIORITY_ANNOTATION,
+    SchedulerReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane import tpu as tpu_mod
 from service_account_auth_improvements_tpu.webhook.server import (
     review_response,
 )
@@ -111,7 +122,7 @@ class _NotebookWorld:
     FakeKubelet + a ready-watch, instrumented for one scenario."""
 
     def __init__(self, cfg: BenchConfig, scenario: str,
-                 fetch_kernels=None):
+                 fetch_kernels=None, scheduler: bool = False):
         self.kube = FakeKube()
         self.tracker = Tracker(scenario)
         self.tracker.instrument_kube(self.kube)
@@ -119,6 +130,15 @@ class _NotebookWorld:
         self.reconciler = NotebookReconciler(self.kube)
         self.tracker.instrument_reconciler(self.reconciler)
         self.reconciler.register(self.mgr)
+        self.sched = None
+        if scheduler:
+            # tpusched owns admission: the notebook controller creates no
+            # children until placement stamps the node-pool annotation
+            self.reconciler.use_scheduler = True
+            self.sched = SchedulerReconciler(self.kube,
+                                             enable_preemption=True)
+            self.tracker.instrument_reconciler(self.sched)
+            self.sched.register(self.mgr)
         self.culler = None
         if fetch_kernels is not None:
             self.culler = CullingReconciler(
@@ -495,12 +515,160 @@ def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
     )
 
 
+SCHED_POOLS = 4
+
+
+def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
+    """N pending v5e 4x4 gangs vs SCHED_POOLS one-slice pools, through
+    the full tpusched pipeline: admission queue (every 5th notebook is
+    priority 100 and may preempt), placement stamping the node-pool
+    selector, gang gating on the assigned pool, Ready, delete — freeing
+    the slice for the next in line. The scenario deletes each notebook
+    once Ready and resumes preempted victims once their placement is
+    cleared, so the queue drains to the last notebook.
+
+    Reported: time-to-placement percentiles (create → node-pool
+    annotation), preemption count, and double-booking violations — the
+    number of poll ticks that ever saw two live notebooks share a pool
+    (must be 0: a multi-host pool is one slice)."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "sched_contention", scheduler=True)
+    ns = "bench"
+    # 4 one-slice v5e 4x4 pools: 4 hosts x 4 chips each
+    for p in range(SCHED_POOLS):
+        for h in range(4):
+            world.kube.create("nodes", {
+                "metadata": {
+                    "name": f"node-sp{p}-{h}",
+                    "labels": {
+                        tpu_mod.SEL_NODEPOOL: f"sched-pool-{p}",
+                        tpu_mod.SEL_ACCELERATOR: "tpu-v5-lite-podslice",
+                        tpu_mod.SEL_TOPOLOGY: "4x4",
+                    },
+                },
+                "status": {"capacity": {tpu_mod.RESOURCE_TPU: "4"}},
+            })
+    placement_ms: dict[str, float] = {}
+    placement_lock = threading.Lock()
+
+    def on_placement(ev_type: str, nb: dict) -> None:
+        if ev_type in ("DELETED", "SYNC"):
+            return
+        name = nb["metadata"]["name"]
+        if (nb["metadata"].get("annotations") or {}).get(
+                tpu_mod.ANNOTATION_NODEPOOL) is None:
+            return
+        rec = world.tracker.record(ns, name)
+        if rec is None or rec.created is None:
+            return
+        with placement_lock:
+            placement_ms.setdefault(
+                name, (time.monotonic() - rec.created) * 1000.0
+            )
+
+    world._ready_inf.add_handler(on_placement)
+    world.start()
+    names = [f"cont-{i:03d}" for i in range(cfg.n)]
+
+    def job(i, name):
+        def run():
+            world.tracker.expect(ns, name)
+            world._want[(ns, name)] = 4
+            nb = _nb(name, ns, {"generation": "v5e", "topology": "4x4"})
+            if i % 5 == 4:
+                nb["metadata"]["annotations"] = {
+                    PRIORITY_ANNOTATION: "100",
+                }
+            world.kube.create("notebooks", nb)
+        return run
+
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        [job(i, n) for i, n in enumerate(names)]
+    )
+
+    deleted: set[str] = set()
+    double_bookings = 0
+    queued_peak = 0
+    deadline = time.monotonic() + cfg.timeout
+    while len(deleted) < len(names) and time.monotonic() < deadline:
+        queued_peak = max(queued_peak, len(world.sched._queue))
+        # One LIST is an ATOMIC snapshot (the fake apiserver lists under
+        # its lock): per-name GETs would read an inconsistent cut — the
+        # scheduler can release a victim's pool and stamp its successor
+        # between two reads of the same tick, and a torn snapshot would
+        # blame the legitimate hand-off as a double booking.
+        snapshot = {
+            o["metadata"]["name"]: o
+            for o in world.kube.list("notebooks", namespace=ns)["items"]
+        }
+        live_pools: dict[str, list[str]] = {}
+        to_delete: list[str] = []
+        to_resume: list[str] = []
+        for name in names:
+            if name in deleted:
+                continue
+            nb = snapshot.get(name)
+            if nb is None:
+                continue  # delete still cascading, or not created yet
+            annots = nb["metadata"].get("annotations") or {}
+            pool = annots.get(tpu_mod.ANNOTATION_NODEPOOL)
+            if pool:
+                live_pools.setdefault(pool, []).append(name)
+            rec = world.tracker.record(ns, name)
+            if rec is not None and rec.ready is not None:
+                to_delete.append(name)
+            elif STOP_ANNOTATION in annots and pool is None:
+                # preempted victim, placement already released: resume it
+                # so it re-queues (at its old priority) and drains too
+                to_resume.append(name)
+        double_bookings += sum(
+            1 for members in live_pools.values() if len(members) > 1
+        )
+        for name in to_delete:
+            try:
+                world.kube.delete("notebooks", name, namespace=ns,
+                                  group=GROUP)
+            except errors.NotFound:
+                pass
+            deleted.add(name)
+        for name in to_resume:
+            try:
+                world.kube.patch(
+                    "notebooks", name,
+                    {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+                    namespace=ns, group=GROUP,
+                )
+            except errors.NotFound:
+                pass
+        time.sleep(0.02)
+    ok = len(deleted) == len(names) and double_bookings == 0
+    world.stop()
+    summary = world.tracker.summary()
+    summary["extra"] = {
+        "pools": SCHED_POOLS,
+        "time_to_placement_ms": percentiles(list(placement_ms.values())),
+        "placed": len(placement_ms),
+        "preemptions": int(world.sched.metrics.preemptions.value()),
+        "double_bookings": double_bookings,
+        "queued_peak": queued_peak,  # sampled, not derived: rate-paced
+                                     # arrivals can drain before peaking
+        "gate_violations": world.actuator.gate_violations,
+        "pods_created": world.actuator.pods_created,
+    }
+    return ScenarioResult(
+        name="sched_contention", elapsed_s=time.monotonic() - started,
+        records=world.tracker.records(), summary=summary,
+        ok=ok and summary["failed"] == 0 and len(placement_ms) == cfg.n,
+    )
+
+
 SCENARIOS = {
     "notebook_ready": scenario_notebook_ready,
     "gang_ready": scenario_gang_ready,
     "churn": scenario_churn,
     "profile_fanout": scenario_profile_fanout,
     "webhook_inject": scenario_webhook_inject,
+    "sched_contention": scenario_sched_contention,
 }
 
 

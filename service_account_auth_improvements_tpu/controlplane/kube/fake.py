@@ -195,6 +195,18 @@ class FakeKube:
                 )
             obj.setdefault("apiVersion", res.api_version)
             obj.setdefault("kind", res.kind)
+            if res.kind == "Node":
+                # kubelet semantics: a registering node reports capacity
+                # and the apiserver view carries allocatable (capacity
+                # minus reserves; the fake reserves nothing). Consumers —
+                # tpusched's inventory reads
+                # status.allocatable["google.com/tpu"] — must see
+                # allocatable even when a test only staged capacity.
+                status = obj.setdefault("status", {})
+                status.setdefault("capacity", {})
+                status.setdefault(
+                    "allocatable", copy.deepcopy(status["capacity"])
+                )
             meta["uid"] = str(uuid.uuid4())
             meta["creationTimestamp"] = _now()
             meta["resourceVersion"] = str(self._bump())

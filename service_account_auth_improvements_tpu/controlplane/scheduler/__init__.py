@@ -1,0 +1,37 @@
+"""tpusched: slice-granular TPU capacity scheduler (docs/scheduler.md).
+
+The control plane's answer to a *full cluster*: a live pool inventory
+from Node watches (``inventory``), best-fit placement at Notebook
+admission (``placement``), a priority admission queue with user-visible
+``Scheduled=False`` parking (``queue``), and opt-in priority preemption
+through the cull path (``preemption``) — wired into the Manager/informer
+stack by ``reconciler``.
+"""
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: F401,E501
+    Assignment,
+    SlicePool,
+    pools_from_nodes,
+    used_chips,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: F401,E501
+    Demand,
+    best_fit,
+    demand_from,
+    feasible,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.preemption import (  # noqa: F401,E501
+    choose_victim,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.queue import (  # noqa: F401,E501
+    AdmissionQueue,
+    QueueEntry,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.reconciler import (  # noqa: F401,E501
+    CONDITION_SCHEDULED,
+    PREEMPTED_BY_ANNOTATION,
+    PRIORITY_ANNOTATION,
+    QUOTA_KEY,
+    SchedulerMetrics,
+    SchedulerReconciler,
+)

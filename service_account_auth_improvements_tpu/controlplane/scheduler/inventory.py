@@ -1,0 +1,113 @@
+"""Slice-pool inventory: the scheduler's live model of cluster capacity.
+
+GKE exposes TPU capacity as *node pools*: every node of a pool carries the
+same ``cloud.google.com/gke-nodepool`` + accelerator/topology labels, and a
+multi-host pool's nodes together form exactly one slice (the invariant the
+gang controller verifies after binding — controllers/notebook.py
+one-pool-one-slice). The inventory inverts the Node list into that pool
+view, typed by generation/topology via ``tpu.GENERATIONS``, with chip
+capacity read from ``status.allocatable["google.com/tpu"]``.
+
+Used chips come from *assignments* — the scheduler's record of which
+Notebook occupies which pool. Assignments are durable on the CR (the
+``tpukf.dev/node-pool`` annotation), so the in-memory book is a cache that
+any restart rebuilds from a list of Notebooks; nothing here is
+checkpoint-unsafe state.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+
+#: reverse map: GKE accelerator label value -> generation key
+GENERATION_BY_SELECTOR = {
+    info["selector"]: gen for gen, info in tpu.GENERATIONS.items()
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class SlicePool:
+    """One GKE TPU node pool. ``num_hosts > 1`` means the pool IS one
+    multi-host slice; ``num_hosts == 1`` pools pack independent
+    single-host slices up to their chip capacity."""
+
+    name: str
+    generation: str
+    topology: str
+    num_hosts: int
+    chips_per_host: int
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_hosts * self.chips_per_host
+
+    @property
+    def slice_class(self) -> str:
+        return f"{self.generation}:{self.topology}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Assignment:
+    """A Notebook's claim on a pool's chips (mirrors the CR annotation)."""
+
+    namespace: str
+    name: str
+    pool: str
+    chips: int
+    priority: int
+    seq: int  # admission order; tie-break for preemption victims
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.namespace, self.name)
+
+
+def pools_from_nodes(nodes: list[dict]) -> dict[str, SlicePool]:
+    """Group Nodes into typed slice pools.
+
+    Nodes without the full TPU label set (pool + accelerator + topology)
+    or without ``google.com/tpu`` allocatable are not TPU capacity and are
+    skipped; a pool whose nodes disagree on type (mislabeled) is dropped
+    whole rather than half-trusted.
+    """
+    members: dict[str, list[tuple[str, str, int]]] = {}
+    for node in nodes:
+        labels = (node.get("metadata") or {}).get("labels") or {}
+        pool = labels.get(tpu.SEL_NODEPOOL)
+        accel = labels.get(tpu.SEL_ACCELERATOR)
+        topology = labels.get(tpu.SEL_TOPOLOGY)
+        gen = GENERATION_BY_SELECTOR.get(accel or "")
+        if not pool or not topology or gen is None:
+            continue
+        alloc = ((node.get("status") or {}).get("allocatable") or {})
+        try:
+            chips = int(alloc.get(tpu.RESOURCE_TPU, 0) or 0)
+        except (TypeError, ValueError):
+            chips = 0
+        if chips <= 0:
+            continue
+        members.setdefault(pool, []).append((gen, topology, chips))
+    pools: dict[str, SlicePool] = {}
+    for name, nodes_of in members.items():
+        types = {(g, t, c) for g, t, c in nodes_of}
+        if len(types) != 1:
+            continue  # mislabeled pool: not schedulable capacity
+        gen, topology, chips = next(iter(types))
+        pools[name] = SlicePool(
+            name=name, generation=gen, topology=topology,
+            num_hosts=len(nodes_of), chips_per_host=chips,
+        )
+    return pools
+
+
+def used_chips(assignments, pools: dict[str, SlicePool]) -> dict[str, int]:
+    """Chips charged per pool by current assignments. Assignments to pools
+    that no longer exist (node pool deleted under a running notebook) are
+    kept out of the map — they hold no real capacity."""
+    used = {name: 0 for name in pools}
+    for a in assignments:
+        if a.pool in used:
+            used[a.pool] += a.chips
+    return used

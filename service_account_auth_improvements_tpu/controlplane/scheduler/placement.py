@@ -1,0 +1,70 @@
+"""Placement: feasibility and best-fit choice over slice pools.
+
+Feasibility is shape-first (generation + topology must match — GKE creates
+a pool per slice shape, and a 4x4 notebook on a 2x2 pool is not a tighter
+fit, it is wrong), then capacity:
+
+- multi-host demand: the pool must carry at least ``num_hosts`` hosts and
+  be COMPLETELY free — a multi-host pool is one slice, and the gang
+  controller refuses pools hosting two gangs (controllers/notebook.py
+  one-pool-one-slice), so the scheduler never creates that state.
+- single-host demand: the pool needs enough free chips and a per-host chip
+  count that fits the slice on one node.
+
+Best-fit minimizes leftover free chips after placement (tightest pool
+first) so large free pools stay whole for large slices; ties break on the
+pool name for determinism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: E501
+    SlicePool,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Demand:
+    """What one Notebook asks of a pool (from its resolved TpuSpec)."""
+
+    generation: str
+    topology: str
+    total_chips: int
+    num_hosts: int
+
+    @property
+    def slice_class(self) -> str:
+        return f"{self.generation}:{self.topology}"
+
+
+def demand_from(resolved) -> Demand:
+    return Demand(
+        generation=resolved.generation, topology=resolved.topology,
+        total_chips=resolved.total_chips, num_hosts=resolved.num_hosts,
+    )
+
+
+def feasible(pool: SlicePool, used: int, demand: Demand) -> bool:
+    if (pool.generation, pool.topology) != (demand.generation,
+                                            demand.topology):
+        return False
+    if demand.num_hosts > 1:
+        return pool.num_hosts >= demand.num_hosts and used == 0
+    return (pool.total_chips - used >= demand.total_chips
+            and pool.chips_per_host >= demand.total_chips)
+
+
+def best_fit(pools: dict[str, SlicePool], used: dict[str, int],
+             demand: Demand) -> str | None:
+    """Name of the feasible pool with the least leftover capacity after
+    placement, or None when nothing fits."""
+    best: tuple[int, str] | None = None
+    for name, pool in pools.items():
+        if not feasible(pool, used.get(name, 0), demand):
+            continue
+        leftover = pool.total_chips - used.get(name, 0) - demand.total_chips
+        if best is None or (leftover, name) < best:
+            best = (leftover, name)
+    return best[1] if best else None

@@ -1,0 +1,49 @@
+"""Priority preemption: who yields when a higher-priority notebook waits.
+
+Opt-in (ENABLE_PREEMPTION): a queued notebook may evict a strictly
+lower-priority *running* (assigned) notebook whose release makes some pool
+feasible for the waiter. The victim choice is conservative:
+
+- only assignments whose single release unblocks the demand are candidates
+  (no cascading multi-victim evictions — freeing two half-pools for one
+  slice is a bin-packing move the ROADMAP defers);
+- among candidates, the LOWEST priority yields; ties evict the YOUNGEST
+  assignment (latest admitted loses first, the standard kube-scheduler
+  tie-break that keeps long-running work stable).
+
+Eviction itself is not here: the reconciler routes it through the normal
+cull path (the stop annotation), so the victim's teardown — STS to zero,
+gang pods deleted, chips released — is the same checkpoint-safe flow a
+culled notebook takes, and a mid-eviction controller restart recovers from
+the CRs alone.
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: E501
+    Assignment,
+    SlicePool,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: E501
+    Demand,
+    feasible,
+)
+
+
+def choose_victim(assignments: list[Assignment],
+                  pools: dict[str, SlicePool], used: dict[str, int],
+                  demand: Demand, priority: int) -> Assignment | None:
+    """The assignment to evict so ``demand`` (at ``priority``) can place,
+    or None when no single lower-priority eviction unblocks it."""
+    candidates = []
+    for a in assignments:
+        if a.priority >= priority:
+            continue
+        pool = pools.get(a.pool)
+        if pool is None:
+            continue
+        if feasible(pool, used.get(a.pool, 0) - a.chips, demand):
+            candidates.append(a)
+    if not candidates:
+        return None
+    return min(candidates, key=lambda a: (a.priority, -a.seq))

@@ -1,0 +1,695 @@
+"""tpusched: the TPU slice capacity scheduler reconciler.
+
+Sits between profiles/quotas and gang gating (ROADMAP: "serve heavy
+traffic from millions of users" needs an answer to *full cluster*, not
+just *valid spec*): the notebook controller resolves a ``TpuSpec`` and
+verifies a node-pool pin after binding, but nothing chose the pool,
+queued the notebook when every slice was busy, or decided who yields
+under contention — a notebook on a full cluster sat Pending forever.
+
+Per Notebook reconcile:
+
+- **admission**: an unassigned TPU notebook enters the admission queue
+  (priority from the ``tpukf.dev/priority`` annotation on the Notebook or
+  its Profile; default 0 — plain FIFO);
+- **placement pass** (``_run_queue``, serialized under one lock so two
+  workers can never double-book a slice): walk the queue in priority/FIFO
+  order, charge chips against the Profile's
+  ``requests.google.com/tpu`` budget at admission time, best-fit over
+  feasible pools, stamp the winner as the ``tpukf.dev/node-pool``
+  annotation — the same ``SEL_NODEPOOL`` selector the gang controller
+  already verifies against bound nodes;
+- **parking**: notebooks that don't fit carry a
+  ``Scheduled=False/Unschedulable`` (or ``QuotaExceeded``) condition with
+  their queue position, re-evaluated on node add, notebook delete, stop
+  (culling), and resume;
+- **preemption** (opt-in ENABLE_PREEMPTION): a higher-priority queued
+  notebook evicts the lowest-priority running notebook whose slice frees
+  enough chips — routed through the normal cull path (stop annotation) so
+  teardown and chip release are checkpoint-safe.
+
+Assignments are durable on the CR; the in-memory book is rebuilt from the
+Notebook list at startup (``setup``) or lazily per reconcile, so a
+scheduler restart never forgets who owns which slice.
+
+Multi-slice (DCN) notebooks bypass tpusched — one ``nodePool`` selector
+cannot express N pools; bin-packing across multi-slice is a ROADMAP
+follow-up.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.controlplane.controllers import (
+    helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+    STOP_ANNOTATION,
+    _utcnow,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Reconciler,
+    Request,
+    Result,
+)
+from service_account_auth_improvements_tpu.controlplane.events import (
+    WARNING,
+    EventRecorder,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: E501
+    Assignment,
+    pools_from_nodes,
+    used_chips,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: E501
+    best_fit,
+    demand_from,
+    feasible,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.preemption import (  # noqa: E501
+    choose_victim,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.queue import (
+    AdmissionQueue,
+)
+from service_account_auth_improvements_tpu.utils.env import get_env_bool
+
+log = logging.getLogger(__name__)
+
+PRIORITY_ANNOTATION = "tpukf.dev/priority"
+PREEMPTED_BY_ANNOTATION = "tpukf.dev/preempted-by"
+CONDITION_SCHEDULED = "Scheduled"
+#: ResourceQuota-style key the Profile's resourceQuotaSpec budgets chips
+#: under; tpusched charges it at ADMISSION, namespace ResourceQuota only
+#: rejects at pod-create time (too late: the STS would flap).
+QUOTA_KEY = "requests." + tpu.RESOURCE_TPU
+
+
+class SchedulerMetrics:
+    def __init__(self, registry: Registry | None = None):
+        self.queue_depth = Gauge(
+            "tpusched_queue_depth",
+            "Notebooks waiting for capacity, per slice class",
+            ("slice_class",), registry=registry,
+        )
+        self.time_to_placement = Histogram(
+            "tpusched_time_to_placement_seconds",
+            "Admission-to-placement latency", registry=registry,
+        )
+        self.placements = Counter(
+            "tpusched_placements_total", "Placement decisions", ("pool",),
+            registry=registry,
+        )
+        self.preemptions = Counter(
+            "tpusched_preemptions_total",
+            "Running notebooks evicted for higher-priority queued ones",
+            registry=registry,
+        )
+
+
+class SchedulerReconciler(Reconciler):
+    resource = "notebooks"
+    group = GROUP
+
+    def __init__(self, kube, metrics: SchedulerMetrics | None = None,
+                 enable_preemption: bool | None = None):
+        self.kube = kube
+        self.metrics = metrics or SchedulerMetrics(Registry())
+        self.recorder = EventRecorder(kube, "tpusched")
+        self.enable_preemption = (
+            enable_preemption if enable_preemption is not None
+            else get_env_bool("ENABLE_PREEMPTION", False)
+        )
+        self._lock = threading.RLock()
+        self._queue = AdmissionQueue()
+        self._assigned: dict[tuple[str, str], Assignment] = {}
+        self._assign_seq = 0
+        self._evicting: set[tuple[str, str]] = set()
+        self._seen_classes: set[str] = set()
+        self._node_informer = None
+        self._nb_informer = None
+        self._profile_informer = None
+
+    # ------------------------------------------------------------ wiring
+
+    def register(self, manager) -> "SchedulerReconciler":
+        ctl = manager.add_reconciler(self)
+        # capacity events: a new/removed node re-evaluates the queue;
+        # profile events too — a raised quota or changed priority class
+        # must unpark waiters without any notebook/node event happening
+        manager.watch_mapped(ctl, "nodes", self._map_capacity_event)
+        manager.watch_mapped(ctl, "profiles", self._map_capacity_event,
+                             group=GROUP)
+        self._node_informer = manager.informer("nodes")
+        self._nb_informer = manager.informer("notebooks", group=GROUP)
+        self._profile_informer = manager.informer("profiles", group=GROUP)
+        return self
+
+    def _map_capacity_event(self, ev_type, obj):
+        if ev_type == "SYNC":
+            return []
+        with self._lock:
+            head = self._queue.ordered()[:1]
+        # one request suffices: any reconcile runs a FULL placement pass
+        # over the queue, so fanning a capacity event out to every queued
+        # notebook would only multiply identical passes
+        return [Request(e.namespace, e.name) for e in head]
+
+    def setup(self, manager) -> None:
+        """Rebuild the assignment book from annotated CRs (informers are
+        synced before workers start) — restart-safe accounting."""
+        if self._nb_informer is None:
+            return
+        for nb in self._nb_informer.list():
+            try:
+                resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
+            except tpu.TpuValidationError:
+                continue
+            self._maybe_recover(nb, resolved)
+
+    # ---------------------------------------------------------- reconcile
+
+    def reconcile(self, req: Request) -> Result:
+        key = (req.namespace or "", req.name)
+        try:
+            nb = self.kube.get("notebooks", req.name,
+                               namespace=req.namespace, group=GROUP)
+        except errors.NotFound:
+            self._forget(key)
+            self._run_queue()
+            return Result()
+        if nb["metadata"].get("deletionTimestamp"):
+            self._forget(key)
+            self._run_queue()
+            return Result()
+        try:
+            resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
+        except tpu.TpuValidationError:
+            return Result()  # terminal; the notebook controller surfaces it
+        if resolved is None or resolved.multi_slice:
+            # CPU or multi-slice: not tpusched's to place. A PLACED
+            # notebook edited into this shape must release its chips —
+            # the new spec rolls its pods off the slice — and drop the
+            # stale placement annotation so flipping back to single-slice
+            # re-enters admission instead of reviving a possibly-taken
+            # pool.
+            if (nb["metadata"].get("annotations") or {}).get(
+                    tpu.ANNOTATION_NODEPOOL):
+                try:
+                    self.kube.patch(
+                        "notebooks", req.name,
+                        {"metadata": {"annotations": {
+                            tpu.ANNOTATION_NODEPOOL: None,
+                        }}}, namespace=req.namespace, group=GROUP,
+                    )
+                except errors.NotFound:
+                    pass
+            if self._forget(key):
+                self._run_queue()
+            return Result()
+        annots = nb["metadata"].get("annotations") or {}
+        if STOP_ANNOTATION in annots:
+            if tpu.ANNOTATION_NODEPOOL in annots:
+                # Clear the placement BEFORE releasing the chips: the
+                # moment _forget frees the pool another worker's
+                # placement pass may stamp a waiter onto it, and two live
+                # annotations on one pool would read as a double booking.
+                # A resume goes back through the queue either way (the
+                # pool may be long gone by then).
+                try:
+                    self.kube.patch(
+                        "notebooks", req.name,
+                        {"metadata": {"annotations": {
+                            tpu.ANNOTATION_NODEPOOL: None,
+                        }}}, namespace=req.namespace, group=GROUP,
+                    )
+                except errors.NotFound:
+                    pass
+            if self._forget(key):
+                self._run_queue()
+            return Result()
+        # Not stopped: if we marked this notebook mid-eviction but its
+        # owner cleared the stop annotation before we processed it, the
+        # eviction was undone — drop the mark, or the
+        # one-eviction-in-flight guard would disable preemption forever.
+        with self._lock:
+            self._evicting.discard(key)
+        # Once placed, the ANNOTATION is the authoritative placement —
+        # the notebook controller renders pods from it even if the user
+        # edits spec.tpu.nodePool afterwards (placement is sticky until
+        # stop/resume, where the stop path clears it and re-admission
+        # honors the new pin). This keeps booking == selector == pods;
+        # honoring a live pin edit would roll pods off the booked pool
+        # while the inventory still charges it.
+        pool = annots.get(tpu.ANNOTATION_NODEPOOL)
+        if not pool and (
+                (nb.get("status") or {}).get("readyReplicas") or 0) > 0:
+            # Legacy RUNNING notebook from before the scheduler was
+            # enabled: ADOPT it in place — book and stamp the pool it
+            # actually occupies (the spec pin, else the pool its bound
+            # pods sit on). Re-admitting a live workload would re-place
+            # it onto a best-fit pool (restarting it) while its real
+            # pool read as free — double-booking by blindness.
+            pool = resolved.node_pool or self._bound_pool(nb)
+            if pool:
+                try:
+                    nb = self.kube.patch(
+                        "notebooks", req.name,
+                        {"metadata": {"annotations": {
+                            tpu.ANNOTATION_NODEPOOL: pool,
+                        }}}, namespace=req.namespace, group=GROUP,
+                    )
+                except errors.NotFound:
+                    return Result()
+        if pool:
+            # already placed (or a just-adopted legacy workload): make
+            # sure the book and the condition agree — the restart
+            # recovery path
+            if self._maybe_recover(nb, resolved):
+                self._run_queue()  # recovered chips may block the queue
+            self._set_condition(nb, "True", "Placed",
+                                f"assigned to node pool {pool}")
+            return Result()
+        # Unplaced — including fresh spec.tpu.nodePool pins: a pin picks
+        # the pool but does NOT skip admission, or one spec field would
+        # bypass the quota charge and the whole queue.
+        priority = self._priority_for(nb)
+        with self._lock:
+            if key in self._assigned:
+                # booked with the annotation stamp still in flight (the
+                # stamp happens lock-free): re-admitting now would
+                # double-book; the stamp's MODIFIED event re-enters the
+                # placed branch
+                return Result()
+            self._queue.add(key[0], req.name, demand_from(resolved),
+                            priority, pinned_pool=resolved.node_pool)
+        self._run_queue()
+        return Result()
+
+    # -------------------------------------------------------- bookkeeping
+
+    def _maybe_recover(self, nb: dict, resolved) -> bool:
+        """Record an annotated CR's assignment if the book lacks it. A
+        bare spec pin only counts when the notebook is already RUNNING —
+        a legacy pre-scheduler workload whose chips must be charged;
+        fresh pins go through admission instead."""
+        if resolved is None or resolved.multi_slice:
+            return False
+        meta = nb["metadata"]
+        annots = meta.get("annotations") or {}
+        if STOP_ANNOTATION in annots or meta.get("deletionTimestamp"):
+            return False
+        pool = annots.get(tpu.ANNOTATION_NODEPOOL)
+        if not pool and resolved.node_pool and (
+                (nb.get("status") or {}).get("readyReplicas") or 0) > 0:
+            pool = resolved.node_pool
+        if not pool:
+            return False
+        key = (meta.get("namespace") or "", meta["name"])
+        with self._lock:
+            if key in self._assigned:
+                return False
+            self._queue.remove(key)
+            self._assign_seq += 1
+            self._assigned[key] = Assignment(
+                namespace=key[0], name=key[1], pool=pool,
+                chips=resolved.total_chips,
+                priority=self._priority_for(nb), seq=self._assign_seq,
+            )
+        return True
+
+    def _forget(self, key: tuple[str, str]) -> bool:
+        """Drop a notebook from queue + book; True when chips freed."""
+        with self._lock:
+            self._queue.remove(key)
+            self._evicting.discard(key)
+            return self._assigned.pop(key, None) is not None
+
+    @staticmethod
+    def _int_or(raw, default: int) -> int:
+        try:
+            return int(raw) if raw is not None else default
+        except (TypeError, ValueError):
+            return default
+
+    def _priority_for(self, nb: dict) -> int:
+        """Effective priority. The Profile (admin-owned) sets the
+        namespace's priority CLASS; the Notebook's own annotation — which
+        any contributor can write — may only lower below that ceiling,
+        never raise it (otherwise the least-privileged actor could jump
+        the queue and, with preemption on, evict anyone). A namespace
+        without a Profile has no tenancy guard rails, so there the
+        notebook annotation stands as-is."""
+        nb_raw = (nb["metadata"].get("annotations") or {}).get(
+            PRIORITY_ANNOTATION
+        )
+        profile = self._profile(nb["metadata"].get("namespace"))
+        if profile is None:
+            return self._int_or(nb_raw, 0)
+        ceiling = self._int_or(
+            (profile["metadata"].get("annotations") or {}).get(
+                PRIORITY_ANNOTATION
+            ), 0,
+        )
+        if nb_raw is None:
+            return ceiling
+        return min(self._int_or(nb_raw, ceiling), ceiling)
+
+    def _profile(self, namespace: str | None) -> dict | None:
+        """Profile for a tenant namespace (same name, cluster-scoped),
+        served from the watch cache the process already maintains —
+        priority/quota lookups run once per notebook reconcile and once
+        per namespace per placement pass."""
+        if not namespace:
+            return None
+        if self._profile_informer is not None and \
+                self._profile_informer.has_synced():
+            return self._profile_informer.get(None, namespace)
+        try:
+            return self.kube.get("profiles", namespace, group=GROUP)
+        except errors.NotFound:
+            return None
+
+    def _quota_chips(self, namespace: str) -> int | None:
+        """Per-profile chip budget; None = unlimited (no profile/quota)."""
+        profile = self._profile(namespace)
+        if profile is None:
+            return None
+        hard = (((profile.get("spec") or {}).get("resourceQuotaSpec") or {})
+                .get("hard") or {})
+        raw = hard.get(QUOTA_KEY, hard.get(tpu.RESOURCE_TPU))
+        try:
+            return int(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    def _nodes(self) -> list[dict]:
+        if self._node_informer is not None and \
+                self._node_informer.has_synced():
+            return self._node_informer.list()
+        return self.kube.list("nodes")["items"]
+
+    def _bound_pool(self, nb: dict) -> str | None:
+        """Pool an already-running notebook actually occupies: the
+        node-pool label of any node its pods are bound to. Used once per
+        legacy adoption, so a live LIST is fine."""
+        meta = nb["metadata"]
+        pods = self.kube.list(
+            "pods", namespace=meta.get("namespace"),
+            label_selector=f"notebook-name={meta['name']}",
+        )["items"]
+        for pod in pods:
+            node_name = (pod.get("spec") or {}).get("nodeName")
+            if not node_name:
+                continue
+            try:
+                node = self.kube.get("nodes", node_name)
+            except errors.NotFound:
+                continue
+            pool = ((node["metadata"].get("labels") or {})
+                    .get(tpu.SEL_NODEPOOL))
+            if pool:
+                return pool
+        return None
+
+    def _get_nb(self, key: tuple[str, str]) -> dict | None:
+        """Prefer the synced informer cache: a placement pass reads every
+        queued notebook, and O(queue) live GETs per pass would multiply
+        into real apiserver load under contention. Staleness is safe —
+        condition writes ride optimistic concurrency (Conflict → the
+        MODIFIED event re-levels us)."""
+        if self._nb_informer is not None and self._nb_informer.has_synced():
+            return self._nb_informer.get(key[0], key[1])
+        try:
+            return self.kube.get("notebooks", key[1], namespace=key[0],
+                                 group=GROUP)
+        except errors.NotFound:
+            return None
+
+    # ------------------------------------------------------ placement pass
+
+    def _run_queue(self) -> None:
+        """One serialized scheduling pass: place what fits (in priority/
+        FIFO order), optionally preempt for what doesn't, restamp queue
+        positions. The single lock is what makes placement double-booking-
+        free under concurrent reconcile workers. Per-pass caches (quota
+        per namespace, the notebooks fetched for the placement walk) keep
+        the pass at one GET per queued notebook instead of O(queue) per
+        entry."""
+        placed: list[tuple] = []       # (entry, pool) — booked, unstamped
+        park_events: list[tuple] = []  # (nb, reason, message)
+        evict: tuple | None = None     # (victim, entry)
+        with self._lock:
+            pools = pools_from_nodes(self._nodes())
+            used = used_chips(self._assigned.values(), pools)
+            budgets: dict[str, int | None] = {}
+            live: dict[tuple[str, str], dict] = {}
+            for entry in self._queue.ordered():
+                nb = self._get_nb(entry.key)
+                if nb is None or nb["metadata"].get("deletionTimestamp") \
+                        or STOP_ANNOTATION in (
+                            nb["metadata"].get("annotations") or {}):
+                    self._queue.remove(entry.key)
+                    continue
+                live[entry.key] = nb
+                ns_used = sum(a.chips for a in self._assigned.values()
+                              if a.namespace == entry.namespace)
+                if entry.namespace not in budgets:
+                    budgets[entry.namespace] = self._quota_chips(
+                        entry.namespace
+                    )
+                budget = budgets[entry.namespace]
+                if budget is not None and \
+                        ns_used + entry.demand.total_chips > budget:
+                    self._park(entry, "QuotaExceeded",
+                               f"profile quota {QUOTA_KEY}={budget} has "
+                               f"{budget - ns_used} chips free, need "
+                               f"{entry.demand.total_chips}",
+                               nb, park_events)
+                    continue
+                if entry.pinned_pool:
+                    pin = pools.get(entry.pinned_pool)
+                    pool = entry.pinned_pool if pin is not None and \
+                        feasible(pin, used.get(entry.pinned_pool, 0),
+                                 entry.demand) else None
+                    if pool is None:
+                        self._park(entry, "Unschedulable",
+                                   f"pinned pool {entry.pinned_pool} is "
+                                   "absent, mismatched, or lacks free "
+                                   "chips", nb, park_events)
+                        continue
+                else:
+                    pool = best_fit(pools, used, entry.demand)
+                    if pool is None:
+                        self._park(entry, "Unschedulable",
+                                   f"no {entry.demand.slice_class} pool "
+                                   f"with {entry.demand.total_chips} free "
+                                   f"chips ({entry.demand.num_hosts} "
+                                   "host(s))", nb, park_events)
+                        continue
+                # COMMIT under the lock: the pool is reserved from this
+                # instant (no other pass can book it); the annotation
+                # stamp happens lock-free below
+                self._queue.remove(entry.key)
+                self._assign_seq += 1
+                self._assigned[entry.key] = Assignment(
+                    namespace=entry.namespace, name=entry.name, pool=pool,
+                    chips=entry.demand.total_chips,
+                    priority=entry.priority, seq=self._assign_seq,
+                )
+                placed.append((entry, pool))
+                live.pop(entry.key, None)
+                used[pool] = used.get(pool, 0) + entry.demand.total_chips
+            if self.enable_preemption and not self._evicting:
+                evict = self._choose_preemption(pools, used, budgets)
+                if evict is not None:
+                    self._evicting.add(evict[0].key)
+            restamp, depth = self._position_snapshot(live)
+        # Apiserver writes AFTER the lock drops: a pass that stamps
+        # several placements and restamps O(queue) positions would
+        # otherwise hold the lock through a storm of round-trips,
+        # stalling every reconcile worker. The book already reflects the
+        # decisions, so concurrent passes see reserved pools; a stale
+        # position write is re-leveled by the pass that moved the queue.
+        for entry, pool in placed:
+            self._finish_place(entry, pool)
+        if evict is not None:
+            self._finish_evict(*evict)
+        for nb, reason, message in park_events:
+            self.recorder.event(nb, WARNING, reason, message)
+        for nb, reason, message, pos, total in restamp:
+            self._set_condition(nb, "False", reason, message,
+                                position=pos, total=total)
+        self._seen_classes |= set(depth)
+        for cls in self._seen_classes:
+            self.metrics.queue_depth.labels(cls).set(depth.get(cls, 0))
+
+    def _finish_place(self, entry, pool: str) -> None:
+        """Lock-free half of placement: stamp the annotation the booking
+        reserved, then surface condition + event."""
+        try:
+            # the patch's return is the post-write object — the condition
+            # write below must use IT, or the status update loses the RV
+            # race against our own annotation stamp
+            nb = self.kube.patch(
+                "notebooks", entry.name,
+                {"metadata": {"annotations": {
+                    tpu.ANNOTATION_NODEPOOL: pool,
+                }}}, namespace=entry.namespace, group=GROUP,
+            )
+        except errors.NotFound:
+            # vanished between the liveness read and the stamp: release
+            with self._lock:
+                self._assigned.pop(entry.key, None)
+            return
+        self.metrics.placements.labels(pool).inc()
+        self.metrics.time_to_placement.observe(
+            time.monotonic() - entry.enqueued
+        )
+        self._set_condition(nb, "True", "Placed",
+                            f"assigned to node pool {pool}")
+        self.recorder.event(
+            nb, "Normal", "Placed",
+            f"tpusched assigned node pool {pool} "
+            f"({entry.demand.total_chips} chips)",
+        )
+
+    @staticmethod
+    def _park(entry, reason: str, message: str, nb: dict,
+              events: list) -> None:
+        """Update the entry's verdict under the lock; the event (emitted
+        lock-free by the caller) fires only on verdict change — the
+        condition restamp carries position churn without event spam."""
+        if (entry.reason, entry.message) != (reason, message):
+            entry.reason, entry.message = reason, message
+            events.append((nb, reason, message))
+
+    def _choose_preemption(self, pools, used, budgets):
+        """Decision half of preemption, under the lock: the (victim,
+        waiter) pair for the highest-priority waiter a single eviction
+        can unblock, or None. A victim is only worth evicting when the
+        waiter can actually use the freed slice — its pinned pool if
+        pinned, and quota included: a quota-blocked waiter must not tear
+        down someone else's workload unless the victim is in its own
+        namespace (its release frees budget too)."""
+        assignments = list(self._assigned.values())
+        for entry in self._queue.ordered():
+            budget = budgets.get(entry.namespace)
+            ns_used = sum(a.chips for a in assignments
+                          if a.namespace == entry.namespace)
+
+            def eligible(victim) -> bool:
+                if entry.pinned_pool and victim.pool != entry.pinned_pool:
+                    return False
+                if budget is None:
+                    return True
+                freed = (victim.chips
+                         if victim.namespace == entry.namespace else 0)
+                return (ns_used - freed + entry.demand.total_chips
+                        <= budget)
+
+            victim = choose_victim(
+                [a for a in assignments if eligible(a)],
+                pools, used, entry.demand, entry.priority,
+            )
+            if victim is not None:
+                return victim, entry
+        return None
+
+    def _finish_evict(self, victim, entry) -> None:
+        """Lock-free half of preemption: route the eviction through the
+        cull path (stop annotation). Further passes re-run once the
+        victim's chips actually free — release is event-driven via the
+        victim's stop reconcile."""
+        try:
+            self.kube.patch(
+                "notebooks", victim.name,
+                {"metadata": {"annotations": {
+                    STOP_ANNOTATION: _utcnow(),
+                    PREEMPTED_BY_ANNOTATION:
+                        f"{entry.namespace}/{entry.name}",
+                }}}, namespace=victim.namespace, group=GROUP,
+            )
+        except errors.NotFound:
+            self._forget(victim.key)
+            return
+        self.metrics.preemptions.inc()
+        victim_nb = self._get_nb(victim.key)
+        if victim_nb is not None:
+            self.recorder.event(
+                victim_nb, WARNING, "Preempted",
+                f"evicted (priority {victim.priority}) for "
+                f"higher-priority notebook {entry.namespace}/"
+                f"{entry.name} (priority {entry.priority})",
+            )
+        log.info("tpusched preempted %s/%s for %s/%s",
+                 victim.namespace, victim.name, entry.namespace,
+                 entry.name)
+
+    def _position_snapshot(self, live: dict) -> tuple[list, dict]:
+        """Under the lock: the (nb, reason, message, position, total)
+        restamp worklist plus queue depth per slice class. The caller
+        performs the writes lock-free."""
+        ordered = self._queue.ordered()
+        total = len(ordered)
+        depth: dict[str, int] = {}
+        restamp = []
+        for i, entry in enumerate(ordered, 1):
+            depth[entry.demand.slice_class] = depth.get(
+                entry.demand.slice_class, 0) + 1
+            nb = live.get(entry.key) or self._get_nb(entry.key)
+            if nb is None:
+                continue
+            restamp.append((
+                nb, entry.reason,
+                f"{entry.message}; queue position {i}/{total}", i, total,
+            ))
+        return restamp, depth
+
+    # ------------------------------------------------------------- status
+
+    def _set_condition(self, nb: dict, status: str, reason: str,
+                       message: str, position: int | None = None,
+                       total: int | None = None) -> None:
+        cur = helpers.get_condition(nb, CONDITION_SCHEDULED)
+        if cur and cur.get("status") == status \
+                and cur.get("reason") == reason \
+                and cur.get("message") == message \
+                and cur.get("queuePosition") == position:
+            return
+        cond = {
+            "type": CONDITION_SCHEDULED, "status": status,
+            "reason": reason, "message": message,
+        }
+        if position is not None:
+            # structured fields alongside the prose: consumers (jupyter
+            # row badge, dashboard queue card) must not scrape the
+            # human-readable message
+            cond["queuePosition"] = position
+            cond["queueTotal"] = total
+        # k8s convention: lastTransitionTime survives same-status refreshes
+        # (position churn must not look like state transitions)
+        if cur and cur.get("status") == status and \
+                cur.get("lastTransitionTime"):
+            cond["lastTransitionTime"] = cur["lastTransitionTime"]
+        else:
+            cond["lastTransitionTime"] = _utcnow()
+        fresh = copy.deepcopy(nb)
+        helpers.set_condition(fresh, cond)
+        try:
+            self.kube.update_status("notebooks", fresh, group=GROUP)
+        except (errors.Conflict, errors.NotFound):
+            pass  # someone else wrote; the MODIFIED event re-levels us

@@ -29,6 +29,12 @@ SEL_NODEPOOL = "cloud.google.com/gke-nodepool"
 
 ANNOTATION_SLICE = "tpukf.dev/tpu-slice"
 LABEL_SLICE_ID = "tpukf.dev/slice-id"
+# tpusched's placement decision (controlplane/scheduler): the chosen node
+# pool, stamped on the Notebook CR at admission. The notebook controller
+# folds it into the resolved selector exactly like an explicit
+# spec.tpu.nodePool pin — so the gang controller verifies the same key the
+# scheduler assigned.
+ANNOTATION_NODEPOOL = "tpukf.dev/node-pool"
 
 # DCN (multi-slice) rendezvous port for the MEGASCALE transport the
 # workload layer consumes (parallel/multihost.py). SURVEY §2b: inter-slice

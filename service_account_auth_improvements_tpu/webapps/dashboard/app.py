@@ -129,6 +129,26 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
                     or e.get("eventTime") or "", reverse=True)
         return {"activities": events}
 
+    @app.route("GET", "/api/tpu-queue/<namespace>")
+    def get_tpu_queue(req):
+        """Notebooks parked by tpusched (Scheduled=False), with reason
+        and queue position — the shell-level answer to "why isn't my
+        notebook up", same SAR gating as any notebook read."""
+        from service_account_auth_improvements_tpu.webapps.jupyter.status import (  # noqa: E501
+            queue_info,
+        )
+
+        ns = req.params["namespace"]
+        nbs = KubeApi(kube, req.user, mode=app.mode).list("notebooks", ns)
+        queued = []
+        for nb in nbs:
+            info = queue_info(nb)
+            if info:
+                queued.append({"name": nb["metadata"]["name"], **info})
+        queued.sort(key=lambda q: (q["position"] is None,
+                                   q["position"] or 0, q["name"]))
+        return {"queued": queued}
+
     @app.route("GET", "/api/dashboard-links")
     def get_links(req):
         path = os.environ.get("DASHBOARD_LINKS_CONFIGMAP", "")

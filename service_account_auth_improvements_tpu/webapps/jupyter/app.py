@@ -81,6 +81,9 @@ def notebook_summary(nb: dict, events: list | None = None) -> dict:
         "labels": meta.get("labels"),
         "annotations": meta.get("annotations"),
         "status": status.process_status(nb, events),
+        # tpusched parking state ({reason, message, position, of} or
+        # None) — the frontend renders "queued N/M" on the status row
+        "queue": status.queue_info(nb),
     }
 
 

@@ -10,6 +10,7 @@ is ready, not replicas==1 (the reference is single-pod).
 from __future__ import annotations
 
 import datetime as dt
+import re
 
 from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
@@ -29,6 +30,42 @@ def expected_hosts(notebook: dict) -> int:
     except tpu.TpuValidationError:
         return 1
     return resolved.num_hosts if resolved else 1
+
+
+_QUEUE_POSITION = re.compile(r"queue position (\d+)/(\d+)")
+
+
+def queue_info(notebook: dict) -> dict | None:
+    """Parsed tpusched parking state (``Scheduled=False``), or None when
+    the notebook is placed / stopped / not scheduler-managed. Shape:
+    ``{reason, message, position, of}`` — position/of None when the
+    condition carries no queue position yet. Prefers the condition's
+    structured ``queuePosition``/``queueTotal`` fields; the regex over
+    the prose message is a fallback for conditions written before those
+    fields existed."""
+    meta = notebook.get("metadata") or {}
+    if STOP_ANNOTATION in (meta.get("annotations") or {}):
+        # a stopped notebook left the queue; its last Scheduled=False
+        # condition is history, not a live queue entry
+        return None
+    for cond in (notebook.get("status") or {}).get("conditions") or []:
+        if cond.get("type") != "Scheduled":
+            continue
+        if cond.get("status") != "False":
+            return None
+        message = cond.get("message") or ""
+        position, of = cond.get("queuePosition"), cond.get("queueTotal")
+        if position is None:
+            m = _QUEUE_POSITION.search(message)
+            position = int(m.group(1)) if m else None
+            of = int(m.group(2)) if m else None
+        return {
+            "reason": cond.get("reason") or "Unschedulable",
+            "message": message,
+            "position": position,
+            "of": of,
+        }
+    return None
 
 
 def process_status(notebook: dict, events: list | None = None) -> dict:
@@ -67,6 +104,19 @@ def process_status(notebook: dict, events: list | None = None) -> dict:
         )
 
     hosts = expected_hosts(notebook)
+    if ready == 0:
+        # Parked by tpusched: not an error — the user sees WHY (reason +
+        # queue position) instead of a bare Pending that never explains
+        # itself. Checked only while nothing is running: a stale
+        # condition (scheduler later disabled) must never mask a live
+        # server.
+        queued = queue_info(notebook)
+        if queued:
+            return create_status(
+                STATUS_PHASE.WAITING,
+                f"{queued['reason']}: {queued['message']}",
+            )
+
     if ready >= hosts:
         msg = "Running" if hosts == 1 else \
             f"Running on all {hosts} hosts of the slice"

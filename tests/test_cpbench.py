@@ -99,6 +99,26 @@ def test_webhook_inject_mutates_every_pod():
     assert res.summary["extra"]["mutated"] == 20
 
 
+def test_sched_contention_serializes_placement():
+    """The tpusched acceptance scenario: 4 one-slice v5e 4x4 pools, 10
+    pending 4x4 notebooks. Placement must serialize (no poll tick ever
+    sees two live notebooks on one pool), every notebook must place and
+    reach Ready, and time-to-placement percentiles must be emitted for
+    CONTROLPLANE_BENCH.json."""
+    res = run_scenario("sched_contention", BenchConfig(n=10, **CFG))
+    assert res.ok, res.summary
+    _assert_monotone(res.records)
+    extra = res.summary["extra"]
+    assert extra["pools"] == 4
+    assert extra["double_bookings"] == 0
+    assert extra["placed"] == 10
+    ttp = extra["time_to_placement_ms"]
+    assert ttp["n"] == 10
+    assert 0.0 <= ttp["p50"] <= ttp["p95"] <= ttp["p99"]
+    assert extra["gate_violations"] == 0
+    assert res.summary["completed"] == 10
+
+
 # ------------------------------------------------------------------- CLI
 
 def test_cli_smoke_emits_parseable_schema(tmp_path):
@@ -112,7 +132,7 @@ def test_cli_smoke_emits_parseable_schema(tmp_path):
     assert report["ok"] is True
     assert set(report["scenarios"]) == {
         "notebook_ready", "gang_ready", "churn", "profile_fanout",
-        "webhook_inject",
+        "webhook_inject", "sched_contention",
     }
     for name, s in report["scenarios"].items():
         assert s["ok"], name

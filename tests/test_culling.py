@@ -176,6 +176,40 @@ def test_training_policy_opts_out():
     assert STOP_ANNOTATION not in _annots(kube)
 
 
+def test_queued_notebook_is_never_culled():
+    """A notebook parked by tpusched (Scheduled=False) has no pods and no
+    kernels — maximally idle by every probe heuristic — but it holds zero
+    chips and is waiting in the admission queue. Culling it would stamp
+    the stop annotation and silently drop it out of that queue."""
+    ancient = "2000-01-01T00:00:00Z"
+    kube, rec = _world(None, annotations={LAST_ACTIVITY: ancient},
+                       idle_minutes=1)
+    rec.unreachable_limit = 1  # even the unreachable-reclaim path
+    nb = kube.get("notebooks", "nb", namespace="u", group="tpukf.dev")
+    nb["status"] = {"conditions": [{
+        "type": "Scheduled", "status": "False",
+        "reason": "Unschedulable",
+        "message": "no v5e:4x4 pool; queue position 1/1",
+    }]}
+    kube.update_status("notebooks", nb, group="tpukf.dev")
+    res = rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert PROBE_FAILURES not in a
+    assert res.requeue_after == 60.0  # stays on the probe cadence
+    # once placed (Scheduled=True) culling applies again
+    nb = kube.get("notebooks", "nb", namespace="u", group="tpukf.dev")
+    nb["status"]["conditions"][0].update(
+        {"status": "True", "reason": "Placed", "message": "pool-a"}
+    )
+    kube.update_status("notebooks", nb, group="tpukf.dev")
+    rec.fetch_kernels = lambda url: [
+        {"execution_state": "idle", "last_activity": ancient}
+    ]
+    rec.reconcile(Request("u", "nb"))
+    assert STOP_ANNOTATION in _annots(kube)
+
+
 def test_already_stopped_is_skipped():
     kube, rec = _world(
         [{"execution_state": "idle"}],

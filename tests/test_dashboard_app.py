@@ -140,6 +140,43 @@ def test_shell_api(world):
     assert out["code"] == 200
 
 
+def test_tpu_queue_surfaces_parked_notebooks(world):
+    kube, _, app = world
+    kube.create("namespaces", {"metadata": {"name": "team"}})
+    kube.create("notebooks", {
+        "metadata": {"name": "second", "namespace": "team"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"}},
+        "status": {"conditions": [{
+            "type": "Scheduled", "status": "False",
+            "reason": "Unschedulable",
+            "message": "no v5e:4x4 pool; queue position 2/2",
+        }]},
+    })
+    kube.create("notebooks", {
+        "metadata": {"name": "first", "namespace": "team"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"}},
+        "status": {"conditions": [{
+            "type": "Scheduled", "status": "False",
+            "reason": "QuotaExceeded",
+            "message": "profile quota; queue position 1/2",
+        }]},
+    })
+    kube.create("notebooks", {
+        "metadata": {"name": "running", "namespace": "team"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"}},
+        "status": {"conditions": [{
+            "type": "Scheduled", "status": "True", "reason": "Placed",
+            "message": "assigned to node pool pool-a",
+        }]},
+    })
+    out = call(app, "GET", "/api/tpu-queue/team")
+    assert out["code"] == 200
+    queued = out["body"]["queued"]
+    assert [q["name"] for q in queued] == ["first", "second"]
+    assert queued[0]["reason"] == "QuotaExceeded"
+    assert queued[0]["position"] == 1 and queued[1]["position"] == 2
+
+
 def test_metrics_service_tpu_series(world, monkeypatch):
     kube, kfam, _ = world
 

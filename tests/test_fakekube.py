@@ -263,6 +263,59 @@ def test_noop_update_and_patch_do_not_bump_rv(kube):
     assert changed["metadata"]["resourceVersion"] != rv0
 
 
+def test_node_capacity_defaults_allocatable_and_watch_replays(kube):
+    """Nodes are tpusched's capacity source: a created Node carries
+    status.capacity/status.allocatable (allocatable defaults from
+    capacity, kubelet-style — incl. google.com/tpu), and node add/delete
+    events replay correctly through watch-from-RV so the scheduler's
+    inventory informer never misses a pool change."""
+    created = kube.create("nodes", {
+        "metadata": {"name": "tpu-node-0", "labels": {
+            "cloud.google.com/gke-nodepool": "pool-a",
+        }},
+        "status": {"capacity": {"google.com/tpu": "4", "cpu": "8"}},
+    })
+    assert created["status"]["allocatable"] == {
+        "google.com/tpu": "4", "cpu": "8",
+    }
+    got = kube.get("nodes", "tpu-node-0")
+    assert got["status"]["capacity"]["google.com/tpu"] == "4"
+    assert got["status"]["allocatable"]["google.com/tpu"] == "4"
+    # explicit allocatable (reserved chips) is preserved, not overwritten
+    explicit = kube.create("nodes", {
+        "metadata": {"name": "tpu-node-1"},
+        "status": {"capacity": {"google.com/tpu": "8"},
+                   "allocatable": {"google.com/tpu": "4"}},
+    })
+    assert explicit["status"]["allocatable"] == {"google.com/tpu": "4"}
+    # a status-less node still gets the (empty) capacity/allocatable shape
+    bare = kube.create("nodes", {"metadata": {"name": "cpu-node"}})
+    assert bare["status"]["allocatable"] == {}
+
+    rv = int(created["metadata"]["resourceVersion"])
+    kube.delete("nodes", "tpu-node-0")
+    kube.create("nodes", {
+        "metadata": {"name": "tpu-node-2"},
+        "status": {"capacity": {"google.com/tpu": "4"}},
+    })
+    events = list(kube.watch("nodes", resource_version=rv, timeout=0.2))
+    replay = [(e["type"], e["object"]["metadata"]["name"])
+              for e in events]
+    assert ("DELETED", "tpu-node-0") in replay
+    assert ("ADDED", "tpu-node-2") in replay
+    assert replay.index(("DELETED", "tpu-node-0")) < replay.index(
+        ("ADDED", "tpu-node-2")
+    )
+    added = [e for e in events if e["type"] == "ADDED"
+             and e["object"]["metadata"]["name"] == "tpu-node-2"][0]
+    assert added["object"]["status"]["allocatable"] == {
+        "google.com/tpu": "4",
+    }, "allocatable defaulting must be visible through the watch too"
+    rvs = [int(e["object"]["metadata"]["resourceVersion"])
+           for e in events]
+    assert rvs == sorted(rvs)
+
+
 def test_orphan_create_is_garbage_collected(kube):
     """A child created after its owner's delete cascade (the in-flight
     reconciler race) is collected like the kube GC would; watchers see

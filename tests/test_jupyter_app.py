@@ -17,6 +17,7 @@ from service_account_auth_improvements_tpu.controlplane.kube import (
 from service_account_auth_improvements_tpu.webapps.jupyter import build_app
 from service_account_auth_improvements_tpu.webapps.jupyter.status import (
     process_status,
+    queue_info,
 )
 
 HEADERS = {
@@ -209,6 +210,56 @@ def test_status_multihost_partial_ready():
                                     "containerState": {"running": {}}},
                             tpu_spec=tpu_spec))
     assert st["phase"] == "ready"
+
+
+QUEUED_CONDITION = {
+    "type": "Scheduled", "status": "False", "reason": "Unschedulable",
+    "message": "no v5e:4x4 pool with 16 free chips (4 host(s)); "
+               "queue position 3/7",
+}
+
+
+def test_status_surfaces_tpusched_queue():
+    """A notebook parked by tpusched shows WHY it isn't up (reason +
+    queue position), not a bare generic warning."""
+    st = process_status(_nb(status={"conditions": [QUEUED_CONDITION]}))
+    assert st["phase"] == "waiting"
+    assert "Unschedulable" in st["message"]
+    assert "queue position 3/7" in st["message"]
+    info = queue_info(_nb(status={"conditions": [QUEUED_CONDITION]}))
+    assert info == {
+        "reason": "Unschedulable",
+        "message": QUEUED_CONDITION["message"],
+        "position": 3, "of": 7,
+    }
+    # placed: the Scheduled=True condition is not queue state
+    placed = dict(QUEUED_CONDITION, status="True", reason="Placed",
+                  message="assigned to node pool pool-a")
+    assert queue_info(_nb(status={"conditions": [placed]})) is None
+    # stopped: the notebook left the queue — its last Scheduled=False
+    # condition is history, not a live entry (it must not show as queued)
+    assert queue_info(_nb(annotations={STOP_ANNOTATION: "t"},
+                          status={"conditions": [QUEUED_CONDITION]})) \
+        is None
+    # structured fields win over (and survive rewording of) the prose
+    structured = dict(QUEUED_CONDITION, message="reworded entirely",
+                      queuePosition=5, queueTotal=9)
+    info = queue_info(_nb(status={"conditions": [structured]}))
+    assert info["position"] == 5 and info["of"] == 9
+
+
+def test_notebook_listing_carries_queue_field(world):
+    kube, app = world
+    kube.create("notebooks", {
+        "metadata": {"name": "parked", "namespace": "user1"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"},
+                 "template": {"spec": {"containers": [{"name": "nb"}]}}},
+        "status": {"conditions": [QUEUED_CONDITION]},
+    })
+    out = call(app, "GET", "/api/namespaces/user1/notebooks")
+    row = out["body"]["notebooks"][0]
+    assert row["queue"]["position"] == 3 and row["queue"]["of"] == 7
+    assert row["status"]["phase"] == "waiting"
 
 
 def test_status_from_warning_events():

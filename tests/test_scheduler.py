@@ -1,0 +1,824 @@
+"""tpusched: slice capacity scheduler (controlplane/scheduler).
+
+Covers the acceptance surface: placement feasibility (generation /
+topology / host-count), FIFO + priority queue ordering with user-visible
+positions, requeue on node add and on cull, quota charging at admission,
+preemption end-to-end through the real gang/STS machinery (flag on) and
+queued-forever (flag off), restart recovery, and the 100-notebooks-vs-4-
+slices scale test asserting serialized placement with no double-booking.
+"""
+
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane import tpu
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    STOP_ANNOTATION,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import (  # noqa: E501
+    FakeKubelet,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Manager,
+    Request,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler import (
+    CONDITION_SCHEDULED,
+    PRIORITY_ANNOTATION,
+    SchedulerReconciler,
+    SlicePool,
+    best_fit,
+    demand_from,
+    feasible,
+    pools_from_nodes,
+)
+
+GROUP = "tpukf.dev"
+NS = "u1"
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def _mk_pool(kube, name, *, generation="v5e", topology="4x4", hosts=4,
+             chips=4):
+    sel = {
+        "v4": "tpu-v4-podslice", "v5e": "tpu-v5-lite-podslice",
+        "v5p": "tpu-v5p-slice", "v6e": "tpu-v6e-slice",
+    }[generation]
+    for i in range(hosts):
+        kube.create("nodes", {
+            "metadata": {"name": f"node-{name}-{i}", "labels": {
+                tpu.SEL_NODEPOOL: name,
+                tpu.SEL_ACCELERATOR: sel,
+                tpu.SEL_TOPOLOGY: topology,
+            }},
+            "status": {"capacity": {tpu.RESOURCE_TPU: str(chips)}},
+        })
+
+
+def _nb(name, *, generation="v5e", topology="4x4", priority=None,
+        annotations=None):
+    annots = dict(annotations or {})
+    if priority is not None:
+        annots[PRIORITY_ANNOTATION] = str(priority)
+    return {
+        "metadata": {"name": name, "namespace": NS,
+                     "annotations": annots},
+        "spec": {
+            "tpu": {"generation": generation, "topology": topology},
+            "template": {"spec": {"containers": [{
+                "name": "notebook", "image": "ghcr.io/tpukf/jax:x",
+            }]}},
+        },
+    }
+
+
+def _sched_cond(kube, name):
+    nb = kube.get("notebooks", name, namespace=NS, group=GROUP)
+    for c in (nb.get("status") or {}).get("conditions") or []:
+        if c.get("type") == CONDITION_SCHEDULED:
+            return c
+    return None
+
+
+def _pool_of(kube, name):
+    nb = kube.get("notebooks", name, namespace=NS, group=GROUP)
+    return (nb["metadata"].get("annotations") or {}).get(
+        tpu.ANNOTATION_NODEPOOL
+    )
+
+
+# ------------------------------------------------------- inventory model
+
+
+def test_pools_from_nodes_types_and_capacity():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")                                  # v5e 4x4
+    _mk_pool(kube, "pool-b", generation="v4", topology="2x2x4", hosts=4)
+    kube.create("nodes", {"metadata": {"name": "cpu-node"}})  # no TPU
+    pools = pools_from_nodes(kube.list("nodes")["items"])
+    assert set(pools) == {"pool-a", "pool-b"}
+    a = pools["pool-a"]
+    assert (a.generation, a.topology) == ("v5e", "4x4")
+    assert a.num_hosts == 4 and a.chips_per_host == 4
+    assert a.total_chips == 16 and a.slice_class == "v5e:4x4"
+
+
+def test_mislabeled_pool_is_dropped_whole():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-x", hosts=2)
+    # third node claims a different topology under the same pool name
+    kube.create("nodes", {
+        "metadata": {"name": "node-pool-x-odd", "labels": {
+            tpu.SEL_NODEPOOL: "pool-x",
+            tpu.SEL_ACCELERATOR: "tpu-v5-lite-podslice",
+            tpu.SEL_TOPOLOGY: "8x8",
+        }},
+        "status": {"capacity": {tpu.RESOURCE_TPU: "4"}},
+    })
+    assert pools_from_nodes(kube.list("nodes")["items"]) == {}
+
+
+# -------------------------------------------------- placement feasibility
+
+
+def _demand(generation="v5e", topology="4x4"):
+    return demand_from(tpu.resolve(
+        {"generation": generation, "topology": topology}
+    ))
+
+
+def test_feasibility_generation_topology_hostcount():
+    pool = SlicePool("p", "v5e", "4x4", num_hosts=4, chips_per_host=4)
+    assert feasible(pool, 0, _demand())
+    assert not feasible(pool, 0, _demand(generation="v6e"))
+    assert not feasible(pool, 0, _demand(topology="4x8"))
+    # multi-host pools are one slice: any occupancy blocks a gang
+    assert not feasible(pool, 4, _demand())
+    # host-count: a 4x8 demand (8 hosts) cannot land on a 4-host pool
+    pool48 = SlicePool("p", "v5e", "4x8", num_hosts=4, chips_per_host=4)
+    assert not feasible(pool48, 0, _demand(topology="4x8"))
+
+
+def test_single_host_pools_pack_by_chips():
+    # a single-host v5e pool: 2 nodes x 8 chips, topology 2x2 (4 chips)
+    pool = SlicePool("p", "v5e", "2x2", num_hosts=2, chips_per_host=8)
+    d = _demand(topology="2x2")
+    assert feasible(pool, 0, d) and feasible(pool, 12, d)
+    assert not feasible(pool, 13, d)
+
+
+def test_best_fit_prefers_tightest_pool():
+    pools = {
+        "big": SlicePool("big", "v5e", "2x2", num_hosts=4,
+                         chips_per_host=8),
+        "small": SlicePool("small", "v5e", "2x2", num_hosts=1,
+                           chips_per_host=8),
+    }
+    d = _demand(topology="2x2")
+    assert best_fit(pools, {"big": 0, "small": 0}, d) == "small"
+    assert best_fit(pools, {"big": 28, "small": 0}, d) == "big"
+    assert best_fit(pools, {"big": 32, "small": 8}, d) is None
+
+
+# --------------------------------------------------- reconciler placement
+
+
+def test_placement_stamps_pool_and_condition():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("nb1"))
+    rec.reconcile(Request(NS, "nb1"))
+    assert _pool_of(kube, "nb1") == "pool-a"
+    cond = _sched_cond(kube, "nb1")
+    assert cond["status"] == "True" and cond["reason"] == "Placed"
+    assert rec.metrics.placements.value("pool-a") == 1
+
+
+def test_multihost_pool_never_double_booked():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("nb1"))
+    kube.create("notebooks", _nb("nb2"))
+    rec.reconcile(Request(NS, "nb1"))
+    rec.reconcile(Request(NS, "nb2"))
+    assert _pool_of(kube, "nb1") == "pool-a"
+    assert _pool_of(kube, "nb2") is None
+    cond = _sched_cond(kube, "nb2")
+    assert cond["status"] == "False"
+    assert cond["reason"] == "Unschedulable"
+    assert "queue position 1/1" in cond["message"]
+
+
+def test_cpu_and_multislice_notebooks_bypass_scheduler():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", {
+        "metadata": {"name": "cpu", "namespace": NS}, "spec": {},
+    })
+    multi = _nb("dcn")
+    multi["spec"]["tpu"]["slices"] = 2
+    kube.create("notebooks", multi)
+    rec.reconcile(Request(NS, "cpu"))
+    rec.reconcile(Request(NS, "dcn"))
+    assert _pool_of(kube, "cpu") is None and _pool_of(kube, "dcn") is None
+    assert _sched_cond(kube, "cpu") is None
+    assert _sched_cond(kube, "dcn") is None
+    assert len(rec._queue) == 0
+
+
+def test_user_pinned_pool_is_charged_against_inventory():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    pinned = _nb("pinned")
+    pinned["spec"]["tpu"]["nodePool"] = "pool-a"
+    kube.create("notebooks", pinned)
+    rec.reconcile(Request(NS, "pinned"))
+    # the pin picks the pool, passes admission, and occupies it
+    assert _pool_of(kube, "pinned") == "pool-a"
+    kube.create("notebooks", _nb("nb2"))
+    rec.reconcile(Request(NS, "nb2"))
+    assert _pool_of(kube, "nb2") is None
+    assert _sched_cond(kube, "nb2")["status"] == "False"
+
+
+def test_pinned_notebook_still_passes_admission():
+    """A spec.tpu.nodePool pin must not bypass quota or place onto an
+    absent/occupied pool — it is a placement constraint, not a queue
+    skip."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    kube.create("profiles", {
+        "metadata": {"name": NS},
+        "spec": {"owner": {"kind": "User", "name": "a@b.c"},
+                 "resourceQuotaSpec": {"hard": {
+                     "requests.google.com/tpu": "0",
+                 }}},
+    })
+    rec = SchedulerReconciler(kube)
+    over = _nb("over-quota")
+    over["spec"]["tpu"]["nodePool"] = "pool-a"
+    kube.create("notebooks", over)
+    rec.reconcile(Request(NS, "over-quota"))
+    assert _pool_of(kube, "over-quota") is None
+    assert _sched_cond(kube, "over-quota")["reason"] == "QuotaExceeded"
+    # pin to a pool that does not exist: parked, not stamped blind
+    kube.delete("profiles", NS, group=GROUP)
+    ghost = _nb("ghost-pin")
+    ghost["spec"]["tpu"]["nodePool"] = "no-such-pool"
+    kube.create("notebooks", ghost)
+    rec.reconcile(Request(NS, "ghost-pin"))
+    cond = _sched_cond(kube, "ghost-pin")
+    assert _pool_of(kube, "ghost-pin") is None
+    assert cond["reason"] == "Unschedulable"
+    assert "no-such-pool" in cond["message"]
+
+
+# -------------------------------------------------- queue order + requeue
+
+
+def test_priority_then_fifo_ordering_with_positions():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("holder"))
+    rec.reconcile(Request(NS, "holder"))
+    for name, prio in (("q-first", None), ("q-second", None),
+                       ("q-vip", 50)):
+        kube.create("notebooks", _nb(name, priority=prio))
+        rec.reconcile(Request(NS, name))
+    assert "position 1/3" in _sched_cond(kube, "q-vip")["message"]
+    assert "position 2/3" in _sched_cond(kube, "q-first")["message"]
+    assert "position 3/3" in _sched_cond(kube, "q-second")["message"]
+    # capacity frees: the VIP places first, then strict FIFO
+    kube.delete("notebooks", "holder", namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "holder"))
+    assert _pool_of(kube, "q-vip") == "pool-a"
+    assert "position 1/2" in _sched_cond(kube, "q-first")["message"]
+    kube.delete("notebooks", "q-vip", namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "q-vip"))
+    assert _pool_of(kube, "q-first") == "pool-a"
+    assert _pool_of(kube, "q-second") is None
+
+
+def test_notebook_priority_capped_by_profile_class():
+    """The Profile (admin-owned) sets the namespace's priority ceiling:
+    a contributor's notebook annotation may lower priority but never
+    raise it above the class — otherwise any user could jump the queue
+    and, with preemption on, evict anyone."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    kube.create("profiles", {
+        "metadata": {"name": NS,
+                     "annotations": {PRIORITY_ANNOTATION: "10"}},
+        "spec": {"owner": {"kind": "User", "name": "a@b.c"}},
+    })
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("holder"))
+    rec.reconcile(Request(NS, "holder"))
+    for name, prio in (("self-promoted", 1000000), ("modest", 3),
+                       ("class-default", None)):
+        kube.create("notebooks", _nb(name, priority=prio))
+        rec.reconcile(Request(NS, name))
+    by_name = {e.name: e.priority for e in rec._queue.ordered()}
+    assert by_name["self-promoted"] == 10, "capped at the profile class"
+    assert by_name["modest"] == 3, "self-deprioritization is allowed"
+    assert by_name["class-default"] == 10
+
+
+def test_undone_eviction_does_not_wedge_preemption():
+    """A victim whose owner clears the stop annotation before the
+    scheduler processes it leaves the eviction undone — the in-flight
+    mark must clear when the victim reconciles alive, or the
+    one-eviction guard would disable preemption forever."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube, enable_preemption=True)
+    kube.create("notebooks", _nb("victim"))
+    rec.reconcile(Request(NS, "victim"))
+    kube.create("notebooks", _nb("vip", priority=100))
+    rec.reconcile(Request(NS, "vip"))   # evicts: stop stamped
+    assert rec._evicting
+    # owner undoes the eviction before the scheduler sees the stop
+    kube.patch("notebooks", "victim",
+               {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "victim"))  # alive + still placed
+    assert not rec._evicting, "undone eviction must clear the mark"
+    assert _pool_of(kube, "victim") == "pool-a"
+    # preemption works again: the next pass re-evicts
+    rec._run_queue()
+    assert STOP_ANNOTATION in (
+        kube.get("notebooks", "victim", namespace=NS,
+                 group=GROUP)["metadata"].get("annotations") or {}
+    )
+
+
+def test_profile_priority_annotation_applies():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    kube.create("profiles", {
+        "metadata": {"name": NS,
+                     "annotations": {PRIORITY_ANNOTATION: "7"}},
+        "spec": {"owner": {"kind": "User", "name": "a@b.c"}},
+    })
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("holder"))
+    rec.reconcile(Request(NS, "holder"))
+    kube.create("notebooks", _nb("from-profile"))
+    rec.reconcile(Request(NS, "from-profile"))
+    assert rec._queue.ordered()[0].priority == 7
+
+
+def test_requeue_on_node_add_via_manager():
+    """A queued notebook places as soon as a matching pool registers —
+    the node watch re-evaluates the queue without any notebook event."""
+    kube = FakeKube()
+    mgr = Manager(kube)
+    SchedulerReconciler(kube).register(mgr)
+    mgr.start()
+    try:
+        kube.create("notebooks", _nb("waiting"))
+        assert _wait(lambda: (_sched_cond(kube, "waiting") or {}).get(
+            "status") == "False")
+        _mk_pool(kube, "pool-late")
+        assert _wait(lambda: _pool_of(kube, "waiting") == "pool-late")
+        cond = _sched_cond(kube, "waiting")
+        assert cond["status"] == "True" and cond["reason"] == "Placed"
+    finally:
+        mgr.stop()
+
+
+def test_requeue_on_cull_stop_releases_chips():
+    """Culling a running notebook (stop annotation) frees its slice for
+    the head of the queue, and clears the victim's placement so a resume
+    goes back through the queue."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("running"))
+    rec.reconcile(Request(NS, "running"))
+    kube.create("notebooks", _nb("queued"))
+    rec.reconcile(Request(NS, "queued"))
+    assert _pool_of(kube, "queued") is None
+    # the culler stamps the stop annotation; the MODIFIED event lands here
+    kube.patch("notebooks", "running",
+               {"metadata": {"annotations": {STOP_ANNOTATION: "now"}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "running"))
+    assert _pool_of(kube, "queued") == "pool-a"
+    assert _pool_of(kube, "running") is None, (
+        "a stopped notebook's placement must be cleared so resume "
+        "reschedules"
+    )
+    # resume: back through the queue (pool now occupied by 'queued')
+    kube.patch("notebooks", "running",
+               {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "running"))
+    assert _pool_of(kube, "running") is None
+    assert _sched_cond(kube, "running")["status"] == "False"
+
+
+# ------------------------------------------------------------------ quota
+
+
+def test_profile_quota_charged_at_admission():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a", topology="2x2", hosts=4, chips=8)
+    kube.create("profiles", {
+        "metadata": {"name": NS},
+        "spec": {
+            "owner": {"kind": "User", "name": "a@b.c"},
+            "resourceQuotaSpec": {"hard": {
+                "requests.google.com/tpu": "6",
+            }},
+        },
+    })
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("one", topology="2x2"))   # 4 chips
+    rec.reconcile(Request(NS, "one"))
+    assert _pool_of(kube, "one") == "pool-a"
+    kube.create("notebooks", _nb("two", topology="2x2"))   # 4 more > 6
+    rec.reconcile(Request(NS, "two"))
+    cond = _sched_cond(kube, "two")
+    assert cond["reason"] == "QuotaExceeded"
+    assert "2 chips free" in cond["message"]
+    # the pool itself has room — quota, not capacity, is the blocker
+    kube.delete("notebooks", "one", namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "one"))
+    assert _pool_of(kube, "two") == "pool-a"
+
+
+def test_quota_blocked_waiter_never_preempts_other_namespace():
+    """A high-priority notebook blocked by its OWN profile quota must not
+    tear down another namespace's running workload — the eviction frees
+    chips it still cannot use."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    _mk_pool(kube, "pool-b")
+    kube.create("profiles", {
+        "metadata": {"name": NS,
+                     # the profile's class is the priority CEILING
+                     "annotations": {PRIORITY_ANNOTATION: "100"}},
+        "spec": {"owner": {"kind": "User", "name": "a@b.c"},
+                 "resourceQuotaSpec": {"hard": {
+                     "requests.google.com/tpu": "16",
+                 }}},
+    })
+    rec = SchedulerReconciler(kube, enable_preemption=True)
+    # other-namespace victim occupies pool-a at priority 0
+    kube.create("notebooks", {
+        "metadata": {"name": "other", "namespace": "other-ns"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "4x4"}},
+    })
+    rec.reconcile(Request("other-ns", "other"))
+    # u1 exhausts its 16-chip quota on pool-b (self-deprioritized to 0)...
+    kube.create("notebooks", _nb("mine", priority=0))
+    rec.reconcile(Request(NS, "mine"))
+    # ...then queues a priority-100 notebook: quota-blocked. The
+    # other-namespace victim frees chips the waiter cannot use (its own
+    # quota stays exhausted) — only the SAME-namespace victim, whose
+    # release frees budget too, is a legal eviction.
+    kube.create("notebooks", _nb("vip", priority=100))
+    rec.reconcile(Request(NS, "vip"))
+    assert _sched_cond(kube, "vip")["reason"] == "QuotaExceeded"
+    assert rec.metrics.preemptions.value() == 1
+    other = kube.get("notebooks", "other", namespace="other-ns",
+                     group=GROUP)
+    assert STOP_ANNOTATION not in (
+        other["metadata"].get("annotations") or {}
+    ), "an out-of-namespace victim must never yield for a quota block"
+    mine = kube.get("notebooks", "mine", namespace=NS, group=GROUP)
+    assert STOP_ANNOTATION in (mine["metadata"].get("annotations") or {})
+    # the victim's release lets the vip through quota AND capacity
+    rec.reconcile(Request(NS, "mine"))
+    assert _pool_of(kube, "vip") is not None
+
+
+def test_parked_condition_carries_structured_position():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("holder"))
+    rec.reconcile(Request(NS, "holder"))
+    kube.create("notebooks", _nb("waiter"))
+    rec.reconcile(Request(NS, "waiter"))
+    cond = _sched_cond(kube, "waiter")
+    assert cond["queuePosition"] == 1 and cond["queueTotal"] == 1
+
+
+# ------------------------------------------------------------- recovery
+
+
+def test_placement_sticky_across_live_pin_edit():
+    """Editing spec.tpu.nodePool on a PLACED notebook must not roll its
+    pods off the booked pool: the stamped annotation stays authoritative
+    (selector == booking) until a stop/resume re-admits under the new
+    pin."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    _mk_pool(kube, "pool-b")
+    rec = SchedulerReconciler(kube)
+    nbrec = NotebookReconciler(kube)
+    nbrec.use_scheduler = True
+    kube.create("notebooks", _nb("sticky"))
+    rec.reconcile(Request(NS, "sticky"))
+    placed_on = _pool_of(kube, "sticky")
+    nb = kube.get("notebooks", "sticky", namespace=NS, group=GROUP)
+    other = "pool-b" if placed_on == "pool-a" else "pool-a"
+    nb["spec"]["tpu"]["nodePool"] = other
+    kube.update("notebooks", nb, group=GROUP)
+    rec.reconcile(Request(NS, "sticky"))
+    assert _pool_of(kube, "sticky") == placed_on, "booking must not move"
+    nbrec.reconcile(Request(NS, "sticky"))
+    sts = kube.get("statefulsets", "sticky", namespace=NS, group="apps")
+    sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel[tpu.SEL_NODEPOOL] == placed_on, (
+        "pods must keep rendering onto the booked pool, not the edit"
+    )
+    # stop → resume re-admits under the new pin
+    kube.patch("notebooks", "sticky",
+               {"metadata": {"annotations": {STOP_ANNOTATION: "now"}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "sticky"))
+    kube.patch("notebooks", "sticky",
+               {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "sticky"))
+    assert _pool_of(kube, "sticky") == other
+
+
+def test_pinned_waiter_only_preempts_on_its_pool():
+    """A pinned high-priority waiter can only use its pinned pool —
+    evicting a victim anywhere else would destroy work without
+    unblocking anyone (the youngest-victim tie-break would otherwise
+    pick the wrong pool's tenant)."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    _mk_pool(kube, "pool-b")
+    rec = SchedulerReconciler(kube, enable_preemption=True)
+    a = _nb("on-a")
+    a["spec"]["tpu"]["nodePool"] = "pool-a"
+    kube.create("notebooks", a)
+    rec.reconcile(Request(NS, "on-a"))
+    b = _nb("on-b")   # younger assignment — the default tie-break bait
+    b["spec"]["tpu"]["nodePool"] = "pool-b"
+    kube.create("notebooks", b)
+    rec.reconcile(Request(NS, "on-b"))
+    vip = _nb("vip", priority=100)
+    vip["spec"]["tpu"]["nodePool"] = "pool-a"
+    kube.create("notebooks", vip)
+    rec.reconcile(Request(NS, "vip"))
+    annots_a = kube.get("notebooks", "on-a", namespace=NS,
+                        group=GROUP)["metadata"].get("annotations") or {}
+    annots_b = kube.get("notebooks", "on-b", namespace=NS,
+                        group=GROUP)["metadata"].get("annotations") or {}
+    assert STOP_ANNOTATION in annots_a, "the pinned pool's tenant yields"
+    assert STOP_ANNOTATION not in annots_b, (
+        "the other pool's tenant must be left alone"
+    )
+
+
+def test_spec_flip_to_multislice_releases_assignment():
+    """Editing a placed notebook to a shape tpusched doesn't manage (CPU
+    or multi-slice) must free its chips and drop the stale placement —
+    the new spec rolls its pods off the slice."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("flip"))
+    rec.reconcile(Request(NS, "flip"))
+    assert _pool_of(kube, "flip") == "pool-a"
+    kube.create("notebooks", _nb("waiter"))
+    rec.reconcile(Request(NS, "waiter"))
+    assert _pool_of(kube, "waiter") is None
+    nb = kube.get("notebooks", "flip", namespace=NS, group=GROUP)
+    nb["spec"]["tpu"]["slices"] = 2
+    kube.update("notebooks", nb, group=GROUP)
+    rec.reconcile(Request(NS, "flip"))
+    assert _pool_of(kube, "flip") is None, "stale placement must clear"
+    assert _pool_of(kube, "waiter") == "pool-a", "chips must free"
+
+
+def test_enabling_scheduler_adopts_running_notebooks():
+    """Flag-enable migration: a notebook already RUNNING when tpusched
+    first starts is adopted onto the pool its pods actually occupy — not
+    re-admitted (which would re-place and restart it) and not ignored
+    (which would double-book its pool)."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    _mk_pool(kube, "pool-b")
+    # a running gang on pool-b from before the scheduler existed
+    legacy = _nb("legacy")
+    legacy["status"] = {"readyReplicas": 4}
+    kube.create("notebooks", legacy)
+    kube.create("pods", {
+        "metadata": {"name": "legacy-0", "namespace": NS,
+                     "labels": {"notebook-name": "legacy"}},
+        "spec": {"nodeName": "node-pool-b-0"},
+    })
+    rec = SchedulerReconciler(kube)
+    rec.reconcile(Request(NS, "legacy"))
+    assert _pool_of(kube, "legacy") == "pool-b", (
+        "adoption must stamp the ACTUAL pool, best-fit would say pool-a"
+    )
+    assert _sched_cond(kube, "legacy")["reason"] == "Placed"
+    # and the adopted pool is charged: a new gang lands on pool-a only
+    kube.create("notebooks", _nb("new1"))
+    kube.create("notebooks", _nb("new2"))
+    rec.reconcile(Request(NS, "new1"))
+    rec.reconcile(Request(NS, "new2"))
+    assert _pool_of(kube, "new1") == "pool-a"
+    assert _pool_of(kube, "new2") is None
+    # a running legacy PIN is adopted via its spec pin and stamped, so
+    # the notebook controller's annotation gate keeps managing it
+    pinned = _nb("legacy-pin")
+    pinned["spec"]["tpu"]["nodePool"] = "pool-b"
+    pinned["status"] = {"readyReplicas": 4}
+    kube.create("notebooks", pinned)
+    rec.reconcile(Request(NS, "legacy-pin"))
+    assert _pool_of(kube, "legacy-pin") == "pool-b"
+
+
+def test_restart_recovers_assignments_from_annotations():
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube)
+    kube.create("notebooks", _nb("survivor"))
+    rec.reconcile(Request(NS, "survivor"))
+    assert _pool_of(kube, "survivor") == "pool-a"
+    # fresh process: empty book, same cluster
+    rec2 = SchedulerReconciler(kube)
+    rec2.reconcile(Request(NS, "survivor"))   # recovery path
+    kube.create("notebooks", _nb("newcomer"))
+    rec2.reconcile(Request(NS, "newcomer"))
+    assert _pool_of(kube, "newcomer") is None, (
+        "recovered assignment must block double-booking after restart"
+    )
+
+
+# ------------------------------------------------- notebook hand-off
+
+
+def test_notebook_controller_waits_for_placement_then_pins():
+    kube = FakeKube()
+    nbrec = NotebookReconciler(kube)
+    nbrec.use_scheduler = True
+    kube.create("notebooks", _nb("gated"))
+    nbrec.reconcile(Request(NS, "gated"))
+    with pytest.raises(errors.NotFound):
+        kube.get("statefulsets", "gated", namespace=NS, group="apps")
+    kube.patch("notebooks", "gated", {"metadata": {"annotations": {
+        tpu.ANNOTATION_NODEPOOL: "pool-a",
+    }}}, namespace=NS, group=GROUP)
+    nbrec.reconcile(Request(NS, "gated"))
+    sts = kube.get("statefulsets", "gated", namespace=NS, group="apps")
+    sel = sts["spec"]["template"]["spec"]["nodeSelector"]
+    assert sel[tpu.SEL_NODEPOOL] == "pool-a"
+
+
+def test_notebook_controller_without_scheduler_unchanged():
+    kube = FakeKube()
+    nbrec = NotebookReconciler(kube)
+    kube.create("notebooks", _nb("plain"))
+    nbrec.reconcile(Request(NS, "plain"))
+    sts = kube.get("statefulsets", "plain", namespace=NS, group="apps")
+    assert tpu.SEL_NODEPOOL not in (
+        sts["spec"]["template"]["spec"]["nodeSelector"]
+    )
+
+
+# ------------------------------------------------------ preemption (e2e)
+
+
+class _SchedWorld:
+    """Full stack: Manager + NotebookReconciler (scheduler hand-off on) +
+    SchedulerReconciler + FakeKubelet playing STS controller/scheduler/
+    kubelet — preemption exercises the real gang teardown."""
+
+    def __init__(self, preemption: bool):
+        self.kube = FakeKube()
+        self.mgr = Manager(self.kube)
+        self.nbrec = NotebookReconciler(self.kube)
+        self.nbrec.use_scheduler = True
+        self.nbrec.register(self.mgr)
+        self.sched = SchedulerReconciler(self.kube,
+                                         enable_preemption=preemption)
+        self.sched.register(self.mgr)
+        self.kubelet = FakeKubelet(self.kube, "const:5")
+
+    def start(self):
+        self.mgr.start()
+        self.kubelet.start()
+
+    def stop(self):
+        self.kubelet.stop()
+        self.mgr.stop()
+
+    def ready_hosts(self, name):
+        nb = self.kube.get("notebooks", name, namespace=NS, group=GROUP)
+        return (nb.get("status") or {}).get("readyReplicas") or 0
+
+
+@pytest.mark.parametrize("preemption", (True, False))
+def test_preemption_end_to_end(preemption):
+    world = _SchedWorld(preemption)
+    _mk_pool(world.kube, "pool-a")
+    world.start()
+    try:
+        world.kube.create("notebooks", _nb("low", priority=0))
+        assert _wait(lambda: world.ready_hosts("low") == 4, timeout=15)
+        world.kube.create("notebooks", _nb("vip", priority=100))
+        if not preemption:
+            assert _wait(lambda: (_sched_cond(world.kube, "vip") or {})
+                         .get("status") == "False")
+            time.sleep(0.3)
+            assert _pool_of(world.kube, "vip") is None
+            assert STOP_ANNOTATION not in (
+                world.kube.get("notebooks", "low", namespace=NS,
+                               group=GROUP)["metadata"]
+                .get("annotations") or {}
+            ), "with the flag off nobody is evicted"
+            assert world.sched.metrics.preemptions.value() == 0
+            return
+        # flag on: the priority-100 notebook evicts the priority-0 one
+        # through the cull path, its gang tears down, placement lands,
+        # and the vip reaches Ready on the freed slice
+        assert _wait(lambda: world.ready_hosts("vip") == 4, timeout=20)
+        low = world.kube.get("notebooks", "low", namespace=NS,
+                             group=GROUP)
+        annots = low["metadata"].get("annotations") or {}
+        assert STOP_ANNOTATION in annots
+        assert annots.get("tpukf.dev/preempted-by") == f"{NS}/vip"
+        assert tpu.ANNOTATION_NODEPOOL not in annots
+        assert world.sched.metrics.preemptions.value() == 1
+        assert _wait(lambda: not world.kube.list(
+            "pods", namespace=NS,
+            label_selector="notebook-name=low")["items"]), (
+            "the victim's gang pods must be torn down"
+        )
+        assert _pool_of(world.kube, "vip") == "pool-a"
+    finally:
+        world.stop()
+
+
+# ---------------------------------------------------------------- scale
+
+
+def test_scale_100_notebooks_4_slices_no_double_booking():
+    """The acceptance scenario: 4 one-slice v5e 4x4 pools, a storm of
+    pending 4x4 notebooks. tpusched serializes placement — at no point do
+    two live notebooks share a multi-host pool — and drains the queue to
+    the last notebook as capacity frees."""
+    kube = FakeKube()
+    for i in range(4):
+        _mk_pool(kube, f"pool-{i}")
+    rec = SchedulerReconciler(kube)
+    n = 100
+    names = [f"nb-{i:03d}" for i in range(n)]
+    for name in names:
+        kube.create("notebooks", _nb(name))
+        rec.reconcile(Request(NS, name))
+
+    def assigned():
+        out = {}
+        for name in names:
+            try:
+                pool = _pool_of(kube, name)
+            except errors.NotFound:
+                continue
+            if pool:
+                out[name] = pool
+        return out
+
+    placed_total = set()
+    first_wave = assigned()
+    assert len(first_wave) == 4
+    assert sorted(first_wave.values()) == sorted(f"pool-{i}"
+                                                 for i in range(4))
+    # queue positions cover the remaining 96, exactly once each
+    positions = set()
+    for name in names:
+        cond = _sched_cond(kube, name)
+        if cond and cond["status"] == "False":
+            pos = cond["message"].rsplit("position ", 1)[-1]
+            positions.add(pos)
+    assert len(positions) == 96 and "1/96" in positions
+
+    rounds = 0
+    while True:
+        wave = assigned()
+        # serialization invariant: a multi-host pool hosts at most ONE
+        # live notebook at any observation point
+        pools_now = list(wave.values())
+        assert len(pools_now) == len(set(pools_now)), (
+            f"double-booked pools in round {rounds}: {wave}"
+        )
+        placed_total |= set(wave)
+        if not wave:
+            break
+        for name in wave:
+            kube.delete("notebooks", name, namespace=NS, group=GROUP)
+            rec.reconcile(Request(NS, name))
+        rounds += 1
+        assert rounds <= n, "queue failed to drain"
+    assert placed_total == set(names)
+    assert rec.metrics.time_to_placement._counts[()][-1] == n
