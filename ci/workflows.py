@@ -188,16 +188,19 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
               "run": "SATPU_BENCH_CPU=1 python bench.py"}],
         )},
     ),
-    # control-plane latency bench: every PR gets cpbench --smoke (pure
-    # stdlib — no jax/flax install needed) and fails on malformed JSON
-    # output; the full run behind BASELINE.md is manual/--full
+    # control-plane latency bench: every PR gets the metric-declaration
+    # lint plus cpbench --smoke (pure stdlib — no jax/flax install
+    # needed) and fails on malformed JSON output; the full run behind
+    # BASELINE.md is manual/--full
     "controlplane_bench.yaml": workflow(
         "Control Plane Bench Smoke",
         ["service_account_auth_improvements_tpu/controlplane/**",
          "service_account_auth_improvements_tpu/webhook/**",
-         "tests/test_cpbench.py"],
+         "tests/test_cpbench.py", "tools/metrics_lint.py"],
         {"cpbench": job([
             CHECKOUT, SETUP_PY,
+            {"name": "Metrics lint",
+             "run": "python tools/metrics_lint.py"},
             {"name": "Run cpbench --smoke",
              "run": "python -m service_account_auth_improvements_tpu."
                     "controlplane.cpbench --smoke "
@@ -214,7 +217,12 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "for k in s]; "
                     "sc = s['sched_contention']['extra']; "
                     "assert sc['double_bookings'] == 0, sc; "
-                    "sc['time_to_placement_ms']['p99']\""},
+                    "sc['time_to_placement_ms']['p99']; "
+                    "att = s['notebook_ready']['stage_attribution']; "
+                    "assert att['attributed_fraction']['mean'] >= 0.8, "
+                    "att; "
+                    "assert 'kubelet' in att['stages_ms'] and "
+                    "'queue_wait' in att['stages_ms'], att\""},
             {"name": "Upload bench record",
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",

@@ -1,5 +1,7 @@
 """KFAM server binary (reference: access-management/main.go:36-58 — flags
-userid-header, userid-prefix, cluster-admin; listens :8081)."""
+userid-header, userid-prefix, cluster-admin; listens :8081, with the
+manager-style ops sidecar — /metrics, probes, /debug/tracez — on its own
+port like every other controlplane binary)."""
 
 from __future__ import annotations
 
@@ -8,6 +10,9 @@ import logging
 import socketserver
 import wsgiref.simple_server
 
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
 from service_account_auth_improvements_tpu.controlplane.kfam import KfamApp
 from service_account_auth_improvements_tpu.controlplane.kube import KubeClient
 
@@ -20,6 +25,7 @@ class ThreadingWSGIServer(socketserver.ThreadingMixIn,
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, default=8081)
+    parser.add_argument("--metrics-port", type=int, default=8082)
     parser.add_argument("--kube-url", default=None)
     parser.add_argument("--cluster-admin", default=None)
     parser.add_argument("--userid-header", default=None)
@@ -34,9 +40,15 @@ def main(argv=None) -> int:
         userid_header=args.userid_header,
         userid_prefix=args.userid_prefix,
     )
+    ready = {"ok": False}
+    # KFAM registers its request counter on a per-app registry (several
+    # apps can share a test process) — export THAT one, not the global
+    serve_ops(args.metrics_port, registry=app.registry,
+              ready_check=lambda: ready["ok"])
     httpd = wsgiref.simple_server.make_server(
         "0.0.0.0", args.port, app, server_class=ThreadingWSGIServer,
     )
+    ready["ok"] = True  # no informers: ready once the socket is bound
     httpd.serve_forever()
     return 0
 

@@ -53,8 +53,14 @@ def run_manager(register, argv=None, add_args=None) -> int:
                       default_workers=args.workers)
     register(client, manager, args)
 
+    # readiness is LIVE informer-sync state, not a started flag: a watch
+    # that loses its caches after startup (long apiserver outage) reads
+    # not-ready again instead of lying to the kubelet
     ready = {"ok": False}
-    serve_ops(args.metrics_port, ready_check=lambda: ready["ok"])
+    serve_ops(
+        args.metrics_port,
+        ready_check=lambda: ready["ok"] and manager.informers_synced(),
+    )
 
     elector = None
     if args.leader_elect:

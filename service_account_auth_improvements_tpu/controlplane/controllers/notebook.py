@@ -26,6 +26,7 @@ import json
 import logging
 import queue
 import threading
+import time
 
 from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers import (
@@ -47,6 +48,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import (
     Gauge,
     Registry,
 )
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.utils.env import (
     get_env_bool,
     get_env_default,
@@ -284,6 +286,27 @@ class NotebookReconciler(Reconciler):
         if nb["metadata"].get("deletionTimestamp"):
             return Result()
 
+        # bind (and on the CR's first reconcile, stamp) the trace id:
+        # uid-derived, so it is deterministic across processes and a
+        # recreated notebook starts a FRESH trace instead of mixing
+        # lifecycles under the reused name. The stamp is one PATCH per CR
+        # incarnation — the durable correlation handle for dashboards /
+        # kubectl (the in-memory binding alone would die with the pod).
+        # A MISMATCHED annotation (exported manifest re-applied, carrying
+        # the old incarnation's id) is re-stamped to self-heal.
+        trace_id = obs.object_trace_id("notebooks", nb)
+        if (nb["metadata"].get("annotations") or {}).get(
+                obs.TRACE_ANNOTATION) != trace_id:
+            try:
+                nb = self.kube.patch(
+                    "notebooks", req.name,
+                    {"metadata": {"annotations": {
+                        obs.TRACE_ANNOTATION: trace_id,
+                    }}}, namespace=req.namespace, group=GROUP,
+                )
+            except errors.NotFound:
+                return Result()
+
         try:
             resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
         except tpu.TpuValidationError as e:
@@ -337,6 +360,23 @@ class NotebookReconciler(Reconciler):
         slice_names = [
             self._sts_name(req.name, j, num_slices) for j in range(num_slices)
         ]
+        # children-create stage of the trace (admission→queue→placement→
+        # gang→STS→Ready): STS + services ensures, parented on the
+        # engine's reconcile span
+        with obs.span("notebook.children", attrs={"slices": num_slices}):
+            all_sts, requeue_after = self._ensure_children(
+                nb, resolved, req, slice_names
+            )
+        gang_cond = None
+        if resolved and (resolved.multi_host or resolved.multi_slice) \
+                and not self._stopped(nb):
+            with obs.span("notebook.gang"):
+                gang_cond = self._reconcile_gang(nb, resolved)
+        self.update_status(nb, all_sts, resolved, gang_cond)
+        return Result(requeue_after=requeue_after)
+
+    def _ensure_children(self, nb: dict, resolved, req: Request,
+                         slice_names: list[str]) -> tuple[list, float]:
         self._prune_stale_statefulsets(nb, keep=set(slice_names))
         all_sts = []
         requeue_after = 0.0
@@ -409,12 +449,7 @@ class NotebookReconciler(Reconciler):
                 self.generate_virtual_service(nb),
                 group="networking.istio.io",
             )
-        gang_cond = None
-        if resolved and (resolved.multi_host or resolved.multi_slice) \
-                and not self._stopped(nb):
-            gang_cond = self._reconcile_gang(nb, resolved)
-        self.update_status(nb, all_sts, resolved, gang_cond)
-        return Result(requeue_after=requeue_after)
+        return all_sts, requeue_after
 
     # -------------------------------------------------------------- gang
 
@@ -638,7 +673,7 @@ class NotebookReconciler(Reconciler):
         annots = {
             k: v for k, v in (nb["metadata"].get("annotations") or {}).items()
             if not k.startswith("kubectl.kubernetes.io/")
-            and k != STOP_ANNOTATION
+            and k not in (STOP_ANNOTATION, obs.TRACE_ANNOTATION)
         }
         if annots:
             meta.setdefault("annotations", {}).update(annots)
@@ -898,6 +933,17 @@ class NotebookReconciler(Reconciler):
             self.metrics.running.labels(ns).set(0)
         else:
             self.metrics.running.labels(ns).set(status["readyReplicas"])
+        want_ready = (resolved.num_hosts * resolved.num_slices
+                      if resolved else 1)
+        if ready >= want_ready and want_ready > 0:
+            # end of the lifecycle trace: every expected host reported
+            # Ready (idempotent — later refreshes don't re-mark)
+            mark = time.monotonic()
+            obs.record(
+                "notebook.ready",
+                obs.object_key("notebooks", ns, name), mark, mark,
+                attrs={"ready_replicas": ready}, once=True,
+            )
         cur = (nb.get("status") or {})
         if cur != status:
             nb = copy.deepcopy(nb)

@@ -45,4 +45,5 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (
     Timeline,
     Tracker,
     percentiles,
+    stage_attribution,
 )

@@ -120,6 +120,9 @@ def run(args) -> dict:
         entry["elapsed_s"] = round(result.elapsed_s, 3)
         report["scenarios"][name] = entry
         ready = (entry.get("phases_ms") or {}).get("create_to_ready") or {}
+        att = (entry.get("stage_attribution") or {}).get(
+            "attributed_fraction") or {}
+        att_txt = (f" attr={att['mean']:.0%}" if "mean" in att else "")
         print(
             f"{name:16s} {'ok' if result.ok else 'FAIL':4s} "
             f"n={entry['n']:<5d} "
@@ -127,7 +130,7 @@ def run(args) -> dict:
             f"p95={ready.get('p95', float('nan')):8.2f}ms "
             f"p99={ready.get('p99', float('nan')):8.2f}ms "
             f"reconciles={entry['reconciles']:<6d} "
-            f"({time.monotonic() - t0:.1f}s)",
+            f"({time.monotonic() - t0:.1f}s){att_txt}",
             file=sys.stderr,
         )
     report["elapsed_s"] = round(time.monotonic() - started, 3)

@@ -30,6 +30,7 @@ import random
 import threading
 import time
 
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.engine import (
     Informer,
 )
@@ -131,22 +132,31 @@ class FakeKubelet:
     """STS-controller + scheduler + kubelet against a FakeKube."""
 
     def __init__(self, kube, latency: LatencyDist | str = "uniform:5,15",
-                 seed: int = 0):
+                 seed: int = 0, tracer=None):
         self.kube = kube
+        #: with a tracer, each pod's schedule→Ready interval lands on the
+        #: owning notebook's trace as a ``kubelet.actuation`` span — the
+        #: ground truth cpbench's stage attribution books as kubelet time
+        self._tracer = tracer
         self.latency = (latency if isinstance(latency, LatencyDist)
                         else LatencyDist(latency))
         self._rng = random.Random(seed)
         self._rng_lock = threading.Lock()
         self._lock = threading.Lock()
         self._scheduled: set[str] = set()      # pod uids with a flip queued
+        self._created_at: dict[tuple, float] = {}  # (ns, pod) -> instant
         self.samples: dict[tuple[str, str], float] = {}  # (ns, pod) -> s
         self.gate_violations = 0   # pods seen bound/Ready while still gated
         self.pods_created = 0
         self.pods_ready = 0
         self._flipper = _Flipper()
-        self._sts_inf = Informer(kube, "statefulsets", group="apps")
+        # tracer'd informers: the STS/pod watch hops inside the fake
+        # cluster surface as informer.deliver spans on the owning
+        # notebook's trace (via the notebook-name label)
+        self._sts_inf = Informer(kube, "statefulsets", group="apps",
+                                 tracer=tracer)
         self._sts_inf.add_handler(self._on_sts)
-        self._pod_inf = Informer(kube, "pods")
+        self._pod_inf = Informer(kube, "pods", tracer=tracer)
         self._pod_inf.add_handler(self._on_pod)
 
     def start(self) -> None:
@@ -206,6 +216,11 @@ class FakeKubelet:
                     sts, template, pod_name, i))
                 with self._lock:
                     self.pods_created += 1
+                    # actuation truly starts here: the kubelet.actuation
+                    # span runs create→Ready so the STS→pod→bind watch
+                    # hops count as cluster time, not controller gaps
+                    self._created_at[(ns or "", pod_name)] = \
+                        time.monotonic()
             except errors.AlreadyExists:
                 pass  # informer cache lagging a pod we already made
         # scale-down (stop annotation → replicas=0): delete extra ordinals
@@ -305,8 +320,13 @@ class FakeKubelet:
             delay = self.latency.sample(self._rng)
         with self._lock:
             self.samples[(ns or "", name)] = delay
-        self._flipper.call_later(delay, lambda: self._flip_ready(ns, name,
-                                                                 uid))
+            scheduled_at = self._created_at.pop(
+                (ns or "", name), time.monotonic()
+            )
+        self._flipper.call_later(
+            delay,
+            lambda: self._flip_ready(ns, name, uid, scheduled_at),
+        )
 
     def _bind(self, pod: dict) -> bool:
         """Assign a node; False when the pod is unbindable (it must stay
@@ -354,7 +374,8 @@ class FakeKubelet:
                         namespace=ns)
         return True
 
-    def _flip_ready(self, ns: str, name: str, uid: str) -> None:
+    def _flip_ready(self, ns: str, name: str, uid: str,
+                    scheduled_at: float | None = None) -> None:
         try:
             pod = self.kube.get("pods", name, namespace=ns)
         except errors.NotFound:
@@ -387,3 +408,14 @@ class FakeKubelet:
         sts = (pod["metadata"].get("labels") or {}).get("statefulset")
         if sts:
             self._sync_sts_status(ns, sts)
+        if self._tracer is not None and scheduled_at is not None:
+            # span runs pod-create → Ready-visible-on-the-STS: everything
+            # the cluster (STS controller + scheduler + kubelet) did, so
+            # attribution books it as actuation rather than a gap
+            nb = (pod["metadata"].get("labels") or {}).get("notebook-name")
+            if nb:
+                self._tracer.record(
+                    "kubelet.actuation",
+                    obs.object_key("notebooks", ns, nb),
+                    scheduled_at, time.monotonic(), attrs={"pod": name},
+                )

@@ -61,7 +61,9 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (
 from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: E501
     Tracker,
     percentiles,
+    stage_attribution,
 )
+from service_account_auth_improvements_tpu.controlplane.obs import Tracer
 from service_account_auth_improvements_tpu.controlplane.engine import (
     Informer,
     Manager,
@@ -125,8 +127,11 @@ class _NotebookWorld:
                  fetch_kernels=None, scheduler: bool = False):
         self.kube = FakeKube()
         self.tracker = Tracker(scenario)
-        self.tracker.instrument_kube(self.kube)
-        self.mgr = Manager(self.kube)
+        # per-world tracer: the span source for per-stage attribution,
+        # isolated so scenarios can't read each other's lifecycles
+        self.trace = Tracer(max_traces=4096)
+        self.tracker.instrument_kube(self.kube, tracer=self.trace)
+        self.mgr = Manager(self.kube, tracer=self.trace)
         self.reconciler = NotebookReconciler(self.kube)
         self.tracker.instrument_reconciler(self.reconciler)
         self.reconciler.register(self.mgr)
@@ -148,10 +153,11 @@ class _NotebookWorld:
             self.tracker.instrument_reconciler(self.culler)
             self.culler.register(self.mgr)
         self.actuator = FakeKubelet(self.kube, cfg.actuation,
-                                    seed=cfg.seed)
+                                    seed=cfg.seed, tracer=self.trace)
         self.tracker.actuation_fn = self.actuator.actuation_for
         self._want: dict[tuple[str, str], int] = {}
-        self._ready_inf = Informer(self.kube, "notebooks", group=GROUP)
+        self._ready_inf = Informer(self.kube, "notebooks", group=GROUP,
+                                   tracer=self.trace)
         self._ready_inf.add_handler(self._on_notebook)
 
     def _on_notebook(self, ev_type: str, nb: dict) -> None:
@@ -175,6 +181,10 @@ class _NotebookWorld:
         self.actuator.stop()
         self.mgr.stop()
 
+    def attribution(self) -> dict:
+        """Per-stage create→Ready attribution from the world's spans."""
+        return stage_attribution(self.tracker.records(), self.trace)
+
     def create_jobs(self, names: list[str], ns: str, tpu: dict | None,
                     want_ready: int):
         """One callable per CR: stamp the timeline, then POST."""
@@ -195,6 +205,7 @@ def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
     ok = world.tracker.wait_ready(keys, cfg.timeout)
     world.stop()
     summary = world.tracker.summary()
+    summary["stage_attribution"] = world.attribution()
     extra.setdefault("gate_violations", world.actuator.gate_violations)
     extra.setdefault("pods_created", world.actuator.pods_created)
     extra.setdefault("pods_ready", world.actuator.pods_ready)
@@ -259,6 +270,7 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
                 gated_left += 1
     world.stop()
     summary = world.tracker.summary()
+    summary["stage_attribution"] = world.attribution()
     summary["extra"] = {
         "hosts_per_gang": 4,
         "gang_scheduled": gang_scheduled,
@@ -361,6 +373,7 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
             ok = False
     world.stop()
     summary = world.tracker.summary()
+    summary["stage_attribution"] = world.attribution()
     summary["extra"] = {
         "cycles": cycles,
         "culled": culled_total,
@@ -644,6 +657,7 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
     ok = len(deleted) == len(names) and double_bookings == 0
     world.stop()
     summary = world.tracker.summary()
+    summary["stage_attribution"] = world.attribution()
     summary["extra"] = {
         "pools": SCHED_POOLS,
         "time_to_placement_ms": percentiles(list(placement_ms.values())),

@@ -23,6 +23,7 @@ import dataclasses
 import threading
 import time
 
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.metrics import (
     Counter,
     Histogram,
@@ -166,13 +167,25 @@ class Tracker:
 
         reconciler.reconcile = wrapped
 
-    def instrument_kube(self, kube) -> None:
+    def instrument_kube(self, kube, tracer=None) -> None:
         """Wrap ``FakeKube.create`` to stamp the first owned-STS create
-        per CR at the apiserver write itself (no watch-dispatch skew)."""
+        per CR at the apiserver write itself (no watch-dispatch skew).
+        With a tracer, the notebook POST itself (apiserver lock + watch
+        fanout — real time under burst load) becomes an
+        ``apiserver.create`` span on the CR's trace."""
         orig = kube.create
 
         def create(plural, obj, namespace=None, group=None):
+            t0 = time.monotonic()
             out = orig(plural, obj, namespace=namespace, group=group)
+            if tracer is not None and plural == "notebooks":
+                meta = out.get("metadata") or {}
+                tracer.record(
+                    "apiserver.create",
+                    obs.object_key("notebooks", meta.get("namespace"),
+                                   meta.get("name", "")),
+                    t0, time.monotonic(),
+                )
             if plural == "statefulsets":
                 meta = out.get("metadata") or {}
                 nb = (meta.get("labels") or {}).get("notebook-name")
@@ -249,3 +262,105 @@ class Tracker:
             "requeues": self.requeues,
             "backoffs": self.backoffs,
         }
+
+
+# -------------------------------------------------- per-stage attribution
+
+#: cptrace span name → attribution stage. Claim priority (the tuple
+#: order) resolves overlaps: the kubelet's injected latency is ground
+#: truth; admission-queue waits subsume the workqueue/reconcile churn
+#: that happens while parked; what remains books to queue/work/delivery.
+STAGE_OF_SPAN = {
+    "kubelet.actuation": "kubelet",
+    "sched.queue_wait": "sched_queue_wait",
+    "queue.wait": "queue_wait",
+    "reconcile": "reconcile",
+    "apiserver.create": "apiserver",
+    "informer.deliver": "deliver",
+}
+STAGE_ORDER = ("kubelet", "sched_queue_wait", "queue_wait", "reconcile",
+               "apiserver", "deliver")
+
+
+def _merge(intervals: list) -> list:
+    """Sorted union of (start, end) intervals."""
+    out: list = []
+    for a, b in sorted(intervals):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def _subtract(intervals: list, claimed: list) -> list:
+    """``intervals`` minus already-claimed time (both merged/sorted)."""
+    out = []
+    for a, b in intervals:
+        cur = a
+        for ca, cb in claimed:
+            if cb <= cur or ca >= b:
+                continue
+            if ca > cur:
+                out.append((cur, ca))
+            cur = max(cur, cb)
+            if cur >= b:
+                break
+        if cur < b:
+            out.append((cur, b))
+    return out
+
+
+def stage_attribution(records, tracer, plural: str = "notebooks") -> dict:
+    """Where each CR's create→Ready wall time went, from its cptrace
+    spans: per-stage DISJOINT milliseconds (overlaps resolved by
+    STAGE_ORDER claim priority, so stages can never sum past the total)
+    plus the attributed fraction — the share of wall time the trace
+    explains. The regression gate on the full run wants ≥ 0.95."""
+    per_stage: dict[str, list] = {}
+    fractions: list[float] = []
+    unattributed: list[float] = []
+    for rec in records:
+        if rec.created is None or rec.ready is None:
+            continue
+        total = rec.ready - rec.created
+        if total <= 0:
+            continue
+        snap = tracer.snapshot(
+            key=obs.object_key(plural, rec.namespace, rec.name)
+        )
+        if snap is None:
+            continue
+        by_stage: dict[str, list] = {}
+        for s in snap["spans"]:
+            stage = STAGE_OF_SPAN.get(s["name"])
+            if stage is None or s["end"] is None:
+                continue
+            a = max(s["start"], rec.created)
+            b = min(s["end"], rec.ready)
+            if b > a:
+                by_stage.setdefault(stage, []).append((a, b))
+        claimed: list = []
+        for stage in STAGE_ORDER:
+            mine = _subtract(_merge(by_stage.get(stage, [])), claimed)
+            per_stage.setdefault(stage, []).append(
+                sum(b - a for a, b in mine) * 1000.0
+            )
+            claimed = _merge(claimed + mine)
+        accounted = sum(b - a for a, b in claimed)
+        fractions.append(accounted / total)
+        unattributed.append((total - accounted) * 1000.0)
+    if not fractions:
+        return {}
+    return {
+        "stages_ms": {
+            stage: percentiles(vals)
+            for stage, vals in per_stage.items() if any(vals)
+        },
+        "unattributed_ms": percentiles(unattributed),
+        "attributed_fraction": {
+            "min": round(min(fractions), 4),
+            "mean": round(sum(fractions) / len(fractions), 4),
+            "n": len(fractions),
+        },
+    }

@@ -13,3 +13,7 @@ from service_account_auth_improvements_tpu.controlplane.engine.manager import ( 
     Request,
     Result,
 )
+from service_account_auth_improvements_tpu.controlplane.engine.metrics import (  # noqa: F401
+    EngineMetrics,
+    engine_metrics,
+)

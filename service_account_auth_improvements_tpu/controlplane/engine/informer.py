@@ -10,20 +10,39 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
+from service_account_auth_improvements_tpu.controlplane.engine.metrics import (
+    engine_metrics,
+)
 from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    trace as obs_trace,
+)
 
 log = logging.getLogger(__name__)
 
 
 class Informer:
+    #: labels whose value names the OWNING traced object: child events
+    #: (pods/STS carry notebook-name across the whole control plane) are
+    #: delivered onto the owner's trace, so a notebook's timeline shows
+    #: the watch hops of its children, not just its own events
+    OWNER_TRACE_LABELS = (("notebook-name", "notebooks"),)
+
     def __init__(self, client, plural: str, group: str | None = None,
-                 namespace: str | None = None, resync_period: float = 0.0):
+                 namespace: str | None = None, resync_period: float = 0.0,
+                 tracer=None):
         self.client = client
         self.plural = plural
         self.group = group
         self.namespace = namespace
         self.resync_period = resync_period
+        #: watch→handler delivery lag rides the engine families; traced
+        #: objects (a manager passes its tracer) additionally get an
+        #: ``informer.deliver`` span per event
+        self._metrics = engine_metrics()
+        self._tracer = tracer
         self._handlers: list = []
         self._cache: dict[tuple, dict] = {}
         self._lock = threading.RLock()
@@ -65,12 +84,48 @@ class Informer:
         m = obj["metadata"]
         return (m.get("namespace") or "", m["name"])
 
-    def _dispatch(self, ev_type: str, obj: dict) -> None:
+    def _dispatch(self, ev_type: str, obj: dict,
+                  emitted: float | None = None) -> None:
+        received = time.monotonic()
+        # the apiserver may stamp the event's emission instant (FakeKube
+        # does — same process, same monotonic clock): lag then covers the
+        # time the event sat in the watch channel behind a backlog, the
+        # part of "watch→handler delivery" a receipt-side clock can't see
+        start = received
+        if emitted is not None and 0 <= received - emitted < 300:
+            start = emitted
         for fn in self._handlers:
             try:
                 fn(ev_type, obj)
             except Exception:  # handler bugs must not kill the watch loop
                 log.exception("informer handler failed (%s)", self.plural)
+        done = time.monotonic()
+        self._metrics.informer_delivery.labels(self.plural).observe(
+            done - start
+        )
+        if self._tracer is not None:
+            meta = obj.get("metadata") or {}
+            name = meta.get("name")
+            if not name:
+                return
+            keys = [obs_trace.object_key(
+                self.plural, meta.get("namespace"), name
+            )]
+            labels = meta.get("labels") or {}
+            for label, owner_plural in self.OWNER_TRACE_LABELS:
+                if owner_plural != self.plural and labels.get(label):
+                    keys.append(obs_trace.object_key(
+                        owner_plural, meta.get("namespace"), labels[label]
+                    ))
+            for key in keys:
+                # only objects already under trace — pods/events churn
+                # must not allocate traces of their own
+                if self._tracer.has(key):
+                    self._tracer.record(
+                        "informer.deliver", key, start, done,
+                        attrs={"event": ev_type, "resource": self.plural,
+                               "object": name},
+                    )
 
     def _relist(self) -> str:
         """Full list: replace the cache, dispatch deltas, return the list RV.
@@ -103,15 +158,21 @@ class Informer:
 
     def _run(self) -> None:
         rv: str | None = None  # None → must (re)list before watching
+        failures = 0           # consecutive list/watch errors
         while not self._stop.is_set():
             try:
                 if rv is None:
                     rv = self._relist()
+                    failures = 0
                 for ev in self.client.watch(
                     self.plural, namespace=self.namespace,
                     resource_version=rv, group=self.group,
                     timeout=self.resync_period or 30,
                 ):
+                    # real progress (any event, even BOOKMARK) resets
+                    # the outage counter; idle watch timeouts don't
+                    # touch it either way
+                    failures = 0
                     if self._stop.is_set():
                         return
                     et, obj = ev.get("type"), ev.get("object")
@@ -124,6 +185,7 @@ class Informer:
                                 or status.get("reason") in ("Expired",
                                                             "Gone")):
                             rv = None
+                            self._synced.clear()
                         else:
                             self._stop.wait(1.0)
                         break
@@ -141,16 +203,30 @@ class Informer:
                             self._cache.pop(key, None)
                         else:
                             self._cache[key] = obj
-                    self._dispatch(et, obj)
+                    self._dispatch(et, obj, emitted=ev.get("emittedAt"))
                 # normal watch expiry (timeout): re-watch from the last RV
-                # without relisting
+                # without relisting. A clean-but-idle round trip is also
+                # progress — without this, blips spread over days would
+                # accumulate to the outage threshold on a quiet resource.
+                failures = 0
             except errors.Gone:
                 log.info("informer %s: resourceVersion expired; relisting",
                          self.plural)
                 rv = None
+                self._synced.clear()
             except Exception:
                 if self._stop.is_set():
                     return
+                failures += 1
                 log.exception("informer %s list/watch failed; retrying",
                               self.plural)
+                if failures >= 3:
+                    # a sustained outage, not a blip: the cache is of
+                    # unknown staleness, so readiness
+                    # (Manager.informers_synced) must read false until a
+                    # relist succeeds — a single failed watch still
+                    # resumes from the last RV without the O(objects)
+                    # relist (the reflector contract)
+                    rv = None
+                    self._synced.clear()
                 self._stop.wait(1.0)

@@ -18,9 +18,13 @@ import time
 from service_account_auth_improvements_tpu.controlplane.engine.informer import (
     Informer,
 )
+from service_account_auth_improvements_tpu.controlplane.engine.metrics import (
+    engine_metrics,
+)
 from service_account_auth_improvements_tpu.controlplane.engine.queue import (
     RateLimitingQueue,
 )
+from service_account_auth_improvements_tpu.controlplane import obs
 
 log = logging.getLogger(__name__)
 
@@ -57,9 +61,16 @@ class Controller:
                  workers: int = 1):
         self.manager = manager
         self.reconciler = reconciler
-        self.queue = RateLimitingQueue()
+        self.name = type(reconciler).__name__
+        self.metrics = engine_metrics()
+        self.queue = RateLimitingQueue(name=self.name,
+                                       metrics=self.metrics)
+        self.queue.trace_hook = self._note_queue_wait
         self.workers = workers
         self._threads: list[threading.Thread] = []
+        # hook → worker handoff stays on the worker's own thread (the
+        # hook fires inside queue.get), so a thread-local carries it
+        self._tl = threading.local()
 
     def enqueue(self, request: Request) -> None:
         self.queue.add(request)
@@ -67,25 +78,86 @@ class Controller:
     def enqueue_after(self, request: Request, delay: float) -> None:
         self.queue.add_after(request, delay)
 
+    def _note_queue_wait(self, req: Request, enqueued: float,
+                         dequeued: float) -> None:
+        self._tl.wait = (req, enqueued)
+
     def _worker(self) -> None:
+        m = self.metrics
+        tracer = self.manager.tracer
         while True:
             req = self.queue.get()
             if req is None:
                 return
+            m.active_workers.labels(self.name).inc()
+            started = time.monotonic()
+            # every tracer interaction is fenced: Manager(tracer=...) is
+            # an injection point, and a raising tracer must never kill
+            # the worker or skip queue.done (which would wedge the key
+            # in _processing forever)
+            wait = getattr(self._tl, "wait", None)
+            self._tl.wait = None
+            if wait is not None and wait[0] == req:
+                try:
+                    # span ends HERE, not at dequeue: worker wake-up
+                    # delay (GIL/scheduler) is time the item waited
+                    tracer.record(
+                        "queue.wait",
+                        obs.object_key(self.reconciler.resource,
+                                       req.namespace, req.name),
+                        wait[1], started, attrs={"queue": self.name},
+                    )
+                except Exception:
+                    pass
+            outcome = "success"
+            span = None
             try:
-                result = self.reconciler.reconcile(req)
-                self.queue.forget(req)
-                if result and result.requeue_after:
-                    self.queue.add_after(req, result.requeue_after)
-                elif result and result.requeue:
-                    self.queue.add(req)
-            except Exception:
-                log.exception(
-                    "reconcile %s/%s failed; backing off",
-                    req.namespace, req.name,
+                span = tracer.span(
+                    "reconcile",
+                    key=obs.object_key(self.reconciler.resource,
+                                       req.namespace, req.name),
+                    attrs={"controller": self.name},
                 )
-                self.queue.add_rate_limited(req)
+                span.__enter__()
+            except Exception:
+                span = None
+            try:
+                try:
+                    result = self.reconciler.reconcile(req)
+                    self.queue.forget(req)
+                    if result and result.requeue_after:
+                        outcome = "requeue_after"
+                        self.queue.add_after(req, result.requeue_after)
+                    elif result and result.requeue:
+                        outcome = "requeue"
+                        self.queue.add(req)
+                except Exception as e:
+                    # the span must close tagged even though the
+                    # exception stops here (backoff, not propagation)
+                    outcome = "error"
+                    if span is not None:
+                        try:
+                            span.record_error(e)
+                        except Exception:
+                            pass
+                    m.reconcile_errors.labels(self.name).inc()
+                    log.exception(
+                        "reconcile %s/%s failed; backing off",
+                        req.namespace, req.name,
+                    )
+                    self.queue.add_rate_limited(req)
             finally:
+                if span is not None:
+                    try:
+                        span.set_attr("outcome", outcome)
+                        span.__exit__(None, None, None)
+                    except Exception:
+                        pass
+                elapsed = time.monotonic() - started
+                m.reconcile_time.labels(self.name).observe(elapsed)
+                m.reconcile_total.labels(self.name, outcome).inc()
+                m.workqueue_work_duration.labels(self.name).observe(elapsed)
+                m.active_workers.labels(self.name).dec()
                 self.queue.done(req)
 
     def start(self) -> None:
@@ -103,10 +175,13 @@ class Controller:
 
 class Manager:
     def __init__(self, client, namespace: str | None = None,
-                 default_workers: int = 1):
+                 default_workers: int = 1, tracer=None):
         self.client = client
         self.namespace = namespace
         self.default_workers = default_workers
+        #: per-manager tracer (benches isolate scenarios); defaults to
+        #: the process-global one so binaries need no wiring
+        self.tracer = tracer if tracer is not None else obs.TRACER
         self._informers: dict[tuple, Informer] = {}
         self._controllers: list[Controller] = []
         self._started = False
@@ -122,9 +197,15 @@ class Manager:
                     "the informer thread would never run"
                 )
             self._informers[key] = Informer(
-                self.client, plural, group=group, namespace=self.namespace
+                self.client, plural, group=group, namespace=self.namespace,
+                tracer=self.tracer,
             )
         return self._informers[key]
+
+    def informers_synced(self) -> bool:
+        """True when every registered informer has completed its initial
+        list — the readiness condition the ops /readyz probes."""
+        return all(inf.has_synced() for inf in self._informers.values())
 
     def add_reconciler(self, reconciler: Reconciler,
                        workers: int | None = None) -> Controller:

@@ -1,0 +1,97 @@
+"""Engine metrics: the controller-runtime / client-go parity families.
+
+The reference binaries got ``workqueue_*`` and ``controller_runtime_*``
+for free from controller-runtime's manager; our engine rebuilt the
+manager but not the instrumentation, so every deployment was blind to
+queue depth and reconcile latency. These families are registered ONCE
+per process on the global REGISTRY via :func:`engine_metrics` — every
+binary that runs a Manager (or even a bare Informer) inherits them on
+its existing ``/metrics`` endpoint with zero wiring.
+
+Labels mirror upstream: workqueue series carry ``name`` (the queue =
+the reconciler class), controller series carry ``controller``.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+)
+
+#: sub-ms informer hops up to multi-second stuck reconciles
+DURATION_BUCKETS = (
+    0.0001, 0.0005, 0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1, 2.5, 5, 10, 30, 60,
+)
+
+
+class EngineMetrics:
+    def __init__(self, registry=None):
+        self.workqueue_depth = Gauge(
+            "workqueue_depth",
+            "Current number of items waiting in the workqueue",
+            ("name",), registry=registry,
+        )
+        self.workqueue_adds = Counter(
+            "workqueue_adds_total",
+            "Items added to the workqueue",
+            ("name",), registry=registry,
+        )
+        self.workqueue_queue_duration = Histogram(
+            "workqueue_queue_duration_seconds",
+            "Time an item waits in the workqueue before processing",
+            ("name",), buckets=DURATION_BUCKETS, registry=registry,
+        )
+        self.workqueue_work_duration = Histogram(
+            "workqueue_work_duration_seconds",
+            "Time processing an item from the workqueue takes",
+            ("name",), buckets=DURATION_BUCKETS, registry=registry,
+        )
+        self.workqueue_retries = Counter(
+            "workqueue_retries_total",
+            "Items re-queued with backoff after a failure",
+            ("name",), registry=registry,
+        )
+        self.reconcile_time = Histogram(
+            "controller_runtime_reconcile_time_seconds",
+            "Length of time per reconciliation",
+            ("controller",), buckets=DURATION_BUCKETS, registry=registry,
+        )
+        self.reconcile_total = Counter(
+            "controller_runtime_reconcile_total",
+            "Reconciliations per controller by result",
+            ("controller", "result"), registry=registry,
+        )
+        self.reconcile_errors = Counter(
+            "controller_runtime_reconcile_errors_total",
+            "Reconciliations that raised, per controller",
+            ("controller",), registry=registry,
+        )
+        self.active_workers = Gauge(
+            "controller_runtime_active_workers",
+            "Workers currently running a reconciliation",
+            ("controller",), registry=registry,
+        )
+        self.informer_delivery = Histogram(
+            "informer_event_delivery_seconds",
+            "Watch event receipt to last handler return, per resource",
+            ("resource",), buckets=DURATION_BUCKETS, registry=registry,
+        )
+
+
+_lock = threading.Lock()
+_default: EngineMetrics | None = None
+
+
+def engine_metrics() -> EngineMetrics:
+    """The process-wide instance on the global REGISTRY (the registry
+    rejects duplicate names, so construction must be once-only)."""
+    global _default
+    with _lock:
+        if _default is None:
+            _default = EngineMetrics()
+        return _default

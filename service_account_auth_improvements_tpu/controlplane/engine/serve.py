@@ -1,21 +1,29 @@
-"""Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz.
+"""Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz,
+/debug/tracez, /debug/threadz.
 
 The manager-port surface of the reference binaries (metrics on :8080,
-probes — components/notebook-controller/main.go:64-131).
+probes — components/notebook-controller/main.go:64-131), plus the
+observability pages the reference never had: /debug/tracez renders the
+process's recent lifecycle traces slowest-first (obs/tracez.py;
+``?key=notebooks/<ns>/<name>`` filters to one object, ``?limit=N``
+bounds the page).
 """
 
 from __future__ import annotations
 
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
 
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
 
 
 def serve_ops(port: int, registry=None, ready_check=None,
-              host: str = "0.0.0.0") -> ThreadingHTTPServer:
+              host: str = "0.0.0.0", tracer=None) -> ThreadingHTTPServer:
     """Start the ops endpoint in a daemon thread; returns the server."""
     reg = registry if registry is not None else REGISTRY
+    trc = tracer if tracer is not None else obs.TRACER
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -34,6 +42,19 @@ def serve_ops(port: int, registry=None, ready_check=None,
                 ok = ready_check() if ready_check else True
                 body = b"ok" if ok else b"not ready"
                 self.send_response(200 if ok else 503)
+            elif self.path.startswith("/debug/tracez"):
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    limit = int(q.get("limit", ["50"])[0])
+                except ValueError:
+                    limit = 50
+                if limit <= 0:  # ?limit=-1 must not invert the slice
+                    limit = 50
+                key = q.get("key", [None])[0]
+                body = obs.render_tracez(trc, limit=limit,
+                                         key=key).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
             elif self.path.startswith("/debug/threadz"):
                 # the Python analog of Go's pprof goroutine dump
                 # (SURVEY.md §5: the reference has no profiling wiring;

@@ -66,8 +66,12 @@ class KfamApp:
             get_env_default("USERID_PREFIX", "")
         reg = registry or Registry()
         self.registry = reg
+        # distinct family from monitoring.py's request_kf_total: the
+        # label sets differ (path/status vs component/action), and one
+        # metric name with two shapes is invalid the moment both land in
+        # a single registry (tools/metrics_lint.py enforces uniqueness)
         self.requests = Counter(
-            "request_kf_total", "KFAM requests", ("path", "status"),
+            "kfam_request_total", "KFAM requests", ("path", "status"),
             registry=reg,
         )
 

@@ -157,7 +157,13 @@ class FakeKube:
     def _emit(self, res: Resource, ev_type: str, obj: dict):
         hkey = (res.group, res.plural)
         rv = int(obj["metadata"]["resourceVersion"])
-        event = {"type": ev_type, "object": copy.deepcopy(obj)}
+        # emittedAt is an optional protocol extension the in-process
+        # informer uses to measure true watch→handler delivery lag (an
+        # event can sit in a watcher's channel behind a backlog); it is
+        # meaningless across processes (monotonic clock) and ignored by
+        # everything else
+        event = {"type": ev_type, "object": copy.deepcopy(obj),
+                 "emittedAt": time.monotonic()}
         self._history.setdefault(hkey, []).append((rv, event))
         if len(self._history[hkey]) > 4096:
             dropped = self._history[hkey][:-2048]

@@ -6,4 +6,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics.registry import 
     Histogram,
     Registry,
     REGISTRY,
+    escape_help,
+    escape_label_value,
+    format_labels,
 )

@@ -10,6 +10,33 @@ from __future__ import annotations
 import threading
 
 
+def escape_label_value(value: str) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote, and line-feed must be escaped or the line
+    is unparseable (one series can corrupt the whole scrape)."""
+    return (str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def escape_help(text: str) -> str:
+    """HELP lines escape backslash and line-feed (quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_labels(names, values) -> str:
+    """``{a="x",b="y"}`` (or "" for the unlabeled series) — the ONE
+    label-formatting path; Counter/Gauge/Histogram all render through it
+    so escaping can never drift between metric kinds."""
+    if not values:
+        return ""
+    inner = ",".join(
+        f'{k}="{escape_label_value(v)}"' for k, v in zip(names, values)
+    )
+    return "{" + inner + "}"
+
+
 class _Metric:
     kind = "untyped"
 
@@ -30,16 +57,11 @@ class _Metric:
         return _Child(self, tuple(str(v) for v in values))
 
     def _fmt_labels(self, values: tuple) -> str:
-        if not values:
-            return ""
-        inner = ",".join(
-            f'{k}="{v}"' for k, v in zip(self.label_names, values)
-        )
-        return "{" + inner + "}"
+        return format_labels(self.label_names, values)
 
     def render(self) -> str:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} {self.kind}",
         ]
         with self._lock:
@@ -59,6 +81,9 @@ class _Child:
     def inc(self, amount: float = 1.0):
         self.metric._add(self.values, amount)
 
+    def dec(self, amount: float = 1.0):
+        self.metric._add(self.values, -amount)
+
     def set(self, value: float):
         self.metric._set(self.values, value)
 
@@ -73,11 +98,22 @@ class Counter(_Metric):
         self._add((), amount)
 
     def _add(self, key: tuple, amount: float):
+        if amount < 0:
+            # counters are monotonic; a decrement (e.g. labels().dec(),
+            # which the shared _Child also exposes for gauges) would
+            # read as a counter reset and corrupt every rate() built on
+            # the series
+            raise ValueError(
+                f"{self.name}: counters can only increase"
+            )
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, *label_values) -> float:
-        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+        with self._lock:
+            return self._values.get(
+                tuple(str(v) for v in label_values), 0.0
+            )
 
 
 class Gauge(_Metric):
@@ -89,6 +125,9 @@ class Gauge(_Metric):
     def inc(self, amount: float = 1.0):
         self._add((), amount)
 
+    def dec(self, amount: float = 1.0):
+        self._add((), -amount)
+
     def _set(self, key: tuple, value: float):
         with self._lock:
             self._values[key] = float(value)
@@ -98,7 +137,10 @@ class Gauge(_Metric):
             self._values[key] = self._values.get(key, 0.0) + amount
 
     def value(self, *label_values) -> float:
-        return self._values.get(tuple(str(v) for v in label_values), 0.0)
+        with self._lock:
+            return self._values.get(
+                tuple(str(v) for v in label_values), 0.0
+            )
 
 
 class Histogram(_Metric):
@@ -130,23 +172,24 @@ class Histogram(_Metric):
 
     def render(self) -> str:
         lines = [
-            f"# HELP {self.name} {self.help}",
+            f"# HELP {self.name} {escape_help(self.help)}",
             f"# TYPE {self.name} histogram",
         ]
+        bucket_names = self.label_names + ("le",)
         with self._lock:
             for key in sorted(self._counts):
                 counts = self._counts[key]  # already cumulative per bucket
                 for i, b in enumerate(self.buckets):
-                    labels = dict(zip(self.label_names, key))
-                    labels["le"] = str(b)
-                    inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
                     lines.append(
-                        f"{self.name}_bucket{{{inner}}} {counts[i]}"
+                        f"{self.name}_bucket"
+                        f"{format_labels(bucket_names, key + (b,))} "
+                        f"{counts[i]}"
                     )
-                labels = dict(zip(self.label_names, key))
-                labels["le"] = "+Inf"
-                inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
-                lines.append(f"{self.name}_bucket{{{inner}}} {counts[-1]}")
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{format_labels(bucket_names, key + ('+Inf',))} "
+                    f"{counts[-1]}"
+                )
                 base = self._fmt_labels(key)
                 lines.append(f"{self.name}_sum{base} {self._sums[key]}")
                 lines.append(f"{self.name}_count{base} {counts[-1]}")

@@ -1,0 +1,18 @@
+"""cptrace: end-to-end reconcile tracing (docs/observability.md)."""
+
+from service_account_auth_improvements_tpu.controlplane.obs.trace import (  # noqa: F401,E501
+    TRACE_ANNOTATION,
+    TRACER,
+    Span,
+    SpanContext,
+    Tracer,
+    current_tracer,
+    object_key,
+    object_trace_id,
+    record,
+    span,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.tracez import (  # noqa: F401,E501
+    render_trace,
+    render_tracez,
+)

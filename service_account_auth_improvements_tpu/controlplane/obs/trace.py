@@ -1,0 +1,405 @@
+"""cptrace: dependency-free per-object lifecycle tracing.
+
+The control plane answers "is my notebook up?" but not "where did the
+3.5 s time-to-placement go?" — cpbench stopwatches from the outside
+while the engine, controllers, and scheduler are internally dark
+(NotebookOS, arXiv:2503.20591, makes the case that interactive-notebook
+platforms live or die on spawn-latency visibility). This module is the
+substrate: spans grouped into per-object traces, kept in a bounded
+in-memory ring, surfaced via ``/debug/tracez`` (engine/serve.py), the
+dashboard trace API, and cpbench's per-stage attribution.
+
+Design points, all stdlib:
+
+- A **trace** is identified by an *object key* (``notebooks/<ns>/<name>``
+  — see :func:`object_key`) plus an opaque trace id. The id is stamped
+  on the CR as the ``tpukf.dev/trace-id`` annotation at admission
+  (controllers/notebook.py) so out-of-process consumers can correlate;
+  in-process lookups go by key.
+- **Propagation** rides a contextvar: the engine opens a ``reconcile``
+  span around every attempt, and any span opened inside (scheduler
+  stages, notebook child creation) parents onto it automatically —
+  reconciles run synchronously on worker threads, so context locality
+  holds.
+- **Retroactive spans** (:meth:`Tracer.record`) cover waits measured
+  after the fact: workqueue enqueue→dequeue, admission-queue wait,
+  fake-kubelet actuation. They attach to the key's trace directly, no
+  context needed — the recorder often runs under a *different* object's
+  reconcile (a placement pass places queued peers).
+- The ring evicts least-recently-touched traces beyond ``max_traces``
+  and caps spans per trace, so a controller that runs for a month holds
+  a bounded window of recent lifecycles, never the history.
+- **Exporter hook**: every finished span is handed to each callable in
+  ``Tracer.exporters`` (off-box shipping, test capture); exporter bugs
+  are swallowed — tracing must never take down a reconcile.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import dataclasses
+import threading
+import time
+import uuid
+
+#: stamped on the CR at admission so any process (or a human with
+#: kubectl) can correlate the object with controller-side traces
+TRACE_ANNOTATION = "tpukf.dev/trace-id"
+
+#: (tracer, SpanContext, object key) of the innermost open span
+_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "tpukf_trace_ctx", default=None
+)
+
+
+def object_key(plural: str, namespace: str | None, name: str) -> str:
+    """Canonical trace key for one API object."""
+    return f"{plural}/{namespace or ''}/{name}"
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+
+class Span:
+    """One timed operation. Mutable while open; snapshots into its trace
+    at :meth:`finish` (also the ``with`` exit). Exceptions escaping a
+    ``with span:`` block are tagged (``error=True``) automatically;
+    callers that swallow exceptions themselves tag via
+    :meth:`record_error` — either way the span still closes."""
+
+    __slots__ = ("tracer", "name", "trace_id", "span_id", "parent_id",
+                 "key", "start", "end", "attrs", "error", "_token",
+                 "_finished")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_id: str | None, key: str | None, attrs: dict):
+        self.tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = uuid.uuid4().hex[:8]
+        self.parent_id = parent_id
+        self.key = key
+        self.start = time.monotonic()
+        self.end: float | None = None
+        self.attrs = attrs
+        self.error = False
+        self._token = None
+        self._finished = False
+
+    def set_attr(self, key: str, value) -> None:
+        self.attrs[key] = value
+
+    def record_error(self, exc: BaseException) -> None:
+        self.error = True
+        self.attrs["error.type"] = type(exc).__name__
+        self.attrs["error.message"] = str(exc)[:200]
+
+    def __enter__(self) -> "Span":
+        self._token = _CTX.set(
+            (self.tracer, SpanContext(self.trace_id, self.span_id),
+             self.key)
+        )
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None:
+            self.record_error(exc)
+        self.finish()
+        return False
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        if self._token is not None:
+            try:
+                _CTX.reset(self._token)
+            except ValueError:
+                pass  # finished from a different context; nothing to pop
+            self._token = None
+        self.end = time.monotonic()
+        self.tracer._finish(self)
+
+
+class _Trace:
+    __slots__ = ("trace_id", "key", "created", "spans", "dropped",
+                 "bound", "once")
+
+    def __init__(self, trace_id: str, key: str | None):
+        self.trace_id = trace_id
+        self.key = key
+        self.created = time.monotonic()
+        self.spans: list[dict] = []
+        self.dropped = 0
+        #: True once bind() explicitly assigned this id (annotation/uid)
+        self.bound = False
+        #: names recorded with once=True — survives ring eviction of the
+        #: span itself (a wrapped ring must not re-fire 'notebook.ready'
+        #: days later with a fresh timestamp)
+        self.once: set[str] = set()
+
+
+class Tracer:
+    def __init__(self, max_traces: int = 1024,
+                 max_spans_per_trace: int = 512):
+        self.max_traces = max_traces
+        self.max_spans_per_trace = max_spans_per_trace
+        self._lock = threading.Lock()
+        self._traces: dict[str, _Trace] = {}   # insertion = recency order
+        self._by_key: dict[str, str] = {}
+        #: callables invoked with each finished span dict
+        self.exporters: list = []
+
+    # ------------------------------------------------------------ binding
+
+    def trace_id_for(self, key: str) -> str:
+        """The key's trace id, creating the trace on first touch."""
+        with self._lock:
+            tid = self._by_key.get(key)
+            if tid is not None and tid in self._traces:
+                return tid
+            return self._new_trace_locked(key).trace_id
+
+    def has(self, key: str) -> bool:
+        with self._lock:
+            return self._by_key.get(key) in self._traces
+
+    def bind(self, key: str, trace_id: str) -> None:
+        """Bind ``key`` to an externally-chosen trace id (the
+        ``tpukf.dev/trace-id`` annotation, derived from the CR's uid).
+
+        A key whose current trace was only ever auto-created (spans
+        recorded before the first reconcile could bind — queue waits,
+        the create call) is RENAMED to the new id, keeping those spans:
+        same incarnation, just late identification. A key whose trace
+        was already explicitly bound to a DIFFERENT id starts fresh —
+        that is a deleted-and-recreated object (new uid), and mixing two
+        lifecycles under a reused name is exactly what must not happen.
+        The old incarnation's trace stays in the ring until evicted."""
+        if not trace_id:
+            return
+        with self._lock:
+            cur_id = self._by_key.get(key)
+            cur = self._traces.get(cur_id) if cur_id else None
+            if cur is not None and cur.trace_id == trace_id:
+                cur.bound = True
+                return
+            if cur is not None and not cur.bound \
+                    and trace_id not in self._traces:
+                del self._traces[cur.trace_id]
+                cur.trace_id = trace_id
+                cur.bound = True
+                self._traces[trace_id] = cur
+                self._by_key[key] = trace_id
+                return
+            if trace_id not in self._traces:
+                self._new_trace_locked(key, trace_id=trace_id)
+            self._traces[trace_id].bound = True
+            self._by_key[key] = trace_id
+
+    def _new_trace_locked(self, key: str | None,
+                          trace_id: str | None = None) -> _Trace:
+        tr = _Trace(trace_id or uuid.uuid4().hex[:16], key)
+        self._traces[tr.trace_id] = tr
+        if key is not None:
+            self._by_key[key] = tr.trace_id
+        while len(self._traces) > self.max_traces:
+            oldest = next(iter(self._traces))
+            old = self._traces.pop(oldest)
+            if old.key is not None and \
+                    self._by_key.get(old.key) == old.trace_id:
+                del self._by_key[old.key]
+        return tr
+
+    def _touch_locked(self, tid: str) -> _Trace | None:
+        tr = self._traces.pop(tid, None)
+        if tr is not None:
+            self._traces[tid] = tr  # re-insert = most recent
+        return tr
+
+    # ------------------------------------------------------------- spans
+
+    def span(self, name: str, key: str | None = None,
+             attrs: dict | None = None) -> Span:
+        """Open a span. With ``key``: on that object's trace (child of
+        the current span when it is on the same trace). Without: child
+        of the current context, or the root of a fresh anonymous
+        trace."""
+        ctx = _CTX.get()
+        parent_id = None
+        if key is not None:
+            trace_id = self.trace_id_for(key)
+            if ctx is not None and ctx[0] is self \
+                    and ctx[1].trace_id == trace_id:
+                parent_id = ctx[1].span_id
+        elif ctx is not None and ctx[0] is self:
+            trace_id = ctx[1].trace_id
+            parent_id = ctx[1].span_id
+            key = ctx[2]
+        else:
+            with self._lock:
+                trace_id = self._new_trace_locked(None).trace_id
+        return Span(self, name, trace_id, parent_id, key,
+                    dict(attrs or {}))
+
+    def record(self, name: str, key: str, start: float, end: float,
+               attrs: dict | None = None, error: bool = False,
+               once: bool = False) -> None:
+        """Retroactive span on ``key``'s trace from already-measured
+        instants (``time.monotonic`` seconds). ``once=True`` drops the
+        record if the trace already holds a span of this name (idempotent
+        lifecycle markers like ``notebook.ready``)."""
+        tid = self.trace_id_for(key)
+        span = {
+            "name": name, "span_id": uuid.uuid4().hex[:8],
+            "parent_id": None, "start": start, "end": end,
+            "error": error, "attrs": dict(attrs or {}),
+        }
+        with self._lock:
+            tr = self._touch_locked(tid)
+            if tr is None:
+                # a concurrent bind() renamed the trace between
+                # trace_id_for() and here — follow the key, as _finish
+                # does, instead of silently dropping the span
+                cur = self._by_key.get(key)
+                tr = self._touch_locked(cur) if cur else None
+            if tr is None:
+                return
+            if once:
+                if name in tr.once:
+                    return
+                tr.once.add(name)
+            self._append_capped_locked(tr, span)
+        self._export(span)
+
+    def _finish(self, span: Span) -> None:
+        d = {
+            "name": span.name, "span_id": span.span_id,
+            "parent_id": span.parent_id, "start": span.start,
+            "end": span.end, "error": span.error,
+            "attrs": dict(span.attrs),
+        }
+        with self._lock:
+            tr = self._touch_locked(span.trace_id)
+            if tr is None and span.key is not None:
+                # the trace was renamed by bind() while this span was
+                # open (first reconcile identifies the object mid-span):
+                # follow the key to its current trace
+                tid = self._by_key.get(span.key)
+                tr = self._touch_locked(tid) if tid else None
+            if tr is None:
+                return
+            self._append_capped_locked(tr, d)
+        self._export(d)
+
+    def _append_capped_locked(self, tr: _Trace, span: dict) -> None:
+        """Cap = a per-trace ring: the OLDEST span falls off, so a
+        long-lived object's trace always shows its recent activity (a
+        cap that refused new spans would freeze the view at the first
+        hours of a notebook's life — exactly what an operator debugging
+        today's slowness doesn't want)."""
+        if len(tr.spans) >= self.max_spans_per_trace:
+            tr.spans.pop(0)
+            tr.dropped += 1
+        tr.spans.append(span)
+
+    def _export(self, span: dict) -> None:
+        for exporter in self.exporters:
+            try:
+                exporter(span)
+            except Exception:
+                pass  # an exporter bug must never fail a reconcile
+
+    # ---------------------------------------------------------- snapshots
+
+    def snapshot(self, key: str | None = None,
+                 trace_id: str | None = None) -> dict | None:
+        """Point-in-time copy of one trace (by key or id), or None."""
+        with self._lock:
+            if trace_id is None and key is not None:
+                trace_id = self._by_key.get(key)
+            tr = self._traces.get(trace_id) if trace_id else None
+            if tr is None:
+                return None
+            return self._snapshot_locked(tr)
+
+    def traces(self) -> list[dict]:
+        """Snapshots of every retained trace (unordered)."""
+        with self._lock:
+            return [self._snapshot_locked(tr)
+                    for tr in self._traces.values()]
+
+    @staticmethod
+    def _snapshot_locked(tr: _Trace) -> dict:
+        # attrs copied too: consumers (the dashboard's tenant-boundary
+        # redaction) may mutate their snapshot; the stored trace must
+        # not change under them
+        spans = [{**s, "attrs": dict(s["attrs"])} for s in tr.spans]
+        starts = [s["start"] for s in spans]
+        ends = [s["end"] for s in spans if s["end"] is not None]
+        start = min(starts) if starts else tr.created
+        duration = (max(ends) - start) if ends else 0.0
+        stages: dict[str, float] = {}
+        for s in spans:
+            if s["end"] is not None:
+                stages[s["name"]] = stages.get(s["name"], 0.0) + \
+                    (s["end"] - s["start"])
+        return {
+            "trace_id": tr.trace_id, "key": tr.key, "start": start,
+            "duration_s": duration, "spans": spans, "stages": stages,
+            "dropped_spans": tr.dropped,
+            "errors": sum(1 for s in spans if s["error"]),
+        }
+
+
+#: process-global tracer — the analog of metrics.REGISTRY; binaries and
+#: the ops endpoint default to it, benches inject their own
+TRACER = Tracer()
+
+
+def current_tracer() -> Tracer:
+    """Tracer of the innermost open span, else the global one — how
+    library code (reconcilers) finds the tracer a Manager injected
+    without threading it through every constructor."""
+    ctx = _CTX.get()
+    return ctx[0] if ctx is not None else TRACER
+
+
+def span(name: str, key: str | None = None,
+         attrs: dict | None = None) -> Span:
+    return current_tracer().span(name, key=key, attrs=attrs)
+
+
+def record(name: str, key: str, start: float, end: float,
+           attrs: dict | None = None, error: bool = False,
+           once: bool = False) -> None:
+    current_tracer().record(name, key, start, end, attrs=attrs,
+                            error=error, once=once)
+
+
+def object_trace_id(plural: str, obj: dict,
+                    tracer: Tracer | None = None) -> str:
+    """Bind ``obj``'s trace and return its id, derived from
+    ``metadata.uid`` — deterministic across processes AND unique per
+    incarnation (a deleted-and-recreated CR has a new uid, so a reused
+    name never mixes two lifecycles on one trace). The uid outranks a
+    stamped annotation: an exported-and-reapplied manifest carries the
+    OLD incarnation's annotation, and honoring it would re-mix exactly
+    the lifecycles the uid separation exists to keep apart (the
+    controller re-stamps the annotation from the uid anyway). The
+    annotation is the fallback for uid-less objects, else an id is
+    generated. Reconcilers call this on every pass; it is two dict
+    lookups when already bound."""
+    meta = obj.get("metadata") or {}
+    key = object_key(plural, meta.get("namespace"), meta.get("name", ""))
+    t = tracer if tracer is not None else current_tracer()
+    tid = (meta.get("uid") or "").replace("-", "")[:16]
+    if not tid:
+        tid = (meta.get("annotations") or {}).get(TRACE_ANNOTATION)
+    if tid:
+        t.bind(key, tid)
+        return tid
+    return t.trace_id_for(key)

@@ -1,0 +1,72 @@
+"""/debug/tracez rendering: recent traces, slowest-first.
+
+The text analog of OpenCensus zPages' tracez — one screen that answers
+"what were the slowest lifecycles this process drove, and where did
+their time go" with nothing but curl. Served by engine/serve.py next to
+/metrics; the dashboard's ``/api/traces/<ns>/<name>`` serves the same
+snapshots as JSON.
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane.obs.trace import (
+    Tracer,
+)
+
+
+def _fmt_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    return " {" + inner + "}"
+
+
+def render_trace(snap: dict) -> str:
+    """One trace: header, stage breakdown, then spans by start time."""
+    head = (
+        f"TRACE {snap['key'] or '(anonymous)'} "
+        f"id={snap['trace_id']} duration={snap['duration_s'] * 1000:.1f}ms "
+        f"spans={len(snap['spans'])} errors={snap['errors']}"
+    )
+    if snap["dropped_spans"]:
+        head += f" dropped={snap['dropped_spans']}"
+    lines = [head]
+    stages = sorted(snap["stages"].items(), key=lambda kv: -kv[1])
+    if stages:
+        lines.append("  stages: " + "  ".join(
+            f"{name}={dur * 1000:.1f}ms" for name, dur in stages
+        ))
+    by_id = {s["span_id"]: s for s in snap["spans"]}
+    for s in sorted(snap["spans"], key=lambda s: s["start"]):
+        offset = (s["start"] - snap["start"]) * 1000
+        dur = ((s["end"] - s["start"]) * 1000
+               if s["end"] is not None else float("nan"))
+        depth = 0
+        parent = s.get("parent_id")
+        while parent in by_id and depth < 8:
+            depth += 1
+            parent = by_id[parent].get("parent_id")
+        lines.append(
+            f"  {'  ' * depth}+{offset:9.1f}ms {dur:9.1f}ms "
+            f"{s['name']}{' ERROR' if s['error'] else ''}"
+            f"{_fmt_attrs(s['attrs'])}"
+        )
+    return "\n".join(lines)
+
+
+def render_tracez(tracer: Tracer, limit: int = 50,
+                  key: str | None = None) -> str:
+    """The whole page. ``key`` filters to one object's trace."""
+    if key is not None:
+        snap = tracer.snapshot(key=key)
+        if snap is None:
+            return f"no trace for key {key!r}\n"
+        return render_trace(snap) + "\n"
+    snaps = sorted(tracer.traces(), key=lambda s: -s["duration_s"])
+    header = (
+        f"cptrace: {len(snaps)} trace(s) retained "
+        f"(showing up to {limit}, slowest first)\n"
+    )
+    return header + "\n\n".join(
+        render_trace(s) for s in snaps[:limit]
+    ) + ("\n" if snaps else "")

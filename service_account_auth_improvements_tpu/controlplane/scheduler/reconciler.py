@@ -69,6 +69,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import (
     Histogram,
     Registry,
 )
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: E501
     Assignment,
     pools_from_nodes,
@@ -107,7 +108,12 @@ class SchedulerMetrics:
         )
         self.time_to_placement = Histogram(
             "tpusched_time_to_placement_seconds",
-            "Admission-to-placement latency", registry=registry,
+            "Admission-to-placement latency",
+            # parked notebooks legitimately wait minutes under
+            # contention — far past the default 60 s top bucket
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60,
+                     120, 300, 600),
+            registry=registry,
         )
         self.placements = Counter(
             "tpusched_placements_total", "Placement decisions", ("pool",),
@@ -195,6 +201,10 @@ class SchedulerReconciler(Reconciler):
             self._forget(key)
             self._run_queue()
             return Result()
+        # same uid-derived trace binding as the notebook controller, so
+        # scheduler spans for a recreated name land on the NEW
+        # incarnation's trace even when this reconcile wins the race
+        obs.object_trace_id("notebooks", nb)
         try:
             resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
         except tpu.TpuValidationError:
@@ -294,8 +304,19 @@ class SchedulerReconciler(Reconciler):
                 # double-book; the stamp's MODIFIED event re-enters the
                 # placed branch
                 return Result()
+            fresh = self._queue.get(key) is None
             self._queue.add(key[0], req.name, demand_from(resolved),
                             priority, pinned_pool=resolved.node_pool)
+        if fresh:
+            # admission marker: trace stage 1 of the glossary
+            # (admission→queue→placement→gang→STS→Ready)
+            now = time.monotonic()
+            obs.record(
+                "sched.admit", obs.object_key("notebooks", *key), now, now,
+                attrs={"priority": priority,
+                       "chips": resolved.total_chips,
+                       "pinned_pool": resolved.node_pool or ""},
+            )
         self._run_queue()
         return Result()
 
@@ -510,7 +531,17 @@ class SchedulerReconciler(Reconciler):
                     chips=entry.demand.total_chips,
                     priority=entry.priority, seq=self._assign_seq,
                 )
-                placed.append((entry, pool))
+                # the (inventory-state, decision) tuple a learned
+                # placement policy trains on (docs/scheduler.md RL hook):
+                # free chips per pool AS SEEN at decision time
+                decision_state = {
+                    "free_chips": {
+                        p: pools[p].total_chips - used.get(p, 0)
+                        for p in sorted(pools)
+                    },
+                    "queue_depth": len(self._queue),  # O(1), lock held
+                }
+                placed.append((entry, pool, decision_state))
                 live.pop(entry.key, None)
                 used[pool] = used.get(pool, 0) + entry.demand.total_chips
             if self.enable_preemption and not self._evicting:
@@ -524,8 +555,8 @@ class SchedulerReconciler(Reconciler):
         # stalling every reconcile worker. The book already reflects the
         # decisions, so concurrent passes see reserved pools; a stale
         # position write is re-leveled by the pass that moved the queue.
-        for entry, pool in placed:
-            self._finish_place(entry, pool)
+        for entry, pool, decision_state in placed:
+            self._finish_place(entry, pool, decision_state)
         if evict is not None:
             self._finish_evict(*evict)
         for nb, reason, message in park_events:
@@ -537,9 +568,29 @@ class SchedulerReconciler(Reconciler):
         for cls in self._seen_classes:
             self.metrics.queue_depth.labels(cls).set(depth.get(cls, 0))
 
-    def _finish_place(self, entry, pool: str) -> None:
+    def _finish_place(self, entry, pool: str,
+                      decision_state: dict | None = None) -> None:
         """Lock-free half of placement: stamp the annotation the booking
-        reserved, then surface condition + event."""
+        reserved, then surface condition + event + trace spans."""
+        now = time.monotonic()
+        trace_key = obs.object_key("notebooks", entry.namespace,
+                                   entry.name)
+        # queue-wait is the dominant stage under contention — record it
+        # retroactively (admission instant → placement decision), then
+        # the decision itself with the RL (state, decision) tuple
+        obs.record("sched.queue_wait", trace_key, entry.enqueued, now,
+                   attrs={"priority": entry.priority})
+        obs.record(
+            "sched.place", trace_key, now, now,
+            attrs={"pool": pool, "chips": entry.demand.total_chips,
+                   "time_to_placement_s": round(now - entry.enqueued, 6),
+                   **(decision_state or {})},
+        )
+        log.info(
+            "tpusched decision %s/%s -> %s (ttp=%.3fs state=%s)",
+            entry.namespace, entry.name, pool, now - entry.enqueued,
+            decision_state,
+        )
         try:
             # the patch's return is the post-write object — the condition
             # write below must use IT, or the status update loses the RV
@@ -627,6 +678,24 @@ class SchedulerReconciler(Reconciler):
             self._forget(victim.key)
             return
         self.metrics.preemptions.inc()
+        now = time.monotonic()
+        # the waiter's trace is readable by the waiter's tenant (the
+        # dashboard API SAR-gates on the waiter's notebook only) — name
+        # the victim only within the same namespace; across tenants the
+        # span records THAT a preemption happened, not WHOSE workload
+        # (RBAC hides other namespaces' object names)
+        victim_ref = (f"{victim.namespace}/{victim.name}"
+                      if victim.namespace == entry.namespace
+                      else "(other namespace)")
+        obs.record(
+            "sched.preempt",
+            obs.object_key("notebooks", entry.namespace, entry.name),
+            now, now,
+            attrs={"victim": victim_ref,
+                   "victim_priority": victim.priority,
+                   "freed_chips": victim.chips,
+                   "waiter_priority": entry.priority},
+        )
         victim_nb = self._get_nb(victim.key)
         if victim_nb is not None:
             self.recorder.event(

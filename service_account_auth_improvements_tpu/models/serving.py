@@ -144,7 +144,7 @@ class GenerationService:
             registry=self.registry)
         self.m_latency = Histogram(
             "serving_request_seconds", "one-shot completion latency",
-            registry=self.registry)
+            buckets=Histogram.DEFAULT_BUCKETS, registry=self.registry)
         self.m_streams = Gauge(
             "serving_streams_active", "open SSE streams",
             registry=self.registry)

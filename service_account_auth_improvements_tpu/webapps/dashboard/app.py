@@ -15,6 +15,7 @@ import os
 import threading
 import time
 
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.webapps.core import (
     frontend_dirs,
@@ -47,7 +48,7 @@ DEFAULT_LINKS = {
 
 def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
               mode: str | None = None,
-              registration_flow: bool = True) -> WebApp:
+              registration_flow: bool = True, tracer=None) -> WebApp:
     """``kfam`` is any object with the KfamApp action surface
     (create_profile, create_binding, delete_binding, list_bindings) —
     in-process KfamApp or an HTTP client facade (the reference uses a
@@ -148,6 +149,32 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
         queued.sort(key=lambda q: (q["position"] is None,
                                    q["position"] or 0, q["name"]))
         return {"queued": queued}
+
+    @app.route("GET", "/api/traces/<namespace>/<notebook>")
+    def get_trace(req):
+        """The notebook's cptrace lifecycle (obs/trace.py snapshot):
+        spans, per-stage totals, duration — the per-object view of what
+        /debug/tracez shows process-wide. Gated by the same SAR as any
+        notebook read (the GET below 404s/403s before the trace is
+        touched). Served from the in-process tracer; a split deployment
+        points ``tracer`` at whatever aggregation it ships spans to."""
+        ns = req.params["namespace"]
+        name = req.params["notebook"]
+        KubeApi(kube, req.user, mode=app.mode).get(
+            "notebooks", name, namespace=ns
+        )
+        trc = tracer if tracer is not None else obs.TRACER
+        snap = trc.snapshot(key=obs.object_key("notebooks", ns, name))
+        if snap is None:
+            raise HttpError(404, f"no trace recorded for {ns}/{name}")
+        # tenant boundary: cluster-scoped scheduler state (per-pool free
+        # chips, global queue depth — the RL decision log) stays on the
+        # operator-only /debug/tracez; a namespaced caller sees their own
+        # notebook's stages, not the whole cluster's occupancy
+        for s in snap["spans"]:
+            for cluster_attr in ("free_chips", "queue_depth"):
+                s["attrs"].pop(cluster_attr, None)
+        return {"trace": snap}
 
     @app.route("GET", "/api/dashboard-links")
     def get_links(req):

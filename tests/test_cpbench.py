@@ -53,6 +53,21 @@ def test_notebook_ready_timelines_monotone():
     assert 5.0 <= phases["actuation"]["p50"] <= 15.0
     assert phases["controller_overhead"]["p50"] >= 0.0
     assert s["extra"]["gate_violations"] == 0
+    # per-stage attribution from the cptrace spans: disjoint stages that
+    # explain most of each CR's create→Ready wall time (the --full gate
+    # is ≥0.95; tiny smoke runs carry proportionally more thread-jitter)
+    att = s["stage_attribution"]
+    assert att["attributed_fraction"]["n"] == 6
+    assert att["attributed_fraction"]["mean"] >= 0.8, att
+    stages = att["stages_ms"]
+    for want in ("kubelet", "queue_wait", "reconcile"):
+        assert want in stages, (want, sorted(stages))
+    # kubelet stage ≈ the injected actuation (same ground truth)
+    assert stages["kubelet"]["p50"] >= 4.0
+    # disjoint by construction: stage sums can never exceed the total
+    total_p50 = phases["create_to_ready"]["p50"]
+    assert sum(v["mean"] for v in stages.values()) <= \
+        phases["create_to_ready"]["mean"] * 1.05 + 1.0, (stages, total_p50)
 
 
 def test_gang_ready_correctness():
@@ -117,6 +132,11 @@ def test_sched_contention_serializes_placement():
     assert 0.0 <= ttp["p50"] <= ttp["p95"] <= ttp["p99"]
     assert extra["gate_violations"] == 0
     assert res.summary["completed"] == 10
+    # under contention the admission queue dominates — the attribution
+    # must name it (sched_queue_wait), not book it as mystery time
+    att = res.summary["stage_attribution"]
+    assert "sched_queue_wait" in att["stages_ms"], att
+    assert att["attributed_fraction"]["mean"] >= 0.85, att
 
 
 # ------------------------------------------------------------------- CLI

@@ -177,6 +177,56 @@ def test_tpu_queue_surfaces_parked_notebooks(world):
     assert queued[0]["position"] == 1 and queued[1]["position"] == 2
 
 
+def test_trace_api_serves_notebook_lifecycle(world):
+    import time
+
+    from service_account_auth_improvements_tpu.controlplane import obs
+
+    kube, kfam, _ = world
+    tracer = obs.Tracer()
+    app = build_app(kube, kfam, mode="prod", tracer=tracer)
+    kube.create("namespaces", {"metadata": {"name": "team"}})
+    kube.create("notebooks", {
+        "metadata": {"name": "traced", "namespace": "team"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "2x2"}},
+    })
+    # no trace yet → 404 even though the notebook exists
+    out = call(app, "GET", "/api/traces/team/traced")
+    assert out["code"] == 404
+    now = time.monotonic()
+    tracer.record("sched.queue_wait", "notebooks/team/traced",
+                  now - 1.5, now, attrs={"priority": 0})
+    tracer.record("sched.place", "notebooks/team/traced", now, now,
+                  attrs={"pool": "pool-a",
+                         "free_chips": {"pool-a": 16, "pool-b": 0},
+                         "queue_depth": 7})
+    tracer.record("notebook.ready", "notebooks/team/traced", now, now)
+    out = call(app, "GET", "/api/traces/team/traced")
+    assert out["code"] == 200
+    trace = out["body"]["trace"]
+    assert trace["key"] == "notebooks/team/traced"
+    assert {s["name"] for s in trace["spans"]} == {"sched.queue_wait",
+                                                   "sched.place",
+                                                   "notebook.ready"}
+    # tenant boundary: cluster-wide inventory attrs are redacted (the
+    # full decision log is operator-only /debug/tracez), the caller's
+    # own placement stays visible
+    place = next(s for s in trace["spans"] if s["name"] == "sched.place")
+    assert place["attrs"]["pool"] == "pool-a"
+    assert "free_chips" not in place["attrs"]
+    assert "queue_depth" not in place["attrs"]
+    # ... and the tracer's own copy is untouched (redaction is per
+    # response, not destructive)
+    raw = tracer.snapshot(key="notebooks/team/traced")
+    raw_place = next(s for s in raw["spans"] if s["name"] == "sched.place")
+    assert "free_chips" in raw_place["attrs"]
+    assert trace["stages"]["sched.queue_wait"] == pytest.approx(1.5,
+                                                                rel=0.01)
+    # unknown notebook: the SAR-gated GET 404s before the tracer is read
+    out = call(app, "GET", "/api/traces/team/ghost")
+    assert out["code"] == 404
+
+
 def test_metrics_service_tpu_series(world, monkeypatch):
     kube, kfam, _ = world
 

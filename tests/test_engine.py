@@ -248,6 +248,49 @@ def test_informer_relists_on_gone():
         inf.stop()
 
 
+def test_informer_sync_flips_false_on_sustained_outage():
+    """Readiness is LIVE: after ~3 consecutive list/watch failures the
+    informer reads not-synced (a pod serving from an hour-stale cache
+    must drop out of /readyz), and recovers once the apiserver does. A
+    single blip must NOT flip it (nor force an O(objects) relist)."""
+    from service_account_auth_improvements_tpu.controlplane.engine.informer import (
+        Informer,
+    )
+
+    kube, calls = _counting_kube()
+    kube.create("pods", _pod("p0"))
+    down = {"on": False}
+    orig_watch, orig_list = kube.watch, kube.list
+
+    def watch(*a, **kw):
+        if down["on"]:
+            raise ConnectionError("apiserver down")
+        return orig_watch(*a, **kw)
+
+    def list_(*a, **kw):
+        if down["on"]:
+            raise ConnectionError("apiserver down")
+        return orig_list(*a, **kw)
+
+    kube.watch, kube.list = watch, list_
+    inf = Informer(kube, "pods", resync_period=0.15)
+    inf.start()
+    try:
+        assert inf.wait_for_sync(5)
+        down["on"] = True
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline and inf.has_synced():
+            time.sleep(0.05)
+        assert not inf.has_synced(), (
+            "sustained outage must drop readiness"
+        )
+        down["on"] = False
+        assert inf.wait_for_sync(15), "recovery must re-sync"
+        assert inf.get("ns1", "p0") is not None
+    finally:
+        inf.stop()
+
+
 def test_fake_watch_raises_gone_after_compaction():
     kube = FakeKube()
     kube.create("pods", _pod("p0"))

@@ -159,7 +159,7 @@ def test_metrics_endpoint(world):
 
     out = b"".join(app(environ, start_response)).decode()
     assert status["code"] == 200
-    assert "request_kf_total" in out
+    assert "kfam_request_total" in out
 
 
 def test_create_profile_requires_self_or_admin(world):

@@ -1,0 +1,78 @@
+"""tools/metrics_lint.py: the tree stays clean, and the rules actually
+fire on violations (a lint that can't fail guards nothing)."""
+
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO / "tools"))
+
+from metrics_lint import lint_file, run_lint  # noqa: E402
+
+
+def test_tree_is_clean():
+    findings = run_lint(REPO)
+    assert findings == [], "\n".join(findings)
+
+
+def test_cli_exits_zero_on_clean_tree():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "metrics_lint.py")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+def _lint_source(tmp_path, source: str):
+    # lint_file reports paths relative to the repo root, so the fixture
+    # file must live under it
+    f = REPO / "service_account_auth_improvements_tpu" / \
+        "_lint_fixture_tmp.py"
+    f.write_text(source)
+    try:
+        return lint_file(f)[0]
+    finally:
+        f.unlink()
+
+
+def test_counter_must_end_total(tmp_path):
+    findings = _lint_source(
+        tmp_path, "c = Counter('requests', 'help', ('a',))\n"
+    )
+    assert any("_total" in f for f in findings)
+
+
+def test_non_counter_must_not_end_total(tmp_path):
+    findings = _lint_source(
+        tmp_path, "g = Gauge('depth_total', 'help')\n"
+    )
+    assert any("must not end" in f for f in findings)
+
+
+def test_histogram_requires_buckets(tmp_path):
+    findings = _lint_source(
+        tmp_path, "h = Histogram('lat_seconds', 'help')\n"
+    )
+    assert any("buckets" in f for f in findings)
+    assert not _lint_source(
+        tmp_path,
+        "h = Histogram('lat_seconds', 'help', buckets=(1, 2))\n",
+    )
+
+
+def test_duplicate_across_modules_flagged(tmp_path):
+    # run_lint over a synthetic repo shaped like ours
+    root = tmp_path / "service_account_auth_improvements_tpu"
+    root.mkdir()
+    (root / "a.py").write_text("x = Counter('dup_total', 'h')\n")
+    (root / "b.py").write_text("y = Counter('dup_total', 'h')\n")
+    import metrics_lint as ml
+
+    old = ml.REPO
+    ml.REPO = tmp_path
+    try:
+        findings = ml.run_lint(tmp_path)
+    finally:
+        ml.REPO = old
+    assert any("multiple modules" in f for f in findings)

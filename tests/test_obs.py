@@ -1,0 +1,487 @@
+"""cptrace + controller-runtime-parity metrics (controlplane/obs,
+engine/metrics.py, engine/serve.py /debug/tracez).
+
+The contracts that make the tracing layer trustworthy: context
+propagation parents spans correctly, the ring stays bounded under
+concurrent writers, a reconcile that RAISES still closes its span with
+error=true (the Controller swallows the exception for backoff — the
+span must not leak open or untagged), the /metrics exposition parses
+under the Prometheus text grammar even with hostile label values, and a
+notebook driven through the full FakeKube e2e path leaves a complete
+trace on /debug/tracez.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Manager,
+    Reconciler,
+    Request,
+    Result,
+    engine_metrics,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    escape_label_value,
+)
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_context_parents_children():
+    t = obs.Tracer()
+    with t.span("outer", key="notebooks/ns/a") as outer:
+        with t.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    snap = t.snapshot(key="notebooks/ns/a")
+    assert [s["name"] for s in snap["spans"]] == ["inner", "outer"]
+    assert snap["errors"] == 0
+    assert snap["duration_s"] >= 0
+
+
+def test_trace_id_stable_and_key_lookup():
+    t = obs.Tracer()
+    tid = t.trace_id_for("notebooks/ns/x")
+    assert t.trace_id_for("notebooks/ns/x") == tid
+    assert t.has("notebooks/ns/x")
+    assert not t.has("notebooks/ns/y")
+    assert t.snapshot(trace_id=tid)["key"] == "notebooks/ns/x"
+
+
+def test_record_retroactive_and_once():
+    t = obs.Tracer()
+    t0 = time.monotonic()
+    t.record("wait", "notebooks/ns/a", t0 - 1.0, t0)
+    t.record("ready", "notebooks/ns/a", t0, t0, once=True)
+    t.record("ready", "notebooks/ns/a", t0, t0, once=True)  # dropped
+    snap = t.snapshot(key="notebooks/ns/a")
+    assert [s["name"] for s in snap["spans"]] == ["wait", "ready"]
+    assert snap["stages"]["wait"] == pytest.approx(1.0, rel=0.01)
+
+
+def test_ring_eviction_bounds_traces():
+    t = obs.Tracer(max_traces=4)
+    for i in range(10):
+        t.trace_id_for(f"notebooks/ns/nb-{i}")
+    assert len(t.traces()) == 4
+    assert not t.has("notebooks/ns/nb-0")
+    assert t.has("notebooks/ns/nb-9")
+    # an evicted key re-binds to a FRESH trace rather than erroring
+    t.record("x", "notebooks/ns/nb-0", 0.0, 0.1)
+    assert t.has("notebooks/ns/nb-0")
+
+
+def test_once_marker_survives_ring_eviction():
+    """A wrapped span ring must not re-fire a once-marker days later
+    with a fresh timestamp — firedness is tracked per trace, not by
+    scanning the capped span list."""
+    t = obs.Tracer(max_spans_per_trace=3)
+    now = time.monotonic()
+    t.record("notebook.ready", "notebooks/ns/a", now, now, once=True)
+    for i in range(5):  # churn the marker out of the ring
+        t.record(f"s{i}", "notebooks/ns/a", now, now)
+    snap = t.snapshot(key="notebooks/ns/a")
+    assert "notebook.ready" not in {s["name"] for s in snap["spans"]}
+    t.record("notebook.ready", "notebooks/ns/a", now + 99, now + 99,
+             once=True)  # must still be suppressed
+    snap = t.snapshot(key="notebooks/ns/a")
+    assert "notebook.ready" not in {s["name"] for s in snap["spans"]}
+
+
+def test_span_cap_keeps_newest_spans():
+    """The per-trace cap is a ring: a long-lived object's trace shows
+    its RECENT activity, not a frozen view of its first spans."""
+    t = obs.Tracer(max_spans_per_trace=5)
+    now = time.monotonic()
+    for i in range(10):
+        t.record(f"s{i}", "notebooks/ns/a", now, now)
+    snap = t.snapshot(key="notebooks/ns/a")
+    assert [s["name"] for s in snap["spans"]] == [
+        "s5", "s6", "s7", "s8", "s9"
+    ]
+    assert snap["dropped_spans"] == 5
+
+
+def test_uid_bind_gives_recreated_object_a_fresh_trace():
+    """Delete + recreate under the same name must NOT mix lifecycles:
+    the uid-derived binding rebinds the key, and the once-per-trace
+    'notebook.ready' marker fires again for the new incarnation."""
+    t = obs.Tracer()
+    first = {"metadata": {"name": "nb", "namespace": "ns",
+                          "uid": "aaaa-bbbb-cccc-dddd"}}
+    tid1 = obs.object_trace_id("notebooks", first, tracer=t)
+    now = time.monotonic()
+    t.record("notebook.ready", "notebooks/ns/nb", now, now, once=True)
+    # recreated: same name, new uid → new trace id, empty span list
+    second = {"metadata": {"name": "nb", "namespace": "ns",
+                           "uid": "eeee-ffff-0000-1111"}}
+    tid2 = obs.object_trace_id("notebooks", second, tracer=t)
+    assert tid2 != tid1
+    snap = t.snapshot(key="notebooks/ns/nb")
+    assert snap["trace_id"] == tid2 and snap["spans"] == []
+    t.record("notebook.ready", "notebooks/ns/nb", now, now, once=True)
+    assert len(t.snapshot(key="notebooks/ns/nb")["spans"]) == 1
+    # the uid outranks a STALE annotation (an exported manifest
+    # re-applied carries the dead incarnation's id — honoring it would
+    # re-mix lifecycles); the annotation only covers uid-less objects
+    stale = {"metadata": {"name": "nb", "namespace": "ns",
+                          "uid": "2222-3333-4444-5555",
+                          "annotations": {obs.TRACE_ANNOTATION: tid1}}}
+    tid3 = obs.object_trace_id("notebooks", stale, tracer=t)
+    assert tid3 == "2222333344445555" and tid3 != tid1
+    uidless = {"metadata": {"name": "nb2", "namespace": "ns",
+                            "annotations": {obs.TRACE_ANNOTATION: "feed"}}}
+    assert obs.object_trace_id("notebooks", uidless, tracer=t) == "feed"
+
+
+def test_counter_rejects_decrement():
+    reg = Registry()
+    c = Counter("mono_total", "", ("k",), registry=reg)
+    c.labels("a").inc()
+    with pytest.raises(ValueError):
+        c.labels("a").dec()
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = Gauge("updown", "", ("k",), registry=reg)
+    g.labels("a").inc()
+    g.labels("a").dec()
+    assert g.value("a") == 0.0
+
+
+def test_tracer_thread_safety_concurrent_spans_one_trace():
+    t = obs.Tracer(max_traces=64, max_spans_per_trace=10_000)
+    errors: list = []
+
+    def hammer(i):
+        try:
+            for j in range(100):
+                with t.span("work", key="notebooks/ns/shared",
+                            attrs={"w": i}):
+                    pass
+                t.record("retro", "notebooks/ns/shared",
+                         time.monotonic(), time.monotonic())
+                # and churn other traces to force eviction races
+                t.trace_id_for(f"notebooks/ns/evict-{i}-{j % 70}")
+        except Exception as e:  # pragma: no cover - the assertion
+            errors.append(e)
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert not errors
+    snap = t.snapshot(key="notebooks/ns/shared")
+    assert len(snap["spans"]) == 8 * 200
+    assert len(t.traces()) <= 64
+
+
+def test_exporter_hook_sees_finished_spans_and_bugs_are_swallowed():
+    t = obs.Tracer()
+    seen: list = []
+    t.exporters.append(seen.append)
+    t.exporters.append(lambda s: 1 / 0)  # must not propagate
+    with t.span("a", key="notebooks/ns/a"):
+        pass
+    assert [s["name"] for s in seen] == ["a"]
+
+
+# ------------------------------------------------- engine error tagging
+
+class _BoomReconciler(Reconciler):
+    resource = "notebooks"
+    group = GROUP
+
+    def reconcile(self, request):
+        raise RuntimeError("kaboom")
+
+
+def test_reconcile_exception_closes_span_with_error():
+    kube = FakeKube()
+    tracer = obs.Tracer()
+    mgr = Manager(kube, tracer=tracer)
+    mgr.add_reconciler(_BoomReconciler())
+    kube.create("namespaces", {"metadata": {"name": "ns"}})
+    kube.create("notebooks", {"metadata": {"name": "boom",
+                                           "namespace": "ns"},
+                              "spec": {}})
+    mgr.start()
+    deadline = time.monotonic() + 10
+    snap = None
+    while time.monotonic() < deadline:
+        snap = tracer.snapshot(key="notebooks/ns/boom")
+        if snap and any(s["name"] == "reconcile" and s["error"]
+                        for s in snap["spans"]):
+            break
+        time.sleep(0.02)
+    mgr.stop()
+    assert snap is not None
+    errored = [s for s in snap["spans"]
+               if s["name"] == "reconcile" and s["error"]]
+    assert errored, snap["spans"]
+    s = errored[0]
+    assert s["end"] is not None, "span must CLOSE despite the raise"
+    assert s["attrs"]["error.type"] == "RuntimeError"
+    assert s["attrs"]["outcome"] == "error"
+    # and the parity metrics saw the failure
+    em = engine_metrics()
+    assert em.reconcile_errors.value("_BoomReconciler") >= 1
+    assert em.workqueue_retries.value("_BoomReconciler") >= 1
+
+
+# -------------------------------------------- exposition format grammar
+
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* .*$")
+_TYPE_RE = re.compile(
+    r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+    r"(counter|gauge|histogram|summary|untyped)$"
+)
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(\{(?P<labels>(?:[a-zA-Z_][a-zA-Z0-9_]*="
+    r"\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\",?)*)\})? "
+    r"(?P<value>[0-9.eE+-]+|\+Inf|-Inf|NaN)$"
+)
+
+
+def _parse_exposition(text: str) -> list:
+    """Validate every line against the text-format grammar; return the
+    parsed samples as (name, labels_dict, value)."""
+    samples = []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP"):
+            assert _HELP_RE.match(line), line
+            continue
+        if line.startswith("# TYPE"):
+            assert _TYPE_RE.match(line), line
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"unparseable sample line: {line!r}"
+        labels = {}
+        raw = m.group("labels") or ""
+        for part in re.findall(
+                r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\\n]|\\\\|\\"|\\n)*)"',
+                raw):
+            labels[part[0]] = (part[1].replace("\\\\", "\\")
+                               .replace('\\"', '"').replace("\\n", "\n"))
+        samples.append((m.group("name"), labels, m.group("value")))
+    return samples
+
+
+def test_exposition_escapes_hostile_label_values():
+    reg = Registry()
+    c = Counter("hostile_total", "values with \"quotes\"\nand newlines",
+                ("path",), registry=reg)
+    nasty = 'a"b\\c\nd'
+    c.labels(nasty).inc()
+    text = reg.render()
+    samples = _parse_exposition(text)
+    got = [lbl for name, lbl, _ in samples if name == "hostile_total"]
+    assert got and got[0]["path"] == nasty, (
+        "label value must round-trip through escaping"
+    )
+
+
+def test_escape_label_value_spec():
+    assert escape_label_value('a"b') == 'a\\"b'
+    assert escape_label_value("a\\b") == "a\\\\b"
+    assert escape_label_value("a\nb") == "a\\nb"
+
+
+def test_histogram_exposition_le_ordering_and_escaping():
+    reg = Registry()
+    h = Histogram("lat_seconds", "x", ("op",), buckets=(0.1, 1, 10),
+                  registry=reg)
+    h.labels('read"y').observe(0.5)
+    h.labels('read"y').observe(5.0)
+    samples = _parse_exposition(reg.render())
+    buckets = [(lbl["le"], float(v)) for name, lbl, v in samples
+               if name == "lat_seconds_bucket"]
+    assert [le for le, _ in buckets] == ["0.1", "1", "10", "+Inf"]
+    counts = [c for _, c in buckets]
+    assert counts == sorted(counts), "bucket counts must be cumulative"
+    assert counts[-1] == 2
+    assert all(lbl["op"] == 'read"y' for name, lbl, _ in samples
+               if name.startswith("lat_seconds_bucket"))
+
+
+def test_counter_gauge_value_reads_are_locked():
+    """Concurrent inc + value must never raise (dict mutation during
+    unlocked read was the bug) and must settle exactly."""
+    reg = Registry()
+    c = Counter("race_total", "", ("k",), registry=reg)
+    g = Gauge("race_gauge", "", ("k",), registry=reg)
+    stop = threading.Event()
+    errs: list = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                c.value("a")
+                g.value("a")
+        except Exception as e:  # pragma: no cover - the assertion
+            errs.append(e)
+
+    r = threading.Thread(target=reader)
+    r.start()
+    for i in range(2000):
+        c.labels(f"k{i % 50}").inc()
+        g.labels(f"k{i % 50}").set(i)
+    stop.set()
+    r.join()
+    assert not errs
+
+
+# ------------------------------------------------------ e2e + /debug/tracez
+
+def _http_get(port: int, path: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+@pytest.fixture
+def traced_world():
+    """The FakeKube e2e path with the fake kubelet: notebook CR →
+    STS → pods → Ready, all under one injected tracer."""
+    from service_account_auth_improvements_tpu.controlplane.cpbench import (
+        FakeKubelet,
+    )
+
+    kube = FakeKube()
+    tracer = obs.Tracer()
+    mgr = Manager(kube, tracer=tracer)
+    NotebookReconciler(kube).register(mgr)
+    kubelet = FakeKubelet(kube, "const:5", tracer=tracer)
+    mgr.start()
+    kubelet.start()
+    yield kube, tracer, mgr
+    kubelet.stop()
+    mgr.stop()
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_e2e_notebook_leaves_complete_trace_on_tracez(traced_world):
+    kube, tracer, mgr = traced_world
+    kube.create("notebooks", {
+        "metadata": {"name": "traced", "namespace": "user1"},
+        "spec": {"tpu": {"generation": "v5e", "topology": "2x2"},
+                 "template": {"spec": {"containers": [
+                     {"name": "notebook", "image": "jax"}]}}},
+    })
+    assert _wait(lambda: ((kube.get("notebooks", "traced",
+                                    namespace="user1", group=GROUP)
+                           .get("status") or {})
+                          .get("readyReplicas") or 0) >= 1)
+    # trace-id annotation stamped at admission, matching the binding
+    nb = kube.get("notebooks", "traced", namespace="user1", group=GROUP)
+    tid = nb["metadata"]["annotations"][obs.TRACE_ANNOTATION]
+    assert tid == tracer.trace_id_for("notebooks/user1/traced")
+    # ... and NOT propagated onto the pod template (volatile annotation)
+    sts = kube.get("statefulsets", "traced", namespace="user1",
+                   group="apps")
+    tmpl_annots = (sts["spec"]["template"]["metadata"]
+                   .get("annotations") or {})
+    assert obs.TRACE_ANNOTATION not in tmpl_annots
+
+    assert _wait(lambda: "notebook.ready" in (
+        tracer.snapshot(key="notebooks/user1/traced") or {}
+    ).get("stages", {}))
+    snap = tracer.snapshot(key="notebooks/user1/traced")
+    names = {s["name"] for s in snap["spans"]}
+    # the full stage ladder: queue → reconcile → children → kubelet →
+    # ready (informer.deliver is best-effort — first event predates the
+    # trace)
+    for want in ("queue.wait", "reconcile", "notebook.children",
+                 "kubelet.actuation", "notebook.ready"):
+        assert want in names, (want, sorted(names))
+    assert snap["errors"] == 0
+
+    server = serve_ops(0, host="127.0.0.1", tracer=tracer)
+    try:
+        port = server.server_address[1]
+        code, page = _http_get(port, "/debug/tracez")
+        assert code == 200
+        assert "notebooks/user1/traced" in page
+        assert "kubelet.actuation" in page
+        code, page = _http_get(
+            port, "/debug/tracez?key=notebooks/user1/traced")
+        assert code == 200
+        assert "notebook.ready" in page
+        code, page = _http_get(port, "/debug/tracez?key=notebooks/x/y")
+        assert code == 200 and "no trace" in page
+        # the parity metric families ride the same server
+        code, metrics_text = _http_get(port, "/metrics")
+        assert code == 200
+        for fam in ("workqueue_depth", "workqueue_queue_duration_seconds",
+                    "workqueue_work_duration_seconds",
+                    "workqueue_retries_total",
+                    "controller_runtime_reconcile_time_seconds",
+                    "controller_runtime_reconcile_errors_total",
+                    "controller_runtime_active_workers"):
+            assert fam in metrics_text, fam
+        assert 'name="NotebookReconciler"' in metrics_text
+        assert 'controller="NotebookReconciler"' in metrics_text
+    finally:
+        server.shutdown()
+
+
+def test_workqueue_metrics_move_with_traffic():
+    em = engine_metrics()
+    before = em.reconcile_time._counts.get(("QueueProbe",), [0])[-1] \
+        if ("QueueProbe",) in em.reconcile_time._counts else 0
+
+    class QueueProbe(Reconciler):
+        resource = "profiles"
+        group = GROUP
+
+        def reconcile(self, request):
+            return Result()
+
+    kube = FakeKube()
+    mgr = Manager(kube, tracer=obs.Tracer())
+    mgr.add_reconciler(QueueProbe())
+    kube.create("profiles", {"metadata": {"name": "p1"},
+                             "spec": {"owner": {"kind": "User",
+                                                "name": "u@x"}}})
+    mgr.start()
+    assert _wait(lambda: mgr.quiesce(0.1))
+    mgr.stop()
+    with em.reconcile_time._lock:
+        after = em.reconcile_time._counts[("QueueProbe",)][-1]
+    assert after > before
+    assert em.workqueue_depth.value("QueueProbe") == 0

@@ -1,0 +1,128 @@
+#!/usr/bin/env python3
+"""Static lint for Prometheus metric declarations.
+
+Walks the package tree's ASTs for ``Counter(...)`` / ``Gauge(...)`` /
+``Histogram(...)`` constructions with a literal name and enforces the
+conventions a scrape-side consumer (and our own exposition renderer)
+depends on:
+
+- **counters end ``_total``** (and nothing else does) — the Prometheus
+  naming convention alerting rules pattern-match on;
+- **histograms declare buckets explicitly** — the silent default hid a
+  time-to-placement histogram whose real range (minutes under
+  contention) sailed past the 60 s top bucket;
+- **no duplicate metric family names across modules** — two modules
+  declaring one name (worse: with different label sets) break the first
+  process that registers both; the registry raises at runtime, this
+  catches it at review time.
+
+Runs as a tier-1 test (tests/test_metrics_lint.py) and as a step in the
+controlplane bench workflow (ci/workflows.py). Exit 0 = clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+#: where metric declarations live; tests/ is excluded on purpose — tests
+#: declare throwaway metrics (including intentional duplicates)
+SCAN_ROOTS = ("service_account_auth_improvements_tpu",)
+METRIC_KINDS = ("Counter", "Gauge", "Histogram")
+
+
+def _call_kind(node: ast.Call) -> str | None:
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    return name if name in METRIC_KINDS else None
+
+
+def metric_calls(tree: ast.AST):
+    """Yield (kind, metric_name, node) for literal-name constructions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_kind(node)
+        if kind is None:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        yield kind, node.args[0].value, node
+
+
+def _has_buckets(node: ast.Call) -> bool:
+    if any(kw.arg == "buckets" for kw in node.keywords):
+        return True
+    # Histogram(name, help_, labels, buckets, ...) — 4th positional
+    return len(node.args) >= 4
+
+
+def lint_file(path: pathlib.Path) -> tuple[list[str], list[tuple]]:
+    """(findings, declarations) for one file; declarations feed the
+    cross-module duplicate check."""
+    findings: list[str] = []
+    decls: list[tuple] = []
+    rel = path.relative_to(REPO)
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except SyntaxError as e:
+        return [f"{rel}: unparseable: {e}"], []
+    for kind, name, node in metric_calls(tree):
+        where = f"{rel}:{node.lineno}"
+        decls.append((name, kind, str(rel), node.lineno))
+        if kind == "Counter" and not name.endswith("_total"):
+            findings.append(
+                f"{where}: counter {name!r} must end with '_total'"
+            )
+        if kind != "Counter" and name.endswith("_total"):
+            findings.append(
+                f"{where}: {kind.lower()} {name!r} must not end with "
+                "'_total' (counters only)"
+            )
+        if kind == "Histogram" and not _has_buckets(node):
+            findings.append(
+                f"{where}: histogram {name!r} must declare buckets "
+                "explicitly"
+            )
+    return findings, decls
+
+
+def run_lint(repo: pathlib.Path = REPO) -> list[str]:
+    findings: list[str] = []
+    by_name: dict[str, list[tuple]] = {}
+    for root in SCAN_ROOTS:
+        for path in sorted((repo / root).rglob("*.py")):
+            file_findings, decls = lint_file(path)
+            findings += file_findings
+            for name, kind, rel, lineno in decls:
+                by_name.setdefault(name, []).append((rel, lineno, kind))
+    for name, sites in sorted(by_name.items()):
+        modules = {rel for rel, _, _ in sites}
+        if len(modules) > 1:
+            where = ", ".join(
+                f"{rel}:{lineno}" for rel, lineno, _ in sorted(sites)
+            )
+            findings.append(
+                f"metric {name!r} declared in multiple modules: {where}"
+            )
+    return findings
+
+
+def main() -> int:
+    findings = run_lint()
+    for f in findings:
+        print(f, file=sys.stderr)
+    print(f"metrics_lint: {len(findings)} finding(s)",
+          file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
