@@ -196,18 +196,21 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
         "Control Plane Bench Smoke",
         ["service_account_auth_improvements_tpu/controlplane/**",
          "service_account_auth_improvements_tpu/webhook/**",
-         "tests/test_cpbench.py", "tools/metrics_lint.py"],
+         "tests/test_cpbench.py", "tools/metrics_lint.py",
+         "tools/bench_gate.py"],
         {"cpbench": job([
             CHECKOUT, SETUP_PY,
             {"name": "Metrics lint",
              "run": "python tools/metrics_lint.py"},
+            # the fresh run goes to bench_out.json so the committed
+            # CONTROLPLANE_BENCH.json stays available as the gate baseline
             {"name": "Run cpbench --smoke",
              "run": "python -m service_account_auth_improvements_tpu."
                     "controlplane.cpbench --smoke "
-                    "--out CONTROLPLANE_BENCH.json"},
+                    "--out bench_out.json"},
             {"name": "Validate bench JSON",
              "run": "python -c \"import json; d = json.load(open("
-                    "'CONTROLPLANE_BENCH.json')); "
+                    "'bench_out.json')); "
                     "assert d['schema'] == 'cpbench/v1' and d['ok'], d; "
                     "s = d['scenarios']; "
                     "assert set(s) == {'notebook_ready', 'gang_ready', "
@@ -223,10 +226,21 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "att; "
                     "assert 'kubelet' in att['stages_ms'] and "
                     "'queue_wait' in att['stages_ms'], att\""},
+            # perf-regression gate vs the committed record: churn
+            # controller_overhead p50 and notebook_ready create→Ready
+            # p95 within +20%, cached-read hit rate reported
+            {"name": "Bench regression gate",
+             "run": "python tools/bench_gate.py "
+                    "--baseline CONTROLPLANE_BENCH.json "
+                    "--run bench_out.json --tolerance 1.2"},
+            # always(): when the regression gate fails, bench_out.json
+            # IS the evidence — dropping it with the runner would force a
+            # full local re-run just to see which percentile regressed
             {"name": "Upload bench record",
+             "if": "always()",
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
-                      "path": "CONTROLPLANE_BENCH.json"}},
+                      "path": "bench_out.json"}},
         ])},
     ),
     "images_multi_arch_test.yaml": workflow(

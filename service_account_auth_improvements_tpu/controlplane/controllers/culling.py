@@ -61,10 +61,14 @@ from service_account_auth_improvements_tpu.utils.env import (
     get_env_int,
 )
 
-LAST_ACTIVITY = "tpukf.dev/last-activity"
-LAST_CHECK = "tpukf.dev/last_activity_check_timestamp"
+from service_account_auth_improvements_tpu.controlplane.controllers.helpers import (  # noqa: E501
+    LAST_ACTIVITY,
+    LAST_CHECK,
+    PROBE_FAILURES,
+    update_predicate,
+)
+
 CULLING_POLICY = "tpukf.dev/culling-policy"
-PROBE_FAILURES = "tpukf.dev/probe-failures"
 TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
 PROBE_TIMEOUT = 10  # seconds (reference culling_controller.go:204-206)
 
@@ -113,7 +117,17 @@ class CullingReconciler(Reconciler):
         self.workers = get_env_int("CULL_WORKERS", 8)
 
     def register(self, manager) -> "CullingReconciler":
-        manager.add_reconciler(self, workers=self.workers)
+        # the probe loop is timer-driven (requeue_after): events only
+        # need to START it (ADDED) or RESTART it (resume clearing the
+        # stop annotation). Without the predicate every probe's own
+        # timestamp patch re-wakes the culler through its watch — an
+        # event-driven hot loop on top of the timer.
+        manager.add_reconciler(self, workers=self.workers,
+                               predicate=update_predicate(
+                                   ignore_status=True))
+        # reads (notebook state, rank-0 pod probe) come from the manager's
+        # informer caches; the annotation patches still hit the apiserver
+        self.kube = manager.cached_client()
         return self
 
     def kernels_url(self, name: str, ns: str) -> str:

@@ -13,6 +13,53 @@ import copy
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 
 
+#: probe bookkeeping the culling controller stamps on every check — the
+#: canonical "volatile" annotations: they change on a timer, carry no
+#: reconcile-relevant state for anyone but the culler's own next probe,
+#: and would otherwise wake every watcher of the resource per probe
+LAST_ACTIVITY = "tpukf.dev/last-activity"
+LAST_CHECK = "tpukf.dev/last_activity_check_timestamp"
+PROBE_FAILURES = "tpukf.dev/probe-failures"
+VOLATILE_PROBE_ANNOTATIONS = (LAST_ACTIVITY, LAST_CHECK, PROBE_FAILURES)
+
+
+def _stripped(obj: dict, ignore_annotations, ignore_status: bool) -> dict:
+    out = {k: v for k, v in obj.items()
+           if k != "status" or not ignore_status}
+    meta = dict(out.get("metadata") or {})
+    meta.pop("resourceVersion", None)
+    meta.pop("managedFields", None)
+    meta["annotations"] = {
+        k: v for k, v in (meta.get("annotations") or {}).items()
+        if k not in ignore_annotations
+    }
+    out["metadata"] = meta
+    return out
+
+
+def update_predicate(ignore_annotations=VOLATILE_PROBE_ANNOTATIONS,
+                     ignore_status: bool = False):
+    """Event filter for ``Manager.add_reconciler(predicate=...)`` —
+    controller-runtime's predicate.Funcs analog.
+
+    ADDED/DELETED (and first-sight events, old=None) always pass;
+    MODIFIED/SYNC pass only when something OTHER than the ignored
+    annotations (and, optionally, status) changed. This is the
+    event-volume half of the cached-read perf work: a write-per-check
+    controller stamping a probe timestamp must not wake every watcher of
+    the resource on every probe. Level-triggering is preserved — a
+    skipped event by definition changed nothing the reconcile reads.
+    """
+
+    def pred(ev_type: str, old: dict | None, new: dict) -> bool:
+        if old is None or ev_type in ("ADDED", "DELETED"):
+            return True
+        return (_stripped(old, ignore_annotations, ignore_status)
+                != _stripped(new, ignore_annotations, ignore_status))
+
+    return pred
+
+
 def owner_reference(obj: dict, controller: bool = True) -> dict:
     return {
         "apiVersion": obj.get("apiVersion"),

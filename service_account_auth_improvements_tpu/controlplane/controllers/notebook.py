@@ -140,28 +140,35 @@ class NotebookReconciler(Reconciler):
         self._reemit_q: queue.Queue = queue.Queue()
         self._reemit_thread: threading.Thread | None = None
         self._reemit_stop = threading.Event()
-        self._pods_informer = None  # set by register(); None in bare tests
-        self._sts_informer = None
         self._node_pool_cache: dict[str, str | None] = {}
 
     # ------------------------------------------------------------ wiring
 
     def register(self, manager) -> "NotebookReconciler":
-        ctl = manager.add_reconciler(self)
+        # predicate: culling's probe stamps (every probe, per notebook)
+        # and this controller's own trace-annotation/status writes carry
+        # nothing reconcile() reads into children — without the filter,
+        # every probe wakes a full reconcile of an unchanged notebook
+        # (the event-storm half of CONTROLPLANE_BENCH's churn hot path)
+        ctl = manager.add_reconciler(self, predicate=helpers.update_predicate(
+            ignore_annotations=(*helpers.VOLATILE_PROBE_ANNOTATIONS,
+                                obs.TRACE_ANNOTATION),
+            ignore_status=True,
+        ))
         manager.watch_owned(ctl, "statefulsets", group="apps",
                             owner_kind="Notebook")
         manager.watch_owned(ctl, "services", owner_kind="Notebook")
         manager.watch_mapped(ctl, "pods", self._map_pod)
-        # gang admission reads host pods from this cache instead of a live
-        # apiserver LIST per reconcile; the same informer enqueues the
-        # reconcile, so its cache is already updated when we run
-        self._pods_informer = manager.informer("pods")
-        self._sts_informer = manager.informer("statefulsets", group="apps")
         # re-emit child pod/STS events onto the CR via a dedicated work
         # queue (never coalesced by reconcile-queue dedup, never blocking
         # the watch thread)
         manager.informer("events").add_handler(self._enqueue_event)
         self._start_reemit_worker()
+        # every read from here on is served by the informer caches the
+        # watches above already maintain (notebooks/STS/services/pods);
+        # writes — and the Conflict-retried status loop — still hit the
+        # apiserver through the same handle (docs/engine.md)
+        self.kube = manager.cached_client()
         return self
 
     @staticmethod
@@ -226,6 +233,30 @@ class NotebookReconciler(Reconciler):
                     log.warning("event re-emission dropped after %d "
                                 "attempts: %s", attempts + 1, e)
 
+    def _get_with_live_fallback(self, plural: str, name: str,
+                                ns: str | None,
+                                group: str | None = None) -> dict | None:
+        """Cache read with one live retry on miss, or None. The events
+        informer and the child informers ride independent watch streams,
+        so a child's FIRST event can overtake its ADDED into the cache —
+        a cache-only NotFound here would silently drop that event. The
+        live GET runs only in that race window (and for true strays),
+        so the steady state stays apiserver-free."""
+        try:
+            return self.kube.get(plural, name, namespace=ns, group=group)
+        except errors.NotFound:
+            pass
+        # only retry when the first read was cache-served: a bare client
+        # (or a pass-through read) already asked the apiserver, and a
+        # second identical GET would double the cost of every true stray
+        serves = getattr(self.kube, "serves", None)
+        if serves is None or not serves(plural, group=group, namespace=ns):
+            return None
+        try:
+            return self.kube.live.get(plural, name, namespace=ns, group=group)
+        except errors.NotFound:
+            return None
+
     def _reemit(self, event: dict) -> None:
         """Re-emit a child pod/STS event onto the owning Notebook
         (reference: notebook_controller.go:109-117 "Reissued from ...",
@@ -234,27 +265,20 @@ class NotebookReconciler(Reconciler):
         ns = event["metadata"].get("namespace")
         if kind == "StatefulSet":
             # resolve the owning CR via the STS's notebook-name label:
-            # a multi-slice STS is named <nb>-s<j>, not <nb>. Prefer the
-            # informer cache — under event storms a live GET per event adds
-            # avoidable apiserver load on the very path the informer exists
-            # to optimize; fall back to a GET on miss/unsynced.
-            sts = None
-            if (self._sts_informer is not None
-                    and self._sts_informer.has_synced()):
-                sts = self._sts_informer.get(ns, obj_name)
+            # a multi-slice STS is named <nb>-s<j>, not <nb>. Once
+            # registered this GET is an informer-cache hit — under event
+            # storms a live GET per event would add apiserver load on the
+            # very path the cache exists to optimize.
+            sts = self._get_with_live_fallback("statefulsets", obj_name,
+                                               ns, group="apps")
             if sts is None:
-                try:
-                    sts = self.kube.get("statefulsets", obj_name,
-                                        namespace=ns, group="apps")
-                except errors.NotFound:
-                    return  # stray event for an STS we never knew — drop
+                return  # stray event for an STS we never knew — drop
             nb_name = (sts["metadata"].get("labels") or {}).get(
                 "notebook-name"
             )
         else:
-            try:
-                pod = self.kube.get("pods", obj_name, namespace=ns)
-            except errors.NotFound:
+            pod = self._get_with_live_fallback("pods", obj_name, ns)
+            if pod is None:
                 return  # stray event for a pod we never knew — drop
             nb_name = (pod["metadata"].get("labels") or {}).get(
                 "notebook-name"
@@ -459,12 +483,15 @@ class NotebookReconciler(Reconciler):
         reference's contract); slices get an -s<j> suffix."""
         return base if num_slices == 1 else f"{base}-s{slice_id}"
 
-    def _owned_statefulsets(self, name: str, ns: str) -> list[dict]:
-        """STSes owned by Notebook ``name`` — matched on BOTH the
-        notebook-name label and an ownerReference to the Notebook, so a
-        user STS merely labeled to join the headless service is never
-        treated (or pruned) as ours. Served from the informer cache when
-        available (no apiserver LIST on the steady-state path)."""
+    def _owned_statefulsets(self, nb: dict) -> list[dict]:
+        """STSes owned by this Notebook — matched on BOTH the
+        notebook-name label and an ownerReference, so a user STS merely
+        labeled to join the headless service is never treated (or pruned)
+        as ours. Through the cached client this is an O(1) owner-UID
+        index hit (no apiserver LIST, no O(cache) scan); against a bare
+        client it falls back to a labeled LIST."""
+        name = nb["metadata"]["name"]
+        ns = nb["metadata"]["namespace"]
 
         def owned(o: dict) -> bool:
             if (o["metadata"].get("labels") or {}).get(
@@ -475,10 +502,12 @@ class NotebookReconciler(Reconciler):
                 for ref in o["metadata"].get("ownerReferences") or []
             )
 
-        if self._sts_informer is not None and self._sts_informer.has_synced():
+        by_owner = getattr(self.kube, "by_owner", None)
+        if by_owner is not None:
             return [
-                o for o in self._sts_informer.list()
-                if o["metadata"].get("namespace") == ns and owned(o)
+                o for o in by_owner("statefulsets", nb["metadata"]["uid"],
+                                    namespace=ns, group="apps")
+                if owned(o)
             ]
         return [
             o for o in self.kube.list(
@@ -490,9 +519,8 @@ class NotebookReconciler(Reconciler):
     def _prune_stale_statefulsets(self, nb: dict, keep: set[str]) -> None:
         """Delete owned STSes whose name no longer matches the desired
         slice layout (single↔multi-slice transitions, slices shrunk)."""
-        name = nb["metadata"]["name"]
         ns = nb["metadata"]["namespace"]
-        for sts in self._owned_statefulsets(name, ns):
+        for sts in self._owned_statefulsets(nb):
             sts_name = sts["metadata"]["name"]
             if sts_name not in keep:
                 self.recorder.event(
@@ -545,13 +573,12 @@ class NotebookReconciler(Reconciler):
         ]
         pods: list[tuple[int, dict]] = []
         for j, pod_name in expected:
-            if self._pods_informer is not None:
-                p = self._pods_informer.get(ns, pod_name)
-            else:
-                try:
-                    p = self.kube.get("pods", pod_name, namespace=ns)
-                except errors.NotFound:
-                    p = None
+            try:
+                # cache hit once registered: the pods informer that
+                # enqueued this reconcile has already absorbed the event
+                p = self.kube.get("pods", pod_name, namespace=ns)
+            except errors.NotFound:
+                p = None
             if p is not None:
                 pods.append((j, p))
         if len(pods) < want:
@@ -872,7 +899,8 @@ class NotebookReconciler(Reconciler):
     # -------------------------------------------------------------- status
 
     def update_status(self, nb: dict, sts_list, resolved,
-                      gang_cond: dict | None = None) -> None:
+                      gang_cond: dict | None = None,
+                      _attempt: int = 0) -> None:
         if isinstance(sts_list, dict):  # single-STS convenience (tests)
             sts_list = [sts_list]
         name = nb["metadata"]["name"]
@@ -950,10 +978,34 @@ class NotebookReconciler(Reconciler):
             nb["status"] = status
             try:
                 self.kube.update_status("notebooks", nb, group=GROUP)
-            except (errors.Conflict, errors.NotFound):
-                # Conflict: next event re-levels. NotFound: the CR was
-                # deleted mid-reconcile (queue-drain deletes race the
-                # status write) — backing off to retry a corpse is noise.
+            except errors.Conflict:
+                # Conflict means our (cache-served) baseline RV is behind
+                # — usually our own earlier annotation/status write. The
+                # retry loop goes LIVE: status events are predicate-
+                # filtered, so "wait for the next event to re-level"
+                # would wait forever on a settled object. Bounded so two
+                # writers can't ping-pong.
+                if _attempt < 2:
+                    try:
+                        live = getattr(self.kube, "live", self.kube).get(
+                            "notebooks", name, namespace=ns, group=GROUP
+                        )
+                    except errors.NotFound:
+                        return
+                    self.update_status(live, sts_list, resolved,
+                                       gang_cond, _attempt=_attempt + 1)
+                else:
+                    # retries exhausted: the write must NOT drop silently
+                    # — status events are predicate-filtered, so nothing
+                    # would ever re-level a settled object and its
+                    # readyReplicas/conditions would stay stale forever.
+                    # Raising fails this reconcile attempt; the worker's
+                    # rate-limited requeue re-runs it against a cache
+                    # that by then reflects the conflicting writer.
+                    raise
+            except errors.NotFound:
+                # the CR was deleted mid-reconcile (queue-drain deletes
+                # race the status write) — retrying a corpse is noise
                 pass
 
     def _main_container_name(self, nb: dict) -> str:

@@ -140,6 +140,9 @@ class PVCViewerReconciler(Reconciler):
         manager.watch_owned(ctl, "deployments", group="apps",
                             owner_kind="PVCViewer")
         manager.watch_owned(ctl, "services", owner_kind="PVCViewer")
+        # cached reads for the watched resources; the PVC/pod affinity
+        # scan (creation-time only) passes through live
+        self.kube = manager.cached_client()
         return self
 
     # ---------------------------------------------------------- reconcile

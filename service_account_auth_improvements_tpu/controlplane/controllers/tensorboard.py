@@ -84,6 +84,10 @@ class TensorboardReconciler(Reconciler):
         manager.watch_owned(ctl, "deployments", group="apps",
                             owner_kind="Tensorboard")
         manager.watch_owned(ctl, "services", owner_kind="Tensorboard")
+        # cached reads for the watched resources (tensorboards,
+        # deployments, services); PVC/pod reads for RWO affinity pass
+        # through live — they aren't watched here and run rarely
+        self.kube = manager.cached_client()
         return self
 
     # ---------------------------------------------------------- reconcile

@@ -158,6 +158,15 @@ class FakeKubelet:
         self._sts_inf.add_handler(self._on_sts)
         self._pod_inf = Informer(kube, "pods", tracer=tracer)
         self._pod_inf.add_handler(self._on_pod)
+        # _sync_sts_status runs per pod Ready-flip/delete: an O(pods)
+        # cache scan there is O(pods²) over a bench — index instead
+        self._pod_inf.add_index(
+            "sts",
+            lambda p: [f"{p['metadata'].get('namespace')}/"
+                       f"{(p['metadata'].get('labels') or {})['statefulset']}"]
+            if (p["metadata"].get("labels") or {}).get("statefulset")
+            else [],
+        )
 
     def start(self) -> None:
         self._flipper.start()
@@ -264,18 +273,22 @@ class FakeKubelet:
     def _sync_sts_status(self, ns: str, name: str,
                          replicas: int | None = None) -> None:
         """Maintain status.readyReplicas — what the notebook controller's
-        update_status reads."""
-        try:
-            sts = self.kube.get("statefulsets", name, namespace=ns,
-                                group="apps")
-        except errors.NotFound:
-            return
+        update_status reads. Served from the actuator's own informer
+        caches (the real StatefulSet controller is informer-driven too):
+        callers invoke this from watch dispatch, where the cache already
+        reflects the event being handled, so a live GET+LIST per pod flip
+        would only re-read what the watch just delivered."""
+        sts = self._sts_inf.get(ns, name)
+        if sts is None:
+            try:
+                sts = self.kube.get("statefulsets", name, namespace=ns,
+                                    group="apps")
+            except errors.NotFound:
+                return
         if replicas is None:
             replicas = int((sts.get("spec") or {}).get("replicas") or 0)
         ready = 0
-        for pod in self.kube.list(
-                "pods", namespace=ns,
-                label_selector=f"statefulset={name}")["items"]:
+        for pod in self._pod_inf.by_index("sts", f"{ns}/{name}"):
             for cond in (pod.get("status") or {}).get("conditions") or []:
                 if cond.get("type") == "Ready" and \
                         cond.get("status") == "True":
@@ -294,7 +307,22 @@ class FakeKubelet:
     # --------------------------------------------------- scheduler/kubelet
 
     def _on_pod(self, ev_type: str, pod: dict) -> None:
+        meta = pod["metadata"]
+        sts_label = (meta.get("labels") or {}).get("statefulset")
         if ev_type == "DELETED":
+            # a vanished pod moves readyReplicas: re-derive the STS
+            # status now that the cache (updated before dispatch) has
+            # dropped it
+            if sts_label:
+                self._sync_sts_status(meta.get("namespace"), sts_label)
+            return
+        if any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in (pod.get("status") or {}).get("conditions") or []):
+            # the Ready flip we (or a replay) wrote is now in the cache:
+            # fold it into the STS status. Event-driven, so the sync
+            # always sees a cache at least as new as the flip itself.
+            if sts_label:
+                self._sync_sts_status(meta.get("namespace"), sts_label)
             return
         spec = pod.get("spec") or {}
         if spec.get("schedulingGates"):
@@ -302,7 +330,6 @@ class FakeKubelet:
             # binding. The gang controller lifts the gate; the MODIFIED
             # event brings the pod back here.
             return
-        meta = pod["metadata"]
         ns, name, uid = meta.get("namespace"), meta["name"], meta["uid"]
         if not spec.get("nodeName"):
             try:
@@ -405,9 +432,8 @@ class FakeKubelet:
             return
         with self._lock:
             self.pods_ready += 1
-        sts = (pod["metadata"].get("labels") or {}).get("statefulset")
-        if sts:
-            self._sync_sts_status(ns, sts)
+        # no direct STS sync here: the Ready patch's MODIFIED event lands
+        # in _on_pod, which syncs against a cache that includes it
         if self._tracer is not None and scheduled_at is not None:
             # span runs pod-create → Ready-visible-on-the-STS: everything
             # the cluster (STS controller + scheduler + kubelet) did, so

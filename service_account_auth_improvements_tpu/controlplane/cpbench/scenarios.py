@@ -155,6 +155,12 @@ class _NotebookWorld:
         self.actuator = FakeKubelet(self.kube, cfg.actuation,
                                     seed=cfg.seed, tracer=self.trace)
         self.tracker.actuation_fn = self.actuator.actuation_for
+        #: the manager's delegating read client — what the converted
+        #: reconcilers read through; scenario poll loops use it too, so
+        #: the apiserver counters measure control-plane load, not the
+        #: bench's own polling
+        self.cached = self.mgr.cached_client()
+        self._api_t0 = self.kube.request_counts_snapshot()
         self._want: dict[tuple[str, str], int] = {}
         self._ready_inf = Informer(self.kube, "notebooks", group=GROUP,
                                    tracer=self.trace)
@@ -185,6 +191,24 @@ class _NotebookWorld:
         """Per-stage create→Ready attribution from the world's spans."""
         return stage_attribution(self.tracker.records(), self.trace)
 
+    def apiserver_extra(self, reconciles: int) -> dict:
+        """Apiserver request volume since world construction: per-verb
+        deltas, GET+LIST per reconcile, and the cached-read hit rate —
+        the before/after evidence for the delegating-read client."""
+        now = self.kube.request_counts_snapshot()
+        delta = {
+            verb: now.get(verb, 0) - self._api_t0.get(verb, 0)
+            for verb in sorted(set(now) | set(self._api_t0))
+        }
+        reads = delta.get("get", 0) + delta.get("list", 0)
+        return {
+            "apiserver_requests": delta,
+            "apiserver_reads_per_reconcile": round(
+                reads / max(reconciles, 1), 3
+            ),
+            "cached_reads": self.cached.stats(),
+        }
+
     def create_jobs(self, names: list[str], ns: str, tpu: dict | None,
                     want_ready: int):
         """One callable per CR: stamp the timeline, then POST."""
@@ -209,6 +233,7 @@ def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
     extra.setdefault("gate_violations", world.actuator.gate_violations)
     extra.setdefault("pods_created", world.actuator.pods_created)
     extra.setdefault("pods_ready", world.actuator.pods_ready)
+    extra.update(world.apiserver_extra(summary["reconciles"]))
     summary["extra"] = extra
     return ScenarioResult(
         name=world.tracker.scenario,
@@ -255,15 +280,15 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
     gang_scheduled = conflicts = gated_left = 0
     for name in names:
         try:
-            nb = world.kube.get("notebooks", name, namespace=ns,
-                                group=GROUP)
+            nb = world.cached.get("notebooks", name, namespace=ns,
+                                  group=GROUP)
         except errors.NotFound:
             continue
         conds = {c.get("type") for c in
                  (nb.get("status") or {}).get("conditions") or []}
         gang_scheduled += "GangScheduled" in conds
         conflicts += "SlicePlacementConflict" in conds
-        for pod in world.kube.list(
+        for pod in world.cached.list(
                 "pods", namespace=ns,
                 label_selector=f"notebook-name={name}")["items"]:
             if (pod.get("spec") or {}).get("schedulingGates"):
@@ -279,6 +304,7 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
         "gate_violations": world.actuator.gate_violations,
         "pods_created": world.actuator.pods_created,
         "pods_ready": world.actuator.pods_ready,
+        **world.apiserver_extra(summary["reconciles"]),
     }
     return ScenarioResult(
         name="gang_ready", elapsed_s=time.monotonic() - started,
@@ -305,8 +331,11 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         name = m.group(2)
         idx = name.rsplit("-", 1)[-1]
         try:
-            nb = world.kube.get("notebooks", name, namespace=ns,
-                                group=GROUP)
+            # cache-backed: this models the notebook's own HTTP kernels
+            # endpoint, which in a real cluster never touches the
+            # apiserver — the GET volume it would fake belongs to nobody
+            nb = world.cached.get("notebooks", name, namespace=ns,
+                                  group=GROUP)
         except errors.NotFound:
             return None
         ready = (nb.get("status") or {}).get("readyReplicas") or 0
@@ -341,11 +370,13 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         idle = [n for n in names if int(n.rsplit("-", 1)[-1]) % 5 == 0]
         deadline = time.monotonic() + cfg.timeout
         while idle and time.monotonic() < deadline:
+            # cached poll: the bench's own waiting must not inflate the
+            # apiserver GET volume it measures
             idle = [
                 n for n in idle
                 if STOP_ANNOTATION not in (
-                    world.kube.get("notebooks", n, namespace=ns,
-                                   group=GROUP)["metadata"]
+                    world.cached.get("notebooks", n, namespace=ns,
+                                     group=GROUP)["metadata"]
                     .get("annotations") or {})
             ]
             if idle:
@@ -366,7 +397,7 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         gen.run([delete(n) for n in names])
         deadline = time.monotonic() + cfg.timeout
         while time.monotonic() < deadline:
-            if not world.kube.list("pods", namespace=ns)["items"]:
+            if not world.cached.list("pods", namespace=ns)["items"]:
                 break
             time.sleep(0.02)
         else:
@@ -380,6 +411,7 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         "delete_cascade_ms": percentiles(delete_ms),
         "gate_violations": world.actuator.gate_violations,
         "pods_created": world.actuator.pods_created,
+        **world.apiserver_extra(summary["reconciles"]),
     }
     return ScenarioResult(
         name="churn", elapsed_s=time.monotonic() - started,
@@ -444,12 +476,20 @@ def scenario_profile_fanout(cfg: BenchConfig) -> ScenarioResult:
     ready_inf.stop()
     mgr.stop()
     summary = tracker.summary()
+    api = kube.request_counts_snapshot()
     summary["extra"] = {
         "namespaces": len(kube.list("namespaces")["items"]),
         "quotas": len(kube.list("resourcequotas")["items"]),
         "rolebindings": len(kube.list(
             "rolebindings", group="rbac.authorization.k8s.io")["items"]),
         "serviceaccounts": len(kube.list("serviceaccounts")["items"]),
+        # the profile reconciler still reads live (not converted); the
+        # raw tally keeps it comparable across PRs
+        "apiserver_requests": api,
+        "apiserver_reads_per_reconcile": round(
+            (api.get("get", 0) + api.get("list", 0))
+            / max(summary["reconciles"], 1), 3
+        ),
     }
     return ScenarioResult(
         name="profile_fanout", elapsed_s=time.monotonic() - started,
@@ -601,18 +641,23 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
 
     deleted: set[str] = set()
     double_bookings = 0
+    double_booking_samples: list[dict] = []  # first few, for diagnosis
     queued_peak = 0
     deadline = time.monotonic() + cfg.timeout
     while len(deleted) < len(names) and time.monotonic() < deadline:
         queued_peak = max(queued_peak, len(world.sched._queue))
-        # One LIST is an ATOMIC snapshot (the fake apiserver lists under
-        # its lock): per-name GETs would read an inconsistent cut — the
-        # scheduler can release a victim's pool and stamp its successor
-        # between two reads of the same tick, and a torn snapshot would
-        # blame the legitimate hand-off as a double booking.
+        # One LIST is an ATOMIC snapshot: the informer cache applies the
+        # event stream one event at a time under its lock, so a cached
+        # list is a consistent prefix of apiserver history — per-name
+        # GETs would read a torn cut where the scheduler has released a
+        # victim's pool and stamped its successor between two reads,
+        # blaming the legitimate hand-off as a double booking. (Cached
+        # rather than live so the bench's 20 ms poll doesn't dominate
+        # the LIST volume it reports.)
         snapshot = {
             o["metadata"]["name"]: o
-            for o in world.kube.list("notebooks", namespace=ns)["items"]
+            for o in world.cached.list("notebooks", namespace=ns,
+                                       group=GROUP)["items"]
         }
         live_pools: dict[str, list[str]] = {}
         to_delete: list[str] = []
@@ -634,9 +679,22 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
                 # preempted victim, placement already released: resume it
                 # so it re-queues (at its old priority) and drains too
                 to_resume.append(name)
-        double_bookings += sum(
-            1 for members in live_pools.values() if len(members) > 1
-        )
+        for pool, members in live_pools.items():
+            if len(members) > 1:
+                double_bookings += 1
+                if len(double_booking_samples) < 8:
+                    double_booking_samples.append({
+                        "pool": pool,
+                        "members": {
+                            m: {
+                                "annotations": dict(
+                                    snapshot[m]["metadata"].get(
+                                        "annotations") or {}),
+                                "readyReplicas": (snapshot[m].get("status")
+                                                  or {}).get("readyReplicas"),
+                            } for m in members
+                        },
+                    })
         for name in to_delete:
             try:
                 world.kube.delete("notebooks", name, namespace=ns,
@@ -664,10 +722,12 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
         "placed": len(placement_ms),
         "preemptions": int(world.sched.metrics.preemptions.value()),
         "double_bookings": double_bookings,
+        "double_booking_samples": double_booking_samples,
         "queued_peak": queued_peak,  # sampled, not derived: rate-paced
                                      # arrivals can drain before peaking
         "gate_violations": world.actuator.gate_violations,
         "pods_created": world.actuator.pods_created,
+        **world.apiserver_extra(summary["reconciles"]),
     }
     return ScenarioResult(
         name="sched_contention", elapsed_s=time.monotonic() - started,

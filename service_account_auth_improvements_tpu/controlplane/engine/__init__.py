@@ -4,6 +4,11 @@ controller-runtime contract rebuilt (SURVEY.md §3.5 startup shape)."""
 from service_account_auth_improvements_tpu.controlplane.engine.queue import (  # noqa: F401
     RateLimitingQueue,
 )
+from service_account_auth_improvements_tpu.controlplane.engine.cache import (  # noqa: F401
+    INDEX_NAMESPACE,
+    INDEX_OWNER_UID,
+    CachedClient,
+)
 from service_account_auth_improvements_tpu.controlplane.engine.informer import (  # noqa: F401
     Informer,
 )

@@ -1,0 +1,232 @@
+"""CachedClient: cache-backed delegating reads, apiserver writes.
+
+controller-runtime's single biggest perf lever rebuilt for this engine:
+the manager already pays for informers (a synced watch cache per watched
+resource), so reads should come from them — a reconcile that GETs its CR
+and LISTs its children from the apiserver on every pass multiplies
+request volume by the very churn the informers exist to absorb (the
+reference gets this from sigs.k8s.io/controller-runtime/pkg/client's
+delegating client; CONTROLPLANE_BENCH.json books the before/after as
+``apiserver_reads_per_reconcile``).
+
+Contract:
+
+- ``get``/``list`` are served from the informer cache when the resource
+  is **watched and synced** (and the informer's namespace scope covers
+  the request); otherwise they pass through to the apiserver.
+- ``by_owner`` is an O(1) hit on the owner-UID index the Manager
+  registers on every informer — "children of this notebook" without an
+  apiserver LIST *or* an O(cache) scan.
+- Everything else — create, update, update_status, patch, delete, watch,
+  pod_logs — delegates to the wrapped client untouched. Writes and the
+  conflict-retry status loops always hit the apiserver.
+- Cached reads return **deep copies** (exactly like the live client), so
+  a reconciler mutating its view can never corrupt the shared cache.
+- Staleness is bounded by the watch stream and absorbed by
+  level-triggered requeue: a reconcile acting on a stale read fails its
+  write (Conflict / AlreadyExists), backs off, and re-runs against the
+  updated cache (docs/engine.md "Read semantics").
+- ``live`` exposes the wrapped client for reads that must observe the
+  apiserver's current state (rare; document why at the call site).
+
+A ``get`` on a watched, synced resource that misses the cache raises
+``NotFound`` *from the cache* — trusting the informer is the point; a
+fallback live GET would reintroduce the full request volume on the
+hottest path (reconcile of a just-deleted object).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.kube.registry import (
+    DEFAULT_REGISTRY,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.selectors import (
+    parse_field_selector,
+    parse_label_selector,
+)
+
+#: standard indexes the Manager registers on every informer
+INDEX_OWNER_UID = "owner-uid"
+INDEX_NAMESPACE = "namespace"
+
+
+def index_owner_uid(obj: dict) -> list[str]:
+    return [ref["uid"] for ref in obj["metadata"].get("ownerReferences")
+            or [] if ref.get("uid")]
+
+
+def index_namespace(obj: dict) -> list[str]:
+    return [obj["metadata"].get("namespace") or ""]
+
+
+def live_client(kube):
+    """The apiserver-backed client behind ``kube``: CachedClient's
+    wrapped client, or ``kube`` itself when it is already a bare client.
+    The one idiom for must-observe-current-state reads (conflict-retry
+    re-reads, adoption confirms — docs/engine.md "When to force a live
+    read")."""
+    return getattr(kube, "live", kube)
+
+
+class CachedClient:
+    """Delegating client over a Manager's informer map (see module doc).
+
+    ``informers`` is the Manager's live registry dict — watches
+    registered after construction are picked up automatically.
+    """
+
+    def __init__(self, client, informers: dict, namespace: str | None = None,
+                 enabled: bool = True):
+        self._client = client
+        self._informers = informers
+        self._namespace = namespace
+        #: ENGINE_CACHED_READS=0 (manager.cached_client) flips this off:
+        #: every read passes through — the A/B lever behind the
+        #: before/after numbers in docs/controlplane_bench.md and the
+        #: escape hatch if a cache bug ever needs ruling out in prod
+        self._enabled = enabled
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def live(self):
+        """The wrapped client, for reads that must bypass the cache."""
+        return self._client
+
+    def stats(self) -> dict:
+        """Cache-served vs passed-through read counts (cpbench reports
+        the hit rate; the CI gate asserts it is present)."""
+        with self._lock:
+            hits, misses = self._hits, self._misses
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else None,
+        }
+
+    def _informer_for(self, plural: str, group: str | None,
+                      namespace: str | None):
+        """The informer able to serve this read, or None (pass through).
+        None when: not watched, not yet synced, or the informer watches a
+        single namespace that doesn't cover the request."""
+        if not self._enabled:
+            return None
+        inf = self._informers.get((group or "", plural))
+        if inf is None or not inf.has_synced():
+            return None
+        if inf.namespace is not None and namespace != inf.namespace:
+            return None
+        return inf
+
+    def serves(self, plural: str, group: str | None = None,
+               namespace: str | None = None) -> bool:
+        """True when a ``get``/``list`` for this resource would be
+        cache-served right now (watched, synced, namespace covered, and
+        caching enabled). Callers with a live-retry-on-miss pattern use
+        this to skip the retry when the first read already went live."""
+        return self._informer_for(plural, group, namespace) is not None
+
+    def _note(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self._hits += 1
+            else:
+                self._misses += 1
+
+    def _res(self, plural: str, group: str | None):
+        registry = getattr(self._client, "registry", None) or DEFAULT_REGISTRY
+        return registry.by_plural(plural, group)
+
+    # ---------------------------------------------------------------- reads
+
+    def get(self, plural: str, name: str, namespace: str | None = None,
+            group: str | None = None) -> dict:
+        inf = self._informer_for(plural, group, namespace)
+        if inf is None:
+            self._note(hit=False)
+            return self._client.get(plural, name, namespace=namespace,
+                                    group=group)
+        self._note(hit=True)
+        obj = inf.get(namespace, name)
+        if obj is None:
+            raise errors.NotFound(f"{plural} {name!r} not found (cache)")
+        return copy.deepcopy(obj)
+
+    def list(self, plural: str, namespace: str | None = None,
+             label_selector: str = "", field_selector: str = "",
+             group: str | None = None) -> dict:
+        inf = self._informer_for(plural, group, namespace)
+        if inf is None:
+            self._note(hit=False)
+            return self._client.list(
+                plural, namespace=namespace, label_selector=label_selector,
+                field_selector=field_selector, group=group,
+            )
+        self._note(hit=True)
+        res = self._res(plural, group)
+        if res.namespaced and namespace:
+            try:
+                candidates = inf.by_index(INDEX_NAMESPACE, namespace)
+            except KeyError:
+                # the Manager registers the namespace index on every
+                # informer, but CachedClient is also constructible over
+                # hand-built informers (tests, tools) — an O(cache)
+                # filter there beats leaking by_index's fail-loud
+                # KeyError through a public read API
+                candidates = [
+                    o for o in inf.list()
+                    if o["metadata"].get("namespace") == namespace
+                ]
+        else:
+            candidates = inf.list()
+        pred = parse_label_selector(label_selector)
+        fpred = parse_field_selector(field_selector)
+        items = [
+            copy.deepcopy(o) for o in candidates
+            if pred(o["metadata"].get("labels") or {}) and fpred(o)
+        ]
+        items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                  o["metadata"]["name"]))
+        return {
+            "apiVersion": res.api_version,
+            "kind": res.kind + "List",
+            "metadata": {"resourceVersion": inf.last_resource_version()},
+            "items": items,
+        }
+
+    def by_owner(self, plural: str, owner_uid: str,
+                 namespace: str | None = None,
+                 group: str | None = None) -> list[dict]:
+        """Objects owner-referencing ``owner_uid`` — an O(1) index hit on
+        a watched resource; an apiserver LIST + ownerReferences filter
+        otherwise. Always returns deep copies."""
+        inf = self._informer_for(plural, group, namespace)
+        if inf is None:
+            self._note(hit=False)
+            items = self._client.list(plural, namespace=namespace,
+                                      group=group)["items"]
+            return [o for o in items
+                    if owner_uid in index_owner_uid(o)]
+        self._note(hit=True)
+        return [
+            copy.deepcopy(o)
+            for o in inf.by_index(INDEX_OWNER_UID, owner_uid)
+            if not namespace or o["metadata"].get("namespace") == namespace
+        ]
+
+    # --------------------------------------------------------------- writes
+
+    def __getattr__(self, name: str):
+        # writes (create/update/update_status/patch/delete) and the rest
+        # of the client surface (watch, pod_logs, sar_hook, registry, …)
+        # delegate untouched; resolved at call time so test
+        # instrumentation wrapping the raw client is honored
+        return getattr(self._client, name)

@@ -45,15 +45,27 @@ class Informer:
         self._tracer = tracer
         self._handlers: list = []
         self._cache: dict[tuple, dict] = {}
+        #: indexers: name -> key_fn(obj) -> iterable of index keys; the
+        #: materialized index maps name -> index key -> {cache key: obj}.
+        #: Maintained under the cache lock on every add/update/delete/
+        #: relist, so a ``by_index`` hit is always exactly as fresh as the
+        #: cache itself (client-go's Indexer contract).
+        self._indexers: dict[str, object] = {}
+        self._indexes: dict[str, dict] = {}
+        self._index_reverse: dict[str, dict] = {}
+        self._last_rv: str = "0"
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: threading.Thread | None = None
 
     # handler: fn(event_type: str, obj: dict) — called for ADDED/MODIFIED/
-    # DELETED (and SYNC on resync/list replay).
-    def add_handler(self, fn) -> None:
-        self._handlers.append(fn)
+    # DELETED (and SYNC on resync/list replay). With ``want_old=True`` the
+    # handler is called fn(event_type, obj, old) where ``old`` is the
+    # cache's previous view of the object (None for first sight) — the
+    # raw material for controller-runtime-style update predicates.
+    def add_handler(self, fn, want_old: bool = False) -> None:
+        self._handlers.append((fn, want_old))
 
     def get(self, namespace: str | None, name: str) -> dict | None:
         with self._lock:
@@ -65,6 +77,84 @@ class Informer:
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
+
+    def last_resource_version(self) -> str:
+        """Most recent resourceVersion the cache reflects (list envelope
+        RV for cache-served LISTs)."""
+        with self._lock:
+            return self._last_rv
+
+    # ------------------------------------------------------------ indexes
+
+    def add_index(self, name: str, key_fn) -> None:
+        """Register an indexer: ``key_fn(obj) -> iterable of str`` (empty
+        for unindexed objects). Idempotent per name; may be called before
+        or after start — the index is (re)built from the current cache."""
+        with self._lock:
+            self._indexers[name] = key_fn
+            index: dict = {}
+            reverse: dict = {}
+            for okey, obj in self._cache.items():
+                self._index_add(name, key_fn, index, reverse, okey, obj)
+            self._indexes[name] = index
+            self._index_reverse[name] = reverse
+
+    def by_index(self, name: str, key: str) -> list[dict]:
+        """Objects whose indexer emitted ``key`` — an O(1) bucket hit.
+        Raises KeyError for an unregistered index (a typo must fail loud,
+        not read as an empty cluster)."""
+        with self._lock:
+            if name not in self._indexes:
+                raise KeyError(f"informer {self.plural}: no index {name!r}")
+            return list(self._indexes[name].get(key, {}).values())
+
+    @staticmethod
+    def _index_add(name: str, key_fn, index: dict, reverse: dict,
+                   okey: tuple, obj: dict) -> None:
+        try:
+            keys = tuple(key_fn(obj) or ())
+        except Exception:  # a broken key_fn must not kill the watch loop
+            log.exception("indexer %s failed", name)
+            keys = ()
+        reverse[okey] = keys
+        for k in keys:
+            index.setdefault(k, {})[okey] = obj
+
+    # cache mutation helpers: every write path goes through these so the
+    # indexes can never drift from the cache. The reverse map (cache key →
+    # emitted index keys) makes update/delete O(keys-per-object), not
+    # O(buckets).
+
+    def _unindex(self, okey: tuple) -> None:
+        for name, reverse in self._index_reverse.items():
+            index = self._indexes[name]
+            for k in reverse.pop(okey, ()):
+                entries = index.get(k)
+                if entries is not None:
+                    entries.pop(okey, None)
+                    if not entries:
+                        del index[k]
+
+    def _cache_set(self, okey: tuple, obj: dict) -> None:
+        self._unindex(okey)
+        self._cache[okey] = obj
+        for name, key_fn in self._indexers.items():
+            self._index_add(name, key_fn, self._indexes[name],
+                            self._index_reverse[name], okey, obj)
+
+    def _cache_delete(self, okey: tuple) -> None:
+        self._unindex(okey)
+        self._cache.pop(okey, None)
+
+    def _cache_replace(self, fresh: dict[tuple, dict]) -> None:
+        self._cache = fresh
+        for name, key_fn in self._indexers.items():
+            index: dict = {}
+            reverse: dict = {}
+            for okey, obj in fresh.items():
+                self._index_add(name, key_fn, index, reverse, okey, obj)
+            self._indexes[name] = index
+            self._index_reverse[name] = reverse
 
     def start(self) -> None:
         self._thread = threading.Thread(
@@ -85,7 +175,8 @@ class Informer:
         return (m.get("namespace") or "", m["name"])
 
     def _dispatch(self, ev_type: str, obj: dict,
-                  emitted: float | None = None) -> None:
+                  emitted: float | None = None,
+                  old: dict | None = None) -> None:
         received = time.monotonic()
         # the apiserver may stamp the event's emission instant (FakeKube
         # does — same process, same monotonic clock): lag then covers the
@@ -94,9 +185,12 @@ class Informer:
         start = received
         if emitted is not None and 0 <= received - emitted < 300:
             start = emitted
-        for fn in self._handlers:
+        for fn, want_old in self._handlers:
             try:
-                fn(ev_type, obj)
+                if want_old:
+                    fn(ev_type, obj, old)
+                else:
+                    fn(ev_type, obj)
             except Exception:  # handler bugs must not kill the watch loop
                 log.exception("informer handler failed (%s)", self.plural)
         done = time.monotonic()
@@ -144,15 +238,17 @@ class Informer:
             # Keep the last-known objects for keys that vanished while
             # the watch was down — handlers (e.g. Owns mapping by
             # ownerReferences) need the real object, not a stub.
+            prev = self._cache
             stale_objs = [
-                obj for key, obj in self._cache.items()
+                obj for key, obj in prev.items()
                 if key not in fresh
             ]
-            self._cache = fresh
+            self._cache_replace(fresh)
+            self._last_rv = rv
         for obj in stale_objs:
-            self._dispatch("DELETED", obj)
-        for obj in fresh.values():
-            self._dispatch("SYNC", obj)
+            self._dispatch("DELETED", obj, old=obj)
+        for key, obj in fresh.items():
+            self._dispatch("SYNC", obj, old=prev.get(key))
         self._synced.set()
         return rv
 
@@ -199,11 +295,15 @@ class Informer:
                         continue
                     key = self._key(obj)
                     with self._lock:
+                        old = self._cache.get(key)
                         if et == "DELETED":
-                            self._cache.pop(key, None)
+                            self._cache_delete(key)
                         else:
-                            self._cache[key] = obj
-                    self._dispatch(et, obj, emitted=ev.get("emittedAt"))
+                            self._cache_set(key, obj)
+                        if rv:
+                            self._last_rv = rv
+                    self._dispatch(et, obj, emitted=ev.get("emittedAt"),
+                                   old=old)
                 # normal watch expiry (timeout): re-watch from the last RV
                 # without relisting. A clean-but-idle round trip is also
                 # progress — without this, blips spread over days would

@@ -12,9 +12,17 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import threading
 import time
 
+from service_account_auth_improvements_tpu.controlplane.engine.cache import (
+    INDEX_NAMESPACE,
+    INDEX_OWNER_UID,
+    CachedClient,
+    index_namespace,
+    index_owner_uid,
+)
 from service_account_auth_improvements_tpu.controlplane.engine.informer import (
     Informer,
 )
@@ -25,6 +33,10 @@ from service_account_auth_improvements_tpu.controlplane.engine.queue import (
     RateLimitingQueue,
 )
 from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.utils.env import (
+    get_env_bool,
+    get_env_int,
+)
 
 log = logging.getLogger(__name__)
 
@@ -174,16 +186,39 @@ class Controller:
 
 
 class Manager:
+    #: reconcile workers per controller. Safe above 1 because
+    #: RateLimitingQueue serializes per key (one in-flight reconcile per
+    #: object, level-triggered re-add while processing); 4 matches the
+    #: cached-read era where reconciles are CPU-bound, not apiserver-bound
+    DEFAULT_WORKERS = 4
+
+    @classmethod
+    def _default_workers(cls) -> int:
+        """DEFAULT_WORKERS capped at the box's CPU count (floor 2): a
+        GIL runtime gains nothing from workers it cannot run — on a
+        2-core box, 4 workers per controller just move the waiting from
+        the workqueue into watch-delivery lag (measured: cpbench churn
+        deliver p50 roughly doubles at 4 vs 2 workers there)."""
+        cpus = os.cpu_count() or cls.DEFAULT_WORKERS
+        return min(cls.DEFAULT_WORKERS, max(2, cpus))
+
     def __init__(self, client, namespace: str | None = None,
-                 default_workers: int = 1, tracer=None):
+                 default_workers: int | None = None, tracer=None):
         self.client = client
         self.namespace = namespace
-        self.default_workers = default_workers
+        #: ENGINE_DEFAULT_WORKERS mirrors controller-runtime's
+        #: MaxConcurrentReconciles flag — the deploy-time lever when a
+        #: workload's reconciles are CPU-bound enough that extra workers
+        #: only add GIL contention
+        self.default_workers = default_workers or get_env_int(
+            "ENGINE_DEFAULT_WORKERS", self._default_workers()
+        )
         #: per-manager tracer (benches isolate scenarios); defaults to
         #: the process-global one so binaries need no wiring
         self.tracer = tracer if tracer is not None else obs.TRACER
         self._informers: dict[tuple, Informer] = {}
         self._controllers: list[Controller] = []
+        self._cached_client: CachedClient | None = None
         self._started = False
 
     # ------------------------------------------------------------ wiring
@@ -196,11 +231,30 @@ class Manager:
                     "cannot register new watches after Manager.start() — "
                     "the informer thread would never run"
                 )
-            self._informers[key] = Informer(
+            inf = Informer(
                 self.client, plural, group=group, namespace=self.namespace,
                 tracer=self.tracer,
             )
+            # standard indexes on every watch: "children of this owner"
+            # and "objects in this namespace" are the two lookups every
+            # controller does per reconcile — index maintenance is O(1)
+            # per event, the reads become O(bucket)
+            inf.add_index(INDEX_OWNER_UID, index_owner_uid)
+            inf.add_index(INDEX_NAMESPACE, index_namespace)
+            self._informers[key] = inf
         return self._informers[key]
+
+    def cached_client(self) -> CachedClient:
+        """The delegating read client over this manager's informers —
+        reconcilers swap to it in ``register`` (reads from the watch
+        cache, writes to the apiserver). One instance per manager so the
+        hit/miss stats aggregate across controllers."""
+        if self._cached_client is None:
+            self._cached_client = CachedClient(
+                self.client, self._informers, namespace=self.namespace,
+                enabled=get_env_bool("ENGINE_CACHED_READS", True),
+            )
+        return self._cached_client
 
     def informers_synced(self) -> bool:
         """True when every registered informer has completed its initial
@@ -208,7 +262,19 @@ class Manager:
         return all(inf.has_synced() for inf in self._informers.values())
 
     def add_reconciler(self, reconciler: Reconciler,
-                       workers: int | None = None) -> Controller:
+                       workers: int | None = None,
+                       predicate=None) -> Controller:
+        """Register a reconciler For its primary resource.
+
+        ``predicate`` is controller-runtime's event-filter analog:
+        ``fn(ev_type, old, new) -> bool`` decides whether an event
+        enqueues a reconcile (``old`` is the informer cache's previous
+        view, None on first sight). Use it to keep write-per-check
+        controllers (probe timestamps, position restamps) from waking
+        every watcher of the resource on every probe — the event-volume
+        half of the cached-reads perf work. DELETED cleanup (backoff
+        forget) runs regardless of the predicate's verdict.
+        """
         if self._started:
             raise RuntimeError(
                 "cannot add reconcilers after Manager.start()"
@@ -217,12 +283,24 @@ class Manager:
                          workers=workers or self.default_workers)
         self._controllers.append(ctl)
 
-        def primary_handler(ev_type, obj):
+        def primary_handler(ev_type, obj, old=None):
             m = obj["metadata"]
-            ctl.enqueue(Request(m.get("namespace"), m["name"]))
+            req = Request(m.get("namespace"), m["name"])
+            if ev_type == "DELETED":
+                # the object is gone: its per-key backoff state must not
+                # outlive it (under churn the failure map would otherwise
+                # accumulate one entry per deleted-while-failing CR,
+                # forever). The deletion reconcile still runs — it just
+                # starts with a clean rate-limiter.
+                ctl.enqueue(req)
+                ctl.queue.forget(req)
+                return
+            if predicate is not None and not predicate(ev_type, old, obj):
+                return
+            ctl.enqueue(req)
 
         self.informer(reconciler.resource, reconciler.group).add_handler(
-            primary_handler
+            primary_handler, want_old=True
         )
         return ctl
 

@@ -15,6 +15,7 @@ stay uninstrumented and cost nothing extra.
 
 from __future__ import annotations
 
+import collections
 import heapq
 import threading
 import time
@@ -27,7 +28,10 @@ class RateLimitingQueue:
         self._pending: set = set()
         self._processing: set = set()
         self._dirty: set = set()          # re-added while processing
-        self._order: list = []            # FIFO of pending keys
+        #: FIFO of pending keys; a deque so dequeue is O(1) — ``_pending``
+        #: dedup guarantees each key appears at most once, so popleft
+        #: never has to skip stale entries
+        self._order: collections.deque = collections.deque()
         self._delayed: list = []          # heap of (when, seq, key)
         self._seq = 0
         self._failures: dict = {}
@@ -80,6 +84,8 @@ class RateLimitingQueue:
 
     def add_rate_limited(self, key) -> None:
         with self._lock:
+            if self._shutdown:
+                return  # no retry is coming; don't grow backoff state
             n = self._failures.get(key, 0)
             self._failures[key] = n + 1
             if self._metrics is not None:
@@ -122,7 +128,7 @@ class RateLimitingQueue:
                         self._order.append(key)
                         self._note_pending_locked(key)
                 if self._order:
-                    key = self._order.pop(0)
+                    key = self._order.popleft()
                     self._pending.discard(key)
                     self._processing.add(key)
                     enqueued = self._added_at.pop(key, None)

@@ -30,54 +30,19 @@ from service_account_auth_improvements_tpu.controlplane.kube.registry import (
     Registry,
     Resource,
 )
+from service_account_auth_improvements_tpu.controlplane.kube.selectors import (
+    parse_field_selector,
+    parse_label_selector,
+)
+
+__all__ = [
+    "FakeKube", "json_merge_patch", "match_selector",
+    "parse_label_selector",  # re-export: historical home of the helper
+]
 
 
 def _now() -> str:
     return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-
-
-def parse_label_selector(sel: str):
-    """Parse equality/set-based selector into a predicate over labels."""
-    requirements = []
-    if not sel:
-        return lambda labels: True
-    for term in sel.split(","):
-        term = term.strip()
-        if not term:
-            continue
-        if " in " in term:
-            key, _, vals = term.partition(" in ")
-            vals = {v.strip() for v in vals.strip(" ()").split(",")}
-            requirements.append(("in", key.strip(), vals))
-        elif " notin " in term:
-            key, _, vals = term.partition(" notin ")
-            vals = {v.strip() for v in vals.strip(" ()").split(",")}
-            requirements.append(("notin", key.strip(), vals))
-        elif "!=" in term:
-            key, _, val = term.partition("!=")
-            requirements.append(("ne", key.strip(), val.strip()))
-        elif "=" in term:
-            key, _, val = term.partition("==" if "==" in term else "=")
-            requirements.append(("eq", key.strip(), val.strip()))
-        else:
-            requirements.append(("exists", term, None))
-
-    def pred(labels: dict) -> bool:
-        labels = labels or {}
-        for op, key, val in requirements:
-            if op == "eq" and labels.get(key) != val:
-                return False
-            if op == "ne" and labels.get(key) == val:
-                return False
-            if op == "in" and labels.get(key) not in val:
-                return False
-            if op == "notin" and labels.get(key) in val:
-                return False
-            if op == "exists" and key not in labels:
-                return False
-        return True
-
-    return pred
 
 
 def match_selector(obj: dict, selector: dict | None) -> bool:
@@ -137,8 +102,22 @@ class FakeKube:
         self._watches: list[_Watch] = []
         self._pod_logs: dict[tuple, str] = {}   # (ns, pod) -> log text
         self.sar_hook = None  # SubjectAccessReview callback (web tier)
+        #: per-verb request tally (apiserver_requests{verb} in cpbench):
+        #: every external call through the client interface counts once;
+        #: internal fan-out (GC cascade deletes) counts as the requests a
+        #: real garbage collector would issue
+        self.request_counts: dict[str, int] = {}
 
     # ------------------------------------------------------------ helpers
+
+    def _count(self, verb: str) -> None:
+        with self._lock:
+            self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
+
+    def request_counts_snapshot(self) -> dict[str, int]:
+        """Copy of the per-verb tally (scenarios diff two snapshots)."""
+        with self._lock:
+            return dict(self.request_counts)
 
     def _res(self, plural: str, group: str | None = None) -> Resource:
         try:
@@ -177,6 +156,7 @@ class FakeKube:
 
     def create(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None) -> dict:
+        self._count("create")
         res = self._res(plural, group)
         if res.kind == "SubjectAccessReview":
             return self._evaluate_sar(obj)
@@ -254,6 +234,7 @@ class FakeKube:
 
     def get(self, plural: str, name: str, namespace: str | None = None,
             group: str | None = None) -> dict:
+        self._count("get")
         res = self._res(plural, group)
         with self._lock:
             key = self._key(res, namespace, name)
@@ -265,19 +246,10 @@ class FakeKube:
     def list(self, plural: str, namespace: str | None = None,
              label_selector: str = "", field_selector: str = "",
              group: str | None = None) -> dict:
+        self._count("list")
         res = self._res(plural, group)
         pred = parse_label_selector(label_selector)
-        fields = {}  # key -> (negate, value); supports =, ==, !=
-        for term in (field_selector or "").split(","):
-            term = term.strip()
-            if not term:
-                continue
-            if "!=" in term:
-                k, _, v = term.partition("!=")
-                fields[k.strip()] = (True, v.strip())
-            elif "=" in term:
-                k, _, v = term.partition("==" if "==" in term else "=")
-                fields[k.strip()] = (False, v.strip())
+        fpred = parse_field_selector(field_selector)
         with self._lock:
             items = []
             for (g, p, ns, name), obj in self._store.items():
@@ -287,17 +259,8 @@ class FakeKube:
                     continue
                 if not pred((obj["metadata"].get("labels") or {})):
                     continue
-                if fields:
-                    ok = True
-                    for fk, (negate, fv) in fields.items():
-                        cur = obj
-                        for part in fk.split("."):
-                            cur = (cur or {}).get(part)
-                        if (cur == fv) == negate:
-                            ok = False
-                            break
-                    if not ok:
-                        continue
+                if not fpred(obj):
+                    continue
                 items.append(copy.deepcopy(obj))
             items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
                                       o["metadata"]["name"]))
@@ -310,6 +273,7 @@ class FakeKube:
 
     def update(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None, subresource: str | None = None) -> dict:
+        self._count("update")
         res = self._res(plural, group)
         with self._lock:
             meta = obj.get("metadata") or {}
@@ -366,6 +330,7 @@ class FakeKube:
 
     def patch(self, plural: str, name: str, patch, namespace: str | None = None,
               group: str | None = None, patch_type: str = "merge") -> dict:
+        self._count("patch")
         res = self._res(plural, group)
         with self._lock:
             key = self._key(res, namespace, name)
@@ -396,6 +361,7 @@ class FakeKube:
 
     def delete(self, plural: str, name: str, namespace: str | None = None,
                group: str | None = None) -> dict:
+        self._count("delete")
         res = self._res(plural, group)
         with self._lock:
             key = self._key(res, namespace, name)
@@ -461,6 +427,7 @@ class FakeKube:
         waiting for events; it ends after ``timeout`` seconds of inactivity
         if given (else runs until closed by the caller).
         """
+        self._count("watch")
         res = self._res(plural, group)
         hkey = (res.group, res.plural)
         rv = int(resource_version or 0)

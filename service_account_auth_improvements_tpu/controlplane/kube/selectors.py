@@ -1,0 +1,84 @@
+"""Selector matching shared by every LIST implementation.
+
+The kube contract has exactly one selector semantics; this module is the
+single implementation behind FakeKube's live ``list`` (kube/fake.py) and
+the informer-cache-backed ``CachedClient.list`` (engine/cache.py). Keeping
+both on one helper is what guarantees a cached list can never drift from
+what the apiserver would have returned for the same selector — the
+property tests/test_cache.py pins with a live-vs-cached matrix.
+"""
+
+from __future__ import annotations
+
+
+def parse_label_selector(sel: str):
+    """Parse equality/set-based selector into a predicate over labels."""
+    requirements = []
+    if not sel:
+        return lambda labels: True
+    for term in sel.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if " in " in term:
+            key, _, vals = term.partition(" in ")
+            vals = {v.strip() for v in vals.strip(" ()").split(",")}
+            requirements.append(("in", key.strip(), vals))
+        elif " notin " in term:
+            key, _, vals = term.partition(" notin ")
+            vals = {v.strip() for v in vals.strip(" ()").split(",")}
+            requirements.append(("notin", key.strip(), vals))
+        elif "!=" in term:
+            key, _, val = term.partition("!=")
+            requirements.append(("ne", key.strip(), val.strip()))
+        elif "=" in term:
+            key, _, val = term.partition("==" if "==" in term else "=")
+            requirements.append(("eq", key.strip(), val.strip()))
+        else:
+            requirements.append(("exists", term, None))
+
+    def pred(labels: dict) -> bool:
+        labels = labels or {}
+        for op, key, val in requirements:
+            if op == "eq" and labels.get(key) != val:
+                return False
+            if op == "ne" and labels.get(key) == val:
+                return False
+            if op == "in" and labels.get(key) not in val:
+                return False
+            if op == "notin" and labels.get(key) in val:
+                return False
+            if op == "exists" and key not in labels:
+                return False
+        return True
+
+    return pred
+
+
+def parse_field_selector(sel: str):
+    """Parse a field selector (``=``, ``==``, ``!=`` over dotted paths)
+    into a predicate over whole objects."""
+    fields = {}  # key -> (negate, value)
+    for term in (sel or "").split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            k, _, v = term.partition("!=")
+            fields[k.strip()] = (True, v.strip())
+        elif "=" in term:
+            k, _, v = term.partition("==" if "==" in term else "=")
+            fields[k.strip()] = (False, v.strip())
+    if not fields:
+        return lambda obj: True
+
+    def pred(obj: dict) -> bool:
+        for fk, (negate, fv) in fields.items():
+            cur = obj
+            for part in fk.split("."):
+                cur = (cur or {}).get(part)
+            if (cur == fv) == negate:
+                return False
+        return True
+
+    return pred

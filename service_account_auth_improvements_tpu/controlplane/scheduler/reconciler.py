@@ -92,6 +92,13 @@ log = logging.getLogger(__name__)
 
 PRIORITY_ANNOTATION = "tpukf.dev/priority"
 PREEMPTED_BY_ANNOTATION = "tpukf.dev/preempted-by"
+#: stamped alongside every placement and never cleared: marks a notebook
+#: as queue-managed. The legacy-ADOPTION path is only for workloads that
+#: predate the scheduler — a marked notebook that looks running-but-
+#: unannotated is a stopped/preempted workload mid-teardown (stale
+#: readyReplicas, pods still draining off its OLD pool), and adopting
+#: that pool would double-book whoever placement handed it to meanwhile.
+MANAGED_ANNOTATION = "tpukf.dev/tpusched-managed"
 CONDITION_SCHEDULED = "Scheduled"
 #: ResourceQuota-style key the Profile's resourceQuotaSpec budgets chips
 #: under; tpusched charges it at ADMISSION, namespace ResourceQuota only
@@ -144,24 +151,44 @@ class SchedulerReconciler(Reconciler):
         self._assigned: dict[tuple[str, str], Assignment] = {}
         self._assign_seq = 0
         self._evicting: set[tuple[str, str]] = set()
+        #: placements committed to the book whose annotation stamp hasn't
+        #: landed yet (the stamp happens lock-free after the pass).
+        #: Preemption must not choose these as victims: the victim's
+        #: stop-reconcile would see no annotation to clear, free the
+        #: chips, and then the delayed stamp would land on a stopped
+        #: notebook — a pool annotation nobody owns, reading as a double
+        #: booking against whoever the chips went to.
+        self._unstamped: set[tuple[str, str]] = set()
         self._seen_classes: set[str] = set()
-        self._node_informer = None
-        self._nb_informer = None
-        self._profile_informer = None
+        self._registered = False
+        self._ctl = None
 
     # ------------------------------------------------------------ wiring
 
     def register(self, manager) -> "SchedulerReconciler":
-        ctl = manager.add_reconciler(self)
+        # predicate: culling's probe stamps change nothing admission
+        # reads — without the filter every probe triggers a full
+        # placement pass per notebook. Status stays significant (the
+        # legacy-adoption path keys off readyReplicas).
+        ctl = manager.add_reconciler(self, predicate=helpers.update_predicate(
+            ignore_annotations=(*helpers.VOLATILE_PROBE_ANNOTATIONS,
+                                obs.TRACE_ANNOTATION),
+        ))
         # capacity events: a new/removed node re-evaluates the queue;
         # profile events too — a raised quota or changed priority class
         # must unpark waiters without any notebook/node event happening
         manager.watch_mapped(ctl, "nodes", self._map_capacity_event)
         manager.watch_mapped(ctl, "profiles", self._map_capacity_event,
                              group=GROUP)
-        self._node_informer = manager.informer("nodes")
-        self._nb_informer = manager.informer("notebooks", group=GROUP)
-        self._profile_informer = manager.informer("profiles", group=GROUP)
+        # the watches above give the cached client everything the
+        # placement pass reads (nodes, profiles, notebooks) — a pass over
+        # a deep queue is O(queue) cache hits, zero apiserver round trips;
+        # annotation stamps and status writes still go live
+        self.kube = manager.cached_client()
+        #: kept for conflict-retry exhaustion: a dropped condition write
+        #: re-enqueues the notebook instead of staying stale
+        self._ctl = ctl
+        self._registered = True
         return self
 
     def _map_capacity_event(self, ev_type, obj):
@@ -176,10 +203,11 @@ class SchedulerReconciler(Reconciler):
 
     def setup(self, manager) -> None:
         """Rebuild the assignment book from annotated CRs (informers are
-        synced before workers start) — restart-safe accounting."""
-        if self._nb_informer is None:
+        synced before workers start, so this LIST is a cache read) —
+        restart-safe accounting."""
+        if not self._registered:
             return
-        for nb in self._nb_informer.list():
+        for nb in self.kube.list("notebooks", group=GROUP)["items"]:
             try:
                 resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))
             except tpu.TpuValidationError:
@@ -265,14 +293,39 @@ class SchedulerReconciler(Reconciler):
         # honoring a live pin edit would roll pods off the booked pool
         # while the inventory still charges it.
         pool = annots.get(tpu.ANNOTATION_NODEPOOL)
-        if not pool and (
+        if not pool and MANAGED_ANNOTATION not in annots and (
+                (nb.get("status") or {}).get("readyReplicas") or 0) > 0:
+            # Cache says running-but-unannotated and the notebook has
+            # never been through placement — the legacy pre-scheduler
+            # shape. CONFIRM LIVE before adopting: a stopped/resumed
+            # notebook can look like this in a lagging cache (stale
+            # readyReplicas from before its teardown). Adoption is a
+            # once-per-workload migration affordance; a live GET here
+            # is cheap.
+            try:
+                nb = getattr(self.kube, "live", self.kube).get(
+                    "notebooks", req.name, namespace=req.namespace,
+                    group=GROUP,
+                )
+            except errors.NotFound:
+                self._forget(key)
+                self._run_queue()
+                return Result()
+            annots = nb["metadata"].get("annotations") or {}
+            pool = annots.get(tpu.ANNOTATION_NODEPOOL)
+            if STOP_ANNOTATION in annots:
+                # stopping after all: the stop branch (re)runs off its
+                # own event; don't adopt a workload on its way down
+                return Result()
+        if not pool and MANAGED_ANNOTATION not in annots and (
                 (nb.get("status") or {}).get("readyReplicas") or 0) > 0:
             # Legacy RUNNING notebook from before the scheduler was
-            # enabled: ADOPT it in place — book and stamp the pool it
-            # actually occupies (the spec pin, else the pool its bound
-            # pods sit on). Re-admitting a live workload would re-place
-            # it onto a best-fit pool (restarting it) while its real
-            # pool read as free — double-booking by blindness.
+            # enabled (live-confirmed): ADOPT it in place — book and
+            # stamp the pool it actually occupies (the spec pin, else
+            # the pool its bound pods sit on). Re-admitting a live
+            # workload would re-place it onto a best-fit pool
+            # (restarting it) while its real pool read as free —
+            # double-booking by blindness.
             pool = resolved.node_pool or self._bound_pool(nb)
             if pool:
                 try:
@@ -280,6 +333,7 @@ class SchedulerReconciler(Reconciler):
                         "notebooks", req.name,
                         {"metadata": {"annotations": {
                             tpu.ANNOTATION_NODEPOOL: pool,
+                            MANAGED_ANNOTATION: "true",
                         }}}, namespace=req.namespace, group=GROUP,
                     )
                 except errors.NotFound:
@@ -357,6 +411,7 @@ class SchedulerReconciler(Reconciler):
         with self._lock:
             self._queue.remove(key)
             self._evicting.discard(key)
+            self._unstamped.discard(key)
             return self._assigned.pop(key, None) is not None
 
     @staticmethod
@@ -396,9 +451,6 @@ class SchedulerReconciler(Reconciler):
         per namespace per placement pass."""
         if not namespace:
             return None
-        if self._profile_informer is not None and \
-                self._profile_informer.has_synced():
-            return self._profile_informer.get(None, namespace)
         try:
             return self.kube.get("profiles", namespace, group=GROUP)
         except errors.NotFound:
@@ -418,17 +470,17 @@ class SchedulerReconciler(Reconciler):
             return None
 
     def _nodes(self) -> list[dict]:
-        if self._node_informer is not None and \
-                self._node_informer.has_synced():
-            return self._node_informer.list()
         return self.kube.list("nodes")["items"]
 
     def _bound_pool(self, nb: dict) -> str | None:
         """Pool an already-running notebook actually occupies: the
         node-pool label of any node its pods are bound to. Used once per
-        legacy adoption, so a live LIST is fine."""
+        legacy adoption, and deliberately LIVE — adoption must reflect
+        where the pods are bound NOW, not a cache's view of a previous
+        incarnation."""
         meta = nb["metadata"]
-        pods = self.kube.list(
+        live = getattr(self.kube, "live", self.kube)
+        pods = live.list(
             "pods", namespace=meta.get("namespace"),
             label_selector=f"notebook-name={meta['name']}",
         )["items"]
@@ -437,7 +489,7 @@ class SchedulerReconciler(Reconciler):
             if not node_name:
                 continue
             try:
-                node = self.kube.get("nodes", node_name)
+                node = live.get("nodes", node_name)
             except errors.NotFound:
                 continue
             pool = ((node["metadata"].get("labels") or {})
@@ -447,13 +499,11 @@ class SchedulerReconciler(Reconciler):
         return None
 
     def _get_nb(self, key: tuple[str, str]) -> dict | None:
-        """Prefer the synced informer cache: a placement pass reads every
+        """Cache read once registered: a placement pass reads every
         queued notebook, and O(queue) live GETs per pass would multiply
         into real apiserver load under contention. Staleness is safe —
         condition writes ride optimistic concurrency (Conflict → the
         MODIFIED event re-levels us)."""
-        if self._nb_informer is not None and self._nb_informer.has_synced():
-            return self._nb_informer.get(key[0], key[1])
         try:
             return self.kube.get("notebooks", key[1], namespace=key[0],
                                  group=GROUP)
@@ -463,11 +513,24 @@ class SchedulerReconciler(Reconciler):
     # ------------------------------------------------------ placement pass
 
     def _run_queue(self) -> None:
-        """One serialized scheduling pass: place what fits (in priority/
-        FIFO order), optionally preempt for what doesn't, restamp queue
-        positions. The single lock is what makes placement double-booking-
-        free under concurrent reconcile workers. Per-pass caches (quota
-        per namespace, the notebooks fetched for the placement walk) keep
+        """Scheduling passes until the queue settles: place what fits
+        (in priority/FIFO order), optionally preempt for what doesn't,
+        restamp queue positions. A pass that placed something under
+        preemption re-evaluates immediately — assignments skipped as
+        victims while unstamped are now fair game — rather than waiting
+        for an unrelated event to wake the queue. A plain loop, not
+        recursion: under sustained arrivals every pass can place, and
+        the depth must not grow with them. Terminates because each
+        re-evaluated pass placed (drained) at least one entry."""
+        while self._run_queue_once():
+            pass
+
+    def _run_queue_once(self) -> bool:
+        """One serialized scheduling pass; True = re-evaluate (something
+        placed while preemption is on and the queue is non-empty). The
+        single lock is what makes placement double-booking-free under
+        concurrent reconcile workers. Per-pass caches (quota per
+        namespace, the notebooks fetched for the placement walk) keep
         the pass at one GET per queued notebook instead of O(queue) per
         entry."""
         placed: list[tuple] = []       # (entry, pool) — booked, unstamped
@@ -531,6 +594,7 @@ class SchedulerReconciler(Reconciler):
                     chips=entry.demand.total_chips,
                     priority=entry.priority, seq=self._assign_seq,
                 )
+                self._unstamped.add(entry.key)
                 # the (inventory-state, decision) tuple a learned
                 # placement policy trains on (docs/scheduler.md RL hook):
                 # free chips per pool AS SEEN at decision time
@@ -567,6 +631,8 @@ class SchedulerReconciler(Reconciler):
         self._seen_classes |= set(depth)
         for cls in self._seen_classes:
             self.metrics.queue_depth.labels(cls).set(depth.get(cls, 0))
+        return bool(placed and self.enable_preemption
+                    and len(self._queue))
 
     def _finish_place(self, entry, pool: str,
                       decision_state: dict | None = None) -> None:
@@ -599,13 +665,22 @@ class SchedulerReconciler(Reconciler):
                 "notebooks", entry.name,
                 {"metadata": {"annotations": {
                     tpu.ANNOTATION_NODEPOOL: pool,
+                    # persistent "queue-managed" marker: survives the
+                    # stop-path's pool-clear so the legacy-ADOPTION
+                    # branch can tell a mid-teardown preemption victim
+                    # (stale readyReplicas, pods still draining) from a
+                    # genuinely pre-scheduler workload
+                    MANAGED_ANNOTATION: "true",
                 }}}, namespace=entry.namespace, group=GROUP,
             )
         except errors.NotFound:
             # vanished between the liveness read and the stamp: release
             with self._lock:
+                self._unstamped.discard(entry.key)
                 self._assigned.pop(entry.key, None)
             return
+        with self._lock:
+            self._unstamped.discard(entry.key)
         self.metrics.placements.labels(pool).inc()
         self.metrics.time_to_placement.observe(
             time.monotonic() - entry.enqueued
@@ -653,7 +728,12 @@ class SchedulerReconciler(Reconciler):
                         <= budget)
 
             victim = choose_victim(
-                [a for a in assignments if eligible(a)],
+                # unstamped assignments are off the menu: their stop path
+                # couldn't clear an annotation that isn't there yet, and
+                # the delayed stamp would land on the stopped victim (the
+                # placing pass re-runs the queue once its stamps land)
+                [a for a in assignments
+                 if a.key not in self._unstamped and eligible(a)],
                 pools, used, entry.demand, entry.priority,
             )
             if victim is not None:
@@ -732,7 +812,8 @@ class SchedulerReconciler(Reconciler):
 
     def _set_condition(self, nb: dict, status: str, reason: str,
                        message: str, position: int | None = None,
-                       total: int | None = None) -> None:
+                       total: int | None = None,
+                       _attempt: int = 0) -> None:
         cur = helpers.get_condition(nb, CONDITION_SCHEDULED)
         if cur and cur.get("status") == status \
                 and cur.get("reason") == reason \
@@ -760,5 +841,39 @@ class SchedulerReconciler(Reconciler):
         helpers.set_condition(fresh, cond)
         try:
             self.kube.update_status("notebooks", fresh, group=GROUP)
-        except (errors.Conflict, errors.NotFound):
-            pass  # someone else wrote; the MODIFIED event re-levels us
+        except errors.Conflict:
+            # conflict-retry loop, LIVE read: the cache-served baseline
+            # RV can trail our own annotation stamp, and the event that
+            # bumped it may be predicate-filtered — waiting for a
+            # MODIFIED to re-level can wait forever on a settled object
+            if _attempt < 2:
+                try:
+                    live = getattr(self.kube, "live", self.kube).get(
+                        "notebooks", nb["metadata"]["name"],
+                        namespace=nb["metadata"].get("namespace"),
+                        group=GROUP,
+                    )
+                except errors.NotFound:
+                    return
+                self._set_condition(live, status, reason, message,
+                                    position=position, total=total,
+                                    _attempt=_attempt + 1)
+            elif self._ctl is not None:
+                # retries exhausted mid-pass: the write must not drop
+                # silently on a queue that then settles. No raise — a
+                # raise here would abort the sibling placements/restamps
+                # of the same pass — just re-enqueue the notebook; its
+                # reconcile re-runs the queue pass, which re-attempts
+                # every un-leveled condition.
+                log.warning(
+                    "condition write for %s/%s dropped after 3 "
+                    "conflicts; re-enqueueing",
+                    nb["metadata"].get("namespace"),
+                    nb["metadata"]["name"],
+                )
+                self._ctl.queue.add_after(
+                    Request(nb["metadata"].get("namespace"),
+                            nb["metadata"]["name"]), 1.0,
+                )
+        except errors.NotFound:
+            pass  # deleted mid-write; the DELETED event cleans up

@@ -223,3 +223,62 @@ def test_loadgen_patterns():
 
     with pytest.raises(ValueError):
         LoadGenerator(pattern="poisson")
+
+
+def test_bench_gate():
+    """tools/bench_gate.py: latency legs trip on >tolerance regressions,
+    hit-rate leg trips on missing OR sub-floor rates (a CachedClient
+    silently falling back to live reads reports hit_rate 0.0, not None
+    — the gate must catch both)."""
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate",
+        pathlib.Path(__file__).resolve().parent.parent
+        / "tools" / "bench_gate.py",
+    )
+    bg = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bg)
+
+    def record(churn_p50=1000.0, nb_p95=2000.0, hit_rate=1.0,
+               reads_per_reconcile=0.5):
+        extra = {"cached_reads": {"hits": 10, "misses": 0,
+                                  "hit_rate": hit_rate},
+                 "apiserver_reads_per_reconcile": reads_per_reconcile}
+        if hit_rate is None:
+            extra = {}
+        return {"scenarios": {
+            "churn": {
+                "phases_ms": {"controller_overhead": {"p50": churn_p50}},
+                "extra": extra,
+            },
+            "notebook_ready": {
+                "phases_ms": {"create_to_ready": {"p95": nb_p95}},
+                "extra": extra,
+            },
+        }}
+
+    base = record()
+    assert bg.gate(base, record(), 1.2) == []
+    # within tolerance: +19% passes, +21% fails the right leg
+    assert bg.gate(base, record(churn_p50=1190.0), 1.2) == []
+    fails = bg.gate(base, record(churn_p50=1210.0), 1.2)
+    assert len(fails) == 1 and "churn.controller_overhead.p50" in fails[0]
+    fails = bg.gate(base, record(nb_p95=2500.0), 1.2)
+    assert len(fails) == 1 and "notebook_ready.create_to_ready.p95" in fails[0]
+    # hit rate: missing and sub-floor both fail, per scenario (the empty
+    # extra also drops reads_per_reconcile → 4 failures)
+    fails = bg.gate(base, record(hit_rate=None), 1.2)
+    assert len(fails) == 4 and all("not reported" in f for f in fails)
+    fails = bg.gate(base, record(hit_rate=0.0), 1.2)
+    assert len(fails) == 2 and all("below" in f for f in fails)
+    assert bg.gate(base, record(hit_rate=0.95), 1.2) == []
+    # reads/reconcile ceiling: an apiserver-side regression fails even
+    # with a (poll-diluted) perfect hit rate
+    fails = bg.gate(base, record(reads_per_reconcile=3.5), 1.2)
+    assert len(fails) == 2 and all("exceeds" in f for f in fails)
+    # missing leg in the fresh run is a failure, not a silent pass
+    run = record()
+    del run["scenarios"]["churn"]["phases_ms"]["controller_overhead"]
+    assert any("missing from run" in f for f in bg.gate(base, run, 1.2))

@@ -310,3 +310,41 @@ def test_culling_emits_culled_event(monkeypatch):
     rec.reconcile(Request("user1", "nb1"))
     evs = _events_for(kube, "user1", "Notebook", "nb1")
     assert any(e["reason"] == "Culled" for e in evs)
+
+
+def test_child_event_racing_informer_cache_still_reemitted():
+    """The events informer and the child informers ride independent
+    watch streams: a child's FIRST event can overtake its ADDED into the
+    STS/pod cache. A cache-only NotFound used to drop the event; the
+    live-GET fallback must resolve it (regression for the CachedClient
+    conversion of _reemit)."""
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        CachedClient,
+        Informer,
+    )
+
+    kube = FakeKube()
+    kube.create("notebooks", _nb())
+    kube.create("statefulsets", {
+        "metadata": {"name": "nb1", "namespace": "user1",
+                     "labels": {"notebook-name": "nb1"}},
+        "spec": {"replicas": 1},
+    }, group="apps")
+
+    # synced informer whose cache has NOT absorbed the STS yet — exactly
+    # the race window (never started: cache stays empty)
+    inf = Informer(kube, "statefulsets", group="apps")
+    inf._synced.set()
+    rec = NotebookReconciler(kube)
+    rec.kube = CachedClient(kube, {("apps", "statefulsets"): inf})
+
+    rec._reemit({
+        "metadata": {"name": "nb1.stsfail", "namespace": "user1"},
+        "involvedObject": {"kind": "StatefulSet", "name": "nb1",
+                           "namespace": "user1"},
+        "type": "Warning",
+        "reason": "FailedCreate",
+        "message": "create Pod nb1-0 in StatefulSet nb1 failed",
+    })
+    assert [e for e in _events_for(kube, "user1", "Notebook", "nb1")
+            if "Reissued from statefulset/nb1" in e.get("message", "")]

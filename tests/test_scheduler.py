@@ -822,3 +822,77 @@ def test_scale_100_notebooks_4_slices_no_double_booking():
         assert rounds <= n, "queue failed to drain"
     assert placed_total == set(names)
     assert rec.metrics.time_to_placement._counts[()][-1] == n
+
+
+def test_preemption_skips_unstamped_placement():
+    """A placement is committed to the book under the lock but its
+    annotation stamp lands lock-free afterwards. A concurrent pass
+    choosing that assignment as a preemption victim would race the stamp:
+    the victim's stop path finds no annotation to clear, frees the chips,
+    and the delayed stamp then lands on a stopped notebook — a pool
+    annotation nobody owns, reading as a double booking against the
+    waiter the chips went to (cpbench sched_contention seed-dependent
+    flake). Unstamped assignments must be off the victim menu until the
+    placing pass re-runs the queue."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube, enable_preemption=True)
+    kube.create("notebooks", _nb("victim"))
+    rec.reconcile(Request(NS, "victim"))
+    assert _pool_of(kube, "victim") == "pool-a"
+    # simulate the stamp still being in flight from the placing pass
+    rec._unstamped.add((NS, "victim"))
+    kube.create("notebooks", _nb("vip", priority=100))
+    rec.reconcile(Request(NS, "vip"))
+    annots = kube.get("notebooks", "victim", namespace=NS,
+                      group=GROUP)["metadata"].get("annotations") or {}
+    assert STOP_ANNOTATION not in annots, (
+        "an unstamped placement must not be chosen as a preemption victim"
+    )
+    # the stamp lands; the next pass may evict
+    rec._unstamped.discard((NS, "victim"))
+    rec._run_queue()
+    annots = kube.get("notebooks", "victim", namespace=NS,
+                      group=GROUP)["metadata"].get("annotations") or {}
+    assert STOP_ANNOTATION in annots
+
+
+def test_preempted_victim_is_not_readopted_mid_teardown():
+    """A preempted victim resumed mid-teardown still reports
+    readyReplicas>0 with pods bound to its OLD pool. The legacy-ADOPTION
+    path must not re-book that pool (the successor holds it): placements
+    stamp a persistent queue-managed marker, and a marked notebook always
+    goes back through admission."""
+    kube = FakeKube()
+    _mk_pool(kube, "pool-a")
+    rec = SchedulerReconciler(kube, enable_preemption=True)
+    kube.create("notebooks", _nb("victim"))
+    rec.reconcile(Request(NS, "victim"))
+    assert _pool_of(kube, "victim") == "pool-a"
+    # the victim is running: ready status + a pod bound into pool-a
+    nb = kube.get("notebooks", "victim", namespace=NS, group=GROUP)
+    nb["status"] = {"readyReplicas": 4}
+    kube.update_status("notebooks", nb, group=GROUP)
+    kube.create("pods", {
+        "metadata": {"name": "victim-0", "namespace": NS,
+                     "labels": {"notebook-name": "victim"}},
+        "spec": {"nodeName": "node-pool-a-0"},
+    })
+    kube.create("notebooks", _nb("vip", priority=100))
+    rec.reconcile(Request(NS, "vip"))        # evicts: stop stamped
+    rec.reconcile(Request(NS, "victim"))     # stop path: clear + release
+    rec.reconcile(Request(NS, "vip"))        # waiter lands on pool-a
+    assert _pool_of(kube, "vip") == "pool-a"
+    # resume the victim while its teardown is still in flight (stale
+    # readyReplicas, pod still bound to the old pool)
+    kube.patch("notebooks", "victim",
+               {"metadata": {"annotations": {STOP_ANNOTATION: None}}},
+               namespace=NS, group=GROUP)
+    rec.reconcile(Request(NS, "victim"))
+    assert _pool_of(kube, "victim") is None, (
+        "a queue-managed notebook must re-enter admission, not re-adopt "
+        "its old pool out from under the successor"
+    )
+    assert _pool_of(kube, "vip") == "pool-a"
+    cond = _sched_cond(kube, "victim")
+    assert cond["status"] == "False", "victim queues behind the vip"

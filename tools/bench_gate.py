@@ -1,0 +1,139 @@
+#!/usr/bin/env python3
+"""Perf-regression gate over CONTROLPLANE_BENCH.json records.
+
+Compares a fresh cpbench run against the committed record and fails on:
+
+- churn ``controller_overhead`` p50 regressing more than the tolerance,
+- notebook_ready ``create_to_ready`` p95 regressing more than the
+  tolerance,
+- the cached-read hit rate missing from either scenario's report, or
+  below the floor (the delegating read client must keep serving reads
+  AND reporting its evidence — a silent fall-back to live reads, e.g. a
+  broken ``_informer_for`` counting every read as a miss, would
+  otherwise look like a latency mystery and still slip under the
+  smoke-vs-full latency headroom),
+- ``apiserver_reads_per_reconcile`` missing or above its ceiling — the
+  apiserver-side counter a controller-only regression cannot hide from
+  behind the bench's own (cache-served) poll traffic.
+
+CI runs the smoke lane against the committed ``--full`` record: smoke is
+smaller and faster, so the latency comparison only trips on gross
+regressions (a hot loop, a lost cache, a serialized queue) — exactly the
+failures a PR lane can catch deterministically on a shared runner. The
+record itself is refreshed by a manual ``--full`` run (BASELINE.md).
+
+Exit 0 = within tolerance.  Usage:
+
+    python tools/bench_gate.py --baseline CONTROLPLANE_BENCH.json \
+        --run bench_out.json [--tolerance 1.2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: (scenario, phase, percentile) latency gates
+GATES = (
+    ("churn", "controller_overhead", "p50"),
+    ("notebook_ready", "create_to_ready", "p95"),
+)
+#: scenarios that must report a cached-read hit rate
+HIT_RATE_SCENARIOS = ("notebook_ready", "churn")
+#: minimum acceptable hit rate in those scenarios — every read on their
+#: hot path is cache-servable (measured 1.0 at both --smoke and --full),
+#: so anything below ~0.9 means reads are falling through to the
+#: apiserver, not ordinary jitter
+MIN_HIT_RATE = 0.9
+#: ceiling on (GET+LIST)/reconciles. The hit rate alone can be diluted:
+#: the bench's own poll loops route through the same shared CachedClient,
+#: so a controller-side fall-back to live reads can hide under thousands
+#: of poll hits. This counter is apiserver-side (FakeKube per-verb tally)
+#: and immune to that — measured ≤1.06 cached (smoke and full), 3.5-7.7
+#: with ENGINE_CACHED_READS=0
+READS_PER_RECONCILE_MAX = 2.0
+
+
+def gate(baseline: dict, run: dict, tolerance: float,
+         min_hit_rate: float = MIN_HIT_RATE) -> list[str]:
+    """Return a list of failure messages (empty = pass)."""
+    failures = []
+    for scenario, phase, pct in GATES:
+        try:
+            base = baseline["scenarios"][scenario]["phases_ms"][phase][pct]
+        except KeyError:
+            failures.append(
+                f"{scenario}.{phase}.{pct}: missing from baseline"
+            )
+            continue
+        try:
+            got = run["scenarios"][scenario]["phases_ms"][phase][pct]
+        except KeyError:
+            failures.append(f"{scenario}.{phase}.{pct}: missing from run")
+            continue
+        limit = base * tolerance
+        if got > limit:
+            failures.append(
+                f"{scenario}.{phase}.{pct}: {got:.1f} ms exceeds "
+                f"{limit:.1f} ms ({tolerance:.0%} of baseline "
+                f"{base:.1f} ms)"
+            )
+    for scenario in HIT_RATE_SCENARIOS:
+        extra = (run.get("scenarios", {}).get(scenario, {})
+                 .get("extra") or {})
+        rate = (extra.get("cached_reads") or {}).get("hit_rate")
+        if rate is None:
+            failures.append(
+                f"{scenario}: cached_reads.hit_rate not reported"
+            )
+        elif rate < min_hit_rate:
+            failures.append(
+                f"{scenario}: cached_reads.hit_rate {rate} below "
+                f"{min_hit_rate} — reads are falling through to the "
+                "apiserver"
+            )
+        rpr = extra.get("apiserver_reads_per_reconcile")
+        if rpr is None:
+            failures.append(
+                f"{scenario}: apiserver_reads_per_reconcile not reported"
+            )
+        elif rpr > READS_PER_RECONCILE_MAX:
+            failures.append(
+                f"{scenario}: apiserver_reads_per_reconcile {rpr} "
+                f"exceeds {READS_PER_RECONCILE_MAX} — controllers are "
+                "round-tripping the apiserver on the read path"
+            )
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", required=True,
+                    help="committed CONTROLPLANE_BENCH.json")
+    ap.add_argument("--run", required=True, help="fresh cpbench output")
+    ap.add_argument("--tolerance", type=float, default=1.2,
+                    help="allowed ratio vs baseline (default 1.2 = +20%%)")
+    ap.add_argument("--min-hit-rate", type=float, default=MIN_HIT_RATE,
+                    help="cached-read hit-rate floor "
+                         f"(default {MIN_HIT_RATE})")
+    args = ap.parse_args(argv)
+    with open(args.baseline) as f:
+        baseline = json.load(f)
+    with open(args.run) as f:
+        run = json.load(f)
+    failures = gate(baseline, run, args.tolerance, args.min_hit_rate)
+    for f in failures:
+        print(f"bench_gate FAIL: {f}", file=sys.stderr)
+    if not failures:
+        for scenario, phase, pct in GATES:
+            base = baseline["scenarios"][scenario]["phases_ms"][phase][pct]
+            got = run["scenarios"][scenario]["phases_ms"][phase][pct]
+            print(f"bench_gate ok: {scenario}.{phase}.{pct} "
+                  f"{got:.1f} ms vs baseline {base:.1f} ms",
+                  file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
