@@ -233,14 +233,29 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
                     "--run bench_out.json --tolerance 1.2"},
-            # always(): when the regression gate fails, bench_out.json
-            # IS the evidence — dropping it with the runner would force a
-            # full local re-run just to see which percentile regressed
+            # chaos smoke: the fault-injection family (cpbench/chaos.py)
+            # — apiserver blackout, 410 Gone storms, node death, kubelet
+            # stall — then the invariant gate: 0 double bookings, 0
+            # orphaned children, recovery-time percentiles present
+            {"name": "Run cpbench chaos --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke "
+                    "--scenario chaos_relist --scenario chaos_blackout "
+                    "--scenario chaos_node_death "
+                    "--scenario chaos_kubelet_stall "
+                    "--out chaos_out.json"},
+            {"name": "Chaos invariant gate",
+             "run": "python tools/bench_gate.py "
+                    "--baseline CONTROLPLANE_BENCH.json "
+                    "--run chaos_out.json --chaos-only"},
+            # always(): when a gate fails, the JSON records ARE the
+            # evidence — dropping them with the runner would force a
+            # full local re-run just to see which leg tripped
             {"name": "Upload bench record",
              "if": "always()",
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
-                      "path": "bench_out.json"}},
+                      "path": "bench_out.json\nchaos_out.json"}},
         ])},
     ),
     "images_multi_arch_test.yaml": workflow(

@@ -60,6 +60,9 @@ def run_manager(register, argv=None, add_args=None) -> int:
     serve_ops(
         args.metrics_port,
         ready_check=lambda: ready["ok"] and manager.informers_synced(),
+        # /readyz?verbose: per-informer sync/failure/relist state, so a
+        # false readiness names the wedged watch instead of just flipping
+        ready_detail=manager.informer_status,
     )
 
     elector = None

@@ -41,7 +41,11 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import
     ScenarioResult,
     run_scenario,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.chaos import (  # noqa: F401 — import registers the chaos family into SCENARIOS
+    CHAOS_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: F401
+    RecoveryTracker,
     Timeline,
     Tracker,
     percentiles,

@@ -23,6 +23,9 @@ import time
 from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import (  # noqa: E501
     LatencyDist,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.chaos import (  # noqa: E501,F401 — importing registers the chaos family into SCENARIOS
+    CHAOS_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
     SCENARIOS,
     BenchConfig,
@@ -32,7 +35,10 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import
 SCHEMA = "cpbench/v1"
 
 #: CRs per scenario. Smoke is sized to finish well inside the 30 s CI
-#: budget; full is the ≥100-CRs-per-scenario record run.
+#: budget; full is the ≥100-CRs-per-scenario record run. The chaos
+#: family is wall-clock-bound by its injection windows (blackout,
+#: stall, storm pulses), not CR count, so its sizes stay modest even
+#: at --full.
 SMOKE_N = {
     "notebook_ready": 24,
     "gang_ready": 8,          # 8 gangs × 4 host pods
@@ -40,6 +46,10 @@ SMOKE_N = {
     "profile_fanout": 24,
     "webhook_inject": 200,
     "sched_contention": 12,   # 12 gangs contending for 4 slice pools
+    "chaos_relist": 8,        # 8 gangs vs 2 pools through the storms
+    "chaos_blackout": 8,      # half healthy, half mid-outage
+    "chaos_node_death": 4,    # 4 gangs, one pool dies under its gang
+    "chaos_kubelet_stall": 8,
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -48,6 +58,10 @@ FULL_N = {
     "profile_fanout": 120,
     "webhook_inject": 1000,
     "sched_contention": 48,   # 12 drain waves over the 4 pools
+    "chaos_relist": 16,
+    "chaos_blackout": 16,
+    "chaos_node_death": 6,
+    "chaos_kubelet_stall": 16,
 }
 
 
@@ -61,7 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
     mode.add_argument("--full", action="store_true",
                       help=">=100 CRs per scenario, the record run")
     ap.add_argument("--scenario", action="append", choices=sorted(SCENARIOS),
-                    help="run only these (repeatable; default: all)")
+                    help="run only these (repeatable; default: all "
+                         "healthy scenarios)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="include the chaos scenario family (fault "
+                         "injection + recovery invariants; "
+                         "docs/chaos.md) in the run")
     ap.add_argument("--n", type=int,
                     help="override CRs per scenario (all scenarios)")
     ap.add_argument("--concurrency", type=int, default=8,
@@ -88,7 +107,12 @@ def run(args) -> dict:
     LatencyDist(args.actuation)  # fail fast on a malformed spec
     mode = "full" if args.full else "smoke"
     sizes = FULL_N if args.full else SMOKE_N
-    wanted = args.scenario or sorted(SCENARIOS)
+    # default run = the healthy family (the regression lane CI parses);
+    # --chaos folds the fault-injection family in; --scenario overrides
+    wanted = args.scenario or sorted(
+        name for name in SCENARIOS
+        if args.chaos or name not in CHAOS_SCENARIOS
+    )
     started = time.monotonic()
     report: dict = {
         "schema": SCHEMA,

@@ -132,7 +132,7 @@ class FakeKubelet:
     """STS-controller + scheduler + kubelet against a FakeKube."""
 
     def __init__(self, kube, latency: LatencyDist | str = "uniform:5,15",
-                 seed: int = 0, tracer=None):
+                 seed: int = 0, tracer=None, relist_period: float = 0.0):
         self.kube = kube
         #: with a tracer, each pod's schedule→Ready interval lands on the
         #: owning notebook's trace as a ``kubelet.actuation`` span — the
@@ -149,14 +149,24 @@ class FakeKubelet:
         self.gate_violations = 0   # pods seen bound/Ready while still gated
         self.pods_created = 0
         self.pods_ready = 0
+        #: chaos knob — a stalled kubelet keeps scheduling and binding
+        #: but stops flipping pods Ready (the node is up, the kubelet's
+        #: sync loop is wedged); queued flips re-arm until unstalled
+        self._stalled = False
+        #: pods whose bind failed (pinned pool momentarily has no nodes
+        #: — node death) with a retry armed; mirrors kube-scheduler's
+        #: backoff-and-retry for unschedulable pods
+        self._bind_retry: set[str] = set()
         self._flipper = _Flipper()
         # tracer'd informers: the STS/pod watch hops inside the fake
         # cluster surface as informer.deliver spans on the owning
         # notebook's trace (via the notebook-name label)
         self._sts_inf = Informer(kube, "statefulsets", group="apps",
-                                 tracer=tracer)
+                                 tracer=tracer,
+                                 relist_period=relist_period)
         self._sts_inf.add_handler(self._on_sts)
-        self._pod_inf = Informer(kube, "pods", tracer=tracer)
+        self._pod_inf = Informer(kube, "pods", tracer=tracer,
+                                 relist_period=relist_period)
         self._pod_inf.add_handler(self._on_pod)
         # _sync_sts_status runs per pod Ready-flip/delete: an O(pods)
         # cache scan there is O(pods²) over a bench — index instead
@@ -180,6 +190,22 @@ class FakeKubelet:
         self._pod_inf.stop()
         self._flipper.stop()
 
+    def stall(self) -> None:
+        """Chaos: stop flipping pods Ready (wedged kubelet sync loop).
+        Scheduling/binding continue — the control plane sees a cluster
+        that accepts work but never delivers it."""
+        self._stalled = True
+
+    def unstall(self) -> None:
+        self._stalled = False
+
+    def _retry_later(self, delay: float, fn) -> None:
+        """A real cluster component retries through outages: apiserver
+        errors (chaos blackouts) re-arm the action instead of dropping
+        it — a lost flip/bind/create would wedge a workload forever in a
+        way no real kubelet/scheduler/STS-controller would."""
+        self._flipper.call_later(delay, fn)
+
     def actuation_for(self, namespace: str, name: str) -> float:
         """Max actuation sample (seconds) over ``<name>-*`` pods — the
         component of this CR's ready latency the kubelet injected (pods
@@ -195,6 +221,23 @@ class FakeKubelet:
     def _on_sts(self, ev_type: str, sts: dict) -> None:
         if ev_type == "DELETED":
             return  # ownerReference cascade deletes the pods
+        meta = sts["metadata"]
+        ns, name = meta.get("namespace"), meta["name"]
+        try:
+            self._sync_sts(sts)
+        except errors.NotFound:
+            pass  # STS vanished mid-sync (cascade); nothing to converge
+        except errors.ApiError:
+            # apiserver hiccup/blackout mid-sync: re-arm from the cache —
+            # the real STS controller's workqueue would retry exactly so
+            def retry(ns=ns, name=name):
+                cur = self._sts_inf.get(ns, name)
+                if cur is not None:
+                    self._on_sts("SYNC", cur)
+
+            self._retry_later(0.15, retry)
+
+    def _sync_sts(self, sts: dict) -> None:
         meta = sts["metadata"]
         ns, name = meta.get("namespace"), meta["name"]
         replicas = int((sts.get("spec") or {}).get("replicas") or 0)
@@ -285,6 +328,11 @@ class FakeKubelet:
                                     group="apps")
             except errors.NotFound:
                 return
+            except errors.ApiError:
+                self._retry_later(
+                    0.15, lambda: self._sync_sts_status(ns, name)
+                )
+                return
         if replicas is None:
             replicas = int((sts.get("spec") or {}).get("replicas") or 0)
         ready = 0
@@ -303,6 +351,12 @@ class FakeKubelet:
             }}, namespace=ns, group="apps")
         except errors.NotFound:
             pass
+        except errors.ApiError:
+            # readyReplicas is level state: re-derive once the apiserver
+            # is back rather than dropping the write
+            self._retry_later(
+                0.15, lambda: self._sync_sts_status(ns, name)
+            )
 
     # --------------------------------------------------- scheduler/kubelet
 
@@ -315,6 +369,11 @@ class FakeKubelet:
             # dropped it
             if sts_label:
                 self._sync_sts_status(meta.get("namespace"), sts_label)
+                # a pod deleted OUT FROM UNDER a live STS (node death,
+                # chaos force-delete) must be replaced — the real STS
+                # controller watches pods and recreates missing ordinals
+                self._maybe_recreate(meta.get("namespace"), sts_label,
+                                     meta["name"])
             return
         if any(c.get("type") == "Ready" and c.get("status") == "True"
                for c in (pod.get("status") or {}).get("conditions") or []):
@@ -334,11 +393,18 @@ class FakeKubelet:
         if not spec.get("nodeName"):
             try:
                 if not self._bind(pod):
-                    # unbindable (pinned pool has no nodes): the pod
-                    # stays Pending — it must never flip Ready unbound
+                    # unbindable (pinned pool has no nodes — node death):
+                    # the pod stays Pending and must never flip Ready
+                    # unbound, but the real scheduler RETRIES pending
+                    # pods — when the pool's nodes come back (repair),
+                    # no pod event fires, so poll from the cache
+                    self._arm_bind_retry(ns, name, uid)
                     return
             except errors.NotFound:
                 return  # deleted mid-flight (churn)
+            except errors.ApiError:
+                self._arm_bind_retry(ns, name, uid)
+                return
         with self._lock:
             if uid in self._scheduled:
                 return
@@ -401,12 +467,70 @@ class FakeKubelet:
                         namespace=ns)
         return True
 
+    def _arm_bind_retry(self, ns: str, name: str, uid: str) -> None:
+        """Re-try binding a Pending pod from the cache until it binds or
+        disappears (one armed retry per pod uid — retries must not
+        multiply when several bind failures race)."""
+        with self._lock:
+            if uid in self._bind_retry:
+                return
+            self._bind_retry.add(uid)
+
+        def retry():
+            with self._lock:
+                self._bind_retry.discard(uid)
+            pod = self._pod_inf.get(ns, name)
+            if pod is not None and pod["metadata"].get("uid") == uid:
+                self._on_pod("SYNC", pod)
+
+        self._retry_later(0.25, retry)
+
+    def _maybe_recreate(self, ns: str, sts_name: str,
+                        pod_name: str) -> None:
+        """Replace a pod deleted under a live STS (node death): if the
+        cached STS still wants this ordinal, confirm the STS is live
+        (cheap GET — the cache may lag a cascade delete) and re-run
+        creation. Scale-downs skip out on the cache check alone."""
+        sts = self._sts_inf.get(ns, sts_name)
+        if sts is None:
+            return
+        replicas = int((sts.get("spec") or {}).get("replicas") or 0)
+        ordinal = pod_name.rsplit("-", 1)[-1]
+        if not ordinal.isdigit() or int(ordinal) >= replicas:
+            return  # scale-down delete: the ordinal is no longer wanted
+        try:
+            live = self.kube.get("statefulsets", sts_name, namespace=ns,
+                                 group="apps")
+        except errors.NotFound:
+            return  # cascade delete: cache lagging the STS's death
+        except errors.ApiError:
+            self._retry_later(
+                0.15,
+                lambda: self._maybe_recreate(ns, sts_name, pod_name),
+            )
+            return
+        if live["metadata"].get("deletionTimestamp"):
+            return
+        self._on_sts("SYNC", live)
+
     def _flip_ready(self, ns: str, name: str, uid: str,
                     scheduled_at: float | None = None) -> None:
+        if self._stalled:
+            # wedged kubelet: the flip stays due, it just doesn't happen
+            # until the stall lifts
+            self._retry_later(
+                0.05, lambda: self._flip_ready(ns, name, uid, scheduled_at)
+            )
+            return
         try:
             pod = self.kube.get("pods", name, namespace=ns)
         except errors.NotFound:
             return  # deleted before it came up (churn / culling)
+        except errors.ApiError:
+            self._retry_later(
+                0.1, lambda: self._flip_ready(ns, name, uid, scheduled_at)
+            )
+            return
         if pod["metadata"].get("uid") != uid:
             return  # recreated under the same name; the new pod rebinds
         if (pod.get("spec") or {}).get("schedulingGates"):
@@ -429,6 +553,13 @@ class FakeKubelet:
                 }],
             }}, namespace=ns)
         except errors.NotFound:
+            return
+        except errors.ApiError:
+            # outage between the GET and the status write: re-arm —
+            # a real kubelet keeps syncing status until it lands
+            self._retry_later(
+                0.1, lambda: self._flip_ready(ns, name, uid, scheduled_at)
+            )
             return
         with self._lock:
             self.pods_ready += 1

@@ -1,0 +1,636 @@
+"""Chaos scenario family: the control plane under failure, measured.
+
+Every healthy cpbench scenario assumes the apiserver answers and no
+watch stream dies. These four do the opposite — they run the REAL
+Manager/controllers/tpusched through a scripted injection schedule
+(kube/chaos.py) and assert **recovery invariants**, with recovery-time
+percentiles recorded into CONTROLPLANE_BENCH.json and gated by
+tools/bench_gate.py:
+
+===========================  ===========================================
+``chaos_relist``             410 Gone storms + watch drops/reorders mid
+                             tpusched drain: no pool is ever
+                             double-booked across forced relists, queue
+                             positions stay consistent, every informer
+                             resync is timed.
+``chaos_blackout``           total apiserver outage (every verb 503,
+                             watch channels severed) with work in
+                             flight: /readyz flips false during the
+                             outage and recovers after; no in-flight
+                             notebook loses its status writes.
+``chaos_node_death``         a busy pool's nodes die mid-gang (pods
+                             force-removed) and are auto-repaired: no
+                             orphaned STS/pods, no pod left bound to a
+                             dead node, every affected gang returns to
+                             Ready.
+``chaos_kubelet_stall``      the kubelet stops flipping Ready for a
+                             window: nothing reads falsely Ready, the
+                             control plane itself stays ready (the
+                             cluster is sick, not the plane), and the
+                             backlog drains on recovery.
+===========================  ===========================================
+
+Invariant glossary and injector catalog: docs/chaos.md.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.error
+import urllib.request
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (  # noqa: E501
+    LoadGenerator,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
+    SCENARIOS,
+    BenchConfig,
+    ScenarioResult,
+    _NotebookWorld,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: E501
+    RecoveryTracker,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.kube.chaos import (
+    ChaosSchedule,
+)
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Registry,
+)
+from service_account_auth_improvements_tpu.controlplane import tpu as tpu_mod
+
+
+# ------------------------------------------------------ invariant helpers
+
+def _orphaned_children(kube) -> int:
+    """Invariant counter: children that survived their owners, plus pods
+    bound to nodes that no longer exist. Checked LIVE at settle (chaos
+    off), so zero means the cluster truly converged clean."""
+    notebooks = kube.list("notebooks", group=GROUP)["items"]
+    sts = kube.list("statefulsets", group="apps")["items"]
+    pods = kube.list("pods")["items"]
+    nodes = {n["metadata"]["name"] for n in kube.list("nodes")["items"]}
+    live_uids = {o["metadata"]["uid"] for o in notebooks + sts}
+    orphans = 0
+    for obj in sts + pods:
+        refs = obj["metadata"].get("ownerReferences") or []
+        ref_uids = [r.get("uid") for r in refs if r.get("uid")]
+        if ref_uids and not any(u in live_uids for u in ref_uids):
+            orphans += 1
+    for pod in pods:
+        bound = (pod.get("spec") or {}).get("nodeName")
+        if bound and bound not in nodes:
+            orphans += 1
+    return orphans
+
+
+def _pool_bookings(notebooks: list[dict]) -> dict[str, list[str]]:
+    """pool → live notebooks annotated onto it; any bucket longer than 1
+    is a double booking (the shared invariant of chaos_relist's poll
+    loop and chaos_node_death's settle check)."""
+    live_pools: dict[str, list[str]] = {}
+    for nb in notebooks:
+        pool = (nb["metadata"].get("annotations") or {}).get(
+            tpu_mod.ANNOTATION_NODEPOOL)
+        if pool:
+            live_pools.setdefault(pool, []).append(nb["metadata"]["name"])
+    return live_pools
+
+
+class _PositionChecker:
+    """Queue-position consistency over poll samples. Restamps are
+    written lock-free after each placement pass, and under chaos a
+    conflicted restamp legitimately re-levels up to ~1 s later (the
+    scheduler's re-enqueue backoff) — so a transient duplicate is
+    eventual consistency at work, not a violation. Only a duplicate
+    assignment that PERSISTS unchanged past ``PERSIST_S`` (a wedge
+    nothing is coming to fix) or a position outside 1..total (never
+    legal: the pair is written atomically) counts."""
+
+    PERSIST_S = 2.5
+
+    def __init__(self):
+        self.violations = 0
+        self._streak: tuple | None = None
+        self._streak_since = 0.0
+        self._streak_counted = False
+
+    def feed(self, notebooks: list[dict]) -> None:
+        positions: dict[int, list[str]] = {}
+        for nb in notebooks:
+            for cond in (nb.get("status") or {}).get("conditions") or []:
+                if cond.get("type") != "Scheduled" or \
+                        cond.get("status") != "False":
+                    continue
+                pos, total = cond.get("queuePosition"), cond.get(
+                    "queueTotal")
+                if pos is None:
+                    continue
+                if pos < 1 or (total is not None and pos > total):
+                    self.violations += 1   # hard bound: no excuse
+                positions.setdefault(pos, []).append(
+                    nb["metadata"]["name"])
+        dupes = tuple(sorted(
+            (p, tuple(sorted(names)))
+            for p, names in positions.items() if len(names) > 1
+        ))
+        now = time.monotonic()
+        if dupes and dupes == self._streak:
+            if not self._streak_counted and \
+                    now - self._streak_since >= self.PERSIST_S:
+                self.violations += 1
+                self._streak_counted = True
+        else:
+            self._streak = dupes or None
+            self._streak_since = now
+            self._streak_counted = False
+
+
+def _caches_coherent(world, ns: str) -> bool:
+    """True when the cached view of the notebooks matches the live
+    apiserver state, name→resourceVersion exact. A storm's dropped/
+    reordered events make the watch caches silently diverge; recovery
+    is the moment they re-converge (reconnect replay or 410→relist).
+    Costs one live LIST — only polled while a pulse is unresolved."""
+    if not world.mgr.informers_synced():
+        return False
+    cached = {
+        o["metadata"]["name"]: o["metadata"]["resourceVersion"]
+        for o in world.cached.list("notebooks", namespace=ns,
+                                   group=GROUP)["items"]
+    }
+    live = {
+        o["metadata"]["name"]: o["metadata"]["resourceVersion"]
+        for o in world.kube.list("notebooks", namespace=ns,
+                                 group=GROUP)["items"]
+    }
+    return cached == live
+
+
+def _http_status(port: int, path: str) -> int | None:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=2) as resp:
+            return resp.status
+    except urllib.error.HTTPError as e:
+        return e.code
+    except Exception:
+        return None
+
+
+def _mk_pool(kube, pool: str, hosts: int = 4, chips: str = "4",
+             accelerator: str = "tpu-v5-lite-podslice",
+             topology: str = "4x4") -> None:
+    for h in range(hosts):
+        kube.create("nodes", {
+            "metadata": {
+                "name": f"node-{pool}-{h}",
+                "labels": {
+                    tpu_mod.SEL_NODEPOOL: pool,
+                    tpu_mod.SEL_ACCELERATOR: accelerator,
+                    tpu_mod.SEL_TOPOLOGY: topology,
+                },
+            },
+            "status": {"capacity": {tpu_mod.RESOURCE_TPU: chips}},
+        })
+
+
+def _chaos_result(world, cfg: BenchConfig, started: float, ok: bool,
+                  rec: RecoveryTracker, chaos, extra: dict,
+                  schedule: ChaosSchedule | None = None) -> ScenarioResult:
+    orphans = _orphaned_children(world.kube)
+    world.stop()
+    summary = world.tracker.summary()
+    summary["stage_attribution"] = world.attribution()
+    chaos_extra = rec.summary()
+    extra.setdefault("double_bookings", 0)
+    extra["orphaned_children"] = orphans
+    extra["recovery_ms"] = chaos_extra["recovery_ms"]
+    extra["invariant_violations"] = chaos_extra["invariant_violations"]
+    extra["injections"] = chaos.summary()
+    if schedule is not None:
+        extra["schedule_errors"] = schedule.errors
+    extra.update(world.apiserver_extra(summary["reconciles"]))
+    summary["extra"] = extra
+    violations = sum(chaos_extra["invariant_violations"].values())
+    return ScenarioResult(
+        name=world.tracker.scenario,
+        elapsed_s=time.monotonic() - started,
+        records=world.tracker.records(),
+        summary=summary,
+        ok=(ok and summary["failed"] == 0 and orphans == 0
+            and extra["double_bookings"] == 0 and violations == 0
+            and bool(extra["recovery_ms"])),
+    )
+
+
+# -------------------------------------------------------------- scenarios
+
+def scenario_chaos_blackout(cfg: BenchConfig) -> ScenarioResult:
+    """Total apiserver outage with work in flight. A healthy first wave
+    proves the baseline; a second wave lands just before every verb
+    starts 503ing and every watch channel is severed. The ops sidecar's
+    /readyz (real HTTP, the kubelet's view) must flip false during the
+    sustained outage and recover after; every in-flight notebook must
+    still converge to Ready — no dropped status write, no lost child."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "chaos_blackout")
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    rec = RecoveryTracker()
+    server = serve_ops(
+        0, host="127.0.0.1", registry=Registry(),
+        ready_check=world.mgr.informers_synced,
+        ready_detail=world.mgr.informer_status,
+    )
+    port = server.server_address[1]
+    try:
+        world.start()
+        ns = "bench"
+        tpu = {"generation": "v5e", "topology": "2x2"}
+        gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+
+        pre = [f"bo-pre-{i}" for i in range(max(1, cfg.n // 2))]
+        gen.run(world.create_jobs(pre, ns, tpu, want_ready=1))
+        ok = world.tracker.wait_ready([(ns, n) for n in pre],
+                                      cfg.timeout)
+
+        post = [f"bo-post-{i}" for i in range(cfg.n - len(pre))]
+        gen.run(world.create_jobs(post, ns, tpu, want_ready=1))
+        # lights out while the second wave's reconciles/flips are in
+        # flight
+        blackout_s = cfg.chaos_window_s
+        chaos.start_blackout(blackout_s, sever=True)
+        flipped = False
+        lights_on = time.monotonic() + blackout_s
+        while time.monotonic() < lights_on:
+            if _http_status(port, "/readyz") == 503:
+                flipped = True
+            time.sleep(0.1)
+        # recovery leg 1: how long until /readyz reads ready again
+        readyz_recover_ms = None
+        deadline = time.monotonic() + cfg.timeout
+        while time.monotonic() < deadline:
+            if _http_status(port, "/readyz") == 200:
+                readyz_recover_ms = round(
+                    (time.monotonic() - lights_on) * 1000.0, 3)
+                rec.note_recovery("readyz", readyz_recover_ms)
+                break
+            time.sleep(0.05)
+        # recovery leg 2: the backlog drains — every notebook Ready
+        keys = [(ns, n) for n in pre + post]
+        ok = world.tracker.wait_ready(keys, cfg.timeout) and ok
+        for name in post:
+            r = world.tracker.record(ns, name)
+            if (r is not None and r.ready is not None
+                    and r.ready > lights_on):
+                rec.note_recovery("notebook_ready",
+                                  (r.ready - lights_on) * 1000.0)
+        if not flipped:
+            rec.violation("readyz_never_flipped")
+        if readyz_recover_ms is None:
+            rec.violation("readyz_never_recovered")
+        return _chaos_result(world, cfg, started, ok, rec, chaos, {
+            "blackout_s": blackout_s,
+            "readyz_flipped_false": flipped,
+            "readyz_recover_ms": readyz_recover_ms,
+        })
+    finally:
+        # an exception anywhere above must not leak the ops server (a
+        # listening port) or the world's informer/kubelet threads into
+        # the next scenario; both are idempotent on the normal path
+        world.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def scenario_chaos_relist(cfg: BenchConfig) -> ScenarioResult:
+    """410 Gone storms + watch drops/reorders against a live tpusched
+    drain. Storm pulses compact the watch history (every reconnect
+    relists) and sever channels while events are randomly dropped and
+    reordered; the drain (delete-on-Ready, like sched_contention)
+    continues throughout. Invariants: no poll tick ever sees two live
+    notebooks booked onto one pool, queue positions stay consistent,
+    and every pulse's informer resync is timed as recovery."""
+    started = time.monotonic()
+    # relist_period: dropped watch events leave caches silently stale at
+    # a CURRENT resourceVersion — only a periodic relist can heal that
+    # (the engine knob this scenario exists to prove out)
+    world = _NotebookWorld(cfg, "chaos_relist", scheduler=True,
+                           relist_period=0.75)
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    rec = RecoveryTracker()
+    ns = "bench"
+    pools = max(2, cfg.n // 4)
+    for p in range(pools):
+        _mk_pool(world.kube, f"storm-pool-{p}")
+    live: dict = {}   # the body parks its ChaosSchedule here for cleanup
+    try:
+        return _run_chaos_relist(cfg, world, chaos, rec, ns, started,
+                                 live)
+    finally:
+        # an exception mid-scenario must not leave the schedule thread
+        # firing storms or the world's informer/kubelet threads alive
+        # while the run unwinds (both stops are idempotent on the
+        # normal path)
+        if live.get("schedule") is not None:
+            live["schedule"].stop()
+        world.stop()
+
+
+def _run_chaos_relist(cfg, world, chaos, rec, ns, started,
+                      live) -> ScenarioResult:
+    pools = max(2, cfg.n // 4)
+    world.start()
+
+    pulse_marks: list[float] = []
+
+    def pulse():
+        chaos.set_watch_faults(drop_rate=0.2, reorder_rate=0.2)
+        chaos.gone_storm()
+        chaos.sever_watches()
+        pulse_marks.append(time.monotonic())
+
+    def calm():
+        chaos.set_watch_faults(0.0, 0.0)
+
+    steps = []
+    last_at = 0.5
+    for i in range(max(1, cfg.chaos_pulses)):
+        at = 0.5 + i * 0.9
+        last_at = at
+        steps.append((at, f"pulse-{i}", pulse))
+        steps.append((at + 0.45, f"calm-{i}", calm))
+    # final heal: one more connection reset AFTER fidelity is restored —
+    # any event dropped inside the last fault window is replayed/relisted
+    # on reconnect, so the drain can't wedge on a lost final MODIFIED
+    steps.append((last_at + 0.9, "heal", chaos.sever_watches))
+    schedule = live["schedule"] = ChaosSchedule(steps).start()
+
+    names = [f"storm-{i:03d}" for i in range(cfg.n)]
+    tpu = {"generation": "v5e", "topology": "4x4"}
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(names, ns, tpu, want_ready=4)
+    )
+    positions = _PositionChecker()
+    deleted: set[str] = set()
+    double_bookings = 0
+    resynced_after: set[int] = set()
+    want_pulses = max(1, cfg.chaos_pulses)
+    deadline = time.monotonic() + cfg.timeout
+    # run until the drain completes AND every scheduled pulse has fired
+    # and been timed to recovery — a small run that drains before the
+    # first storm lands hasn't been chaos-tested at all
+    while (len(deleted) < len(names)
+           or len(resynced_after) < want_pulses) \
+            and time.monotonic() < deadline:
+        # time each pulse's recovery: storm → watch caches coherent with
+        # the apiserver again (only judged once the pulse's fault window
+        # is over — mid-faults incoherence is the injection, not the
+        # recovery)
+        for i, mark in enumerate(list(pulse_marks)):
+            if i in resynced_after:
+                continue
+            if time.monotonic() - mark < 0.5:
+                break
+            if _caches_coherent(world, ns):
+                rec.note_recovery(
+                    "cache_coherent", (time.monotonic() - mark) * 1000.0)
+                resynced_after.add(i)
+        snapshot = world.cached.list("notebooks", namespace=ns,
+                                     group=GROUP)["items"]
+        positions.feed(snapshot)
+        live = [nb for nb in snapshot
+                if nb["metadata"]["name"] not in deleted]
+        double_bookings += sum(
+            1 for m in _pool_bookings(live).values() if len(m) > 1)
+        to_delete = []
+        for nb in live:
+            r = world.tracker.record(ns, nb["metadata"]["name"])
+            if r is not None and r.ready is not None:
+                to_delete.append(nb["metadata"]["name"])
+        for name in to_delete:
+            try:
+                world.kube.delete("notebooks", name, namespace=ns,
+                                  group=GROUP)
+            except errors.NotFound:
+                pass  # already collected; counts as drained
+            deleted.add(name)
+        time.sleep(0.02)
+    schedule.stop()
+    chaos.set_watch_faults(0.0, 0.0)
+    ok = len(deleted) == len(names)
+    if double_bookings:
+        rec.violation("double_booking", double_bookings)
+    if positions.violations:
+        rec.violation("queue_position", positions.violations)
+    if len(resynced_after) < want_pulses:
+        # a pulse whose caches never re-converged is the exact failure
+        # this scenario hunts — partial recovery must not pass just
+        # because EARLIER pulses produced recovery_ms samples
+        rec.violation("pulse_never_recovered",
+                      want_pulses - len(resynced_after))
+    return _chaos_result(world, cfg, started, ok, rec, chaos, {
+        "pools": pools,
+        "pulses": len(pulse_marks),
+        "double_bookings": double_bookings,
+        "position_violations": positions.violations,
+        "drained": len(deleted),
+    }, schedule=schedule)
+
+
+def scenario_chaos_node_death(cfg: BenchConfig) -> ScenarioResult:
+    """A busy pool's nodes die mid-gang and are auto-repaired. Every
+    gang gets its own pool and reaches Ready; then one placed pool's
+    Node objects are deleted with their bound pods force-removed (the
+    node controller's eventual pod GC). The fake STS controller must
+    replace the pods, the scheduler's bind retry must pick them up when
+    the repaired nodes register, and the gang must return to Ready —
+    with no orphaned children, no pod bound to a dead node, and no
+    double-booked pool at settle."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "chaos_node_death", scheduler=True)
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    rec = RecoveryTracker()
+    ns = "bench"
+    n = max(2, cfg.n)
+    for p in range(n):
+        _mk_pool(world.kube, f"death-pool-{p}")
+    try:
+        return _run_chaos_node_death(cfg, world, chaos, rec, ns, n,
+                                     started)
+    finally:
+        world.stop()   # idempotent; covers the exception path
+
+
+def _run_chaos_node_death(cfg, world, chaos, rec, ns, n,
+                          started) -> ScenarioResult:
+    world.start()
+    names = [f"mort-{i:02d}" for i in range(n)]
+    tpu = {"generation": "v5e", "topology": "4x4"}
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(names, ns, tpu, want_ready=4)
+    )
+    keys = [(ns, n_) for n_ in names]
+    ok = world.tracker.wait_ready(keys, cfg.timeout)
+
+    # find a placed pool and kill it under its gang
+    victim_pool = None
+    victims: list[str] = []
+    for name in names:
+        try:
+            nb = world.cached.get("notebooks", name, namespace=ns,
+                                  group=GROUP)
+        except errors.NotFound:
+            continue
+        pool = (nb["metadata"].get("annotations") or {}).get(
+            tpu_mod.ANNOTATION_NODEPOOL)
+        if pool:
+            victim_pool = pool
+            victims = [name]
+            break
+    killed = chaos.kill_nodes(victim_pool, tpu_mod.SEL_NODEPOOL) \
+        if victim_pool else []
+    # the gang must actually observe the death (readyReplicas drops).
+    # No victim (nothing got placed — the run already failed) → don't
+    # spin the full timeout waiting on an empty list
+    observed_down = False
+    deadline = time.monotonic() + (cfg.timeout if victims else 0)
+    while time.monotonic() < deadline and not observed_down:
+        for name in victims:
+            try:
+                nb = world.cached.get("notebooks", name, namespace=ns,
+                                      group=GROUP)
+            except errors.NotFound:
+                continue
+            if ((nb.get("status") or {}).get("readyReplicas") or 0) < 4:
+                observed_down = True
+        time.sleep(0.02)
+    time.sleep(0.3)   # let the replacement pods pile up unbindable
+    chaos.repair_nodes()
+    repaired_at = time.monotonic()
+    # recovery: each victim gang returns to full readiness
+    pending = set(victims)
+    deadline = time.monotonic() + cfg.timeout
+    while pending and time.monotonic() < deadline:
+        for name in list(pending):
+            try:
+                nb = world.cached.get("notebooks", name, namespace=ns,
+                                      group=GROUP)
+            except errors.NotFound:
+                continue
+            if ((nb.get("status") or {}).get("readyReplicas") or 0) >= 4:
+                rec.note_recovery(
+                    "re_ready",
+                    (time.monotonic() - repaired_at) * 1000.0)
+                pending.discard(name)
+        time.sleep(0.02)
+    ok = ok and observed_down and not pending
+    if not observed_down:
+        rec.violation("death_not_observed")
+    if pending:
+        rec.violation("gang_never_recovered", len(pending))
+    # settle: one live booking per pool
+    double = sum(
+        1 for m in _pool_bookings(
+            world.cached.list("notebooks", namespace=ns,
+                              group=GROUP)["items"]
+        ).values() if len(m) > 1)
+    if double:
+        rec.violation("double_booking", double)
+    return _chaos_result(world, cfg, started, ok, rec, chaos, {
+        "pools": n,
+        "nodes_killed": len(killed),
+        "victim_pool": victim_pool,
+        "victim_gangs": victims,
+        "observed_down": observed_down,
+        "double_bookings": double,
+    })
+
+
+def scenario_chaos_kubelet_stall(cfg: BenchConfig) -> ScenarioResult:
+    """The kubelet wedges: pods schedule and bind but stop flipping
+    Ready for a window. Nothing may read falsely Ready during the stall
+    (the tracker would see it), the control plane itself must STAY
+    ready (/readyz semantics: the cluster is sick, the plane is not),
+    and the backlog must drain once the stall lifts — recovery is
+    unstall → Ready per held notebook."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "chaos_kubelet_stall")
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    rec = RecoveryTracker()
+    try:
+        return _run_chaos_kubelet_stall(cfg, world, chaos, rec, started)
+    finally:
+        world.stop()   # idempotent; covers the exception path
+
+
+def _run_chaos_kubelet_stall(cfg, world, chaos, rec,
+                             started) -> ScenarioResult:
+    world.start()
+    ns = "bench"
+    tpu = {"generation": "v5e", "topology": "2x2"}
+    gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+
+    pre = [f"st-pre-{i}" for i in range(max(1, cfg.n // 2))]
+    gen.run(world.create_jobs(pre, ns, tpu, want_ready=1))
+    ok = world.tracker.wait_ready([(ns, n) for n in pre], cfg.timeout)
+
+    world.actuator.stall()
+    chaos._note("kubelet_stalled")
+    held = [f"st-held-{i}" for i in range(cfg.n - len(pre))]
+    gen.run(world.create_jobs(held, ns, tpu, want_ready=1))
+    stall_until = time.monotonic() + cfg.chaos_stall_s
+    false_ready = 0
+    plane_ready_samples = 0
+    plane_ready_true = 0
+    while time.monotonic() < stall_until:
+        for name in held:
+            r = world.tracker.record(ns, name)
+            if r is not None and r.ready is not None:
+                false_ready += 1
+        plane_ready_samples += 1
+        plane_ready_true += int(world.mgr.informers_synced())
+        time.sleep(0.05)
+    world.actuator.unstall()
+    chaos._note("kubelet_unstalled")
+    unstalled_at = time.monotonic()
+    ok = world.tracker.wait_ready([(ns, n) for n in held],
+                                  cfg.timeout) and ok
+    for name in held:
+        r = world.tracker.record(ns, name)
+        if r is not None and r.ready is not None and \
+                r.ready > unstalled_at:
+            rec.note_recovery("unstall_to_ready",
+                              (r.ready - unstalled_at) * 1000.0)
+    if false_ready:
+        rec.violation("false_ready", false_ready)
+    if plane_ready_true < plane_ready_samples:
+        # a sick cluster must not read as a sick control plane
+        rec.violation("plane_flapped_during_stall",
+                      plane_ready_samples - plane_ready_true)
+    return _chaos_result(world, cfg, started, ok, rec, chaos, {
+        "stall_s": cfg.chaos_stall_s,
+        "false_ready": false_ready,
+        "held_notebooks": len(held),
+        "plane_ready_during_stall":
+            plane_ready_true == plane_ready_samples,
+    })
+
+
+CHAOS_SCENARIOS = {
+    "chaos_relist": scenario_chaos_relist,
+    "chaos_blackout": scenario_chaos_blackout,
+    "chaos_node_death": scenario_chaos_node_death,
+    "chaos_kubelet_stall": scenario_chaos_kubelet_stall,
+}
+
+# the family registers into the shared scenario table (run_scenario and
+# the CLI reach it there); importing this module is the registration
+SCENARIOS.update(CHAOS_SCENARIOS)

@@ -95,6 +95,13 @@ class BenchConfig:
     timeout: float = 30.0            # per-scenario ready deadline (s)
     churn_cycles: int = 2
     cull_period_minutes: float = 0.01   # culling probe cadence (36 s/60)
+    # chaos-family knobs (cpbench/chaos.py). The blackout window must
+    # comfortably exceed the informers' 3-consecutive-failures outage
+    # threshold (~3 s of severed/503 watch attempts) or /readyz never
+    # flips and the scenario can't observe the recovery it measures.
+    chaos_window_s: float = 4.5      # apiserver blackout length
+    chaos_stall_s: float = 2.0       # kubelet stall length
+    chaos_pulses: int = 3            # 410-Gone storm pulses
 
 
 @dataclasses.dataclass
@@ -124,14 +131,18 @@ class _NotebookWorld:
     FakeKubelet + a ready-watch, instrumented for one scenario."""
 
     def __init__(self, cfg: BenchConfig, scenario: str,
-                 fetch_kernels=None, scheduler: bool = False):
+                 fetch_kernels=None, scheduler: bool = False,
+                 relist_period: float = 0.0):
         self.kube = FakeKube()
         self.tracker = Tracker(scenario)
         # per-world tracer: the span source for per-stage attribution,
         # isolated so scenarios can't read each other's lifecycles
         self.trace = Tracer(max_traces=4096)
         self.tracker.instrument_kube(self.kube, tracer=self.trace)
-        self.mgr = Manager(self.kube, tracer=self.trace)
+        # relist_period > 0 (chaos scenarios): periodic relists heal
+        # silent watch-cache divergence injected by event drops
+        self.mgr = Manager(self.kube, tracer=self.trace,
+                           relist_period=relist_period)
         self.reconciler = NotebookReconciler(self.kube)
         self.tracker.instrument_reconciler(self.reconciler)
         self.reconciler.register(self.mgr)
@@ -153,7 +164,8 @@ class _NotebookWorld:
             self.tracker.instrument_reconciler(self.culler)
             self.culler.register(self.mgr)
         self.actuator = FakeKubelet(self.kube, cfg.actuation,
-                                    seed=cfg.seed, tracer=self.trace)
+                                    seed=cfg.seed, tracer=self.trace,
+                                    relist_period=relist_period)
         self.tracker.actuation_fn = self.actuator.actuation_for
         #: the manager's delegating read client — what the converted
         #: reconcilers read through; scenario poll loops use it too, so
@@ -163,7 +175,8 @@ class _NotebookWorld:
         self._api_t0 = self.kube.request_counts_snapshot()
         self._want: dict[tuple[str, str], int] = {}
         self._ready_inf = Informer(self.kube, "notebooks", group=GROUP,
-                                   tracer=self.trace)
+                                   tracer=self.trace,
+                                   relist_period=relist_period)
         self._ready_inf.add_handler(self._on_notebook)
 
     def _on_notebook(self, ev_type: str, nb: dict) -> None:
@@ -183,6 +196,11 @@ class _NotebookWorld:
         self._ready_inf.wait_for_sync(10)
 
     def stop(self) -> None:
+        # idempotent: chaos scenarios stop via _chaos_result on the
+        # normal path AND from a finally block on the exception path
+        if getattr(self, "_stopped", False):
+            return
+        self._stopped = True
         self._ready_inf.stop()
         self.actuator.stop()
         self.mgr.stop()

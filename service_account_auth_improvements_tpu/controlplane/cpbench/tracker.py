@@ -264,6 +264,56 @@ class Tracker:
         }
 
 
+# ------------------------------------------------------ chaos bookkeeping
+
+class RecoveryTracker:
+    """Chaos-scenario ledger: recovery-time samples per injection kind
+    plus invariant-violation counters.
+
+    A chaos scenario's verdict is two-sided — *did the invariants hold*
+    (violations, must be zero) and *how fast did the plane heal*
+    (recovery samples, reported as p50/p95 in CONTROLPLANE_BENCH.json
+    and gated by tools/bench_gate.py). Thread-safe: watch handlers and
+    the scenario's poll loop both stamp it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: dict[str, list[float]] = {}
+        self._violations: dict[str, int] = {}
+
+    def note_recovery(self, kind: str, ms: float) -> None:
+        """One healed-after-injection sample (milliseconds)."""
+        with self._lock:
+            self._samples.setdefault(kind, []).append(ms)
+
+    def violation(self, kind: str, n: int = 1) -> None:
+        """An invariant broke (double booking, orphan, false-ready...)."""
+        with self._lock:
+            self._violations[kind] = self._violations.get(kind, 0) + n
+
+    def violations(self, kind: str) -> int:
+        with self._lock:
+            return self._violations.get(kind, 0)
+
+    def recovery_ms(self) -> dict:
+        """{kind: percentiles} over every sample recorded so far; the
+        flat union rides under the "all" key so the gate has one field
+        to require."""
+        with self._lock:
+            per = {k: percentiles(v, qs=(50, 95))
+                   for k, v in self._samples.items() if v}
+            every = [s for v in self._samples.values() for s in v]
+        if every:
+            per["all"] = percentiles(every, qs=(50, 95))
+        return per
+
+    def summary(self) -> dict:
+        with self._lock:
+            violations = dict(self._violations)
+        return {"recovery_ms": self.recovery_ms(),
+                "invariant_violations": violations}
+
+
 # -------------------------------------------------- per-stage attribution
 
 #: cptrace span name → attribution stage. Claim priority (the tuple

@@ -32,12 +32,22 @@ class Informer:
 
     def __init__(self, client, plural: str, group: str | None = None,
                  namespace: str | None = None, resync_period: float = 0.0,
-                 tracer=None):
+                 tracer=None, relist_period: float = 0.0):
         self.client = client
         self.plural = plural
         self.group = group
         self.namespace = namespace
+        #: idle watch timeout (0 → 30 s): how long one watch call may sit
+        #: quiet before re-watching FROM THE LAST RV — no relist (the
+        #: reflector contract; test_engine pins it)
         self.resync_period = resync_period
+        #: periodic full relist. 0 = never: a healthy watch stream is
+        #: lossless, so steady-state relists would be pure apiserver
+        #: load. Chaos/HA deployments set it as the heal-all for SILENT
+        #: cache divergence — a dropped event leaves the cache stale at
+        #: a current RV, and no reconnect replay or 410 ever repairs
+        #: that (docs/chaos.md).
+        self.relist_period = relist_period
         #: watch→handler delivery lag rides the engine families; traced
         #: objects (a manager passes its tracer) additionally get an
         #: ``informer.deliver`` span per event
@@ -58,6 +68,13 @@ class Informer:
         self._stop = threading.Event()
         self._synced = threading.Event()
         self._thread: threading.Thread | None = None
+        #: outage diagnostics surfaced by ``status()`` (/readyz?verbose):
+        #: when readiness flips false, the operator needs to see WHICH
+        #: watch is wedged, how many times in a row it failed, and how
+        #: stale its last successful relist is
+        self.consecutive_failures = 0
+        self._last_relist: float | None = None   # monotonic
+        self._last_error: str | None = None
 
     # handler: fn(event_type: str, obj: dict) — called for ADDED/MODIFIED/
     # DELETED (and SYNC on resync/list replay). With ``want_old=True`` the
@@ -77,6 +94,27 @@ class Informer:
 
     def has_synced(self) -> bool:
         return self._synced.is_set()
+
+    def status(self) -> dict:
+        """Diagnostic snapshot for /readyz?verbose: sync state, outage
+        counters, and relist staleness — enough to tell a wedged watch
+        from a healthy-but-quiet one."""
+        last = self._last_relist
+        return {
+            "synced": self._synced.is_set(),
+            "consecutive_failures": self.consecutive_failures,
+            "last_relist_age_s": (round(time.monotonic() - last, 3)
+                                  if last is not None else None),
+            "last_error": self._last_error,
+            "resource_version": self.last_resource_version(),
+            "cached_objects": len(self._cache),
+        }
+
+    @property
+    def last_relist_monotonic(self) -> float | None:
+        """Monotonic instant of the last successful relist (None before
+        the first) — chaos benches time storm→relist recovery off it."""
+        return self._last_relist
 
     def last_resource_version(self) -> str:
         """Most recent resourceVersion the cache reflects (list envelope
@@ -245,6 +283,8 @@ class Informer:
             ]
             self._cache_replace(fresh)
             self._last_rv = rv
+            self._last_relist = time.monotonic()
+            self._last_error = None
         for obj in stale_objs:
             self._dispatch("DELETED", obj, old=obj)
         for key, obj in fresh.items():
@@ -252,39 +292,58 @@ class Informer:
         self._synced.set()
         return rv
 
+    def _relist_due(self) -> bool:
+        """True when periodic relisting is enabled and a full relist is
+        overdue. The relist refreshes the cache WITHOUT clearing
+        ``_synced`` — it is hygiene, not an outage."""
+        return bool(
+            self.relist_period
+            and self._last_relist is not None
+            and time.monotonic() - self._last_relist >= self.relist_period
+        )
+
     def _run(self) -> None:
         rv: str | None = None  # None → must (re)list before watching
-        failures = 0           # consecutive list/watch errors
+        # consecutive list/watch errors live on the instance
+        # (self.consecutive_failures) so /readyz?verbose can show them
         while not self._stop.is_set():
             try:
                 if rv is None:
                     rv = self._relist()
-                    failures = 0
+                    self.consecutive_failures = 0
+                timeout = self.resync_period or 30
+                if self.relist_period:
+                    # an idle stream must still hit its relist on time
+                    timeout = min(timeout, self.relist_period)
                 for ev in self.client.watch(
                     self.plural, namespace=self.namespace,
                     resource_version=rv, group=self.group,
-                    timeout=self.resync_period or 30,
+                    timeout=timeout,
                 ):
-                    # real progress (any event, even BOOKMARK) resets
-                    # the outage counter; idle watch timeouts don't
-                    # touch it either way
-                    failures = 0
                     if self._stop.is_set():
                         return
                     et, obj = ev.get("type"), ev.get("object")
                     if et == "ERROR":
                         # in-stream Status object: 410/Expired means our RV
-                        # was compacted → relist; anything else → back off
-                        # briefly, then re-watch (no tight retry loop)
+                        # was compacted → relist; anything else is a FAILED
+                        # round, not progress — raise into the outage path
+                        # (backoff + consecutive_failures), or a stream
+                        # that only ever yields ERROR (severed channels, a
+                        # dying proxy) would never flip readiness
                         status = obj or {}
                         if (status.get("code") == 410
                                 or status.get("reason") in ("Expired",
                                                             "Gone")):
                             rv = None
                             self._synced.clear()
-                        else:
-                            self._stop.wait(1.0)
-                        break
+                            break
+                        raise errors.ApiError(
+                            f"in-stream ERROR event: {status}"
+                        )
+                    # real progress (any non-ERROR event, even BOOKMARK)
+                    # resets the outage counter; idle watch timeouts
+                    # don't touch it either way
+                    self.consecutive_failures = 0
                     if obj is not None:
                         new_rv = (obj.get("metadata") or {}).get(
                             "resourceVersion"
@@ -304,23 +363,35 @@ class Informer:
                             self._last_rv = rv
                     self._dispatch(et, obj, emitted=ev.get("emittedAt"),
                                    old=old)
+                    if self._relist_due():
+                        # periodic relist: a watch stream that silently
+                        # lost an event leaves the cache diverged with a
+                        # CURRENT resourceVersion — no reconnect replay
+                        # or 410 will ever heal it. The in-loop check
+                        # matters: a busy stream never hits the idle
+                        # timeout below.
+                        rv = None
+                        break
                 # normal watch expiry (timeout): re-watch from the last RV
                 # without relisting. A clean-but-idle round trip is also
                 # progress — without this, blips spread over days would
                 # accumulate to the outage threshold on a quiet resource.
-                failures = 0
+                self.consecutive_failures = 0
+                if self._relist_due():
+                    rv = None
             except errors.Gone:
                 log.info("informer %s: resourceVersion expired; relisting",
                          self.plural)
                 rv = None
                 self._synced.clear()
-            except Exception:
+            except Exception as e:
                 if self._stop.is_set():
                     return
-                failures += 1
+                self.consecutive_failures += 1
+                self._last_error = repr(e)
                 log.exception("informer %s list/watch failed; retrying",
                               self.plural)
-                if failures >= 3:
+                if self.consecutive_failures >= 3:
                     # a sustained outage, not a blip: the cache is of
                     # unknown staleness, so readiness
                     # (Manager.informers_synced) must read false until a

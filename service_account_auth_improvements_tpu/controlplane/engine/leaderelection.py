@@ -12,6 +12,18 @@ here, on the stdlib kube client:
 - lost lease (renewal failing past the deadline): ``on_lost`` fires —
   default os._exit, the controller-runtime behavior, because continuing
   as a deposed leader would mean two active reconcilers.
+
+Clock skew: lease timestamps are written by the HOLDER's wall clock and
+judged by each CANDIDATE's — two clocks that disagree by more than the
+lease duration would let a candidate depose a perfectly healthy leader
+(and the deposed holder, seeing a "live" rival, self-evicts). Expiry
+therefore tolerates a bounded skew (``skew_tolerance``, default 25% of
+the lease's advertised duration, the margin k8s HA docs assume):
+a lease is only expired when it is stale past duration + tolerance, and
+a renewTime absurdly far in the FUTURE (beyond the same bound) is
+treated as a broken clock, not a valid hold — a crashed holder with a
+future-dated renewTime must not keep the lease forever. ``now_fn``
+injects the candidate's clock (chaos: ``kube.chaos.skewed_clock``).
 """
 
 from __future__ import annotations
@@ -56,7 +68,9 @@ class LeaderElector:
                  lease_duration: float = 15.0,
                  renew_period: float = 5.0,
                  retry_period: float = 2.0,
-                 on_lost=None):
+                 on_lost=None,
+                 now_fn=None,
+                 skew_tolerance: float | None = None):
         self.kube = kube
         self.lease_name = lease_name
         self.namespace = namespace
@@ -65,6 +79,12 @@ class LeaderElector:
         self.renew_period = renew_period
         self.retry_period = retry_period
         self.on_lost = on_lost if on_lost is not None else self._die
+        #: this candidate's wall clock (injection point for skew tests /
+        #: chaos); every timestamp written or judged goes through it
+        self._now = now_fn if now_fn is not None else _now
+        #: bounded clock-skew grace when judging ANOTHER holder's lease;
+        #: None → 25% of the lease's own advertised duration
+        self.skew_tolerance = skew_tolerance
         self._stop = threading.Event()
         self._renewer: threading.Thread | None = None
         self.is_leader = False
@@ -172,11 +192,25 @@ class LeaderElector:
         duration = spec.get("leaseDurationSeconds")
         if duration is None:  # 0 is a valid (instant-expiry) duration
             duration = self.lease_duration
-        return (_now() - renew).total_seconds() > duration
+        tol = self.skew_tolerance
+        if tol is None:
+            # proportional to the lease's OWN advertised duration (not
+            # ours): the holder that wrote it declared how long its
+            # heartbeat may be trusted, so the skew grace scales with it
+            tol = 0.25 * float(duration)
+        age = (self._now() - renew).total_seconds()
+        # stale past duration + tolerance → expired (the tolerance keeps
+        # a healthy holder whose clock trails ours within bounds from
+        # being deposed, and stops that holder self-evicting when it
+        # then sees the usurper's "live" lease); a renewTime further in
+        # the FUTURE than the same bound is a broken clock, not a hold —
+        # without that leg, a crashed holder that wrote a far-future
+        # renewTime would keep the lease forever
+        return age > float(duration) + tol or age < -(float(duration) + tol)
 
     def _try_acquire(self) -> bool:
         lease = self._get()
-        now = _fmt(_now())
+        now = _fmt(self._now())
         try:
             if lease is None:
                 self.kube.create("leases", {

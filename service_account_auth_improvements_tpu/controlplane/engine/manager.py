@@ -203,9 +203,14 @@ class Manager:
         return min(cls.DEFAULT_WORKERS, max(2, cpus))
 
     def __init__(self, client, namespace: str | None = None,
-                 default_workers: int | None = None, tracer=None):
+                 default_workers: int | None = None, tracer=None,
+                 relist_period: float = 0.0):
         self.client = client
         self.namespace = namespace
+        #: periodic relist for every informer this manager creates
+        #: (Informer.relist_period): 0 for healthy clusters; chaos/HA
+        #: deployments set it to heal silent watch-cache divergence
+        self.relist_period = relist_period
         #: ENGINE_DEFAULT_WORKERS mirrors controller-runtime's
         #: MaxConcurrentReconciles flag — the deploy-time lever when a
         #: workload's reconciles are CPU-bound enough that extra workers
@@ -233,7 +238,7 @@ class Manager:
                 )
             inf = Informer(
                 self.client, plural, group=group, namespace=self.namespace,
-                tracer=self.tracer,
+                tracer=self.tracer, relist_period=self.relist_period,
             )
             # standard indexes on every watch: "children of this owner"
             # and "objects in this namespace" are the two lookups every
@@ -260,6 +265,15 @@ class Manager:
         """True when every registered informer has completed its initial
         list — the readiness condition the ops /readyz probes."""
         return all(inf.has_synced() for inf in self._informers.values())
+
+    def informer_status(self) -> dict:
+        """Per-informer diagnostics for /readyz?verbose: when readiness
+        flips false, this names WHICH watch is wedged (sync state,
+        consecutive failures, last-relist age, last error)."""
+        return {
+            (f"{plural}.{group}" if group else plural): inf.status()
+            for (group, plural), inf in self._informers.items()
+        }
 
     def add_reconciler(self, reconciler: Reconciler,
                        workers: int | None = None,

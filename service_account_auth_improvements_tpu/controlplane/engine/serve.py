@@ -11,6 +11,7 @@ bounds the page).
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -20,8 +21,15 @@ from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
 
 
 def serve_ops(port: int, registry=None, ready_check=None,
-              host: str = "0.0.0.0", tracer=None) -> ThreadingHTTPServer:
-    """Start the ops endpoint in a daemon thread; returns the server."""
+              host: str = "0.0.0.0", tracer=None,
+              ready_detail=None) -> ThreadingHTTPServer:
+    """Start the ops endpoint in a daemon thread; returns the server.
+
+    ``ready_check() -> bool`` drives /readyz's status code;
+    ``ready_detail() -> dict`` (typically ``Manager.informer_status``)
+    powers ``/readyz?verbose`` — the JSON diagnosis of WHY readiness is
+    false (which informer is wedged, how many consecutive failures, how
+    stale its last relist is) rather than just the fact of it."""
     reg = registry if registry is not None else REGISTRY
     trc = tracer if tracer is not None else obs.TRACER
 
@@ -40,8 +48,22 @@ def serve_ops(port: int, registry=None, ready_check=None,
                 self.send_response(200)
             elif self.path.startswith("/readyz"):
                 ok = ready_check() if ready_check else True
-                body = b"ok" if ok else b"not ready"
-                self.send_response(200 if ok else 503)
+                q = parse_qs(urlparse(self.path).query,
+                             keep_blank_values=True)
+                if "verbose" in q and ready_detail is not None:
+                    try:
+                        detail = ready_detail()
+                    except Exception as e:  # diagnosis must not 500 a probe
+                        detail = {"error": repr(e)}
+                    body = json.dumps(
+                        {"ready": ok, "informers": detail},
+                        indent=2, sort_keys=True, default=str,
+                    ).encode()
+                    self.send_response(200 if ok else 503)
+                    self.send_header("Content-Type", "application/json")
+                else:
+                    body = b"ok" if ok else b"not ready"
+                    self.send_response(200 if ok else 503)
             elif self.path.startswith("/debug/tracez"):
                 q = parse_qs(urlparse(self.path).query)
                 try:

@@ -15,6 +15,11 @@ from service_account_auth_improvements_tpu.controlplane.kube.errors import (  # 
 from service_account_auth_improvements_tpu.controlplane.kube.fake import (  # noqa: F401
     FakeKube,
 )
+from service_account_auth_improvements_tpu.controlplane.kube.chaos import (  # noqa: F401
+    ChaosInjector,
+    ChaosSchedule,
+    skewed_clock,
+)
 from service_account_auth_improvements_tpu.controlplane.kube.client import (  # noqa: F401
     KubeClient,
 )

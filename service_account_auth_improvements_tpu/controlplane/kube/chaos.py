@@ -1,0 +1,413 @@
+"""Fault injection for FakeKube — the cluster's bad day, scripted.
+
+cpbench (and every test before this module) only ever exercised a
+HEALTHY cluster: the apiserver answers every request, no watch stream
+dies, the kubelet always flips pods Ready. Real control planes earn
+their keep in the other regime, and PR 5's ``_reemit`` event-overtake
+race showed that the bugs that matter only surface under induced
+disorder. ``ChaosInjector`` makes that disorder a first-class, seeded,
+scriptable input (Jup2Kub, arXiv:2311.12308, frames the fault-tolerance
+bar for notebook pipelines; docs/chaos.md is the operator's catalog):
+
+- **apiserver blackout** — every verb raises 503 ``ServiceUnavailable``
+  for a window, and live watch channels are severed (connection reset),
+  exactly what a control-plane restart or network partition looks like
+  to a client;
+- **410 Gone storm** — forced history compactions so any watcher that
+  reconnects from its last resourceVersion gets 410 and must relist
+  (the etcd-compaction path of the reflector contract);
+- **per-verb latency / error rates** — a slow or flaky apiserver
+  without a full outage;
+- **watch-channel drops and reordering** — events silently lost from a
+  stream, or delivered out of order (the overtake shape), per watcher;
+- **node death / repair** — a pool's Node objects deleted with their
+  bound pods force-removed (what the node controller eventually does to
+  a dead kubelet's pods), then re-registered;
+- **kubelet stall** — the actuator keeps scheduling but stops flipping
+  Ready (``FakeKubelet.stall()`` — the knob itself lives in
+  cpbench/actuator.py);
+- **clock skew** — ``skewed_clock(offset)`` plugs into
+  ``LeaderElector(now_fn=...)`` so lease timestamps are written by a
+  clock that disagrees with everyone else's.
+
+Every injection is recorded (``log`` / ``counters``) so a bench run can
+report exactly what it survived. The hooks are ZERO-COST when disabled:
+FakeKube checks one ``self.chaos is not None`` per request and per
+event fanout — no chaos object, no branches taken.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import datetime
+import random
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+__all__ = ["ChaosInjector", "ChaosSchedule", "skewed_clock"]
+
+#: a reordered event held back longer than this is flushed even if no
+#: follow-up event arrives to overtake it — a mangled channel may delay,
+#: it must never swallow forever (that would be a drop, a different knob)
+HOLD_FLUSH_S = 0.25
+
+
+def skewed_clock(offset_s: float):
+    """A wall-clock whose "now" is ``offset_s`` seconds off — inject via
+    ``LeaderElector(now_fn=skewed_clock(-3.0))`` to play a holder whose
+    clock trails (negative) or leads (positive) the rest of the
+    cluster."""
+
+    def now() -> datetime.datetime:
+        return (datetime.datetime.now(datetime.timezone.utc)
+                + datetime.timedelta(seconds=offset_s))
+
+    return now
+
+
+class ChaosInjector:
+    """Fault state attached to one FakeKube (``kube.enable_chaos()``).
+
+    Thread-safe; every knob may flip while traffic is in flight — that
+    is the point. Scripted use goes through :class:`ChaosSchedule`."""
+
+    def __init__(self, kube, seed: int = 0):
+        self._kube = kube
+        self._lock = threading.Lock()
+        self._rng = random.Random(seed)
+        self._blackout_until = 0.0          # monotonic deadline, 0 = off
+        self._verb_latency: dict[str, float] = {}
+        self._verb_error_rate: dict[str, float] = {}
+        self._drop_rate = 0.0
+        self._drop_types: tuple | None = None    # None = any event type
+        self._reorder_rate = 0.0
+        #: reordering holds ONE event per watch channel until the next
+        #: event overtakes it: id(watch) -> (held_since, watch, event)
+        self._held: dict[int, tuple] = {}
+        #: at most ONE sweep timer outstanding per injector — a timer
+        #: per hold would spawn an OS thread per reordered event inside
+        #: the very fault windows the scenarios are timing
+        self._sweep_armed = False
+        self._dead_nodes: dict[str, dict] = {}   # name -> saved Node obj
+        #: injection journal (bounded) + counters for bench reports
+        self.log: list[dict] = []
+        self.counters: dict[str, int] = {}
+
+    # ------------------------------------------------------------ journal
+
+    def _note(self, kind: str, **attrs) -> None:
+        with self._lock:
+            self.counters[kind] = self.counters.get(kind, 0) + 1
+            if len(self.log) < 512:
+                self.log.append({"t": time.monotonic(), "kind": kind,
+                                 **attrs})
+
+    def summary(self) -> dict:
+        with self._lock:
+            return dict(self.counters)
+
+    @contextlib.contextmanager
+    def _as_internal(self):
+        """Mark this thread's FakeKube calls as an internal actor (the
+        fake's GC-cascade guard): the injector's OWN remediation —
+        killing nodes is the cloud provider's hand, not an API client —
+        must not be subject to the blackout/error-rate faults it
+        coexists with, or a composed schedule would journal a node
+        death that never (fully) happened and the scenario would time
+        'recovery' from a phantom injection."""
+        tl = self._kube._internal
+        tl.depth = getattr(tl, "depth", 0) + 1
+        try:
+            yield
+        finally:
+            tl.depth -= 1
+
+    # --------------------------------------------------- scripted actions
+
+    def start_blackout(self, duration_s: float, sever: bool = True) -> None:
+        """Total apiserver outage: every verb 503s until the window ends;
+        ``sever`` additionally resets live watch connections (clients
+        must reconnect — into the blackout)."""
+        with self._lock:
+            self._blackout_until = time.monotonic() + duration_s
+        self._note("blackout_started", duration_s=duration_s)
+        if sever:
+            self.sever_watches()
+
+    def end_blackout(self) -> None:
+        with self._lock:
+            self._blackout_until = 0.0
+        self._note("blackout_ended")
+
+    def blackout_active(self) -> bool:
+        with self._lock:
+            return time.monotonic() < self._blackout_until
+
+    def sever_watches(self) -> None:
+        """Connection-reset every live watch channel (the streams end;
+        reconnection hits whatever faults are active)."""
+        n = self._kube._sever_watches()
+        self._note("watches_severed", count=n)
+
+    def gone_storm(self, plural: str | None = None,
+                   group: str | None = None) -> None:
+        """Forced compaction sweep: expire the retained watch history so
+        every reconnect-from-last-RV gets 410 Gone and must relist."""
+        self._kube.compact_history(plural, group)
+        self._note("gone_storm", plural=plural or "*")
+
+    def set_verb_latency(self, verb: str, seconds: float) -> None:
+        """Add fixed latency to one verb ('*' = all); 0 clears."""
+        with self._lock:
+            if seconds > 0:
+                self._verb_latency[verb] = seconds
+            else:
+                self._verb_latency.pop(verb, None)
+        self._note("verb_latency_set", verb=verb, seconds=seconds)
+
+    def set_verb_error_rate(self, verb: str, rate: float) -> None:
+        """Probabilistic 503s on one verb ('*' = all); 0 clears."""
+        with self._lock:
+            if rate > 0:
+                self._verb_error_rate[verb] = rate
+            else:
+                self._verb_error_rate.pop(verb, None)
+        self._note("verb_error_rate_set", verb=verb, rate=rate)
+
+    def set_watch_faults(self, drop_rate: float = 0.0,
+                         reorder_rate: float = 0.0,
+                         drop_types: tuple | None = None) -> None:
+        """Mangle watch channels: ``drop_rate`` silently loses events
+        (``drop_types`` restricts which, e.g. ``("DELETED",)`` — None
+        means any), ``reorder_rate`` holds an event back so its
+        successor overtakes it. Setting both to 0 flushes held events
+        and restores fidelity."""
+        with self._lock:
+            self._drop_rate = drop_rate
+            self._reorder_rate = reorder_rate
+            self._drop_types = tuple(drop_types) if drop_types else None
+        self._note("watch_faults_set", drop_rate=drop_rate,
+                   reorder_rate=reorder_rate)
+        if drop_rate == 0.0 and reorder_rate == 0.0:
+            self.flush_held()
+
+    def kill_nodes(self, pool: str, node_pool_label: str) -> list[str]:
+        """Node death: delete every Node labeled into ``pool`` and
+        force-remove the pods bound to them (the node lifecycle
+        controller's eventual pod GC, compressed). The saved Node
+        objects come back on :meth:`repair_nodes` — auto-repair."""
+        kube = self._kube
+        killed: list[str] = []
+        with self._as_internal():
+            for node in kube.list(
+                    "nodes",
+                    label_selector=f"{node_pool_label}={pool}")["items"]:
+                name = node["metadata"]["name"]
+                with self._lock:
+                    self._dead_nodes[name] = {
+                        "metadata": {
+                            "name": name,
+                            "labels": dict(
+                                node["metadata"].get("labels") or {}),
+                        },
+                        "status": {"capacity": dict(
+                            (node.get("status") or {}).get("capacity")
+                            or {})},
+                    }
+                try:
+                    kube.delete("nodes", name)
+                except errors.NotFound:
+                    pass
+                killed.append(name)
+            if killed:
+                dead = set(killed)
+                for pod in kube.list("pods")["items"]:
+                    if (pod.get("spec") or {}).get("nodeName") in dead:
+                        try:
+                            kube.delete("pods",
+                                        pod["metadata"]["name"],
+                                        namespace=pod["metadata"].get(
+                                            "namespace"))
+                        except errors.NotFound:
+                            pass
+        self._note("nodes_killed", pool=pool, count=len(killed))
+        return killed
+
+    def repair_nodes(self) -> int:
+        """Re-register every node killed so far (GKE node auto-repair):
+        same names, labels, and capacity — fresh uids/RVs."""
+        with self._lock:
+            dead, self._dead_nodes = self._dead_nodes, {}
+        with self._as_internal():
+            for obj in dead.values():
+                try:
+                    self._kube.create("nodes", obj)
+                except errors.AlreadyExists:
+                    pass
+        self._note("nodes_repaired", count=len(dead))
+        return len(dead)
+
+    # ------------------------------------------------- FakeKube hook: API
+
+    def admit(self, verb: str) -> None:
+        """Called by FakeKube at the top of every external request; may
+        sleep (latency) and may raise 503 (blackout / error rate)."""
+        with self._lock:
+            now = time.monotonic()
+            blackout = now < self._blackout_until
+            delay = self._verb_latency.get(verb,
+                                           self._verb_latency.get("*", 0.0))
+            rate = self._verb_error_rate.get(
+                verb, self._verb_error_rate.get("*", 0.0))
+            flaky = rate > 0 and self._rng.random() < rate
+        if delay > 0:
+            time.sleep(delay)
+        if blackout:
+            self._note("request_blackholed", verb=verb)
+            raise errors.ServiceUnavailable(
+                f"chaos: apiserver blackout ({verb})"
+            )
+        if flaky:
+            self._note("request_errored", verb=verb)
+            raise errors.ServiceUnavailable(
+                f"chaos: injected {verb} failure"
+            )
+
+    # ----------------------------------------------- FakeKube hook: watch
+
+    def mangle(self, watch, event: dict) -> list[dict]:
+        """Called by FakeKube's event fanout per (watch, event): the list
+        to actually enqueue — [] drops, [event] passes, [next, held]
+        is the overtake. Also flushes any held event that has waited
+        past HOLD_FLUSH_S (in order — delay, not overtake)."""
+        out: list[dict] = []
+        overtook = False
+        with self._lock:
+            held = self._held.pop(id(watch), None)
+            if held is not None and \
+                    time.monotonic() - held[0] > HOLD_FLUSH_S:
+                out.append(held[2])     # stale hold: deliver in order
+                held = None
+            etype = event.get("type")
+            if self._drop_rate > 0 and (
+                    self._drop_types is None or etype in self._drop_types
+            ) and self._rng.random() < self._drop_rate:
+                drop = True
+            else:
+                drop = False
+            if not drop:
+                if held is not None:
+                    out += [event, held[2]]    # the overtake
+                    held = None
+                    overtook = True
+                elif self._reorder_rate > 0 and \
+                        self._rng.random() < self._reorder_rate:
+                    self._held[id(watch)] = (time.monotonic(), watch,
+                                             event)
+                    # the flush paths otherwise only run from the event
+                    # fanout: on a quiet cluster no follow-up event ever
+                    # arrives to overtake OR flush this hold, so arm the
+                    # sweep timer — delay, never swallow (the module
+                    # contract)
+                    self._arm_sweep()
+                else:
+                    out.append(event)
+            if held is not None:        # dropped current, still holding
+                self._held[id(watch)] = held
+        if drop:
+            self._note("event_dropped", type=etype)
+        if overtook:
+            # only a true overtake counts — a stale hold flushed ahead of
+            # the current event is an in-order delay, not a reorder
+            self._note("event_reordered", type=etype)
+        return out
+
+    def _arm_sweep(self) -> None:
+        """Start the single outstanding sweep timer (caller holds
+        ``self._lock``); no-op when one is already pending."""
+        if self._sweep_armed:
+            return
+        self._sweep_armed = True
+        timer = threading.Timer(HOLD_FLUSH_S + 0.01, self._timed_sweep)
+        timer.daemon = True
+        timer.start()
+
+    def _timed_sweep(self) -> None:
+        with self._lock:
+            self._sweep_armed = False
+        self.sweep()
+        with self._lock:
+            if self._held:
+                # holds younger than the flush deadline survived the
+                # sweep: keep a timer pending so they flush on time
+                self._arm_sweep()
+
+    def sweep(self) -> None:
+        """Flush held events older than HOLD_FLUSH_S to their channels
+        (called opportunistically from the fanout path)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [k for k, (t, _, _) in self._held.items()
+                     if now - t > HOLD_FLUSH_S]
+            flushes = [self._held.pop(k) for k in stale]
+        for _, w, ev in flushes:
+            if not w.closed:
+                w.q.put(ev)
+
+    def flush_held(self) -> None:
+        with self._lock:
+            flushes = list(self._held.values())
+            self._held.clear()
+        for _, w, ev in flushes:
+            if not w.closed:
+                w.q.put(ev)
+
+
+class ChaosSchedule:
+    """A scripted fault timeline: ``[(at_s, label, action), ...]`` run
+    relative to ``start()`` on a daemon thread. Actions are plain
+    callables (usually bound ChaosInjector methods); a raising action is
+    recorded and the schedule continues — chaos must not need chaos
+    handling. ``wait()`` joins the script; ``stop()`` abandons any
+    steps not yet due."""
+
+    def __init__(self, steps):
+        self.steps = sorted(steps, key=lambda s: s[0])
+        self.executed: list[tuple[float, str]] = []
+        self.errors: list[tuple[str, str]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.started_at: float | None = None
+
+    def start(self) -> "ChaosSchedule":
+        self.started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-schedule", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        t0 = self.started_at
+        for at_s, label, action in self.steps:
+            delay = t0 + at_s - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                action()
+            except Exception as e:  # noqa: BLE001 — journal, don't die
+                self.errors.append((label, repr(e)))
+            self.executed.append((time.monotonic() - t0, label))
+
+    def wait(self, timeout: float | None = None) -> bool:
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def stop(self) -> None:
+        self._stop.set()

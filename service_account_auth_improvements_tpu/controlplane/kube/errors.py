@@ -26,7 +26,7 @@ class ApiError(Exception):
         code = status.get("code", 500)
         msg = status.get("message", "")
         for cls in (NotFound, Conflict, AlreadyExists, BadRequest, Forbidden,
-                    Invalid, Gone):
+                    Invalid, Gone, ServiceUnavailable):
             if cls.code == code and (
                 cls.reason == status.get("reason")
                 or cls in (NotFound, Gone)
@@ -72,6 +72,19 @@ class Gone(ApiError):
     apiserver's signal that a watcher must relist (reason "Expired")."""
     code = 410
     reason = "Expired"
+
+
+class ServiceUnavailable(ApiError):
+    """503: the apiserver is down/overloaded (or chaos is playing it).
+    Retryable by definition — clients back off and re-try, they never
+    treat it as a verdict about the object. ``retry_after`` (seconds)
+    maps to the HTTP Retry-After header on the wire."""
+    code = 503
+    reason = "ServiceUnavailable"
+
+    def __init__(self, message: str = "", retry_after: int | None = 1):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 def is_not_found(e: Exception) -> bool:

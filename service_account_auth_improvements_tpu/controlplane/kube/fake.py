@@ -107,12 +107,40 @@ class FakeKube:
         #: internal fan-out (GC cascade deletes) counts as the requests a
         #: real garbage collector would issue
         self.request_counts: dict[str, int] = {}
+        #: fault injection (kube/chaos.py). None = healthy cluster, and
+        #: the hooks reduce to one attribute check per request/event —
+        #: the bench gate holds the healthy path to its usual numbers
+        self.chaos = None
+        #: auto-compaction: every N emitted events, drop the retained
+        #: watch history (an aggressive etcd compaction). A watcher that
+        #: reconnects from a pre-compaction RV gets 410 Gone and must
+        #: relist — the reflector recovery path, exercisable in tier-1
+        #: without chaos scripting. 0 disables.
+        self.compact_every_n_events = 0
+        self._emits_since_compact = 0
+        #: internal actors (the synchronous GC cascade) are not network
+        #: clients: chaos must not leave half a cascade behind as
+        #: permanent orphans a real garbage collector would retry away
+        self._internal = threading.local()
 
     # ------------------------------------------------------------ helpers
+
+    def enable_chaos(self, seed: int = 0):
+        """Attach (or return) this fake's ChaosInjector."""
+        from service_account_auth_improvements_tpu.controlplane.kube.chaos import (  # noqa: E501  (local import: chaos is optional machinery)
+            ChaosInjector,
+        )
+
+        if self.chaos is None:
+            self.chaos = ChaosInjector(self, seed=seed)
+        return self.chaos
 
     def _count(self, verb: str) -> None:
         with self._lock:
             self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
+        if self.chaos is not None and \
+                not getattr(self._internal, "depth", 0):
+            self.chaos.admit(verb)
 
     def request_counts_snapshot(self) -> dict[str, int]:
         """Copy of the per-verb tally (scenarios diff two snapshots)."""
@@ -148,9 +176,28 @@ class FakeKube:
             dropped = self._history[hkey][:-2048]
             self._pruned[hkey] = dropped[-1][0]
             self._history[hkey] = self._history[hkey][-2048:]
+        if self.compact_every_n_events:
+            self._emits_since_compact += 1
+            if self._emits_since_compact >= self.compact_every_n_events:
+                self._emits_since_compact = 0
+                # compact everything EXCEPT the event being emitted:
+                # connected watchers still receive it via their queues,
+                # but any watcher that has to reconnect from an older RV
+                # is now behind the compaction window → 410 → relist
+                for k, hist in self._history.items():
+                    if hist:
+                        self._pruned[k] = hist[-1][0]
+                        self._history[k] = []
+        chaos = self.chaos
+        if chaos is not None:
+            chaos.sweep()
         for w in self._watches:
             if w.key == hkey and not w.closed:
-                w.q.put(event)
+                if chaos is None:
+                    w.q.put(event)
+                else:
+                    for ev in chaos.mangle(w, event):
+                        w.q.put(ev)
 
     # ---------------------------------------------------------------- CRUD
 
@@ -402,15 +449,24 @@ class FakeKube:
                 if ref.get("uid") == uid:
                     children.append((ckey, cobj))
                     break
-        for ckey, cobj in children:
-            cres = self.registry.by_plural(ckey[1], ckey[0])
-            try:
-                self.delete(
-                    cres.plural, ckey[3],
-                    namespace=ckey[2] or None, group=cres.group,
-                )
-            except errors.ApiError:
-                pass
+        # the cascade is the fake's synchronous garbage collector, not a
+        # network client: chaos (blackouts, error rates) must not abort
+        # it halfway — a real GC retries until the children are gone,
+        # so a one-shot cascade that chaos could interrupt would create
+        # permanent orphans no real cluster would have
+        self._internal.depth = getattr(self._internal, "depth", 0) + 1
+        try:
+            for ckey, cobj in children:
+                cres = self.registry.by_plural(ckey[1], ckey[0])
+                try:
+                    self.delete(
+                        cres.plural, ckey[3],
+                        namespace=ckey[2] or None, group=cres.group,
+                    )
+                except errors.ApiError:
+                    pass
+        finally:
+            self._internal.depth -= 1
 
     # --------------------------------------------------------------- watch
 
@@ -506,7 +562,25 @@ class FakeKube:
                     self._pruned[hkey] = self._history[hkey][-1][0]
                     self._history[hkey] = []
 
+    def _sever_watches(self) -> int:
+        """Connection-reset every live watch (chaos blackout): mark the
+        channels closed and wake any blocked reader with an in-stream
+        ERROR Status so the reset is seen now, not at the next idle
+        timeout. Returns the number of channels severed."""
+        with self._lock:
+            watches = list(self._watches)
+        for w in watches:
+            w.closed = True
+            w.q.put({"type": "ERROR", "object": {
+                "kind": "Status", "code": 503,
+                "reason": "ServiceUnavailable",
+                "message": "chaos: watch connection severed",
+            }})
+        return len(watches)
+
     def _filter_ns(self, ev, res, namespace):
+        if "metadata" not in (ev.get("object") or {}):
+            return ev  # in-stream ERROR Status (severed channel)
         if namespace and res.namespaced:
             if ev["object"]["metadata"].get("namespace") != namespace:
                 # Keep the stream's RV monotonic but never leak the foreign

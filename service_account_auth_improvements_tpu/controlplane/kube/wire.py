@@ -126,9 +126,13 @@ def handle(fake, environ, start_response):
         return [payload]
     except errors.ApiError as e:
         payload = json.dumps(e.to_status()).encode()
-        start_response(
-            f"{e.code} {e.reason}",
-            [("Content-Type", "application/json"),
-             ("Content-Length", str(len(payload)))],
-        )
+        headers = [("Content-Type", "application/json"),
+                   ("Content-Length", str(len(payload)))]
+        # apiserver convention: retryable rejections (503 outages, and
+        # 429 flow control when it lands) carry Retry-After so clients
+        # back off instead of hammering a struggling server
+        retry_after = getattr(e, "retry_after", None)
+        if retry_after is not None:
+            headers.append(("Retry-After", str(int(retry_after))))
+        start_response(f"{e.code} {e.reason}", headers)
         return [payload]

@@ -342,6 +342,24 @@ class SchedulerReconciler(Reconciler):
             # already placed (or a just-adopted legacy workload): make
             # sure the book and the condition agree — the restart
             # recovery path
+            with self._lock:
+                booked = self._assigned.get(key)
+                # the annotation IS the stamp: a booking still marked
+                # unstamped is hereby confirmed landed (left marked, it
+                # would hide from preemption forever). Claiming the
+                # mark is the single ticket for the placement
+                # metric/event — the racing first attempt (or a
+                # _retry_stamp) finds it gone and skips counting.
+                confirm = (booked is not None and booked.pool == pool
+                           and key in self._unstamped)
+                if confirm:
+                    self._unstamped.discard(key)
+            if confirm:
+                self.metrics.placements.labels(pool).inc()
+                self.recorder.event(
+                    nb, "Normal", "Placed",
+                    f"tpusched assigned node pool {pool}",
+                )
             if self._maybe_recover(nb, resolved):
                 self._run_queue()  # recovered chips may block the queue
             self._set_condition(nb, "True", "Placed",
@@ -351,16 +369,27 @@ class SchedulerReconciler(Reconciler):
         # the pool but does NOT skip admission, or one spec field would
         # bypass the quota charge and the whole queue.
         priority = self._priority_for(nb)
+        retry_pool = None
         with self._lock:
             if key in self._assigned:
-                # booked with the annotation stamp still in flight (the
-                # stamp happens lock-free): re-admitting now would
-                # double-book; the stamp's MODIFIED event re-enters the
-                # placed branch
-                return Result()
-            fresh = self._queue.get(key) is None
-            self._queue.add(key[0], req.name, demand_from(resolved),
-                            priority, pinned_pool=resolved.node_pool)
+                if key not in self._unstamped:
+                    # booked and stamped: the annotation just hasn't hit
+                    # this read yet; re-admitting now would double-book
+                    return Result()
+                # booked but the stamp's fate is unknown — either still
+                # in flight on another worker (a duplicate patch below is
+                # idempotent) or its first attempt failed
+                # indeterminately: re-drive the stamp rather than
+                # re-admitting (double-book) or returning (the booking
+                # would sit booked-but-unstamped forever — charged chips,
+                # invisible to preemption)
+                retry_pool = self._assigned[key].pool
+            fresh = retry_pool is None and self._queue.get(key) is None
+            if retry_pool is None:
+                self._queue.add(key[0], req.name, demand_from(resolved),
+                                priority, pinned_pool=resolved.node_pool)
+        if retry_pool is not None:
+            return self._retry_stamp(key, retry_pool)
         if fresh:
             # admission marker: trace stage 1 of the glossary
             # (admission→queue→placement→gang→STS→Ready)
@@ -679,19 +708,108 @@ class SchedulerReconciler(Reconciler):
                 self._unstamped.discard(entry.key)
                 self._assigned.pop(entry.key, None)
             return
+        except errors.ApiError:
+            # apiserver failure mid-stamp — INDETERMINATE: the patch may
+            # have been applied server-side with only the response lost
+            # (LB reset, timeout surfaced as 5xx). Resolve with a live
+            # read: if the annotation landed, the booking must stand
+            # (releasing it would free the pool in inventory while the
+            # authoritative annotation says occupied — a concurrent pass
+            # could double-book it); only a CONFIRMED non-landing
+            # releases and re-admits. When the read fails too the fate
+            # stays unknown — the booking and its _unstamped mark are
+            # KEPT and the requeue re-drives the stamp
+            # (reconcile→_retry_stamp) until the apiserver answers:
+            # releasing on an unresolved verify would double-book the
+            # pool the moment a rival's requests succeed while ours
+            # flake, and holding without a retry path would sit
+            # booked-but-unstamped forever — charged chips, invisible
+            # to preemption.
+            landed = None
+            try:
+                cur = self.kube.get("notebooks", entry.name,
+                                    namespace=entry.namespace, group=GROUP)
+                landed = (cur["metadata"].get("annotations") or {}).get(
+                    tpu.ANNOTATION_NODEPOOL) == pool
+            except errors.NotFound:
+                landed = False  # vanished: confirmed non-landing
+            except errors.ApiError:
+                pass            # outage/flake: fate still unknown
+            with self._lock:
+                if landed is False:
+                    self._unstamped.discard(entry.key)
+                    self._assigned.pop(entry.key, None)
+                # landed True/unknown: booking AND unstamped mark stay —
+                # the requeued reconcile confirms the landed annotation
+                # (placed branch) or re-drives the stamp (_retry_stamp),
+                # and whoever discards the mark counts the placement,
+                # exactly once
+            if self._ctl is not None:
+                self._ctl.queue.add_after(
+                    Request(entry.namespace, entry.name), 0.5
+                )
+            return
         with self._lock:
+            # claiming the unstamped mark is the single ticket for the
+            # placement metric/event: a concurrent _retry_stamp (racing
+            # an in-flight first attempt) may have resolved — and
+            # counted — this placement already
+            claimed = entry.key in self._unstamped
             self._unstamped.discard(entry.key)
-        self.metrics.placements.labels(pool).inc()
-        self.metrics.time_to_placement.observe(
-            time.monotonic() - entry.enqueued
-        )
+        if claimed:
+            self.metrics.placements.labels(pool).inc()
+            self.metrics.time_to_placement.observe(
+                time.monotonic() - entry.enqueued
+            )
         self._set_condition(nb, "True", "Placed",
                             f"assigned to node pool {pool}")
-        self.recorder.event(
-            nb, "Normal", "Placed",
-            f"tpusched assigned node pool {pool} "
-            f"({entry.demand.total_chips} chips)",
-        )
+        if claimed:
+            self.recorder.event(
+                nb, "Normal", "Placed",
+                f"tpusched assigned node pool {pool} "
+                f"({entry.demand.total_chips} chips)",
+            )
+
+    def _retry_stamp(self, key: tuple[str, str], pool: str) -> Result:
+        """Re-drive a placement stamp whose fate is unknown (its first
+        attempt failed indeterminately): the booking holds the pool, so
+        the annotation must land — or the notebook vanish — before the
+        key leaves ``_unstamped``. The patch is idempotent against a
+        stamp that actually landed or is concurrently in flight."""
+        try:
+            nb = self.kube.patch(
+                "notebooks", key[1],
+                {"metadata": {"annotations": {
+                    tpu.ANNOTATION_NODEPOOL: pool,
+                    MANAGED_ANNOTATION: "true",
+                }}}, namespace=key[0] or None, group=GROUP,
+            )
+        except errors.NotFound:
+            self._forget(key)
+            self._run_queue()
+            return Result()
+        except errors.ApiError:
+            # still indeterminate: keep booking + _unstamped, try again
+            if self._ctl is not None:
+                self._ctl.queue.add_after(Request(key[0], key[1]), 0.5)
+            return Result()
+        with self._lock:
+            # same claim ticket as _finish_place: whoever discards the
+            # unstamped mark counts the placement, exactly once
+            claimed = key in self._unstamped
+            self._unstamped.discard(key)
+        if claimed:
+            # surface the placement like the first-try success path
+            # (time_to_placement is skipped: the admission instant
+            # isn't retained on the Assignment, and a fabricated one
+            # would skew the histogram)
+            self.metrics.placements.labels(pool).inc()
+        self._set_condition(nb, "True", "Placed",
+                            f"assigned to node pool {pool}")
+        if claimed:
+            self.recorder.event(nb, "Normal", "Placed",
+                                f"tpusched assigned node pool {pool}")
+        return Result()
 
     @staticmethod
     def _park(entry, reason: str, message: str, nb: dict,
@@ -756,6 +874,18 @@ class SchedulerReconciler(Reconciler):
             )
         except errors.NotFound:
             self._forget(victim.key)
+            return
+        except errors.ApiError:
+            # outage mid-eviction: clear the one-eviction-in-flight
+            # guard, or preemption would be disabled for the rest of the
+            # process (the stop annotation never landed, so no stop
+            # reconcile will ever discard the mark for us)
+            with self._lock:
+                self._evicting.discard(victim.key)
+            if self._ctl is not None:
+                self._ctl.queue.add_after(
+                    Request(entry.namespace, entry.name), 0.5
+                )
             return
         self.metrics.preemptions.inc()
         now = time.monotonic()
@@ -877,3 +1007,14 @@ class SchedulerReconciler(Reconciler):
                 )
         except errors.NotFound:
             pass  # deleted mid-write; the DELETED event cleans up
+        except errors.ApiError:
+            # apiserver outage (chaos blackout): conditions are level
+            # state — re-enqueue so the write re-levels once the server
+            # answers. A raise here would abort the sibling placements/
+            # restamps of the same pass (same rationale as the
+            # conflict-exhaustion branch above).
+            if self._ctl is not None:
+                self._ctl.queue.add_after(
+                    Request(nb["metadata"].get("namespace"),
+                            nb["metadata"]["name"]), 1.0,
+                )

@@ -1,0 +1,552 @@
+"""Chaos layer (kube/chaos.py) + recovery invariants.
+
+Three tiers of coverage:
+
+1. injector semantics — blackouts 503 every verb, per-verb error rates
+   and latency, watch-channel drops/reorders, cascade-GC immunity (an
+   interrupted cascade would fabricate orphans no real cluster has);
+2. the reflector recovery contract — auto-compaction (``compact_every_
+   n_events``) forces 410 Gone on stale reconnects and the informer
+   relists without losing or duplicating events; a DELETED dropped from
+   a live channel is healed by the periodic resync relist;
+3. recovery invariants on the real stack — a blackout mid-flight does
+   not drop a status write, tpusched never double-books across forced
+   relists, /readyz?verbose names the wedged informer.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench import (
+    BenchConfig,
+    FakeKubelet,
+    run_scenario,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Informer,
+    Manager,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    ChaosSchedule,
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Registry,
+)
+
+
+def _wait(pred, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------ injector semantics
+
+def test_blackout_503s_every_verb_then_recovers():
+    kube = FakeKube()
+    chaos = kube.enable_chaos()
+    kube.create("namespaces", {"metadata": {"name": "ns1"}})
+    chaos.start_blackout(0.25, sever=False)
+    for call in (
+        lambda: kube.get("namespaces", "ns1"),
+        lambda: kube.list("pods"),
+        lambda: kube.create("namespaces", {"metadata": {"name": "ns2"}}),
+        lambda: kube.delete("namespaces", "ns1"),
+        lambda: kube.watch("pods"),
+    ):
+        with pytest.raises(errors.ServiceUnavailable):
+            call()
+    time.sleep(0.3)
+    assert kube.get("namespaces", "ns1")["metadata"]["name"] == "ns1"
+    assert chaos.summary()["request_blackholed"] == 5
+
+
+def test_verb_error_rate_and_latency_are_per_verb():
+    kube = FakeKube()
+    chaos = kube.enable_chaos(seed=7)
+    kube.create("namespaces", {"metadata": {"name": "ns1"}})
+    chaos.set_verb_error_rate("get", 1.0)
+    with pytest.raises(errors.ServiceUnavailable):
+        kube.get("namespaces", "ns1")
+    kube.list("namespaces")  # other verbs untouched
+    chaos.set_verb_error_rate("get", 0.0)
+    chaos.set_verb_latency("list", 0.15)
+    t0 = time.monotonic()
+    kube.list("namespaces")
+    assert time.monotonic() - t0 >= 0.14
+    kube.get("namespaces", "ns1")  # latency is per-verb too
+
+
+def test_cascade_gc_is_immune_to_injected_delete_failures():
+    """The fake's synchronous GC cascade is not a network client: chaos
+    on the delete verb must not abort it halfway — that would fabricate
+    permanent orphans a real (retrying) garbage collector never leaves."""
+    kube = FakeKube()
+    chaos = kube.enable_chaos()
+    nb = kube.create("notebooks", {
+        "metadata": {"name": "parent", "namespace": "u1",
+                     "finalizers": ["tpukf.dev/teardown"]},
+    })
+    kube.create("statefulsets", {
+        "metadata": {"name": "child", "namespace": "u1",
+                     "ownerReferences": [{
+                         "kind": "Notebook", "name": "parent",
+                         "uid": nb["metadata"]["uid"],
+                     }]},
+    }, group="apps")
+    kube.delete("notebooks", "parent", namespace="u1")  # pending (finalizer)
+    chaos.set_verb_error_rate("delete", 1.0)
+    # external deletes DO fail...
+    with pytest.raises(errors.ServiceUnavailable):
+        kube.delete("services", "nope", namespace="u1")
+    # ...but finishing the parent's delete (finalizer removal) cascades
+    # through the internal GC regardless
+    cur = kube.get("notebooks", "parent", namespace="u1")
+    cur["metadata"]["finalizers"] = []
+    kube.update("notebooks", cur)
+    with pytest.raises(errors.NotFound):
+        kube.get("statefulsets", "child", namespace="u1", group="apps")
+
+
+def test_watch_reorder_swaps_consecutive_events():
+    kube = FakeKube()
+    chaos = kube.enable_chaos(seed=0)
+    kube.create("configmaps", {"metadata": {"name": "a", "namespace": "x"}})
+    events = kube.watch("configmaps", resource_version=kube._rv)
+    chaos.set_watch_faults(reorder_rate=1.0)
+    kube.patch("configmaps", "a", {"data": {"k": "1"}}, namespace="x")
+    kube.patch("configmaps", "a", {"data": {"k": "2"}}, namespace="x")
+    chaos.set_watch_faults(0.0, 0.0)  # flushes anything still held
+    seen = [next(events), next(events)]
+    rvs = [int(e["object"]["metadata"]["resourceVersion"]) for e in seen]
+    assert rvs == sorted(rvs, reverse=True), (
+        "with reorder_rate=1.0 the second write must overtake the first"
+    )
+    assert chaos.summary()["event_reordered"] >= 1
+
+
+def test_watch_drop_filters_by_type():
+    kube = FakeKube()
+    chaos = kube.enable_chaos(seed=0)
+    kube.create("configmaps", {"metadata": {"name": "a", "namespace": "x"}})
+    events = kube.watch("configmaps", resource_version=kube._rv)
+    chaos.set_watch_faults(drop_rate=1.0, drop_types=("DELETED",))
+    kube.patch("configmaps", "a", {"data": {"k": "1"}}, namespace="x")
+    kube.delete("configmaps", "a", namespace="x")  # dropped
+    kube.create("configmaps", {"metadata": {"name": "b", "namespace": "x"}})
+    chaos.set_watch_faults(0.0, 0.0)
+    seen = [next(events), next(events)]
+    assert [e["type"] for e in seen] == ["MODIFIED", "ADDED"]
+    assert chaos.summary()["event_dropped"] == 1
+
+
+def test_chaos_schedule_runs_steps_and_journals_errors():
+    ran = []
+    sched = ChaosSchedule([
+        (0.0, "first", lambda: ran.append("first")),
+        (0.05, "boom", lambda: 1 / 0),
+        (0.1, "second", lambda: ran.append("second")),
+    ]).start()
+    assert sched.wait(5.0)
+    assert ran == ["first", "second"]
+    assert [label for _, label in sched.executed] == [
+        "first", "boom", "second",
+    ]
+    assert sched.errors and sched.errors[0][0] == "boom"
+
+
+def test_chaos_disabled_is_zero_cost_path():
+    """No injector attached → no chaos branches taken (the healthy-path
+    bench gate depends on this being free)."""
+    kube = FakeKube()
+    assert kube.chaos is None
+    kube.create("namespaces", {"metadata": {"name": "ns1"}})
+    assert kube.get("namespaces", "ns1")
+
+
+# ------------------------------------- reflector recovery (auto-compaction)
+
+def test_stale_watch_after_auto_compaction_gets_410():
+    kube = FakeKube()
+    kube.compact_every_n_events = 3
+    for i in range(5):
+        kube.create("configmaps",
+                    {"metadata": {"name": f"c{i}", "namespace": "x"}})
+    with pytest.raises(errors.Gone):
+        kube.watch("configmaps", resource_version=1)
+
+
+def test_informer_relists_through_compaction_without_loss_or_dup():
+    """The reflector recovery contract, pinned: an informer reconnecting
+    from a pruned RV gets 410, relists, and its handlers converge with
+    exactly one DELETED per vanished key — no loss, no duplicates."""
+    kube = FakeKube()
+    kube.compact_every_n_events = 2   # aggressive: every 2 events
+    chaos = kube.enable_chaos()
+    for name in ("a", "b", "c"):
+        kube.create("configmaps",
+                    {"metadata": {"name": name, "namespace": "x"}})
+    inf = Informer(kube, "configmaps", relist_period=0.1)
+    deleted, lock = [], threading.Lock()
+
+    def handler(ev, obj):
+        if ev == "DELETED":
+            with lock:
+                deleted.append(obj["metadata"]["name"])
+
+    inf.add_handler(handler)
+    inf.start()
+    assert inf.wait_for_sync(5)
+    # cut the stream, then mutate + compact while nobody is watching:
+    # the reconnect RV is now behind the compaction window
+    chaos.sever_watches()
+    kube.delete("configmaps", "b", namespace="x")
+    kube.create("configmaps", {"metadata": {"name": "d", "namespace": "x"}})
+    kube.patch("configmaps", "a", {"data": {"k": "1"}}, namespace="x")
+    assert _wait(lambda: inf.get("x", "d") is not None), \
+        "relist must repopulate the cache"
+    assert _wait(lambda: deleted == ["b"])
+    time.sleep(0.3)  # further resyncs must not re-announce the delete
+    assert deleted == ["b"]
+    cache_names = sorted(o["metadata"]["name"] for o in inf.list())
+    assert cache_names == ["a", "c", "d"]
+    assert (inf.get("x", "a").get("data") or {}).get("k") == "1"
+    inf.stop()
+
+
+def test_dropped_deleted_event_healed_by_periodic_resync():
+    """A DELETED silently dropped from a LIVE stream leaves the cache
+    stale at a current RV — no 410, no replay will ever heal it; only
+    the periodic resync relist does (the engine knob chaos_relist
+    proves out at bench scale)."""
+    kube = FakeKube()
+    chaos = kube.enable_chaos(seed=0)
+    kube.create("configmaps", {"metadata": {"name": "a", "namespace": "x"}})
+    inf = Informer(kube, "configmaps", relist_period=0.2)
+    deleted = []
+    inf.add_handler(
+        lambda ev, obj: deleted.append(obj["metadata"]["name"])
+        if ev == "DELETED" else None
+    )
+    inf.start()
+    assert inf.wait_for_sync(5)
+    chaos.set_watch_faults(drop_rate=1.0, drop_types=("DELETED",))
+    kube.delete("configmaps", "a", namespace="x")
+    # later traffic advances the stream's RV past the dropped event
+    kube.create("configmaps", {"metadata": {"name": "z", "namespace": "x"}})
+    assert _wait(lambda: inf.get("x", "z") is not None)
+    assert inf.get("x", "a") is not None, (
+        "precondition: the drop really left a ghost in the cache"
+    )
+    assert _wait(lambda: deleted == ["a"] and inf.get("x", "a") is None), \
+        "periodic resync must relist away the ghost and say DELETED once"
+    chaos.set_watch_faults(0.0, 0.0)
+    inf.stop()
+
+
+# --------------------------------------- recovery invariants, real stack
+
+def test_blackout_mid_flight_does_not_drop_status_write():
+    """A notebook created just before a total apiserver outage must
+    still converge to Ready: every failed write (children, conflict
+    retries, status) re-levels through backoff once the apiserver
+    answers again."""
+    kube = FakeKube()
+    chaos = kube.enable_chaos()
+    mgr = Manager(kube)
+    NotebookReconciler(kube).register(mgr)
+    kubelet = FakeKubelet(kube, "const:5")
+    mgr.start()
+    kubelet.start()
+    try:
+        kube.create("notebooks", {
+            "metadata": {"name": "nb1", "namespace": "u1"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jax"},
+            ]}}},
+        })
+        chaos.start_blackout(0.8, sever=True)
+
+        def ready():
+            try:
+                nb = kube.get("notebooks", "nb1", namespace="u1",
+                              group=GROUP)
+            except errors.ApiError:
+                return False
+            return ((nb.get("status") or {}).get("readyReplicas")
+                    or 0) >= 1
+
+        assert _wait(ready, timeout=15.0), (
+            "status write lost across the blackout"
+        )
+    finally:
+        kubelet.stop()
+        mgr.stop()
+
+
+def test_scheduler_never_double_books_across_forced_relists():
+    """tpusched under 410 storms: the chaos_relist scenario at unit
+    scale — drains 4 gangs through 2 pools across compaction pulses
+    with zero double bookings and zero orphans."""
+    res = run_scenario("chaos_relist", BenchConfig(
+        n=4, concurrency=4, timeout=20.0, chaos_pulses=2,
+    ))
+    extra = res.summary["extra"]
+    assert extra["double_bookings"] == 0, extra
+    assert extra["orphaned_children"] == 0, extra
+    assert extra["drained"] == 4, extra
+    assert res.ok, res.summary
+
+
+def test_chaos_kubelet_stall_scenario_invariants():
+    res = run_scenario("chaos_kubelet_stall", BenchConfig(
+        n=4, concurrency=4, timeout=20.0, chaos_stall_s=1.0,
+    ))
+    extra = res.summary["extra"]
+    assert extra["false_ready"] == 0, extra
+    assert extra["plane_ready_during_stall"] is True, extra
+    assert extra["recovery_ms"]["unstall_to_ready"]["n"] == 2, extra
+    assert res.ok, res.summary
+
+
+def test_chaos_node_death_scenario_invariants():
+    res = run_scenario("chaos_node_death", BenchConfig(
+        n=2, concurrency=2, timeout=20.0,
+    ))
+    extra = res.summary["extra"]
+    assert extra["observed_down"] is True, extra
+    assert extra["orphaned_children"] == 0, extra
+    assert extra["double_bookings"] == 0, extra
+    assert extra["recovery_ms"]["re_ready"]["n"] >= 1, extra
+    assert res.ok, res.summary
+
+
+def test_stamp_landed_but_response_lost_keeps_booking():
+    """Indeterminate failure on the placement stamp: the PATCH is
+    applied server-side but the response is lost (LB reset / 5xx). The
+    booking must NOT be released — the annotation is the authoritative
+    placement, so freeing the pool in inventory would let a concurrent
+    pass double-book it."""
+    from service_account_auth_improvements_tpu.controlplane import tpu
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+    from service_account_auth_improvements_tpu.controlplane.scheduler import (
+        SchedulerReconciler,
+    )
+
+    kube = FakeKube()
+    for h in range(4):
+        kube.create("nodes", {
+            "metadata": {"name": f"node-lone-{h}", "labels": {
+                tpu.SEL_NODEPOOL: "lone-pool",
+                tpu.SEL_ACCELERATOR: "tpu-v5-lite-podslice",
+                tpu.SEL_TOPOLOGY: "4x4",
+            }},
+            "status": {"capacity": {tpu.RESOURCE_TPU: "4"}},
+        })
+    rec = SchedulerReconciler(kube)
+
+    real_patch = kube.patch
+    lost = {"fired": False}
+
+    def lossy_patch(plural, name, body, **kw):
+        result = real_patch(plural, name, body, **kw)
+        if (not lost["fired"] and plural == "notebooks"
+                and tpu.ANNOTATION_NODEPOOL in (
+                    (body.get("metadata") or {}).get("annotations") or {})):
+            lost["fired"] = True          # applied — but the reply dies
+            raise errors.ServiceUnavailable("response lost after apply")
+        return result
+
+    kube.patch = lossy_patch
+
+    def nb(name):
+        return {
+            "metadata": {"name": name, "namespace": "u1"},
+            "spec": {"tpu": {"generation": "v5e", "topology": "4x4"},
+                     "template": {"spec": {"containers": [
+                         {"name": "notebook", "image": "jax"}]}}},
+        }
+
+    def pool_of(name):
+        obj = kube.get("notebooks", name, namespace="u1", group=GROUP)
+        return (obj["metadata"].get("annotations") or {}).get(
+            tpu.ANNOTATION_NODEPOOL)
+
+    kube.create("notebooks", nb("first"))
+    rec.reconcile(Request("u1", "first"))
+    assert lost["fired"] and pool_of("first") == "lone-pool"
+    # a rival admitted while the stamp's fate was unknown must NOT be
+    # placed onto the (actually occupied) pool
+    kube.create("notebooks", nb("rival"))
+    rec.reconcile(Request("u1", "rival"))
+    assert pool_of("rival") is None, "double-booked the lone pool"
+    # the requeued reconcile re-levels the landed placement cleanly
+    rec.reconcile(Request("u1", "first"))
+    assert pool_of("first") == "lone-pool"
+
+
+def test_stamp_unresolved_verify_keeps_booking_and_retries():
+    """Worse than a lost response: the PATCH lands server-side, the
+    reply dies, and the confirming GET fails too (flaky apiserver, not
+    a total outage). The fate is UNKNOWN — the booking must be kept
+    (releasing would let a rival whose requests succeed double-book the
+    occupied pool) and the requeued reconcile must re-drive the stamp
+    instead of re-admitting or wedging booked-but-unstamped."""
+    from service_account_auth_improvements_tpu.controlplane import tpu
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+    from service_account_auth_improvements_tpu.controlplane.scheduler import (
+        SchedulerReconciler,
+    )
+
+    kube = FakeKube()
+    for h in range(4):
+        kube.create("nodes", {
+            "metadata": {"name": f"node-solo-{h}", "labels": {
+                tpu.SEL_NODEPOOL: "solo-pool",
+                tpu.SEL_ACCELERATOR: "tpu-v5-lite-podslice",
+                tpu.SEL_TOPOLOGY: "4x4",
+            }},
+            "status": {"capacity": {tpu.RESOURCE_TPU: "4"}},
+        })
+    rec = SchedulerReconciler(kube)
+
+    real_patch, real_get = kube.patch, kube.get
+    flaky = {"patch": False, "get": False}
+
+    def lossy_patch(plural, name, body, **kw):
+        result = real_patch(plural, name, body, **kw)
+        if (not flaky["patch"] and plural == "notebooks"
+                and tpu.ANNOTATION_NODEPOOL in (
+                    (body.get("metadata") or {}).get("annotations") or {})):
+            flaky["patch"] = True         # applied — but the reply dies
+            raise errors.ServiceUnavailable("response lost after apply")
+        return result
+
+    def flaky_get(plural, name, **kw):
+        if plural == "notebooks" and flaky["patch"] and not flaky["get"]:
+            flaky["get"] = True           # the verify read flakes too
+            raise errors.ServiceUnavailable("flaky get")
+        return real_get(plural, name, **kw)
+
+    kube.patch, kube.get = lossy_patch, flaky_get
+
+    def nb(name):
+        return {
+            "metadata": {"name": name, "namespace": "u1"},
+            "spec": {"tpu": {"generation": "v5e", "topology": "4x4"},
+                     "template": {"spec": {"containers": [
+                         {"name": "notebook", "image": "jax"}]}}},
+        }
+
+    def pool_of(name):
+        obj = real_get("notebooks", name, namespace="u1", group=GROUP)
+        return (obj["metadata"].get("annotations") or {}).get(
+            tpu.ANNOTATION_NODEPOOL)
+
+    kube.create("notebooks", nb("first"))
+    rec.reconcile(Request("u1", "first"))
+    assert flaky["patch"] and flaky["get"]
+    # fate unknown: the booking (and its unstamped mark) must survive
+    assert ("u1", "first") in rec._assigned
+    assert ("u1", "first") in rec._unstamped
+    # a rival must not book the pool whose stamp is unresolved
+    kube.create("notebooks", nb("rival"))
+    rec.reconcile(Request("u1", "rival"))
+    assert pool_of("rival") is None, "double-booked the solo pool"
+    # the requeued reconcile re-drives the stamp (idempotent against
+    # the landed annotation) and resolves the unstamped mark
+    rec.reconcile(Request("u1", "first"))
+    assert pool_of("first") == "solo-pool"
+    assert ("u1", "first") not in rec._unstamped
+
+
+# ------------------------------------------------------- /readyz?verbose
+
+def test_informer_status_reports_outage_diagnostics():
+    class DownKube:
+        def list(self, *a, **kw):
+            raise errors.ServiceUnavailable("down")
+
+        def watch(self, *a, **kw):
+            raise errors.ServiceUnavailable("down")
+
+    inf = Informer(DownKube(), "notebooks", group=GROUP)
+    inf.start()
+    assert _wait(lambda: inf.status()["consecutive_failures"] >= 1)
+    st = inf.status()
+    assert st["synced"] is False
+    assert "ServiceUnavailable" in st["last_error"]
+    assert st["last_relist_age_s"] is None
+    inf.stop()
+
+
+def test_readyz_verbose_names_the_wedged_informer():
+    kube = FakeKube()
+    mgr = Manager(kube)
+    NotebookReconciler(kube).register(mgr)
+    mgr.start()
+    server = serve_ops(0, host="127.0.0.1", registry=Registry(),
+                       ready_check=mgr.informers_synced,
+                       ready_detail=mgr.informer_status)
+    port = server.server_address[1]
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz?verbose",
+                timeout=5) as resp:
+            assert resp.status == 200
+            body = json.loads(resp.read())
+        assert body["ready"] is True
+        nb_key = f"notebooks.{GROUP}"
+        assert nb_key in body["informers"], body
+        st = body["informers"][nb_key]
+        assert st["synced"] is True
+        assert st["consecutive_failures"] == 0
+        assert st["last_relist_age_s"] is not None
+        # plain probe still answers the terse body
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/readyz", timeout=5) as resp:
+            assert resp.read() == b"ok"
+    finally:
+        server.shutdown()
+        server.server_close()
+        mgr.stop()
+
+
+def test_wire_503_carries_retry_after():
+    kube = FakeKube()
+    kube.enable_chaos().start_blackout(5.0, sever=False)
+    captured = {}
+
+    def start_response(status, headers):
+        captured["status"] = status
+        captured["headers"] = dict(headers)
+
+    body = b"".join(kube.wsgi_app({
+        "REQUEST_METHOD": "GET",
+        "PATH_INFO": "/api/v1/pods",
+        "QUERY_STRING": "",
+    }, start_response))
+    assert captured["status"].startswith("503")
+    assert captured["headers"]["Retry-After"] == "1"
+    status = json.loads(body)
+    assert status["reason"] == "ServiceUnavailable"

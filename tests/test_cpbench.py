@@ -225,11 +225,8 @@ def test_loadgen_patterns():
         LoadGenerator(pattern="poisson")
 
 
-def test_bench_gate():
-    """tools/bench_gate.py: latency legs trip on >tolerance regressions,
-    hit-rate leg trips on missing OR sub-floor rates (a CachedClient
-    silently falling back to live reads reports hit_rate 0.0, not None
-    — the gate must catch both)."""
+def _load_bench_gate():
+    """tools/ is not a package: load bench_gate.py by path."""
     import importlib.util
     import pathlib
 
@@ -240,6 +237,15 @@ def test_bench_gate():
     )
     bg = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(bg)
+    return bg
+
+
+def test_bench_gate():
+    """tools/bench_gate.py: latency legs trip on >tolerance regressions,
+    hit-rate leg trips on missing OR sub-floor rates (a CachedClient
+    silently falling back to live reads reports hit_rate 0.0, not None
+    — the gate must catch both)."""
+    bg = _load_bench_gate()
 
     def record(churn_p50=1000.0, nb_p95=2000.0, hit_rate=1.0,
                reads_per_reconcile=0.5):
@@ -282,3 +288,55 @@ def test_bench_gate():
     run = record()
     del run["scenarios"]["churn"]["phases_ms"]["controller_overhead"]
     assert any("missing from run" in f for f in bg.gate(base, run, 1.2))
+
+
+def test_bench_gate_chaos_legs():
+    """chaos_gate: per-scenario invariant legs (double bookings,
+    orphans, recorded violations, recovery-time evidence) plus the
+    --chaos-only all-four-present requirement."""
+    bg = _load_bench_gate()
+
+    def chaos_record(db=0, orphans=0, violations=None, recovery=True):
+        extra = {
+            "double_bookings": db,
+            "orphaned_children": orphans,
+            "invariant_violations": violations or {},
+            "recovery_ms": (
+                {"all": {"p50": 120.0, "p95": 340.0}} if recovery else {}
+            ),
+        }
+        return {"scenarios": {
+            name: {"extra": dict(extra)} for name in bg.CHAOS_SCENARIOS
+        }}
+
+    assert bg.chaos_gate(chaos_record(), require_all=True) == []
+    # each invariant leg trips on every scenario carrying the defect
+    fails = bg.chaos_gate(chaos_record(db=1), require_all=True)
+    assert len(fails) == 4 and all("double_bookings" in f for f in fails)
+    fails = bg.chaos_gate(chaos_record(orphans=2), require_all=True)
+    assert len(fails) == 4 and all("orphaned_children" in f
+                                   for f in fails)
+    fails = bg.chaos_gate(
+        chaos_record(violations={"false_ready": 1}), require_all=True)
+    assert len(fails) == 4 and all("violations" in f for f in fails)
+    fails = bg.chaos_gate(chaos_record(recovery=False), require_all=True)
+    assert len(fails) == 4 and all("recovery_ms" in f for f in fails)
+    # an absent scenario only fails the dedicated chaos lane
+    partial = chaos_record()
+    del partial["scenarios"]["chaos_node_death"]
+    assert bg.chaos_gate(partial, require_all=False) == []
+    fails = bg.chaos_gate(partial, require_all=True)
+    assert len(fails) == 1 and "chaos_node_death" in fails[0]
+    # a healthy-only run sails through the opportunistic mode
+    assert bg.chaos_gate({"scenarios": {}}, require_all=False) == []
+    # a FUTURE chaos_* scenario riding in a run is gated by name, not by
+    # membership in the hard-coded tuple — new family members must not
+    # slip through un-gated
+    extended = chaos_record()
+    extended["scenarios"]["chaos_custom"] = {
+        "extra": {"double_bookings": 1, "orphaned_children": 0,
+                  "invariant_violations": {},
+                  "recovery_ms": {"all": {"p50": 1.0, "p95": 2.0}}},
+    }
+    fails = bg.chaos_gate(extended, require_all=True)
+    assert len(fails) == 1 and "chaos_custom" in fails[0]

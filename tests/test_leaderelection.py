@@ -114,6 +114,81 @@ def test_acquire_blocks_until_lease_free(kube):
     b.release()
 
 
+def test_behind_skew_within_tolerance_keeps_lease(kube):
+    """A healthy holder whose clock trails the judging candidate's must
+    not be deposed: its renewTime looks (skew) seconds stale, and
+    without the bounded tolerance the rival would take over — then the
+    holder, seeing a live rival, would self-evict."""
+    from service_account_auth_improvements_tpu.controlplane.kube.chaos import (  # noqa: E501
+        skewed_clock,
+    )
+
+    a = elector(kube, "a", lease_duration=0.5,
+                now_fn=skewed_clock(-0.55))   # writes 0.55 s in the past
+    assert a._try_acquire()
+    b = elector(kube, "b", lease_duration=0.5, skew_tolerance=0.2)
+    # age 0.55 > duration 0.5 but ≤ duration+tolerance 0.7 → still held
+    assert not b._try_acquire()
+    # beyond the bound the holder is genuinely expired-looking: takeover
+    c = elector(kube, "c", lease_duration=0.5, skew_tolerance=0.01)
+    assert c._try_acquire()
+
+
+def test_far_future_renew_time_is_a_broken_clock_not_a_hold(kube):
+    """A crashed holder that wrote a far-future renewTime (clock way
+    ahead) must not keep the lease forever: past the same skew bound,
+    future-dated is expired too."""
+    from service_account_auth_improvements_tpu.controlplane.kube.chaos import (  # noqa: E501
+        skewed_clock,
+    )
+
+    a = elector(kube, "a", lease_duration=0.5,
+                now_fn=skewed_clock(+30.0))
+    assert a._try_acquire()   # renewTime ~30 s in the future
+    b = elector(kube, "b", lease_duration=0.5, skew_tolerance=0.2)
+    assert b._try_acquire(), (
+        "a renewTime beyond duration+tolerance in the future must read "
+        "as expired, or a crashed fast-clock holder wedges the lease"
+    )
+
+
+def test_deposed_holder_fires_on_lost(kube):
+    """The renew loop's deposal path (the branch behind the default
+    ``_die``): a rival holds a LIVE lease — the old holder must fire
+    on_lost instead of carrying on as a zombie leader."""
+    import datetime
+
+    from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+        _fmt,
+    )
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        errors,
+    )
+
+    lost = threading.Event()
+    a = elector(kube, "a", lease_duration=0.4, on_lost=lost.set)
+    a.acquire()
+    assert a.is_leader
+    # a rival steals the lease with a fresh renewTime (a's optimistic-
+    # concurrency renew may race the write — retry on Conflict)
+    for _ in range(50):
+        lease = kube.get("leases", "test-controller",
+                         namespace="kubeflow", group=LEASE_GROUP)
+        lease["spec"]["holderIdentity"] = "b"
+        lease["spec"]["renewTime"] = _fmt(
+            datetime.datetime.now(datetime.timezone.utc)
+        )
+        try:
+            kube.update("leases", lease, namespace="kubeflow",
+                        group=LEASE_GROUP)
+            break
+        except errors.Conflict:
+            continue
+    assert lost.wait(5.0), "deposed holder must fire on_lost"
+    assert not a.is_leader
+    a.release()
+
+
 def test_forbidden_is_fatal_misconfiguration(kube):
     # missing coordination.k8s.io/leases RBAC must surface loudly, not
     # retry forever as a never-Ready standby

@@ -14,18 +14,28 @@ Compares a fresh cpbench run against the committed record and fails on:
   smoke-vs-full latency headroom),
 - ``apiserver_reads_per_reconcile`` missing or above its ceiling — the
   apiserver-side counter a controller-only regression cannot hide from
-  behind the bench's own (cache-served) poll traffic.
+  behind the bench's own (cache-served) poll traffic,
+- chaos invariant legs, for every chaos scenario present in the run:
+  ``double_bookings > 0``, ``orphaned_children > 0``, any
+  ``invariant_violations``, or missing recovery-time p50/p95 fields —
+  surviving the injection without evidence of recovery doesn't count.
 
 CI runs the smoke lane against the committed ``--full`` record: smoke is
 smaller and faster, so the latency comparison only trips on gross
 regressions (a hot loop, a lost cache, a serialized queue) — exactly the
 failures a PR lane can catch deterministically on a shared runner. The
-record itself is refreshed by a manual ``--full`` run (BASELINE.md).
+record itself is refreshed by a manual ``--full --chaos`` run
+(BASELINE.md).
 
 Exit 0 = within tolerance.  Usage:
 
     python tools/bench_gate.py --baseline CONTROLPLANE_BENCH.json \
         --run bench_out.json [--tolerance 1.2]
+
+    # chaos lane: only the invariant legs, and all four scenarios
+    # must be present in the run
+    python tools/bench_gate.py --baseline CONTROLPLANE_BENCH.json \
+        --run chaos_out.json --chaos-only
 """
 
 from __future__ import annotations
@@ -53,6 +63,63 @@ MIN_HIT_RATE = 0.9
 #: and immune to that — measured ≤1.06 cached (smoke and full), 3.5-7.7
 #: with ENGINE_CACHED_READS=0
 READS_PER_RECONCILE_MAX = 2.0
+#: the chaos family (cpbench/chaos.py): every member present in a run
+#: gets the invariant legs; --chaos-only additionally requires all four
+CHAOS_SCENARIOS = ("chaos_relist", "chaos_blackout", "chaos_node_death",
+                   "chaos_kubelet_stall")
+
+
+def chaos_scenarios_in(run: dict) -> list[str]:
+    """Chaos scenarios to gate: the canonical family plus ANY
+    ``chaos_*``-named scenario the run contains — a new member of the
+    family must not ride along un-gated just because this tuple wasn't
+    updated."""
+    present = {n for n in run.get("scenarios", {}) if
+               n.startswith("chaos_")}
+    return sorted(set(CHAOS_SCENARIOS) | present)
+
+
+def chaos_gate(run: dict, require_all: bool = False) -> list[str]:
+    """Invariant legs over whichever chaos scenarios the run contains
+    (the canonical four required when ``require_all``): zero double
+    bookings, zero orphaned children, zero recorded invariant
+    violations, and recovery-time p50/p95 actually present — a chaos
+    run that can't show WHEN it recovered hasn't shown THAT it
+    recovered."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    for name in chaos_scenarios_in(run):
+        s = scenarios.get(name)
+        if s is None:
+            if require_all:
+                failures.append(f"{name}: missing from chaos run")
+            continue
+        extra = s.get("extra") or {}
+        db = extra.get("double_bookings")
+        if db is None or db > 0:
+            failures.append(
+                f"{name}: double_bookings={db} (must be reported and 0)"
+            )
+        orphans = extra.get("orphaned_children")
+        if orphans is None or orphans > 0:
+            failures.append(
+                f"{name}: orphaned_children={orphans} "
+                "(must be reported and 0)"
+            )
+        violations = extra.get("invariant_violations")
+        if violations is None:
+            failures.append(f"{name}: invariant_violations not reported")
+        elif any(violations.values()):
+            failures.append(
+                f"{name}: invariant violations {violations}"
+            )
+        recovery = (extra.get("recovery_ms") or {}).get("all") or {}
+        if "p50" not in recovery or "p95" not in recovery:
+            failures.append(
+                f"{name}: recovery_ms p50/p95 missing — no evidence the "
+                "plane recovered from the injection"
+            )
+    return failures
 
 
 def gate(baseline: dict, run: dict, tolerance: float,
@@ -109,29 +176,52 @@ def gate(baseline: dict, run: dict, tolerance: float,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--baseline", required=True,
-                    help="committed CONTROLPLANE_BENCH.json")
+    ap.add_argument("--baseline",
+                    help="committed CONTROLPLANE_BENCH.json (unused — "
+                         "and optional — with --chaos-only: the chaos "
+                         "legs are invariants, not comparisons)")
     ap.add_argument("--run", required=True, help="fresh cpbench output")
     ap.add_argument("--tolerance", type=float, default=1.2,
                     help="allowed ratio vs baseline (default 1.2 = +20%%)")
     ap.add_argument("--min-hit-rate", type=float, default=MIN_HIT_RATE,
                     help="cached-read hit-rate floor "
                          f"(default {MIN_HIT_RATE})")
+    ap.add_argument("--chaos-only", action="store_true",
+                    help="check only the chaos invariant legs and "
+                         "require all four chaos scenarios in the run "
+                         "(the CI chaos smoke step)")
     args = ap.parse_args(argv)
-    with open(args.baseline) as f:
-        baseline = json.load(f)
     with open(args.run) as f:
         run = json.load(f)
-    failures = gate(baseline, run, args.tolerance, args.min_hit_rate)
+    if args.chaos_only:
+        failures = chaos_gate(run, require_all=True)
+    else:
+        if not args.baseline:
+            ap.error("--baseline is required unless --chaos-only")
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        failures = gate(baseline, run, args.tolerance, args.min_hit_rate)
+        # chaos scenarios riding along in a mixed run (--chaos) get
+        # their invariant legs too
+        failures += chaos_gate(run, require_all=False)
     for f in failures:
         print(f"bench_gate FAIL: {f}", file=sys.stderr)
     if not failures:
-        for scenario, phase, pct in GATES:
-            base = baseline["scenarios"][scenario]["phases_ms"][phase][pct]
-            got = run["scenarios"][scenario]["phases_ms"][phase][pct]
-            print(f"bench_gate ok: {scenario}.{phase}.{pct} "
-                  f"{got:.1f} ms vs baseline {base:.1f} ms",
-                  file=sys.stderr)
+        if args.chaos_only:
+            for name in chaos_scenarios_in(run):
+                rec = (run["scenarios"][name]["extra"]["recovery_ms"]
+                       ["all"])
+                print(f"bench_gate ok: {name} recovery p50/p95 "
+                      f"{rec['p50']:.0f}/{rec['p95']:.0f} ms, "
+                      "invariants clean", file=sys.stderr)
+        else:
+            for scenario, phase, pct in GATES:
+                base = baseline["scenarios"][scenario]["phases_ms"][
+                    phase][pct]
+                got = run["scenarios"][scenario]["phases_ms"][phase][pct]
+                print(f"bench_gate ok: {scenario}.{phase}.{pct} "
+                      f"{got:.1f} ms vs baseline {base:.1f} ms",
+                      file=sys.stderr)
     return 1 if failures else 0
 
 
