@@ -1,10 +1,15 @@
 # Top-level targets (the reference drives everything through per-component
 # Makefiles; this is the one-stop equivalent).
 
-.PHONY: test native manifests workflows images bench-cpu
+.PHONY: test lint native manifests workflows images bench-cpu
 
 test: native
 	python -m pytest tests/ -x -q
+
+# cplint: the six control-plane invariant passes (docs/cplint.md);
+# exits nonzero on any unsuppressed finding
+lint:
+	python -m tools.cplint
 
 native:
 	$(MAKE) -C native

@@ -113,7 +113,11 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              {"name": "Build native components", "run": "make -C native"},
              {"name": "Run tests",
               "run": "python -m pytest tests/ -x -q"}],
-            env=PY_TEST_ENV,
+            # CPLINT_LOCKWATCH: tests/conftest.py instruments every
+            # controlplane Lock/RLock/Condition (tools/cplint/lockwatch)
+            # and fails the session on lock-order cycles or held-lock
+            # apiserver writes observed anywhere in the tier-1 run
+            env={**PY_TEST_ENV, "CPLINT_LOCKWATCH": "1"},
         ),
          # the reference runs its Angular specs in a dedicated lane
          # (jwa_frontend_tests.yaml:33-50); same tier here with the
@@ -196,12 +200,26 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
         "Control Plane Bench Smoke",
         ["service_account_auth_improvements_tpu/controlplane/**",
          "service_account_auth_improvements_tpu/webhook/**",
+         "manifests/controllers/**",
          "tests/test_cpbench.py", "tools/metrics_lint.py",
-         "tools/bench_gate.py"],
+         "tools/cplint/**", "tools/bench_gate.py"],
         {"cpbench": job([
             CHECKOUT, SETUP_PY,
-            {"name": "Metrics lint",
-             "run": "python tools/metrics_lint.py"},
+            # cplint needs pyyaml for the rbac-check manifest diff;
+            # everything else in this job is stdlib-only
+            {"name": "Install lint dependencies",
+             "run": "pip install pyyaml"},
+            # the six invariant passes (lock-discipline, cache-mutation,
+            # queue-span, rbac-check, clock-injection, metrics — the
+            # last subsuming the old metrics_lint) fail the job on any
+            # unsuppressed finding; the JSON report is uploaded
+            # if: always() below so a red run carries its evidence
+            {"name": "Control-plane invariant lint (cplint)",
+             "run": "python -m tools.cplint --json cplint_report.json"},
+            {"name": "Lint report gate",
+             "if": "always()",
+             "run": "python tools/bench_gate.py "
+                    "--lint-report cplint_report.json"},
             # the fresh run goes to bench_out.json so the committed
             # CONTROLPLANE_BENCH.json stays available as the gate baseline
             {"name": "Run cpbench --smoke",
@@ -255,7 +273,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "if": "always()",
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
-                      "path": "bench_out.json\nchaos_out.json"}},
+                      "path": "bench_out.json\nchaos_out.json\n"
+                              "cplint_report.json"}},
         ])},
     ),
     "images_multi_arch_test.yaml": workflow(

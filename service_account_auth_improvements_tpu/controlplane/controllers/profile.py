@@ -67,7 +67,11 @@ class WorkloadIdentityPlugin:
         if not gsa:
             return
         try:
-            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            # cplint cache-mutation: mutate an owned copy, never the read
+            # result (docs/engine.md "Read semantics")
+            sa = copy.deepcopy(
+                kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            )
         except errors.NotFound:
             return
         annots = sa["metadata"].setdefault("annotations", {})
@@ -123,7 +127,11 @@ class AwsIamForServiceAccountPlugin:
                 "AwsIamForServiceAccount plugin requires awsIamRole"
             )
         try:
-            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            # cplint cache-mutation: mutate an owned copy, never the read
+            # result (docs/engine.md "Read semantics")
+            sa = copy.deepcopy(
+                kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            )
         except errors.NotFound:
             return  # SAs not reconciled yet; the next pass re-applies
         annots = sa["metadata"].setdefault("annotations", {})
@@ -137,7 +145,11 @@ class AwsIamForServiceAccountPlugin:
         ns = profile["metadata"]["name"]
         role = spec.get("awsIamRole", "")
         try:
-            sa = kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            # cplint cache-mutation: mutate an owned copy, never the read
+            # result (docs/engine.md "Read semantics")
+            sa = copy.deepcopy(
+                kube.get("serviceaccounts", EDIT_SA, namespace=ns)
+            )
         except errors.NotFound:
             sa = None
         if sa is not None:
@@ -439,8 +451,12 @@ class ProfileReconciler(Reconciler):
         }, {"type": "Ready", "status": "False"})
 
     def _set_condition(self, profile, cond, *extra):
-        cur = self.kube.get("profiles", profile["metadata"]["name"],
-                            group=GROUP)
+        # cplint cache-mutation: conditions are folded into an owned copy
+        # of the read result (docs/engine.md "Read semantics")
+        cur = copy.deepcopy(
+            self.kube.get("profiles", profile["metadata"]["name"],
+                          group=GROUP)
+        )
         before = copy.deepcopy(cur.get("status"))
         helpers.set_condition(cur, cond)
         for c in extra:

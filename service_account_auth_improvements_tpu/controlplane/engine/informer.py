@@ -98,17 +98,22 @@ class Informer:
     def status(self) -> dict:
         """Diagnostic snapshot for /readyz?verbose: sync state, outage
         counters, and relist staleness — enough to tell a wedged watch
-        from a healthy-but-quiet one."""
-        last = self._last_relist
-        return {
-            "synced": self._synced.is_set(),
-            "consecutive_failures": self.consecutive_failures,
-            "last_relist_age_s": (round(time.monotonic() - last, 3)
-                                  if last is not None else None),
-            "last_error": self._last_error,
-            "resource_version": self.last_resource_version(),
-            "cached_objects": len(self._cache),
-        }
+        from a healthy-but-quiet one. Taken under the cache lock so the
+        snapshot is coherent with _relist's healed state — a lock-free
+        read could pair a stale error with synced=True and name the
+        wrong wedge (the reader half of the cplint lock-discipline
+        fix)."""
+        with self._lock:
+            last = self._last_relist
+            return {
+                "synced": self._synced.is_set(),
+                "consecutive_failures": self.consecutive_failures,
+                "last_relist_age_s": (round(time.monotonic() - last, 3)
+                                      if last is not None else None),
+                "last_error": self._last_error,
+                "resource_version": self._last_rv,
+                "cached_objects": len(self._cache),
+            }
 
     @property
     def last_relist_monotonic(self) -> float | None:
@@ -388,7 +393,11 @@ class Informer:
                 if self._stop.is_set():
                     return
                 self.consecutive_failures += 1
-                self._last_error = repr(e)
+                # under the cache lock: _relist (same thread) clears it
+                # inside the lock, and status() renders it from another —
+                # a torn read would name the wrong error in /readyz
+                with self._lock:
+                    self._last_error = repr(e)
                 log.exception("informer %s list/watch failed; retrying",
                               self.plural)
                 if self.consecutive_failures >= 3:

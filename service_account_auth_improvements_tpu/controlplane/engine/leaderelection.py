@@ -70,6 +70,7 @@ class LeaderElector:
                  retry_period: float = 2.0,
                  on_lost=None,
                  now_fn=None,
+                 mono_fn=None,
                  skew_tolerance: float | None = None):
         self.kube = kube
         self.lease_name = lease_name
@@ -82,6 +83,11 @@ class LeaderElector:
         #: this candidate's wall clock (injection point for skew tests /
         #: chaos); every timestamp written or judged goes through it
         self._now = now_fn if now_fn is not None else _now
+        #: the renew-deadline clock. Injectable for the same reason as
+        #: ``now_fn`` (cplint clock-injection): the "have I failed to
+        #: renew for a whole lease_duration?" self-eviction must be
+        #: drivable from a chaos scenario's clock, not the host's
+        self._mono = mono_fn if mono_fn is not None else time.monotonic
         #: bounded clock-skew grace when judging ANOTHER holder's lease;
         #: None → 25% of the lease's own advertised duration
         self.skew_tolerance = skew_tolerance
@@ -248,11 +254,11 @@ class LeaderElector:
             return False  # somebody else won the race; retry
 
     def _renew_loop(self) -> None:
-        deadline = time.monotonic() + self.lease_duration
+        deadline = self._mono() + self.lease_duration
         while not self._stop.wait(self.renew_period):
             try:
                 if self._try_acquire():
-                    deadline = time.monotonic() + self.lease_duration
+                    deadline = self._mono() + self.lease_duration
                     continue
                 # _try_acquire returning False may be a transient
                 # Conflict (e.g. racing our own release()); only depose
@@ -262,7 +268,7 @@ class LeaderElector:
                 lease = self._get()
                 holder = self._holder(lease) if lease else None
                 if holder == self.identity:
-                    deadline = time.monotonic() + self.lease_duration
+                    deadline = self._mono() + self.lease_duration
                     continue
                 if holder and not self._expired(lease):
                     log.error("leader election: lease %s taken by %s",
@@ -274,7 +280,7 @@ class LeaderElector:
                 log.warning("leader election: renew failed: %s", e)
             if self._stop.is_set():
                 return
-            if time.monotonic() > deadline:
+            if self._mono() > deadline:
                 self.is_leader = False
                 self.on_lost()
                 return

@@ -657,8 +657,15 @@ class SchedulerReconciler(Reconciler):
         for nb, reason, message, pos, total in restamp:
             self._set_condition(nb, "False", reason, message,
                                 position=pos, total=total)
-        self._seen_classes |= set(depth)
-        for cls in self._seen_classes:
+        # fold + snapshot under the lock: this runs after the pass body
+        # released it, so two workers can be here at once — iterating the
+        # live set while a sibling grows it is a "set changed size
+        # during iteration" crash (lockwatch-era hardening; the gauge
+        # itself tolerates a stale snapshot, the iteration does not)
+        with self._lock:
+            self._seen_classes |= set(depth)
+            seen = set(self._seen_classes)
+        for cls in seen:
             self.metrics.queue_depth.labels(cls).set(depth.get(cls, 0))
         return bool(placed and self.enable_preemption
                     and len(self._queue))

@@ -11,6 +11,8 @@ set here still takes effect. Export SATPU_TEST_TPU=1 to run on real TPU.
 """
 
 import os
+import pathlib
+import sys
 
 if not os.environ.get("SATPU_TEST_TPU"):
     flags = os.environ.get("XLA_FLAGS", "")
@@ -21,3 +23,37 @@ if not os.environ.get("SATPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# CPLINT_LOCKWATCH=1 (the tier-1 CI lane sets it — ci/workflows.py):
+# instrument every controlplane-created Lock/RLock/Condition with
+# tools/cplint/lockwatch, recording the per-thread acquisition graph for
+# the whole test session. pytest_sessionfinish below fails the run on
+# any recorded lock-order cycle or held-lock apiserver write. Installed
+# here — after jax (whose import must see the raw primitives it was
+# built against) and before any test imports controlplane modules, so
+# module-level singletons (obs.TRACER, metrics.REGISTRY) get watched
+# locks too.
+_LOCKWATCH = None
+if os.environ.get("CPLINT_LOCKWATCH"):
+    _repo = pathlib.Path(__file__).resolve().parent.parent
+    if str(_repo) not in sys.path:
+        sys.path.insert(0, str(_repo))
+    from tools.cplint import lockwatch as _lockwatch_mod
+
+    _LOCKWATCH = _lockwatch_mod.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCKWATCH is None:
+        return
+    problems = _LOCKWATCH.violations + _LOCKWATCH.api_violations
+    if problems:
+        print("\n" + _LOCKWATCH.report(), file=sys.stderr)
+        print(f"lockwatch: {len(problems)} violation(s) recorded over "
+              "the session — failing the run", file=sys.stderr)
+        session.exitstatus = 3
+    elif _LOCKWATCH.self_edges:
+        # design smell, not an inversion proof: surface without failing
+        print("\nlockwatch: same-site lock nesting observed at: "
+              + ", ".join(sorted(_LOCKWATCH.self_edges)),
+              file=sys.stderr)

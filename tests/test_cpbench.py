@@ -340,3 +340,89 @@ def test_bench_gate_chaos_legs():
     }
     fails = bg.chaos_gate(extended, require_all=True)
     assert len(fails) == 1 and "chaos_custom" in fails[0]
+
+
+def test_bench_gate_lint_leg():
+    """lint_gate: the cplint-report leg passes only on a well-formed
+    clean record — wrong schema, missing counts, and unsuppressed
+    findings all fail (absence of evidence isn't cleanliness)."""
+    bg = _load_bench_gate()
+
+    clean = {"schema": "cplint/v1", "ok": True,
+             "counts": {"errors": 0, "suppressed": 2}, "findings": []}
+    assert bg.lint_gate(clean) == []
+    # wrong/missing schema: not a cplint record at all
+    fails = bg.lint_gate({"schema": "other/v1"})
+    assert len(fails) == 1 and "cplint/v1" in fails[0]
+    assert bg.lint_gate({}) and "cplint/v1" in bg.lint_gate({})[0]
+    # unsuppressed findings fail and are named in the message
+    dirty = {"schema": "cplint/v1", "ok": False,
+             "counts": {"errors": 1},
+             "findings": [{"pass": "lock-discipline", "path": "x.py",
+                           "line": 7, "message": "racy", "severity":
+                           "error", "suppressed": False}]}
+    fails = bg.lint_gate(dirty)
+    assert len(fails) == 1 and "x.py:7" in fails[0] and \
+        "lock-discipline" in fails[0]
+    # counts without the errors field is malformed, not clean
+    assert bg.lint_gate({"schema": "cplint/v1", "ok": True,
+                         "counts": {}})
+    # a report that parses to a non-object (truncated/corrupt) must
+    # fail the CLI leg, not read as clean (review fix)
+    assert bg.main(["--lint-report", "/dev/null"]) == 1
+    # suppressed-only findings stay green (they carry justifications)
+    suppressed = dict(clean)
+    suppressed["findings"] = [{"pass": "rbac-check", "path": "r.yaml",
+                               "line": 3, "message": "kept",
+                               "suppressed": True}]
+    assert bg.lint_gate(suppressed) == []
+
+
+def test_bench_gate_lint_cli(tmp_path):
+    """--lint-report works standalone: exit 0 on a clean report, 1 on a
+    dirty or unreadable one, no --run/--baseline needed."""
+    import json as _json
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    gate_py = pathlib.Path(__file__).resolve().parent.parent / \
+        "tools" / "bench_gate.py"
+    clean = tmp_path / "clean.json"
+    clean.write_text(_json.dumps(
+        {"schema": "cplint/v1", "ok": True,
+         "counts": {"errors": 0, "suppressed": 0}, "findings": []}
+    ))
+    proc = subprocess.run(
+        [_sys.executable, str(gate_py), "--lint-report", str(clean)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "cplint report clean" in proc.stderr
+    proc = subprocess.run(
+        [_sys.executable, str(gate_py), "--lint-report",
+         str(tmp_path / "missing.json")],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "unreadable" in proc.stderr
+    # valid JSON but not an object (truncated/corrupt report): must
+    # fail, not read as clean (review fix)
+    notdict = tmp_path / "notdict.json"
+    notdict.write_text("[]")
+    proc = subprocess.run(
+        [_sys.executable, str(gate_py), "--lint-report", str(notdict)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 1
+    assert "not a JSON object" in proc.stderr
+    # --chaos-only explicitly requests the chaos legs: pairing it with
+    # --lint-report but forgetting --run must error, not silently skip
+    # the invariants it asked for (review fix)
+    proc = subprocess.run(
+        [_sys.executable, str(gate_py), "--chaos-only",
+         "--lint-report", str(clean)],
+        capture_output=True, text=True,
+    )
+    assert proc.returncode == 2
+    assert "--chaos-only requires --run" in proc.stderr

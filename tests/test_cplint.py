@@ -1,0 +1,697 @@
+"""cplint: the tree stays clean, every pass fires on its known-bad
+fixture (a lint that can't fail guards nothing), suppressions are
+honored, the RBAC diff works in both directions, and lockwatch detects
+a real A→B/B→A lock inversion.
+
+Also pins the fixes the passes surfaced (ISSUE 7 satellite): informer
+outage diagnostics stay coherent under the cache lock, and the
+leader-elector's renew deadline rides the injectable monotonic clock.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.cplint import lockwatch as lw  # noqa: E402
+from tools.cplint.core import PassContext, run_passes  # noqa: E402
+from tools.cplint.passes import (  # noqa: E402
+    ALL_PASSES,
+    cache_mutation,
+    clock_injection,
+    lock_discipline,
+    queue_span,
+    rbac,
+)
+
+CP = "service_account_auth_improvements_tpu/controlplane"
+
+
+def _fixture_ctx(tmp_path, source: str,
+                 rel: str = f"{CP}/engine/fixture.py") -> tuple:
+    """A throwaway repo containing one controlplane module."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return PassContext(repo=tmp_path), path
+
+
+def _messages(findings, include_suppressed=False):
+    return [f.message for f in findings
+            if include_suppressed or not f.suppressed]
+
+
+# ------------------------------------------------------------ the tree
+
+def test_repo_is_clean():
+    findings = run_passes(ALL_PASSES, PassContext(REPO))
+    active = [f.format() for f in findings if not f.suppressed]
+    assert active == [], "\n".join(active)
+
+
+def test_cli_exits_zero_and_writes_report(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.cplint", "--json", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(out.read_text())
+    assert report["schema"] == "cplint/v1"
+    assert report["ok"] is True
+    assert report["counts"]["errors"] == 0
+    assert {p["name"] for p in report["passes"]} == {
+        "lock-discipline", "cache-mutation", "queue-span", "rbac-check",
+        "clock-injection", "metrics",
+    }
+
+
+# ------------------------------------------------------ lock-discipline
+
+BAD_LOCK = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+
+    def locked_inc(self):
+        with self._lock:
+            self.count += 1
+
+    def racy_inc(self):
+        self.count += 1
+"""
+
+
+def test_lock_discipline_flags_mixed_mutation(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_LOCK)
+    msgs = _messages(lock_discipline.run(ctx))
+    assert len(msgs) == 1 and "C.count" in msgs[0]
+
+
+def test_lock_discipline_clean_when_always_locked(tmp_path):
+    good = BAD_LOCK.replace(
+        "    def racy_inc(self):\n        self.count += 1",
+        "    def safe_inc(self):\n"
+        "        with self._lock:\n            self.count += 1",
+    )
+    ctx, _ = _fixture_ctx(tmp_path, good)
+    assert _messages(lock_discipline.run(ctx)) == []
+
+
+def test_lock_discipline_init_and_threadsafe_types_exempt(tmp_path):
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self.count = 0   # init write never counts
+
+    def flip(self):
+        self._stop.set()     # Event is internally synchronized
+
+    def inc(self):
+        with self._lock:
+            self.count += 1
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(lock_discipline.run(ctx)) == []
+
+
+def test_lock_discipline_locked_helper_convention(tmp_path):
+    src = """
+import threading
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.depth = 0
+
+    def _bump_locked(self):
+        self.depth += 1        # *_locked: runs with the lock held
+
+    def _bump(self):
+        self.depth += 1        # private, only ever called under lock
+
+    def add(self):
+        with self._lock:
+            self._bump_locked()
+            self._bump()
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(lock_discipline.run(ctx)) == []
+
+
+def test_lock_discipline_suppression_honored(tmp_path):
+    src = BAD_LOCK.replace(
+        "        self.count += 1\n",
+        "        self.count += 1  # cplint: disable=lock-discipline — "
+        "single-writer stat\n", 1,
+    ).replace(
+        "    def racy_inc(self):\n        self.count += 1",
+        "    def racy_inc(self):\n"
+        "        # cplint: disable=lock-discipline — justified\n"
+        "        self.count += 1",
+    )
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    findings = lock_discipline.run(ctx)
+    assert _messages(findings) == []
+    assert any(f.suppressed for f in findings)
+
+
+def test_suppression_parses_comma_space_lists():
+    """`disable=a, b — why` must cover BOTH passes (review fix: the
+    chunk needed stripping before first-word extraction)."""
+    from tools.cplint.core import load_suppressions
+
+    s = load_suppressions(
+        "x = 1  # cplint: disable=queue-span, lock-discipline — "
+        "hand-off shape\n"
+    )
+    assert s.covers("queue-span", 1)
+    assert s.covers("lock-discipline", 1)
+
+
+def test_suppression_justification_text_never_widens():
+    """Free text after the pass names — even containing commas and the
+    word 'all' — must not be parsed as more pass names (review fix)."""
+    from tools.cplint.core import load_suppressions
+
+    s = load_suppressions(
+        "x = 1  # cplint: disable=queue-span - handed off, all closers "
+        "run in the worker\n"
+    )
+    assert s.covers("queue-span", 1)
+    assert not s.covers("lock-discipline", 1)
+    assert not s.covers("cache-mutation", 1)
+
+
+def test_metrics_pass_honors_suppressions(tmp_path):
+    """metrics scans beyond the controlplane roots, so its run() must
+    populate the suppression index itself (review fix)."""
+    from tools.cplint.passes import metrics as metrics_pass
+
+    root = tmp_path / "service_account_auth_improvements_tpu"
+    root.mkdir(parents=True)
+    (root / "m.py").write_text(
+        "c = Counter('requests', 'h')  "
+        "# cplint: disable=metrics — legacy wire name\n"
+    )
+    findings = metrics_pass.run(PassContext(repo=tmp_path))
+    assert _messages(findings) == []
+    assert len(findings) == 1 and findings[0].suppressed
+    # and without the comment it fires, message un-doubled
+    (root / "m.py").write_text("c = Counter('requests', 'h')\n")
+    findings = metrics_pass.run(PassContext(repo=tmp_path))
+    msgs = _messages(findings)
+    assert len(msgs) == 1 and msgs[0].startswith("counter ")
+
+
+# ------------------------------------------------------- cache-mutation
+
+def test_cache_mutation_flags_informer_read_mutation(tmp_path):
+    src = """
+def handler(self, ns, name):
+    obj = self._pod_inf.get(ns, name)
+    obj["status"]["phase"] = "Running"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(cache_mutation.run(ctx))
+    assert len(msgs) == 1 and "live informer cache" in msgs[0]
+
+
+def test_cache_mutation_deepcopy_cleanses(tmp_path):
+    src = """
+import copy
+
+def handler(self, ns, name):
+    obj = self._pod_inf.get(ns, name)
+    obj = copy.deepcopy(obj)
+    obj["status"]["phase"] = "Running"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(cache_mutation.run(ctx)) == []
+
+
+def test_cache_mutation_flags_client_read_mutation(tmp_path):
+    src = """
+def reconcile(self, req):
+    nb = self.kube.get("notebooks", req.name, namespace=req.namespace)
+    nb["metadata"]["annotations"]["x"] = "y"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(cache_mutation.run(ctx))
+    assert len(msgs) == 1 and "cached-client read" in msgs[0]
+
+
+def test_cache_mutation_live_read_is_exempt(tmp_path):
+    src = """
+def reconcile(self, req):
+    nb = self.kube.live.get("notebooks", req.name)
+    nb["metadata"]["annotations"]["x"] = "y"
+    pod = live_client(self.kube).get("pods", req.name)
+    pod["spec"]["nodeName"] = "n1"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(cache_mutation.run(ctx)) == []
+
+
+def test_cache_mutation_shallow_copy_does_not_cleanse(tmp_path):
+    """A shallow .copy() shares every nested dict with the live cache —
+    only deepcopy cleanses (review fix)."""
+    src = """
+def handler(self, ns, name):
+    p = self._pod_inf.get(ns, name).copy()
+    p["metadata"]["labels"]["x"] = "y"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert len(_messages(cache_mutation.run(ctx))) == 1
+
+
+def test_cache_mutation_iteration_taints_items(tmp_path):
+    src = """
+def sweep(self):
+    for o in self.kube.list("pods")["items"]:
+        o["metadata"]["labels"]["x"] = "y"
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert len(_messages(cache_mutation.run(ctx))) == 1
+
+
+# ----------------------------------------------------------- queue-span
+
+def test_queue_span_flags_done_outside_finally(tmp_path):
+    src = """
+def worker(self):
+    req = self.queue.get()
+    self.reconcile(req)      # a raise here leaks the key
+    self.queue.done(req)
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(queue_span.run(ctx))
+    assert len(msgs) == 1 and "_processing forever" in msgs[0]
+
+
+def test_queue_span_clean_with_finally(tmp_path):
+    src = """
+def worker(self):
+    req = self.queue.get()
+    try:
+        self.reconcile(req)
+    finally:
+        self.queue.done(req)
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(queue_span.run(ctx)) == []
+
+
+def test_queue_span_flags_unfinished_span_and_bare_acquire(tmp_path):
+    src = """
+def work(self, tracer):
+    span = tracer.span("reconcile")
+    span.__enter__()
+    self.do()
+    span.__exit__(None, None, None)   # not in a finally
+
+def locky(self):
+    self._lock.acquire()
+    self.do()
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(queue_span.run(ctx))
+    assert any("__enter__" in m for m in msgs)
+    assert any("acquire() with no .release()" in m for m in msgs)
+
+
+def test_queue_span_flags_rlq_get_with_no_done_at_all(tmp_path):
+    """Forgetting done() entirely is the worst leak — flagged when the
+    receiver is a known RateLimitingQueue; plain queue.Queue consumers
+    carry no done obligation (review fix)."""
+    src = """
+import queue
+
+class C:
+    def __init__(self):
+        self.queue = RateLimitingQueue(name="c")
+        self._plain_q = queue.Queue()
+
+    def worker(self):
+        req = self.queue.get()
+        self.reconcile(req)          # done() never called: leak
+
+    def consumer(self):
+        item = self._plain_q.get()   # queue.Queue: no done protocol
+        self.handle(item)
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(queue_span.run(ctx))
+    assert len(msgs) == 1 and "no .done() in this function" in msgs[0]
+
+
+def test_queue_span_closure_get_not_satisfied_by_outer_done(tmp_path):
+    """A get() inside a nested def must not pair with the enclosing
+    function's done() — different dynamic scopes (review fix)."""
+    src = """
+def outer(self):
+    def worker():
+        req = self.queue.get()
+        self.reconcile(req)
+        self.queue.done(req)     # closure's own done, not in finally
+    req2 = self.queue.get()
+    try:
+        self.run(req2)
+    finally:
+        self.queue.done(req2)
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(queue_span.run(ctx))
+    assert len(msgs) == 1 and "_processing forever" in msgs[0]
+
+
+def test_queue_span_with_statement_span_is_clean(tmp_path):
+    src = """
+def work(self, tracer):
+    with tracer.span("reconcile"):
+        self.do()
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(queue_span.run(ctx)) == []
+
+
+# ----------------------------------------------------------- rbac-check
+
+ROLE_YAML = """
+apiVersion: rbac.authorization.k8s.io/v1
+kind: ClusterRole
+metadata:
+  name: fixture-controller
+rules:
+  - apiGroups: [tpukf.dev]
+    resources: [notebooks]
+    verbs: [get, list, watch, delete]
+"""
+
+ROLE_SRC = """
+class FixtureReconciler:
+    resource = "notebooks"
+    group = "tpukf.dev"
+
+    def reconcile(self, req):
+        nb = self.kube.get("notebooks", req.name)
+        self.kube.patch("notebooks", req.name, {})
+"""
+
+
+def _rbac_findings(tmp_path, monkeypatch, yaml_text=ROLE_YAML,
+                   extra=None):
+    from tools.cplint import rbacmap
+
+    src = tmp_path / CP / "controllers" / "fixture.py"
+    src.parent.mkdir(parents=True, exist_ok=True)
+    src.write_text(ROLE_SRC)
+    manifest = tmp_path / "manifests" / "fixture" / "rbac.yaml"
+    manifest.parent.mkdir(parents=True, exist_ok=True)
+    manifest.write_text(yaml_text)
+    monkeypatch.setattr(rbacmap, "ROLES", {
+        "fixture-controller": {
+            "manifest": "manifests/fixture/rbac.yaml",
+            "sources": (f"{CP}/controllers/fixture.py",),
+        },
+    })
+    monkeypatch.setattr(rbacmap, "ALLOWED_EXTRA", extra or {})
+    return rbac.run(PassContext(repo=tmp_path))
+
+
+def test_rbac_flags_missing_and_dead_grants(tmp_path, monkeypatch):
+    msgs = _messages(_rbac_findings(tmp_path, monkeypatch))
+    # missing: the code patches notebooks, the role doesn't grant patch
+    assert any("issues patch" in m and "does not grant" in m
+               for m in msgs)
+    # dead: the role grants delete, no call site deletes
+    assert any("grants delete" in m and "dead grant" in m for m in msgs)
+    # granted-and-used verbs are silent
+    assert not any("grants get " in m for m in msgs)
+
+
+def test_rbac_allowed_extra_is_not_dead(tmp_path, monkeypatch):
+    findings = _rbac_findings(
+        tmp_path, monkeypatch,
+        extra={("fixture-controller", "tpukf.dev", "notebooks",
+                "delete"): "kept for operator break-glass"},
+    )
+    assert not any("dead grant" in m for m in _messages(findings))
+
+
+def test_rbac_informer_registrations_count_as_list_watch(tmp_path,
+                                                         monkeypatch):
+    # without the Reconciler.resource attr the list/watch grants would
+    # read as dead — the fixture's class attr must cover them
+    msgs = _messages(_rbac_findings(tmp_path, monkeypatch))
+    assert not any("grants list" in m for m in msgs)
+    assert not any("grants watch" in m for m in msgs)
+
+
+# ------------------------------------------------------ clock-injection
+
+def test_clock_injection_flags_bare_clock(tmp_path):
+    src = """
+import time
+
+class Elector:
+    def __init__(self, now_fn=None):
+        self._now = now_fn or _now
+
+    def loop(self):
+        deadline = time.monotonic() + 5
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(clock_injection.run(ctx))
+    assert len(msgs) == 1 and "time.monotonic" in msgs[0]
+
+
+def test_clock_injection_ignores_modules_without_clock_param(tmp_path):
+    src = """
+import time
+
+def stamp():
+    return time.time()
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(clock_injection.run(ctx)) == []
+
+
+def test_clock_injection_default_helper_and_lambda_exempt(tmp_path):
+    src = """
+import datetime
+import time
+
+def _now():
+    return datetime.datetime.now(datetime.timezone.utc)
+
+class C:
+    def __init__(self, now=None):
+        self.now = now or (lambda: datetime.datetime.now(
+            datetime.timezone.utc))
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(clock_injection.run(ctx)) == []
+
+
+def test_clock_injection_non_default_lambda_still_flagged(tmp_path):
+    """Only injection-default lambdas are exempt — a clock read inside
+    ordinary callback logic is a second uninjectable clock
+    (review fix)."""
+    src = """
+import threading
+import time
+
+class C:
+    def __init__(self, now_fn=None):
+        self._now = now_fn or _now
+
+    def arm(self):
+        self._timer = threading.Timer(
+            5, lambda: self.expire(time.time())
+        )
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(clock_injection.run(ctx))
+    assert len(msgs) == 1 and "time.time" in msgs[0]
+
+
+# -------------------------------------------------------------- lockwatch
+
+def test_lockwatch_detects_real_inversion():
+    """Two threads, A→B in one and B→A in the other — the canonical
+    deadlock shape, detected from the order graph without having to
+    actually deadlock."""
+    w = lw.LockWatch()
+    a = w.lock("sched.py:10")
+    b = w.lock("informer.py:20")
+    done = threading.Barrier(2, timeout=5)
+
+    def t1():
+        with a:
+            with b:
+                pass
+        done.wait()
+
+    def t2():
+        done.wait()   # strictly after t1, so no real deadlock risk
+        with b:
+            with a:
+                pass
+
+    th1 = threading.Thread(target=t1)
+    th2 = threading.Thread(target=t2)
+    th1.start(); th2.start()
+    th1.join(5); th2.join(5)
+    assert len(w.violations) == 1
+    v = w.violations[0]
+    assert v["kind"] == "lock-order-cycle"
+    assert set(v["edge"]) == {"sched.py:10", "informer.py:20"}
+
+
+def test_lockwatch_consistent_order_is_clean():
+    w = lw.LockWatch()
+    a, b = w.lock("a.py:1"), w.lock("b.py:2")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert w.violations == []
+
+
+def test_lockwatch_rlock_reentry_is_not_an_edge():
+    w = lw.LockWatch()
+    r = w.rlock("r.py:1")
+    with r:
+        with r:
+            pass
+    assert w.violations == [] and w.self_edges == set()
+
+
+def test_lockwatch_condition_wait_releases_held_state():
+    w = lw.LockWatch()
+    cond = threading.Condition(w.rlock("q.py:1"))
+    other = w.lock("other.py:2")
+    woke = threading.Event()
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            woke.set()
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    # while the waiter sleeps it must NOT count as holding q.py:1 —
+    # taking q.py:1 under other.py:2 here must be the graph's only edge
+    with other:
+        with cond:
+            cond.notify()
+    t.join(5)
+    assert woke.is_set()
+    assert w.violations == []
+    assert w.held_sites() == []
+
+
+def test_lockwatch_held_lock_apiserver_write_flagged():
+    w = lw.LockWatch()
+    sched = w.lock(f"/x/controlplane/scheduler/reconciler.py:1")
+    with sched:
+        w.note_api_call("patch")
+        w.note_api_call("get")   # reads are cache-served; not a fault
+    kube_internal = w.lock("/x/controlplane/kube/fake.py:1")
+    with kube_internal:
+        w.note_api_call("update")  # the fake's own machinery is exempt
+    assert len(w.api_violations) == 1
+    assert w.api_violations[0]["verb"] == "patch"
+
+
+# ------------------------------------------------------------- fix pins
+
+def test_informer_status_reports_error_after_failures():
+    """Pins the lock-discipline fix: _last_error is written under the
+    cache lock and surfaces coherently via status()."""
+    from service_account_auth_improvements_tpu.controlplane.engine.informer import (  # noqa: E501
+        Informer,
+    )
+
+    class FailingClient:
+        def list(self, *a, **k):
+            raise RuntimeError("boom: apiserver down")
+
+        def watch(self, *a, **k):
+            raise RuntimeError("boom: apiserver down")
+
+    inf = Informer(FailingClient(), "notebooks", group="tpukf.dev")
+    inf.start()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        st = inf.status()
+        if st["consecutive_failures"] >= 1 and st["last_error"]:
+            break
+        time.sleep(0.02)
+    inf.stop()
+    st = inf.status()
+    assert st["consecutive_failures"] >= 1
+    assert "boom" in (st["last_error"] or "")
+    assert st["synced"] is False
+
+
+def test_leaderelection_mono_clock_is_injectable():
+    """Pins the clock-injection fix: the renew deadline rides mono_fn,
+    so a chaos clock can deterministically drive self-eviction."""
+    from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+        LeaderElector,
+    )
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        errors,
+    )
+    from service_account_auth_improvements_tpu.controlplane.kube.fake import (
+        FakeKube,
+    )
+
+    kube = FakeKube()
+    mono = {"t": 0.0}
+    lost = threading.Event()
+    elector = LeaderElector(
+        kube, "cplint-test", identity="me",
+        lease_duration=10.0, renew_period=0.02, retry_period=0.02,
+        on_lost=lost.set, mono_fn=lambda: mono["t"],
+    )
+    elector.acquire()
+    assert elector.is_leader
+    # sever the apiserver so renewals fail, then jump the injected
+    # monotonic clock past the renew deadline — eviction must follow
+    # from the INJECTED clock alone (real elapsed time stays tiny)
+    real_update = kube.update
+
+    def failing_update(*a, **k):
+        raise errors.ApiError("chaos: blackout")
+
+    kube.update = failing_update
+    kube.get = failing_update
+    mono["t"] = 1000.0
+    assert lost.wait(5), "on_lost never fired from the injected clock"
+    kube.update = real_update
+    elector._stop.set()
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

@@ -1,0 +1,3 @@
+# tools/ is a package so `python -m tools.cplint` works from the repo
+# root; the individual scripts (bench_gate.py, metrics_lint.py) remain
+# directly runnable too.

@@ -36,6 +36,10 @@ Exit 0 = within tolerance.  Usage:
     # must be present in the run
     python tools/bench_gate.py --baseline CONTROLPLANE_BENCH.json \
         --run chaos_out.json --chaos-only
+
+    # static-analysis lane: assert the cplint report exists and holds
+    # zero unsuppressed errors (python -m tools.cplint --json wrote it)
+    python tools/bench_gate.py --lint-report cplint_report.json
 """
 
 from __future__ import annotations
@@ -122,6 +126,39 @@ def chaos_gate(run: dict, require_all: bool = False) -> list[str]:
     return failures
 
 
+def lint_gate(report: dict) -> list[str]:
+    """cplint-report leg: the report must be the real cplint record and
+    carry zero unsuppressed errors — a missing or malformed report must
+    read as a failure, not as "no findings" (the same asymmetry as the
+    chaos recovery-evidence leg: absence of evidence isn't cleanliness)."""
+    failures = []
+    if report.get("schema") != "cplint/v1":
+        failures.append(
+            "lint report schema is "
+            f"{report.get('schema')!r}, want 'cplint/v1' — was this "
+            "written by python -m tools.cplint --json?"
+        )
+        return failures
+    errors = (report.get("counts") or {}).get("errors")
+    if errors is None:
+        failures.append("lint report has no counts.errors field")
+    elif errors > 0:
+        examples = [
+            f"{f.get('path')}:{f.get('line')} [{f.get('pass')}] "
+            f"{f.get('message')}"
+            for f in (report.get("findings") or [])
+            if not f.get("suppressed")
+        ][:5]
+        failures.append(
+            f"cplint reported {errors} unsuppressed finding(s): "
+            + "; ".join(examples)
+        )
+    if not report.get("ok") and not failures:
+        failures.append("lint report ok=false with zero errors — "
+                        "inconsistent record")
+    return failures
+
+
 def gate(baseline: dict, run: dict, tolerance: float,
          min_hit_rate: float = MIN_HIT_RATE) -> list[str]:
     """Return a list of failure messages (empty = pass)."""
@@ -180,7 +217,8 @@ def main(argv=None) -> int:
                     help="committed CONTROLPLANE_BENCH.json (unused — "
                          "and optional — with --chaos-only: the chaos "
                          "legs are invariants, not comparisons)")
-    ap.add_argument("--run", required=True, help="fresh cpbench output")
+    ap.add_argument("--run", help="fresh cpbench output (required "
+                                  "unless only --lint-report is given)")
     ap.add_argument("--tolerance", type=float, default=1.2,
                     help="allowed ratio vs baseline (default 1.2 = +20%%)")
     ap.add_argument("--min-hit-rate", type=float, default=MIN_HIT_RATE,
@@ -190,24 +228,62 @@ def main(argv=None) -> int:
                     help="check only the chaos invariant legs and "
                          "require all four chaos scenarios in the run "
                          "(the CI chaos smoke step)")
+    ap.add_argument("--lint-report", metavar="PATH",
+                    help="cplint JSON report to assert clean (the CI "
+                         "static-analysis step); usable alone or "
+                         "alongside the bench legs")
     args = ap.parse_args(argv)
-    with open(args.run) as f:
-        run = json.load(f)
-    if args.chaos_only:
-        failures = chaos_gate(run, require_all=True)
+    failures = []
+    if args.lint_report:
+        try:
+            with open(args.lint_report) as f:
+                lint = json.load(f)
+        except (OSError, ValueError) as e:
+            lint = None
+            failures.append(f"lint report unreadable: {e}")
+        if isinstance(lint, dict):
+            failures += lint_gate(lint)
+        elif lint is not None:
+            # parsed but not an object (list/null/string): a truncated
+            # or corrupted report must fail, not read as clean
+            failures.append(
+                "lint report is not a JSON object "
+                f"(got {type(lint).__name__}) — was this written by "
+                "python -m tools.cplint --json?"
+            )
+    if args.run is None:
+        if not args.lint_report:
+            ap.error("--run is required unless --lint-report is given")
+        if args.chaos_only:
+            # --chaos-only explicitly requests the chaos invariant
+            # legs; silently skipping them because --run was forgotten
+            # would greenlight a misconfigured CI step
+            ap.error("--chaos-only requires --run")
+        run = None
     else:
+        with open(args.run) as f:
+            run = json.load(f)
+    if run is not None and args.chaos_only:
+        failures += chaos_gate(run, require_all=True)
+    elif run is not None:
         if not args.baseline:
             ap.error("--baseline is required unless --chaos-only")
         with open(args.baseline) as f:
             baseline = json.load(f)
-        failures = gate(baseline, run, args.tolerance, args.min_hit_rate)
+        failures += gate(baseline, run, args.tolerance,
+                         args.min_hit_rate)
         # chaos scenarios riding along in a mixed run (--chaos) get
         # their invariant legs too
         failures += chaos_gate(run, require_all=False)
     for f in failures:
         print(f"bench_gate FAIL: {f}", file=sys.stderr)
     if not failures:
-        if args.chaos_only:
+        if args.lint_report:
+            print("bench_gate ok: cplint report clean (0 unsuppressed "
+                  "findings)", file=sys.stderr)
+        if run is None:
+            pass
+        elif args.chaos_only:
             for name in chaos_scenarios_in(run):
                 rec = (run["scenarios"][name]["extra"]["recovery_ms"]
                        ["all"])

@@ -1,0 +1,27 @@
+"""cplint: control-plane invariant analyzer.
+
+Repo-specific static analysis over the controlplane package — the
+invariants PR 5 (cached reads), PR 3 (tracing) and PR 6 (chaos) rely on
+are enforced by machine, not by whichever test happens to exercise the
+path. See docs/cplint.md for the pass catalog and suppression policy.
+
+Entry points:
+
+- ``python -m tools.cplint`` — run every pass, print findings, exit
+  nonzero on any unsuppressed error (``--json report.json`` writes the
+  SARIF-ish record CI uploads and ``bench_gate --lint-report`` asserts
+  against).
+- :mod:`tools.cplint.lockwatch` — the dynamic half: instrumented locks
+  recording the per-thread acquisition graph during tier-1 tests
+  (``CPLINT_LOCKWATCH=1``), failing on lock-order cycles and held-lock
+  apiserver writes.
+"""
+
+from tools.cplint.core import (  # noqa: F401
+    Finding,
+    PassContext,
+    load_suppressions,
+    run_passes,
+    report_dict,
+)
+from tools.cplint.passes import ALL_PASSES  # noqa: F401

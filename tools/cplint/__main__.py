@@ -1,0 +1,59 @@
+"""CLI: ``python -m tools.cplint`` — run every pass, print findings,
+exit nonzero on unsuppressed errors.
+
+    python -m tools.cplint                      # all passes
+    python -m tools.cplint --pass lock-discipline --pass rbac-check
+    python -m tools.cplint --json cplint_report.json   # CI record
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.cplint.core import PassContext, report_dict, run_passes
+from tools.cplint.passes import ALL_PASSES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.cplint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help="run only the named pass (repeatable); "
+                         "names: " + ", ".join(p.NAME for p in ALL_PASSES))
+    ap.add_argument("--json", dest="json_out", metavar="PATH",
+                    help="write the SARIF-ish JSON report "
+                         "(bench_gate --lint-report asserts it clean)")
+    ap.add_argument("--repo", default=None,
+                    help="repo root override (tests)")
+    args = ap.parse_args(argv)
+
+    known = {p.NAME for p in ALL_PASSES}
+    only = set(args.passes or ())
+    unknown = only - known
+    if unknown:
+        ap.error(f"unknown pass(es): {', '.join(sorted(unknown))}")
+
+    ctx = PassContext(repo=args.repo)
+    findings = run_passes(ALL_PASSES, ctx, only=only or None)
+    report = report_dict(findings, ALL_PASSES)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2)
+    for finding in findings:
+        print(finding.format(), file=sys.stderr)
+    counts = report["counts"]
+    print(
+        f"cplint: {counts['errors']} finding(s), "
+        f"{counts['suppressed']} suppressed",
+        file=sys.stderr,
+    )
+    return 1 if counts["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

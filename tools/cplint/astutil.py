@@ -1,0 +1,167 @@
+"""Small AST helpers shared by the cplint passes."""
+
+from __future__ import annotations
+
+import ast
+
+#: method names that mutate their receiver in place (dict/list/set/deque
+#: surface) — the mutation half of lock-discipline and cache-mutation
+MUTATING_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+})
+
+#: constructors whose instances are internally synchronized (or
+#: thread-confined by design) — mutating method calls on them don't need
+#: the class lock
+THREADSAFE_CTORS = frozenset({
+    "Event", "local", "Lock", "RLock", "Condition", "Semaphore",
+    "BoundedSemaphore", "Barrier", "Timer", "Queue", "SimpleQueue",
+    "LifoQueue", "PriorityQueue",
+})
+
+
+def attr_chain(node: ast.AST) -> list[str] | None:
+    """``a.b.c`` -> ["a", "b", "c"]; None for non-name-rooted chains."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+def dotted(node: ast.AST) -> str | None:
+    chain = attr_chain(node)
+    return ".".join(chain) if chain else None
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """'x' when node is exactly ``self.x``."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Trailing name of the called expression: ``threading.Lock`` ->
+    'Lock', ``Lock`` -> 'Lock'."""
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def base_name(node: ast.AST) -> str | None:
+    """Root Name of a subscript/attribute chain: ``x["a"]["b"]`` / ``x.a``
+    -> 'x'."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def str_arg(node: ast.Call, index: int = 0) -> str | None:
+    """The call's positional arg at ``index`` when it is a string
+    literal."""
+    if len(node.args) > index:
+        a = node.args[index]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value
+    return None
+
+
+def iter_functions(tree: ast.AST):
+    """Yield every (Function/AsyncFunction) node in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def self_mutations(stmt: ast.AST):
+    """Yield (attr_name, node) for every in-place mutation of a
+    ``self.X`` attribute inside ``stmt`` (without descending into nested
+    function defs): assignment, augmented assignment, subscript
+    write/delete, and mutating method calls (incl. ``heapq.heappush``
+    style helpers whose first arg is the container)."""
+    for node in walk_no_nested_functions(stmt):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                yield from _mutation_targets(tgt)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            yield from _mutation_targets(node.target)
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                yield from _mutation_targets(tgt)
+        elif isinstance(node, ast.Call):
+            yield from call_mutations(node)
+
+
+def call_mutations(node: ast.Call):
+    """(attr_name, node) when the call mutates a ``self.X`` container
+    in place: ``self.X.append(...)``, ``self.X[k].update(...)``,
+    ``heapq.heappush(self.X, ...)`` — the ONE definition of the
+    mutating-call surface, shared by self_mutations and the
+    lock-discipline expression scan."""
+    name = call_name(node)
+    if name in MUTATING_METHODS and isinstance(node.func, ast.Attribute):
+        # receiver is self.X or self.X[...] / self.X.Y chains:
+        # attribute the mutation to the outermost self attr
+        attr = _rooted_self_attr(node.func.value)
+        if attr:
+            yield attr, node
+    elif name in ("heappush", "heappop", "heapify") and node.args:
+        attr = _rooted_self_attr(node.args[0])
+        if attr:
+            yield attr, node
+
+
+def _mutation_targets(tgt: ast.AST):
+    attr = self_attr(tgt)
+    if attr:
+        yield attr, tgt
+        return
+    if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+        rooted = _rooted_self_attr(tgt)
+        if rooted:
+            yield rooted, tgt
+    elif isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _mutation_targets(elt)
+
+
+def _rooted_self_attr(node: ast.AST) -> str | None:
+    """'x' when node is ``self.x`` possibly wrapped in further
+    subscripts/attributes (``self.x[k]``, ``self.x.y[k]``)."""
+    prev = None
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        prev = node
+        node = node.value
+    if isinstance(node, ast.Name) and node.id == "self" and \
+            isinstance(prev, ast.Attribute):
+        return prev.attr
+    return None
+
+
+def walk_no_nested_functions(root: ast.AST):
+    """ast.walk that does not descend into nested function/class defs
+    (their bodies run in a different dynamic context)."""
+    stack = [root]
+    first = True
+    while stack:
+        node = stack.pop()
+        if not first and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)):
+            yield node  # the def itself, not its body
+            continue
+        first = False
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

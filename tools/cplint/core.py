@@ -1,0 +1,188 @@
+"""Shared cplint infrastructure: file discovery, suppressions, findings.
+
+Every pass is a module exposing ``NAME`` (the suppression handle),
+``DESCRIPTION`` and ``run(ctx) -> list[Finding]``. The context owns the
+parsed-AST cache so five passes cost one parse per file, and the
+suppression index so ``# cplint: disable=<pass>`` comments are honored
+uniformly (same line or the line above; a file-level
+``# cplint: disable-file=<pass>`` in the first 20 lines silences the
+pass for the whole file — every suppression is expected to carry a
+justification after the pass name).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+
+#: scan roots for the control-plane passes — the ONE place the package
+#: path lives (lock-discipline/cache-mutation/queue-span/clock-injection
+#: all import this as their SCOPE)
+CONTROLPLANE = (
+    "service_account_auth_improvements_tpu/controlplane",
+)
+
+#: pass names are bare kebab-case tokens; the list ends at the first
+#: token not joined by a comma, so free-text justification after the
+#: names ("— handed off, all closers run in the worker") can never be
+#: mis-read as more pass names
+_NAMES = r"[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*"
+_DISABLE_RE = re.compile(
+    r"#\s*cplint:\s*disable=(" + _NAMES + ")"
+)
+_DISABLE_FILE_RE = re.compile(
+    r"#\s*cplint:\s*disable-file=(" + _NAMES + ")"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    pass_name: str
+    path: str          # repo-relative, posix
+    line: int
+    message: str
+    severity: str = "error"
+    suppressed: bool = False
+
+    def format(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.pass_name}] " \
+               f"{self.message}{tag}"
+
+    def to_dict(self) -> dict:
+        return {
+            "pass": self.pass_name,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "severity": self.severity,
+            "suppressed": self.suppressed,
+        }
+
+
+@dataclasses.dataclass
+class Suppressions:
+    #: line number -> set of pass names disabled on that line
+    lines: dict
+    #: pass names disabled for the whole file
+    file_level: set
+
+    def covers(self, pass_name: str, line: int) -> bool:
+        if pass_name in self.file_level or "all" in self.file_level:
+            return True
+        for candidate in (line, line - 1):
+            names = self.lines.get(candidate)
+            if names and (pass_name in names or "all" in names):
+                return True
+        return False
+
+
+def load_suppressions(source: str) -> Suppressions:
+    lines: dict = {}
+    file_level: set = set()
+    def names_in(spec: str):
+        # the regex already guarantees a comma-separated token list
+        return {chunk.strip() for chunk in spec.split(",")
+                if chunk.strip()}
+
+    for i, raw in enumerate(source.splitlines(), 1):
+        m = _DISABLE_RE.search(raw)
+        if m:
+            lines.setdefault(i, set()).update(names_in(m.group(1)))
+        if i <= 20:
+            fm = _DISABLE_FILE_RE.search(raw)
+            if fm:
+                file_level.update(names_in(fm.group(1)))
+    return Suppressions(lines=lines, file_level=file_level)
+
+
+class PassContext:
+    """Parsed-module cache + suppression index shared across passes."""
+
+    def __init__(self, repo: pathlib.Path | None = None):
+        self.repo = pathlib.Path(repo) if repo else REPO
+        self._parsed: dict = {}   # path -> (tree, source) | None
+        self._suppr: dict = {}    # path -> Suppressions
+
+    # ------------------------------------------------------------ files
+
+    def files(self, *roots: str) -> list[pathlib.Path]:
+        """Python files under the given repo-relative roots, sorted;
+        __pycache__ and the cplint fixture corpus are skipped."""
+        out: list[pathlib.Path] = []
+        for root in roots:
+            base = self.repo / root
+            if base.is_file():
+                out.append(base)
+                continue
+            for p in sorted(base.rglob("*.py")):
+                if "__pycache__" in p.parts:
+                    continue
+                out.append(p)
+        return out
+
+    def parse(self, path: pathlib.Path):
+        """(tree, source) for one file, or None when unparseable —
+        passes report unparseable files once via :meth:`parse_findings`."""
+        key = str(path)
+        if key not in self._parsed:
+            try:
+                source = path.read_text()
+                tree = ast.parse(source, filename=str(path))
+                self._parsed[key] = (tree, source)
+                self._suppr[key] = load_suppressions(source)
+            except (OSError, SyntaxError):
+                self._parsed[key] = None
+        return self._parsed[key]
+
+    def rel(self, path: pathlib.Path) -> str:
+        try:
+            return path.relative_to(self.repo).as_posix()
+        except ValueError:
+            return path.as_posix()
+
+    # ------------------------------------------------------ suppressions
+
+    def finding(self, pass_name: str, path: pathlib.Path, line: int,
+                message: str) -> Finding:
+        """Build a Finding, marking it suppressed when the source carries
+        a matching ``# cplint: disable=`` comment."""
+        suppr = self._suppr.get(str(path))
+        suppressed = bool(suppr and suppr.covers(pass_name, line))
+        return Finding(pass_name=pass_name, path=self.rel(path),
+                       line=line, message=message, suppressed=suppressed)
+
+
+def run_passes(passes, ctx: PassContext | None = None,
+               only: set | None = None) -> list[Finding]:
+    ctx = ctx or PassContext()
+    findings: list[Finding] = []
+    for mod in passes:
+        if only and mod.NAME not in only:
+            continue
+        findings.extend(mod.run(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_name))
+    return findings
+
+
+def report_dict(findings, passes) -> dict:
+    """The SARIF-ish JSON record: CI uploads it ``if: always()`` and
+    ``tools/bench_gate.py --lint-report`` asserts errors == 0."""
+    active = [f for f in findings if not f.suppressed]
+    return {
+        "schema": "cplint/v1",
+        "ok": not active,
+        "counts": {
+            "errors": len(active),
+            "suppressed": len(findings) - len(active),
+        },
+        "passes": [
+            {"name": p.NAME, "description": p.DESCRIPTION}
+            for p in passes
+        ],
+        "findings": [f.to_dict() for f in findings],
+    }

@@ -1,0 +1,343 @@
+"""lockwatch: dynamic lock-order race detection for the control plane.
+
+The static lock-discipline pass proves mutations happen under *a* lock;
+only the running system shows whether two locks are ever taken in
+conflicting orders — the deadlock that strikes once a month in
+production and never in a quick test. This module instruments every
+``threading.Lock``/``RLock``/``Condition`` **created by controlplane
+code** (creation-site filtered, so jax/logging/stdlib locks stay raw)
+and maintains:
+
+- the per-thread *held* stack, and
+- a global acquisition-order graph over lock **creation sites**
+  (file:line) — instances churn per Manager/queue, sites are stable.
+
+Two failure classes are recorded:
+
+- **lock-order cycle**: acquiring B while holding A inserts edge A→B;
+  if the graph already proves B→…→A, the inversion is recorded with
+  both stacks. Same-site self-edges (two instances of the same class
+  nested) are reported separately as ``self_edges`` — they are a design
+  smell, not proof of inversion, and must not fail a run.
+- **held-lock apiserver write**: a FakeKube WRITE verb (create/update/
+  patch/delete — reads are legitimately cache-served under locks)
+  issued while the calling thread holds any watched lock created
+  outside ``kube/``. A write can block on chaos latency or retry
+  through a blackout; doing that under a lock starves every sibling
+  worker (the scheduler's write-after-lock-drop rule, machine-checked).
+
+Enable for a test run with ``CPLINT_LOCKWATCH=1`` (tests/conftest.py
+calls :func:`install` before any controlplane import and fails the
+session on recorded violations). ``install()`` is idempotent;
+:class:`LockWatch` is also directly constructible for the unit tests
+that build deliberate inversions.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+#: module-path fragment that opts a creation site into instrumentation
+WATCH_PATH_FRAGMENT = os.sep + "controlplane" + os.sep
+#: locks created inside the fake apiserver itself — held while it runs
+#: its own synchronous machinery, exempt from the held-lock write check
+KUBE_PATH_FRAGMENT = os.sep + "kube" + os.sep
+
+#: FakeKube verbs gated by the held-lock check (reads are cache-served
+#: under locks by design; see module docstring)
+WRITE_VERBS = frozenset({"create", "update", "patch", "delete"})
+
+
+class LockWatch:
+    """Acquisition-graph recorder. One global instance per process when
+    installed; tests construct their own."""
+
+    def __init__(self):
+        self._g = _REAL_LOCK()           # guards the graph (a raw lock)
+        self._tls = threading.local()
+        #: site -> set of sites acquired while holding it
+        self.order: dict = {}
+        #: (a, b) edges already seen (dedup for the cycle check)
+        self._edges: set = set()
+        self.violations: list = []       # lock-order cycles
+        self.api_violations: list = []   # held-lock apiserver writes
+        self.self_edges: set = set()     # same-site nesting (smell)
+
+    # ------------------------------------------------------------ state
+
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def held_sites(self) -> list:
+        return [site for site, _, _ in self._held()]
+
+    def lock(self, site: str):
+        """A watched non-reentrant lock for ``site`` (test surface)."""
+        return _WatchedLock(self, site, _REAL_LOCK())
+
+    def rlock(self, site: str):
+        return _WatchedLock(self, site, _REAL_RLOCK())
+
+    def reset(self) -> None:
+        with self._g:
+            self.order.clear()
+            self._edges.clear()
+            self.violations.clear()
+            self.api_violations.clear()
+            self.self_edges.clear()
+
+    # ------------------------------------------------------------ hooks
+
+    def note_acquire(self, site: str, lock) -> None:
+        held = self._held()
+        for entry in held:
+            if entry[1] is lock:
+                entry[2] += 1            # reentrant re-acquire
+                return
+        for held_site, _, _ in held:
+            self._edge(held_site, site)
+        held.append([site, lock, 1])
+
+    def note_release(self, site: str, lock) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] is lock:
+                held[i][2] -= 1
+                if held[i][2] <= 0:
+                    del held[i]
+                return
+
+    def note_api_call(self, verb: str) -> None:
+        """FakeKube write entry: no non-kube watched lock may be held."""
+        if verb not in WRITE_VERBS:
+            return
+        offending = [site for site, _, _ in self._held()
+                     if KUBE_PATH_FRAGMENT not in site]
+        if offending:
+            with self._g:
+                self.api_violations.append({
+                    "kind": "held-lock-apiserver-write",
+                    "verb": verb,
+                    "held": offending,
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack(limit=12)),
+                })
+
+    # ------------------------------------------------------------ graph
+
+    def _edge(self, a: str, b: str) -> None:
+        if a == b:
+            with self._g:
+                self.self_edges.add(a)
+            return
+        with self._g:
+            if (a, b) in self._edges:
+                return
+            self._edges.add((a, b))
+            self.order.setdefault(a, set()).add(b)
+            path = self._path(b, a)
+            if path is not None:
+                self.violations.append({
+                    "kind": "lock-order-cycle",
+                    "edge": (a, b),
+                    "cycle": [b] + path,
+                    "thread": threading.current_thread().name,
+                    "stack": "".join(traceback.format_stack(limit=12)),
+                })
+
+    def _path(self, src: str, dst: str) -> list | None:
+        """DFS path src → dst in the order graph (caller holds _g)."""
+        seen = {src}
+        stack = [(src, [])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in self.order.get(node, ()):
+                if nxt == dst:
+                    return path + [nxt]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ----------------------------------------------------------- report
+
+    def report(self) -> str:
+        lines = []
+        for v in self.violations:
+            lines.append(
+                f"lockwatch: lock-order cycle via edge "
+                f"{v['edge'][0]} -> {v['edge'][1]} "
+                f"(cycle {' -> '.join(v['cycle'])}) "
+                f"on thread {v['thread']}\n{v['stack']}"
+            )
+        for v in self.api_violations:
+            lines.append(
+                f"lockwatch: apiserver {v['verb']} while holding "
+                f"{', '.join(v['held'])} on thread {v['thread']}\n"
+                f"{v['stack']}"
+            )
+        return "\n".join(lines)
+
+
+class _WatchedLock:
+    """Lock/RLock wrapper that reports to a LockWatch. Also speaks the
+    private RLock protocol Condition relies on, so watched Conditions
+    keep held-state correct across wait()."""
+
+    __slots__ = ("_watch", "_site", "_inner")
+
+    def __init__(self, watch: LockWatch, site: str, inner):
+        self._watch = watch
+        self._site = site
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            self._watch.note_acquire(self._site, self)
+        return ok
+
+    def release(self):
+        self._watch.note_release(self._site, self)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # --- private RLock protocol (Condition.wait/_is_owned) ---
+    # Delegates when the inner lock is an RLock; falls back to the
+    # plain-Lock semantics Condition itself would use otherwise, so a
+    # watched Lock handed to Condition(lock) still behaves.
+
+    def _is_owned(self):
+        fn = getattr(self._inner, "_is_owned", None)
+        if fn is not None:
+            return fn()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _acquire_restore(self, state):
+        fn = getattr(self._inner, "_acquire_restore", None)
+        if fn is not None:
+            fn(state)
+        else:
+            self._inner.acquire()
+        self._watch.note_acquire(self._site, self)
+
+    def _release_save(self):
+        self._watch.note_release(self._site, self)
+        fn = getattr(self._inner, "_release_save", None)
+        if fn is not None:
+            return fn()
+        self._inner.release()
+        return None
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"<watched {self._inner!r} from {self._site}>"
+
+
+# --------------------------------------------------------- installation
+
+_GLOBAL: LockWatch | None = None
+
+
+def active() -> LockWatch | None:
+    return _GLOBAL
+
+
+def _creation_site(depth: int = 2) -> str | None:
+    """file:line of the caller when it lives under controlplane/."""
+    try:
+        frame = sys._getframe(depth)
+    except ValueError:  # pragma: no cover - shallow stacks
+        return None
+    fname = frame.f_code.co_filename
+    if WATCH_PATH_FRAGMENT not in fname:
+        return None
+    return f"{fname}:{frame.f_lineno}"
+
+
+def install() -> LockWatch:
+    """Patch threading.Lock/RLock/Condition with creation-site-filtered
+    watched variants and hook FakeKube's request choke point. Idempotent;
+    returns the process-global LockWatch."""
+    global _GLOBAL
+    if _GLOBAL is not None:
+        return _GLOBAL
+    watch = LockWatch()
+    _GLOBAL = watch
+
+    def make_lock():
+        site = _creation_site()
+        inner = _REAL_LOCK()
+        if site is None:
+            return inner
+        return _WatchedLock(watch, site, inner)
+
+    def make_rlock():
+        site = _creation_site()
+        inner = _REAL_RLOCK()
+        if site is None:
+            return inner
+        return _WatchedLock(watch, site, inner)
+
+    def make_condition(lock=None):
+        if lock is None:
+            site = _creation_site()
+            inner = _REAL_RLOCK()
+            lock = (inner if site is None
+                    else _WatchedLock(watch, site, inner))
+        return _REAL_CONDITION(lock)
+
+    threading.Lock = make_lock
+    threading.RLock = make_rlock
+    threading.Condition = make_condition
+
+    # the apiserver choke point: FakeKube._count(verb) runs first in
+    # every external request (before FakeKube's own lock is taken)
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        fake,
+    )
+
+    if not getattr(fake.FakeKube._count, "_lockwatch", False):
+        orig_count = fake.FakeKube._count
+
+        def counted(self, verb):
+            w = active()   # current watch, surviving uninstall/reinstall
+            if w is not None:
+                w.note_api_call(verb)
+            return orig_count(self, verb)
+
+        counted._lockwatch = True  # marker so double-install can't stack
+        fake.FakeKube._count = counted
+    return watch
+
+
+def uninstall() -> None:
+    """Restore the raw primitives (tests of lockwatch itself)."""
+    global _GLOBAL
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+    _GLOBAL = None

@@ -1,0 +1,20 @@
+"""Pass registry: one module per pass, each exposing NAME / DESCRIPTION
+/ run(ctx)."""
+
+from tools.cplint.passes import (
+    cache_mutation,
+    clock_injection,
+    lock_discipline,
+    metrics,
+    queue_span,
+    rbac,
+)
+
+ALL_PASSES = (
+    lock_discipline,
+    cache_mutation,
+    queue_span,
+    rbac,
+    clock_injection,
+    metrics,
+)

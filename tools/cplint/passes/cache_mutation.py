@@ -1,0 +1,232 @@
+"""cache-mutation: objects read from the informer/delegating cache are
+never mutated in place.
+
+The PR 5 contract (docs/engine.md "Read semantics"): ``CachedClient``
+reads return deep copies, but *direct informer reads*
+(``Informer.get/list/by_index``) hand out the live cache objects — one
+in-place mutation corrupts the shared cache for every reader and every
+index built over it. And even for deep-copied reads, the repo's
+convention for read-modify-write is explicit: mutate a ``deepcopy`` (or
+a fresh patch dict), or go through ``.live`` when the write needs the
+apiserver's current state — mutating the read result in place is how
+stale-write bugs start.
+
+Taint model (per function, name-based):
+
+- sources: ``<informer>.get/list/by_index(...)`` where the receiver
+  names an informer (``*inf*`` identifier or a ``.informer(...)``
+  result), and ``<kube>.get/list(...)`` whose first argument is a known
+  resource plural (the delegating/cached client surface);
+- propagation: direct assignment, ``["items"]`` extraction, iteration
+  (``for o in <tainted>...``);
+- cleansers: ``copy.deepcopy``, or re-assignment from an untainted
+  expression;
+- sinks: subscript writes, ``del``, mutating method calls on a tainted
+  root, and ``helpers.set_condition(<tainted>, ...)`` (which mutates its
+  argument).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "cache-mutation"
+DESCRIPTION = (
+    "in-place mutation of objects obtained from informer caches or "
+    "cached-client reads"
+)
+
+SCOPE = CONTROLPLANE
+
+#: read methods on informers that return live cache objects
+INFORMER_READS = ("get", "list", "by_index")
+#: read methods on clients (deep-copied, but in-place mutation of the
+#: result is still the stale-write pattern the docs ban)
+CLIENT_READS = ("get", "list", "by_owner")
+
+_INFORMER_NAME = re.compile(r"(^|_)inf(ormer)?($|_)|informer")
+
+#: mutators that take the object as first argument
+ARG_MUTATORS = {"set_condition"}
+
+
+def _known_plurals():
+    from service_account_auth_improvements_tpu.controlplane.kube.registry import (  # noqa: E501
+        DEFAULT_REGISTRY,
+    )
+
+    return {r.plural for r in DEFAULT_REGISTRY.all()}
+
+
+def run(ctx) -> list:
+    plurals = _known_plurals()
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_check_function(ctx, path, fn, plurals))
+    return findings
+
+
+def _is_informer_recv(node: ast.AST) -> bool:
+    """Receiver expression that names an informer: ``inf``,
+    ``self._pod_inf``, ``manager.informer("pods")``."""
+    if isinstance(node, ast.Call):
+        return astutil.call_name(node) == "informer"
+    name = astutil.dotted(node)
+    if not name:
+        return False
+    last = name.split(".")[-1]
+    return bool(_INFORMER_NAME.search(last))
+
+
+def _source_kind(node: ast.Call, plurals: set) -> str | None:
+    """'informer' / 'client' when the call reads from a cache."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    method = node.func.attr
+    recv = node.func.value
+    if method in INFORMER_READS and _is_informer_recv(recv):
+        return "informer"
+    if method in CLIENT_READS:
+        plural = astutil.str_arg(node)
+        if method == "by_owner" and plural in plurals:
+            return "client"
+        if plural in plurals and not _through_live(recv):
+            return "client"
+    return None
+
+
+def _through_live(recv: ast.AST) -> bool:
+    """True for ``kube.live.get(...)`` / ``live_client(kube).get(...)``
+    — the documented live-read escape hatch; those reads are fresh
+    apiserver objects the caller owns outright."""
+    name = astutil.dotted(recv)
+    if name and (name.endswith(".live") or name == "live"):
+        return True
+    if isinstance(recv, ast.Call) and \
+            astutil.call_name(recv) == "live_client":
+        return True
+    return False
+
+
+def _check_function(ctx, path, fn, plurals) -> list:
+    findings = []
+    tainted: dict = {}   # var name -> (kind, source line)
+
+    def value_taint(expr: ast.AST):
+        """Taint of an assigned expression, following ["items"] /
+        .get("items") extraction; deepcopy cleanses."""
+        if isinstance(expr, ast.Call):
+            name = astutil.call_name(expr)
+            # ONLY deepcopy cleanses: a shallow .copy()/copy.copy()
+            # shares every nested dict with the live cache, so mutating
+            # through it corrupts the cache exactly as the bare object
+            # would — the contract says "mutate a deepcopy"
+            if name == "deepcopy":
+                return None
+            if name == "copy":
+                # method form x.copy() carries x's taint; module form
+                # copy.copy(x) carries x's
+                if isinstance(expr.func, ast.Attribute) and \
+                        not expr.args:
+                    return value_taint(expr.func.value)
+                if expr.args:
+                    return value_taint(expr.args[0])
+                return None
+            kind = _source_kind(expr, plurals)
+            if kind:
+                return (kind, expr.lineno)
+            # x = tainted.get("items", []) — dict-read off a taint
+            if isinstance(expr.func, ast.Attribute) and \
+                    expr.func.attr == "get":
+                base = astutil.base_name(expr.func.value)
+                if base in tainted:
+                    return tainted[base]
+            return None
+        if isinstance(expr, ast.Subscript):
+            base = astutil.base_name(expr)
+            if base in tainted:
+                return tainted[base]
+            inner = expr.value
+            if isinstance(inner, ast.Call):
+                return value_taint(inner)
+            return None
+        if isinstance(expr, ast.Name):
+            return tainted.get(expr.id)
+        return None
+
+    def handle_assign(targets, value):
+        taint = value_taint(value)
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                if taint:
+                    tainted[tgt.id] = taint
+                else:
+                    tainted.pop(tgt.id, None)
+            elif isinstance(tgt, ast.Tuple):
+                for elt in tgt.elts:
+                    if isinstance(elt, ast.Name):
+                        tainted.pop(elt.id, None)
+
+    def flag(node, var, kind):
+        what = ("the live informer cache" if kind == "informer"
+                else "a cached-client read")
+        findings.append(ctx.finding(
+            NAME, path, node.lineno,
+            f"{var!r} was obtained from {what} and is mutated in "
+            "place — deepcopy it (or read through .live) before "
+            "writing",
+        ))
+
+    # approximate flow order: AST walk sorted by source position (the
+    # taint map is flow-sensitive-ish — a deepcopy re-assignment must be
+    # seen before the mutations that follow it). Nested defs are
+    # excluded: iter_functions analyzes each with its OWN taint map, so
+    # a shadowing parameter can't inherit the parent's taint
+    nodes = [n for n in astutil.walk_no_nested_functions(fn)
+             if hasattr(n, "lineno")]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            # mutation sink first: tainted["k"] = v
+            for tgt in node.targets:
+                if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                    base = astutil.base_name(tgt)
+                    if base in tainted:
+                        flag(tgt, base, tainted[base][0])
+            handle_assign(node.targets, node.value)
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, (ast.Subscript, ast.Attribute)):
+                base = astutil.base_name(node.target)
+                if base in tainted:
+                    flag(node.target, base, tainted[base][0])
+        elif isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                base = astutil.base_name(tgt)
+                if base in tainted:
+                    flag(tgt, base, tainted[base][0])
+        elif isinstance(node, ast.For):
+            # for o in <tainted> / <tainted>["items"] / .get("items")
+            taint = value_taint(node.iter)
+            if taint and isinstance(node.target, ast.Name):
+                tainted[node.target.id] = taint
+        elif isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name in astutil.MUTATING_METHODS and \
+                    isinstance(node.func, ast.Attribute):
+                base = astutil.base_name(node.func.value)
+                if base in tainted:
+                    flag(node, base, tainted[base][0])
+            elif name in ARG_MUTATORS and node.args:
+                base = astutil.base_name(node.args[0])
+                if base in tainted:
+                    flag(node, base, tainted[base][0])
+    return findings

@@ -1,0 +1,144 @@
+"""clock-injection: modules with an injectable clock never read the
+wall/monotonic clock directly.
+
+A module that exposes a clock parameter (``now_fn`` / ``now`` /
+``mono_fn`` / ``clock``) has declared that time is an INPUT — that is
+what lets chaos's ``skewed_clock`` and the lease-skew tests run
+deterministically. A bare ``time.time()`` / ``time.monotonic()`` /
+``datetime.now()`` in the same module is a second, uninjectable clock:
+under ``skewed_clock`` the two disagree and the scenario's determinism
+quietly dies (the exact failure mode PR 6's lease-skew work had to
+hunt).
+
+Exemptions: the module-level ``_now``-style default helper, default
+expressions (``x or time.monotonic``) that *reference* without calling,
+and calls inside ``lambda`` defaults — those ARE the injection default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "clock-injection"
+DESCRIPTION = (
+    "bare time.time()/time.monotonic()/datetime.now() in modules that "
+    "expose an injectable clock"
+)
+
+SCOPE = CONTROLPLANE
+
+CLOCK_PARAMS = {"now_fn", "now", "mono_fn", "clock", "time_fn"}
+#: (receiver suffix, method) pairs that read a clock
+CLOCK_CALLS = (
+    ("time", "time"),
+    ("time", "monotonic"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+)
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        if not _exposes_clock(tree):
+            continue
+        findings.extend(_check_module(ctx, path, tree))
+    return findings
+
+
+def _exposes_clock(tree: ast.AST) -> bool:
+    for fn in astutil.iter_functions(tree):
+        args = fn.args
+        names = [a.arg for a in (args.posonlyargs + args.args
+                                 + args.kwonlyargs)]
+        if any(n in CLOCK_PARAMS for n in names):
+            return True
+    return False
+
+
+def _default_helper_names(tree: ast.AST) -> set:
+    """Module-level ``_now``/``_utcnow``-style helpers: THE designated
+    defaults a clock param falls back to."""
+    return {
+        node.name for node in tree.body
+        if isinstance(node, ast.FunctionDef)
+        and node.name.lstrip("_").startswith(("now", "utcnow", "mono"))
+    }
+
+
+def _is_clock_call(node: ast.Call) -> bool:
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    chain = astutil.attr_chain(node.func)
+    if not chain or len(chain) < 2:
+        return False
+    recv, method = chain[-2], chain[-1]
+    return (recv, method) in CLOCK_CALLS
+
+
+def _check_module(ctx, path, tree) -> list:
+    findings = []
+    helpers = _default_helper_names(tree)
+    exempt_nodes: set = set()
+    # calls inside the designated default helpers are the injection
+    # default itself
+    for fn in astutil.iter_functions(tree):
+        if fn.name in helpers:
+            for sub in ast.walk(fn):
+                exempt_nodes.add(id(sub))
+    # lambdas are exempt ONLY as clock-injection defaults: a lambda
+    # assigned to a clock-ish attribute (``self.now = now or (lambda:
+    # datetime.now(tz))``) or used as a clock param's default value.
+    # A lambda in ordinary logic (a Timer callback reading time.time())
+    # is a second, uninjectable clock and must still be flagged.
+    def clock_attr(name):
+        return bool(name and ("now" in name or "clock" in name
+                              or "mono" in name))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = []
+            for tgt in node.targets:
+                attr = astutil.self_attr(tgt)
+                targets.append(attr or (tgt.id if isinstance(
+                    tgt, ast.Name) else None))
+            if any(clock_attr(t) for t in targets):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Lambda):
+                        for inner in ast.walk(sub):
+                            exempt_nodes.add(id(inner))
+    for fn in astutil.iter_functions(tree):
+        args = fn.args
+        # align trailing defaults to trailing params (positional) plus
+        # kw-only defaults; exempt lambdas defaulting a clock param
+        pos = args.posonlyargs + args.args
+        pos_defaults = list(zip(pos[len(pos) - len(args.defaults):],
+                                args.defaults))
+        kw_defaults = [(p, d) for p, d in zip(args.kwonlyargs,
+                                              args.kw_defaults or [])
+                       if d is not None]
+        for param, default in pos_defaults + kw_defaults:
+            if param.arg in CLOCK_PARAMS and default is not None:
+                for sub in ast.walk(default):
+                    if isinstance(sub, ast.Lambda):
+                        for inner in ast.walk(sub):
+                            exempt_nodes.add(id(inner))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_clock_call(node) \
+                and id(node) not in exempt_nodes:
+            chain = astutil.attr_chain(node.func)
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"bare {'.'.join(chain[-2:])}() in a module that "
+                "exposes an injectable clock — route it through the "
+                "injected fn or chaos skewed_clock scenarios lose "
+                "determinism",
+            ))
+    return findings

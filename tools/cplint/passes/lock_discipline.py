@@ -1,0 +1,245 @@
+"""lock-discipline: shared state in threaded classes is touched only
+under its lock.
+
+A *threaded class* is one that creates a ``threading.Lock``/``RLock``/
+``Condition`` on ``self``. For each such class the pass classifies every
+in-place mutation of a ``self.X`` attribute as *locked* (inside a
+``with self.<lock>:`` block, or in a method only ever called from locked
+contexts, or in a method named ``*_locked`` — the repo's call-with-lock-
+held convention) or *unlocked*, and flags attributes mutated **both
+ways**: one racy writer is enough to corrupt every careful one.
+
+Exemptions — each is a happens-before argument, not a loophole:
+
+- ``__init__`` writes (construction precedes publication);
+- attributes initialized to internally-synchronized types (Event,
+  local, Queue, the locks themselves);
+- private methods whose every call site inside the class holds the lock
+  (computed to a fixpoint); a method whose NAME is referenced without a
+  call (thread targets, callbacks) stays an unlocked entry point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "lock-discipline"
+DESCRIPTION = (
+    "attributes of threaded classes mutated both inside and outside "
+    "their lock"
+)
+
+#: directories whose classes are analyzed
+SCOPE = CONTROLPLANE
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(ctx, path, node))
+    return findings
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set:
+    """Attributes assigned a Lock/RLock/Condition anywhere in the
+    class."""
+    locks = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = astutil.call_name(node.value)
+            if name in ("Lock", "RLock", "Condition"):
+                for tgt in node.targets:
+                    attr = astutil.self_attr(tgt)
+                    if attr:
+                        locks.add(attr)
+    return locks
+
+
+def _exempt_attrs(cls: ast.ClassDef) -> set:
+    """Attributes initialized to internally-synchronized types."""
+    out = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = astutil.call_name(node.value)
+            if name in astutil.THREADSAFE_CTORS:
+                for tgt in node.targets:
+                    attr = astutil.self_attr(tgt)
+                    if attr:
+                        out.add(attr)
+    return out
+
+
+def _is_with_lock(item: ast.withitem, locks: set) -> bool:
+    expr = item.context_expr
+    # ``with self._lock:`` and ``with self._lock.something():`` both
+    # count (Condition use sometimes wraps)
+    attr = astutil.self_attr(expr)
+    if attr in locks:
+        return True
+    if isinstance(expr, ast.Call):
+        attr = astutil.self_attr(expr.func)
+        if attr in locks:
+            return True
+        if isinstance(expr.func, ast.Attribute):
+            inner = astutil.self_attr(expr.func.value)
+            if inner in locks:
+                return True
+    return False
+
+
+class _MethodScan:
+    """Per-method classification of mutations and intra-class calls by
+    lock context."""
+
+    def __init__(self, locks: set):
+        self.locks = locks
+        #: attr -> list of (locked: bool, node)
+        self.mutations: list = []
+        #: method name -> set of contexts it is called from
+        self.calls: dict = {}
+        #: methods referenced without a call (thread targets, hooks)
+        self.referenced: set = set()
+
+    def scan(self, fn: ast.FunctionDef, base_locked: bool) -> None:
+        self._scan_body(fn.body, base_locked)
+
+    def _scan_body(self, stmts, locked: bool) -> None:
+        for stmt in stmts:
+            self._scan_stmt(stmt, locked)
+
+    def _scan_stmt(self, stmt: ast.stmt, locked: bool) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = locked or any(
+                _is_with_lock(item, self.locks) for item in stmt.items
+            )
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, locked)
+            self._scan_body(stmt.body, inner)
+            return
+        # compound statements: recurse into bodies with the same context
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self._scan_body(sub, locked)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._scan_body(handler.body, locked)
+        # expressions hanging off this statement (test/targets/value)
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._scan_expr(node, locked)
+        # mutations within this single statement (no recursion into
+        # nested defs)
+        if not isinstance(stmt, (ast.With, ast.AsyncWith, ast.Try,
+                                 ast.If, ast.For, ast.While)):
+            for attr, node in astutil.self_mutations(stmt):
+                self.mutations.append((attr, locked, node))
+        else:
+            # compound statement headers can still mutate (for-targets);
+            # scan only the header expressions already handled above
+            if isinstance(stmt, ast.For):
+                for attr, node in astutil.self_mutations(stmt.target):
+                    self.mutations.append((attr, locked, node))
+
+    def _scan_expr(self, expr: ast.expr, locked: bool) -> None:
+        call_funcs = set()
+        nodes = list(astutil.walk_no_nested_functions(expr))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                call_funcs.add(id(node.func))
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                # intra-class call: self._helper(...)
+                attr = astutil.self_attr(node.func) if isinstance(
+                    node.func, ast.Attribute) else None
+                if attr:
+                    self.calls.setdefault(attr, set()).add(locked)
+                for a, n in astutil.call_mutations(node):
+                    self.mutations.append((a, locked, n))
+            elif isinstance(node, ast.Attribute) and \
+                    id(node) not in call_funcs:
+                attr = astutil.self_attr(node)
+                if attr:
+                    # bare method reference (thread target / callback)
+                    self.referenced.add(attr)
+
+
+def _check_class(ctx, path, cls: ast.ClassDef) -> list:
+    locks = _lock_attrs(cls)
+    if not locks:
+        return []
+    exempt = _exempt_attrs(cls) | locks
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    scans: dict = {}
+    for fn in methods:
+        scan = _MethodScan(locks)
+        # *_locked naming convention: the body runs with the lock held
+        scan.scan(fn, base_locked=fn.name.endswith("_locked"))
+        scans[fn.name] = scan
+
+    # fixpoint: a private method whose every intra-class call site is
+    # locked (and which is never referenced as a bare attribute) runs
+    # with the lock held
+    locked_methods = {name for name in scans if name.endswith("_locked")}
+    referenced = set()
+    for scan in scans.values():
+        referenced |= scan.referenced
+    changed = True
+    while changed:
+        changed = False
+        for name, fn_scan in scans.items():
+            if name in locked_methods or not name.startswith("_") \
+                    or name.startswith("__") or name in referenced:
+                continue
+            contexts = set()
+            called = False
+            for caller, scan in scans.items():
+                ctxs = scan.calls.get(name)
+                if ctxs:
+                    called = True
+                    base_locked = caller in locked_methods
+                    contexts |= {c or base_locked for c in ctxs}
+            if called and contexts == {True}:
+                locked_methods.add(name)
+                changed = True
+
+    # classify every mutation with method-level lock context folded in
+    by_attr: dict = {}
+    for fn in methods:
+        scan = scans[fn.name]
+        method_locked = fn.name in locked_methods
+        for attr, locked, node in scan.mutations:
+            if attr in exempt:
+                continue
+            if fn.name == "__init__":
+                continue
+            by_attr.setdefault(attr, []).append(
+                (locked or method_locked, fn.name, node)
+            )
+
+    findings = []
+    for attr, sites in sorted(by_attr.items()):
+        locked_sites = [s for s in sites if s[0]]
+        unlocked_sites = [s for s in sites if not s[0]]
+        if locked_sites and unlocked_sites:
+            _, fn_name, node = unlocked_sites[0]
+            lock_names = ", ".join(sorted("self." + x for x in locks))
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"{cls.name}.{attr} is mutated under {lock_names} "
+                f"elsewhere but without it in {fn_name}() — one racy "
+                "writer corrupts every locked one",
+            ))
+    return findings

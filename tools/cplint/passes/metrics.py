@@ -1,0 +1,161 @@
+"""metrics: Prometheus declaration conventions (folded from
+tools/metrics_lint.py — same rules, shared AST infra; the old
+``python -m tools.metrics_lint`` CLI remains as a compat shim).
+
+- counters end ``_total`` (and nothing else does);
+- histograms declare buckets explicitly;
+- no duplicate metric family across modules.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+
+NAME = "metrics"
+DESCRIPTION = (
+    "Prometheus declaration conventions: _total suffixes, explicit "
+    "histogram buckets, no cross-module duplicates"
+)
+
+#: where metric declarations live; tests/ is excluded on purpose — tests
+#: declare throwaway metrics (including intentional duplicates)
+SCAN_ROOTS = ("service_account_auth_improvements_tpu",)
+METRIC_KINDS = ("Counter", "Gauge", "Histogram")
+
+
+def _call_kind(node: ast.Call) -> str | None:
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Name):
+        name = fn.id
+    elif isinstance(fn, ast.Attribute):
+        name = fn.attr
+    return name if name in METRIC_KINDS else None
+
+
+def metric_calls(tree: ast.AST):
+    """Yield (kind, metric_name, node) for literal-name constructions."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _call_kind(node)
+        if kind is None:
+            continue
+        if not node.args or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, str):
+            continue
+        yield kind, node.args[0].value, node
+
+
+def _has_buckets(node: ast.Call) -> bool:
+    if any(kw.arg == "buckets" for kw in node.keywords):
+        return True
+    # Histogram(name, help_, labels, buckets, ...) — 4th positional
+    return len(node.args) >= 4
+
+
+def lint_file(path: pathlib.Path, repo: pathlib.Path, tree=None):
+    """(findings, declarations) for one file; declarations feed the
+    cross-module duplicate check. Findings are (bare_message, lineno) —
+    no location prefix; the compat shim and the pass each add their own
+    (the pass via Finding.format, the shim via the historical
+    ``rel:line:`` string). ``tree`` lets the cplint pass hand in the
+    PassContext's cached AST instead of re-reading and re-parsing."""
+    findings: list = []
+    decls: list = []
+    try:
+        rel = path.relative_to(repo)
+    except ValueError:
+        rel = path
+    if tree is None:
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except (OSError, SyntaxError) as e:
+            return [(f"unparseable: {e}", 1)], []
+    for kind, name, node in metric_calls(tree):
+        decls.append((name, kind, str(rel), node.lineno))
+        if kind == "Counter" and not name.endswith("_total"):
+            findings.append(
+                (f"counter {name!r} must end with '_total'",
+                 node.lineno)
+            )
+        if kind != "Counter" and name.endswith("_total"):
+            findings.append(
+                (f"{kind.lower()} {name!r} must not end with "
+                 "'_total' (counters only)", node.lineno)
+            )
+        if kind == "Histogram" and not _has_buckets(node):
+            findings.append(
+                (f"histogram {name!r} must declare buckets "
+                 "explicitly", node.lineno)
+            )
+    return findings, decls
+
+
+def run_lint(repo: pathlib.Path) -> list:
+    """All findings as (bare_message, rel_path, lineno, located)
+    tuples. ``located`` distinguishes per-site findings (the shim
+    prefixes them ``rel:line:``) from the cross-module duplicate
+    summaries (historically printed bare)."""
+    findings: list = []
+    by_name: dict = {}
+    for root in SCAN_ROOTS:
+        base = repo / root
+        if not base.exists():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            file_findings, decls = lint_file(path, repo)
+            rel = str(path.relative_to(repo))
+            findings += [(msg, rel, lineno, True)
+                         for msg, lineno in file_findings]
+            for name, kind, drel, lineno in decls:
+                by_name.setdefault(name, []).append((drel, lineno, kind))
+    findings += [(msg, rel, lineno, False)
+                 for msg, rel, lineno in _duplicate_findings(by_name)]
+    return findings
+
+
+def _duplicate_findings(by_name: dict) -> list:
+    """(message, rel, lineno) for metric families declared in more than
+    one module — shared by run_lint (shim) and run (pass)."""
+    out = []
+    for name, sites in sorted(by_name.items()):
+        modules = {rel for rel, _, _ in sites}
+        if len(modules) > 1:
+            where = ", ".join(
+                f"{rel}:{lineno}" for rel, lineno, _ in sorted(sites)
+            )
+            first = sorted(sites)[0]
+            out.append((
+                f"metric {name!r} declared in multiple modules: {where}",
+                first[0], first[1],
+            ))
+    return out
+
+
+def run(ctx) -> list:
+    """The cplint pass: same rules through the PassContext, so the AST
+    cache is shared (no second read/parse of the tree) and the
+    ``# cplint: disable=metrics`` suppression index is populated for
+    every scanned file — metrics scans the whole package, beyond the
+    controlplane roots the other passes parse."""
+    out = []
+    by_name: dict = {}
+    for root in SCAN_ROOTS:
+        for path in ctx.files(root):
+            parsed = ctx.parse(path)
+            if parsed is None:
+                out.append(ctx.finding(NAME, path, 1, "unparseable"))
+                continue
+            tree, _ = parsed
+            file_findings, decls = lint_file(path, ctx.repo, tree=tree)
+            for msg, lineno in file_findings:
+                out.append(ctx.finding(NAME, path, lineno, msg))
+            for name, kind, drel, lineno in decls:
+                by_name.setdefault(name, []).append((drel, lineno, kind))
+    for msg, rel, lineno in _duplicate_findings(by_name):
+        out.append(ctx.finding(NAME, ctx.repo / rel, lineno, msg))
+    return out

@@ -1,0 +1,237 @@
+"""queue-span: paired acquire/release protocols close on ALL paths.
+
+Three protocols, one rule — the closer must sit in a ``finally`` so an
+exception (or an early return threaded past it) cannot leak the opened
+resource:
+
+- ``queue.get()`` → ``queue.done(key)``: a key popped from a
+  RateLimitingQueue and never marked done stays in ``_processing``
+  forever — the object can never be reconciled again (the engine's
+  level-triggering silently dies for that key);
+- ``span.__enter__()`` (or an un-``with``-ed ``tracer.span(...)``) →
+  ``span.__exit__``/``finish()``: an unclosed span wedges the trace's
+  open-context and mis-books every duration after it;
+- ``lock.acquire()`` → ``lock.release()``: the classic.
+
+The analysis is per function: when both halves of a pair appear in one
+function, every closer must be inside a ``Try.finalbody``. A ``get()``
+on a receiver known to be a **RateLimitingQueue** (an attribute the
+file assigns from the constructor) with NO ``done()`` in the same
+function is flagged outright — forgetting ``done()`` entirely is the
+worst leak, and a genuine get-here/done-elsewhere hand-off requires a
+``# cplint: disable=queue-span`` with a justification. Plain
+``queue.Queue`` consumers (no done protocol) are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "queue-span"
+DESCRIPTION = (
+    "queue.get/done, span enter/exit and lock acquire/release closed "
+    "in a finally on all paths"
+)
+
+SCOPE = CONTROLPLANE
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        rlq = _rate_limiting_queue_attrs(tree)
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_check_function(ctx, path, fn, rlq))
+    return findings
+
+
+def _rate_limiting_queue_attrs(tree) -> set:
+    """Attribute/variable names the module assigns from a
+    ``RateLimitingQueue(...)`` constructor — the receivers whose
+    ``get()`` carries the done() obligation."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                astutil.call_name(node.value) == "RateLimitingQueue":
+            for tgt in node.targets:
+                attr = astutil.self_attr(tgt)
+                if attr:
+                    out.add(attr)
+                elif isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _finally_nodes(fn) -> set:
+    """ids of all nodes inside any Try.finalbody of THIS function —
+    nested defs are analyzed as their own functions, so their tries (and
+    their bodies) don't count here."""
+    out = set()
+    for node in astutil.walk_no_nested_functions(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in astutil.walk_no_nested_functions(stmt):
+                    out.add(id(sub))
+    return out
+
+
+def _queue_like(recv: ast.AST) -> str | None:
+    """Dotted receiver text when it names a queue ('queue' in the last
+    component, or exactly 'q')."""
+    name = astutil.dotted(recv)
+    if not name:
+        return None
+    last = name.split(".")[-1]
+    if "queue" in last.lower() or last == "q":
+        return name
+    return None
+
+
+def _lock_like(recv: ast.AST) -> str | None:
+    name = astutil.dotted(recv)
+    if not name:
+        return None
+    last = name.split(".")[-1]
+    if "lock" in last.lower() or "cond" in last.lower():
+        return name
+    return None
+
+
+def _check_function(ctx, path, fn, rlq_attrs=frozenset()) -> list:
+    findings = []
+    in_finally = _finally_nodes(fn)
+    gets: dict = {}       # recv -> first get node
+    dones: dict = {}      # recv -> list of (node, in_finally)
+    acquires: dict = {}
+    releases: dict = {}
+    enters: dict = {}     # var/recv -> node
+    exits: dict = {}      # var/recv -> list of (node, in_finally)
+    span_vars: dict = {}  # var -> assign node for un-with-ed spans
+    with_ctx_calls = set()
+
+    # a closure's get() must not be satisfied by the enclosing
+    # function's done() (different dynamic scopes) — iter_functions
+    # yields nested defs separately, so each is analyzed on its own
+    nodes = list(astutil.walk_no_nested_functions(fn))
+    for node in nodes:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                for sub in ast.walk(item.context_expr):
+                    with_ctx_calls.add(id(sub))
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            # s = tracer.span(...) / s = obs.span(...)
+            if astutil.call_name(node.value) == "span":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        span_vars[tgt.id] = node
+
+    for node in nodes:
+        if not isinstance(node, ast.Call) or \
+                not isinstance(node.func, ast.Attribute):
+            continue
+        method = node.func.attr
+        recv = node.func.value
+        if method == "get":
+            q = _queue_like(recv)
+            # queue.get() / queue.get(timeout=..) — zero positional
+            # args, so dict.get("key") never matches
+            if q and not node.args:
+                gets.setdefault(q, node)
+        elif method == "done":
+            q = _queue_like(recv)
+            if q:
+                dones.setdefault(q, []).append(
+                    (node, id(node) in in_finally)
+                )
+        elif method == "acquire":
+            lk = _lock_like(recv)
+            if lk:
+                acquires.setdefault(lk, node)
+        elif method == "release":
+            lk = _lock_like(recv)
+            if lk:
+                releases.setdefault(lk, []).append(
+                    (node, id(node) in in_finally)
+                )
+        elif method == "__enter__":
+            name = astutil.dotted(recv)
+            if name:
+                enters.setdefault(name, node)
+        elif method in ("__exit__", "finish"):
+            name = astutil.dotted(recv)
+            if name:
+                exits.setdefault(name, []).append(
+                    (node, id(node) in in_finally)
+                )
+
+    for q, get_node in gets.items():
+        closers = dones.get(q)
+        if closers is None:
+            # no done() in this function at all: flag when the receiver
+            # is a known RateLimitingQueue — forgetting done() wedges
+            # the key in _processing forever, the worst leak class.
+            # Other queue types (queue.Queue) carry no done obligation.
+            if q.split(".")[-1] in rlq_attrs:
+                findings.append(ctx.finding(
+                    NAME, path, get_node.lineno,
+                    f"{q}.get() with no .done() in this function — the "
+                    "popped key stays in _processing forever; a "
+                    "get-here/done-elsewhere hand-off needs an explicit "
+                    "disable with its justification",
+                ))
+            continue
+        if not any(ok for _, ok in closers):
+            findings.append(ctx.finding(
+                NAME, path, get_node.lineno,
+                f"{q}.get() has a matching .done() but none inside a "
+                "finally — an exception between them wedges the key in "
+                "_processing forever",
+            ))
+
+    for lk, acq_node in acquires.items():
+        if id(acq_node) in with_ctx_calls:
+            continue
+        closers = releases.get(lk)
+        if closers is None:
+            findings.append(ctx.finding(
+                NAME, path, acq_node.lineno,
+                f"{lk}.acquire() with no .release() in the same "
+                "function — use `with`, or suppress with the hand-off "
+                "justification",
+            ))
+        elif not any(ok for _, ok in closers):
+            findings.append(ctx.finding(
+                NAME, path, acq_node.lineno,
+                f"{lk}.acquire() whose .release() is not in a finally",
+            ))
+
+    for name, enter_node in enters.items():
+        closers = exits.get(name)
+        if not closers or not any(ok for _, ok in closers):
+            findings.append(ctx.finding(
+                NAME, path, enter_node.lineno,
+                f"{name}.__enter__() without __exit__/finish in a "
+                "finally — a raise leaks the open span/context",
+            ))
+
+    for var, assign_node in span_vars.items():
+        if id(assign_node.value) in with_ctx_calls:
+            continue
+        if var in enters:
+            continue  # handled by the enter/exit rule above
+        closers = exits.get(var)
+        if not closers or not any(ok for _, ok in closers):
+            findings.append(ctx.finding(
+                NAME, path, assign_node.lineno,
+                f"span assigned to {var!r} is neither used as a "
+                "context manager nor finished in a finally",
+            ))
+    return findings

@@ -1,0 +1,236 @@
+"""rbac-check: client call-sites vs the Role rules in manifests/.
+
+For each controller Role (tools/cplint/rbacmap.py maps role → manifest
+→ source modules) the pass extracts every ``(group, resource, verb)``
+the code can issue:
+
+- client verbs: ``X.get/list/watch/create/update/update_status/patch/
+  delete/pod_logs("<plural>", ...)`` with a literal plural known to the
+  resource registry (group resolved from the registry — unambiguous by
+  construction);
+- ``helpers.ensure(kube, "<plural>", ...)`` → get + create + update;
+- informer registrations (``manager.informer``, ``watch_owned``,
+  ``watch_mapped``, and each Reconciler's ``resource`` class attr) →
+  list + watch.
+
+It then diffs against the ClusterRole parsed from the manifest, in both
+directions: a **missing grant** is a runtime Forbidden waiting for the
+flag that enables the code path; a **dead grant** is standing privilege
+nothing uses — exactly the drift RBAC reviews exist to catch.
+Intentional extras carry a justification in ``ALLOWED_EXTRA``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil, rbacmap
+
+NAME = "rbac-check"
+DESCRIPTION = (
+    "controller client verbs vs manifest Role rules — missing grants "
+    "and dead grants"
+)
+
+#: client method -> RBAC verb (resource transformed for subresources)
+VERB_METHODS = {
+    "get": "get",
+    "list": "list",
+    "watch": "watch",
+    "create": "create",
+    "update": "update",
+    "update_status": "update",
+    "patch": "patch",
+    "delete": "delete",
+    "pod_logs": "get",
+}
+
+INFORMER_METHODS = {"informer": 0, "watch_owned": 1, "watch_mapped": 1}
+
+
+def _registry():
+    from service_account_auth_improvements_tpu.controlplane.kube.registry import (  # noqa: E501
+        DEFAULT_REGISTRY,
+    )
+
+    return DEFAULT_REGISTRY
+
+
+def run(ctx) -> list:
+    try:
+        import yaml  # noqa: F401
+    except ImportError:
+        # degrade loudly but don't invent findings the environment
+        # can't verify
+        return [ctx.finding(
+            NAME, ctx.repo / "manifests", 1,
+            "pyyaml unavailable — rbac-check skipped (install pyyaml "
+            "to run the manifest diff)",
+        )]
+    registry = _registry()
+    plurals = {r.plural: r for r in registry.all()}
+    findings = []
+    for role, cfg in rbacmap.ROLES.items():
+        findings.extend(
+            _check_role(ctx, role, cfg, plurals)
+        )
+    return findings
+
+
+# ----------------------------------------------------------- extraction
+
+def extract_uses(tree: ast.AST, plurals: dict) -> dict:
+    """{(group, resource, verb): first lineno} for one module."""
+    uses: dict = {}
+
+    def note(plural: str, verb: str, lineno: int) -> None:
+        res = plurals.get(plural)
+        if res is None:
+            return
+        resource = plural
+        if verb == "update" and plural.endswith("/status"):
+            resource = plural
+        uses.setdefault((res.group, resource, verb), lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            # Reconciler primary resource: the manager lists+watches it
+            for stmt in node.body:
+                if isinstance(stmt, ast.Assign) and \
+                        isinstance(stmt.value, ast.Constant) and \
+                        isinstance(stmt.value.value, str):
+                    names = [t.id for t in stmt.targets
+                             if isinstance(t, ast.Name)]
+                    if "resource" in names:
+                        note(stmt.value.value, "list", stmt.lineno)
+                        note(stmt.value.value, "watch", stmt.lineno)
+        if not isinstance(node, ast.Call):
+            continue
+        name = astutil.call_name(node)
+        if name in INFORMER_METHODS:
+            plural = astutil.str_arg(node, INFORMER_METHODS[name])
+            if plural and plural in plurals:
+                note(plural, "list", node.lineno)
+                note(plural, "watch", node.lineno)
+            continue
+        if name == "ensure":
+            plural = astutil.str_arg(node, 1)
+            if plural and plural in plurals:
+                for verb in ("get", "create", "update"):
+                    note(plural, verb, node.lineno)
+            continue
+        if name in VERB_METHODS and isinstance(node.func, ast.Attribute):
+            plural = astutil.str_arg(node, 0)
+            if not plural or plural not in plurals:
+                continue
+            res = plurals[plural]
+            verb = VERB_METHODS[name]
+            if name == "update_status":
+                uses.setdefault(
+                    (res.group, plural + "/status", "update"),
+                    node.lineno,
+                )
+            elif name == "pod_logs":
+                uses.setdefault((res.group, plural, "get"), node.lineno)
+            else:
+                note(plural, verb, node.lineno)
+    return uses
+
+
+def role_uses(ctx, cfg, plurals: dict) -> dict:
+    uses: dict = {}
+    for src in cfg["sources"]:
+        for path in ctx.files(src):
+            parsed = ctx.parse(path)
+            if parsed is None:
+                continue
+            tree, _ = parsed
+            for triple, lineno in extract_uses(tree, plurals).items():
+                uses.setdefault(triple, (ctx.rel(path), lineno))
+    return uses
+
+
+# ------------------------------------------------------------ manifests
+
+def parse_role_rules(text: str, role: str) -> tuple[set, dict]:
+    """(granted triples, resource → manifest line) for the named
+    ClusterRole/Role in a multi-doc YAML."""
+    import yaml
+
+    granted: set = set()
+    for doc in yaml.safe_load_all(text):
+        if not isinstance(doc, dict):
+            continue
+        if doc.get("kind") not in ("ClusterRole", "Role"):
+            continue
+        if (doc.get("metadata") or {}).get("name") != role:
+            continue
+        for rule in doc.get("rules") or []:
+            groups = rule.get("apiGroups") or [""]
+            for group in groups:
+                for resource in rule.get("resources") or []:
+                    for verb in rule.get("verbs") or []:
+                        granted.add((group, resource, verb))
+    # resource token -> first line mentioning it (anchor for findings
+    # and for # cplint: disable= comments in the yaml)
+    lines: dict = {}
+    for i, raw in enumerate(text.splitlines(), 1):
+        if "resources:" in raw:
+            for _, resource, _ in granted:
+                base = resource.split("/")[0]
+                if base in raw:
+                    lines.setdefault(resource, i)
+    return granted, lines
+
+
+# ------------------------------------------------------------ the diff
+
+def _check_role(ctx, role: str, cfg: dict, plurals: dict) -> list:
+    findings = []
+    manifest = ctx.repo / cfg["manifest"]
+    try:
+        text = manifest.read_text()
+    except OSError:
+        return [ctx.finding(
+            NAME, manifest, 1,
+            f"manifest for role {role!r} not found",
+        )]
+    # manifest suppressions ride the shared comment syntax
+    from tools.cplint.core import load_suppressions
+
+    suppr = load_suppressions(text)
+    granted, lines = parse_role_rules(text, role)
+    if not granted:
+        return [ctx.finding(
+            NAME, manifest, 1,
+            f"no ClusterRole/Role named {role!r} in {cfg['manifest']}",
+        )]
+    uses = role_uses(ctx, cfg, plurals)
+
+    for triple in sorted(set(uses) - granted):
+        group, resource, verb = triple
+        src, lineno = uses[triple]
+        findings.append(ctx.finding(
+            NAME, ctx.repo / src, lineno,
+            f"{role}: code issues {verb} on "
+            f"{group or 'core'}/{resource} (first at {src}:{lineno}) "
+            "but the Role does not grant it — a runtime Forbidden "
+            "waiting to happen",
+        ))
+
+    for triple in sorted(granted - set(uses)):
+        group, resource, verb = triple
+        if (role, group, resource, verb) in rbacmap.ALLOWED_EXTRA:
+            continue
+        line = lines.get(resource, 1)
+        f = ctx.finding(
+            NAME, manifest, line,
+            f"{role}: Role grants {verb} on "
+            f"{group or 'core'}/{resource} but no call site uses it — "
+            "dead grant (trim it, or justify in "
+            "tools/cplint/rbacmap.py ALLOWED_EXTRA)",
+        )
+        if suppr.covers(NAME, line):
+            f.suppressed = True
+        findings.append(f)
+    return findings

@@ -1,0 +1,70 @@
+"""RBAC cross-check configuration: which sources run under which Role.
+
+Each controller binary (cmd/*.py) runs one ServiceAccount whose
+ClusterRole lives under manifests/controllers/<name>/rbac.yaml. The
+static pass extracts every ``(group, resource, verb)`` a binary's
+modules can issue and diffs against the parsed rules — in BOTH
+directions. This map is the binary→sources join the AST can't see
+(imports are conditional: culling/tpusched ride ENABLE_* flags but
+still need their verbs granted for when the flag is on).
+
+``ALLOWED_EXTRA`` lists grants that are intentionally broader than the
+statically-visible call graph; every entry carries its justification
+and is reported as covered, never as dead.
+"""
+
+from __future__ import annotations
+
+CP = "service_account_auth_improvements_tpu/controlplane"
+
+#: role name -> (manifest path, module paths whose client calls run
+#: under that role's ServiceAccount)
+ROLES = {
+    "notebook-controller": {
+        "manifest": "manifests/controllers/notebook/rbac.yaml",
+        "sources": (
+            f"{CP}/controllers/notebook.py",
+            f"{CP}/controllers/culling.py",       # ENABLE_CULLING
+            f"{CP}/scheduler",                    # ENABLE_SCHEDULER
+            f"{CP}/events.py",                    # EventRecorder verbs
+            f"{CP}/engine/leaderelection.py",     # --leader-elect
+        ),
+    },
+    "profile-controller": {
+        "manifest": "manifests/controllers/profile/rbac.yaml",
+        "sources": (
+            f"{CP}/controllers/profile.py",
+            f"{CP}/engine/leaderelection.py",
+        ),
+    },
+    "tensorboard-controller": {
+        "manifest": "manifests/controllers/tensorboard/rbac.yaml",
+        "sources": (
+            f"{CP}/controllers/tensorboard.py",
+            f"{CP}/events.py",
+            f"{CP}/engine/leaderelection.py",
+        ),
+    },
+    "pvcviewer-controller": {
+        "manifest": "manifests/controllers/pvcviewer/rbac.yaml",
+        "sources": (
+            f"{CP}/controllers/pvcviewer.py",
+            f"{CP}/events.py",
+            f"{CP}/engine/leaderelection.py",
+        ),
+    },
+}
+
+#: (role, group, resource, verb) -> justification. These grants exceed
+#: what the AST can prove is used; each one says why it stays.
+ALLOWED_EXTRA = {
+    # Finalizer mutation rides kube.update("profiles") in this
+    # implementation, but a real apiserver checks the /finalizers
+    # subresource whenever ownerReferences carry
+    # blockOwnerDeletion=true on children the controller creates —
+    # dropping it would break owner-cascade setup on a conformant
+    # cluster even though no call site names it.
+    ("profile-controller", "tpukf.dev", "profiles/finalizers", "update"):
+        "blockOwnerDeletion on owned children needs /finalizers update "
+        "on a real apiserver (OwnerReferencesPermissionEnforcement)",
+}

@@ -1,118 +1,56 @@
 #!/usr/bin/env python3
-"""Static lint for Prometheus metric declarations.
+"""Static lint for Prometheus metric declarations — compat shim.
 
-Walks the package tree's ASTs for ``Counter(...)`` / ``Gauge(...)`` /
-``Histogram(...)`` constructions with a literal name and enforces the
-conventions a scrape-side consumer (and our own exposition renderer)
-depends on:
+The rules moved into the cplint framework (tools/cplint/passes/
+metrics.py) so they share its AST infra and run as one pass among six
+(``python -m tools.cplint``). This shim keeps the historical surface —
+``python -m tools.metrics_lint`` / ``python tools/metrics_lint.py``,
+plus the ``lint_file``/``run_lint``/``metric_calls`` helpers
+tests/test_metrics_lint.py exercises — delegating to the pass.
 
-- **counters end ``_total``** (and nothing else does) — the Prometheus
-  naming convention alerting rules pattern-match on;
-- **histograms declare buckets explicitly** — the silent default hid a
-  time-to-placement histogram whose real range (minutes under
-  contention) sailed past the 60 s top bucket;
-- **no duplicate metric family names across modules** — two modules
-  declaring one name (worse: with different label sets) break the first
-  process that registers both; the registry raises at runtime, this
-  catches it at review time.
+Rules (unchanged):
 
-Runs as a tier-1 test (tests/test_metrics_lint.py) and as a step in the
-controlplane bench workflow (ci/workflows.py). Exit 0 = clean.
+- **counters end ``_total``** (and nothing else does);
+- **histograms declare buckets explicitly**;
+- **no duplicate metric family names across modules**.
 """
 
 from __future__ import annotations
 
-import ast
 import pathlib
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-#: where metric declarations live; tests/ is excluded on purpose — tests
-#: declare throwaway metrics (including intentional duplicates)
-SCAN_ROOTS = ("service_account_auth_improvements_tpu",)
-METRIC_KINDS = ("Counter", "Gauge", "Histogram")
+if str(REPO) not in sys.path:  # direct `python tools/metrics_lint.py`
+    sys.path.insert(0, str(REPO))
 
+from tools.cplint.passes import metrics as _pass  # noqa: E402
 
-def _call_kind(node: ast.Call) -> str | None:
-    fn = node.func
-    name = None
-    if isinstance(fn, ast.Name):
-        name = fn.id
-    elif isinstance(fn, ast.Attribute):
-        name = fn.attr
-    return name if name in METRIC_KINDS else None
-
-
-def metric_calls(tree: ast.AST):
-    """Yield (kind, metric_name, node) for literal-name constructions."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        kind = _call_kind(node)
-        if kind is None:
-            continue
-        if not node.args or not isinstance(node.args[0], ast.Constant) \
-                or not isinstance(node.args[0].value, str):
-            continue
-        yield kind, node.args[0].value, node
-
-
-def _has_buckets(node: ast.Call) -> bool:
-    if any(kw.arg == "buckets" for kw in node.keywords):
-        return True
-    # Histogram(name, help_, labels, buckets, ...) — 4th positional
-    return len(node.args) >= 4
+#: re-exported for callers that introspect the scan scope
+SCAN_ROOTS = _pass.SCAN_ROOTS
+METRIC_KINDS = _pass.METRIC_KINDS
+metric_calls = _pass.metric_calls
 
 
 def lint_file(path: pathlib.Path) -> tuple[list[str], list[tuple]]:
-    """(findings, declarations) for one file; declarations feed the
-    cross-module duplicate check."""
-    findings: list[str] = []
-    decls: list[tuple] = []
-    rel = path.relative_to(REPO)
+    """(findings, declarations) for one file — historical signature;
+    paths are relativized against the module-level ``REPO`` (tests
+    monkeypatch it)."""
+    path = pathlib.Path(path)
+    findings, decls = _pass.lint_file(path, REPO)
     try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except SyntaxError as e:
-        return [f"{rel}: unparseable: {e}"], []
-    for kind, name, node in metric_calls(tree):
-        where = f"{rel}:{node.lineno}"
-        decls.append((name, kind, str(rel), node.lineno))
-        if kind == "Counter" and not name.endswith("_total"):
-            findings.append(
-                f"{where}: counter {name!r} must end with '_total'"
-            )
-        if kind != "Counter" and name.endswith("_total"):
-            findings.append(
-                f"{where}: {kind.lower()} {name!r} must not end with "
-                "'_total' (counters only)"
-            )
-        if kind == "Histogram" and not _has_buckets(node):
-            findings.append(
-                f"{where}: histogram {name!r} must declare buckets "
-                "explicitly"
-            )
-    return findings, decls
+        rel = path.relative_to(REPO)
+    except ValueError:
+        rel = path
+    return [f"{rel}:{lineno}: {msg}" for msg, lineno in findings], decls
 
 
-def run_lint(repo: pathlib.Path = REPO) -> list[str]:
-    findings: list[str] = []
-    by_name: dict[str, list[tuple]] = {}
-    for root in SCAN_ROOTS:
-        for path in sorted((repo / root).rglob("*.py")):
-            file_findings, decls = lint_file(path)
-            findings += file_findings
-            for name, kind, rel, lineno in decls:
-                by_name.setdefault(name, []).append((rel, lineno, kind))
-    for name, sites in sorted(by_name.items()):
-        modules = {rel for rel, _, _ in sites}
-        if len(modules) > 1:
-            where = ", ".join(
-                f"{rel}:{lineno}" for rel, lineno, _ in sorted(sites)
-            )
-            findings.append(
-                f"metric {name!r} declared in multiple modules: {where}"
-            )
-    return findings
+def run_lint(repo: pathlib.Path = None) -> list[str]:
+    out = []
+    for msg, rel, lineno, located in _pass.run_lint(
+            pathlib.Path(repo) if repo else REPO):
+        out.append(f"{rel}:{lineno}: {msg}" if located else msg)
+    return out
 
 
 def main() -> int:
