@@ -225,7 +225,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             {"name": "Run cpbench --smoke",
              "run": "python -m service_account_auth_improvements_tpu."
                     "controlplane.cpbench --smoke "
-                    "--out bench_out.json"},
+                    "--out bench_out.json --dump-dir bench_out"},
             {"name": "Validate bench JSON",
              "run": "python -c \"import json; d = json.load(open("
                     "'bench_out.json')); "
@@ -243,14 +243,19 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "assert att['attributed_fraction']['mean'] >= 0.8, "
                     "att; "
                     "assert 'kubelet' in att['stages_ms'] and "
-                    "'queue_wait' in att['stages_ms'], att\""},
+                    "'queue_wait' in att['stages_ms'], att; "
+                    "ex = s['notebook_ready']['extra']['explainz']; "
+                    "assert ex['answered'] == ex['of'] > 0, ex\""},
             # perf-regression gate vs the committed record: churn
             # controller_overhead p50 and notebook_ready create→Ready
             # p95 within +20%, cached-read hit rate reported
+            # ... with the SLO leg riding along: per-scenario
+            # attainment records present and every objective met
             {"name": "Bench regression gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
-                    "--run bench_out.json --tolerance 1.2"},
+                    "--run bench_out.json --tolerance 1.2 "
+                    "--slo-report"},
             # chaos smoke: the fault-injection family (cpbench/chaos.py)
             # — apiserver blackout, 410 Gone storms, node death, kubelet
             # stall — then the invariant gate: 0 double bookings, 0
@@ -261,11 +266,11 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "--scenario chaos_relist --scenario chaos_blackout "
                     "--scenario chaos_node_death "
                     "--scenario chaos_kubelet_stall "
-                    "--out chaos_out.json"},
+                    "--out chaos_out.json --dump-dir bench_out"},
             {"name": "Chaos invariant gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
-                    "--run chaos_out.json --chaos-only"},
+                    "--run chaos_out.json --chaos-only --slo-report"},
             # always(): when a gate fails, the JSON records ARE the
             # evidence — dropping them with the runner would force a
             # full local re-run just to see which leg tripped
@@ -274,7 +279,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
                       "path": "bench_out.json\nchaos_out.json\n"
-                              "cplint_report.json"}},
+                              "cplint_report.json\nbench_out/"}},
         ])},
     ),
     "images_multi_arch_test.yaml": workflow(

@@ -15,6 +15,7 @@ import logging
 import signal
 import threading
 
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.controlplane.engine import Manager
 from service_account_auth_improvements_tpu.controlplane.engine.serve import (
     serve_ops,
@@ -53,6 +54,19 @@ def run_manager(register, argv=None, add_args=None) -> int:
                       default_workers=args.workers)
     register(client, manager, args)
 
+    # cpscope wiring: the process journal rides the global tracer's
+    # exporter hook (placements, preemptions, reconcile outcomes), and
+    # the process SLO engine — fed by the controllers' obs.slo_observe
+    # calls (create→Ready at the Ready transition, time-to-placement at
+    # the stamp) — puts its gauges on the same /metrics the kubelet
+    # scrapes
+    obs.JOURNAL.attach(obs.TRACER)
+    from service_account_auth_improvements_tpu.controlplane.obs.slo import (  # noqa: E501
+        default_engine,
+    )
+
+    slo_engine = default_engine().attach(obs.TRACER)
+
     # readiness is LIVE informer-sync state, not a started flag: a watch
     # that loses its caches after startup (long apiserver outage) reads
     # not-ready again instead of lying to the kubelet
@@ -63,6 +77,8 @@ def run_manager(register, argv=None, add_args=None) -> int:
         # /readyz?verbose: per-informer sync/failure/relist state, so a
         # false readiness names the wedged watch instead of just flipping
         ready_detail=manager.informer_status,
+        # /debug/explainz/<ns>/<name> + /slostatus (obs/explain, obs/slo)
+        kube=client, journal=obs.JOURNAL, slo=slo_engine,
     )
 
     elector = None
@@ -77,8 +93,17 @@ def run_manager(register, argv=None, add_args=None) -> int:
             "tpukf-" + (sys.argv[0].rsplit("/", 1)[-1]
                         .removesuffix(".py").replace("_", "-"))
         )
-        elector = LeaderElector(client, name,
-                                namespace=args.leader_elect_namespace)
+        from service_account_auth_improvements_tpu.controlplane.events import (  # noqa: E501
+            EventRecorder,
+        )
+
+        elector = LeaderElector(
+            client, name, namespace=args.leader_elect_namespace,
+            # leader transitions become Events on the Lease + journal
+            # entries — the flight-recorder view of who held the plane
+            recorder=EventRecorder(client, name),
+            journal=obs.JOURNAL,
+        )
         logging.getLogger(__name__).info(
             "waiting for leader lease %s/%s",
             args.leader_elect_namespace, name)

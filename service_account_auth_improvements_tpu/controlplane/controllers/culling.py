@@ -56,6 +56,7 @@ from service_account_auth_improvements_tpu.controlplane.events import (
 )
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.metrics import Registry
+from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.utils.env import (
     get_env_default,
     get_env_int,
@@ -71,6 +72,10 @@ from service_account_auth_improvements_tpu.controlplane.controllers.helpers impo
 CULLING_POLICY = "tpukf.dev/culling-policy"
 TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
 PROBE_TIMEOUT = 10  # seconds (reference culling_controller.go:204-206)
+
+#: Event reasons (cplint event-reason: constant, CamelCase)
+REASON_CULLED = "Culled"
+REASON_CULLED_UNREACHABLE = "CulledUnreachable"
 
 
 def _parse_time(s: str) -> dt.datetime | None:
@@ -193,10 +198,19 @@ class CullingReconciler(Reconciler):
                     patch["metadata"]["annotations"][PROBE_FAILURES] = "0"
                     self.metrics.culled.labels(req.namespace).inc()
                     self.recorder.event(
-                        nb, "Warning", "CulledUnreachable",
+                        nb, "Warning", REASON_CULLED_UNREACHABLE,
                         f"Stopped after {failures} consecutive failed "
                         f"kernel probes with the rank-0 pod bound but not "
                         f"Ready (limit {self.unreachable_limit})",
+                    )
+                    # flight recorder: a reclaim is a capacity decision
+                    # (the chips come back) — durable past the span ring
+                    obs.decide(
+                        "cull",
+                        key=obs.object_key("notebooks", req.namespace,
+                                           req.name),
+                        reason=REASON_CULLED_UNREACHABLE,
+                        probe_failures=failures,
                     )
                 else:
                     patch["metadata"]["annotations"][PROBE_FAILURES] = (
@@ -238,9 +252,15 @@ class CullingReconciler(Reconciler):
             )
             self.metrics.culled.labels(req.namespace).inc()
             self.recorder.event(
-                nb, "Normal", "Culled",
+                nb, "Normal", REASON_CULLED,
                 f"Culled after {idle_for.total_seconds() / 3600:.1f}h idle "
                 f"(threshold {self.cull_idle_minutes} min)",
+            )
+            obs.decide(
+                "cull",
+                key=obs.object_key("notebooks", req.namespace, req.name),
+                reason=REASON_CULLED,
+                idle_s=round(idle_for.total_seconds(), 1),
             )
         self.kube.patch("notebooks", req.name, patch,
                         namespace=req.namespace, group=GROUP)

@@ -63,6 +63,15 @@ def _utcnow() -> str:
     )
 
 
+def _parse_k8s_time(raw) -> datetime.datetime | None:
+    try:
+        return datetime.datetime.strptime(
+            raw, "%Y-%m-%dT%H:%M:%SZ"
+        ).replace(tzinfo=datetime.timezone.utc)
+    except (TypeError, ValueError):
+        return None
+
+
 GROUP = "tpukf.dev"
 STOP_ANNOTATION = "tpukf.dev/resource-stopped"
 NOTEBOOK_PORT = 8888
@@ -91,6 +100,20 @@ GANG_CONDITION_TYPES = ("SliceIncomplete", "SlicePlacementConflict",
 # group-one/group-two servers (webapps/jupyter/form.py set_server_type).
 ANNOTATION_REWRITE_URI = "notebooks.tpukf.dev/http-rewrite-uri"
 ANNOTATION_HEADERS_REQUEST_SET = "notebooks.tpukf.dev/http-headers-request-set"
+
+# Event reasons — module-level CamelCase constants (cplint event-reason:
+# reasons are a queryable API surface with bounded cardinality, so no
+# inline literals and never f-strings). The catalog lives in
+# docs/observability.md.
+REASON_INVALID_TPU_SPEC = "InvalidTpuSpec"
+REASON_RECREATING_STATEFULSET = "RecreatingStatefulSet"
+REASON_CREATED_STATEFULSET = "CreatedStatefulSet"
+REASON_PRUNING_STATEFULSET = "PruningStatefulSet"
+REASON_SLICE_INCOMPLETE = "SliceIncomplete"
+REASON_SLICE_PLACEMENT_CONFLICT = "SlicePlacementConflict"
+REASON_GANG_SCHEDULED = "GangScheduled"
+#: fallback reason for re-emitted child events that arrive reason-less
+REASON_CHILD_EVENT = "ChildEvent"
 
 
 class NotebookMetrics:
@@ -291,10 +314,13 @@ class NotebookReconciler(Reconciler):
         except errors.NotFound:
             return  # not one of ours (e.g. a bare STS named like no CR)
         # raising variant (not the fire-and-forget ``event()``) so a failed
-        # write propagates to the worker's bounded retry
+        # write propagates to the worker's bounded retry. The reason is
+        # the CHILD event's own (kubelet/STS-controller vocabulary —
+        # already constant at its source), falling back to the catalog
+        # constant when the child arrived reason-less.
+        reason = event.get("reason") or REASON_CHILD_EVENT
         self.recorder.emit(
-            nb, event.get("type") or "Normal",
-            event.get("reason") or "ChildEvent",
+            nb, event.get("type") or "Normal", reason,
             f"Reissued from {kind.lower()}/{obj_name}: "
             f"{event.get('message', '')}",
         )
@@ -338,7 +364,7 @@ class NotebookReconciler(Reconciler):
             # (the reference's appendErrorConditionAndReturn pattern —
             # profile_controller.go:337-347).
             self.metrics.create_failed.inc()
-            self.recorder.event(nb, WARNING, "InvalidTpuSpec", str(e))
+            self.recorder.event(nb, WARNING, REASON_INVALID_TPU_SPEC, str(e))
             nb = copy.deepcopy(nb)
             helpers.set_condition(nb, {
                 "type": "InvalidTpuSpec", "status": "True", "message": str(e),
@@ -434,7 +460,7 @@ class NotebookReconciler(Reconciler):
                 )
                 if want_policy != have_policy:
                     self.recorder.event(
-                        nb, "Normal", "RecreatingStatefulSet",
+                        nb, "Normal", REASON_RECREATING_STATEFULSET,
                         f"podManagementPolicy {have_policy} -> {want_policy} "
                         "is immutable; recreating StatefulSet",
                     )
@@ -455,7 +481,7 @@ class NotebookReconciler(Reconciler):
             if fresh:
                 self.metrics.created.inc()
                 self.recorder.event(
-                    nb, "Normal", "CreatedStatefulSet",
+                    nb, "Normal", REASON_CREATED_STATEFULSET,
                     f"Created StatefulSet {req.namespace}/{sts_name}",
                 )
         helpers.ensure(
@@ -524,7 +550,7 @@ class NotebookReconciler(Reconciler):
             sts_name = sts["metadata"]["name"]
             if sts_name not in keep:
                 self.recorder.event(
-                    nb, "Normal", "PruningStatefulSet",
+                    nb, "Normal", REASON_PRUNING_STATEFULSET,
                     f"slice layout changed; deleting StatefulSet {sts_name}",
                 )
                 try:
@@ -584,7 +610,7 @@ class NotebookReconciler(Reconciler):
         if len(pods) < want:
             msg = (f"waiting for slice hosts: {len(pods)}/{want} "
                    "pods created")
-            self.recorder.event(nb, WARNING, "SliceIncomplete", msg)
+            self.recorder.event(nb, WARNING, REASON_SLICE_INCOMPLETE, msg)
             return {"type": "SliceIncomplete", "status": "True",
                     "reason": "WaitingForHosts", "message": msg}
         slice_id = f"{resolved.generation}:{resolved.topology}"
@@ -596,7 +622,7 @@ class NotebookReconciler(Reconciler):
                 msg = (f"pod {p['metadata']['name']} does not pin slice "
                        f"{slice_id}; refusing to lift gang gates")
                 self.recorder.event(
-                    nb, WARNING, "SlicePlacementConflict", msg
+                    nb, WARNING, REASON_SLICE_PLACEMENT_CONFLICT, msg
                 )
                 return {"type": "SlicePlacementConflict", "status": "True",
                         "reason": "InconsistentPlacement", "message": msg}
@@ -641,7 +667,7 @@ class NotebookReconciler(Reconciler):
                 )
             msg = ("gang placement violates one-pool-one-slice: "
                    + "; ".join(parts))
-            self.recorder.event(nb, WARNING, "SlicePlacementConflict", msg)
+            self.recorder.event(nb, WARNING, REASON_SLICE_PLACEMENT_CONFLICT, msg)
             return {"type": "SlicePlacementConflict", "status": "True",
                     "reason": "SplitAcrossSlices", "message": msg}
         lifted = 0
@@ -660,7 +686,7 @@ class NotebookReconciler(Reconciler):
             lifted += 1
         if lifted:
             self.recorder.event(
-                nb, "Normal", "GangScheduled",
+                nb, "Normal", REASON_GANG_SCHEDULED,
                 f"all {want} slice host pods present; "
                 f"lifted {lifted} scheduling gate(s)",
             )
@@ -967,11 +993,30 @@ class NotebookReconciler(Reconciler):
             # end of the lifecycle trace: every expected host reported
             # Ready (idempotent — later refreshes don't re-mark)
             mark = time.monotonic()
-            obs.record(
+            first_ready = obs.record(
                 "notebook.ready",
                 obs.object_key("notebooks", ns, name), mark, mark,
                 attrs={"ready_replicas": ready}, once=True,
             )
+            prior = (nb.get("status") or {}).get("readyReplicas") or 0
+            if first_ready and prior < want_ready:
+                # FIRST Ready of this incarnation: feed the create→Ready
+                # SLO from the CR's own creationTimestamp — the
+                # production attainment signal behind /slostatus (wall
+                # clock, 1 s resolution; plenty for a 15 s objective).
+                # BOTH guards matter: the once-marker stops a pod flap
+                # (Ready → not → Ready again) from re-sampling a
+                # days-old creation as a fresh violation, and the
+                # prior-status check stops a restarted controller (empty
+                # once set) from re-sampling every already-Ready
+                # notebook it first refreshes.
+                created = _parse_k8s_time(
+                    nb["metadata"].get("creationTimestamp"))
+                if created is not None:
+                    age = (datetime.datetime.now(datetime.timezone.utc)
+                           - created).total_seconds()
+                    obs.slo_observe("create_to_ready",
+                                    max(age, 0.0) * 1000.0)
         cur = (nb.get("status") or {})
         if cur != status:
             nb = copy.deepcopy(nb)

@@ -33,12 +33,23 @@ from service_account_auth_improvements_tpu.controlplane.engine import (
     Request,
     Result,
 )
+from service_account_auth_improvements_tpu.controlplane.events import (
+    WARNING,
+    EventRecorder,
+)
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.utils.env import get_env_default
 
 log = logging.getLogger(__name__)
 
 GROUP = "tpukf.dev"
+
+#: Event reasons (cplint event-reason: constant, CamelCase). PR 7's
+#: rbac-check found the profile ClusterRole's events grant DEAD — no
+#: recorder existed here; cpscope closes the gap: tenant onboarding
+#: emits its lifecycle into the tenant's own namespace.
+REASON_PROFILE_READY = "ProfileReady"
+REASON_PROFILE_ERROR = "ProfileError"
 OWNER_ANNOTATION = "owner"
 FINALIZER = "profile-finalizer.tpukf.dev"
 ADMIN_BINDING = "namespaceAdmin"
@@ -181,6 +192,12 @@ class ProfileReconciler(Reconciler):
                  namespace_labels_path: str | None = None,
                  monitor=None):
         self.kube = kube
+        # Events land in the TENANT namespace (the Profile is
+        # cluster-scoped; its namespace is the thing it manages), so the
+        # namespace owner sees onboarding progress with plain
+        # `kubectl get events` — and the ClusterRole's events grant is
+        # live again in both rbac-check directions
+        self.recorder = EventRecorder(kube, "profile-controller")
         self.plugins = plugins if plugins is not None else {
             WorkloadIdentityPlugin.kind: WorkloadIdentityPlugin(),
             AwsIamForServiceAccountPlugin.kind:
@@ -442,15 +459,30 @@ class ProfileReconciler(Reconciler):
     def _set_ready_condition(self, profile):
         # A successful pass clears any prior Error so recovered profiles
         # don't report Error=True alongside Ready=True forever.
-        self._set_condition(profile, {"type": "Ready", "status": "True"},
-                            {"type": "Error", "status": "False"})
+        if self._set_condition(profile,
+                               {"type": "Ready", "status": "True"},
+                               {"type": "Error", "status": "False"}):
+            # transition only (the condition write dedupes): steady-state
+            # reconciles must not churn count bumps
+            ns = profile["metadata"]["name"]
+            self.recorder.event(
+                profile, "Normal", REASON_PROFILE_READY,
+                f"tenant namespace {ns} reconciled: RBAC, service "
+                "accounts, quota, and plugins applied",
+                namespace=ns,
+            )
 
     def _set_error_condition(self, profile, message):
-        self._set_condition(profile, {
+        if self._set_condition(profile, {
             "type": "Error", "status": "True", "message": message,
-        }, {"type": "Ready", "status": "False"})
+        }, {"type": "Ready", "status": "False"}):
+            self.recorder.event(
+                profile, WARNING, REASON_PROFILE_ERROR, message,
+                namespace=profile["metadata"]["name"],
+            )
 
-    def _set_condition(self, profile, cond, *extra):
+    def _set_condition(self, profile, cond, *extra) -> bool:
+        """True when the status actually changed (the Event trigger)."""
         # cplint cache-mutation: conditions are folded into an owned copy
         # of the read result (docs/engine.md "Read semantics")
         cur = copy.deepcopy(
@@ -465,4 +497,6 @@ class ProfileReconciler(Reconciler):
             try:
                 self.kube.update_status("profiles", cur, group=GROUP)
             except errors.Conflict:
-                pass
+                return False
+            return True
+        return False

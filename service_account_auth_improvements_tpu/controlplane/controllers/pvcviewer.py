@@ -45,6 +45,10 @@ from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.utils.env import get_env_default
 
 GROUP = "tpukf.dev"
+
+#: Event reasons (cplint event-reason: constant, CamelCase)
+REASON_CREATED_DEPLOYMENT = "CreatedDeployment"
+REASON_INVALID_SPEC = "InvalidSpec"
 RESOURCE_PREFIX = "pvcviewer-"
 SERVICE_PORT = 80
 VOLUME_NAME = "viewer-volume"
@@ -166,7 +170,7 @@ class PVCViewerReconciler(Reconciler):
         except ValidationError as e:
             # Terminal user error (e.g. explicit podSpec not mounting the
             # PVC): surface on the CR instead of retry-storming.
-            self.recorder.event(viewer, WARNING, "InvalidSpec", str(e))
+            self.recorder.event(viewer, WARNING, REASON_INVALID_SPEC, str(e))
             self._set_invalid_condition(viewer, str(e))
             return Result()
 
@@ -180,7 +184,7 @@ class PVCViewerReconciler(Reconciler):
         self._reconcile_deployment(viewer, labels)
         if fresh:
             self.recorder.event(
-                viewer, "Normal", "CreatedDeployment",
+                viewer, "Normal", REASON_CREATED_DEPLOYMENT,
                 f"Created Deployment {req.namespace}/{req.name}",
             )
         if self._networking(viewer):

@@ -43,6 +43,9 @@ from service_account_auth_improvements_tpu.utils.env import (
 )
 
 GROUP = "tpukf.dev"
+
+#: Event reasons (cplint event-reason: constant, CamelCase)
+REASON_CREATED_DEPLOYMENT = "CreatedDeployment"
 TB_PORT = 6006
 SERVICE_PORT = 80
 MOUNT_PATH = "/tensorboard_logs/"
@@ -115,7 +118,7 @@ class TensorboardReconciler(Reconciler):
         )
         if fresh:
             self.recorder.event(
-                tb, "Normal", "CreatedDeployment",
+                tb, "Normal", REASON_CREATED_DEPLOYMENT,
                 f"Created Deployment {req.namespace}/{req.name}",
             )
         helpers.ensure(

@@ -17,6 +17,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import sys
 import time
 
@@ -97,6 +98,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="per-scenario ready deadline (seconds)")
     ap.add_argument("--out", default="CONTROLPLANE_BENCH.json",
                     help="output path ('-' for stdout only)")
+    ap.add_argument("--dump-dir", default="bench_out",
+                    help="black-box artifact directory: scenarios with "
+                         "non-Ready objects or invariant violations "
+                         "dump journal + explain timelines here (CI "
+                         "uploads it if: always() — a failed gate must "
+                         "carry its own evidence); empty string "
+                         "disables")
     ap.add_argument("--verbose", action="store_true",
                     help="keep controller logs (expected transient "
                          "NotFound backoffs during churn are noisy)")
@@ -143,6 +151,16 @@ def run(args) -> dict:
         entry["ok"] = result.ok
         entry["elapsed_s"] = round(result.elapsed_s, 3)
         report["scenarios"][name] = entry
+        if result.blackbox and getattr(args, "dump_dir", ""):
+            # black-box flight record: journal tail + explain timeline
+            # per non-Ready/violating object, one file per scenario
+            os.makedirs(args.dump_dir, exist_ok=True)
+            path = os.path.join(args.dump_dir, f"{name}_blackbox.json")
+            with open(path, "w") as f:
+                json.dump(result.blackbox, f, indent=2, sort_keys=True,
+                          default=str)
+            print(f"{name}: black-box evidence -> {path}",
+                  file=sys.stderr)
         ready = (entry.get("phases_ms") or {}).get("create_to_ready") or {}
         att = (entry.get("stage_attribution") or {}).get(
             "attributed_fraction") or {}

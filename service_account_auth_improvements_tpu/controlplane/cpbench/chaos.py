@@ -185,6 +185,18 @@ def _http_status(port: int, path: str) -> int | None:
         return None
 
 
+def _http_body(port: int, path: str) -> str | None:
+    """Body of a 200 response over real HTTP, else None — the explainz
+    acceptance check goes through the actual ops port, not a function
+    call, so a broken route can't hide behind a working engine."""
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.read().decode()
+    except Exception:
+        return None
+
+
 def _mk_pool(kube, pool: str, hosts: int = 4, chips: str = "4",
              accelerator: str = "tpu-v5-lite-podslice",
              topology: str = "4x4") -> None:
@@ -218,7 +230,12 @@ def _chaos_result(world, cfg: BenchConfig, started: float, ok: bool,
     if schedule is not None:
         extra["schedule_errors"] = schedule.errors
     extra.update(world.apiserver_extra(summary["reconciles"]))
+    world.cpscope_extra(extra)
     summary["extra"] = extra
+    # SLO attainment: recovery samples against the chaos-family ceiling
+    # (create→Ready rides along — an outage must not break the product
+    # promise, only dent the headroom)
+    summary["slo"] = world.slo_record({"recovery": rec.samples()})
     violations = sum(chaos_extra["invariant_violations"].values())
     return ScenarioResult(
         name=world.tracker.scenario,
@@ -228,6 +245,10 @@ def _chaos_result(world, cfg: BenchConfig, started: float, ok: bool,
         ok=(ok and summary["failed"] == 0 and orphans == 0
             and extra["double_bookings"] == 0 and violations == 0
             and bool(extra["recovery_ms"])),
+        # a chaos run with ANY violation ships its flight record even if
+        # every notebook eventually converged — the evidence of what the
+        # injections did is the point
+        blackbox=world.blackbox(force=bool(violations or orphans)),
     )
 
 
@@ -243,11 +264,16 @@ def scenario_chaos_blackout(cfg: BenchConfig) -> ScenarioResult:
     started = time.monotonic()
     world = _NotebookWorld(cfg, "chaos_blackout")
     chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal   # injections land in the flight record
     rec = RecoveryTracker()
     server = serve_ops(
         0, host="127.0.0.1", registry=Registry(),
         ready_check=world.mgr.informers_synced,
         ready_detail=world.mgr.informer_status,
+        # the explainz acceptance surface: conditions/Events from the
+        # fake apiserver, spans from the world tracer, decisions (incl.
+        # the blackout itself) from the world journal
+        tracer=world.trace, kube=world.kube, journal=world.journal,
     )
     port = server.server_address[1]
     try:
@@ -296,10 +322,31 @@ def scenario_chaos_blackout(cfg: BenchConfig) -> ScenarioResult:
             rec.violation("readyz_never_flipped")
         if readyz_recover_ms is None:
             rec.violation("readyz_never_recovered")
+        # acceptance: every RECOVERED notebook's explain timeline —
+        # fetched over the real ops port — must name the blackout, not
+        # just show a generic slow patch (the whole point of folding
+        # ambient chaos decisions into per-object timelines)
+        explainz_ok = blackout_named = recovered = 0
+        for name in pre + post:
+            r = world.tracker.record(ns, name)
+            if r is None or r.ready is None:
+                continue
+            recovered += 1
+            body = _http_body(port, f"/debug/explainz/{ns}/{name}")
+            if body is not None:
+                explainz_ok += 1
+                if "blackout" in body:
+                    blackout_named += 1
+        if blackout_named < recovered:
+            rec.violation("blackout_not_named",
+                          recovered - blackout_named)
         return _chaos_result(world, cfg, started, ok, rec, chaos, {
             "blackout_s": blackout_s,
             "readyz_flipped_false": flipped,
             "readyz_recover_ms": readyz_recover_ms,
+            "explainz_http": {"answered": explainz_ok,
+                              "blackout_named": blackout_named,
+                              "recovered": recovered},
         })
     finally:
         # an exception anywhere above must not leak the ops server (a
@@ -325,6 +372,7 @@ def scenario_chaos_relist(cfg: BenchConfig) -> ScenarioResult:
     world = _NotebookWorld(cfg, "chaos_relist", scheduler=True,
                            relist_period=0.75)
     chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
     rec = RecoveryTracker()
     ns = "bench"
     pools = max(2, cfg.n // 4)
@@ -457,6 +505,7 @@ def scenario_chaos_node_death(cfg: BenchConfig) -> ScenarioResult:
     started = time.monotonic()
     world = _NotebookWorld(cfg, "chaos_node_death", scheduler=True)
     chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
     rec = RecoveryTracker()
     ns = "bench"
     n = max(2, cfg.n)
@@ -564,6 +613,7 @@ def scenario_chaos_kubelet_stall(cfg: BenchConfig) -> ScenarioResult:
     started = time.monotonic()
     world = _NotebookWorld(cfg, "chaos_kubelet_stall")
     chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
     rec = RecoveryTracker()
     try:
         return _run_chaos_kubelet_stall(cfg, world, chaos, rec, started)

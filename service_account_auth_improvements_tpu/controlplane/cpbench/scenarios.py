@@ -63,7 +63,14 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (
     percentiles,
     stage_attribution,
 )
-from service_account_auth_improvements_tpu.controlplane.obs import Tracer
+from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    Journal,
+    Tracer,
+)
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    slo as slo_mod,
+)
 from service_account_auth_improvements_tpu.controlplane.engine import (
     Informer,
     Manager,
@@ -111,6 +118,10 @@ class ScenarioResult:
     records: list                    # Timelines (tests assert monotone)
     summary: dict                    # tracker.summary() + "extra"
     ok: bool
+    #: black-box evidence for non-Ready/violating objects (journal tail
+    #: + explain timelines) — the CLI writes it into bench_out/ so a
+    #: failed gate carries its own evidence
+    blackbox: dict | None = None
 
 
 # --------------------------------------------------------------- fixtures
@@ -138,6 +149,17 @@ class _NotebookWorld:
         # per-world tracer: the span source for per-stage attribution,
         # isolated so scenarios can't read each other's lifecycles
         self.trace = Tracer(max_traces=4096)
+        # per-world decision journal (cpscope): rides the tracer's
+        # exporter hook, so placements/preemptions/reconcile outcomes
+        # land without extra wiring; chaos scenarios point their
+        # injector at it too — the black-box record a failing run dumps
+        self.journal = Journal().attach(self.trace)
+        # per-world SLO engine (isolated registry): absorbs the
+        # controllers' production obs.slo_observe calls so scenarios
+        # don't cross-pollute the process-global engine; the bench's own
+        # attainment record still comes from exact tracker samples
+        self.slo_engine = slo_mod.SloEngine().attach(self.trace)
+        self._sources = None   # lazy ExplainSources (post-run snapshot)
         self.tracker.instrument_kube(self.kube, tracer=self.trace)
         # relist_period > 0 (chaos scenarios): periodic relists heal
         # silent watch-cache divergence injected by event drops
@@ -227,6 +249,96 @@ class _NotebookWorld:
             "cached_reads": self.cached.stats(),
         }
 
+    # ---------------------------------------------------- cpscope surface
+
+    def _explain_sources(self):
+        """One Event LIST per namespace + one journal snapshot, shared
+        by every per-object explain (otherwise the post-run check is
+        O(objects x (events + ring)) of redundant copying at --full
+        scale). Cached: explain_check, event_count, and blackbox all run
+        on the FINISHED world, so one snapshot serves them all."""
+        if getattr(self, "_sources", None) is None:
+            from service_account_auth_improvements_tpu.controlplane.obs.explain import (  # noqa: E501
+                ExplainSources,
+            )
+
+            namespaces = tuple({r.namespace
+                                for r in self.tracker.records()})
+            self._sources = ExplainSources(
+                kube=self.kube, journal=self.journal,
+                namespaces=namespaces,
+            )
+        return self._sources
+
+    def explain_check(self) -> dict:
+        """Every tracked notebook must be explainable — the acceptance
+        bar: /debug/explainz (this is its engine, called in-process)
+        answers with a non-empty timeline for each CR the scenario
+        drove."""
+        records = self.tracker.records()
+        sources = self._explain_sources()
+        answered = 0
+        for rec in records:
+            e = obs.explain(rec.namespace, rec.name, kube=self.kube,
+                            tracer=self.trace, journal=self.journal,
+                            prefetched=sources)
+            if e["timeline"]:
+                answered += 1
+        return {"answered": answered, "of": len(records)}
+
+    def cpscope_extra(self, extra: dict) -> None:
+        """Event/journal/explain evidence for the scenario report (call
+        AFTER apiserver_extra — the counting LISTs here must not pollute
+        the request-volume deltas the bench gates on)."""
+        extra["event_count"] = self._explain_sources().total_events
+        recorder_stats = self.reconciler.recorder.stats()
+        if self.sched is not None:
+            sched_stats = self.sched.recorder.stats()
+            recorder_stats = {
+                k: recorder_stats[k] + sched_stats[k]
+                for k in recorder_stats
+            }
+        extra["recorder"] = recorder_stats
+        extra["journal"] = self.journal.counts()
+        extra["explainz"] = self.explain_check()
+
+    def slo_record(self, extra_samples: dict | None = None) -> dict:
+        """Per-scenario SLO attainment (obs/slo.py report shape):
+        create→Ready always; callers add time-to-placement / recovery
+        sample sets where the scenario produces them."""
+        samples = {
+            "create_to_ready": _create_to_ready_ms(self.tracker),
+        }
+        samples.update(extra_samples or {})
+        return slo_mod.report(samples)
+
+    def blackbox(self, violating=(), force: bool = False) -> dict | None:
+        """Journal tail + explain timelines for every non-Ready (or
+        explicitly named violating) object — the artifact a failed gate
+        ships so diagnosis doesn't need a local re-run. None when the
+        scenario has nothing to confess (and ``force`` is unset)."""
+        failed = [(r.namespace, r.name) for r in self.tracker.records()
+                  if r.ready is None]
+        keys = sorted(set(failed) | set(violating))
+        if not keys and not force:
+            return None
+        explains = {}
+        sources = self._explain_sources()
+        for ns, name in keys[:20]:   # cap: evidence, not a core dump
+            rec = obs.explain(ns, name, kube=self.kube,
+                              tracer=self.trace, journal=self.journal,
+                              prefetched=sources)
+            explains[f"{ns}/{name}"] = {
+                "rendered": obs.render_explain(rec), "record": rec,
+            }
+        tail = self.journal.entries()[-1000:]
+        return {
+            "scenario": self.tracker.scenario,
+            "non_ready": [f"{ns}/{name}" for ns, name in failed],
+            "explain": explains,
+            "journal_tail": tail,
+        }
+
     def create_jobs(self, names: list[str], ns: str, tpu: dict | None,
                     want_ready: int):
         """One callable per CR: stamp the timeline, then POST."""
@@ -241,6 +353,23 @@ class _NotebookWorld:
         return [job(n) for n in names]
 
 
+def _create_to_ready_ms(tracker) -> list[float]:
+    """The ONE definition of the create→Ready SLO sample set (used by
+    the world-based and tracker-only scenario paths alike, so the
+    extraction rule can never silently diverge between them)."""
+    return [
+        ms for r in tracker.records()
+        if (ms := r.phase_ms().get("create_to_ready")) is not None
+    ]
+
+
+def _slo_from_tracker(tracker) -> dict:
+    """create→Ready SLO record for worlds without a _NotebookWorld
+    (profile_fanout, webhook_inject) — every scenario reports
+    attainment, uniformly (bench_gate --slo-report requires it)."""
+    return slo_mod.report({"create_to_ready": _create_to_ready_ms(tracker)})
+
+
 def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
             started: float, extra: dict) -> ScenarioResult:
     keys = [(ns, n) for n in names]
@@ -252,13 +381,16 @@ def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
     extra.setdefault("pods_created", world.actuator.pods_created)
     extra.setdefault("pods_ready", world.actuator.pods_ready)
     extra.update(world.apiserver_extra(summary["reconciles"]))
+    world.cpscope_extra(extra)
     summary["extra"] = extra
+    summary["slo"] = world.slo_record()
     return ScenarioResult(
         name=world.tracker.scenario,
         elapsed_s=time.monotonic() - started,
         records=world.tracker.records(),
         summary=summary,
         ok=ok and summary["failed"] == 0,
+        blackbox=world.blackbox(),
     )
 
 
@@ -314,7 +446,7 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
     world.stop()
     summary = world.tracker.summary()
     summary["stage_attribution"] = world.attribution()
-    summary["extra"] = {
+    extra = {
         "hosts_per_gang": 4,
         "gang_scheduled": gang_scheduled,
         "placement_conflicts": conflicts,
@@ -324,10 +456,14 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
         "pods_ready": world.actuator.pods_ready,
         **world.apiserver_extra(summary["reconciles"]),
     }
+    world.cpscope_extra(extra)
+    summary["extra"] = extra
+    summary["slo"] = world.slo_record()
     return ScenarioResult(
         name="gang_ready", elapsed_s=time.monotonic() - started,
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0 and gated_left == 0,
+        blackbox=world.blackbox(),
     )
 
 
@@ -423,7 +559,7 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
     world.stop()
     summary = world.tracker.summary()
     summary["stage_attribution"] = world.attribution()
-    summary["extra"] = {
+    extra = {
         "cycles": cycles,
         "culled": culled_total,
         "delete_cascade_ms": percentiles(delete_ms),
@@ -431,10 +567,14 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         "pods_created": world.actuator.pods_created,
         **world.apiserver_extra(summary["reconciles"]),
     }
+    world.cpscope_extra(extra)
+    summary["extra"] = extra
+    summary["slo"] = world.slo_record()
     return ScenarioResult(
         name="churn", elapsed_s=time.monotonic() - started,
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0,
+        blackbox=world.blackbox(),
     )
 
 
@@ -508,7 +648,13 @@ def scenario_profile_fanout(cfg: BenchConfig) -> ScenarioResult:
             (api.get("get", 0) + api.get("list", 0))
             / max(summary["reconciles"], 1), 3
         ),
+        # cpscope: ProfileReady/ProfileError Events now land in tenant
+        # namespaces (the PR 7 dead-grant gap, closed)
+        "event_count": len(kube.list("events")["items"]),
+        "recorder": rec.recorder.stats(),
+        "journal": {},
     }
+    summary["slo"] = _slo_from_tracker(tracker)
     return ScenarioResult(
         name="profile_fanout", elapsed_s=time.monotonic() - started,
         records=tracker.records(), summary=summary,
@@ -578,7 +724,10 @@ def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
         "namespaces": len(namespaces),
         "poddefaults_per_namespace": 2,
         "mutated": mutated[0],
+        "event_count": len(kube.list("events")["items"]),
+        "journal": {},
     }
+    summary["slo"] = _slo_from_tracker(tracker)
     return ScenarioResult(
         name="webhook_inject", elapsed_s=time.monotonic() - started,
         records=tracker.records(), summary=summary,
@@ -734,7 +883,7 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
     world.stop()
     summary = world.tracker.summary()
     summary["stage_attribution"] = world.attribution()
-    summary["extra"] = {
+    extra = {
         "pools": SCHED_POOLS,
         "time_to_placement_ms": percentiles(list(placement_ms.values())),
         "placed": len(placement_ms),
@@ -747,10 +896,18 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
         "pods_created": world.actuator.pods_created,
         **world.apiserver_extra(summary["reconciles"]),
     }
+    world.cpscope_extra(extra)
+    summary["extra"] = extra
+    summary["slo"] = world.slo_record(
+        {"time_to_placement": list(placement_ms.values())}
+    )
+    violating = [(ns, m) for s in double_booking_samples
+                 for m in s["members"]]
     return ScenarioResult(
         name="sched_contention", elapsed_s=time.monotonic() - started,
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0 and len(placement_ms) == cfg.n,
+        blackbox=world.blackbox(violating=violating),
     )
 
 

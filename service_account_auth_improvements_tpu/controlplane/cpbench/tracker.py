@@ -295,6 +295,12 @@ class RecoveryTracker:
         with self._lock:
             return self._violations.get(kind, 0)
 
+    def samples(self) -> list[float]:
+        """Flat list of every recovery sample (ms) — the raw input to
+        the per-scenario SLO attainment record (obs/slo.py)."""
+        with self._lock:
+            return [s for v in self._samples.values() for s in v]
+
     def recovery_ms(self) -> dict:
         """{kind: percentiles} over every sample recorded so far; the
         flat union rides under the "all" key so the gate has one field

@@ -36,10 +36,20 @@ import time
 import uuid
 
 from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    journal as journal_mod,
+)
 
 log = logging.getLogger(__name__)
 
 LEASE_GROUP = "coordination.k8s.io"
+
+#: Event reasons (constant, CamelCase — cplint event-reason): leader
+#: transitions are recorded against the Lease object itself, client-go's
+#: resourcelock convention, so `kubectl describe lease` shows the
+#: succession history
+REASON_LEADER_ELECTED = "LeaderElected"
+REASON_LEADER_LOST = "LeaderLost"
 
 
 def _now() -> datetime.datetime:
@@ -71,7 +81,9 @@ class LeaderElector:
                  on_lost=None,
                  now_fn=None,
                  mono_fn=None,
-                 skew_tolerance: float | None = None):
+                 skew_tolerance: float | None = None,
+                 recorder=None,
+                 journal=None):
         self.kube = kube
         self.lease_name = lease_name
         self.namespace = namespace
@@ -91,6 +103,14 @@ class LeaderElector:
         #: bounded clock-skew grace when judging ANOTHER holder's lease;
         #: None → 25% of the lease's own advertised duration
         self.skew_tolerance = skew_tolerance
+        #: optional obs EventRecorder: leader transitions become Events
+        #: on the Lease object (cpscope); None = silent (tests)
+        self.recorder = recorder
+        #: decision journal for lease transitions — the explain engine's
+        #: ambient "who held the plane when" context; defaults to the
+        #: process journal
+        self.journal = (journal if journal is not None
+                        else journal_mod.JOURNAL)
         self._stop = threading.Event()
         self._renewer: threading.Thread | None = None
         self.is_leader = False
@@ -128,6 +148,8 @@ class LeaderElector:
                 self.is_leader = True
                 log.info("leader election: %s acquired %s/%s",
                          self.identity, self.namespace, self.lease_name)
+                self._surface_transition(REASON_LEADER_ELECTED,
+                                         "acquired the lease")
                 self._renewer = threading.Thread(
                     target=self._renew_loop, daemon=True,
                     name=f"lease-renew-{self.lease_name}",
@@ -168,6 +190,42 @@ class LeaderElector:
     def _die():  # pragma: no cover - terminal
         log.error("leader election: lease lost, exiting")
         os._exit(1)
+
+    def _surface_transition(self, reason: str, detail: str) -> None:
+        """Record a leader transition in the journal and (on ELECTION
+        only) as an Event on the Lease. The LOST paths run immediately
+        before ``on_lost`` — whose default is ``os._exit``, and whose
+        whole point is fencing a deposed leader FAST: blocking apiserver
+        I/O there (a lease GET + Event write, each with a ~30 s HTTP
+        timeout against an apiserver that just failed us) would extend
+        the old leader's life 30-90 s past its forfeited lease while the
+        successor is already active — manufacturing exactly the
+        split-brain the lease prevents. So a loss is journaled (local,
+        microseconds) and logged, never written to the apiserver; the
+        successor's LeaderElected event carries the succession into the
+        cluster record. Never raises: surfacing must not break
+        election."""
+        try:
+            self.journal.decide(
+                "lease",
+                key=f"leases/{self.namespace}/{self.lease_name}",
+                action=("acquired" if reason == REASON_LEADER_ELECTED
+                        else "lost"),
+                identity=self.identity, detail=detail,
+            )
+        except Exception:  # noqa: BLE001 — flight recorder, not control
+            pass
+        if self.recorder is None or reason != REASON_LEADER_ELECTED:
+            return
+        try:
+            lease = self._get()
+            if lease is not None:
+                self.recorder.event(
+                    lease, "Normal", reason,
+                    f"{self.identity}: {detail}",
+                )
+        except Exception:  # noqa: BLE001
+            pass
 
     def _wire_duration(self):
         """Lease.spec.leaseDurationSeconds is int32 on a real apiserver;
@@ -274,6 +332,9 @@ class LeaderElector:
                     log.error("leader election: lease %s taken by %s",
                               self.lease_name, holder)
                     self.is_leader = False
+                    self._surface_transition(
+                        REASON_LEADER_LOST, f"deposed by {holder}"
+                    )
                     self.on_lost()
                     return
             except errors.ApiError as e:
@@ -282,5 +343,9 @@ class LeaderElector:
                 return
             if self._mono() > deadline:
                 self.is_leader = False
+                self._surface_transition(
+                    REASON_LEADER_LOST,
+                    "renew deadline exceeded (self-eviction)",
+                )
                 self.on_lost()
                 return

@@ -1,12 +1,15 @@
 """Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz,
-/debug/tracez, /debug/threadz.
+/debug/tracez, /debug/explainz, /slostatus, /debug/threadz.
 
 The manager-port surface of the reference binaries (metrics on :8080,
 probes — components/notebook-controller/main.go:64-131), plus the
 observability pages the reference never had: /debug/tracez renders the
 process's recent lifecycle traces slowest-first (obs/tracez.py;
 ``?key=notebooks/<ns>/<name>`` filters to one object, ``?limit=N``
-bounds the page).
+bounds the page); /debug/explainz/<ns>/<name> is the cpscope explain
+engine's operator view — conditions + Events + spans + journal stitched
+into one causal timeline (obs/explain.py); /slostatus reports declared
+SLO attainment and error-budget burn (obs/slo.py).
 """
 
 from __future__ import annotations
@@ -22,16 +25,23 @@ from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
 
 def serve_ops(port: int, registry=None, ready_check=None,
               host: str = "0.0.0.0", tracer=None,
-              ready_detail=None) -> ThreadingHTTPServer:
+              ready_detail=None, kube=None, journal=None,
+              slo=None) -> ThreadingHTTPServer:
     """Start the ops endpoint in a daemon thread; returns the server.
 
     ``ready_check() -> bool`` drives /readyz's status code;
     ``ready_detail() -> dict`` (typically ``Manager.informer_status``)
     powers ``/readyz?verbose`` — the JSON diagnosis of WHY readiness is
     false (which informer is wedged, how many consecutive failures, how
-    stale its last relist is) rather than just the fact of it."""
+    stale its last relist is) rather than just the fact of it.
+
+    ``kube``/``journal`` feed /debug/explainz (conditions+Events come
+    from the client, decisions from the journal; both optional — the
+    page degrades to whatever sources exist and says which are absent);
+    ``slo`` (an obs.SloEngine) serves /slostatus."""
     reg = registry if registry is not None else REGISTRY
     trc = tracer if tracer is not None else obs.TRACER
+    jnl = journal if journal is not None else obs.JOURNAL
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -77,6 +87,31 @@ def serve_ops(port: int, registry=None, ready_check=None,
                                          key=key).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain")
+            elif self.path.startswith("/debug/explainz/"):
+                # /debug/explainz/<ns>/<name> — operator view, no
+                # tenant redaction (this port is cluster-internal, like
+                # /debug/tracez's scheduler attrs)
+                parts = urlparse(self.path).path.split("/")
+                if len(parts) == 5 and parts[3] and parts[4]:
+                    record = obs.explain(parts[3], parts[4], kube=kube,
+                                         tracer=trc, journal=jnl)
+                    body = obs.render_explain(record).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                else:
+                    body = b"usage: /debug/explainz/<namespace>/<name>"
+                    self.send_response(400)
+            elif self.path.startswith("/slostatus"):
+                if slo is not None:
+                    body = json.dumps(slo.status(), indent=2,
+                                      sort_keys=True).encode()
+                else:
+                    body = json.dumps(
+                        {"schema": "slostatus/v1", "objectives": {},
+                         "note": "no SloEngine wired on this port"}
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
             elif self.path.startswith("/debug/threadz"):
                 # the Python analog of Go's pprof goroutine dump
                 # (SURVEY.md §5: the reference has no profiling wiring;

@@ -1,125 +1,16 @@
-"""Event recording — the controllers' user-visible debugging surface.
-
-The reference notebook reconciler re-emits pod/StatefulSet events onto
-the Notebook CR through client-go's EventRecorder so users see scheduling
-failures and image-pull errors on the object they created
-(components/notebook-controller/controllers/notebook_controller.go:94-122,
-event watch wiring :691-739). This module is the recorder half of that
-design, built on the stdlib kube client: v1 Events with client-go-style
-aggregation — a stable name per (involvedObject, reason, message) and a
-``count``/``lastTimestamp`` bump on repeats instead of a new object per
-occurrence.
+"""Compat shim — event recording moved to ``controlplane/obs/events.py``
+(cpscope). The correlating recorder (dedup, aggregation, token-bucket
+rate limiting) lives there with the rest of the observability stack;
+this module keeps the historical import path working, same pattern as
+``tools/metrics_lint.py`` after the cplint fold-in.
 """
 
 from __future__ import annotations
 
-import datetime
-import hashlib
-import logging
-
-from service_account_auth_improvements_tpu.controlplane.kube import errors
-
-log = logging.getLogger(__name__)
-
-NORMAL = "Normal"
-WARNING = "Warning"
-
-
-def _now() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y-%m-%dT%H:%M:%SZ"
-    )
-
-
-class EventRecorder:
-    """Records v1 Events against an involved object.
-
-    ``event()`` is fire-and-forget: a failed write is logged, never
-    raised — losing an Event must not fail a reconcile (client-go's
-    recorder is asynchronous for the same reason).
-    """
-
-    def __init__(self, kube, component: str):
-        self.kube = kube
-        self.component = component
-
-    def event(self, obj: dict, etype: str, reason: str,
-              message: str) -> None:
-        try:
-            self.emit(obj, etype, reason, message)
-        except errors.ApiError as e:
-            log.warning("event %s/%s dropped: %s", reason,
-                        obj["metadata"].get("name"), e)
-
-    def emit(self, obj: dict, etype: str, reason: str,
-             message: str) -> None:
-        """Raising variant of ``event()`` — for callers with their own
-        retry policy (e.g. the notebook re-emission worker)."""
-        meta = obj["metadata"]
-        namespace = meta.get("namespace")
-        involved = {
-            "kind": obj.get("kind", ""),
-            "apiVersion": obj.get("apiVersion", ""),
-            "name": meta["name"],
-            "namespace": namespace,
-            "uid": meta.get("uid", ""),
-        }
-        # The digest must include the recorder's component (and namespace):
-        # two controllers emitting the same (kind, name, type, reason,
-        # message) would otherwise collide on one Event object and the
-        # second write would be mis-attributed to the first's
-        # source.component.
-        digest = hashlib.sha1(
-            "\x00".join((self.component, namespace or "", involved["kind"],
-                         involved["name"], etype, reason,
-                         message)).encode()
-        ).hexdigest()[:12]
-        name = f"{meta['name']}.{digest}"
-        now = _now()
-        try:
-            existing = self.kube.get("events", name, namespace=namespace)
-        except errors.NotFound:
-            existing = None
-        if existing is not None:
-            self.kube.patch(
-                "events", name,
-                {"count": int(existing.get("count") or 1) + 1,
-                 "lastTimestamp": now},
-                namespace=namespace,
-            )
-            return
-        try:
-            self.kube.create("events", {
-                "apiVersion": "v1",
-                "kind": "Event",
-                "metadata": {"name": name, "namespace": namespace},
-                "involvedObject": involved,
-                "type": etype,
-                "reason": reason,
-                "message": message,
-                "count": 1,
-                "firstTimestamp": now,
-                "lastTimestamp": now,
-                "source": {"component": self.component},
-                "reportingComponent": self.component,
-            }, namespace=namespace)
-        except errors.AlreadyExists:
-            # lost a create race with another worker — re-read the winner's
-            # count so occurrences aren't undercounted, then fold into a
-            # bump. Two workers can still read N concurrently and both
-            # write N+1 (get-then-patch): acceptable for events, which are
-            # best-effort counters; exactness would need a server-side
-            # increment k8s doesn't offer for event counts.
-            try:
-                existing = self.kube.get("events", name, namespace=namespace)
-                count = int(existing.get("count") or 1) + 1
-            except errors.ApiError:
-                count = 2
-            self.kube.patch("events", name,
-                            {"count": count, "lastTimestamp": now},
-                            namespace=namespace)
-
-
-def involved_kind_and_name(event: dict) -> tuple[str, str]:
-    involved = event.get("involvedObject") or {}
-    return involved.get("kind", ""), involved.get("name", "")
+from service_account_auth_improvements_tpu.controlplane.obs.events import (  # noqa: F401,E501
+    AGGREGATE_PREFIX,
+    NORMAL,
+    WARNING,
+    EventRecorder,
+    involved_kind_and_name,
+)

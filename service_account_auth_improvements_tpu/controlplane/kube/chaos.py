@@ -94,6 +94,22 @@ class ChaosInjector:
         #: injection journal (bounded) + counters for bench reports
         self.log: list[dict] = []
         self.counters: dict[str, int] = {}
+        #: optional cpscope decision journal (obs/journal.py): scripted
+        #: injections land there as kind="chaos" entries so a notebook's
+        #: explain timeline can name the blackout that stalled it —
+        #: per-request noise (blackholed/errored/dropped counts) stays
+        #: in the counters only
+        self.journal = None
+
+    #: _note kinds that are SCRIPTED actions (one entry per injection),
+    #: journal-worthy; the rest are per-request/per-event tallies that
+    #: would flood a bounded decision ring
+    JOURNALED_KINDS = frozenset({
+        "blackout_started", "blackout_ended", "watches_severed",
+        "gone_storm", "verb_latency_set", "verb_error_rate_set",
+        "watch_faults_set", "nodes_killed", "nodes_repaired",
+        "kubelet_stalled", "kubelet_unstalled",
+    })
 
     # ------------------------------------------------------------ journal
 
@@ -103,6 +119,12 @@ class ChaosInjector:
             if len(self.log) < 512:
                 self.log.append({"t": time.monotonic(), "kind": kind,
                                  **attrs})
+            journal = self.journal
+        if journal is not None and kind in self.JOURNALED_KINDS:
+            try:
+                journal.decide("chaos", action=kind, **attrs)
+            except Exception:
+                pass  # a journal bug must never fail an injection
 
     def summary(self) -> dict:
         with self._lock:

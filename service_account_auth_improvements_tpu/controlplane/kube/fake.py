@@ -118,6 +118,13 @@ class FakeKube:
         #: without chaos scripting. 0 disables.
         self.compact_every_n_events = 0
         self._emits_since_compact = 0
+        #: core-v1 Event TTL (seconds; a real apiserver defaults to 1 h
+        #: via --event-ttl). Events whose lastTimestamp is older are
+        #: swept whenever history compacts (compact_history and the
+        #: auto-compaction above) — so controller churn can never grow
+        #: the Event store monotonically. None/0 disables (tests that
+        #: assert on events stay deterministic by default).
+        self.event_ttl_s: float | None = None
         #: internal actors (the synchronous GC cascade) are not network
         #: clients: chaos must not leave half a cascade behind as
         #: permanent orphans a real garbage collector would retry away
@@ -188,6 +195,7 @@ class FakeKube:
                     if hist:
                         self._pruned[k] = hist[-1][0]
                         self._history[k] = []
+                self._gc_events_locked()
         chaos = self.chaos
         if chaos is not None:
             chaos.sweep()
@@ -561,6 +569,36 @@ class FakeKube:
                 if self._history.get(hkey):
                     self._pruned[hkey] = self._history[hkey][-1][0]
                     self._history[hkey] = []
+            self._gc_events_locked()
+
+    def _gc_events_locked(self) -> None:
+        """TTL sweep of core-v1 Events, piggybacking on history
+        compaction (the apiserver's --event-ttl, approximated: real
+        clusters do it in etcd via lease expiry; compaction time is
+        when this fake already accepts losing history). Caller holds
+        ``self._lock``. Deletion goes through the normal path so
+        watchers see DELETED, like any other removal."""
+        if not self.event_ttl_s:
+            return
+        import calendar
+
+        cutoff = time.time() - self.event_ttl_s
+        doomed = []
+        for key, obj in self._store.items():
+            if key[0] != "" or key[1] != "events":
+                continue
+            raw = (obj.get("lastTimestamp") or obj.get("firstTimestamp")
+                   or obj["metadata"].get("creationTimestamp"))
+            try:
+                ts = calendar.timegm(
+                    time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ"))
+            except (TypeError, ValueError):
+                continue  # unparseable stamp: never silently GC it
+            if ts < cutoff:
+                doomed.append(key)
+        res = self._res("events") if doomed else None
+        for key in doomed:
+            self._finish_delete(res, key)
 
     def _sever_watches(self) -> int:
         """Connection-reset every live watch (chaos blackout): mark the

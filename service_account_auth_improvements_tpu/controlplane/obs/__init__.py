@@ -1,4 +1,5 @@
-"""cptrace: end-to-end reconcile tracing (docs/observability.md)."""
+"""cpscope: tracing, events, decision journal, explain engine, SLOs
+(docs/observability.md)."""
 
 from service_account_auth_improvements_tpu.controlplane.obs.trace import (  # noqa: F401,E501
     TRACE_ANNOTATION,
@@ -15,4 +16,27 @@ from service_account_auth_improvements_tpu.controlplane.obs.trace import (  # no
 from service_account_auth_improvements_tpu.controlplane.obs.tracez import (  # noqa: F401,E501
     render_trace,
     render_tracez,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.events import (  # noqa: F401,E501
+    NORMAL,
+    WARNING,
+    EventRecorder,
+    involved_kind_and_name,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.journal import (  # noqa: F401,E501
+    JOURNAL,
+    Journal,
+    current_journal,
+    decide,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.explain import (  # noqa: F401,E501
+    explain,
+    redact as redact_explain,
+    render_explain,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.slo import (  # noqa: F401,E501
+    DEFAULT_OBJECTIVES,
+    Objective,
+    SloEngine,
+    observe as slo_observe,
 )

@@ -1,0 +1,307 @@
+"""cpscope event recording: correlated, aggregated, rate-limited Events.
+
+The controllers' user-visible debugging surface, rebuilt to client-go's
+EventCorrelator contract (client-go tools/record: EventAggregator +
+eventLogger + EventSourceObjectSpamFilter). The first recorder
+(PR 0's ``controlplane/events.py``, now a thin re-export of this module)
+round-tripped a GET per repeat and had no spam control at all — a
+hot-looping controller could storm the apiserver with its own telemetry,
+which is exactly the failure mode Events exist to *diagnose*. Three
+layers fix that, all decided locally before any apiserver call:
+
+- **dedup** — a stable name per (component, involvedObject, type,
+  reason, message) digest; repeats become one ``count``/``lastTimestamp``
+  PATCH against the remembered count (no read-modify-write round trip
+  after the first occurrence);
+- **aggregation** — more than ``aggregate_after`` *distinct* messages
+  for one (involvedObject, type, reason) group collapse into a single
+  "(combined from similar events)" Event whose message tracks the latest
+  occurrence: cardinality stays bounded no matter how creative the
+  failure text gets;
+- **token-bucket rate limiting** — per involved object, ``burst``
+  events then one earned back every ``refill_s/burst`` seconds
+  (client-go's spam filter: 25 / qps 1/300); beyond that the record is
+  DROPPED locally and counted in :meth:`stats`, never sent.
+
+Clocks are injected (``now_fn`` wall for timestamps, ``mono_fn`` for
+the bucket) so chaos scenarios and the cplint clock-injection pass can
+drive them deterministically.
+
+Reason strings are part of the public, queryable surface (``kubectl get
+events --field-selector reason=...``, dashboards group by them), so they
+are constants — the cplint ``event-reason`` pass holds every call site
+to module-level CamelCase constants, no f-strings.
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import hashlib
+import logging
+import threading
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+log = logging.getLogger(__name__)
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+#: message prefix of an aggregated Event (client-go parity, verbatim)
+AGGREGATE_PREFIX = "(combined from similar events): "
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _mono() -> float:
+    import time
+
+    return time.monotonic()
+
+
+def _fmt(ts: datetime.datetime) -> str:
+    return ts.strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
+class EventRecorder:
+    """Records v1 Events against an involved object (module docstring
+    has the correlation pipeline).
+
+    ``event()`` is fire-and-forget: a failed write is logged, never
+    raised — losing an Event must not fail a reconcile. ``emit()`` is
+    the raising variant for callers with their own retry policy (the
+    notebook re-emission worker). Both return ``True`` when a write was
+    issued and ``False`` when the spam filter dropped the record.
+    """
+
+    def __init__(self, kube, component: str, *,
+                 burst: int = 25, refill_s: float = 300.0,
+                 aggregate_after: int = 10, cache_size: int = 512,
+                 now_fn=None, mono_fn=None):
+        self.kube = kube
+        self.component = component
+        self.burst = burst
+        self.refill_s = refill_s
+        self.aggregate_after = aggregate_after
+        self.cache_size = cache_size
+        self._now = now_fn if now_fn is not None else _utcnow
+        self._mono = mono_fn if mono_fn is not None else _mono
+        self._lock = threading.Lock()
+        #: event object name -> last count this recorder wrote (the
+        #: dedup cache: repeats patch count+1 with no preceding GET)
+        self._counts: collections.OrderedDict = collections.OrderedDict()
+        #: (involved, type, reason) group -> set of message digests (the
+        #: aggregation trigger) — LRU-bounded like the count cache
+        self._messages: collections.OrderedDict = collections.OrderedDict()
+        #: per-involved-object token bucket: key -> [tokens, last_mono]
+        self._buckets: collections.OrderedDict = collections.OrderedDict()
+        self._dropped = 0
+        self._aggregated = 0
+        self._emitted = 0
+
+    # ------------------------------------------------------------- public
+
+    def event(self, obj: dict, etype: str, reason: str,
+              message: str, namespace: str | None = None) -> bool:
+        try:
+            return self.emit(obj, etype, reason, message,
+                             namespace=namespace)
+        except errors.ApiError as e:
+            log.warning("event %s/%s dropped: %s", reason,
+                        obj["metadata"].get("name"), e)
+            return False
+
+    def emit(self, obj: dict, etype: str, reason: str,
+             message: str, namespace: str | None = None) -> bool:
+        """``namespace`` overrides where the Event OBJECT lives — Events
+        are namespaced even when the involved object isn't (a
+        cluster-scoped Profile's events land in the tenant namespace it
+        manages, where the tenant can actually read them)."""
+        meta = obj["metadata"]
+        involved = {
+            "kind": obj.get("kind", ""),
+            "apiVersion": obj.get("apiVersion", ""),
+            "name": meta["name"],
+            "namespace": meta.get("namespace"),
+            "uid": meta.get("uid", ""),
+        }
+        namespace = namespace or meta.get("namespace") or "default"
+        # correlate under the lock — pure local state; the apiserver
+        # write happens after the lock drops (lockwatch held-write rule)
+        with self._lock:
+            if not self._take_token_locked(involved):
+                self._dropped += 1
+                return False
+            name, message, count = self._correlate_locked(
+                involved, etype, reason, message
+            )
+            self._emitted += 1
+        now = _fmt(self._now())
+        if count > 1:
+            try:
+                self._bump(name, namespace, count, now, message)
+                return True
+            except errors.NotFound:
+                # the Event was GC'd (TTL) mid-life: restart its count
+                # and recreate below
+                with self._lock:
+                    self._counts[name] = 1
+        self._write_new(name, namespace, involved, etype, reason,
+                        message, now)
+        return True
+
+    def stats(self) -> dict:
+        """{emitted, dropped_rate_limited, aggregated} — cpbench reports
+        these per scenario so spam control is visible, not silent."""
+        with self._lock:
+            return {
+                "emitted": self._emitted,
+                "dropped_rate_limited": self._dropped,
+                "aggregated": self._aggregated,
+            }
+
+    # --------------------------------------------------------- correlation
+
+    def _take_token_locked(self, involved: dict) -> bool:
+        """Spam filter: one bucket per involved object. Caller holds the
+        lock."""
+        key = (involved["namespace"], involved["kind"], involved["name"])
+        now = self._mono()
+        bucket = self._buckets.get(key)
+        if bucket is None:
+            bucket = [float(self.burst), now]
+            self._buckets[key] = bucket
+            self._trim_locked(self._buckets)
+        else:
+            self._buckets.move_to_end(key)
+        tokens, last = bucket
+        if self.refill_s > 0:
+            tokens = min(float(self.burst),
+                         tokens + (now - last) * self.burst / self.refill_s)
+        bucket[1] = now
+        if tokens < 1.0:
+            bucket[0] = tokens
+            return False
+        bucket[0] = tokens - 1.0
+        return True
+
+    def _correlate_locked(self, involved: dict, etype: str, reason: str,
+                          message: str) -> tuple[str, str, int]:
+        """(event name, possibly-aggregated message, count). Caller
+        holds the lock."""
+        gkey = (self.component, involved["namespace"], involved["kind"],
+                involved["name"], etype, reason)
+        digests = self._messages.get(gkey)
+        if digests is None:
+            digests = set()
+            self._messages[gkey] = digests
+            self._trim_locked(self._messages)
+        else:
+            self._messages.move_to_end(gkey)
+        mdigest = hashlib.sha1(message.encode()).hexdigest()[:12]
+        aggregate = (len(digests) >= self.aggregate_after
+                     and mdigest not in digests)
+        if not aggregate:
+            digests.add(mdigest)
+        if aggregate:
+            # past the similarity threshold: everything new folds into
+            # ONE aggregate Event for the group, message tracking the
+            # latest occurrence (client-go EventAggregator semantics)
+            self._aggregated += 1
+            message = AGGREGATE_PREFIX + message
+            digest = hashlib.sha1(
+                "\x00".join(("aggregate",) + tuple(
+                    str(p) for p in gkey)).encode()
+            ).hexdigest()[:12]
+        else:
+            # The digest must include the recorder's component (and
+            # namespace): two controllers emitting the same (kind, name,
+            # type, reason, message) would otherwise collide on one
+            # Event object and the second write would be mis-attributed
+            # to the first's source.component.
+            digest = hashlib.sha1(
+                "\x00".join((self.component, involved["namespace"] or "",
+                             involved["kind"], involved["name"], etype,
+                             reason, message)).encode()
+            ).hexdigest()[:12]
+        name = f"{involved['name']}.{digest}"
+        count = self._counts.get(name, 0) + 1
+        self._counts[name] = count
+        self._counts.move_to_end(name)
+        self._trim_locked(self._counts)
+        return name, message, count
+
+    def _trim_locked(self, lru: collections.OrderedDict) -> None:
+        while len(lru) > self.cache_size:
+            lru.popitem(last=False)
+
+    # ---------------------------------------------------------- API writes
+
+    def _bump(self, name: str, namespace: str | None, count: int,
+              now: str, message: str) -> None:
+        """Repeat occurrence: one PATCH, no read. The remembered count is
+        authoritative for this recorder; a raced writer at worst lands a
+        nearby value — Events are best-effort counters (k8s offers no
+        server-side increment for them)."""
+        patch = {"count": count, "lastTimestamp": now}
+        if message.startswith(AGGREGATE_PREFIX):
+            patch["message"] = message  # aggregate tracks the latest text
+        self.kube.patch("events", name, patch, namespace=namespace)
+
+    def _write_new(self, name: str, namespace: str | None, involved: dict,
+                   etype: str, reason: str, message: str,
+                   now: str) -> None:
+        """First occurrence this process has seen: reconcile against any
+        survivor from a previous incarnation (GET), else create."""
+        try:
+            existing = self.kube.get("events", name, namespace=namespace)
+        except errors.NotFound:
+            existing = None
+        if existing is not None:
+            count = int(existing.get("count") or 1) + 1
+            with self._lock:
+                self._counts[name] = count
+            self.kube.patch(
+                "events", name,
+                {"count": count, "lastTimestamp": now},
+                namespace=namespace,
+            )
+            return
+        try:
+            self.kube.create("events", {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": namespace},
+                "involvedObject": involved,
+                "type": etype,
+                "reason": reason,
+                "message": message,
+                "count": 1,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "source": {"component": self.component},
+                "reportingComponent": self.component,
+            }, namespace=namespace)
+        except errors.AlreadyExists:
+            # lost a create race with another worker — re-read the
+            # winner's count so occurrences aren't undercounted, fold
+            # into a bump
+            try:
+                existing = self.kube.get("events", name,
+                                         namespace=namespace)
+                count = int(existing.get("count") or 1) + 1
+            except errors.ApiError:
+                count = 2
+            with self._lock:
+                self._counts[name] = count
+            self.kube.patch("events", name,
+                            {"count": count, "lastTimestamp": now},
+                            namespace=namespace)
+
+
+def involved_kind_and_name(event: dict) -> tuple[str, str]:
+    involved = event.get("involvedObject") or {}
+    return involved.get("kind", ""), involved.get("name", "")

@@ -1,0 +1,365 @@
+"""cpscope explain engine: "why isn't notebook X Ready", answered.
+
+Stitches the four evidence sources the stack already produces into ONE
+causal, time-ordered timeline per notebook:
+
+- **conditions** on the CR (Scheduled/SliceIncomplete/GangScheduled/...)
+  — the level state;
+- **Events** involving the CR (obs/events.py recorder + kubelet
+  re-emissions) — the discrete history, with counts;
+- **spans** from the object's trace (obs/trace.py) — where the time
+  went;
+- **journal entries** (obs/journal.py) — the decisions, including
+  ambient ones with no per-object key: chaos injections and lease
+  transitions that overlap the object's lifetime explain stalls nothing
+  object-scoped can (a recovered notebook's timeline must name the
+  blackout, not a generic timeout).
+
+Surfaces: ``/debug/explainz/<ns>/<name>`` on every ops port
+(engine/serve.py, operator view, plain text) and the SAR-gated dashboard
+``GET /api/explain/<ns>/<notebook>`` (tenant view, JSON) — the latter
+through :func:`redact` with the same tenant boundary as the traces API:
+no cluster-wide chip counts or queue depths, no cross-namespace victim
+names (those are redacted at record time by the scheduler; redact()
+drops the cluster-scoped attrs).
+
+Monotonic stamps are projected onto the wall clock with one offset
+captured at explain time — exact enough for a single process, which is
+where every source lives.
+"""
+
+from __future__ import annotations
+
+import copy
+import datetime
+import time
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.obs import journal as journal_mod  # noqa: E501
+from service_account_auth_improvements_tpu.controlplane.obs.trace import (
+    TRACER,
+    object_key,
+)
+
+#: journal kinds with no per-object key that still belong on every
+#: overlapping timeline — cluster-level causes of object-level symptoms
+AMBIENT_KINDS = ("chaos", "lease")
+
+#: span names that carry explanatory weight (the reconcile firehose is
+#: summarized, not listed — except failures, which are always evidence)
+TIMELINE_SPANS = {
+    "apiserver.create", "sched.admit", "sched.queue_wait", "sched.place",
+    "sched.preempt", "notebook.children", "notebook.gang",
+    "notebook.ready", "kubelet.actuation",
+}
+
+#: attrs that never cross the tenant boundary (same contract as the
+#: dashboard traces API): cluster-wide occupancy is operator-only
+CLUSTER_ATTRS = ("free_chips", "queue_depth")
+
+
+def _parse_wall(raw) -> float | None:
+    """K8s timestamp string -> epoch seconds, else None."""
+    if not raw:
+        return None
+    for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S.%fZ"):
+        try:
+            return datetime.datetime.strptime(raw, fmt).replace(
+                tzinfo=datetime.timezone.utc).timestamp()
+        except (ValueError, TypeError):
+            continue
+    return None
+
+
+def _iso(epoch: float | None) -> str | None:
+    if epoch is None:
+        return None
+    return datetime.datetime.fromtimestamp(
+        epoch, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+class ExplainSources:
+    """Pre-fetched, pre-indexed Event + journal sources for BATCH
+    explains: cpbench explains every object a scenario drove, and
+    re-LISTing the namespace's Events plus re-snapshotting the whole
+    journal ring per object is O(objects x (events + ring)) for
+    identical data. One LIST per namespace, one ring snapshot, indexed
+    by involved name / object key."""
+
+    def __init__(self, kube=None, journal=None,
+                 namespaces: tuple = ()):
+        jnl = journal if journal is not None else \
+            journal_mod.current_journal()
+        self._events: dict[tuple, list] = {}
+        #: Events listed across the given namespaces (cpbench's
+        #: event_count comes from here — no extra LIST)
+        self.total_events = 0
+        self.events_ok = kube is not None
+        if kube is not None:
+            for ns in namespaces:
+                try:
+                    listed = kube.list("events", namespace=ns)["items"]
+                except errors.ApiError:
+                    self.events_ok = False
+                    continue
+                self.total_events += len(listed)
+                for ev in listed:
+                    inv = ev.get("involvedObject") or {}
+                    self._events.setdefault(
+                        (ns, inv.get("name")), []).append(ev)
+        snap = jnl.entries()
+        self._journal: dict[str, list] = {}
+        self.ambient: list = []
+        for e in snap:
+            if e.get("key") is not None:
+                self._journal.setdefault(e["key"], []).append(e)
+            elif e["kind"] in AMBIENT_KINDS:
+                self.ambient.append(e)
+
+    def events_for(self, namespace: str | None, name: str) -> list:
+        return self._events.get((namespace, name), [])
+
+    def journal_for(self, key: str) -> list:
+        return self._journal.get(key, [])
+
+
+def explain(namespace: str | None, name: str, *, kube=None, tracer=None,
+            journal=None, plural: str = "notebooks",
+            group: str | None = "tpukf.dev",
+            prefetched: "ExplainSources | None" = None) -> dict:
+    """Build the explain record for one object. Every source is
+    optional — the engine reports what it can see, and says what it
+    couldn't (an explainer that silently omits a dead source would turn
+    'no data' into 'no problem'). Batch callers (cpbench explains every
+    object of a scenario) pass ``prefetched`` (:class:`ExplainSources`)
+    so N explains cost one Event LIST and one journal snapshot instead
+    of N of each."""
+    trc = tracer if tracer is not None else TRACER
+    jnl = journal if journal is not None else journal_mod.current_journal()
+    key = object_key(plural, namespace, name)
+    # one offset projects monotonic stamps onto the wall clock
+    mono_to_wall = time.time() - time.monotonic()
+    items: list[dict] = []
+    sources: dict[str, bool] = {}
+
+    obj = None
+    if kube is not None:
+        try:
+            obj = kube.get(plural, name, namespace=namespace, group=group)
+            sources["object"] = True
+        except errors.NotFound:
+            sources["object"] = False
+        except errors.ApiError:
+            sources["object"] = False
+    for cond in ((obj or {}).get("status") or {}).get("conditions") or []:
+        wall = _parse_wall(cond.get("lastTransitionTime")
+                           or cond.get("lastProbeTime"))
+        what = f"condition {cond.get('type')}={cond.get('status', '?')}"
+        if cond.get("reason"):
+            what += f" {cond['reason']}"
+        if cond.get("message"):
+            what += f": {cond['message']}"
+        items.append({"wall": wall, "source": "condition", "what": what,
+                      "attrs": {k: cond[k] for k in
+                                ("queuePosition", "queueTotal")
+                                if k in cond}})
+
+    events = None
+    if prefetched is not None:
+        events = prefetched.events_for(namespace, name)
+        sources["events"] = prefetched.events_ok
+    elif kube is not None and namespace:
+        try:
+            events = kube.list("events", namespace=namespace)["items"]
+            sources["events"] = True
+        except errors.ApiError:
+            events, sources["events"] = [], False
+    if events is not None:
+        for ev in events:
+            inv = ev.get("involvedObject") or {}
+            if inv.get("name") != name:
+                continue
+            wall = _parse_wall(ev.get("lastTimestamp")
+                               or ev.get("firstTimestamp"))
+            count = int(ev.get("count") or 1)
+            what = (f"event {ev.get('type', 'Normal')}/"
+                    f"{ev.get('reason', '?')}"
+                    + (f" x{count}" if count > 1 else "")
+                    + f": {ev.get('message', '')}")
+            items.append({"wall": wall, "source": "event", "what": what,
+                          "attrs": {"reason": ev.get("reason"),
+                                    "count": count}})
+
+    snap = trc.snapshot(key=key)
+    sources["trace"] = snap is not None
+    window_lo = None
+    if snap is not None:
+        reconciles = errors_n = 0
+        for s in snap["spans"]:
+            start = s["start"] + mono_to_wall
+            window_lo = start if window_lo is None else min(window_lo, start)
+            if s["name"] == "reconcile":
+                reconciles += 1
+                errors_n += bool(s["error"])
+                if not s["error"]:
+                    continue  # the firehose is summarized below
+            if s["name"] not in TIMELINE_SPANS and not s["error"]:
+                continue
+            dur = ((s["end"] - s["start"]) * 1000.0
+                   if s["end"] is not None else None)
+            what = f"span {s['name']}"
+            if dur is not None:
+                what += f" ({dur:.1f}ms)"
+            if s["error"]:
+                what += (" ERROR "
+                         + str(s["attrs"].get("error.message", "")))
+            items.append({"wall": start, "source": "span", "what": what,
+                          "attrs": dict(s["attrs"])})
+        if reconciles:
+            items.append({
+                "wall": window_lo, "source": "span",
+                "what": f"reconciles: {reconciles} total, "
+                        f"{errors_n} errored",
+                "attrs": {"reconciles": reconciles,
+                          "reconcile_errors": errors_n},
+            })
+
+    if prefetched is not None:
+        entries = prefetched.journal_for(key)
+        ambient = prefetched.ambient
+    else:
+        entries = jnl.entries(key=key)
+        ambient = [e for e in jnl.entries(kinds=AMBIENT_KINDS)
+                   if e.get("key") is None]
+    sources["journal"] = bool(entries or ambient)
+    for e in entries:
+        if e["kind"] == "reconcile":
+            continue  # summarized via the trace above
+        wall = _parse_wall(e.get("wall")) or (
+            e["mono"] + mono_to_wall if e.get("mono") else None)
+        attrs = dict(e["attrs"])
+        what = f"decision {e['kind']}"
+        detail = attrs.get("pool") or attrs.get("outcome") \
+            or attrs.get("reason") or attrs.get("action")
+        if detail:
+            what += f": {detail}"
+        items.append({"wall": wall, "source": "journal", "what": what,
+                      "attrs": attrs})
+    lo = window_lo if window_lo is not None else min(
+        (i["wall"] for i in items if i["wall"] is not None),
+        default=None)
+    for e in ambient:
+        wall = _parse_wall(e.get("wall")) or (
+            e["mono"] + mono_to_wall if e.get("mono") else None)
+        if lo is not None and wall is not None and wall < lo - 1.0:
+            continue  # before this object's lifetime: not its story
+        attrs = dict(e["attrs"])
+        action = attrs.get("action", "")
+        what = f"{e['kind']}: {action}"
+        if action == "blackout_started":
+            what = (f"chaos: apiserver blackout began "
+                    f"({attrs.get('duration_s', '?')}s window — every "
+                    "verb 503, watch channels severed)")
+        elif action == "blackout_ended":
+            what = "chaos: apiserver blackout ended"
+        items.append({"wall": wall, "source": e["kind"], "what": what,
+                      "attrs": attrs})
+
+    items.sort(key=lambda i: (i["wall"] is None, i["wall"] or 0.0))
+    for i in items:
+        i["wall_iso"] = _iso(i["wall"])
+
+    ready = None
+    if obj is not None:
+        ready = _is_ready(obj, plural)
+    verdict = _verdict(obj, ready, items, sources)
+    return {
+        "key": key, "namespace": namespace, "name": name,
+        "ready": ready, "verdict": verdict, "sources": sources,
+        "timeline": items,
+    }
+
+
+def _is_ready(obj: dict, plural: str) -> bool:
+    """The controller's own readiness test, not truthiness: a 4-host
+    gang with 1/4 hosts up has readyReplicas == 1, and calling that
+    'Ready' would report the exact stuck-gang case this engine exists
+    to diagnose as healthy. For notebooks the target is the resolved
+    gang size (num_hosts x num_slices — notebook.py's want_ready); for
+    other plurals, any ready replica counts."""
+    have = ((obj.get("status") or {}).get("readyReplicas")) or 0
+    want = 1
+    if plural == "notebooks":
+        try:
+            from service_account_auth_improvements_tpu.controlplane import (  # noqa: E501
+                tpu,
+            )
+
+            resolved = tpu.resolve((obj.get("spec") or {}).get("tpu"))
+            if resolved is not None:
+                want = resolved.num_hosts * resolved.num_slices
+        except Exception:  # noqa: BLE001 — invalid spec: fall back to 1
+            pass
+    return have >= want
+
+
+def _verdict(obj, ready, items, sources) -> str:
+    if obj is None and not sources.get("trace") \
+            and not sources.get("journal"):
+        return "unknown object: no CR, no trace, no journal entries"
+    if ready:
+        return "Ready"
+    blocking = None
+    for cond in ((obj or {}).get("status") or {}).get("conditions") or []:
+        if cond.get("type") == "Scheduled" and cond.get("status") == "False":
+            blocking = (f"parked by tpusched: {cond.get('reason', '')} "
+                        f"{cond.get('message', '')}").strip()
+        if cond.get("type") in ("SliceIncomplete",
+                                "SlicePlacementConflict") \
+                and cond.get("status") == "True":
+            blocking = f"{cond['type']}: {cond.get('message', '')}"
+        if cond.get("type") == "InvalidTpuSpec" \
+                and cond.get("status") == "True":
+            blocking = f"invalid TPU spec: {cond.get('message', '')}"
+    if blocking:
+        return "not Ready — " + blocking
+    for i in reversed(items):
+        if i["source"] == "chaos":
+            return ("not Ready — most recent cluster-level cause: "
+                    + i["what"])
+    if obj is None:
+        return "object not found (deleted, or explain asked the wrong " \
+               "namespace)"
+    return "not Ready — no blocking condition recorded; see timeline"
+
+
+def redact(record: dict) -> dict:
+    """Tenant view of an explain record: deep copy with cluster-scoped
+    attrs removed from every item (the traces-API redaction contract —
+    snapshots are copies, the stored evidence must not change)."""
+    out = copy.deepcopy(record)
+    for item in out.get("timeline") or []:
+        attrs = item.get("attrs") or {}
+        for k in CLUSTER_ATTRS:
+            attrs.pop(k, None)
+    return out
+
+
+def render_explain(record: dict) -> str:
+    """Plain-text rendering for /debug/explainz — curl-friendly, one
+    line per timeline item."""
+    lines = [
+        f"EXPLAIN {record['key']}",
+        f"  ready: {record['ready']}",
+        f"  verdict: {record['verdict']}",
+        "  sources: " + ", ".join(
+            f"{k}={'ok' if v else 'absent'}"
+            for k, v in sorted(record["sources"].items())),
+        "",
+    ]
+    for item in record["timeline"]:
+        ts = item.get("wall_iso") or "????-??-??T??:??:??"
+        lines.append(f"  {ts}  [{item['source']:9s}] {item['what']}")
+    if not record["timeline"]:
+        lines.append("  (no recorded history)")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,160 @@
+"""cpscope decision journal: a bounded, durable-enough record of *why*.
+
+The span ring (obs/trace.py) answers "where did the time go" but wraps:
+a placement made an hour ago, the preemption that evicted a tenant, the
+chaos injection that explains a latency cliff — all gone once the ring
+turns over. The journal is the black-box flight recorder for
+*decisions*: a bounded ring of JSONL-serializable entries, each stamped
+with BOTH clocks (monotonic for ordering/intervals, wall for humans and
+cross-process correlation), fed two ways:
+
+- **span subscription** (:meth:`Journal.attach`): the journal rides the
+  existing ``Tracer.exporters`` hook and keeps every decision-shaped
+  span — reconcile outcomes, ``sched.admit``/``sched.place`` (the
+  (state, decision, outcome) tuple the ROADMAP's learned-placement item
+  harvests), ``sched.preempt``, ``notebook.ready``;
+- **explicit** :func:`decide` **call sites** for decisions that never
+  open a span: culls, lease transitions, chaos injections.
+
+``decide()`` (module-level) resolves the journal through
+``current_tracer().journal`` so reconcile-context callers need no
+wiring and cpbench worlds stay isolated, falling back to the
+process-global :data:`JOURNAL`.
+
+Lock discipline: one lock guards the ring and counters; entries are
+plain dicts built before acquisition; nothing under the lock ever
+touches the apiserver (lockwatch-clean by construction).
+"""
+
+from __future__ import annotations
+
+import collections
+import datetime
+import io
+import json
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.obs.trace import (
+    current_tracer,
+)
+
+SCHEMA = "cpjournal/v1"
+
+#: span name -> journal kind; spans outside this map are not decisions
+SPAN_KINDS = {
+    "reconcile": "reconcile",
+    "sched.admit": "admission",
+    "sched.place": "placement",
+    "sched.preempt": "preemption",
+    "notebook.ready": "ready",
+}
+
+
+def _utcnow() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _mono() -> float:
+    return time.monotonic()
+
+
+class Journal:
+    """Bounded ring of decision entries (module docstring)."""
+
+    def __init__(self, capacity: int = 8192, now_fn=None, mono_fn=None):
+        self.capacity = capacity
+        self._now = now_fn if now_fn is not None else _utcnow
+        self._mono = mono_fn if mono_fn is not None else _mono
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._counts: dict[str, int] = {}
+        self._seq = 0
+
+    # ------------------------------------------------------------- intake
+
+    def decide(self, kind: str, key: str | None = None, **attrs) -> dict:
+        """Record one decision; returns the entry (already stored)."""
+        entry = {
+            "kind": kind,
+            "key": key,
+            "mono": self._mono(),
+            "wall": self._now().strftime("%Y-%m-%dT%H:%M:%S.%fZ"),
+            "attrs": attrs,
+        }
+        with self._lock:
+            self._seq += 1
+            entry["seq"] = self._seq
+            self._ring.append(entry)
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+        return entry
+
+    def record_span(self, span: dict) -> None:
+        """``Tracer.exporters`` hook: keep decision-shaped spans."""
+        kind = SPAN_KINDS.get(span.get("name", ""))
+        if kind is None:
+            return
+        attrs = dict(span.get("attrs") or {})
+        if span.get("error"):
+            attrs["error"] = True
+        self.decide(kind, key=span.get("key"),
+                    span=span.get("name"), **attrs)
+
+    def attach(self, tracer) -> "Journal":
+        """Subscribe to ``tracer``'s exporter hook (idempotent) and make
+        this journal discoverable via ``current_tracer().journal``."""
+        if self.record_span not in tracer.exporters:
+            tracer.exporters.append(self.record_span)
+        tracer.journal = self
+        return self
+
+    # -------------------------------------------------------------- output
+
+    def entries(self, key: str | None = None,
+                kinds=None) -> list[dict]:
+        """Snapshot, oldest first. ``key`` filters to one object (plus
+        keyless entries are NOT included — callers that want ambient
+        context, like the explain engine folding in chaos windows, ask
+        for those kinds explicitly)."""
+        with self._lock:
+            snap = list(self._ring)
+        if key is not None:
+            snap = [e for e in snap if e.get("key") == key]
+        if kinds is not None:
+            wanted = set(kinds)
+            snap = [e for e in snap if e["kind"] in wanted]
+        return [dict(e, attrs=dict(e["attrs"])) for e in snap]
+
+    def counts(self) -> dict[str, int]:
+        """Per-kind totals since construction (NOT bounded by the ring —
+        the evidence that N decisions happened survives their eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def to_jsonl(self, key: str | None = None) -> str:
+        """The ring as JSONL — the cpbench black-box artifact format and
+        the harvest surface for the learned-placement training set."""
+        buf = io.StringIO()
+        for entry in self.entries(key=key):
+            buf.write(json.dumps(entry, sort_keys=True, default=str))
+            buf.write("\n")
+        return buf.getvalue()
+
+
+#: process-global journal — the analog of obs.TRACER; binaries attach it
+#: to the global tracer in cmd/runner.py, benches build their own
+JOURNAL = Journal()
+
+
+def current_journal() -> Journal:
+    """Journal attached to the innermost tracer, else the global one."""
+    j = getattr(current_tracer(), "journal", None)
+    return j if j is not None else JOURNAL
+
+
+def decide(kind: str, key: str | None = None, **attrs) -> dict:
+    return current_journal().decide(kind, key=key, **attrs)

@@ -1,0 +1,246 @@
+"""cpscope SLO engine: objectives, attainment, error-budget burn.
+
+Until now the plane had latency *measurements* (cpbench percentiles,
+engine histograms) but no *objectives* — nothing to tell a regression
+from noise, or CI from product truth. This module declares the
+objectives once and computes two numbers per objective from whatever
+samples exist:
+
+- **attainment** — the fraction of samples meeting the target
+  (``value_ms <= target_ms``). The objective is met when attainment ≥
+  the declared objective fraction (e.g. 0.95 for a p95 target);
+- **error-budget burn** — the violation fraction divided by the budget
+  (``1 - objective``). Burn 1.0 = spending the budget exactly as
+  declared; > 1.0 = burning faster (the page-worthy signal SRE burn-rate
+  alerts key on); < 1.0 = headroom.
+
+Samples come from raw lists (cpbench's exact timelines) or from the
+existing Prometheus histograms via :func:`attainment_from_histogram`
+(bucket-cumulative, no raw retention needed) — the production
+``/slostatus`` path. Gauges ``slo_attainment`` / ``slo_error_budget_burn``
+expose both per objective.
+
+The target numbers are PRODUCT ceilings, not bench baselines: the ±20%
+bench_gate envelope catches regressions long before an SLO trips; an SLO
+miss means the product promise broke, on any hardware.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Gauge,
+    Registry,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.trace import (
+    current_tracer,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    name: str
+    description: str
+    target_ms: float
+    #: required attainment fraction (0.95 = a p95 target)
+    objective: float = 0.95
+
+
+#: the declared objectives. create→Ready and time-to-placement come from
+#: the paper's product surface (notebook spawn latency IS the product);
+#: the recovery ceiling comes from the chaos family's recovery-time
+#: samples — a plane that heals slower than this isn't HA-ready.
+DEFAULT_OBJECTIVES = (
+    Objective(
+        "create_to_ready",
+        "notebook CR create -> status Ready, p95 under 15s",
+        target_ms=15_000.0,
+    ),
+    Objective(
+        "time_to_placement",
+        "tpusched admission -> node-pool stamp under contention, "
+        "p95 under 60s",
+        target_ms=60_000.0,
+    ),
+    Objective(
+        "recovery",
+        "chaos injection -> invariant-clean recovery, p95 under 30s",
+        target_ms=30_000.0,
+    ),
+)
+
+OBJECTIVES_BY_NAME = {o.name: o for o in DEFAULT_OBJECTIVES}
+
+
+def attainment(samples_ms, target_ms: float) -> float | None:
+    """Fraction of samples meeting the target; None without samples."""
+    xs = list(samples_ms)
+    if not xs:
+        return None
+    return sum(1 for v in xs if v <= target_ms) / len(xs)
+
+
+def burn_rate(attained: float | None, objective: float) -> float | None:
+    """Violation fraction over budget. An objective of 1.0 has zero
+    budget: any violation is infinite burn (represented as None-safe
+    large value by the caller's rendering; here: float('inf'))."""
+    if attained is None:
+        return None
+    budget = 1.0 - objective
+    violated = 1.0 - attained
+    if budget <= 0:
+        return 0.0 if violated <= 0 else float("inf")
+    return violated / budget
+
+
+def report(samples_by_objective: dict, objectives=None) -> dict:
+    """Attainment record for a set of raw sample lists — the shape
+    cpbench writes per scenario and ``bench_gate --slo-report`` gates:
+    ``{objective: {target_ms, objective, n, attainment, burn, met}}``.
+    An objective with zero samples is NOT met — absence of evidence
+    isn't attainment (the chaos-gate asymmetry, applied to SLOs)."""
+    objs = {o.name: o for o in (objectives or DEFAULT_OBJECTIVES)}
+    out: dict = {}
+    for name, samples in samples_by_objective.items():
+        obj = objs.get(name)
+        if obj is None:
+            raise KeyError(f"undeclared SLO objective {name!r}")
+        att = attainment(samples, obj.target_ms)
+        burn = burn_rate(att, obj.objective)
+        out[name] = {
+            "target_ms": obj.target_ms,
+            "objective": obj.objective,
+            "n": len(list(samples)),
+            "attainment": None if att is None else round(att, 4),
+            "burn": (None if burn is None
+                     else round(burn, 4) if burn != float("inf")
+                     else "inf"),
+            "met": att is not None and att >= obj.objective,
+        }
+    return out
+
+
+def attainment_from_histogram(hist, target_s: float,
+                              label_values: tuple = ()) -> float | None:
+    """Attainment straight from a metrics/registry Histogram: cumulative
+    count of the smallest bucket ≥ target over the total. Conservative —
+    when the target falls between bucket bounds the bucket BELOW it is
+    used (never over-reports attainment)."""
+    key = tuple(str(v) for v in label_values)
+    with hist._lock:
+        counts = hist._counts.get(key)
+        if not counts or counts[-1] == 0:
+            return None
+        total = counts[-1]
+        att = 0
+        for i, bound in enumerate(hist.buckets):
+            if bound <= target_s:
+                att = counts[i]
+            else:
+                break
+        return att / total
+
+
+class SloEngine:
+    """Live SLO state for one process: observe samples (or ingest
+    histograms), expose gauges, answer ``/slostatus``."""
+
+    #: per-objective raw-sample retention (attainment is a fraction over
+    #: the retained window — a month-old miss must age out)
+    MAX_SAMPLES = 4096
+
+    def __init__(self, objectives=None, registry: Registry | None = None):
+        self.objectives = tuple(objectives or DEFAULT_OBJECTIVES)
+        self._by_name = {o.name: o for o in self.objectives}
+        self._lock = threading.Lock()
+        self._samples: dict[str, list] = {o.name: []
+                                          for o in self.objectives}
+        reg = registry if registry is not None else Registry()
+        self.g_attainment = Gauge(
+            "slo_attainment",
+            "fraction of samples meeting the objective's target",
+            ("objective",), registry=reg,
+        )
+        self.g_burn = Gauge(
+            "slo_error_budget_burn",
+            "error-budget burn rate (1.0 = budget spent exactly)",
+            ("objective",), registry=reg,
+        )
+
+    def attach(self, tracer) -> "SloEngine":
+        """Make this engine discoverable via ``current_tracer().slo`` —
+        the journal's wiring pattern: controllers call the module-level
+        :func:`observe` with zero plumbing, and cpbench worlds get
+        isolated engines."""
+        tracer.slo = self
+        return self
+
+    def observe(self, objective: str, value_ms: float) -> None:
+        obj = self._by_name.get(objective)
+        if obj is None:
+            raise KeyError(f"undeclared SLO objective {objective!r}")
+        with self._lock:
+            samples = self._samples[objective]
+            samples.append(float(value_ms))
+            if len(samples) > self.MAX_SAMPLES:
+                del samples[:len(samples) - self.MAX_SAMPLES]
+            snapshot = list(samples)
+        att = attainment(snapshot, obj.target_ms)
+        burn = burn_rate(att, obj.objective)
+        self.g_attainment.labels(objective).set(att if att is not None
+                                                else 0.0)
+        if burn is not None and burn != float("inf"):
+            self.g_burn.labels(objective).set(burn)
+
+    def status(self) -> dict:
+        """The /slostatus body: every declared objective with its
+        current attainment record (objectives with no samples yet say
+        so rather than vanishing)."""
+        with self._lock:
+            samples = {name: list(v) for name, v in self._samples.items()}
+        rec = report(samples, objectives=self.objectives)
+        return {
+            "schema": "slostatus/v1",
+            "objectives": {
+                o.name: {"description": o.description, **rec[o.name]}
+                for o in self.objectives
+            },
+        }
+
+
+#: lazy process-global engine — the production /slostatus + gauge
+#: surface. Lazy (not import-time) so the global metrics registry only
+#: grows the slo families in processes that actually serve them.
+_DEFAULT: list = []
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_engine() -> SloEngine:
+    """The process engine, gauges on the GLOBAL metrics registry —
+    cmd/runner.py serves it on /slostatus and every binary's /metrics."""
+    with _DEFAULT_LOCK:
+        if not _DEFAULT:
+            from service_account_auth_improvements_tpu.controlplane.metrics import (  # noqa: E501
+                REGISTRY,
+            )
+
+            _DEFAULT.append(SloEngine(registry=REGISTRY))
+        return _DEFAULT[0]
+
+
+def observe(objective: str, value_ms: float) -> None:
+    """Feed one sample into the ambient engine: the one attached to the
+    current tracer (cpbench worlds), else the process default. This is
+    how production code reports — the notebook controller observes
+    create→Ready at the Ready transition, tpusched observes
+    time-to-placement at the stamp — with the journal's zero-plumbing
+    resolution rule. Never raises into a reconcile."""
+    eng = getattr(current_tracer(), "slo", None)
+    if eng is None:
+        eng = default_engine()
+    try:
+        eng.observe(objective, value_ms)
+    except Exception:  # noqa: BLE001 — telemetry, not control flow
+        pass

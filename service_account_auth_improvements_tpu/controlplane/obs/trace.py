@@ -152,6 +152,12 @@ class Tracer:
         self._by_key: dict[str, str] = {}
         #: callables invoked with each finished span dict
         self.exporters: list = []
+        #: decision journal attached via ``Journal.attach`` (cpscope):
+        #: library code finds it through ``current_tracer().journal`` so
+        #: per-world isolation (cpbench) needs no extra threading
+        self.journal = None
+        #: SLO engine attached via ``SloEngine.attach`` — same pattern
+        self.slo = None
 
     # ------------------------------------------------------------ binding
 
@@ -247,16 +253,22 @@ class Tracer:
 
     def record(self, name: str, key: str, start: float, end: float,
                attrs: dict | None = None, error: bool = False,
-               once: bool = False) -> None:
+               once: bool = False) -> bool:
         """Retroactive span on ``key``'s trace from already-measured
         instants (``time.monotonic`` seconds). ``once=True`` drops the
         record if the trace already holds a span of this name (idempotent
-        lifecycle markers like ``notebook.ready``)."""
+        lifecycle markers like ``notebook.ready``). Returns True when the
+        span was actually recorded — with ``once``, the first-time
+        verdict callers key once-per-incarnation side effects on (the
+        create→Ready SLO sample must not re-fire for a pod flap)."""
         tid = self.trace_id_for(key)
         span = {
             "name": name, "span_id": uuid.uuid4().hex[:8],
             "parent_id": None, "start": start, "end": end,
             "error": error, "attrs": dict(attrs or {}),
+            # exporters (the decision journal) attribute by object, not
+            # by trace ring position — the key rides on the record
+            "key": key, "trace_id": tid,
         }
         with self._lock:
             tr = self._touch_locked(tid)
@@ -267,13 +279,14 @@ class Tracer:
                 cur = self._by_key.get(key)
                 tr = self._touch_locked(cur) if cur else None
             if tr is None:
-                return
+                return False
             if once:
                 if name in tr.once:
-                    return
+                    return False
                 tr.once.add(name)
             self._append_capped_locked(tr, span)
         self._export(span)
+        return True
 
     def _finish(self, span: Span) -> None:
         d = {
@@ -281,6 +294,7 @@ class Tracer:
             "parent_id": span.parent_id, "start": span.start,
             "end": span.end, "error": span.error,
             "attrs": dict(span.attrs),
+            "key": span.key, "trace_id": span.trace_id,
         }
         with self._lock:
             tr = self._touch_locked(span.trace_id)
@@ -375,9 +389,9 @@ def span(name: str, key: str | None = None,
 
 def record(name: str, key: str, start: float, end: float,
            attrs: dict | None = None, error: bool = False,
-           once: bool = False) -> None:
-    current_tracer().record(name, key, start, end, attrs=attrs,
-                            error=error, once=once)
+           once: bool = False) -> bool:
+    return current_tracer().record(name, key, start, end, attrs=attrs,
+                                   error=error, once=once)
 
 
 def object_trace_id(plural: str, obj: dict,

@@ -100,6 +100,13 @@ PREEMPTED_BY_ANNOTATION = "tpukf.dev/preempted-by"
 #: that pool would double-book whoever placement handed it to meanwhile.
 MANAGED_ANNOTATION = "tpukf.dev/tpusched-managed"
 CONDITION_SCHEDULED = "Scheduled"
+#: Event reasons (cplint event-reason: constant, CamelCase). Placed /
+#: Unschedulable / QuotaExceeded double as the Scheduled condition's
+#: reason vocabulary; Preempted rides the victim's eviction.
+REASON_PLACED = "Placed"
+REASON_PREEMPTED = "Preempted"
+REASON_UNSCHEDULABLE = "Unschedulable"
+REASON_QUOTA_EXCEEDED = "QuotaExceeded"
 #: ResourceQuota-style key the Profile's resourceQuotaSpec budgets chips
 #: under; tpusched charges it at ADMISSION, namespace ResourceQuota only
 #: rejects at pod-create time (too late: the STS would flap).
@@ -357,12 +364,12 @@ class SchedulerReconciler(Reconciler):
             if confirm:
                 self.metrics.placements.labels(pool).inc()
                 self.recorder.event(
-                    nb, "Normal", "Placed",
+                    nb, "Normal", REASON_PLACED,
                     f"tpusched assigned node pool {pool}",
                 )
             if self._maybe_recover(nb, resolved):
                 self._run_queue()  # recovered chips may block the queue
-            self._set_condition(nb, "True", "Placed",
+            self._set_condition(nb, "True", REASON_PLACED,
                                 f"assigned to node pool {pool}")
             return Result()
         # Unplaced — including fresh spec.tpu.nodePool pins: a pin picks
@@ -587,7 +594,7 @@ class SchedulerReconciler(Reconciler):
                 budget = budgets[entry.namespace]
                 if budget is not None and \
                         ns_used + entry.demand.total_chips > budget:
-                    self._park(entry, "QuotaExceeded",
+                    self._park(entry, REASON_QUOTA_EXCEEDED,
                                f"profile quota {QUOTA_KEY}={budget} has "
                                f"{budget - ns_used} chips free, need "
                                f"{entry.demand.total_chips}",
@@ -599,7 +606,7 @@ class SchedulerReconciler(Reconciler):
                         feasible(pin, used.get(entry.pinned_pool, 0),
                                  entry.demand) else None
                     if pool is None:
-                        self._park(entry, "Unschedulable",
+                        self._park(entry, REASON_UNSCHEDULABLE,
                                    f"pinned pool {entry.pinned_pool} is "
                                    "absent, mismatched, or lacks free "
                                    "chips", nb, park_events)
@@ -607,7 +614,7 @@ class SchedulerReconciler(Reconciler):
                 else:
                     pool = best_fit(pools, used, entry.demand)
                     if pool is None:
-                        self._park(entry, "Unschedulable",
+                        self._park(entry, REASON_UNSCHEDULABLE,
                                    f"no {entry.demand.slice_class} pool "
                                    f"with {entry.demand.total_chips} free "
                                    f"chips ({entry.demand.num_hosts} "
@@ -765,14 +772,15 @@ class SchedulerReconciler(Reconciler):
             self._unstamped.discard(entry.key)
         if claimed:
             self.metrics.placements.labels(pool).inc()
-            self.metrics.time_to_placement.observe(
-                time.monotonic() - entry.enqueued
-            )
-        self._set_condition(nb, "True", "Placed",
+            ttp = time.monotonic() - entry.enqueued
+            self.metrics.time_to_placement.observe(ttp)
+            # the production time-to-placement SLO sample (obs/slo.py)
+            obs.slo_observe("time_to_placement", ttp * 1000.0)
+        self._set_condition(nb, "True", REASON_PLACED,
                             f"assigned to node pool {pool}")
         if claimed:
             self.recorder.event(
-                nb, "Normal", "Placed",
+                nb, "Normal", REASON_PLACED,
                 f"tpusched assigned node pool {pool} "
                 f"({entry.demand.total_chips} chips)",
             )
@@ -811,10 +819,10 @@ class SchedulerReconciler(Reconciler):
             # isn't retained on the Assignment, and a fabricated one
             # would skew the histogram)
             self.metrics.placements.labels(pool).inc()
-        self._set_condition(nb, "True", "Placed",
+        self._set_condition(nb, "True", REASON_PLACED,
                             f"assigned to node pool {pool}")
         if claimed:
-            self.recorder.event(nb, "Normal", "Placed",
+            self.recorder.event(nb, "Normal", REASON_PLACED,
                                 f"tpusched assigned node pool {pool}")
         return Result()
 
@@ -916,7 +924,7 @@ class SchedulerReconciler(Reconciler):
         victim_nb = self._get_nb(victim.key)
         if victim_nb is not None:
             self.recorder.event(
-                victim_nb, WARNING, "Preempted",
+                victim_nb, WARNING, REASON_PREEMPTED,
                 f"evicted (priority {victim.priority}) for "
                 f"higher-priority notebook {entry.namespace}/"
                 f"{entry.name} (priority {entry.priority})",

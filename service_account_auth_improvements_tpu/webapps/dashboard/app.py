@@ -48,7 +48,8 @@ DEFAULT_LINKS = {
 
 def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
               mode: str | None = None,
-              registration_flow: bool = True, tracer=None) -> WebApp:
+              registration_flow: bool = True, tracer=None,
+              journal=None) -> WebApp:
     """``kfam`` is any object with the KfamApp action surface
     (create_profile, create_binding, delete_binding, list_bindings) —
     in-process KfamApp or an HTTP client facade (the reference uses a
@@ -175,6 +176,25 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
             for cluster_attr in ("free_chips", "queue_depth"):
                 s["attrs"].pop(cluster_attr, None)
         return {"trace": snap}
+
+    @app.route("GET", "/api/explain/<namespace>/<notebook>")
+    def get_explain(req):
+        """cpscope explain engine, tenant view: conditions + Events +
+        spans + journal decisions stitched into one causal timeline —
+        the API answer to "why isn't my notebook Ready". Gated by the
+        same SAR as any notebook read; redacted with the same tenant
+        boundary as the traces API (obs.explain.redact: no cluster-wide
+        chip counts or queue depths — cross-namespace victim names were
+        already redacted at record time by the scheduler)."""
+        ns = req.params["namespace"]
+        name = req.params["notebook"]
+        KubeApi(kube, req.user, mode=app.mode).get(
+            "notebooks", name, namespace=ns
+        )
+        trc = tracer if tracer is not None else obs.TRACER
+        record = obs.explain(ns, name, kube=kube, tracer=trc,
+                             journal=journal)
+        return {"explain": obs.redact_explain(record)}
 
     @app.route("GET", "/api/dashboard-links")
     def get_links(req):

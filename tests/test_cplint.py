@@ -72,7 +72,7 @@ def test_cli_exits_zero_and_writes_report(tmp_path):
     assert report["counts"]["errors"] == 0
     assert {p["name"] for p in report["passes"]} == {
         "lock-discipline", "cache-mutation", "queue-span", "rbac-check",
-        "clock-injection", "metrics",
+        "clock-injection", "metrics", "event-reason",
     }
 
 

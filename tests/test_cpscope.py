@@ -1,0 +1,865 @@
+"""cpscope (ISSUE 8): flight recorder — correlated EventRecorder,
+FakeKube Event TTL GC, decision journal, explain engine, SLO burn math,
+dashboard redaction pins, bench_gate --slo-report, and the cplint
+event-reason pass.
+"""
+
+from __future__ import annotations
+
+import datetime
+import io
+import json
+import pathlib
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from service_account_auth_improvements_tpu.controlplane import obs  # noqa: E402
+from service_account_auth_improvements_tpu.controlplane.events import (  # noqa: E402,E501
+    AGGREGATE_PREFIX,
+    EventRecorder,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (  # noqa: E402,E501
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.obs import (  # noqa: E402,E501
+    slo as slo_mod,
+)
+
+NB = {"apiVersion": "tpukf.dev/v1beta1", "kind": "Notebook",
+      "metadata": {"name": "nb1", "namespace": "u1", "uid": "u-1"}}
+
+
+def _events(kube, ns="u1"):
+    return kube.list("events", namespace=ns)["items"]
+
+
+# ------------------------------------------------------------- recorder
+
+def test_recorder_repeats_patch_without_get():
+    """After the first occurrence the recorder remembers the count: a
+    repeat is ONE PATCH, no read-modify-write round trip."""
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c")
+    rec.event(NB, "Warning", "FailedCreate", "boom")
+    gets_after_first = kube.request_counts_snapshot().get("get", 0)
+    for _ in range(9):
+        rec.event(NB, "Warning", "FailedCreate", "boom")
+    counts = kube.request_counts_snapshot()
+    assert counts.get("get", 0) == gets_after_first, \
+        "repeats must not GET"
+    evs = _events(kube)
+    assert len(evs) == 1 and evs[0]["count"] == 10
+
+
+def test_recorder_aggregates_past_threshold():
+    """More than aggregate_after distinct messages for one (involved,
+    type, reason) group collapse into a single combined Event."""
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c", aggregate_after=3)
+    for i in range(10):
+        rec.event(NB, "Warning", "FailedCreate", f"boom #{i}")
+    evs = _events(kube)
+    # 3 distinct events + exactly one aggregate
+    combined = [e for e in evs
+                if e["message"].startswith(AGGREGATE_PREFIX)]
+    assert len(evs) == 4, [e["message"] for e in evs]
+    assert len(combined) == 1
+    assert combined[0]["count"] == 7
+    assert "boom #9" in combined[0]["message"]  # tracks the latest
+    assert rec.stats()["aggregated"] == 7
+
+
+def test_recorder_token_bucket_drops_then_refills():
+    clock = [0.0]
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c", burst=2, refill_s=2.0,
+                        mono_fn=lambda: clock[0])
+    wrote = [rec.event(NB, "Normal", "Hot", f"m{i}") for i in range(5)]
+    assert wrote == [True, True, False, False, False]
+    assert rec.stats()["dropped_rate_limited"] == 3
+    # one token earns back per refill_s/burst = 1 s
+    clock[0] = 1.1
+    assert rec.event(NB, "Normal", "Hot", "after-refill") is True
+    # spam control is per OBJECT: another notebook has its own bucket
+    other = {"kind": "Notebook",
+             "metadata": {"name": "nb2", "namespace": "u1"}}
+    assert rec.event(other, "Normal", "Hot", "fresh-bucket") is True
+
+
+def test_recorder_hammer_eight_threads():
+    """8 threads emitting the same event concurrently: no exception, one
+    Event object, store bounded, and the spam filter's verdicts add up."""
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c", burst=10_000)
+    barrier = threading.Barrier(8)
+    boom: list = []
+
+    def worker():
+        try:
+            barrier.wait(5)
+            for _ in range(50):
+                rec.event(NB, "Warning", "FailedCreate", "boom")
+        except Exception as e:  # noqa: BLE001
+            boom.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert not boom
+    evs = _events(kube)
+    assert len(evs) == 1
+    stats = rec.stats()
+    assert stats["emitted"] + stats["dropped_rate_limited"] == 400
+    assert 1 <= evs[0]["count"] <= 400
+
+
+def test_recorder_recreates_after_ttl_gc():
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c")
+    rec.event(NB, "Warning", "FailedCreate", "boom")
+    name = _events(kube)[0]["metadata"]["name"]
+    kube.delete("events", name, namespace="u1")   # plays the TTL GC
+    rec.event(NB, "Warning", "FailedCreate", "boom")
+    evs = _events(kube)
+    assert len(evs) == 1 and evs[0]["count"] == 1
+
+
+# --------------------------------------------------- FakeKube Event GC
+
+def _old_event(kube, name, ns="u1", ts="2000-01-01T00:00:00Z"):
+    kube.create("events", {
+        "metadata": {"name": name, "namespace": ns},
+        "involvedObject": {"kind": "Notebook", "name": "nb1"},
+        "type": "Normal", "reason": "Old", "message": "m",
+        "count": 1, "firstTimestamp": ts, "lastTimestamp": ts,
+    }, namespace=ns)
+
+
+def test_event_ttl_sweep_piggybacks_on_compaction():
+    kube = FakeKube()
+    kube.event_ttl_s = 3600
+    _old_event(kube, "stale.1")
+    _old_event(kube, "stale.2")
+    now = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ")
+    _old_event(kube, "fresh.1", ts=now)
+    kube.compact_history()
+    names = {e["metadata"]["name"] for e in _events(kube)}
+    assert names == {"fresh.1"}, names
+    # watchers saw real DELETED events for the swept ones
+    evs = list(kube.watch("events", resource_version=0, timeout=0.1))
+    deleted = {e["object"]["metadata"]["name"] for e in evs
+               if e["type"] == "DELETED"}
+    assert deleted == {"stale.1", "stale.2"}
+
+
+def test_event_ttl_disabled_by_default():
+    kube = FakeKube()
+    _old_event(kube, "stale.1")
+    kube.compact_history()
+    assert len(_events(kube)) == 1
+
+
+def test_churn_burst_cannot_grow_event_store_monotonically():
+    """A hot-looping controller inventing a fresh message per pass: the
+    aggregator caps distinct Event objects, TTL sweeps the rest — the
+    store stays bounded no matter how long the burst runs."""
+    kube = FakeKube()
+    kube.event_ttl_s = 3600
+    rec = EventRecorder(kube, "c", aggregate_after=10, burst=100_000)
+    for i in range(500):
+        rec.event(NB, "Warning", "FailedCreate", f"attempt {i} failed")
+    evs = _events(kube)
+    assert len(evs) <= 11, len(evs)   # 10 distinct + 1 aggregate
+    combined = [e for e in evs
+                if e["message"].startswith(AGGREGATE_PREFIX)]
+    assert combined and combined[0]["count"] == 490
+
+
+def test_event_aggregation_patch_keeps_rv_and_noop_semantics():
+    """PR 1 fidelity rules hold for the recorder's patches: a no-op
+    patch keeps the RV and emits nothing; a count bump bumps the RV and
+    emits exactly one MODIFIED."""
+    kube = FakeKube()
+    rec = EventRecorder(kube, "c")
+    rec.event(NB, "Warning", "FailedCreate", "boom")
+    ev = _events(kube)[0]
+    name, rv = ev["metadata"]["name"], ev["metadata"]["resourceVersion"]
+    same = kube.patch("events", name,
+                      {"count": ev["count"],
+                       "lastTimestamp": ev["lastTimestamp"]},
+                      namespace="u1")
+    assert same["metadata"]["resourceVersion"] == rv, "no-op kept RV"
+    w = kube.watch("events", resource_version=rv, timeout=0.1)
+    assert list(w) == [], "no-op patch must not emit"
+    bumped = kube.patch("events", name,
+                        {"count": ev["count"] + 1,
+                         "lastTimestamp": "2099-01-01T00:00:00Z"},
+                        namespace="u1")
+    assert bumped["metadata"]["resourceVersion"] != rv
+    mods = [e for e in kube.watch("events", resource_version=rv,
+                                  timeout=0.1)]
+    assert [e["type"] for e in mods] == ["MODIFIED"]
+
+
+# -------------------------------------------------------------- journal
+
+def test_journal_ring_bounds_and_counts():
+    j = obs.Journal(capacity=8)
+    for i in range(20):
+        j.decide("placement", key=f"notebooks/ns/nb{i}", pool=f"p{i}")
+    assert len(j) == 8
+    assert j.counts() == {"placement": 20}   # totals survive eviction
+    entries = j.entries()
+    assert [e["attrs"]["pool"] for e in entries] == \
+        [f"p{i}" for i in range(12, 20)]
+    assert all(e["mono"] is not None and e["wall"] for e in entries)
+
+
+def test_journal_rides_tracer_exporters():
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    with t.span("sched.place", key="notebooks/ns/nb",
+                attrs={"pool": "p0", "free_chips": {"p0": 4}}):
+        pass
+    with t.span("informer.deliver", key="notebooks/ns/nb"):
+        pass  # not decision-shaped: stays out of the ring
+    entries = j.entries(key="notebooks/ns/nb")
+    assert [e["kind"] for e in entries] == ["placement"]
+    assert entries[0]["attrs"]["pool"] == "p0"
+    # attach is idempotent; decide() resolves through the tracer context
+    j.attach(t)
+    assert t.exporters.count(j.record_span) == 1
+    with t.span("reconcile", key="notebooks/ns/nb"):
+        obs.decide("cull", key="notebooks/ns/nb", reason="Culled")
+    assert j.counts()["cull"] == 1
+
+
+def test_journal_thread_hammer_and_jsonl():
+    j = obs.Journal(capacity=4096)
+    threads = [
+        threading.Thread(target=lambda: [
+            j.decide("reconcile", key="notebooks/ns/nb", outcome="success")
+            for _ in range(100)
+        ])
+        for _ in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert j.counts()["reconcile"] == 800
+    lines = j.to_jsonl().strip().splitlines()
+    assert len(lines) == 800
+    assert json.loads(lines[0])["kind"] == "reconcile"
+
+
+# -------------------------------------------------------------- explain
+
+def test_explain_timeline_names_chaos_blackout():
+    """The acceptance shape: a notebook that stalled through an
+    apiserver blackout explains the blackout by name, not a generic
+    timeout — ambient chaos decisions fold into per-object timelines."""
+    kube = FakeKube()
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"},
+        "spec": {}, "status": {"readyReplicas": 1},
+    })
+    now = time.monotonic()
+    t.record("apiserver.create", "notebooks/u1/nb1", now - 5.0, now - 5.0)
+    j.decide("chaos", action="blackout_started", duration_s=4.5)
+    j.decide("chaos", action="blackout_ended")
+    t.record("notebook.ready", "notebooks/u1/nb1", now, now, once=True)
+    rec = obs.explain("u1", "nb1", kube=kube, tracer=t, journal=j)
+    rendered = obs.render_explain(rec)
+    assert rec["ready"] is True and rec["verdict"] == "Ready"
+    assert "apiserver blackout began (4.5s window" in rendered
+    assert "blackout ended" in rendered
+    # monotone timeline
+    walls = [i["wall"] for i in rec["timeline"] if i["wall"] is not None]
+    assert walls == sorted(walls)
+
+
+def test_explain_partial_gang_is_not_ready():
+    """readyReplicas == 1 on a 4-host gang must not read as Ready — the
+    stuck-gang case is the one the explain engine exists to diagnose."""
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "gang", "namespace": "u1"},
+        "spec": {"tpu": {"generation": "v4", "topology": "2x2x4"}},
+        "status": {"readyReplicas": 1, "conditions": [{
+            "type": "SliceIncomplete", "status": "True",
+            "reason": "WaitingForHosts",
+            "message": "waiting for slice hosts: 1/4 pods created",
+        }]},
+    })
+    rec = obs.explain("u1", "gang", kube=kube, tracer=obs.Tracer(),
+                      journal=obs.Journal())
+    assert rec["ready"] is False
+    assert "SliceIncomplete" in rec["verdict"]
+    # the full gang IS ready
+    nb = kube.get("notebooks", "gang", namespace="u1",
+                  group="tpukf.dev")
+    import copy as _copy
+    full = _copy.deepcopy(nb)
+    full["status"]["readyReplicas"] = 4
+    full["status"]["conditions"] = []
+    kube.update_status("notebooks", full, group="tpukf.dev")
+    rec = obs.explain("u1", "gang", kube=kube, tracer=obs.Tracer(),
+                      journal=obs.Journal())
+    assert rec["ready"] is True and rec["verdict"] == "Ready"
+
+
+def test_slo_sample_not_refired_on_readiness_flap():
+    """A pod restart (Ready → not → Ready) must not re-sample
+    create→Ready from the original creationTimestamp — the once-marker
+    keys the observation to the FIRST Ready of the incarnation."""
+    from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+        NotebookReconciler,
+    )
+
+    kube = FakeKube()
+    t = obs.Tracer()
+    eng = obs.SloEngine().attach(t)
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "notebook", "image": "x"}]}}},
+    })
+    rec = NotebookReconciler(kube)
+    up = {"metadata": {"name": "nb1", "namespace": "u1"},
+          "status": {"readyReplicas": 1}}
+    down = {"metadata": {"name": "nb1", "namespace": "u1"},
+            "status": {"readyReplicas": 0}}
+    try:
+        with t.span("reconcile", key="notebooks/u1/nb1"):
+            def nb():
+                return kube.get("notebooks", "nb1", namespace="u1",
+                                group="tpukf.dev")
+            rec.update_status(nb(), [up], None)     # first Ready
+            rec.update_status(nb(), [down], None)   # pod restarts
+            rec.update_status(nb(), [up], None)     # recovers
+    finally:
+        rec.shutdown()
+    e = eng.status()["objectives"]["create_to_ready"]
+    assert e["n"] == 1, e   # the flap recovery did not re-sample
+
+
+def test_explain_verdict_names_scheduler_park():
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"},
+        "spec": {},
+        "status": {"conditions": [{
+            "type": "Scheduled", "status": "False",
+            "reason": "Unschedulable",
+            "message": "no v5e pool with 16 free chips; queue position "
+                       "2/5",
+            "queuePosition": 2, "queueTotal": 5,
+            "lastTransitionTime": "2026-08-03T00:00:00Z",
+        }]},
+    })
+    rec = obs.explain("u1", "nb1", kube=kube, tracer=obs.Tracer(),
+                      journal=obs.Journal())
+    assert "parked by tpusched" in rec["verdict"]
+    assert "Unschedulable" in rec["verdict"]
+
+
+def test_explain_redaction_strips_cluster_attrs():
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    now = time.monotonic()
+    t.record("sched.place", "notebooks/u1/nb1", now, now,
+             attrs={"pool": "p0", "free_chips": {"p0": 4},
+                    "queue_depth": 7})
+    rec = obs.explain("u1", "nb1", tracer=t, journal=j)
+    redacted = obs.redact_explain(rec)
+    for item in redacted["timeline"]:
+        assert "free_chips" not in item["attrs"]
+        assert "queue_depth" not in item["attrs"]
+    # non-destructive: the original record still carries them
+    assert any("free_chips" in i["attrs"] for i in rec["timeline"])
+
+
+# ------------------------------------------------------------------ SLO
+
+def test_slo_burn_math_hand_computed():
+    samples = [100.0] * 19 + [20_000.0]          # 19/20 meet 15 s
+    rec = slo_mod.report({"create_to_ready": samples})
+    e = rec["create_to_ready"]
+    assert e["attainment"] == pytest.approx(0.95)
+    assert e["burn"] == pytest.approx(1.0)       # budget spent exactly
+    assert e["met"] is True
+    rec = slo_mod.report({"create_to_ready": [100.0] * 18
+                          + [20_000.0] * 2})     # 18/20 = 0.9
+    e = rec["create_to_ready"]
+    assert e["attainment"] == pytest.approx(0.9)
+    assert e["burn"] == pytest.approx(2.0)       # 2x budget burn
+    assert e["met"] is False
+    # zero samples: absence of evidence is NOT attainment
+    e = slo_mod.report({"recovery": []})["recovery"]
+    assert e["attainment"] is None and e["met"] is False
+    with pytest.raises(KeyError):
+        slo_mod.report({"not_an_objective": [1.0]})
+
+
+def test_slo_attainment_from_histogram_is_conservative():
+    from service_account_auth_improvements_tpu.controlplane.metrics import (
+        Histogram,
+        Registry,
+    )
+
+    h = Histogram("t_seconds", "", buckets=(1, 5, 10), registry=Registry())
+    for v in (0.5, 0.5, 4.0, 9.0, 20.0):
+        h.observe(v)
+    # target 5 s sits exactly on a bound: 3/5 observations ≤ 5
+    assert slo_mod.attainment_from_histogram(h, 5.0) == pytest.approx(0.6)
+    # target 7 s falls between bounds 5 and 10: uses the bucket BELOW
+    # (≤5 → 3/5), never over-reporting
+    assert slo_mod.attainment_from_histogram(h, 7.0) == pytest.approx(0.6)
+    empty = Histogram("e_seconds", "", buckets=(1,), registry=Registry())
+    assert slo_mod.attainment_from_histogram(empty, 1.0) is None
+
+
+def test_slo_engine_status_and_gauges():
+    from service_account_auth_improvements_tpu.controlplane.metrics import (
+        Registry,
+    )
+
+    reg = Registry()
+    eng = obs.SloEngine(registry=reg)
+    for _ in range(19):
+        eng.observe("create_to_ready", 1000.0)
+    eng.observe("create_to_ready", 60_000.0)
+    status = eng.status()
+    e = status["objectives"]["create_to_ready"]
+    assert e["met"] is True and e["attainment"] == pytest.approx(0.95)
+    # objectives with no samples still appear (and are not met)
+    assert status["objectives"]["recovery"]["met"] is False
+    rendered = reg.render()
+    assert 'slo_attainment{objective="create_to_ready"} 0.95' in rendered
+    assert 'slo_error_budget_burn{objective="create_to_ready"} 1.0' \
+        in rendered
+    with pytest.raises(KeyError):
+        eng.observe("nope", 1.0)
+
+
+# ------------------------------------------------------- ops + dashboard
+
+def test_serve_ops_explainz_and_slostatus_http():
+    from service_account_auth_improvements_tpu.controlplane.engine.serve import (  # noqa: E501
+        serve_ops,
+    )
+    from service_account_auth_improvements_tpu.controlplane.metrics import (
+        Registry,
+    )
+
+    kube = FakeKube()
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    kube.create("notebooks", {"metadata": {"name": "nb1",
+                                           "namespace": "u1"},
+                              "spec": {}})
+    now = time.monotonic()
+    t.record("apiserver.create", "notebooks/u1/nb1", now, now)
+    slo = obs.SloEngine(registry=Registry())
+    slo.observe("create_to_ready", 1200.0)
+    server = serve_ops(0, host="127.0.0.1", registry=Registry(),
+                       tracer=t, kube=kube, journal=j, slo=slo)
+    port = server.server_address[1]
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                return r.status, r.read().decode()
+
+        code, body = get("/debug/explainz/u1/nb1")
+        assert code == 200
+        assert "EXPLAIN notebooks/u1/nb1" in body
+        assert "apiserver.create" in body
+        code, body = get("/slostatus")
+        assert code == 200
+        payload = json.loads(body)
+        assert payload["schema"] == "slostatus/v1"
+        assert payload["objectives"]["create_to_ready"]["n"] == 1
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_dashboard_explain_api_sar_gated_and_redacted():
+    from service_account_auth_improvements_tpu.controlplane.kfam import (
+        KfamApp,
+    )
+    from service_account_auth_improvements_tpu.webapps.dashboard import (
+        build_app,
+    )
+
+    kube = FakeKube()
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    app = build_app(kube, KfamApp(kube, cluster_admin="root@x"),
+                    mode="prod", tracer=t, journal=j)
+    kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "team"}, "spec": {},
+    })
+    now = time.monotonic()
+    t.record("sched.place", "notebooks/team/nb1", now, now,
+             attrs={"pool": "p0", "free_chips": {"p0": 0},
+                    "queue_depth": 3})
+
+    def call(path, user="alice@x"):
+        environ = {
+            "REQUEST_METHOD": "GET", "PATH_INFO": path,
+            "QUERY_STRING": "", "CONTENT_LENGTH": "0",
+            "wsgi.input": io.BytesIO(b""),
+            "HTTP_KUBEFLOW_USERID": user,
+        }
+        out = {}
+
+        def sr(status_line, hdrs):
+            out["code"] = int(status_line.split()[0])
+
+        out["body"] = json.loads(b"".join(app(environ, sr)) or b"{}")
+        return out
+
+    out = call("/api/explain/team/nb1")
+    assert out["code"] == 200
+    record = out["body"]["explain"]
+    assert record["key"] == "notebooks/team/nb1"
+    items = [i for i in record["timeline"]
+             if i["source"] in ("span", "journal")]
+    assert items, record
+    for item in record["timeline"]:
+        assert "free_chips" not in item["attrs"]
+        assert "queue_depth" not in item["attrs"]
+    # SAR denial blocks before the explain engine is touched
+    kube.sar_hook = lambda spec: False
+    out = call("/api/explain/team/nb1")
+    assert out["code"] == 403
+    kube.sar_hook = None
+    out = call("/api/explain/team/ghost")
+    assert out["code"] == 404
+
+
+# ----------------------------------------------------- leader elections
+
+def test_leader_transition_event_and_journal():
+    from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+        LeaderElector,
+    )
+
+    kube = FakeKube()
+    j = obs.Journal()
+    elector = LeaderElector(
+        kube, "test-lease", namespace="kubeflow", identity="me",
+        recorder=EventRecorder(kube, "test-controller"), journal=j,
+    )
+    elector.acquire()
+    try:
+        entries = j.entries(kinds=("lease",))
+        assert entries and entries[0]["attrs"]["action"] == "acquired"
+        assert entries[0]["key"] == "leases/kubeflow/test-lease"
+        evs = _events(kube, ns="kubeflow")
+        assert any(e["reason"] == "LeaderElected"
+                   and e["involvedObject"]["kind"] == "Lease"
+                   for e in evs), evs
+    finally:
+        elector.release()
+
+
+# ------------------------------------------------- bench_gate --slo-report
+
+def _run_fixture(slo):
+    return {"scenarios": {"notebook_ready": {"slo": slo}}}
+
+
+def test_bench_gate_slo_leg():
+    sys.path.insert(0, str(REPO))
+    from tools.bench_gate import slo_gate
+
+    met = {"create_to_ready": {"target_ms": 15000.0, "objective": 0.95,
+                               "n": 10, "attainment": 1.0, "burn": 0.0,
+                               "met": True}}
+    assert slo_gate(_run_fixture(met)) == []
+    missed = {"create_to_ready": {**met["create_to_ready"],
+                                  "attainment": 0.5, "met": False}}
+    fails = slo_gate(_run_fixture(missed))
+    assert len(fails) == 1 and "missed" in fails[0]
+    # absent attainment record fails — absence of evidence isn't
+    # attainment
+    fails = slo_gate({"scenarios": {"notebook_ready": {}}})
+    assert len(fails) == 1 and "no SLO attainment record" in fails[0]
+    assert slo_gate({"scenarios": {}}) == ["slo: run contains no "
+                                           "scenarios"]
+
+
+def test_bench_gate_slo_cli_requires_run(tmp_path):
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_gate.py", "--slo-report"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    run = tmp_path / "run.json"
+    run.write_text(json.dumps(_run_fixture({
+        "create_to_ready": {"target_ms": 1.0, "objective": 0.95, "n": 1,
+                            "attainment": 1.0, "burn": 0.0,
+                            "met": True}})))
+    proc = subprocess.run(
+        [sys.executable, "tools/bench_gate.py", "--slo-report",
+         "--run", str(run)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+
+
+# ------------------------------------------------- cplint event-reason
+
+def _reason_findings(tmp_path, source):
+    from tools.cplint.core import PassContext
+    from tools.cplint.passes import event_reason
+
+    rel = ("service_account_auth_improvements_tpu/controlplane/"
+           "controllers/fixture.py")
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(source)
+    return event_reason.run(PassContext(repo=tmp_path))
+
+
+def test_event_reason_flags_inline_fstring_and_case(tmp_path):
+    findings = _reason_findings(tmp_path, '''
+BAD = "not_camel"
+GOOD = "Placed"
+class C:
+    def go(self, nb, name):
+        self.recorder.event(nb, "Normal", "Inline", "m")
+        self.recorder.event(nb, "Normal", f"Dyn{name}", "m")
+        self.recorder.event(nb, "Normal", BAD, "m")
+        self.recorder.event(nb, "Normal", GOOD, "m")
+''')
+    msgs = [f.message for f in findings]
+    assert len(msgs) == 3, msgs
+    assert any("inline Event reason 'Inline'" in m for m in msgs)
+    assert any("dynamic Event reason" in m for m in msgs)
+    assert any("not CamelCase" in m for m in msgs)
+
+
+def test_event_reason_allows_locals_and_ignores_non_recorders(tmp_path):
+    findings = _reason_findings(tmp_path, '''
+GOOD = "ChildEvent"
+class C:
+    def go(self, nb, ev):
+        reason = ev.get("reason") or GOOD
+        self.recorder.emit(nb, "Normal", reason, "m")
+        self.tracker.event(nb, "Normal", "NotARecorder", "m")
+        self.queue.get()
+''')
+    assert findings == []
+
+
+def test_event_reason_suppression_honored(tmp_path):
+    findings = _reason_findings(tmp_path, '''
+class C:
+    def go(self, nb):
+        # cplint: disable=event-reason — legacy import shim, migrating
+        self.recorder.event(nb, "Normal", "Inline", "m")
+''')
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+def test_repo_event_reasons_are_constants():
+    """The tree itself is clean under the new pass (the satellite: every
+    controller + tpusched + the leader elector emit constant reasons)."""
+    from tools.cplint.core import PassContext
+    from tools.cplint.passes import event_reason
+
+    findings = [f for f in event_reason.run(PassContext(REPO))
+                if not f.suppressed]
+    assert findings == [], [f.format() for f in findings]
+
+
+# -------------------------------------------------------- profile events
+
+def test_profile_controller_emits_tenant_events():
+    """The PR 7 dead-grant gap, closed: the profile controller now wires
+    a recorder and its ProfileReady Events land in the TENANT namespace
+    (the Profile itself is cluster-scoped)."""
+    from service_account_auth_improvements_tpu.controlplane.controllers.profile import (  # noqa: E501
+        ProfileReconciler,
+    )
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+
+    kube = FakeKube()
+    kube.create("profiles", {
+        "metadata": {"name": "team-a"},
+        "spec": {"owner": {"kind": "User", "name": "a@x"}},
+    }, group="tpukf.dev")
+    rec = ProfileReconciler(kube)
+    try:
+        rec.reconcile(Request(None, "team-a"))
+        evs = _events(kube, ns="team-a")
+        assert any(e["reason"] == "ProfileReady" for e in evs), evs
+        ready = next(e for e in evs if e["reason"] == "ProfileReady")
+        assert ready["involvedObject"]["kind"] == "Profile"
+        # steady state: a second pass changes nothing → no new event,
+        # no count churn
+        rec.reconcile(Request(None, "team-a"))
+        again = [e for e in _events(kube, ns="team-a")
+                 if e["reason"] == "ProfileReady"]
+        assert len(again) == 1 and again[0]["count"] == 1
+    finally:
+        rec.shutdown()
+
+
+def test_profile_error_event_on_plugin_failure():
+    from service_account_auth_improvements_tpu.controlplane.controllers.profile import (  # noqa: E501
+        ProfileReconciler,
+    )
+    from service_account_auth_improvements_tpu.controlplane.engine import (
+        Request,
+    )
+
+    class BoomPlugin:
+        kind = "Boom"
+
+        def apply(self, kube, profile, spec):
+            raise ValueError("plugin spec missing required field")
+
+        def revoke(self, kube, profile, spec):
+            pass
+
+    kube = FakeKube()
+    kube.create("profiles", {
+        "metadata": {"name": "team-b"},
+        "spec": {"owner": {"kind": "User", "name": "b@x"},
+                 "plugins": [{"kind": "Boom", "spec": {}}]},
+    }, group="tpukf.dev")
+    rec = ProfileReconciler(kube, plugins={"Boom": BoomPlugin()})
+    try:
+        rec.reconcile(Request(None, "team-b"))
+        evs = _events(kube, ns="team-b")
+        assert any(e["reason"] == "ProfileError"
+                   and "required field" in e["message"] for e in evs), evs
+    finally:
+        rec.shutdown()
+
+
+def test_leader_lost_path_does_no_apiserver_io():
+    """Fencing must be FAST: the LOST transition runs right before
+    on_lost (default os._exit), so it journals locally and never blocks
+    on the apiserver — a lease GET + Event write against the apiserver
+    that just failed us would keep a deposed leader alive 30-90 s into
+    the successor's term (the split-brain the lease exists to
+    prevent)."""
+    from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+        REASON_LEADER_LOST,
+        LeaderElector,
+    )
+
+    kube = FakeKube()
+    j = obs.Journal()
+    elector = LeaderElector(
+        kube, "l", namespace="kubeflow", identity="me",
+        recorder=EventRecorder(kube, "c"), journal=j,
+    )
+    before = kube.request_counts_snapshot()
+    elector._surface_transition(REASON_LEADER_LOST,
+                                "renew deadline exceeded")
+    assert kube.request_counts_snapshot() == before, \
+        "LOST must not touch the apiserver"
+    entries = j.entries(kinds=("lease",))
+    assert entries and entries[0]["attrs"]["action"] == "lost"
+
+
+def test_slo_engine_fed_by_production_observe_sites():
+    """The Ready transition feeds create_to_ready into the ambient
+    engine (current_tracer().slo — runner attaches the process default;
+    cpbench worlds attach isolated ones), so /slostatus reports real
+    attainment instead of n=0 forever."""
+    from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+        NotebookReconciler,
+    )
+
+    kube = FakeKube()
+    t = obs.Tracer()
+    eng = obs.SloEngine().attach(t)
+    nb = kube.create("notebooks", {
+        "metadata": {"name": "nb1", "namespace": "u1"},
+        "spec": {"template": {"spec": {"containers": [
+            {"name": "notebook", "image": "x"}]}}},
+    })
+    rec = NotebookReconciler(kube)
+    sts = {"metadata": {"name": "nb1", "namespace": "u1"},
+           "status": {"readyReplicas": 1}}
+    try:
+        with t.span("reconcile", key="notebooks/u1/nb1"):
+            rec.update_status(nb, [sts], None)
+            # second refresh at steady state: no duplicate sample
+            nb2 = kube.get("notebooks", "nb1", namespace="u1",
+                           group="tpukf.dev")
+            rec.update_status(nb2, [sts], None)
+    finally:
+        rec.shutdown()
+    e = eng.status()["objectives"]["create_to_ready"]
+    assert e["n"] == 1, e
+    assert e["met"] is True
+
+
+def test_explain_prefetched_sources_match_per_call_path():
+    from service_account_auth_improvements_tpu.controlplane.obs.explain import (  # noqa: E501
+        ExplainSources,
+    )
+
+    kube = FakeKube()
+    t = obs.Tracer()
+    j = obs.Journal().attach(t)
+    kube.create("notebooks", {"metadata": {"name": "nb1",
+                                           "namespace": "u1"},
+                              "spec": {}})
+    EventRecorder(kube, "c").event(NB, "Warning", "FailedCreate", "boom")
+    j.decide("chaos", action="blackout_started", duration_s=1.0)
+    now = time.monotonic()
+    t.record("sched.place", "notebooks/u1/nb1", now, now,
+             attrs={"pool": "p0"})
+    plain = obs.explain("u1", "nb1", kube=kube, tracer=t, journal=j)
+    batched = obs.explain(
+        "u1", "nb1", kube=kube, tracer=t, journal=j,
+        prefetched=ExplainSources(kube=kube, journal=j,
+                                  namespaces=("u1",)),
+    )
+    assert [i["what"] for i in plain["timeline"]] == \
+        [i["what"] for i in batched["timeline"]]
+    assert plain["sources"] == batched["sources"]
+
+
+# ------------------------------------------------------ explain of loss
+
+def test_explain_absent_sources_are_reported_not_hidden():
+    class DeadKube:
+        def get(self, *a, **kw):
+            raise errors.ApiError("down")
+
+        list = get
+
+    rec = obs.explain("u1", "nb1", kube=DeadKube(), tracer=obs.Tracer(),
+                      journal=obs.Journal())
+    assert rec["sources"]["object"] is False
+    assert "unknown object" in rec["verdict"]

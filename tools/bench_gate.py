@@ -18,7 +18,11 @@ Compares a fresh cpbench run against the committed record and fails on:
 - chaos invariant legs, for every chaos scenario present in the run:
   ``double_bookings > 0``, ``orphaned_children > 0``, any
   ``invariant_violations``, or missing recovery-time p50/p95 fields —
-  surviving the injection without evidence of recovery doesn't count.
+  surviving the injection without evidence of recovery doesn't count,
+- SLO legs (``--slo-report``): every scenario in the run must carry a
+  non-empty ``slo`` attainment record (obs/slo.py shape) and every
+  objective in it must be met — a missed objective OR an absent
+  attainment record fails (absence of evidence isn't attainment).
 
 CI runs the smoke lane against the committed ``--full`` record: smoke is
 smaller and faster, so the latency comparison only trips on gross
@@ -123,6 +127,35 @@ def chaos_gate(run: dict, require_all: bool = False) -> list[str]:
                 f"{name}: recovery_ms p50/p95 missing — no evidence the "
                 "plane recovered from the injection"
             )
+    return failures
+
+
+def slo_gate(run: dict) -> list[str]:
+    """--slo-report leg: per-scenario SLO attainment, uniformly. The
+    record shape is obs/slo.py report(): {objective: {target_ms,
+    objective, n, attainment, burn, met}}."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    if not scenarios:
+        return ["slo: run contains no scenarios"]
+    for name in sorted(scenarios):
+        slo = scenarios[name].get("slo")
+        if not isinstance(slo, dict) or not slo:
+            failures.append(
+                f"{name}: no SLO attainment record — the scenario ran "
+                "without declaring whether the product promise held"
+            )
+            continue
+        for objective in sorted(slo):
+            entry = slo[objective]
+            if not entry.get("met"):
+                failures.append(
+                    f"{name}: SLO {objective} missed — attainment "
+                    f"{entry.get('attainment')} over n={entry.get('n')} "
+                    f"vs objective {entry.get('objective')} at "
+                    f"{entry.get('target_ms')} ms (burn "
+                    f"{entry.get('burn')})"
+                )
     return failures
 
 
@@ -232,6 +265,10 @@ def main(argv=None) -> int:
                     help="cplint JSON report to assert clean (the CI "
                          "static-analysis step); usable alone or "
                          "alongside the bench legs")
+    ap.add_argument("--slo-report", action="store_true",
+                    help="fail on any missed SLO objective or absent "
+                         "per-scenario attainment record in --run "
+                         "(obs/slo.py; composes with the other legs)")
     args = ap.parse_args(argv)
     failures = []
     if args.lint_report:
@@ -254,6 +291,10 @@ def main(argv=None) -> int:
     if args.run is None:
         if not args.lint_report:
             ap.error("--run is required unless --lint-report is given")
+        if args.slo_report:
+            # same asymmetry as --chaos-only: an explicitly requested
+            # leg silently skipped is a misconfigured CI step passing
+            ap.error("--slo-report requires --run")
         if args.chaos_only:
             # --chaos-only explicitly requests the chaos invariant
             # legs; silently skipping them because --run was forgotten
@@ -263,11 +304,17 @@ def main(argv=None) -> int:
     else:
         with open(args.run) as f:
             run = json.load(f)
+    if run is not None and args.slo_report:
+        failures += slo_gate(run)
+    baseline = None
     if run is not None and args.chaos_only:
         failures += chaos_gate(run, require_all=True)
-    elif run is not None:
+    elif run is not None and (args.baseline or not args.slo_report):
+        # latency legs need the committed record; a pure --slo-report
+        # invocation legitimately runs without one
         if not args.baseline:
-            ap.error("--baseline is required unless --chaos-only")
+            ap.error("--baseline is required unless --chaos-only or "
+                     "--slo-report")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -290,7 +337,7 @@ def main(argv=None) -> int:
                 print(f"bench_gate ok: {name} recovery p50/p95 "
                       f"{rec['p50']:.0f}/{rec['p95']:.0f} ms, "
                       "invariants clean", file=sys.stderr)
-        else:
+        elif baseline is not None:
             for scenario, phase, pct in GATES:
                 base = baseline["scenarios"][scenario]["phases_ms"][
                     phase][pct]
@@ -298,6 +345,10 @@ def main(argv=None) -> int:
                 print(f"bench_gate ok: {scenario}.{phase}.{pct} "
                       f"{got:.1f} ms vs baseline {base:.1f} ms",
                       file=sys.stderr)
+        if run is not None and args.slo_report:
+            n = len(run.get("scenarios", {}))
+            print(f"bench_gate ok: SLO attainment met in all "
+                  f"{n} scenario(s)", file=sys.stderr)
     return 1 if failures else 0
 
 

@@ -4,6 +4,7 @@
 from tools.cplint.passes import (
     cache_mutation,
     clock_injection,
+    event_reason,
     lock_discipline,
     metrics,
     queue_span,
@@ -17,4 +18,5 @@ ALL_PASSES = (
     rbac,
     clock_injection,
     metrics,
+    event_reason,
 )

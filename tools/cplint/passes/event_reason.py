@@ -1,0 +1,141 @@
+"""event-reason: Event reasons are module-level CamelCase constants.
+
+Event ``reason`` strings are a queryable API surface (``kubectl get
+events --field-selector reason=Preempted``, dashboards group by them)
+AND a cardinality control point: the cpscope recorder's correlation
+groups key on (involvedObject, type, reason), so a reason built with an
+f-string fans one logical event out into unbounded Event objects —
+exactly the spam the aggregator exists to prevent, manufactured one
+layer up.
+
+The rule, checked at every ``*recorder*.event(...)`` / ``.emit(...)``
+call site in the controlplane scope:
+
+- the reason argument (positional 2, after the object and type) must be
+  a **Name** or **Attribute** reference — never an inline string
+  literal, f-string, concatenation, %-format, ``.format()`` call, or
+  boolean fallback expression containing one;
+- when the Name resolves to a module-level string constant, its value
+  must be CamelCase (``^[A-Z][A-Za-z0-9]*$``) — the k8s Event reason
+  convention;
+- Names that do NOT resolve statically (locals, parameters — e.g. the
+  notebook re-emission worker forwarding the CHILD event's own reason)
+  are allowed: the pass is sound, not clairvoyant, and the constant
+  hoisting it enforces makes the flows it can't follow start from
+  checked constants anyway.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "event-reason"
+DESCRIPTION = (
+    "Event reasons must be module-level CamelCase constants — no inline "
+    "literals, no f-strings (cardinality control)"
+)
+
+SCOPE = CONTROLPLANE
+
+CAMEL_RE = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+
+#: recorder method names whose reason argument is checked
+RECORDER_METHODS = ("event", "emit")
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        findings.extend(_check_module(ctx, path, tree))
+    return findings
+
+
+def _module_str_constants(tree: ast.AST) -> dict:
+    """{name: value} for every module-level string assignment."""
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value.value
+    return out
+
+
+def _is_recorder_call(node: ast.Call) -> bool:
+    """``<something>recorder<something>.event/emit(...)`` — the receiver
+    chain must mention a recorder, so Tracker.record / queue.get style
+    homonyms never false-positive."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr not in RECORDER_METHODS:
+        return False
+    chain = astutil.attr_chain(fn.value)
+    if chain is None:
+        return False
+    return any("recorder" in part.lower() for part in chain)
+
+
+def _reason_arg(node: ast.Call):
+    """The reason argument: positional index 2 (obj, type, reason, msg)
+    or the ``reason=`` keyword."""
+    for kw in node.keywords:
+        if kw.arg == "reason":
+            return kw.value
+    if len(node.args) > 2:
+        return node.args[2]
+    return None
+
+
+def _check_module(ctx, path, tree) -> list:
+    findings = []
+    constants = _module_str_constants(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_recorder_call(node):
+            continue
+        reason = _reason_arg(node)
+        if reason is None:
+            continue
+        if isinstance(reason, ast.Constant) and \
+                isinstance(reason.value, str):
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"inline Event reason {reason.value!r} — hoist it to a "
+                "module-level CamelCase constant (reasons are a "
+                "queryable API surface; the catalog lives in "
+                "docs/observability.md)",
+            ))
+        elif isinstance(reason, (ast.JoinedStr, ast.BinOp, ast.BoolOp,
+                                 ast.IfExp)) or (
+                isinstance(reason, ast.Call)):
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                "dynamic Event reason (f-string/concatenation/"
+                "fallback expression) — reasons key the recorder's "
+                "correlation groups, so unbounded values defeat "
+                "aggregation; bind the value to a local first if it "
+                "genuinely flows from data",
+            ))
+        elif isinstance(reason, ast.Name):
+            value = constants.get(reason.id)
+            if value is not None and not CAMEL_RE.match(value):
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"Event reason constant {reason.id} = {value!r} is "
+                    "not CamelCase (k8s Event reason convention)",
+                ))
+        # unresolvable Names / Attributes: allowed (see module docstring)
+    return findings

@@ -26,7 +26,7 @@ ROLES = {
             f"{CP}/controllers/notebook.py",
             f"{CP}/controllers/culling.py",       # ENABLE_CULLING
             f"{CP}/scheduler",                    # ENABLE_SCHEDULER
-            f"{CP}/events.py",                    # EventRecorder verbs
+            f"{CP}/obs/events.py",                # EventRecorder verbs
             f"{CP}/engine/leaderelection.py",     # --leader-elect
         ),
     },
@@ -34,6 +34,7 @@ ROLES = {
         "manifest": "manifests/controllers/profile/rbac.yaml",
         "sources": (
             f"{CP}/controllers/profile.py",
+            f"{CP}/obs/events.py",                # EventRecorder verbs
             f"{CP}/engine/leaderelection.py",
         ),
     },
@@ -41,7 +42,7 @@ ROLES = {
         "manifest": "manifests/controllers/tensorboard/rbac.yaml",
         "sources": (
             f"{CP}/controllers/tensorboard.py",
-            f"{CP}/events.py",
+            f"{CP}/obs/events.py",
             f"{CP}/engine/leaderelection.py",
         ),
     },
@@ -49,7 +50,7 @@ ROLES = {
         "manifest": "manifests/controllers/pvcviewer/rbac.yaml",
         "sources": (
             f"{CP}/controllers/pvcviewer.py",
-            f"{CP}/events.py",
+            f"{CP}/obs/events.py",
             f"{CP}/engine/leaderelection.py",
         ),
     },
