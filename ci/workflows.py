@@ -201,7 +201,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
         ["service_account_auth_improvements_tpu/controlplane/**",
          "service_account_auth_improvements_tpu/webhook/**",
          "manifests/controllers/**",
-         "tests/test_cpbench.py", "tools/metrics_lint.py",
+         "tests/test_cpbench.py", "tests/test_cpprof.py",
+         "tools/metrics_lint.py",
          "tools/cplint/**", "tools/bench_gate.py"],
         {"cpbench": job([
             CHECKOUT, SETUP_PY,
@@ -221,10 +222,14 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "run": "python tools/bench_gate.py "
                     "--lint-report cplint_report.json"},
             # the fresh run goes to bench_out.json so the committed
-            # CONTROLPLANE_BENCH.json stays available as the gate baseline
+            # CONTROLPLANE_BENCH.json stays available as the gate
+            # baseline. --profile: cpprof samples hot stacks + lock
+            # contention + saturation per scenario into extra.prof and
+            # records the CPPROF=0 vs 1 A/B (folded profiles land in
+            # bench_out/ on violations, uploaded below)
             {"name": "Run cpbench --smoke",
              "run": "python -m service_account_auth_improvements_tpu."
-                    "controlplane.cpbench --smoke "
+                    "controlplane.cpbench --smoke --profile "
                     "--out bench_out.json --dump-dir bench_out"},
             {"name": "Validate bench JSON",
              "run": "python -c \"import json; d = json.load(open("
@@ -249,13 +254,16 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             # perf-regression gate vs the committed record: churn
             # controller_overhead p50 and notebook_ready create→Ready
             # p95 within +20%, cached-read hit rate reported
-            # ... with the SLO leg riding along: per-scenario
-            # attainment records present and every objective met
+            # ... with the SLO leg riding along (per-scenario
+            # attainment records present, every objective met) and the
+            # cpprof leg: every scenario names its top hot stack, top
+            # contended lock site and per-client apiserver split, and
+            # the profiler A/B overhead stays ≤5% on notebook_ready p95
             {"name": "Bench regression gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
                     "--run bench_out.json --tolerance 1.2 "
-                    "--slo-report"},
+                    "--slo-report --prof-report"},
             # chaos smoke: the fault-injection family (cpbench/chaos.py)
             # — apiserver blackout, 410 Gone storms, node death, kubelet
             # stall — then the invariant gate: 0 double bookings, 0

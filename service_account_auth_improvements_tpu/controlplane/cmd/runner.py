@@ -49,6 +49,11 @@ def run_manager(register, argv=None, add_args=None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s",
     )
+    # cpprof: CPPROF=1 starts the sampling profiler, CPPROF_LOCKS=1 the
+    # lock-contention instrumentation — BEFORE the manager exists, so
+    # its queue/informer locks are created watched (only locks created
+    # after installation are instrumented). Both feed /debug/profilez.
+    obs.start_profiler_from_env()
     client = KubeClient(base_url=args.kube_url)
     manager = Manager(client, namespace=args.namespace,
                       default_workers=args.workers)
@@ -79,6 +84,9 @@ def run_manager(register, argv=None, add_args=None) -> int:
         ready_detail=manager.informer_status,
         # /debug/explainz/<ns>/<name> + /slostatus (obs/explain, obs/slo)
         kube=client, journal=obs.JOURNAL, slo=slo_engine,
+        # /debug/profilez: the process profiler (idle unless CPPROF=1 —
+        # the page then says so instead of 404ing)
+        profiler=obs.PROFILER,
     )
 
     elector = None

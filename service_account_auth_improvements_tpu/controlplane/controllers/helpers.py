@@ -80,7 +80,18 @@ def ensure(kube, plural: str, desired: dict, group: str | None = None,
     try:
         live = kube.get(plural, meta["name"], namespace=ns, group=group)
     except errors.NotFound:
-        return kube.create(plural, desired, namespace=ns, group=group), True
+        try:
+            return (kube.create(plural, desired, namespace=ns,
+                                group=group), True)
+        except errors.AlreadyExists:
+            # stale-cache window: the cached read missed an object whose
+            # ADDED event hasn't landed yet. One live read converges
+            # NOW instead of riding an error-tagged backoff retry —
+            # level-triggering would heal it anyway, but a routine cache
+            # lag must not read as a reconcile error (and under load the
+            # retry itself can hit the same window again).
+            live = getattr(kube, "live", kube).get(
+                plural, meta["name"], namespace=ns, group=group)
     updated = copy.deepcopy(live)
     changed = (copy_fields or copy_spec_fields)(desired, updated)
     if changed:

@@ -18,6 +18,7 @@ import argparse
 import json
 import logging
 import os
+import statistics
 import sys
 import time
 
@@ -32,6 +33,7 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import
     BenchConfig,
     run_scenario,
 )
+from service_account_auth_improvements_tpu.controlplane import obs
 
 SCHEMA = "cpbench/v1"
 
@@ -82,6 +84,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="include the chaos scenario family (fault "
                          "injection + recovery invariants; "
                          "docs/chaos.md) in the run")
+    ap.add_argument("--profile", action="store_true",
+                    help="cpprof: sample hot stacks + lock contention + "
+                         "saturation per scenario into extra.prof, and "
+                         "record the profiler-off A/B on notebook_ready "
+                         "(gated by bench_gate --prof-report); full "
+                         "folded profiles land in --dump-dir on "
+                         "violations")
     ap.add_argument("--n", type=int,
                     help="override CRs per scenario (all scenarios)")
     ap.add_argument("--concurrency", type=int, default=8,
@@ -111,8 +120,123 @@ def build_parser() -> argparse.ArgumentParser:
     return ap
 
 
+def _prof_extra(profiler, locks_t0: dict, extra: dict) -> dict:
+    """The per-scenario ``extra.prof`` record: top hot stacks (sampler,
+    reconcile-attributed), top contended lock sites (lockwatch delta
+    over this scenario), saturation gauges, and the per-client apiserver
+    request split — the one place bench_gate --prof-report looks."""
+    rep = profiler.report(top_k=10)
+    locks = obs.lock_contention_top(since=locks_t0, limit=10)
+    return {
+        "schema": "cpprof/v1",
+        "hz": rep["hz"],
+        "samples": rep["samples"],
+        "duration_s": rep["duration_s"],
+        "top_stack": rep["top_stack"],
+        "top_controller": rep["top_controller"],
+        "stacks": rep["stacks"],
+        "functions": rep["functions"],
+        "locks": locks,
+        "top_contended_lock": locks[0]["site"] if locks else None,
+        "saturation": obs.saturation_snapshot(),
+        "by_client": extra.get("apiserver_requests_by_client") or {},
+    }
+
+
+def _overhead_ab(args) -> dict:
+    """CPPROF=0 vs 1 A/B on notebook_ready, the evidence that profiling
+    is cheap enough to leave on (bench_gate --prof-report holds the p95
+    ratio to ≤1.05). Methodology, tuned for a noisy shared box whose
+    run-to-run drift (±20 % observed) dwarfs the sampler's ~1 % true
+    cost:
+
+    - **paired runs** (O N O N ... O — every profiled run sandwiched
+      between unprofiled neighbors): ambient load on a shared box
+      drifts with a correlation time of tens of seconds — comparable to
+      the whole experiment — so arm-pooled statistics (min-of-k,
+      median-of-k, any mirrored order) inherit whichever slow swell
+      happened to cover more of one arm; measured ±6 % wander, sign
+      included. Each profiled run divided by the MEAN of its two
+      neighbors cancels the swell locally (both neighbors ride the same
+      one); the reported ratio is the median over the pairs, robust to
+      a single loaded pair.
+    - **n pinned at 48** (both lanes; --n still overrides): the full
+      burst (150 CRs) sits on the saturation cliff where p95 amplifies
+      ambient noise far more than it amplifies sampler cost, while the
+      smoke burst (24 CRs) finishes in ~150 ms — below the box's
+      scheduling jitter. 48 sits between: saturated enough that a real
+      overhead regression (a 10x costlier sampler) shows, long enough
+      that p95 isn't noise, and identical across lanes so the smoke
+      gate and the committed record measure the same experiment.
+
+    The lock instrumentation stays installed in both arms: wrappers on
+    live locks cannot be peeled off a running process, so the A/B
+    isolates the sampler — the only part with a global (GIL) cost."""
+    cfg = BenchConfig(
+        n=args.n or 48,
+        concurrency=args.concurrency, pattern=args.pattern,
+        rate=args.rate, actuation=args.actuation, seed=args.seed,
+        timeout=args.timeout,
+    )
+    pairs = 10
+    # unmeasured warm-up: the A/B runs first in a cold process, and the
+    # first few runs ride a convex warm-up curve (allocator, caches) —
+    # on that curve EVERY pair reads below 1 (the midpoint of a convex
+    # arc is below its endpoints' mean), systematically understating
+    # overhead. Two throwaway runs flatten it before measurement.
+    for _ in range(2):
+        run_scenario("notebook_ready", cfg)
+    sequence: list[float | None] = []   # p95 per run, off/on alternating
+    ok = True
+    for i in range(2 * pairs + 1):      # O N O N ... O
+        profiled = i % 2 == 1
+        profiler = obs.Profiler() if profiled else None
+        if profiler is not None:
+            profiler.start()
+        try:
+            result = run_scenario("notebook_ready", cfg)
+        finally:
+            if profiler is not None:
+                profiler.stop()
+        ok = ok and result.ok
+        p95 = (result.summary["phases_ms"]
+               .get("create_to_ready") or {}).get("p95")
+        sequence.append(round(p95, 3) if p95 is not None else None)
+    paired = [
+        sequence[i] / ((sequence[i - 1] + sequence[i + 1]) / 2.0)
+        for i in range(1, len(sequence), 2)
+        if sequence[i] and sequence[i - 1] and sequence[i + 1]
+    ]
+    ons = [sequence[i] for i in range(1, len(sequence), 2)
+           if sequence[i]]
+    offs = [sequence[i] for i in range(0, len(sequence), 2)
+            if sequence[i]]
+    return {
+        "scenario": "notebook_ready",
+        "method": "paired off/on x10 at n=48, median of "
+                  "on-vs-adjacent-offs ratios",
+        "n": cfg.n,
+        "p95_on_ms": (round(statistics.median(ons), 3)
+                      if ons else None),
+        "p95_off_ms": (round(statistics.median(offs), 3)
+                       if offs else None),
+        "p95_runs_ms": sequence,
+        "paired_ratios": [round(r, 4) for r in paired],
+        "ratio": (round(statistics.median(paired), 4)
+                  if paired else None),
+        "runs_ok": ok,
+    }
+
+
 def run(args) -> dict:
     LatencyDist(args.actuation)  # fail fast on a malformed spec
+    profiling = getattr(args, "profile", False)
+    if profiling:
+        # lock wrappers only watch locks created AFTER installation —
+        # install before any scenario world exists. Idempotent (shares
+        # the CPLINT_LOCKWATCH instance when the lint lane installed it
+        # first: ONE wrapper layer, by design).
+        obs.install_lock_contention()
     mode = "full" if args.full else "smoke"
     sizes = FULL_N if args.full else SMOKE_N
     # default run = the healthy family (the regression lane CI parses);
@@ -135,6 +259,22 @@ def run(args) -> dict:
         },
         "scenarios": {},
     }
+    if profiling and "notebook_ready" in wanted:
+        # the A/B runs FIRST, in the freshest process state: after a
+        # full suite the heap is large and GC pauses spike individual
+        # runs by 2x, noise the pairing can't always reject (measured —
+        # the same experiment reads ±1 % fresh and ±10 % post-suite).
+        # An overhead measurement exists to catch sampler-cost
+        # regressions; fresh-state is the controlled condition.
+        report["profiler_overhead"] = _overhead_ab(args)
+        ov = report["profiler_overhead"]
+        print(
+            f"profiler A/B     "
+            f"p95 on={ov['p95_on_ms'] or float('nan'):.2f}ms "
+            f"off={ov['p95_off_ms'] or float('nan'):.2f}ms "
+            f"ratio={ov['ratio']}",
+            file=sys.stderr,
+        )
     for name in wanted:
         cfg = BenchConfig(
             n=args.n or sizes[name],
@@ -146,10 +286,33 @@ def run(args) -> dict:
             timeout=args.timeout,
         )
         t0 = time.monotonic()
-        result = run_scenario(name, cfg)
+        profiler = locks_t0 = None
+        if profiling:
+            profiler = obs.Profiler()
+            locks_t0 = obs.lock_contention_snapshot()
+            profiler.start()
+        try:
+            result = run_scenario(name, cfg)
+        finally:
+            if profiler is not None:
+                profiler.stop()
         entry = dict(result.summary)
         entry["ok"] = result.ok
         entry["elapsed_s"] = round(result.elapsed_s, 3)
+        if profiler is not None:
+            entry.setdefault("extra", {})["prof"] = _prof_extra(
+                profiler, locks_t0, entry.get("extra") or {}
+            )
+            if not result.ok and getattr(args, "dump_dir", ""):
+                # a violating scenario ships its FULL folded profile —
+                # the flamegraph input, not just the top-k summary
+                os.makedirs(args.dump_dir, exist_ok=True)
+                fold_path = os.path.join(args.dump_dir,
+                                         f"{name}_profile.folded")
+                with open(fold_path, "w") as f:
+                    f.write(profiler.folded())
+                print(f"{name}: folded profile -> {fold_path}",
+                      file=sys.stderr)
         report["scenarios"][name] = entry
         if result.blackbox and getattr(args, "dump_dir", ""):
             # black-box flight record: journal tail + explain timeline

@@ -133,6 +133,12 @@ class FakeKubelet:
 
     def __init__(self, kube, latency: LatencyDist | str = "uniform:5,15",
                  seed: int = 0, tracer=None, relist_period: float = 0.0):
+        # per-client attribution (cpprof): everything the fake cluster
+        # does — pod creates, binds, Ready flips, STS status — books
+        # under "kubelet" in the apiserver's per-client split
+        if hasattr(kube, "client_for") \
+                and getattr(kube, "client_id", None) is None:
+            kube = kube.client_for("kubelet")
         self.kube = kube
         #: with a tracer, each pod's schedule→Ready interval lands on the
         #: owning notebook's trace as a ``kubelet.actuation`` span — the

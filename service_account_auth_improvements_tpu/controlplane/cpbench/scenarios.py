@@ -72,6 +72,7 @@ from service_account_auth_improvements_tpu.controlplane.obs import (
     slo as slo_mod,
 )
 from service_account_auth_improvements_tpu.controlplane.engine import (
+    CachedClient,
     Informer,
     Manager,
 )
@@ -84,6 +85,7 @@ from service_account_auth_improvements_tpu.controlplane.scheduler import (
     SchedulerReconciler,
 )
 from service_account_auth_improvements_tpu.controlplane import tpu as tpu_mod
+from service_account_auth_improvements_tpu.utils.env import get_env_bool
 from service_account_auth_improvements_tpu.webhook.server import (
     review_response,
 )
@@ -126,6 +128,23 @@ class ScenarioResult:
 
 # --------------------------------------------------------------- fixtures
 
+def by_client_delta(snapshot: dict, t0: dict) -> dict:
+    """Per-(client, verb) request delta between two
+    ``request_counts_snapshot(by_client=True)`` snapshots, zero rows
+    dropped."""
+    out: dict = {}
+    for client in sorted(set(snapshot) | set(t0)):
+        cur, base = snapshot.get(client) or {}, t0.get(client) or {}
+        verbs = {
+            verb: cur.get(verb, 0) - base.get(verb, 0)
+            for verb in sorted(set(cur) | set(base))
+            if cur.get(verb, 0) - base.get(verb, 0)
+        }
+        if verbs:
+            out[client] = verbs
+    return out
+
+
 def _nb(name: str, ns: str, tpu: dict | None) -> dict:
     spec: dict = {
         "template": {"spec": {"containers": [{
@@ -145,6 +164,13 @@ class _NotebookWorld:
                  fetch_kernels=None, scheduler: bool = False,
                  relist_period: float = 0.0):
         self.kube = FakeKube()
+        # per-client request attribution (cpprof): the bench's own
+        # traffic (creates, deletes, cache-miss polls) books under
+        # "cpbench"; the Manager tags itself "manager" + installs the
+        # reconcile-actor hook, the kubelet tags itself "kubelet" — so
+        # extra.apiserver_requests_by_client names who stormed the
+        # apiserver, not just how hard
+        self.kube.default_client_id = "cpbench"
         self.tracker = Tracker(scenario)
         # per-world tracer: the span source for per-stage attribution,
         # isolated so scenarios can't read each other's lifecycles
@@ -190,11 +216,28 @@ class _NotebookWorld:
                                     relist_period=relist_period)
         self.tracker.actuation_fn = self.actuator.actuation_for
         #: the manager's delegating read client — what the converted
-        #: reconcilers read through; scenario poll loops use it too, so
-        #: the apiserver counters measure control-plane load, not the
-        #: bench's own polling
-        self.cached = self.mgr.cached_client()
+        #: reconcilers read through; its stats() are the cached-read
+        #: hit-rate evidence the gate holds to ≥0.9 (control-plane
+        #: reads, not bench polling)
+        self._mgr_cached = self.mgr.cached_client()
+        #: what the SCENARIO poll loops read through: the same informer
+        #: caches (so the bench's own waiting doesn't inflate the
+        #: apiserver volume it measures) but over a "cpbench"-tagged
+        #: client, so the rare cache-miss fallthroughs book under the
+        #: bench in the per-client split — not under "manager", whose
+        #: row exists to show the control plane's own appetite
+        self.cached = CachedClient(
+            self.kube.client_for("cpbench"), self.mgr._informers,
+            namespace=self.mgr.namespace,
+            # honor the documented cache A/B lever: ENGINE_CACHED_READS=0
+            # must turn the bench's own polling live too, or the
+            # cache-off apiserver-volume numbers stop being comparable
+            enabled=get_env_bool("ENGINE_CACHED_READS", True),
+        )
         self._api_t0 = self.kube.request_counts_snapshot()
+        self._api_t0_by_client = self.kube.request_counts_snapshot(
+            by_client=True
+        )
         self._want: dict[tuple[str, str], int] = {}
         self._ready_inf = Informer(self.kube, "notebooks", group=GROUP,
                                    tracer=self.trace,
@@ -243,10 +286,14 @@ class _NotebookWorld:
         reads = delta.get("get", 0) + delta.get("list", 0)
         return {
             "apiserver_requests": delta,
+            "apiserver_requests_by_client": by_client_delta(
+                self.kube.request_counts_snapshot(by_client=True),
+                self._api_t0_by_client,
+            ),
             "apiserver_reads_per_reconcile": round(
                 reads / max(reconciles, 1), 3
             ),
-            "cached_reads": self.cached.stats(),
+            "cached_reads": self._mgr_cached.stats(),
         }
 
     # ---------------------------------------------------- cpscope surface
@@ -583,6 +630,7 @@ def scenario_profile_fanout(cfg: BenchConfig) -> ScenarioResult:
     service accounts, Istio ACLs, and cloud-IAM plugin binds."""
     started = time.monotonic()
     kube = FakeKube()
+    kube.default_client_id = "cpbench"
     tracker = Tracker("profile_fanout")
     tracker.instrument_kube(kube)
     mgr = Manager(kube)
@@ -644,6 +692,9 @@ def scenario_profile_fanout(cfg: BenchConfig) -> ScenarioResult:
         # the profile reconciler still reads live (not converted); the
         # raw tally keeps it comparable across PRs
         "apiserver_requests": api,
+        "apiserver_requests_by_client": kube.request_counts_snapshot(
+            by_client=True
+        ),
         "apiserver_reads_per_reconcile": round(
             (api.get("get", 0) + api.get("list", 0))
             / max(summary["reconciles"], 1), 3
@@ -668,7 +719,11 @@ def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
     per review (what the real webhook does per pod CREATE)."""
     started = time.monotonic()
     kube = FakeKube()
+    kube.default_client_id = "cpbench"
     tracker = Tracker("webhook_inject")
+    # the per-review PodDefault LIST is the webhook's own traffic — tag
+    # it so the per-client split separates it from the bench's staging
+    webhook_client = kube.client_for("webhook")
     namespaces = [f"wh-{i}" for i in range(min(8, max(1, cfg.n // 4)))]
     for ns in namespaces:
         for pd_name, labels in (("tpu-env", {"inject-tpu": "true"}),
@@ -686,7 +741,7 @@ def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
             }, namespace=ns)
 
     def list_pds(ns):
-        return kube.list("poddefaults", namespace=ns)["items"]
+        return webhook_client.list("poddefaults", namespace=ns)["items"]
 
     mutated = [0]
     mutated_lock = threading.Lock()
@@ -724,6 +779,9 @@ def scenario_webhook_inject(cfg: BenchConfig) -> ScenarioResult:
         "namespaces": len(namespaces),
         "poddefaults_per_namespace": 2,
         "mutated": mutated[0],
+        "apiserver_requests_by_client": kube.request_counts_snapshot(
+            by_client=True
+        ),
         "event_count": len(kube.list("events")["items"]),
         "journal": {},
     }

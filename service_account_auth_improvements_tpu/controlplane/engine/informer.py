@@ -226,6 +226,17 @@ class Informer:
         # time the event sat in the watch channel behind a backlog, the
         # part of "watch→handler delivery" a receipt-side clock can't see
         start = received
+        if emitted is not None and received - emitted >= 0:
+            # cpprof saturation feed: how long this event sat in the
+            # watch channel before we picked it up — a growing value is
+            # the informer falling behind its stream. Deliberately NOT
+            # under the 300 s sanity bound below: an informer minutes
+            # behind is exactly what this gauge exists to flag, and a
+            # guard that stopped updating there would freeze it at the
+            # last healthy reading.
+            self._metrics.informer_backlog.labels(self.plural).set(
+                received - emitted
+            )
         if emitted is not None and 0 <= received - emitted < 300:
             start = emitted
         for fn, want_old in self._handlers:

@@ -27,7 +27,9 @@ from service_account_auth_improvements_tpu.controlplane.engine.informer import (
     Informer,
 )
 from service_account_auth_improvements_tpu.controlplane.engine.metrics import (
+    BusyRatio,
     engine_metrics,
+    register_busy_ratio,
 )
 from service_account_auth_improvements_tpu.controlplane.engine.queue import (
     RateLimitingQueue,
@@ -79,6 +81,12 @@ class Controller:
                                        metrics=self.metrics)
         self.queue.trace_hook = self._note_queue_wait
         self.workers = workers
+        # cpprof saturation feeds: depth-per-worker on the queue, a
+        # time-weighted busy fraction on the workers (registered so
+        # saturation readers can refresh the gauge while idle)
+        self.queue.saturation_workers = workers
+        self.busy = BusyRatio(workers)
+        register_busy_ratio(self.name, self.busy)
         self._threads: list[threading.Thread] = []
         # hook → worker handoff stays on the worker's own thread (the
         # hook fires inside queue.get), so a thread-local carries it
@@ -102,6 +110,7 @@ class Controller:
             if req is None:
                 return
             m.active_workers.labels(self.name).inc()
+            self.busy.busy()
             started = time.monotonic()
             # every tracer interaction is fenced: Manager(tracer=...) is
             # an injection point, and a raising tracer must never kill
@@ -123,6 +132,7 @@ class Controller:
                     pass
             outcome = "success"
             span = None
+            tag = None
             try:
                 span = tracer.span(
                     "reconcile",
@@ -133,6 +143,20 @@ class Controller:
                 span.__enter__()
             except Exception:
                 span = None
+            # cpprof thread tag: the sampler folds this thread's stacks
+            # under the controller (not the anonymous worker), and
+            # FakeKube attributes the reconcile's apiserver requests to
+            # it (obs.current_actor). Fenced like the tracer — a
+            # profiler bug must never kill a worker.
+            try:
+                tag = obs.reconcile_tag(
+                    self.name,
+                    key=obs.object_key(self.reconciler.resource,
+                                       req.namespace, req.name),
+                )
+                tag.__enter__()
+            except Exception:
+                tag = None
             try:
                 try:
                     result = self.reconciler.reconcile(req)
@@ -159,6 +183,11 @@ class Controller:
                     )
                     self.queue.add_rate_limited(req)
             finally:
+                if tag is not None:
+                    try:
+                        tag.__exit__(None, None, None)
+                    except Exception:
+                        pass
                 if span is not None:
                     try:
                         span.set_attr("outcome", outcome)
@@ -170,6 +199,8 @@ class Controller:
                 m.reconcile_total.labels(self.name, outcome).inc()
                 m.workqueue_work_duration.labels(self.name).observe(elapsed)
                 m.active_workers.labels(self.name).dec()
+                self.busy.idle()
+                m.worker_busy_ratio.labels(self.name).set(self.busy.ratio())
                 self.queue.done(req)
 
     def start(self) -> None:
@@ -205,6 +236,18 @@ class Manager:
     def __init__(self, client, namespace: str | None = None,
                  default_workers: int | None = None, tracer=None,
                  relist_period: float = 0.0):
+        # per-client apiserver request attribution (kube/fake.py): the
+        # manager tags its traffic (informers, cached-client fallthrough
+        # and writes) as "manager", and installs the cpprof actor hook
+        # so requests issued FROM a reconcile resolve to the controller
+        # name instead — the split that makes a storming controller
+        # visible. No-ops on clients without the FakeKube surface.
+        if hasattr(client, "client_for") \
+                and getattr(client, "client_id", None) is None:
+            client = client.client_for("manager")
+        set_actor = getattr(client, "set_actor_fn", None)
+        if set_actor is not None:
+            set_actor(obs.current_actor)
         self.client = client
         self.namespace = namespace
         #: periodic relist for every informer this manager creates
@@ -377,14 +420,30 @@ class Manager:
                 shutdown()
 
     # Convenience for tests: block until all queues drain.
-    def quiesce(self, timeout: float = 10.0) -> bool:
+    def quiesce(self, timeout: float = 10.0,
+                settle: float = 0.06) -> bool:
+        """True once every queue has been empty (and no worker busy)
+        CONTINUOUSLY for ``settle`` seconds. The settle window exists
+        because emptiness alone races event delivery: right after a
+        burst of writes, the watch events are still in the informer's
+        channel and nothing has been enqueued YET — a single-shot
+        emptiness check returns True before the first reconcile ever
+        runs (a race this helper's callers lost regularly on a loaded
+        single-core box). A few scheduler slices of sustained quiet let
+        in-flight deliveries land and re-arm the check."""
         deadline = time.monotonic() + timeout
+        settle = min(settle, timeout / 2)
+        quiet_since = None
         while time.monotonic() < deadline:
-            if all(len(c.queue) == 0 for c in self._controllers):
-                busy = any(
-                    c.queue._processing for c in self._controllers
-                )
-                if not busy:
-                    return True
-            time.sleep(0.02)
+            empty = all(len(c.queue) == 0 for c in self._controllers) \
+                and not any(c.queue._processing
+                            for c in self._controllers)
+            now = time.monotonic()
+            if not empty:
+                quiet_since = None
+            elif quiet_since is None:
+                quiet_since = now
+            elif now - quiet_since >= settle:
+                return True
+            time.sleep(0.01)
         return False

@@ -15,6 +15,7 @@ the reconciler class), controller series carry ``controller``.
 from __future__ import annotations
 
 import threading
+import time
 
 from service_account_auth_improvements_tpu.controlplane.metrics import (
     Counter,
@@ -81,6 +82,108 @@ class EngineMetrics:
             "Watch event receipt to last handler return, per resource",
             ("resource",), buckets=DURATION_BUCKETS, registry=registry,
         )
+        # cpprof saturation feeds: active_workers says how many workers
+        # run RIGHT NOW; busy_ratio says how much of the recent window
+        # they actually worked (a 4-worker controller at ratio 0.95 is
+        # saturated even when the instantaneous gauge reads 0);
+        # depth-per-worker is the queue-side view of the same question.
+        self.worker_busy_ratio = Gauge(
+            "controller_runtime_worker_busy_ratio",
+            "Time-weighted fraction of reconcile workers busy over the "
+            "trailing window", ("controller",), registry=registry,
+        )
+        self.workqueue_depth_per_worker = Gauge(
+            "workqueue_depth_per_worker",
+            "Pending workqueue items per reconcile worker (sustained "
+            ">1 = arrivals outpace the workers)",
+            ("name",), registry=registry,
+        )
+        self.informer_backlog = Gauge(
+            "informer_watch_backlog_seconds",
+            "Age of the most recently delivered watch event at receipt "
+            "(time it sat in the watch channel)",
+            ("resource",), registry=registry,
+        )
+
+
+class BusyRatio:
+    """Time-weighted worker busy fraction over a trailing window.
+
+    Feeds ``controller_runtime_worker_busy_ratio``: the engine calls
+    :meth:`busy` / :meth:`idle` around each reconcile and publishes
+    :meth:`ratio`. Two rolling half-windows (current + last completed)
+    blend so the value both responds to fresh traffic and decays after
+    it stops, instead of averaging over the process's whole life.
+    ``mono_fn`` is injectable for deterministic tests."""
+
+    WINDOW_S = 30.0
+
+    def __init__(self, workers: int, mono_fn=None):
+        self._mono = mono_fn or time.monotonic
+        self.workers = max(int(workers), 1)
+        self._lock = threading.Lock()
+        now = self._mono()
+        self._busy = 0              # workers currently inside reconcile
+        self._mark = now            # last integral advance
+        self._window_start = now
+        self._acc = 0.0             # busy worker-seconds, current window
+        self._prev_acc = 0.0        # last completed window
+        self._prev_len = 0.0
+
+    def _advance_locked(self, now: float) -> None:
+        self._acc += self._busy * max(now - self._mark, 0.0)
+        self._mark = now
+        span = now - self._window_start
+        if span >= self.WINDOW_S:
+            self._prev_acc, self._prev_len = self._acc, span
+            self._acc = 0.0
+            self._window_start = now
+
+    def busy(self) -> None:
+        with self._lock:
+            self._advance_locked(self._mono())
+            self._busy += 1
+
+    def idle(self) -> None:
+        with self._lock:
+            self._advance_locked(self._mono())
+            self._busy = max(self._busy - 1, 0)
+
+    def ratio(self) -> float:
+        with self._lock:
+            now = self._mono()
+            self._advance_locked(now)
+            span = (now - self._window_start) + self._prev_len
+            if span <= 0:
+                return 0.0
+            return min((self._acc + self._prev_acc)
+                       / (span * self.workers), 1.0)
+
+
+#: controller name -> its live BusyRatio (latest registration wins —
+#: cpbench builds many managers per process; the gauge label is shared
+#: anyway). Exists so READERS can refresh the published gauge: the
+#: worker loop only publishes at reconcile completion, and with no
+#: traffic nothing would ever publish the decayed value — an idle
+#: controller would read "saturated" forever off its last busy burst.
+_busy_lock = threading.Lock()
+_busy_ratios: dict[str, BusyRatio] = {}
+
+
+def register_busy_ratio(controller: str, busy: BusyRatio) -> None:
+    with _busy_lock:
+        _busy_ratios[controller] = busy
+
+
+def refresh_busy_ratios() -> None:
+    """Re-publish every registered controller's CURRENT busy ratio —
+    called by the saturation readers (obs/prof.py) so the gauge decays
+    while idle instead of freezing at the last reconcile's value."""
+    em = engine_metrics()
+    with _busy_lock:
+        items = list(_busy_ratios.items())
+    for controller, busy in items:
+        em.worker_busy_ratio.labels(controller).set(busy.ratio())
 
 
 _lock = threading.Lock()

@@ -44,12 +44,19 @@ class RateLimitingQueue:
         #: fn(key, enqueued_at, dequeued_at) called per dequeue, outside
         #: the lock — the tracing seam (engine/manager.py Controller)
         self.trace_hook = None
+        #: worker count serving this queue (set by the Controller):
+        #: turns the raw depth into the saturation gauge cpprof reads —
+        #: depth 8 means opposite things to 1 worker and to 8
+        self.saturation_workers: int | None = None
 
     def _observe_depth_locked(self) -> None:
         if self._metrics is not None:
-            self._metrics.workqueue_depth.labels(self.name).set(
-                len(self._pending)
-            )
+            depth = len(self._pending)
+            self._metrics.workqueue_depth.labels(self.name).set(depth)
+            if self.saturation_workers:
+                self._metrics.workqueue_depth_per_worker.labels(
+                    self.name
+                ).set(depth / self.saturation_workers)
 
     def _note_pending_locked(self, key) -> None:
         """Key just became pending: stamp its wait start (first add wins

@@ -1,5 +1,6 @@
 """Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz,
-/debug/tracez, /debug/explainz, /slostatus, /debug/threadz.
+/debug/tracez, /debug/explainz, /debug/profilez, /slostatus,
+/debug/threadz.
 
 The manager-port surface of the reference binaries (metrics on :8080,
 probes — components/notebook-controller/main.go:64-131), plus the
@@ -26,7 +27,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
 def serve_ops(port: int, registry=None, ready_check=None,
               host: str = "0.0.0.0", tracer=None,
               ready_detail=None, kube=None, journal=None,
-              slo=None) -> ThreadingHTTPServer:
+              slo=None, profiler=None) -> ThreadingHTTPServer:
     """Start the ops endpoint in a daemon thread; returns the server.
 
     ``ready_check() -> bool`` drives /readyz's status code;
@@ -38,10 +39,14 @@ def serve_ops(port: int, registry=None, ready_check=None,
     ``kube``/``journal`` feed /debug/explainz (conditions+Events come
     from the client, decisions from the journal; both optional — the
     page degrades to whatever sources exist and says which are absent);
-    ``slo`` (an obs.SloEngine) serves /slostatus."""
+    ``slo`` (an obs.SloEngine) serves /slostatus; ``profiler`` (an
+    obs.Profiler, default the process-global one) serves
+    /debug/profilez — hot stacks + contended locks + saturation,
+    ``?controller=``/``?fold=`` filtered."""
     reg = registry if registry is not None else REGISTRY
     trc = tracer if tracer is not None else obs.TRACER
     jnl = journal if journal is not None else obs.JOURNAL
+    prof = profiler if profiler is not None else obs.PROFILER
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *args):
@@ -49,6 +54,13 @@ def serve_ops(port: int, registry=None, ready_check=None,
 
         def do_GET(self):
             if self.path.startswith("/metrics"):
+                try:
+                    # refresh the cpprof_lock_* / sample gauges on the
+                    # global registry from the lockwatch pull model; a
+                    # profiler bug must never break a scrape
+                    obs.prof_sync_metrics()
+                except Exception:
+                    pass
                 body = reg.render().encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
@@ -101,6 +113,17 @@ def serve_ops(port: int, registry=None, ready_check=None,
                 else:
                     body = b"usage: /debug/explainz/<namespace>/<name>"
                     self.send_response(400)
+            elif self.path.startswith("/debug/profilez"):
+                # cpprof: hot stacks (reconcile-attributed), contended
+                # lock sites, saturation gauges — one page, filterable
+                q = parse_qs(urlparse(self.path).query)
+                body = obs.render_profilez(
+                    prof,
+                    controller=q.get("controller", [None])[0],
+                    fold=q.get("fold", [None])[0],
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain")
             elif self.path.startswith("/slostatus"):
                 if slo is not None:
                     body = json.dumps(slo.status(), indent=2,

@@ -107,6 +107,19 @@ class FakeKube:
         #: internal fan-out (GC cascade deletes) counts as the requests a
         #: real garbage collector would issue
         self.request_counts: dict[str, int] = {}
+        #: per-(client, verb) tally — the priority-and-fairness pre-work
+        #: (cpprof): who is storming the apiserver, not just how hard it
+        #: is being stormed. Clients identify via :meth:`client_for`
+        #: handles (Manager/kubelet/cpbench tag theirs); requests from a
+        #: reconcile resolve to the controller name through ``actor_fn``
+        #: (obs.current_actor, installed by the Manager); everything
+        #: else books under ``default_client_id``, and the synchronous
+        #: GC cascade under ``(gc)`` — a real garbage collector is its
+        #: own API client.
+        self.request_counts_by_client: dict[str, dict[str, int]] = {}
+        self.default_client_id = "(untagged)"
+        self.actor_fn = None
+        self._caller = threading.local()
         #: fault injection (kube/chaos.py). None = healthy cluster, and
         #: the hooks reduce to one attribute check per request/event —
         #: the bench gate holds the healthy path to its usual numbers
@@ -142,16 +155,47 @@ class FakeKube:
             self.chaos = ChaosInjector(self, seed=seed)
         return self.chaos
 
+    def client_for(self, client_id: str) -> "_TaggedClient":
+        """A client handle whose requests count under ``client_id`` in
+        ``request_counts_snapshot(by_client=True)``. Same interface as
+        this fake (and as ``KubeClient``), so it threads anywhere a
+        client does; handles are cheap and stateless."""
+        return _TaggedClient(self, client_id)
+
+    def set_actor_fn(self, fn) -> None:
+        """Install the thread-actor resolver (``obs.current_actor``):
+        when it names an actor, that actor outranks the handle's
+        client_id — a reconcile's requests belong to the controller
+        running it, whichever handle it borrowed."""
+        self.actor_fn = fn
+
     def _count(self, verb: str) -> None:
+        if getattr(self._internal, "depth", 0):
+            client = "(gc)"
+        else:
+            client = None
+            if self.actor_fn is not None:
+                try:
+                    client = self.actor_fn()
+                except Exception:
+                    client = None  # attribution must never fail a request
+            client = (client or getattr(self._caller, "id", None)
+                      or self.default_client_id)
         with self._lock:
             self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
+            by = self.request_counts_by_client.setdefault(client, {})
+            by[verb] = by.get(verb, 0) + 1
         if self.chaos is not None and \
                 not getattr(self._internal, "depth", 0):
             self.chaos.admit(verb)
 
-    def request_counts_snapshot(self) -> dict[str, int]:
-        """Copy of the per-verb tally (scenarios diff two snapshots)."""
+    def request_counts_snapshot(self, by_client: bool = False):
+        """Copy of the per-verb tally (scenarios diff two snapshots);
+        ``by_client=True`` returns the {client: {verb: count}} split."""
         with self._lock:
+            if by_client:
+                return {c: dict(v)
+                        for c, v in self.request_counts_by_client.items()}
             return dict(self.request_counts)
 
     def _res(self, plural: str, group: str | None = None) -> Resource:
@@ -638,6 +682,54 @@ class FakeKube:
         )
 
         return wire.handle(self, environ, start_response)
+
+
+#: client-interface methods whose calls carry the handle's client_id
+#: (everything that reaches ``_count``, directly or transitively)
+_TAGGED_VERBS = frozenset({
+    "create", "get", "list", "update", "update_status", "patch",
+    "delete", "watch", "pod_logs", "set_pod_logs", "compact_history",
+})
+
+
+class _TaggedClient:
+    """Per-client identity over a shared FakeKube: delegates the client
+    interface verbatim, stamping a thread-local caller id around each
+    call so ``_count`` can attribute it. Attribute lookups resolve on
+    the fake AT CALL TIME (cpbench's tracker wraps ``kube.create`` after
+    handles exist — binding early would dodge the instrumentation);
+    ``__slots__`` keeps accidental attribute writes from silently
+    shadowing the fake's state."""
+
+    __slots__ = ("_kube", "client_id")
+
+    def __init__(self, kube: FakeKube, client_id: str):
+        self._kube = kube
+        self.client_id = client_id
+
+    def client_for(self, client_id: str) -> "_TaggedClient":
+        return _TaggedClient(self._kube, client_id)
+
+    def __getattr__(self, name):
+        attr = getattr(self._kube, name)
+        if name in _TAGGED_VERBS and callable(attr):
+            kube = self._kube
+            cid = self.client_id
+
+            def tagged(*args, _attr=attr, **kwargs):
+                tls = kube._caller
+                prev = getattr(tls, "id", None)
+                tls.id = cid
+                try:
+                    return _attr(*args, **kwargs)
+                finally:
+                    tls.id = prev
+
+            return tagged
+        return attr
+
+    def __repr__(self):  # pragma: no cover - debugging nicety
+        return f"<FakeKube client {self.client_id!r}>"
 
 
 def _apply_json_patch(doc: dict, ops: list) -> dict:

@@ -1,5 +1,5 @@
-"""cpscope: tracing, events, decision journal, explain engine, SLOs
-(docs/observability.md)."""
+"""cpscope: tracing, events, decision journal, explain engine, SLOs,
+and the cpprof profiler (docs/observability.md)."""
 
 from service_account_auth_improvements_tpu.controlplane.obs.trace import (  # noqa: F401,E501
     TRACE_ANNOTATION,
@@ -39,4 +39,17 @@ from service_account_auth_improvements_tpu.controlplane.obs.slo import (  # noqa
     Objective,
     SloEngine,
     observe as slo_observe,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.prof import (  # noqa: F401,E501
+    PROFILER,
+    Profiler,
+    current_actor,
+    install_lock_contention,
+    lock_contention_snapshot,
+    lock_contention_top,
+    reconcile_tag,
+    render_profilez,
+    saturation_snapshot,
+    start_from_env as start_profiler_from_env,
+    sync_metrics as prof_sync_metrics,
 )

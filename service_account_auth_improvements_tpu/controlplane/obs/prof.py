@@ -1,0 +1,585 @@
+"""cpprof: sampling wall-clock profiler + contention/saturation feeds.
+
+The plane can say *what* happened (cptrace spans, the cpscope journal)
+and *whether* the SLOs held (obs/slo.py) — this module answers *where
+the CPU went and who is waiting on whom*, the question every
+"fast as the hardware allows" investigation starts with (NotebookOS,
+arXiv:2503.20591, treats lifecycle-latency visibility as a product
+feature; a latency number without a hot stack is a mystery, not a
+diagnosis).
+
+Three feeds, merged on ``/debug/profilez`` (engine/serve.py) and in
+cpbench's per-scenario ``extra.prof``:
+
+- **Hot stacks** (:class:`Profiler`): a background daemon thread walks
+  ``sys._current_frames()`` at a configurable rate (``CPPROF_HZ``,
+  default 7 — see DEFAULT_HZ for why low-and-prime) and folds each
+  thread's stack flamegraph-style.
+  Samples are attributed to the RUNNING RECONCILE via the thread-tag
+  registry below (the engine tags its workers per attempt), so stacks
+  fold per controller, not per anonymous worker thread. This is a
+  *wall* profiler: blocked threads are sampled too — a stack parked in
+  ``queue.get`` is real wait, and for a control plane the waits are
+  usually the finding.
+- **Lock contention**: tools/cplint/lockwatch's instrumented locks (the
+  ONE lock wrapper — lint mode and the contention view share it) record
+  per-creation-site wait/hold time histograms. Enable outside lint mode
+  with ``CPPROF_LOCKS=1`` (:func:`install_lock_contention`).
+- **Saturation**: worker busy-fraction / queue depth-per-worker /
+  informer watch-backlog gauges (engine/metrics.py) snapshotted by
+  :func:`saturation_snapshot`; FakeKube's per-client request split
+  (``request_counts_snapshot(by_client=True)``) rides the same report
+  in cpbench — the per-client attribution the apiserver
+  priority-and-fairness ROADMAP item needs as pre-work.
+
+Everything is stdlib; the profiler costs nothing when not started and
+its A/B overhead on cpbench's notebook_ready p95 is gated at ≤5 %
+(tools/bench_gate.py --prof-report).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+
+#: default sampling rate: an off-round prime (no phase-lock with
+#: periodic work), chosen low because a saturated control plane
+#: amplifies sampler cost superlinearly — near full utilization a ~2 %
+#: GIL tax turns into >10 % p95 (queueing theory, measured at cpbench
+#: --full burst scale), and on a small-core box the dominant cost is
+#: the WAKE itself, not the sampling work: an A/B with a no-op sampler
+#: measures the same p95 tax as the real one — each wake forces a GIL
+#: handoff + two context switches on the core doing the reconciling,
+#: so overhead scales with wake count and nothing else. 7 Hz keeps the
+#: A/B inside the ≤5 % budget with margin while still landing samples
+#: on any scenario that takes a second (and stop() guarantees at least
+#: one pass regardless). Raise CPPROF_HZ for short-lived
+#: investigations where resolution beats overhead.
+DEFAULT_HZ = 7.0
+
+#: thread ident -> (controller, stage, object key) of the work the
+#: thread is executing RIGHT NOW. The engine's reconcile workers tag
+#: themselves per attempt (engine/manager.py); the sampler reads it to
+#: fold stacks per controller; FakeKube reads it (via ``actor_fn`` =
+#: :func:`current_actor`) to attribute apiserver requests per client.
+#: Plain dict ops under the GIL — no lock on the reconcile hot path.
+_THREAD_TAGS: dict[int, tuple] = {}
+
+
+@contextlib.contextmanager
+def reconcile_tag(controller: str, key: str | None = None,
+                  stage: str = "reconcile"):
+    """Tag the current thread as running ``controller``'s ``stage`` for
+    the duration of the with-block (nestable; the previous tag is
+    restored on exit)."""
+    ident = threading.get_ident()
+    prev = _THREAD_TAGS.get(ident)
+    _THREAD_TAGS[ident] = (controller, stage, key)
+    try:
+        yield
+    finally:
+        if prev is None:
+            _THREAD_TAGS.pop(ident, None)
+        else:
+            _THREAD_TAGS[ident] = prev
+
+
+def current_actor() -> str | None:
+    """Controller name of the innermost tag on THIS thread (None when
+    untagged) — FakeKube's per-client request attribution hook."""
+    tag = _THREAD_TAGS.get(threading.get_ident())
+    return tag[0] if tag else None
+
+
+class Profiler:
+    """Sampling wall profiler over every live thread.
+
+    ``start``/``stop`` are idempotent; a stopped profiler keeps its
+    samples (``report`` / ``folded``) until the next ``start``, which
+    resumes accumulation. ``stop`` takes one final synchronous sample so
+    even a sub-interval workload leaves evidence. ``mono_fn`` is the
+    injected clock for durations (sampling cadence itself rides
+    ``Event.wait`` — it paces, it never *reads* time)."""
+
+    def __init__(self, hz: float | None = None, mono_fn=None,
+                 max_stack: int = 48):
+        env_hz = os.environ.get("CPPROF_HZ")
+        try:
+            hz = float(hz if hz is not None else (env_hz or DEFAULT_HZ))
+        except ValueError:
+            hz = DEFAULT_HZ
+        self.hz = min(max(hz, 1.0), 1000.0)
+        self.max_stack = max_stack
+        self._mono = mono_fn or time.monotonic
+        self._lock = threading.Lock()
+        self._stop_ev = threading.Event()
+        self._thread: threading.Thread | None = None
+        #: (bucket, folded stack) -> sample count. Bucket = the thread
+        #: tag's controller when tagged, else the thread's name — so an
+        #: untagged hot thread is still visible, just less foldable.
+        self._counts: dict[tuple[str, str], int] = {}
+        self._bucket_samples: dict[str, int] = {}
+        self._passes = 0
+        self._active_s = 0.0
+        self._started_at: float | None = None
+        # code object -> display label; code objects are retained, which
+        # bounds the cache at the program's live code size
+        self._label_cache: dict = {}
+        # thread ident -> name, refreshed only when an unknown ident
+        # appears (threading.enumerate() per pass is avoidable cost)
+        self._name_cache: dict[int, str] = {}
+        # ident -> (id(top frame), f_lasti, folded): a thread whose top
+        # frame object AND instruction pointer are unchanged since the
+        # last pass is parked at the same spot (queue.get, watch poll,
+        # Condition.wait — most of a control plane, most of the time);
+        # its fold is reused instead of re-walked. This is what keeps a
+        # pass O(running threads), not O(all threads x stack depth) —
+        # the difference between ~1.5 ms and ~0.2 ms per pass on a busy
+        # bench, i.e. between a measurable and an unmeasurable p95 tax.
+        self._fold_cache: dict[int, tuple] = {}
+
+    # ------------------------------------------------------------ control
+
+    @property
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    @property
+    def passes(self) -> int:
+        """Sampling passes completed — the cheap counter for metric
+        exposition (``report()`` aggregates every fold just to build
+        its tables; a scrape must not pay that)."""
+        with self._lock:
+            return self._passes
+
+    def start(self) -> "Profiler":
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return self
+            self._stop_ev = threading.Event()
+            self._started_at = self._mono()
+            t = threading.Thread(target=self._run, name="cpprof-sampler",
+                                 daemon=True)
+            self._thread = t
+        t.start()
+        return self
+
+    def stop(self) -> "Profiler":
+        with self._lock:
+            t = self._thread
+            self._thread = None
+            ev = self._stop_ev
+            if self._started_at is not None:
+                self._active_s += self._mono() - self._started_at
+                self._started_at = None
+        ev.set()
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        # final synchronous pass: a workload shorter than one sampling
+        # interval must still leave at least one stack behind
+        self.sample_once()
+        return self
+
+    def _run(self) -> None:
+        interval = 1.0 / self.hz
+        stop_ev = self._stop_ev
+        while not stop_ev.wait(interval):
+            try:
+                self.sample_once()
+            except Exception:
+                # a profiler bug must never take the process with it
+                pass
+
+    # ----------------------------------------------------------- sampling
+
+    def _label(self, code) -> str:
+        lbl = self._label_cache.get(code)
+        if lbl is None:
+            fname = code.co_filename.replace("\\", "/")
+            short = "/".join(fname.rsplit("/", 2)[-2:])
+            lbl = f"{short}:{code.co_name}"
+            self._label_cache[code] = lbl
+        return lbl
+
+    def sample_once(self) -> int:
+        """One sampling pass over every live thread except the sampler
+        and the caller (whose stack IS the profiler). Returns the number
+        of stacks recorded — the test surface."""
+        frames = sys._current_frames()
+        tags = dict(_THREAD_TAGS)
+        names = self._name_cache
+        if any(ident not in names for ident in frames):
+            names = {t.ident: t.name for t in threading.enumerate()}
+            self._name_cache = names
+        me = threading.get_ident()
+        sampler = self._thread
+        sampler_ident = sampler.ident if sampler is not None else None
+        rows = []
+        fold_cache = self._fold_cache
+        fresh_cache: dict[int, tuple] = {}
+        for ident, frame in frames.items():
+            if ident == me or ident == sampler_ident:
+                continue
+            # the code object rides the key too: id(frame) can be
+            # recycled after a frame is freed, and id+lasti alone could
+            # serve a dead stack for new work (statistical noise, but
+            # cheap to shrink the window)
+            fid, lasti, code = id(frame), frame.f_lasti, frame.f_code
+            cached = fold_cache.get(ident)
+            if cached is not None and cached[0] == fid \
+                    and cached[1] == lasti and cached[2] is code:
+                folded = cached[3]
+            else:
+                stack = []
+                f = frame
+                while f is not None and len(stack) < self.max_stack:
+                    stack.append(self._label(f.f_code))
+                    f = f.f_back
+                if not stack:
+                    continue
+                stack.reverse()
+                folded = ";".join(stack)
+            fresh_cache[ident] = (fid, lasti, code, folded)
+            tag = tags.get(ident)
+            bucket = tag[0] if tag else names.get(ident, f"thread-{ident}")
+            rows.append((bucket, folded))
+        # replacing (not updating) the cache drops dead threads' entries
+        self._fold_cache = fresh_cache
+        with self._lock:
+            self._passes += 1
+            for bucket, folded in rows:
+                k = (bucket, folded)
+                self._counts[k] = self._counts.get(k, 0) + 1
+                self._bucket_samples[bucket] = \
+                    self._bucket_samples.get(bucket, 0) + 1
+        return len(rows)
+
+    # ------------------------------------------------------------ reports
+
+    def _snapshot(self):
+        with self._lock:
+            active = self._active_s
+            if self._started_at is not None:
+                active += self._mono() - self._started_at
+            return (dict(self._counts), self._passes,
+                    dict(self._bucket_samples), active)
+
+    def report(self, top_k: int = 20, controller: str | None = None,
+               fold: str | None = None) -> dict:
+        """Aggregated view: top-k folded stacks (each stack's sampled
+        seconds are its *self* time — a fold is its own leaf) plus a
+        per-function self/total table (total counts a function once per
+        stack it appears anywhere in; self only when it is the leaf)."""
+        counts, passes, buckets, active = self._snapshot()
+        sec = (active / passes) if passes else 0.0
+        items = [
+            (b, s, n) for (b, s), n in counts.items()
+            if (controller is None or b == controller)
+            and (fold is None or fold in s)
+        ]
+        items.sort(key=lambda r: r[2], reverse=True)
+        selfs: dict[str, int] = {}
+        totals: dict[str, int] = {}
+        for _, s, n in items:
+            frames = s.split(";")
+            selfs[frames[-1]] = selfs.get(frames[-1], 0) + n
+            for fr in set(frames):
+                totals[fr] = totals.get(fr, 0) + n
+        functions = sorted(
+            totals,
+            key=lambda fr: (selfs.get(fr, 0), totals[fr]),
+            reverse=True,
+        )
+        return {
+            "schema": "cpprof/v1",
+            "running": self.running,
+            "hz": self.hz,
+            "passes": passes,
+            "samples": sum(n for _, _, n in items),
+            "duration_s": round(active, 3),
+            "controllers": buckets,
+            "stacks": [
+                {"controller": b, "stack": s, "count": n,
+                 "seconds": round(n * sec, 4)}
+                for b, s, n in items[:top_k]
+            ],
+            "functions": [
+                {"name": fr,
+                 "self_s": round(selfs.get(fr, 0) * sec, 4),
+                 "total_s": round(totals[fr] * sec, 4)}
+                for fr in functions[:top_k]
+            ],
+            "top_stack": items[0][1] if items else None,
+            "top_controller": (max(buckets, key=buckets.get)
+                               if buckets else None),
+        }
+
+    def folded(self) -> str:
+        """Full profile in flamegraph folded format, one fold per line:
+        ``bucket;frame;frame;... count`` (root left, leaf right)."""
+        counts, _, _, _ = self._snapshot()
+        lines = [f"{b};{s} {n}"
+                 for (b, s), n in sorted(counts.items(),
+                                         key=lambda kv: -kv[1])]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: process-global profiler, the analog of obs.TRACER — not started
+#: until :func:`start_from_env` (CPPROF=1) or an explicit ``.start()``
+PROFILER = Profiler()
+
+
+def start_from_env(env=None) -> Profiler | None:
+    """Binary wiring (cmd/runner.py): ``CPPROF=1`` starts the global
+    profiler, ``CPPROF_LOCKS=1`` installs lock-contention
+    instrumentation. Returns the profiler when started."""
+    env = env if env is not None else os.environ
+    if env.get("CPPROF_LOCKS") == "1":
+        install_lock_contention()
+    if env.get("CPPROF") == "1":
+        return PROFILER.start()
+    return None
+
+
+# ------------------------------------------------------- lock contention
+
+def _lockwatch_mod():
+    try:
+        from tools.cplint import lockwatch
+    except ImportError:  # deployed binaries may not ship tools/
+        return None
+    return lockwatch
+
+
+def install_lock_contention():
+    """Install lockwatch's instrumented locks (idempotent) — the same
+    wrapper lint mode uses, recording per-creation-site wait/hold
+    histograms as a side effect. Only locks created AFTER installation
+    are watched, so call this before building managers/worlds."""
+    lw = _lockwatch_mod()
+    return lw.install() if lw is not None else None
+
+
+def lock_contention_snapshot(watch=None) -> dict:
+    """{creation site: cumulative wait/hold stats} from the active
+    lockwatch (or ``watch``); {} when no instrumentation is installed."""
+    lw = _lockwatch_mod()
+    w = watch if watch is not None else (lw.active() if lw else None)
+    if w is None or not hasattr(w, "contention_snapshot"):
+        return {}
+    return w.contention_snapshot()
+
+
+def _short_site(site: str) -> str:
+    """Trim a creation site's absolute path to its last three segments
+    — reports and metric labels stay readable across checkouts."""
+    path, _, line = site.rpartition(":")
+    short = "/".join(path.replace("\\", "/").rsplit("/", 3)[-3:])
+    return f"{short}:{line}" if line else site
+
+
+def lock_contention_top(since: dict | None = None, limit: int = 10,
+                        watch=None) -> list[dict]:
+    """The most-contended creation sites, by waited seconds, optionally
+    as a delta against an earlier :func:`lock_contention_snapshot`
+    (cpbench diffs per scenario). Max fields are cumulative — a max
+    cannot be diffed, so they read 'worst ever seen', not 'worst in this
+    window'."""
+    now = lock_contention_snapshot(watch)
+    since = since or {}
+    rows = []
+    for site, st in now.items():
+        base = since.get(site) or {}
+        acquires = st["acquires"] - base.get("acquires", 0)
+        if acquires <= 0:
+            continue
+        rows.append({
+            "site": _short_site(site),
+            "acquires": acquires,
+            "contended": st["contended"] - base.get("contended", 0),
+            "wait_s": round(st["wait_s"] - base.get("wait_s", 0.0), 6),
+            "hold_s": round(st["hold_s"] - base.get("hold_s", 0.0), 6),
+            "wait_max_s": round(st["wait_max_s"], 6),
+            "hold_max_s": round(st["hold_max_s"], 6),
+        })
+    rows.sort(key=lambda r: (r["wait_s"], r["hold_s"]), reverse=True)
+    return rows[:limit]
+
+
+# ----------------------------------------------------------- saturation
+
+def saturation_snapshot() -> dict:
+    """Point-in-time saturation view from the engine metric families:
+    per-controller worker busy ratio + active workers, per-queue depth
+    and depth-per-worker, per-resource informer watch backlog age."""
+    # lazy import: obs must stay importable without dragging the engine
+    # in (engine/manager itself imports obs)
+    from service_account_auth_improvements_tpu.controlplane.engine.metrics import (  # noqa: E501
+        engine_metrics,
+        refresh_busy_ratios,
+    )
+
+    # the worker loop only publishes busy_ratio at reconcile completion;
+    # refreshing here lets an idle controller's ratio decay on the page
+    # instead of freezing at its last busy burst
+    refresh_busy_ratios()
+    em = engine_metrics()
+
+    def series(metric):
+        with metric._lock:
+            return dict(metric._values)
+
+    workers: dict[str, dict] = {}
+    for (ctl,), v in series(em.worker_busy_ratio).items():
+        workers.setdefault(ctl, {})["busy_ratio"] = round(v, 4)
+    for (ctl,), v in series(em.active_workers).items():
+        workers.setdefault(ctl, {})["active"] = v
+    queues: dict[str, dict] = {}
+    for (name,), v in series(em.workqueue_depth).items():
+        queues.setdefault(name, {})["depth"] = v
+    for (name,), v in series(em.workqueue_depth_per_worker).items():
+        queues.setdefault(name, {})["depth_per_worker"] = round(v, 4)
+    informers = {
+        res: round(v, 4)
+        for (res,), v in series(em.informer_backlog).items()
+    }
+    return {"workers": workers, "queues": queues, "informers": informers}
+
+
+# ------------------------------------------------------ metrics exposure
+
+_metrics_lock = threading.Lock()
+_metrics: dict | None = None
+
+
+def sync_metrics() -> None:
+    """Refresh the cpprof gauge families on the global registry from the
+    lockwatch contention stats and the profiler's sample counter —
+    called by the ops endpoint just before rendering /metrics (pull
+    model: lock stats live in plain dicts so the lock hot path never
+    touches a metric lock)."""
+    global _metrics
+    from service_account_auth_improvements_tpu.controlplane.engine.metrics import (  # noqa: E501
+        refresh_busy_ratios,
+    )
+
+    refresh_busy_ratios()   # idle controllers' ratios decay on scrape
+    contention = lock_contention_snapshot()
+    with _metrics_lock:
+        if _metrics is None:
+            from service_account_auth_improvements_tpu.controlplane.metrics import (  # noqa: E501
+                Gauge,
+            )
+
+            _metrics = {
+                "wait": Gauge(
+                    "cpprof_lock_wait_seconds",
+                    "Cumulative seconds threads waited to acquire locks "
+                    "created at this site", ("site",),
+                ),
+                "hold": Gauge(
+                    "cpprof_lock_hold_seconds",
+                    "Cumulative seconds locks created at this site were "
+                    "held", ("site",),
+                ),
+                "acquires": Gauge(
+                    "cpprof_lock_acquisitions",
+                    "Cumulative acquisitions of locks created at this "
+                    "site", ("site",),
+                ),
+                "contended": Gauge(
+                    "cpprof_lock_contended_acquisitions",
+                    "Acquisitions that waited measurably at this site",
+                    ("site",),
+                ),
+                "passes": Gauge(
+                    "cpprof_profiler_passes",
+                    "Sampling passes completed by the cpprof profiler",
+                ),
+            }
+        m = _metrics
+    for site, st in contention.items():
+        site = _short_site(site)
+        m["wait"].labels(site).set(st["wait_s"])
+        m["hold"].labels(site).set(st["hold_s"])
+        m["acquires"].labels(site).set(st["acquires"])
+        m["contended"].labels(site).set(st["contended"])
+    m["passes"].set(PROFILER.passes)
+
+
+# ------------------------------------------------------------ rendering
+
+def render_profilez(profiler: Profiler | None = None,
+                    controller: str | None = None,
+                    fold: str | None = None, top_k: int = 20,
+                    lockwatch=None) -> str:
+    """The /debug/profilez page: hot stacks, functions, contended locks,
+    saturated queues — one text page, filterable with ``?controller=``
+    (attribution bucket) and ``?fold=`` (substring over folded
+    stacks)."""
+    p = profiler if profiler is not None else PROFILER
+    rep = p.report(top_k=top_k, controller=controller, fold=fold)
+    lines = ["cpprof /debug/profilez", ""]
+    state = "running" if rep["running"] else \
+        "stopped (set CPPROF=1 or start the profiler)"
+    lines.append(
+        f"profiler: {state}  hz={rep['hz']:g}  passes={rep['passes']}  "
+        f"samples={rep['samples']}  duration={rep['duration_s']:.1f}s"
+    )
+    if controller or fold:
+        lines.append(
+            f"filters: controller={controller or '*'} fold={fold or '*'}"
+        )
+    lines.append("")
+    lines.append(f"== hot stacks (top {top_k}, wall-sampled; waits are "
+                 "samples too) ==")
+    if not rep["stacks"]:
+        lines.append("  (no samples)")
+    for s in rep["stacks"]:
+        lines.append(f"  {s['seconds']:9.3f}s  {s['count']:6d}  "
+                     f"[{s['controller']}]")
+        lines.append(f"      {s['stack']}")
+    lines.append("")
+    lines.append(f"== functions (top {top_k}, self/total seconds) ==")
+    for fn in rep["functions"]:
+        lines.append(f"  {fn['self_s']:9.3f} / {fn['total_s']:9.3f}  "
+                     f"{fn['name']}")
+    lines.append("")
+    lines.append("== attribution buckets (samples) ==")
+    for b, n in sorted(rep["controllers"].items(),
+                       key=lambda kv: -kv[1]):
+        lines.append(f"  {n:8d}  {b}")
+    lines.append("")
+    lines.append("== contended locks (by waited seconds) ==")
+    locks = lock_contention_top(limit=top_k, watch=lockwatch)
+    if not locks:
+        lines.append("  (no lock instrumentation — set CPPROF_LOCKS=1 "
+                     "or CPLINT_LOCKWATCH=1)")
+    for lk in locks:
+        lines.append(
+            f"  wait={lk['wait_s']:.4f}s (max {lk['wait_max_s']:.4f}s) "
+            f"hold={lk['hold_s']:.4f}s "
+            f"contended={lk['contended']}/{lk['acquires']}  {lk['site']}"
+        )
+    lines.append("")
+    lines.append("== saturation ==")
+    try:
+        sat = saturation_snapshot()
+    except Exception as e:  # the page must render even if engine is odd
+        sat = {"error": repr(e)}
+    for ctl, st in sorted((sat.get("workers") or {}).items()):
+        lines.append(f"  worker {ctl}: busy_ratio="
+                     f"{st.get('busy_ratio', 0)} "
+                     f"active={st.get('active', 0)}")
+    for q, st in sorted((sat.get("queues") or {}).items()):
+        lines.append(f"  queue {q}: depth={st.get('depth', 0)} "
+                     f"depth_per_worker={st.get('depth_per_worker', 0)}")
+    for res, age in sorted((sat.get("informers") or {}).items()):
+        lines.append(f"  informer {res}: watch_backlog_s={age}")
+    lines.append("")
+    lines.append("filters: ?controller=<bucket>  ?fold=<substring>")
+    return "\n".join(lines) + "\n"

@@ -485,3 +485,7 @@ def test_workqueue_metrics_move_with_traffic():
         after = em.reconcile_time._counts[("QueueProbe",)][-1]
     assert after > before
     assert em.workqueue_depth.value("QueueProbe") == 0
+    # cpprof saturation feed: the time-weighted busy ratio moved with
+    # the traffic (reconciles ran → nonzero) and stays a fraction
+    ratio = em.worker_busy_ratio.value("QueueProbe")
+    assert 0.0 < ratio <= 1.0

@@ -22,7 +22,14 @@ Compares a fresh cpbench run against the committed record and fails on:
 - SLO legs (``--slo-report``): every scenario in the run must carry a
   non-empty ``slo`` attainment record (obs/slo.py shape) and every
   objective in it must be met — a missed objective OR an absent
-  attainment record fails (absence of evidence isn't attainment).
+  attainment record fails (absence of evidence isn't attainment),
+- profiler legs (``--prof-report``): every scenario must carry an
+  ``extra.prof`` record naming its top hot stack, top contended lock
+  site, and a non-empty per-client apiserver request split, and the
+  run-level ``profiler_overhead`` A/B (CPPROF=0 vs 1 on notebook_ready)
+  must exist with p95 ratio ≤ ``--prof-overhead-max`` (default 1.05) —
+  a profiler you can't afford to leave on is not continuous profiling,
+  and attribution that silently vanished is not attribution.
 
 CI runs the smoke lane against the committed ``--full`` record: smoke is
 smaller and faster, so the latency comparison only trips on gross
@@ -159,6 +166,76 @@ def slo_gate(run: dict) -> list[str]:
     return failures
 
 
+#: profiler A/B overhead ceiling: notebook_ready create→Ready p95 with
+#: the sampler on may cost at most this ratio vs off (ISSUE/acceptance:
+#: ≤5 %)
+PROF_OVERHEAD_MAX = 1.05
+
+
+def prof_gate(run: dict, max_overhead: float = PROF_OVERHEAD_MAX
+              ) -> list[str]:
+    """--prof-report leg: per-scenario cpprof attribution, uniformly.
+    Record shape is cpbench's ``extra.prof`` (obs/prof.py report +
+    lockwatch contention + per-client split) plus the run-level
+    ``profiler_overhead`` A/B."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    if not scenarios:
+        return ["prof: run contains no scenarios"]
+    for name in sorted(scenarios):
+        prof = (scenarios[name].get("extra") or {}).get("prof")
+        if not isinstance(prof, dict) or not prof:
+            failures.append(
+                f"{name}: no extra.prof record — was cpbench run with "
+                "--profile?"
+            )
+            continue
+        top = prof.get("top_stack")
+        if not isinstance(top, str) or not top.strip():
+            failures.append(
+                f"{name}: extra.prof.top_stack absent/empty — the "
+                "sampler recorded nothing for this scenario"
+            )
+        lock = prof.get("top_contended_lock")
+        if not isinstance(lock, str) or not lock.strip():
+            failures.append(
+                f"{name}: extra.prof.top_contended_lock absent — the "
+                "lock-contention feed is dark (lockwatch not installed "
+                "before the scenario ran?)"
+            )
+        by_client = prof.get("by_client")
+        if not isinstance(by_client, dict) or not by_client:
+            failures.append(
+                f"{name}: extra.prof.by_client absent/empty — no "
+                "per-client apiserver request split"
+            )
+    overhead = run.get("profiler_overhead")
+    if not isinstance(overhead, dict) \
+            or not isinstance(overhead.get("ratio"), (int, float)):
+        failures.append(
+            "profiler_overhead record absent/malformed — no CPPROF=0 "
+            "vs 1 A/B evidence in the run"
+        )
+    else:
+        if overhead["ratio"] > max_overhead:
+            failures.append(
+                f"profiler overhead ratio {overhead['ratio']} exceeds "
+                f"{max_overhead} on {overhead.get('scenario')} p95 "
+                f"(on={overhead.get('p95_on_ms')} ms, "
+                f"off={overhead.get('p95_off_ms')} ms) — sampling is "
+                "no longer cheap enough to leave on"
+            )
+        if overhead.get("runs_ok") is False:
+            # a ratio computed over failed runs is garbage evidence —
+            # p95s of non-converged notebooks measure the timeout, not
+            # the sampler
+            failures.append(
+                "profiler_overhead A/B runs_ok=false — the overhead "
+                "ratio was measured over failed notebook_ready runs"
+            )
+    return failures
+
+
 def lint_gate(report: dict) -> list[str]:
     """cplint-report leg: the report must be the real cplint record and
     carry zero unsuppressed errors — a missing or malformed report must
@@ -269,6 +346,16 @@ def main(argv=None) -> int:
                     help="fail on any missed SLO objective or absent "
                          "per-scenario attainment record in --run "
                          "(obs/slo.py; composes with the other legs)")
+    ap.add_argument("--prof-report", action="store_true",
+                    help="fail on absent/malformed cpprof attribution "
+                         "(extra.prof per scenario) or profiler A/B "
+                         "overhead beyond --prof-overhead-max in --run "
+                         "(cpbench --profile; composes with the other "
+                         "legs)")
+    ap.add_argument("--prof-overhead-max", type=float,
+                    default=PROF_OVERHEAD_MAX,
+                    help="profiler-on vs -off p95 ratio ceiling "
+                         f"(default {PROF_OVERHEAD_MAX})")
     args = ap.parse_args(argv)
     failures = []
     if args.lint_report:
@@ -295,6 +382,8 @@ def main(argv=None) -> int:
             # same asymmetry as --chaos-only: an explicitly requested
             # leg silently skipped is a misconfigured CI step passing
             ap.error("--slo-report requires --run")
+        if args.prof_report:
+            ap.error("--prof-report requires --run")
         if args.chaos_only:
             # --chaos-only explicitly requests the chaos invariant
             # legs; silently skipping them because --run was forgotten
@@ -306,15 +395,19 @@ def main(argv=None) -> int:
             run = json.load(f)
     if run is not None and args.slo_report:
         failures += slo_gate(run)
+    if run is not None and args.prof_report:
+        failures += prof_gate(run, args.prof_overhead_max)
     baseline = None
     if run is not None and args.chaos_only:
         failures += chaos_gate(run, require_all=True)
-    elif run is not None and (args.baseline or not args.slo_report):
-        # latency legs need the committed record; a pure --slo-report
-        # invocation legitimately runs without one
+    elif run is not None and (args.baseline
+                              or not (args.slo_report
+                                      or args.prof_report)):
+        # latency legs need the committed record; a pure --slo-report /
+        # --prof-report invocation legitimately runs without one
         if not args.baseline:
-            ap.error("--baseline is required unless --chaos-only or "
-                     "--slo-report")
+            ap.error("--baseline is required unless --chaos-only, "
+                     "--slo-report or --prof-report")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -349,6 +442,12 @@ def main(argv=None) -> int:
             n = len(run.get("scenarios", {}))
             print(f"bench_gate ok: SLO attainment met in all "
                   f"{n} scenario(s)", file=sys.stderr)
+        if run is not None and args.prof_report:
+            ov = run.get("profiler_overhead") or {}
+            print(f"bench_gate ok: cpprof attribution present in all "
+                  f"{len(run.get('scenarios', {}))} scenario(s), "
+                  f"profiler overhead ratio {ov.get('ratio')} "
+                  f"<= {args.prof_overhead_max}", file=sys.stderr)
     return 1 if failures else 0
 
 

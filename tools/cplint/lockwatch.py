@@ -12,6 +12,17 @@ and maintains:
 - a global acquisition-order graph over lock **creation sites**
   (file:line) — instances churn per Manager/queue, sites are stable.
 
+Besides the failure classes below, every watched lock records
+**contention telemetry** per creation site — acquisition count, waited
+time (the gap between calling ``acquire`` and getting the lock) and
+held time (acquire→release), each with totals/maxima and a log-scale
+histogram. Lint mode (``CPLINT_LOCKWATCH=1``) and the cpprof contention
+view (``CPPROF_LOCKS=1`` / cpbench ``--profile``) share this ONE
+wrapper — there is deliberately no second instrumentation layer that
+could drift from the one the lint trusts. ``contention_snapshot()`` is
+the read surface; obs/prof.py turns it into /debug/profilez rows and
+``cpprof_lock_*`` gauges.
+
 Two failure classes are recorded:
 
 - **lock-order cycle**: acquiring B while holding A inserts edge A→B;
@@ -38,6 +49,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 import traceback
 
 _REAL_LOCK = threading.Lock
@@ -54,13 +66,45 @@ KUBE_PATH_FRAGMENT = os.sep + "kube" + os.sep
 #: under locks by design; see module docstring)
 WRITE_VERBS = frozenset({"create", "update", "patch", "delete"})
 
+#: wait/hold histogram bucket upper bounds (seconds, log scale); one
+#: implicit overflow bucket rides at the end
+CONTENTION_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)
+#: a wait below this is the uncontended fast path (two clock reads of
+#: measurement overhead), not contention
+CONTENDED_WAIT_S = 1e-4
+
+
+def _new_site_stats() -> dict:
+    # "_lock" is the per-site raw stat lock (stripped from snapshots):
+    # updating the totals under the GLOBAL _g would make every watched
+    # lock's acquire/release rendezvous on one process-wide lock —
+    # serializing unrelated locks and distorting the very contention
+    # being measured. Per-site locks only contend when the watched lock
+    # itself is contended.
+    return {
+        "_lock": _REAL_LOCK(),
+        "acquires": 0, "contended": 0,
+        "wait_s": 0.0, "hold_s": 0.0,
+        "wait_max_s": 0.0, "hold_max_s": 0.0,
+        "wait_hist": [0] * (len(CONTENTION_BUCKETS) + 1),
+        "hold_hist": [0] * (len(CONTENTION_BUCKETS) + 1),
+    }
+
+
+def _bucket_index(seconds: float) -> int:
+    for i, bound in enumerate(CONTENTION_BUCKETS):
+        if seconds <= bound:
+            return i
+    return len(CONTENTION_BUCKETS)
+
 
 class LockWatch:
     """Acquisition-graph recorder. One global instance per process when
     installed; tests construct their own."""
 
-    def __init__(self):
+    def __init__(self, mono_fn=None):
         self._g = _REAL_LOCK()           # guards the graph (a raw lock)
+        self._mono = mono_fn or time.monotonic
         self._tls = threading.local()
         #: site -> set of sites acquired while holding it
         self.order: dict = {}
@@ -69,6 +113,9 @@ class LockWatch:
         self.violations: list = []       # lock-order cycles
         self.api_violations: list = []   # held-lock apiserver writes
         self.self_edges: set = set()     # same-site nesting (smell)
+        #: site -> wait/hold contention stats (see _new_site_stats);
+        #: guarded by _g — plain floats/ints, nanoseconds per update
+        self.contention: dict = {}
 
     # ------------------------------------------------------------ state
 
@@ -80,7 +127,7 @@ class LockWatch:
         return held
 
     def held_sites(self) -> list:
-        return [site for site, _, _ in self._held()]
+        return [entry[0] for entry in self._held()]
 
     def lock(self, site: str):
         """A watched non-reentrant lock for ``site`` (test surface)."""
@@ -96,18 +143,62 @@ class LockWatch:
             self.violations.clear()
             self.api_violations.clear()
             self.self_edges.clear()
+            self.contention.clear()
+
+    def contention_snapshot(self) -> dict:
+        """Copy of the per-site wait/hold stats (histogram bucket
+        bounds are the module-level ``CONTENTION_BUCKETS``); obs/prof.py
+        and /debug/profilez consume this. The per-site stat lock is
+        stripped — readers get plain data."""
+        with self._g:
+            sites = list(self.contention.items())
+        out = {}
+        for site, st in sites:
+            with st["_lock"]:
+                out[site] = {
+                    k: (list(v) if isinstance(v, list) else v)
+                    for k, v in st.items() if k != "_lock"
+                }
+        return out
 
     # ------------------------------------------------------------ hooks
 
-    def note_acquire(self, site: str, lock) -> None:
+    def _site_stats(self, site: str) -> dict:
+        st = self.contention.get(site)    # GIL-safe read
+        if st is None:
+            with self._g:
+                st = self.contention.setdefault(site, _new_site_stats())
+        return st
+
+    def _note_wait(self, site: str, waited: float) -> None:
+        st = self._site_stats(site)
+        with st["_lock"]:
+            st["acquires"] += 1
+            if waited >= CONTENDED_WAIT_S:
+                st["contended"] += 1
+            st["wait_s"] += waited
+            if waited > st["wait_max_s"]:
+                st["wait_max_s"] = waited
+            st["wait_hist"][_bucket_index(waited)] += 1
+
+    def _note_hold(self, site: str, held_for: float) -> None:
+        st = self._site_stats(site)
+        with st["_lock"]:
+            st["hold_s"] += held_for
+            if held_for > st["hold_max_s"]:
+                st["hold_max_s"] = held_for
+            st["hold_hist"][_bucket_index(held_for)] += 1
+
+    def note_acquire(self, site: str, lock, waited: float = 0.0) -> None:
         held = self._held()
         for entry in held:
             if entry[1] is lock:
                 entry[2] += 1            # reentrant re-acquire
                 return
-        for held_site, _, _ in held:
-            self._edge(held_site, site)
-        held.append([site, lock, 1])
+        for entry in held:
+            self._edge(entry[0], site)
+        held.append([site, lock, 1, self._mono()])
+        self._note_wait(site, waited)
 
     def note_release(self, site: str, lock) -> None:
         held = self._held()
@@ -115,15 +206,17 @@ class LockWatch:
             if held[i][1] is lock:
                 held[i][2] -= 1
                 if held[i][2] <= 0:
+                    held_for = self._mono() - held[i][3]
                     del held[i]
+                    self._note_hold(site, held_for)
                 return
 
     def note_api_call(self, verb: str) -> None:
         """FakeKube write entry: no non-kube watched lock may be held."""
         if verb not in WRITE_VERBS:
             return
-        offending = [site for site, _, _ in self._held()
-                     if KUBE_PATH_FRAGMENT not in site]
+        offending = [entry[0] for entry in self._held()
+                     if KUBE_PATH_FRAGMENT not in entry[0]]
         if offending:
             with self._g:
                 self.api_violations.append({
@@ -203,9 +296,11 @@ class _WatchedLock:
         self._inner = inner
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        t0 = self._watch._mono()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
-            self._watch.note_acquire(self._site, self)
+            self._watch.note_acquire(self._site, self,
+                                     waited=self._watch._mono() - t0)
         return ok
 
     def release(self):
@@ -238,12 +333,14 @@ class _WatchedLock:
         return True
 
     def _acquire_restore(self, state):
+        t0 = self._watch._mono()
         fn = getattr(self._inner, "_acquire_restore", None)
         if fn is not None:
             fn(state)
         else:
             self._inner.acquire()
-        self._watch.note_acquire(self._site, self)
+        self._watch.note_acquire(self._site, self,
+                                 waited=self._watch._mono() - t0)
 
     def _release_save(self):
         self._watch.note_release(self._site, self)
