@@ -238,12 +238,17 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "s = d['scenarios']; "
                     "assert set(s) == {'notebook_ready', 'gang_ready', "
                     "'churn', 'profile_fanout', 'webhook_inject', "
-                    "'sched_contention'}; "
+                    "'sched_contention', 'apiserver_stress'}; "
                     "[s[k]['phases_ms']['create_to_ready']['p99'] "
-                    "for k in s]; "
+                    "for k in s if k != 'apiserver_stress']; "
                     "sc = s['sched_contention']['extra']; "
                     "assert sc['double_bookings'] == 0, sc; "
                     "sc['time_to_placement_ms']['p99']; "
+                    "st = s['apiserver_stress']['extra']; "
+                    "assert set(st['workers_sweep']) == "
+                    "{'1', '2', '4'}, st; "
+                    "assert st['ordering_violations'] == 0, st; "
+                    "st['watch_lag_ms']['p95']; "
                     "att = s['notebook_ready']['stage_attribution']; "
                     "assert att['attributed_fraction']['mean'] >= 0.8, "
                     "att; "
@@ -258,12 +263,17 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             # attainment records present, every objective met) and the
             # cpprof leg: every scenario names its top hot stack, top
             # contended lock site and per-client apiserver split, and
-            # the profiler A/B overhead stays ≤5% on notebook_ready p95
+            # the profiler A/B overhead stays ≤5% on notebook_ready
+            # p95. --store-lock-max-share: the striped-MVCC-FakeKube
+            # regression tripwire — the fake apiserver may never again
+            # be the top contended lock site or take more than 25% of
+            # the contended lock wait in any scenario (docs/fakekube.md)
             {"name": "Bench regression gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
                     "--run bench_out.json --tolerance 1.2 "
-                    "--slo-report --prof-report"},
+                    "--slo-report --prof-report "
+                    "--store-lock-max-share 0.25"},
             # chaos smoke: the fault-injection family (cpbench/chaos.py)
             # — apiserver blackout, 410 Gone storms, node death, kubelet
             # stall — then the invariant gate: 0 double bookings, 0

@@ -49,6 +49,7 @@ SMOKE_N = {
     "profile_fanout": 24,
     "webhook_inject": 200,
     "sched_contention": 12,   # 12 gangs contending for 4 slice pools
+    "apiserver_stress": 240,  # CRs per sweep arm (x3 arms: 1/2/4 workers)
     "chaos_relist": 8,        # 8 gangs vs 2 pools through the storms
     "chaos_blackout": 8,      # half healthy, half mid-outage
     "chaos_node_death": 4,    # 4 gangs, one pool dies under its gang
@@ -61,6 +62,7 @@ FULL_N = {
     "profile_fanout": 120,
     "webhook_inject": 1000,
     "sched_contention": 48,   # 12 drain waves over the 4 pools
+    "apiserver_stress": 10_000,  # the HA-item scale: ~40k watch events/arm
     "chaos_relist": 16,
     "chaos_blackout": 16,
     "chaos_node_death": 6,
@@ -126,7 +128,14 @@ def _prof_extra(profiler, locks_t0: dict, extra: dict) -> dict:
     over this scenario), saturation gauges, and the per-client apiserver
     request split — the one place bench_gate --prof-report looks."""
     rep = profiler.report(top_k=10)
-    locks = obs.lock_contention_top(since=locks_t0, limit=10)
+    # wide window for the share sum (a lock-heavy process can push the
+    # fake's sites past any top-10), narrow slice for the report rows
+    all_locks = obs.lock_contention_top(since=locks_t0, limit=50)
+    locks = all_locks[:10]
+    # the ONE share definition (obs.store_lock_wait_share — shared with
+    # the apiserver_stress sweep arms); bench_gate --store-lock-max-share
+    # fails CI when the fake becomes the serialization point again
+    share = obs.store_lock_wait_share(all_locks, rep["duration_s"])
     return {
         "schema": "cpprof/v1",
         "hz": rep["hz"],
@@ -138,6 +147,7 @@ def _prof_extra(profiler, locks_t0: dict, extra: dict) -> dict:
         "functions": rep["functions"],
         "locks": locks,
         "top_contended_lock": locks[0]["site"] if locks else None,
+        "store_lock_wait_share": share,
         "saturation": obs.saturation_snapshot(),
         "by_client": extra.get("apiserver_requests_by_client") or {},
     }

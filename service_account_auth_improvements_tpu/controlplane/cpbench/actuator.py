@@ -91,7 +91,12 @@ class _Flipper(threading.Thread):
         self._cond = threading.Condition()
         self._heap: list = []
         self._seq = 0
-        self._stop = False
+        # NOT named _stop: threading.Thread has an internal _stop()
+        # METHOD, and shadowing it with a bool makes is_alive()/join()
+        # on a finished thread raise "'bool' object is not callable"
+        # deep in threading internals (found by FakeKube's stats-cell
+        # reaper, which probes thread liveness)
+        self._stopping = False
 
     def call_later(self, delay: float, fn) -> None:
         with self._cond:
@@ -103,13 +108,13 @@ class _Flipper(threading.Thread):
 
     def stop(self) -> None:
         with self._cond:
-            self._stop = True
+            self._stopping = True
             self._cond.notify()
 
     def run(self) -> None:
         while True:
             with self._cond:
-                while not self._stop and (
+                while not self._stopping and (
                         not self._heap
                         or self._heap[0][0] > time.monotonic()):
                     wait = 0.2
@@ -119,7 +124,7 @@ class _Flipper(threading.Thread):
                                       0.001),
                         )
                     self._cond.wait(wait)
-                if self._stop:
+                if self._stopping:
                     return
                 _, _, fn = heapq.heappop(self._heap)
             try:

@@ -969,6 +969,210 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
     )
 
 
+def _stress_arm(cfg: BenchConfig, workers: int) -> dict:
+    """One apiserver_stress sweep arm: ``workers`` writer threads drive
+    a fresh FakeKube through a fixed create/update/patch/get/list/delete
+    mix over ``cfg.n`` notebook CRs spread across namespaces, while a
+    replay-from-0 watch consumer measures emit→receipt delivery lag and
+    checks per-key event fidelity (ADDED first, strictly increasing RVs,
+    DELETED terminal, nothing lost or duplicated). Returns the arm
+    record for ``extra.workers_sweep``."""
+    kube = FakeKube()
+    kube.default_client_id = "cpbench"
+    namespaces = [f"stress-{i}" for i in range(8)]
+    api_t0 = kube.request_counts_snapshot()
+    locks_t0 = obs.lock_contention_snapshot()
+    per_worker = max(1, cfg.n // workers)
+    emitted = [0] * workers          # watch events each worker caused
+    ops = [0] * workers              # apiserver calls each worker made
+    errors_seen: list[str] = []
+    err_lock = threading.Lock()
+
+    def worker(w: int) -> None:
+        # a tagged handle per worker: the per-client split in the prof
+        # record shows exactly who stormed the apiserver
+        client = kube.client_for(f"stress-w{w}")
+        try:
+            for i in range(per_worker):
+                ns = namespaces[(w + i) % len(namespaces)]
+                name = f"cr-{w}-{i}"
+                obj = client.create(
+                    "notebooks", _nb(name, ns, {"generation": "v5e",
+                                                "topology": "2x2"}))
+                emitted[w] += 1
+                # every write changes the object — the fake suppresses
+                # no-op writes (no RV bump, no event), so an identical
+                # payload would silently skew the emitted-event ledger
+                obj["status"] = {"readyReplicas": 1, "seq": i}
+                client.update_status("notebooks", obj)
+                emitted[w] += 1
+                client.patch(
+                    "notebooks", name,
+                    {"metadata": {"annotations": {"stress/seq": str(i)}}},
+                    namespace=ns, group=GROUP)
+                emitted[w] += 1
+                client.get("notebooks", name, namespace=ns, group=GROUP)
+                ops[w] += 4
+                if i % 16 == 0:
+                    client.list("notebooks", namespace=ns, group=GROUP)
+                    ops[w] += 1
+                if i % 4 == 3:
+                    client.delete("notebooks", name, namespace=ns,
+                                  group=GROUP)
+                    emitted[w] += 1
+                    ops[w] += 1
+        except errors.ApiError as e:  # healthy cluster: nothing may fail
+            with err_lock:
+                errors_seen.append(repr(e))
+
+    lag_ms: list[float] = []
+    per_key: dict[str, list] = {}    # key -> [(rv, type), ...] in order
+    watcher_done = threading.Event()
+    workers_done = threading.Event()
+
+    def watch_consumer() -> None:
+        # replay-from-0 with an idle timeout: once the writers stop and
+        # the backlog drains, 2 s of quiet ends the stream
+        for ev in kube.watch("notebooks", resource_version=0,
+                             group=GROUP, timeout=2.0):
+            received = time.monotonic()
+            sent = ev.get("emittedAt")
+            if sent is not None and received >= sent:
+                lag_ms.append((received - sent) * 1000.0)
+            meta = ev["object"]["metadata"]
+            key = f"{meta.get('namespace')}/{meta['name']}"
+            per_key.setdefault(key, []).append(
+                (int(meta["resourceVersion"]), ev["type"]))
+            if workers_done.is_set() and \
+                    sum(len(v) for v in per_key.values()) >= sum(emitted):
+                break
+        watcher_done.set()
+
+    consumer = threading.Thread(target=watch_consumer,
+                                name="stress-watch", daemon=True)
+    consumer.start()
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker, args=(w,),
+                                name=f"stress-w{w}", daemon=True)
+               for w in range(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    workers_done.set()
+    drained = watcher_done.wait(cfg.timeout)
+
+    ordering_violations = 0
+    expected = sum(emitted)
+    if not drained:
+        # the consumer thread is still appending: iterating its dicts
+        # now would crash the whole bench run ("dict changed size
+        # during iteration") instead of failing the arm. Report the
+        # failure from atomic reads only; the arm's seen<expected (and
+        # the recorded error) fail the scenario honestly.
+        with err_lock:
+            errors_seen.append(
+                f"watch consumer did not drain within {cfg.timeout}s"
+            )
+        seen = sum(len(v) for v in list(per_key.values()))
+    else:
+        for key, seq in per_key.items():
+            rvs = [rv for rv, _ in seq]
+            if rvs != sorted(rvs) or len(set(rvs)) != len(rvs):
+                ordering_violations += 1
+                continue
+            if seq[0][1] != "ADDED":
+                ordering_violations += 1
+            if any(t == "DELETED" for _, t in seq[:-1]):
+                ordering_violations += 1
+        seen = sum(len(v) for v in per_key.values())
+    locks = obs.lock_contention_top(since=locks_t0, limit=50)
+    # throughput = apiserver REQUESTS per second; emitted tracks watch
+    # events (for the fidelity ledger), which are the same writes seen
+    # again — summing both would double-count every write
+    total_ops = sum(ops)
+    return {
+        "workers": workers,
+        "n": per_worker * workers,
+        "elapsed_s": round(elapsed, 3),
+        "throughput_ops_s": round(total_ops / elapsed, 1) if elapsed
+        else None,
+        "apiserver_requests": {
+            verb: n - api_t0.get(verb, 0)
+            for verb, n in kube.request_counts_snapshot().items()
+        },
+        "by_client": by_client_delta(
+            kube.request_counts_snapshot(by_client=True), {}),
+        # the serialization-point evidence, ONE definition shared with
+        # extra.prof (obs.store_lock_wait_share; None without lock
+        # instrumentation, i.e. no --profile / CPPROF_LOCKS /
+        # CPLINT_LOCKWATCH)
+        "store_lock_wait_share": (obs.store_lock_wait_share(locks,
+                                                            elapsed)
+                                  if locks else None),
+        "watch_lag_ms": percentiles(lag_ms),
+        "watch_events_expected": expected,
+        "watch_events_seen": seen,
+        "ordering_violations": ordering_violations,
+        "errors": errors_seen[:8],
+        "_lag_samples": lag_ms,      # stripped before the report
+    }
+
+
+def scenario_apiserver_stress(cfg: BenchConfig) -> ScenarioResult:
+    """The apiserver itself under churn — the measurement substrate for
+    the sharded/HA roadmap item. No Manager, no controllers: W writer
+    threads drive create/update/patch/get/list/delete across namespaces
+    against a fresh FakeKube per arm, swept at 1/2/4 workers, while a
+    watch consumer measures emit→receipt delivery lag and audits event
+    fidelity. Reports per-arm verb throughput, the store-lock wait
+    share from cpprof's lock instrumentation, and watch-delivery lag —
+    at 10k-CR scale (--full) a serialized fake would be the bottleneck
+    the bench measures instead of the plane."""
+    started = time.monotonic()
+    tracker = Tracker("apiserver_stress")
+    sweep: dict[str, dict] = {}
+    lag_all: list[float] = []
+    by_client_all: dict = {}
+    ok = True
+    for workers in (1, 2, 4):
+        arm = _stress_arm(cfg, workers)
+        lag_all.extend(arm.pop("_lag_samples"))
+        for client, verbs in arm["by_client"].items():
+            agg = by_client_all.setdefault(client, {})
+            for verb, n in verbs.items():
+                agg[verb] = agg.get(verb, 0) + n
+        ok = ok and not arm["errors"] \
+            and arm["ordering_violations"] == 0 \
+            and arm["watch_events_seen"] == arm["watch_events_expected"]
+        sweep[str(workers)] = arm
+    summary = tracker.summary()
+    shares = [a["store_lock_wait_share"] for a in sweep.values()
+              if a["store_lock_wait_share"] is not None]
+    summary["extra"] = {
+        "workers_sweep": sweep,
+        "watch_lag_ms": percentiles(lag_all),
+        "store_lock_wait_share": (round(max(shares), 4) if shares
+                                  else None),
+        "throughput_ops_s": {
+            w: a["throughput_ops_s"] for w, a in sweep.items()
+        },
+        "ordering_violations": sum(
+            a["ordering_violations"] for a in sweep.values()),
+        # the per-client split rides here so extra.prof.by_client (and
+        # the --prof-report leg) see who the stormers were
+        "apiserver_requests_by_client": by_client_all,
+        "event_count": 0,
+        "journal": {},
+    }
+    summary["slo"] = slo_mod.report({"watch_delivery": lag_all})
+    return ScenarioResult(
+        name="apiserver_stress", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary, ok=ok,
+    )
+
+
 SCENARIOS = {
     "notebook_ready": scenario_notebook_ready,
     "gang_ready": scenario_gang_ready,
@@ -976,6 +1180,7 @@ SCENARIOS = {
     "profile_fanout": scenario_profile_fanout,
     "webhook_inject": scenario_webhook_inject,
     "sched_contention": scenario_sched_contention,
+    "apiserver_stress": scenario_apiserver_stress,
 }
 
 

@@ -84,6 +84,13 @@ class Informer:
     def add_handler(self, fn, want_old: bool = False) -> None:
         self._handlers.append((fn, want_old))
 
+    # NOTE: get/list/by_index return the LIVE cache objects — and since
+    # FakeKube's MVCC fanout, a watch-delivered cache entry is often THE
+    # apiserver's own immutable stored snapshot, shared with its history
+    # and every other watcher. Mutating one corrupts the cluster, not
+    # just this cache; read-only use (or deepcopy-then-mutate, what
+    # CachedClient does) is the contract, machine-checked by cplint's
+    # cache-mutation pass.
     def get(self, namespace: str | None, name: str) -> dict | None:
         with self._lock:
             return self._cache.get((namespace or "", name))

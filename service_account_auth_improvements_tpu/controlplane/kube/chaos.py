@@ -176,7 +176,10 @@ class ChaosInjector:
     def gone_storm(self, plural: str | None = None,
                    group: str | None = None) -> None:
         """Forced compaction sweep: expire the retained watch history so
-        every reconnect-from-last-RV gets 410 Gone and must relist."""
+        every reconnect-from-last-RV gets 410 Gone and must relist.
+        ``compact_history`` sweeps families one at a time in canonical
+        order with no lock nesting (docs/fakekube.md), so a storm fired
+        mid-churn cannot deadlock against in-flight verbs."""
         self._kube.compact_history(plural, group)
         self._note("gone_storm", plural=plural or "*")
 
@@ -303,7 +306,13 @@ class ChaosInjector:
         """Called by FakeKube's event fanout per (watch, event): the list
         to actually enqueue — [] drops, [event] passes, [next, held]
         is the overtake. Also flushes any held event that has waited
-        past HOLD_FLUSH_S (in order — delay, not overtake)."""
+        past HOLD_FLUSH_S (in order — delay, not overtake).
+
+        Lock-order note (docs/fakekube.md): the fanout calls this while
+        holding the resource family's event lock, so family → chaos is
+        a recorded lockwatch edge. This method must therefore never
+        call back into FakeKube verbs or block — it only takes its own
+        lock and enqueues to per-watcher queues."""
         out: list[dict] = []
         overtook = False
         with self._lock:

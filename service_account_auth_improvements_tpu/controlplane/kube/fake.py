@@ -12,12 +12,51 @@ and fast.
 ``FakeKube`` exposes the same Python interface as ``KubeClient`` so
 controllers are transport-agnostic; ``FakeKube.wsgi_app`` additionally
 serves the real REST+watch wire protocol for client transport tests.
+
+Concurrency model (docs/fakekube.md is the operator's contract; cpprof
+named the old single store RLock the top contended lock in every bench
+scenario, and the HA roadmap item needs the fake to NOT be the thing a
+10k-CR bench measures):
+
+- **striped store** — objects live in one ``_Stripe`` per
+  (group, plural, namespace), each with its own lock. Same-stripe verbs
+  serialize; everything else runs in parallel.
+- **MVCC / copy-on-write** — stored objects are immutable once written:
+  every write commits a NEW object, so a reader holding a reference
+  (a GET about to deepcopy, a watch event in a queue, an informer
+  cache) can never observe a torn or later state. All ``deepcopy``
+  calls happen OUTSIDE lock holds; watch events share the stored
+  object itself (zero copies on the fanout path — consumers must not
+  mutate event objects, the same contract informer caches already
+  carry, machine-checked by cplint's cache-mutation pass).
+- **per-family event lock** — each (group, plural) ``_Family`` owns its
+  watch history + watcher registry under one lock; commits take it
+  OUTSIDE the stripe lock (lock order: family → stripe) and allocate
+  the resourceVersion under it, so history order == RV order and every
+  watcher sees a resource's events in RV order. The stripe lock is
+  released before the fanout — it is held only for the identity check
+  and the store assignment, microseconds — and the fanout enqueues to
+  unbounded per-watcher queues, so a slow consumer never blocks the
+  writing verb.
+- **global atomics** — resourceVersion allocation is the one global
+  atomic left, an ``itertools.count`` (C-level atomic — no lock at all
+  in the commit section); request tallies ride per-THREAD cells (a
+  per-request stats lock, however small, becomes the top contended
+  site under the GIL at stress scale) so ``/debug/profilez`` scrapes
+  and bench polling never touch store stripes; uid liveness + the
+  owner→children index ride ``_uids_lock``, a leaf lock.
+- **deferred cross-stripe work** — the GC cascade, orphan collection,
+  and auto-compaction are recorded while locked and executed by the
+  outermost verb AFTER every lock is released, taking fresh locks one
+  family/stripe at a time in canonical order. No lock is ever held
+  while acquiring another family's locks, so lockwatch can prove the
+  order graph acyclic (lock order: family → stripe → leaves).
 """
 
 from __future__ import annotations
 
 import copy
-import json
+import itertools
 import queue
 import threading
 import time
@@ -81,11 +120,82 @@ def json_merge_patch(target, patch):
 
 
 class _Watch:
-    def __init__(self, key, rv: int):
-        self.key = key
-        self.min_rv = rv
+    """One live watch channel: an unbounded event queue + a closed
+    flag. Family membership lives in the per-family watcher list — the
+    channel itself needs no key filter."""
+
+    def __init__(self):
         self.q: queue.Queue = queue.Queue()
         self.closed = False
+
+
+class _StatsCell:
+    """One thread's private request tally. Bumps are plain dict ops
+    under the GIL — no shared lock on the request hot path at all: a
+    per-request stats lock, however tiny its hold, still loses the GIL
+    mid-hold every few ms under load and turns into the top contended
+    site (measured at 10k-CR stress scale). Snapshots sum the cells."""
+
+    __slots__ = ("verbs", "by_client")
+
+    def __init__(self):
+        self.verbs: dict[str, int] = {}
+        self.by_client: dict[str, dict[str, int]] = {}
+
+
+def _thread_dead(t) -> bool:
+    """Liveness probe that survives broken Thread subclasses: a class
+    shadowing the internal ``Thread._stop`` METHOD with an attribute
+    (it happens — cpbench's _Flipper did) makes ``is_alive()`` raise
+    from threading internals; treat unknowable as alive and keep the
+    cell rather than crash a request."""
+    try:
+        return not t.is_alive()
+    except Exception:
+        return False
+
+
+def _fold_stats(into: "_StatsCell", cell: "_StatsCell") -> None:
+    """Accumulate a dead thread's tallies into the retired fold (caller
+    holds the stats lock; the dead thread can no longer bump)."""
+    for verb, n in cell.verbs.items():
+        into.verbs[verb] = into.verbs.get(verb, 0) + n
+    for client, verbs in cell.by_client.items():
+        agg = into.by_client.setdefault(client, {})
+        for verb, n in verbs.items():
+            agg[verb] = agg.get(verb, 0) + n
+
+
+class _Stripe:
+    """One (group, plural, namespace) store shard: the lock serializes
+    same-stripe commits; ``objects`` maps the full store key to the
+    current immutable object. Reads snapshot references under the lock
+    (or, for single-key GETs, via a GIL-atomic ``dict.get``) and copy
+    outside it."""
+
+    __slots__ = ("lock", "objects")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.objects: dict[tuple, dict] = {}
+
+
+class _Family:
+    """Per-(group, plural) event machinery. ``lock`` is the event lock:
+    commits take it OUTSIDE their stripe lock and allocate the RV under
+    it, so ``history`` is RV-ordered by construction and a watch
+    registration (backlog snapshot + watcher append, also under it) can
+    never race a gap. ``pruned`` is the newest RV dropped from history —
+    a reconnect from at-or-below it gets 410 Gone."""
+
+    __slots__ = ("lock", "stripes", "history", "pruned", "watchers")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.stripes: dict[str, _Stripe] = {}
+        self.history: list = []          # [(rv, event), ...] RV-ordered
+        self.pruned = 0
+        self.watchers: list[_Watch] = []
 
 
 class FakeKube:
@@ -93,30 +203,56 @@ class FakeKube:
 
     def __init__(self, registry: Registry | None = None):
         self.registry = registry or DEFAULT_REGISTRY
-        self._lock = threading.RLock()
-        self._store: dict[tuple, dict] = {}     # (group,plural,ns,name) -> obj
-        self._uids: set[str] = set()            # live uids (owner-GC check)
+        #: (group, plural) -> _Family (stripes + watch machinery). Keys
+        #: are only ever added (setdefault — atomic under the GIL),
+        #: never removed, so lock-free lookups are safe.
+        self._families: dict[tuple, _Family] = {}
+        #: resourceVersion allocation — THE one global atomic left.
+        #: ``itertools.count`` is C-level atomic under the GIL, so
+        #: allocation costs no lock at all inside the commit section;
+        #: ``_rv`` shadows the last allocated value for list envelopes
+        #: (a transiently stale — i.e. LOWER — envelope is safe:
+        #: watch-from-envelope then replays an event the list already
+        #: contained, and level-triggered consumers dedup; an envelope
+        #: AHEAD of a missing event would lose objects, and commits
+        #: order allocation before publication under the family lock
+        #: so that can't happen)
+        self._rv_alloc = itertools.count(1)
         self._rv = 0
-        self._history: dict[tuple, list] = {}   # (group,plural) -> [(rv, ev)]
-        self._pruned: dict[tuple, int] = {}     # (group,plural) -> last rv dropped
-        self._watches: list[_Watch] = []
+        self._emit_tally = itertools.count(1)
+        #: uid liveness + owner-uid -> {child store keys} index, for the
+        #: GC cascade and the orphan-create check (leaf lock)
+        self._uids_lock = threading.Lock()
+        self._uids: set[str] = set()
+        self._owner_children: dict[str, set] = {}
         self._pod_logs: dict[tuple, str] = {}   # (ns, pod) -> log text
         self.sar_hook = None  # SubjectAccessReview callback (web tier)
-        #: per-verb request tally (apiserver_requests{verb} in cpbench):
-        #: every external call through the client interface counts once;
-        #: internal fan-out (GC cascade deletes) counts as the requests a
-        #: real garbage collector would issue
-        self.request_counts: dict[str, int] = {}
-        #: per-(client, verb) tally — the priority-and-fairness pre-work
-        #: (cpprof): who is storming the apiserver, not just how hard it
-        #: is being stormed. Clients identify via :meth:`client_for`
-        #: handles (Manager/kubelet/cpbench tag theirs); requests from a
+        #: request tallies (``request_counts`` per verb — the
+        #: apiserver_requests{verb} source in cpbench — and
+        #: ``request_counts_by_client``, the priority-and-fairness
+        #: pre-work: who is storming the apiserver, not just how hard).
+        #: Counted into per-THREAD cells (no shared lock on the request
+        #: hot path; see _StatsCell) and summed on snapshot, so
+        #: /debug/profilez scrapes and bench polling never touch a
+        #: store stripe OR serialize the verbs they observe. The stats
+        #: lock only guards cell registration + snapshot iteration.
+        #: Clients identify via :meth:`client_for` handles
+        #: (Manager/kubelet/cpbench tag theirs); requests from a
         #: reconcile resolve to the controller name through ``actor_fn``
         #: (obs.current_actor, installed by the Manager); everything
         #: else books under ``default_client_id``, and the synchronous
         #: GC cascade under ``(gc)`` — a real garbage collector is its
         #: own API client.
-        self.request_counts_by_client: dict[str, dict[str, int]] = {}
+        self._stats_lock = threading.Lock()
+        #: live (thread, cell) pairs + the folded tallies of dead
+        #: threads: a thread-per-connection caller (the dev-mode WSGI
+        #: tier) would otherwise leak one cell per connection forever
+        #: and make every snapshot scan the graveyard. Reaped on cell
+        #: registration — amortized against exactly the thread churn
+        #: that creates the garbage.
+        self._stats_cells: list[tuple] = []
+        self._stats_retired = _StatsCell()
+        self._stats_tls = threading.local()
         self.default_client_id = "(untagged)"
         self.actor_fn = None
         self._caller = threading.local()
@@ -130,7 +266,6 @@ class FakeKube:
         #: relist — the reflector recovery path, exercisable in tier-1
         #: without chaos scripting. 0 disables.
         self.compact_every_n_events = 0
-        self._emits_since_compact = 0
         #: core-v1 Event TTL (seconds; a real apiserver defaults to 1 h
         #: via --event-ttl). Events whose lastTimestamp is older are
         #: swept whenever history compacts (compact_history and the
@@ -142,6 +277,10 @@ class FakeKube:
         #: clients: chaos must not leave half a cascade behind as
         #: permanent orphans a real garbage collector would retry away
         self._internal = threading.local()
+        #: cross-stripe work (GC cascades, orphan removal, compaction)
+        #: recorded while locked, executed lock-free by the outermost
+        #: verb (see _run_deferred)
+        self._deferred = threading.local()
 
     # ------------------------------------------------------------ helpers
 
@@ -181,22 +320,71 @@ class FakeKube:
                     client = None  # attribution must never fail a request
             client = (client or getattr(self._caller, "id", None)
                       or self.default_client_id)
-        with self._lock:
-            self.request_counts[verb] = self.request_counts.get(verb, 0) + 1
-            by = self.request_counts_by_client.setdefault(client, {})
-            by[verb] = by.get(verb, 0) + 1
+        cell = getattr(self._stats_tls, "cell", None)
+        if cell is None:
+            cell = _StatsCell()
+            with self._stats_lock:
+                dead = [(t, c) for t, c in self._stats_cells
+                        if _thread_dead(t)]
+                for t, c in dead:
+                    _fold_stats(self._stats_retired, c)
+                    self._stats_cells.remove((t, c))
+                self._stats_cells.append(
+                    (threading.current_thread(), cell))
+            self._stats_tls.cell = cell
+        cell.verbs[verb] = cell.verbs.get(verb, 0) + 1
+        by = cell.by_client.get(client)
+        if by is None:
+            by = cell.by_client[client] = {}
+        by[verb] = by.get(verb, 0) + 1
         if self.chaos is not None and \
                 not getattr(self._internal, "depth", 0):
             self.chaos.admit(verb)
 
     def request_counts_snapshot(self, by_client: bool = False):
         """Copy of the per-verb tally (scenarios diff two snapshots);
-        ``by_client=True`` returns the {client: {verb: count}} split."""
-        with self._lock:
-            if by_client:
-                return {c: dict(v)
-                        for c, v in self.request_counts_by_client.items()}
-            return dict(self.request_counts)
+        ``by_client=True`` returns the {client: {verb: count}} split.
+        Sums the per-thread cells (plus the retired fold of dead
+        threads): exact once the counted threads are quiescent,
+        monotonic (never over-reads) while they run. The WHOLE
+        summation holds the stats lock — releasing it after copying the
+        cell list would race the dead-thread reaper, which folds a cell
+        into the retired tally in place: a snapshot still holding the
+        old list would then count that cell twice."""
+        with self._stats_lock:
+            cells = [c for _, c in self._stats_cells]
+            cells.append(self._stats_retired)
+            return (self._sum_by_client(cells) if by_client
+                    else self._sum_verbs(cells))
+
+    @staticmethod
+    def _sum_by_client(cells) -> dict:
+        out: dict[str, dict[str, int]] = {}
+        for cell in cells:
+            for client, verbs in list(cell.by_client.items()):
+                agg = out.setdefault(client, {})
+                for verb, n in list(verbs.items()):
+                    agg[verb] = agg.get(verb, 0) + n
+        return out
+
+    @staticmethod
+    def _sum_verbs(cells) -> dict:
+        totals: dict[str, int] = {}
+        for cell in cells:
+            for verb, n in list(cell.verbs.items()):
+                totals[verb] = totals.get(verb, 0) + n
+        return totals
+
+    @property
+    def request_counts(self) -> dict[str, int]:
+        """Aggregate per-verb tally (compat surface; prefer
+        :meth:`request_counts_snapshot`)."""
+        return self.request_counts_snapshot()
+
+    @property
+    def request_counts_by_client(self) -> dict[str, dict[str, int]]:
+        """Aggregate per-(client, verb) tally (compat surface)."""
+        return self.request_counts_snapshot(by_client=True)
 
     def _res(self, plural: str, group: str | None = None) -> Resource:
         try:
@@ -208,43 +396,99 @@ class FakeKube:
         ns = namespace if res.namespaced else ""
         return (res.group, res.plural, ns or "", name)
 
-    def _bump(self) -> int:
-        self._rv += 1
-        return self._rv
+    def _family(self, res: Resource) -> _Family:
+        fam = self._families.get((res.group, res.plural))
+        if fam is None:
+            fam = self._families.setdefault((res.group, res.plural),
+                                            _Family())
+        return fam
 
-    def _emit(self, res: Resource, ev_type: str, obj: dict):
-        hkey = (res.group, res.plural)
+    def _stripe(self, fam: _Family, ns: str,
+                create: bool = False) -> _Stripe | None:
+        """The (namespace) stripe, or None when absent. Only create()
+        allocates (``create=True``): a read/update/delete probe of a
+        never-seen namespace must answer NotFound/empty without
+        permanently growing ``fam.stripes`` — an adversarial (or merely
+        chatty) client probing fresh namespace strings would otherwise
+        leak a dict+Lock per probe, and cluster-wide LISTs would wade
+        through the graveyard forever."""
+        stripe = fam.stripes.get(ns)
+        if stripe is None and create:
+            stripe = fam.stripes.setdefault(ns, _Stripe())
+        return stripe
+
+    def _next_rv(self) -> tuple[int, bool]:
+        """Allocate the next resourceVersion (lock-free atomic counter)
+        and report whether the auto-compaction threshold tripped — the
+        caller DEFERS the actual compaction to lock-free context.
+        Callers hold their family's event lock, so per family the
+        allocation order is the publication order."""
+        rv = next(self._rv_alloc)
+        self._rv = rv
+        n = self.compact_every_n_events
+        compact = bool(n) and next(self._emit_tally) % n == 0
+        return rv, compact
+
+    # -------------------------------------------------- deferred actions
+
+    def _defer(self, kind: str, res: Resource | None, arg) -> None:
+        """Queue cross-stripe work for the outermost verb to run after
+        every lock is released (thread-local, so concurrent verbs keep
+        independent queues)."""
+        items = getattr(self._deferred, "items", None)
+        if items is None:
+            items = self._deferred.items = []
+        items.append((kind, res, arg))
+
+    def _run_deferred(self) -> None:
+        """Drain this thread's deferred queue — cascades, orphan
+        removals, auto-compaction — taking fresh locks per action (never
+        nested inside a verb's locks). Re-entrant calls no-op: a cascade
+        delete's own verbs append to the same queue and the outer loop
+        drains them."""
+        tl = self._deferred
+        if getattr(tl, "draining", False):
+            return
+        items = getattr(tl, "items", None)
+        if not items:
+            return
+        tl.draining = True
+        try:
+            while items:
+                kind, res, arg = items.pop(0)
+                if kind == "remove":
+                    key, expect = arg
+                    self._remove(res, key, expect=expect)
+                elif kind == "cascade":
+                    self._cascade(arg)
+                elif kind == "compact":
+                    self.compact_history()
+        finally:
+            tl.draining = False
+
+    # --------------------------------------------------------- emit core
+
+    def _emit_locked(self, fam: _Family, ev_type: str, obj: dict) -> None:
+        """Append to the family history and fan out to its watchers.
+        Caller holds ``fam.lock`` (and usually the stripe lock outside
+        it). The event SHARES the immutable stored object — no per-event
+        deepcopy — and queue puts never block, so a slow consumer never
+        blocks the writing verb. emittedAt is an in-process protocol
+        extension the informer uses to measure true watch→handler
+        delivery lag; the wire layer strips it."""
         rv = int(obj["metadata"]["resourceVersion"])
-        # emittedAt is an optional protocol extension the in-process
-        # informer uses to measure true watch→handler delivery lag (an
-        # event can sit in a watcher's channel behind a backlog); it is
-        # meaningless across processes (monotonic clock) and ignored by
-        # everything else
-        event = {"type": ev_type, "object": copy.deepcopy(obj),
+        event = {"type": ev_type, "object": obj,
                  "emittedAt": time.monotonic()}
-        self._history.setdefault(hkey, []).append((rv, event))
-        if len(self._history[hkey]) > 4096:
-            dropped = self._history[hkey][:-2048]
-            self._pruned[hkey] = dropped[-1][0]
-            self._history[hkey] = self._history[hkey][-2048:]
-        if self.compact_every_n_events:
-            self._emits_since_compact += 1
-            if self._emits_since_compact >= self.compact_every_n_events:
-                self._emits_since_compact = 0
-                # compact everything EXCEPT the event being emitted:
-                # connected watchers still receive it via their queues,
-                # but any watcher that has to reconnect from an older RV
-                # is now behind the compaction window → 410 → relist
-                for k, hist in self._history.items():
-                    if hist:
-                        self._pruned[k] = hist[-1][0]
-                        self._history[k] = []
-                self._gc_events_locked()
+        fam.history.append((rv, event))
+        if len(fam.history) > 4096:
+            dropped = fam.history[:-2048]
+            fam.pruned = dropped[-1][0]
+            fam.history = fam.history[-2048:]
         chaos = self.chaos
         if chaos is not None:
             chaos.sweep()
-        for w in self._watches:
-            if w.key == hkey and not w.closed:
+        for w in fam.watchers:
+            if not w.closed:
                 if chaos is None:
                     w.q.put(event)
                 else:
@@ -259,64 +503,96 @@ class FakeKube:
         res = self._res(plural, group)
         if res.kind == "SubjectAccessReview":
             return self._evaluate_sar(obj)
-        with self._lock:
-            obj = copy.deepcopy(obj)
-            meta = obj.setdefault("metadata", {})
-            name = meta.get("name")
-            if not name and meta.get("generateName"):
-                name = meta["generateName"] + uuid.uuid4().hex[:6]
-                meta["name"] = name
-            if not name:
-                raise errors.BadRequest("metadata.name required")
-            ns = namespace or meta.get("namespace")
-            if res.namespaced:
-                if not ns:
-                    raise errors.BadRequest("namespace required")
-                meta["namespace"] = ns
-            key = self._key(res, ns, name)
-            if key in self._store:
-                raise errors.AlreadyExists(
-                    f"{res.plural} {name!r} already exists"
-                )
-            obj.setdefault("apiVersion", res.api_version)
-            obj.setdefault("kind", res.kind)
-            if res.kind == "Node":
-                # kubelet semantics: a registering node reports capacity
-                # and the apiserver view carries allocatable (capacity
-                # minus reserves; the fake reserves nothing). Consumers —
-                # tpusched's inventory reads
-                # status.allocatable["google.com/tpu"] — must see
-                # allocatable even when a test only staged capacity.
-                status = obj.setdefault("status", {})
-                status.setdefault("capacity", {})
-                status.setdefault(
-                    "allocatable", copy.deepcopy(status["capacity"])
-                )
-            meta["uid"] = str(uuid.uuid4())
-            meta["creationTimestamp"] = _now()
-            meta["resourceVersion"] = str(self._bump())
-            meta.setdefault("generation", 1)
-            self._store[key] = obj
-            self._uids.add(meta["uid"])
-            self._emit(res, "ADDED", obj)
-            # uid-less refs (which a real apiserver would reject at
-            # validation) can never match an owner — they must not count
-            # as "dangling" and get the object silently collected
-            ref_uids = [r.get("uid")
-                        for r in meta.get("ownerReferences") or []
-                        if r.get("uid")]
-            if ref_uids:
-                if not any(u in self._uids for u in ref_uids):
-                    # Every owner is already gone: the garbage collector
-                    # would collect this object. The race is real — a
-                    # reconciler that GETs its CR just before the CR's
-                    # delete cascades will re-create children right after
-                    # the cascade removed them; real clusters rely on the
-                    # GC to mop these orphans up, so the fake must too
-                    # (watchers see ADDED then DELETED, as they would
-                    # from a fast GC).
-                    self._finish_delete(res, key)
+        # the store owns a private copy; taken OUTSIDE any lock (MVCC)
+        obj = copy.deepcopy(obj)
+        meta = obj.setdefault("metadata", {})
+        name = meta.get("name")
+        if not name and meta.get("generateName"):
+            name = meta["generateName"] + uuid.uuid4().hex[:6]
+            meta["name"] = name
+        if not name:
+            raise errors.BadRequest("metadata.name required")
+        ns = namespace or meta.get("namespace")
+        if res.namespaced:
+            if not ns:
+                raise errors.BadRequest("namespace required")
+            meta["namespace"] = ns
+        key = self._key(res, ns, name)
+        obj.setdefault("apiVersion", res.api_version)
+        obj.setdefault("kind", res.kind)
+        if res.kind == "Node":
+            # kubelet semantics: a registering node reports capacity
+            # and the apiserver view carries allocatable (capacity
+            # minus reserves; the fake reserves nothing). Consumers —
+            # tpusched's inventory reads
+            # status.allocatable["google.com/tpu"] — must see
+            # allocatable even when a test only staged capacity.
+            status = obj.setdefault("status", {})
+            status.setdefault("capacity", {})
+            status.setdefault(
+                "allocatable", copy.deepcopy(status["capacity"])
+            )
+        meta["uid"] = str(uuid.uuid4())
+        meta["creationTimestamp"] = _now()
+        meta.setdefault("generation", 1)
+        # uid-less refs (which a real apiserver would reject at
+        # validation) can never match an owner — they must not count
+        # as "dangling" and get the object silently collected
+        ref_uids = [r.get("uid")
+                    for r in meta.get("ownerReferences") or []
+                    if r.get("uid")]
+        fam = self._family(res)
+        stripe = self._stripe(fam, key[2], create=True)
+        try:
+            orphan = False
+            with fam.lock:
+                with stripe.lock:
+                    if key in stripe.objects:
+                        raise errors.AlreadyExists(
+                            f"{res.plural} {name!r} already exists"
+                        )
+                    rv, compact = self._next_rv()
+                    meta["resourceVersion"] = str(rv)
+                    stripe.objects[key] = obj
+                self._emit_locked(fam, "ADDED", obj)
+                # uid registration + owner-liveness, AFTER the store
+                # insert and still under the family lock (index order ==
+                # commit order — a later same-key write's reindex can
+                # never run before this registration): a concurrent
+                # owner-delete discards its uid BEFORE its (deferred)
+                # cascade reads the index, so either we see the owner
+                # dead here, or the cascade sees this child there —
+                # never neither (the orphan race the old global lock
+                # closed by brute force).
+                with self._uids_lock:
+                    self._uids.add(meta["uid"])
+                    for u in ref_uids:
+                        self._owner_children.setdefault(u,
+                                                        set()).add(key)
+                    if ref_uids and not any(u in self._uids
+                                            for u in ref_uids):
+                        # Every owner is already gone: the garbage
+                        # collector would collect this object. The race
+                        # is real — a reconciler that GETs its CR just
+                        # before the CR's delete cascades will re-create
+                        # children right after the cascade removed them;
+                        # real clusters rely on the GC to mop these
+                        # orphans up, so the fake must too (watchers see
+                        # ADDED then DELETED, as they would from a fast
+                        # GC). The caller's response keeps the creation
+                        # RV — the delete is a later event.
+                        orphan = True
+            if orphan:
+                # identity-guarded: by the time the deferred removal
+                # runs, another thread may have deleted this orphan
+                # itself AND recreated the name with a live owner — an
+                # unguarded remove would delete the legitimate successor
+                self._defer("remove", res, (key, obj))
+            if compact:
+                self._defer("compact", None, None)
             return copy.deepcopy(obj)
+        finally:
+            self._run_deferred()
 
     def _evaluate_sar(self, sar: dict) -> dict:
         """SubjectAccessReview is an ephemeral evaluation, not an object:
@@ -335,12 +611,14 @@ class FakeKube:
             group: str | None = None) -> dict:
         self._count("get")
         res = self._res(plural, group)
-        with self._lock:
-            key = self._key(res, namespace, name)
-            obj = self._store.get(key)
-            if obj is None:
-                raise errors.NotFound(f"{res.plural} {name!r} not found")
-            return copy.deepcopy(obj)
+        key = self._key(res, namespace, name)
+        stripe = self._stripe(self._family(res), key[2])
+        # MVCC read: a GIL-atomic dict.get yields an immutable snapshot
+        # reference — no lock, no wait; the copy happens outside any hold
+        obj = stripe.objects.get(key) if stripe is not None else None
+        if obj is None:
+            raise errors.NotFound(f"{res.plural} {name!r} not found")
+        return copy.deepcopy(obj)
 
     def list(self, plural: str, namespace: str | None = None,
              label_selector: str = "", field_selector: str = "",
@@ -349,78 +627,128 @@ class FakeKube:
         res = self._res(plural, group)
         pred = parse_label_selector(label_selector)
         fpred = parse_field_selector(field_selector)
-        with self._lock:
-            items = []
-            for (g, p, ns, name), obj in self._store.items():
-                if (g, p) != (res.group, res.plural):
-                    continue
-                if res.namespaced and namespace and ns != namespace:
-                    continue
-                if not pred((obj["metadata"].get("labels") or {})):
-                    continue
-                if not fpred(obj):
-                    continue
-                items.append(copy.deepcopy(obj))
-            items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
-                                      o["metadata"]["name"]))
-            return {
-                "apiVersion": res.api_version,
-                "kind": res.kind + "List",
-                "metadata": {"resourceVersion": str(self._rv)},
-                "items": items,
-            }
+        fam = self._family(res)
+        # snapshot REFERENCES under the narrowest lock that yields an
+        # exact cut, then filter + deepcopy outside any hold:
+        # - namespaced list: the one stripe lock (same-stripe commits
+        #   excluded; other-namespace events are invisible to a
+        #   namespaced watch anyway, so the envelope RV stays safe);
+        # - cluster-wide list: the family event lock (every same-family
+        #   commit holds it, so the cut is exact across stripes and the
+        #   envelope RV can never be ahead of a missing event).
+        if res.namespaced and namespace:
+            stripe = self._stripe(fam, namespace)
+            if stripe is None:
+                rv, refs = self._rv, []
+            else:
+                with stripe.lock:
+                    rv = self._rv
+                    refs = list(stripe.objects.values())
+        else:
+            with fam.lock:
+                rv = self._rv
+                # materialize the stripe list in one C call first: the
+                # comprehension runs bytecode between iterations, and
+                # _stripe() inserts brand-new namespaces into
+                # fam.stripes WITHOUT fam.lock (setdefault, pre-commit)
+                # — iterating the live dict here can raise
+                # "dictionary changed size during iteration"
+                refs = [o for s in list(fam.stripes.values())
+                        for o in s.objects.values()]
+        items = [
+            copy.deepcopy(o) for o in refs
+            if pred((o["metadata"].get("labels") or {})) and fpred(o)
+        ]
+        items.sort(key=lambda o: (o["metadata"].get("namespace", ""),
+                                  o["metadata"]["name"]))
+        return {
+            "apiVersion": res.api_version,
+            "kind": res.kind + "List",
+            "metadata": {"resourceVersion": str(rv)},
+            "items": items,
+        }
 
     def update(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None, subresource: str | None = None) -> dict:
         self._count("update")
         res = self._res(plural, group)
-        with self._lock:
-            meta = obj.get("metadata") or {}
-            name = meta.get("name")
-            ns = namespace or meta.get("namespace")
-            key = self._key(res, ns, name)
-            cur = self._store.get(key)
-            if cur is None:
-                raise errors.NotFound(f"{res.plural} {name!r} not found")
-            sent_rv = meta.get("resourceVersion")
-            if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
-                raise errors.Conflict(
-                    f"resourceVersion mismatch for {name!r}: "
-                    f"sent {sent_rv}, have {cur['metadata']['resourceVersion']}"
-                )
-            new = copy.deepcopy(obj)
-            if subresource == "status":
-                merged = copy.deepcopy(cur)
-                merged["status"] = new.get("status")
-                new = merged
-            else:
-                # Spec update bumps generation when spec changed.
-                if new.get("spec") != cur.get("spec"):
-                    gen = int(cur["metadata"].get("generation", 1))
-                    new.setdefault("metadata", {})["generation"] = gen + 1
-                if "status" not in new and "status" in cur:
-                    new["status"] = cur["status"]
-            nm = new.setdefault("metadata", {})
-            for field in ("uid", "creationTimestamp"):
-                nm[field] = cur["metadata"].get(field)
-            nm.setdefault("generation", cur["metadata"].get("generation", 1))
-            if "deletionTimestamp" in cur["metadata"]:
-                nm["deletionTimestamp"] = cur["metadata"]["deletionTimestamp"]
-            # No-op write: a real apiserver leaves resourceVersion
-            # unchanged and emits no watch event. Without this, a
-            # write-per-check controller (culling stamps an annotation
-            # every probe) self-triggers through its own watch — the
-            # hot loop cpbench's churn scenario exposed.
-            nm["resourceVersion"] = cur["metadata"]["resourceVersion"]
-            if new == cur:
-                return copy.deepcopy(cur)
-            nm["resourceVersion"] = str(self._bump())
-            self._store[key] = new
-            self._emit(res, "MODIFIED", new)
-            # Finalizer removal on a deleting object completes the delete.
-            if nm.get("deletionTimestamp") and not nm.get("finalizers"):
-                self._finish_delete(res, key)
-            return copy.deepcopy(new)
+        meta_in = obj.get("metadata") or {}
+        name = meta_in.get("name")
+        ns = namespace or meta_in.get("namespace")
+        key = self._key(res, ns, name)
+        fam = self._family(res)
+        stripe = self._stripe(fam, key[2])
+        if stripe is None:
+            raise errors.NotFound(f"{res.plural} {name!r} not found")
+        try:
+            # optimistic loop: read the current immutable object, build
+            # the successor OUTSIDE any lock (this is where the deepcopy
+            # cost lives), commit only if the store still holds the same
+            # object — else recompute against the fresh one.
+            while True:
+                cur = stripe.objects.get(key)
+                if cur is None:
+                    raise errors.NotFound(
+                        f"{res.plural} {name!r} not found")
+                sent_rv = meta_in.get("resourceVersion")
+                if sent_rv and sent_rv != cur["metadata"]["resourceVersion"]:
+                    raise errors.Conflict(
+                        f"resourceVersion mismatch for {name!r}: "
+                        f"sent {sent_rv}, have "
+                        f"{cur['metadata']['resourceVersion']}"
+                    )
+                new = copy.deepcopy(obj)
+                if subresource == "status":
+                    # COW status write: share every unchanged subtree
+                    # with the current object; metadata is copied one
+                    # level deep because the stamps below write into it
+                    merged = dict(cur)
+                    merged["metadata"] = dict(cur["metadata"])
+                    merged["status"] = new.get("status")
+                    new = merged
+                else:
+                    # Spec update bumps generation when spec changed.
+                    if new.get("spec") != cur.get("spec"):
+                        gen = int(cur["metadata"].get("generation", 1))
+                        new.setdefault("metadata", {})["generation"] = \
+                            gen + 1
+                    if "status" not in new and "status" in cur:
+                        new["status"] = cur["status"]
+                nm = new.setdefault("metadata", {})
+                for field in ("uid", "creationTimestamp"):
+                    nm[field] = cur["metadata"].get(field)
+                nm.setdefault("generation",
+                              cur["metadata"].get("generation", 1))
+                if "deletionTimestamp" in cur["metadata"]:
+                    nm["deletionTimestamp"] = \
+                        cur["metadata"]["deletionTimestamp"]
+                # No-op write: a real apiserver leaves resourceVersion
+                # unchanged and emits no watch event. Without this, a
+                # write-per-check controller (culling stamps an
+                # annotation every probe) self-triggers through its own
+                # watch — the hot loop cpbench's churn scenario exposed.
+                nm["resourceVersion"] = cur["metadata"]["resourceVersion"]
+                if new == cur:
+                    return copy.deepcopy(cur)
+                with fam.lock:
+                    with stripe.lock:
+                        if stripe.objects.get(key) is not cur:
+                            continue    # lost the race: recompute
+                        rv, compact = self._next_rv()
+                        nm["resourceVersion"] = str(rv)
+                        stripe.objects[key] = new
+                    self._emit_locked(fam, "MODIFIED", new)
+                    self._reindex_owners(key, cur, new)
+                if compact:
+                    self._defer("compact", None, None)
+                # Finalizer removal on a deleting object completes the
+                # delete (identity-guarded: a racing writer that revived
+                # a finalizer wins).
+                if nm.get("deletionTimestamp") and not nm.get("finalizers"):
+                    self._remove(res, key, expect=new)
+                return copy.deepcopy(new)
+        finally:
+            self._run_deferred()
 
     def update_status(self, plural: str, obj: dict,
                       namespace: str | None = None,
@@ -431,91 +759,216 @@ class FakeKube:
               group: str | None = None, patch_type: str = "merge") -> dict:
         self._count("patch")
         res = self._res(plural, group)
-        with self._lock:
-            key = self._key(res, namespace, name)
-            cur = self._store.get(key)
-            if cur is None:
-                raise errors.NotFound(f"{res.plural} {name!r} not found")
-            if patch_type == "merge":
-                new = json_merge_patch(cur, patch)
-            elif patch_type == "json":
-                new = _apply_json_patch(cur, patch)
-            else:
-                raise errors.BadRequest(f"unsupported patch type {patch_type}")
-            new["metadata"]["name"] = name
-            new["metadata"]["uid"] = cur["metadata"]["uid"]
-            new["metadata"]["resourceVersion"] = cur["metadata"][
-                "resourceVersion"]
-            if new == cur:
-                # no-op patch: same RV, no watch event (kube semantics)
-                return copy.deepcopy(cur)
-            new["metadata"]["resourceVersion"] = str(self._bump())
-            self._store[key] = new
-            self._emit(res, "MODIFIED", new)
-            if new["metadata"].get("deletionTimestamp") and not new[
-                "metadata"
-            ].get("finalizers"):
-                self._finish_delete(res, key)
-            return copy.deepcopy(new)
+        key = self._key(res, namespace, name)
+        fam = self._family(res)
+        stripe = self._stripe(fam, key[2])
+        if stripe is None:
+            raise errors.NotFound(f"{res.plural} {name!r} not found")
+        try:
+            while True:
+                cur = stripe.objects.get(key)
+                if cur is None:
+                    raise errors.NotFound(
+                        f"{res.plural} {name!r} not found")
+                # the merge itself deep-copies the target — outside any
+                # lock; a lost commit race recomputes against the fresh
+                # object (a real apiserver retries merge patches
+                # server-side the same way)
+                if patch_type == "merge":
+                    new = json_merge_patch(cur, patch)
+                elif patch_type == "json":
+                    new = _apply_json_patch(cur, patch)
+                else:
+                    raise errors.BadRequest(
+                        f"unsupported patch type {patch_type}")
+                new["metadata"]["name"] = name
+                new["metadata"]["uid"] = cur["metadata"]["uid"]
+                new["metadata"]["resourceVersion"] = cur["metadata"][
+                    "resourceVersion"]
+                if new == cur:
+                    # no-op patch: same RV, no watch event (kube semantics)
+                    return copy.deepcopy(cur)
+                with fam.lock:
+                    with stripe.lock:
+                        if stripe.objects.get(key) is not cur:
+                            continue
+                        rv, compact = self._next_rv()
+                        new["metadata"]["resourceVersion"] = str(rv)
+                        stripe.objects[key] = new
+                    self._emit_locked(fam, "MODIFIED", new)
+                    self._reindex_owners(key, cur, new)
+                if compact:
+                    self._defer("compact", None, None)
+                if new["metadata"].get("deletionTimestamp") and not new[
+                    "metadata"
+                ].get("finalizers"):
+                    self._remove(res, key, expect=new)
+                return copy.deepcopy(new)
+        finally:
+            self._run_deferred()
 
     def delete(self, plural: str, name: str, namespace: str | None = None,
                group: str | None = None) -> dict:
         self._count("delete")
         res = self._res(plural, group)
-        with self._lock:
-            key = self._key(res, namespace, name)
-            cur = self._store.get(key)
-            if cur is None:
-                raise errors.NotFound(f"{res.plural} {name!r} not found")
-            if cur["metadata"].get("finalizers"):
-                if not cur["metadata"].get("deletionTimestamp"):
-                    cur["metadata"]["deletionTimestamp"] = _now()
-                    cur["metadata"]["resourceVersion"] = str(self._bump())
-                    self._emit(res, "MODIFIED", cur)
-                return copy.deepcopy(cur)
-            self._finish_delete(res, key)
-            return {"kind": "Status", "status": "Success"}
+        key = self._key(res, namespace, name)
+        fam = self._family(res)
+        stripe = self._stripe(fam, key[2])
+        if stripe is None:
+            raise errors.NotFound(f"{res.plural} {name!r} not found")
+        try:
+            while True:
+                cur = stripe.objects.get(key)
+                if cur is None:
+                    raise errors.NotFound(
+                        f"{res.plural} {name!r} not found")
+                if cur["metadata"].get("finalizers"):
+                    if cur["metadata"].get("deletionTimestamp"):
+                        return copy.deepcopy(cur)
+                    # COW deletion stamp: never mutate the stored object
+                    new = dict(cur)
+                    new["metadata"] = {**cur["metadata"],
+                                       "deletionTimestamp": _now()}
+                    with fam.lock:
+                        with stripe.lock:
+                            if stripe.objects.get(key) is not cur:
+                                continue
+                            rv, compact = self._next_rv()
+                            new["metadata"]["resourceVersion"] = str(rv)
+                            stripe.objects[key] = new
+                        self._emit_locked(fam, "MODIFIED", new)
+                    if compact:
+                        self._defer("compact", None, None)
+                    return copy.deepcopy(new)
+                if self._remove(res, key, expect=cur) is None:
+                    continue    # a writer slipped in (maybe adding a
+                    # finalizer): re-evaluate against the fresh object
+                return {"kind": "Status", "status": "Success"}
+        finally:
+            self._run_deferred()
 
-    def _finish_delete(self, res: Resource, key):
-        obj = self._store.pop(key, None)
-        if obj is None:
-            return
-        self._uids.discard(obj["metadata"].get("uid"))
-        # a real apiserver bumps the RV on delete; emitting the stale
-        # pre-delete RV would make a resume-from-last-RV watcher (the
-        # informer) drop the DELETED event from its backlog — or regress
-        # its tracked RV and replay newer events. Bump a COPY: when the
-        # orphan GC fires inside create(), the caller's response must
-        # keep the creation RV (the delete is a later event), not the
-        # delete's.
-        obj = copy.deepcopy(obj)
-        obj["metadata"]["resourceVersion"] = str(self._bump())
-        self._emit(res, "DELETED", obj)
-        # ownerReference cascade (synchronous; foreground-ish for tests).
+    def _remove(self, res: Resource, key, expect: dict | None = None):
+        """Remove ``key`` from its stripe and emit DELETED. With
+        ``expect``, only removes that exact object (optimistic callers
+        retry on None). Takes fresh locks — callers hold NONE — and
+        defers the ownerReference cascade to lock-free context. Returns
+        the removed object (None when absent or the identity check
+        failed)."""
+        fam = self._family(res)
+        stripe = self._stripe(fam, key[2])
+        if stripe is None:
+            return None
+        with fam.lock:
+            with stripe.lock:
+                obj = stripe.objects.get(key)
+                if obj is None or (expect is not None
+                                   and obj is not expect):
+                    return None
+                rv, compact = self._next_rv()
+                del stripe.objects[key]
+            # a real apiserver bumps the RV on delete; emitting the
+            # stale pre-delete RV would make a resume-from-last-RV
+            # watcher (the informer) drop the DELETED event from its
+            # backlog — or regress its tracked RV and replay newer
+            # events. Bump a COW copy: when the orphan GC fires after
+            # create(), the caller's response must keep the creation RV
+            # (the delete is a later event), not the delete's.
+            ev_obj = dict(obj)
+            ev_obj["metadata"] = {**obj["metadata"],
+                                  "resourceVersion": str(rv)}
+            self._emit_locked(fam, "DELETED", ev_obj)
         uid = obj["metadata"].get("uid")
-        if not uid:
+        with self._uids_lock:
+            if uid:
+                self._uids.discard(uid)
+            for r in obj["metadata"].get("ownerReferences") or []:
+                ru = r.get("uid")
+                children = self._owner_children.get(ru) if ru else None
+                if children is not None:
+                    children.discard(key)
+                    if not children:
+                        del self._owner_children[ru]
+        if uid:
+            self._defer("cascade", None, uid)
+        if compact:
+            self._defer("compact", None, None)
+        return obj
+
+    def _reindex_owners(self, key, old_obj: dict, new_obj: dict) -> None:
+        """Keep the owner-uid → children index current when a write
+        changes ownerReferences (adoption / orphaning via update or
+        patch). Caller holds the FAMILY event lock, so index updates
+        apply in commit order — two racing same-key writers can never
+        index out of order (an out-of-order discard would leave a live
+        ownerReference unindexed: a permanent orphan). It also closes
+        the race against a concurrent owner delete: if every referenced
+        owner is already dead by the time we register (the delete's uid
+        discard happens BEFORE its cascade pops the index, so either
+        the cascade sees our entry or we see the owner dead here — same
+        ordering argument as create), the adopted object is collected
+        like any other orphan."""
+        old = {r.get("uid")
+               for r in old_obj["metadata"].get("ownerReferences") or []
+               if r.get("uid")}
+        new = {r.get("uid")
+               for r in new_obj["metadata"].get("ownerReferences") or []
+               if r.get("uid")}
+        if old == new:
             return
-        children = []
-        for ckey, cobj in list(self._store.items()):
-            for ref in cobj["metadata"].get("ownerReferences") or []:
-                if ref.get("uid") == uid:
-                    children.append((ckey, cobj))
-                    break
-        # the cascade is the fake's synchronous garbage collector, not a
-        # network client: chaos (blackouts, error rates) must not abort
-        # it halfway — a real GC retries until the children are gone,
-        # so a one-shot cascade that chaos could interrupt would create
-        # permanent orphans no real cluster would have
+        orphan = False
+        with self._uids_lock:
+            for u in old - new:
+                children = self._owner_children.get(u)
+                if children is not None:
+                    children.discard(key)
+                    if not children:
+                        del self._owner_children[u]
+            for u in new - old:
+                self._owner_children.setdefault(u, set()).add(key)
+            if new and not any(u in self._uids for u in new):
+                orphan = True
+        if orphan:
+            res = self.registry.by_plural(key[1], key[0])
+            self._defer("remove", res, (key, new_obj))
+
+    def _cascade(self, uid: str) -> None:
+        """ownerReference cascade for a deleted owner (the fake's
+        synchronous garbage collector). Runs from _run_deferred with NO
+        locks held; children are deleted through the normal verb in
+        canonical (sorted-key) order, each taking fresh locks — the
+        cascade can never participate in a lock-order cycle. Chaos
+        (blackouts, error rates) must not abort it halfway: a real GC
+        retries until the children are gone, so a one-shot cascade that
+        chaos could interrupt would create permanent orphans no real
+        cluster would have — hence the internal-actor mark."""
+        with self._uids_lock:
+            children = sorted(self._owner_children.pop(uid, ()))
+        if not children:
+            return
         self._internal.depth = getattr(self._internal, "depth", 0) + 1
         try:
-            for ckey, cobj in children:
-                cres = self.registry.by_plural(ckey[1], ckey[0])
+            for ckey in children:
                 try:
+                    cres = self.registry.by_plural(ckey[1], ckey[0])
+                    # re-check under the current object: a disown
+                    # (ownerReferences removed) whose commit landed
+                    # after this cascade popped the index must not get
+                    # its object destroyed — the index entry is a hint,
+                    # the immutable stored object is the truth
+                    fam = self._family(cres)
+                    stripe = self._stripe(fam, ckey[2])
+                    cur = (stripe.objects.get(ckey)
+                           if stripe is not None else None)
+                    if cur is None or not any(
+                            r.get("uid") == uid
+                            for r in cur["metadata"].get(
+                                "ownerReferences") or []):
+                        continue
                     self.delete(
                         cres.plural, ckey[3],
                         namespace=ckey[2] or None, group=cres.group,
                     )
-                except errors.ApiError:
+                except (errors.ApiError, KeyError):
                     pass
         finally:
             self._internal.depth -= 1
@@ -533,32 +986,29 @@ class FakeKube:
         produced (the wire layer must be able to answer with an HTTP 410
         status, not a truncated 200 stream). The returned generator blocks
         waiting for events; it ends after ``timeout`` seconds of inactivity
-        if given (else runs until closed by the caller).
-        """
+        if given (else runs until closed by the caller)."""
         self._count("watch")
         res = self._res(plural, group)
-        hkey = (res.group, res.plural)
+        fam = self._family(res)
         rv = int(resource_version or 0)
-        w = _Watch(hkey, rv)
-        with self._lock:
+        w = _Watch()
+        with fam.lock:
             # a nonzero start-RV older than the retained history window is
             # exactly the apiserver's "too old resource version" — the
             # watcher must relist (kube semantics: 410 Gone / Expired)
-            if rv and rv < self._pruned.get(hkey, 0):
+            if rv and rv < fam.pruned:
                 raise errors.Gone(
                     f"too old resource version: {rv} "
-                    f"(oldest retained: {self._pruned[hkey] + 1})"
+                    f"(oldest retained: {fam.pruned + 1})"
                 )
-            backlog = [
-                ev for (erv, ev) in self._history.get(hkey, []) if erv > rv
-            ]
-            self._watches.append(w)
+            backlog = [ev for (erv, ev) in fam.history if erv > rv]
+            fam.watchers.append(w)
 
         def cleanup():
             w.closed = True
-            with self._lock:
-                if w in self._watches:
-                    self._watches.remove(w)
+            with fam.lock:
+                if w in fam.watchers:
+                    fam.watchers.remove(w)
 
         def stream():
             try:
@@ -601,64 +1051,83 @@ class FakeKube:
 
     def compact_history(self, plural: str | None = None,
                         group: str | None = None) -> None:
-        """Drop retained watch history (test helper): the next watch from a
-        pre-compaction RV gets 410 Gone, like an etcd compaction."""
-        with self._lock:
-            if plural is None:
-                keys = list(self._history)
-            else:
-                res = self._res(plural, group)
-                keys = [(res.group, res.plural)]
-            for hkey in keys:
-                if self._history.get(hkey):
-                    self._pruned[hkey] = self._history[hkey][-1][0]
-                    self._history[hkey] = []
-            self._gc_events_locked()
+        """Drop retained watch history (test helper / chaos gone_storm):
+        the next watch from a pre-compaction RV gets 410 Gone, like an
+        etcd compaction. Families are swept one at a time in canonical
+        (sorted-key) order with no lock nesting — the 410-storm sweep
+        can never deadlock against in-flight verbs."""
+        if plural is None:
+            fams = [self._families[k] for k in sorted(self._families)]
+        else:
+            res = self._res(plural, group)
+            fams = [self._family(res)]
+        for fam in fams:
+            with fam.lock:
+                if fam.history:
+                    fam.pruned = fam.history[-1][0]
+                    fam.history = []
+        self._gc_events()
+        self._run_deferred()
 
-    def _gc_events_locked(self) -> None:
+    def _gc_events(self) -> None:
         """TTL sweep of core-v1 Events, piggybacking on history
         compaction (the apiserver's --event-ttl, approximated: real
         clusters do it in etcd via lease expiry; compaction time is
-        when this fake already accepts losing history). Caller holds
-        ``self._lock``. Deletion goes through the normal path so
-        watchers see DELETED, like any other removal."""
+        when this fake already accepts losing history). Runs with NO
+        locks held — doomed keys are collected from per-stripe snapshots
+        and removed through the normal path so watchers see DELETED,
+        like any other removal."""
         if not self.event_ttl_s:
             return
         import calendar
 
         cutoff = time.time() - self.event_ttl_s
+        try:
+            res = self._res("events")
+        except errors.NotFound:
+            return
+        fam = self._family(res)
         doomed = []
-        for key, obj in self._store.items():
-            if key[0] != "" or key[1] != "events":
-                continue
-            raw = (obj.get("lastTimestamp") or obj.get("firstTimestamp")
-                   or obj["metadata"].get("creationTimestamp"))
-            try:
-                ts = calendar.timegm(
-                    time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ"))
-            except (TypeError, ValueError):
-                continue  # unparseable stamp: never silently GC it
-            if ts < cutoff:
-                doomed.append(key)
-        res = self._res("events") if doomed else None
-        for key in doomed:
-            self._finish_delete(res, key)
+        for ns in sorted(fam.stripes):
+            stripe = fam.stripes[ns]
+            with stripe.lock:
+                snapshot = list(stripe.objects.items())
+            for key, obj in snapshot:
+                raw = (obj.get("lastTimestamp") or obj.get("firstTimestamp")
+                       or obj["metadata"].get("creationTimestamp"))
+                try:
+                    ts = calendar.timegm(
+                        time.strptime(raw, "%Y-%m-%dT%H:%M:%SZ"))
+                except (TypeError, ValueError):
+                    continue  # unparseable stamp: never silently GC it
+                if ts < cutoff:
+                    doomed.append((key, obj))
+        for key, obj in doomed:
+            # identity-guarded: an Event refreshed (repeat-count patch,
+            # fresh lastTimestamp) between the snapshot and this removal
+            # commits a NEW object — it must survive until it genuinely
+            # expires, not vanish under the recorder's feet
+            self._remove(res, key, expect=obj)
 
     def _sever_watches(self) -> int:
         """Connection-reset every live watch (chaos blackout): mark the
         channels closed and wake any blocked reader with an in-stream
         ERROR Status so the reset is seen now, not at the next idle
-        timeout. Returns the number of channels severed."""
-        with self._lock:
-            watches = list(self._watches)
-        for w in watches:
-            w.closed = True
-            w.q.put({"type": "ERROR", "object": {
-                "kind": "Status", "code": 503,
-                "reason": "ServiceUnavailable",
-                "message": "chaos: watch connection severed",
-            }})
-        return len(watches)
+        timeout. Families are visited one at a time (no lock nesting).
+        Returns the number of channels severed."""
+        severed = 0
+        for fam in list(self._families.values()):
+            with fam.lock:
+                watchers = list(fam.watchers)
+            for w in watchers:
+                w.closed = True
+                w.q.put({"type": "ERROR", "object": {
+                    "kind": "Status", "code": 503,
+                    "reason": "ServiceUnavailable",
+                    "message": "chaos: watch connection severed",
+                }})
+                severed += 1
+        return severed
 
     def _filter_ns(self, ev, res, namespace):
         if "metadata" not in (ev.get("object") or {}):

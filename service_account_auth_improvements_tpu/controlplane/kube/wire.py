@@ -75,6 +75,15 @@ def handle(fake, environ, start_response):
 
                 def stream():
                     for ev in events:
+                        # the fake's watch events share the immutable
+                        # stored object (MVCC fanout) and carry the
+                        # in-process emittedAt extension (a monotonic
+                        # stamp, meaningless across processes): strip it
+                        # here via a shallow copy — never mutate the
+                        # shared event
+                        if "emittedAt" in ev:
+                            ev = {k: v for k, v in ev.items()
+                                  if k != "emittedAt"}
                         yield (json.dumps(ev) + "\n").encode()
 
                 return stream()

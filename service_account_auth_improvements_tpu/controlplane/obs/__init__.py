@@ -50,6 +50,7 @@ from service_account_auth_improvements_tpu.controlplane.obs.prof import (  # noq
     reconcile_tag,
     render_profilez,
     saturation_snapshot,
+    store_lock_wait_share,
     start_from_env as start_profiler_from_env,
     sync_metrics as prof_sync_metrics,
 )

@@ -406,8 +406,38 @@ def lock_contention_top(since: dict | None = None, limit: int = 10,
             "wait_max_s": round(st["wait_max_s"], 6),
             "hold_max_s": round(st["hold_max_s"], 6),
         })
-    rows.sort(key=lambda r: (r["wait_s"], r["hold_s"]), reverse=True)
+    # genuinely contended sites first (any acquisition that measurably
+    # waited — CONTENDED_WAIT_S in lockwatch — outranks pure fast-path
+    # acquire bookkeeping, which sums to milliseconds on a hot verb
+    # without a single thread ever blocking), then by waited seconds
+    rows.sort(key=lambda r: (1 if r["contended"] else 0,
+                             r["wait_s"], r["hold_s"]), reverse=True)
     return rows[:limit]
+
+
+#: creation-site fragment identifying the fake apiserver's own locks
+#: (store stripes, family event locks) in lockwatch site labels
+STORE_LOCK_SITE_FRAGMENT = "kube/fake.py"
+
+
+def store_lock_wait_share(locks: list, duration_s: float) -> float:
+    """Store-lock wait share — the ONE definition, shared by cpbench's
+    ``extra.prof`` and the ``apiserver_stress`` sweep arms (bench_gate
+    --store-lock-max-share gates it): CONTENDED wait on locks created
+    in kube/fake.py, divided by wall time. "Of this window's runtime,
+    how much thread time was spent blocked on the fake apiserver" —
+    stable whether or not anything else contends (a share-of-total-
+    contention ratio would read 1.0 for a single 150 µs blip in an
+    otherwise clean run and near 0 for a saturated fake on a busy
+    box), and can exceed 1.0 when several threads block concurrently
+    (the pre-refactor fake measured 2.9 on the 4-worker stress arm).
+    Uncontended fast-path acquire bookkeeping is excluded: it sums to
+    milliseconds on a hot verb without anything ever serializing.
+    ``locks`` is :func:`lock_contention_top` output (use a wide limit —
+    a lock-heavy process can push fake sites past any top-10)."""
+    wait = sum(r["wait_s"] for r in locks
+               if r["contended"] and STORE_LOCK_SITE_FRAGMENT in r["site"])
+    return round(wait / max(duration_s, 1e-9), 4)
 
 
 # ----------------------------------------------------------- saturation

@@ -69,6 +69,12 @@ DEFAULT_OBJECTIVES = (
         "chaos injection -> invariant-clean recovery, p95 under 30s",
         target_ms=30_000.0,
     ),
+    Objective(
+        "watch_delivery",
+        "apiserver watch event emit -> consumer receipt under stress "
+        "churn, p95 under 5s",
+        target_ms=5_000.0,
+    ),
 )
 
 OBJECTIVES_BY_NAME = {o.name: o for o in DEFAULT_OBJECTIVES}

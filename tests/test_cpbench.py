@@ -152,16 +152,28 @@ def test_cli_smoke_emits_parseable_schema(tmp_path):
     assert report["ok"] is True
     assert set(report["scenarios"]) == {
         "notebook_ready", "gang_ready", "churn", "profile_fanout",
-        "webhook_inject", "sched_contention",
+        "webhook_inject", "sched_contention", "apiserver_stress",
     }
     for name, s in report["scenarios"].items():
         assert s["ok"], name
+        for counter in ("reconciles", "requeues", "backoffs"):
+            assert isinstance(s[counter], int)
+        if name == "apiserver_stress":
+            # no notebook lifecycle here — the apiserver itself is the
+            # system under test; the sweep record is the evidence
+            sweep = s["extra"]["workers_sweep"]
+            assert set(sweep) == {"1", "2", "4"}
+            for arm in sweep.values():
+                assert arm["throughput_ops_s"] > 0
+                assert arm["ordering_violations"] == 0
+                assert arm["watch_events_seen"] == \
+                    arm["watch_events_expected"]
+            assert s["slo"]["watch_delivery"]["met"]
+            continue
         ready = s["phases_ms"]["create_to_ready"]
         for q in ("p50", "p95", "p99"):
             assert isinstance(ready[q], float), (name, q)
         assert ready["p50"] <= ready["p95"] <= ready["p99"]
-        for counter in ("reconciles", "requeues", "backoffs"):
-            assert isinstance(s[counter], int)
 
 
 def test_cli_scenario_filter(tmp_path):

@@ -474,3 +474,82 @@ def test_prof_gate_cli_end_to_end(tmp_path):
     # a tightened ceiling via the flag trips the good run too
     assert bg.main(["--run", str(good), "--prof-report",
                     "--prof-overhead-max", "1.005"]) == 1
+
+
+# ------------------------------------- bench_gate store-lock-share leg
+
+
+def _striped_run():
+    """A post-refactor-shaped run: top contention moved off the fake."""
+    run = _good_run()
+    for s in run["scenarios"].values():
+        s["extra"]["prof"]["top_contended_lock"] = \
+            "engine/informer.py:67"
+        s["extra"]["prof"]["store_lock_wait_share"] = 0.12
+    return run
+
+
+def test_store_lock_leg_known_good():
+    bg = _load_bench_gate()
+    assert bg.prof_gate(_striped_run(), store_max_share=0.5) == []
+    # the leg is opt-in: without the ceiling, a fake-heavy run only has
+    # to satisfy the presence legs (pre-refactor records stay gateable)
+    assert bg.prof_gate(_good_run()) == []
+
+
+def test_store_lock_leg_known_bad():
+    bg = _load_bench_gate()
+    # the fake as top contended lock fails even with share under ceiling
+    run = _striped_run()
+    prof = run["scenarios"]["churn"]["extra"]["prof"]
+    prof["top_contended_lock"] = "controlplane/kube/fake.py:142"
+    prof["store_lock_wait_share"] = 0.2   # above the top-site floor
+    fails = bg.prof_gate(run, store_max_share=0.5)
+    assert any("serialization point" in f and "churn" in f
+               for f in fails)
+    # share over the ceiling fails even with a non-fake top lock
+    run = _striped_run()
+    run["scenarios"]["churn"]["extra"]["prof"][
+        "store_lock_wait_share"] = 0.9
+    fails = bg.prof_gate(run, store_max_share=0.5)
+    assert any("wait share 0.9 exceeds 0.5" in f for f in fails)
+    # an absent share is absent evidence, not a pass
+    run = _striped_run()
+    del run["scenarios"]["churn"]["extra"]["prof"][
+        "store_lock_wait_share"]
+    fails = bg.prof_gate(run, store_max_share=0.5)
+    assert any("store_lock_wait_share absent" in f for f in fails)
+
+
+def test_store_lock_leg_cli(tmp_path):
+    import json
+
+    bg = _load_bench_gate()
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_striped_run()))
+    assert bg.main(["--run", str(good), "--prof-report",
+                    "--store-lock-max-share", "0.5"]) == 0
+    bad_run = _striped_run()
+    bad_run["scenarios"]["notebook_ready"]["extra"]["prof"][
+        "store_lock_wait_share"] = 0.95
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_run))
+    assert bg.main(["--run", str(bad), "--prof-report",
+                    "--store-lock-max-share", "0.5"]) == 1
+    # the leg cannot be requested without the prof records it reads
+    with pytest.raises(SystemExit):
+        bg.main(["--run", str(good), "--store-lock-max-share", "0.5"])
+    with pytest.raises(SystemExit):
+        bg.main(["--store-lock-max-share", "0.5"])
+
+
+def test_store_lock_leg_top_site_needs_meaningful_share():
+    """With the share below the noise floor, the fake being the nominal
+    top site is a couple of GIL-slice blips, not a serialization point
+    — the top-site leg must not convict."""
+    bg = _load_bench_gate()
+    run = _striped_run()
+    prof = run["scenarios"]["churn"]["extra"]["prof"]
+    prof["top_contended_lock"] = "controlplane/kube/fake.py:149"
+    prof["store_lock_wait_share"] = 0.1   # below the 0.15 floor
+    assert bg.prof_gate(run, store_max_share=0.5) == []

@@ -1,0 +1,625 @@
+"""Striped MVCC FakeKube fidelity hammer (docs/fakekube.md).
+
+The PR 11 re-architecture replaced the fake apiserver's single store
+RLock with per-(group, plural, namespace) stripes, MVCC snapshot reads,
+and a per-resource event lock — faster must not mean looser, so these
+tests hammer the concurrency semantics the old global lock gave for
+free: strict RV monotonicity per resource, per-key watch ordering, no
+lost or duplicated events across compaction + 410 replay, optimistic-
+concurrency conflicts identical to the pre-refactor fake, and a GC
+cascade that leaves no orphans when owners die mid-create. Runs under
+CPLINT_LOCKWATCH=1 in the tier-1 lane, so every path here also proves
+its lock order acyclic.
+"""
+
+import threading
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Informer,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+
+NS = [f"ns-{i}" for i in range(4)]
+
+
+def _cm(name, ns, data=None):
+    return {"metadata": {"name": name, "namespace": ns},
+            "data": data or {}}
+
+
+def _run_threads(fns):
+    threads = [threading.Thread(target=fn, daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+# ------------------------------------------------- RV + event ordering
+
+
+def test_rv_allocation_unique_and_history_rv_ordered():
+    """Concurrent mixed writers across namespaces: every emitted event
+    carries a unique RV and a replay-from-0 watch delivers the whole
+    resource's history in strictly increasing RV order (the event lock
+    allocates the RV and appends under one hold — order == allocation
+    order by construction)."""
+    kube = FakeKube()
+    n_workers, per = 8, 30
+
+    def writer(w):
+        for i in range(per):
+            ns = NS[(w + i) % len(NS)]
+            name = f"cm-{w}-{i}"
+            obj = kube.create("configmaps", _cm(name, ns))
+            obj["data"] = {"seq": str(i)}
+            kube.update("configmaps", obj)
+            if i % 3 == 0:
+                kube.delete("configmaps", name, namespace=ns)
+
+    _run_threads([lambda w=w: writer(w) for w in range(n_workers)])
+    events = list(kube.watch("configmaps", resource_version=0,
+                             timeout=0.2))
+    rvs = [int(e["object"]["metadata"]["resourceVersion"])
+           for e in events]
+    assert rvs == sorted(rvs), "history must be RV-ordered"
+    assert len(set(rvs)) == len(rvs), "RVs must be unique"
+    deletes = per // 3 + (1 if per % 3 else 0)
+    assert len(events) == n_workers * (2 * per + deletes)
+
+
+def test_per_key_watch_ordering_under_concurrency():
+    """Per object: ADDED first, MODIFIED in payload order (each write
+    bumps a counter), DELETED terminal — across 8 concurrent writers
+    sharing stripes."""
+    kube = FakeKube()
+
+    def writer(w):
+        ns = NS[w % len(NS)]
+        name = f"obj-{w}"
+        obj = kube.create("configmaps", _cm(name, ns))
+        for i in range(20):
+            obj["data"] = {"seq": str(i)}
+            obj = kube.update("configmaps", obj)
+        kube.delete("configmaps", name, namespace=ns)
+
+    _run_threads([lambda w=w: writer(w) for w in range(8)])
+    per_key: dict[str, list] = {}
+    for ev in kube.watch("configmaps", resource_version=0, timeout=0.2):
+        meta = ev["object"]["metadata"]
+        per_key.setdefault(meta["name"], []).append(ev)
+    assert len(per_key) == 8
+    for name, evs in per_key.items():
+        types = [e["type"] for e in evs]
+        assert types[0] == "ADDED" and types[-1] == "DELETED", types
+        assert types[1:-1] == ["MODIFIED"] * 20, name
+        seqs = [int(e["object"]["data"]["seq"]) for e in evs[1:-1]]
+        assert seqs == list(range(20)), "per-key writes reordered"
+
+
+def test_resume_from_midpoint_replays_exact_suffix():
+    kube = FakeKube()
+    for i in range(40):
+        kube.create("configmaps", _cm(f"c-{i}", NS[i % len(NS)]))
+    events = list(kube.watch("configmaps", resource_version=0,
+                             timeout=0.2))
+    rvs = [int(e["object"]["metadata"]["resourceVersion"])
+           for e in events]
+    mid = rvs[len(rvs) // 2]
+    suffix = list(kube.watch("configmaps", resource_version=mid,
+                             timeout=0.2))
+    assert [int(e["object"]["metadata"]["resourceVersion"])
+            for e in suffix] == [rv for rv in rvs if rv > mid]
+
+
+# ------------------------------------------- conflicts (pre-refactor pin)
+
+
+def test_conflict_on_stale_rv_identical_to_prerefactor():
+    """The optimistic-concurrency contract, byte-for-byte: stale RV
+    conflicts, no-op writes keep the RV and emit nothing, retry-with-
+    fresh-read loses no increment under 20 concurrent writers."""
+    kube = FakeKube()
+    kube.create("configmaps", _cm("shared", "ns-0", {"count": "0"}))
+
+    a = kube.get("configmaps", "shared", namespace="ns-0")
+    b = kube.get("configmaps", "shared", namespace="ns-0")
+    a["data"]["count"] = "1"
+    kube.update("configmaps", a)
+    b["data"]["count"] = "99"
+    with pytest.raises(errors.Conflict):
+        kube.update("configmaps", b)
+
+    # no-op update: RV kept, no event (the churn-scenario hot-loop fix)
+    cur = kube.get("configmaps", "shared", namespace="ns-0")
+    rv0 = cur["metadata"]["resourceVersion"]
+    same = kube.update("configmaps", cur)
+    assert same["metadata"]["resourceVersion"] == rv0
+    assert list(kube.watch("configmaps", resource_version=int(rv0),
+                           timeout=0.1)) == []
+
+    per_thread, n_threads = 5, 20
+
+    def bump():
+        for _ in range(per_thread):
+            while True:
+                cur = kube.get("configmaps", "shared", namespace="ns-0")
+                cur["data"]["count"] = str(
+                    int(cur["data"]["count"]) + 1)
+                try:
+                    kube.update("configmaps", cur)
+                    break
+                except errors.Conflict:
+                    pass
+
+    _run_threads([bump] * n_threads)
+    final = kube.get("configmaps", "shared", namespace="ns-0")
+    assert int(final["data"]["count"]) == 1 + per_thread * n_threads
+
+
+def test_concurrent_merge_patches_all_land():
+    """Merge patches have no client RV: the fake applies each against
+    the current object (server-side retry on a lost commit race), so N
+    concurrent single-key patches must all be visible at the end."""
+    kube = FakeKube()
+    kube.create("configmaps", _cm("patched", "ns-0"))
+
+    def patcher(w):
+        for i in range(10):
+            kube.patch("configmaps", "patched",
+                       {"data": {f"k-{w}-{i}": "1"}}, namespace="ns-0")
+
+    _run_threads([lambda w=w: patcher(w) for w in range(8)])
+    final = kube.get("configmaps", "patched", namespace="ns-0")
+    assert len(final["data"]) == 80, "a lost patch = a torn commit race"
+
+
+# ------------------------------- compaction + 410 replay, no loss/no dup
+
+
+def test_no_lost_or_dup_events_across_compaction_and_replay():
+    """The reflector contract under concurrent churn AND 410 storms: an
+    informer relisting through aggressive auto-compaction converges to
+    the exact store state, with exactly one DELETED per vanished key."""
+    kube = FakeKube()
+    kube.compact_every_n_events = 7    # aggressive: constant 410s
+    deleted: dict[str, int] = {}
+    lock = threading.Lock()
+
+    def handler(ev, obj):
+        if ev == "DELETED":
+            with lock:
+                name = obj["metadata"]["name"]
+                deleted[name] = deleted.get(name, 0) + 1
+
+    inf = Informer(kube, "configmaps", relist_period=0.05)
+    inf.add_handler(handler)
+    inf.start()
+    assert inf.wait_for_sync(5)
+    doomed: set[str] = set()
+
+    def writer(w):
+        for i in range(25):
+            ns = NS[(w + i) % len(NS)]
+            name = f"cc-{w}-{i}"
+            obj = kube.create("configmaps", _cm(name, ns))
+            obj["data"] = {"x": "1"}
+            kube.update("configmaps", obj)
+            if i % 5 == 0:
+                kube.delete("configmaps", name, namespace=ns)
+                doomed.add(name)
+
+    try:
+        _run_threads([lambda w=w: writer(w) for w in range(6)])
+        # convergence: the cache must equal the store exactly
+        expect = {(o["metadata"]["namespace"], o["metadata"]["name"])
+                  for o in kube.list("configmaps")["items"]}
+        deadline = threading.Event()
+        for _ in range(100):
+            got = {(o["metadata"]["namespace"], o["metadata"]["name"])
+                   for o in inf.list()}
+            if got == expect:
+                break
+            deadline.wait(0.05)
+        assert got == expect, (len(got), len(expect))
+    finally:
+        inf.stop()
+    with lock:
+        over_delivered = {n: c for n, c in deleted.items() if c > 1}
+    # relists may report a key's disappearance once; never twice, and
+    # never for a key that still exists
+    assert not over_delivered, over_delivered
+    assert set(deleted) <= doomed, set(deleted) - doomed
+
+
+def test_stale_watch_after_concurrent_compaction_gets_410():
+    kube = FakeKube()
+    for i in range(5):
+        kube.create("configmaps", _cm(f"c{i}", "ns-0"))
+    kube.compact_history()
+    with pytest.raises(errors.Gone):
+        kube.watch("configmaps", resource_version=1)
+    # fresh events after the compaction replay fine from the new floor
+    out = kube.create("configmaps", _cm("after", "ns-1"))
+    rv = int(out["metadata"]["resourceVersion"])
+    events = list(kube.watch("configmaps", resource_version=rv - 1,
+                             timeout=0.1))
+    assert [e["object"]["metadata"]["name"] for e in events] == ["after"]
+
+
+# ------------------------------------------------- GC cascade vs creates
+
+
+def test_cascade_leaves_no_orphans_under_concurrent_child_creates():
+    """Children racing their owner's delete: whichever side loses the
+    race, the child must be collected — by the cascade (created before
+    the uid discard) or by the orphan check (created after). No
+    interleaving may leak a live child of a dead owner."""
+    kube = FakeKube()
+    rounds = 30
+    for r in range(rounds):
+        nb = kube.create("configmaps", _cm(f"owner-{r}", "ns-0"))
+        uid = nb["metadata"]["uid"]
+        barrier = threading.Barrier(2)
+
+        def deleter():
+            barrier.wait()
+            kube.delete("configmaps", f"owner-{r}", namespace="ns-0")
+
+        def creator():
+            barrier.wait()
+            try:
+                kube.create("secrets", {
+                    "metadata": {
+                        "name": f"child-{r}", "namespace": "ns-0",
+                        "ownerReferences": [{
+                            "kind": "ConfigMap", "name": f"owner-{r}",
+                            "uid": uid,
+                        }],
+                    },
+                })
+            except errors.ApiError:
+                pass
+
+        _run_threads([deleter, creator])
+    for r in range(rounds):
+        with pytest.raises(errors.NotFound):
+            kube.get("secrets", f"child-{r}", namespace="ns-0")
+    # watchers saw a DELETED for every child that was ever ADDED
+    added = dropped = 0
+    for ev in kube.watch("secrets", resource_version=0, timeout=0.1):
+        if ev["type"] == "ADDED":
+            added += 1
+        elif ev["type"] == "DELETED":
+            dropped += 1
+    assert added == dropped
+
+
+def test_cascade_respects_finalizers_and_finishes_on_clear():
+    kube = FakeKube()
+    nb = kube.create("configmaps", _cm("own", "ns-0"))
+    kube.create("secrets", {
+        "metadata": {
+            "name": "kid", "namespace": "ns-0",
+            "finalizers": ["tpukf.dev/cleanup"],
+            "ownerReferences": [{"kind": "ConfigMap", "name": "own",
+                                 "uid": nb["metadata"]["uid"]}],
+        },
+    })
+    kube.delete("configmaps", "own", namespace="ns-0")
+    kid = kube.get("secrets", "kid", namespace="ns-0")
+    assert kid["metadata"]["deletionTimestamp"], (
+        "cascade must stamp, not force-remove, a finalized child"
+    )
+    kid["metadata"]["finalizers"] = []
+    kube.update("secrets", kid)
+    with pytest.raises(errors.NotFound):
+        kube.get("secrets", "kid", namespace="ns-0")
+
+
+def test_adopted_child_is_cascaded():
+    """ownerReferences patched in AFTER create (adoption) must still
+    cascade — the owner index follows updates, not just creates."""
+    kube = FakeKube()
+    nb = kube.create("configmaps", _cm("adoptive", "ns-0"))
+    kube.create("secrets", {"metadata": {"name": "found", "namespace":
+                                         "ns-0"}})
+    kube.patch("secrets", "found", {
+        "metadata": {"ownerReferences": [{
+            "kind": "ConfigMap", "name": "adoptive",
+            "uid": nb["metadata"]["uid"],
+        }]},
+    }, namespace="ns-0")
+    kube.delete("configmaps", "adoptive", namespace="ns-0")
+    with pytest.raises(errors.NotFound):
+        kube.get("secrets", "found", namespace="ns-0")
+
+
+# ------------------------------------------------- MVCC read snapshots
+
+
+def test_reads_are_immutable_snapshots():
+    """A LIST taken before a burst of writes keeps its pre-burst view
+    (MVCC: stored objects are immutable once written), and mutating a
+    GET/LIST result never leaks into the store."""
+    kube = FakeKube()
+    kube.create("configmaps", _cm("snap", "ns-0", {"v": "0"}))
+    before = kube.list("configmaps", namespace="ns-0")["items"][0]
+    got = kube.get("configmaps", "snap", namespace="ns-0")
+    for i in range(1, 4):
+        cur = kube.get("configmaps", "snap", namespace="ns-0")
+        cur["data"]["v"] = str(i)
+        kube.update("configmaps", cur)
+    assert before["data"]["v"] == "0"
+    got["data"]["v"] = "tampered"
+    assert kube.get("configmaps", "snap",
+                    namespace="ns-0")["data"]["v"] == "3"
+
+
+def test_cluster_wide_list_is_exact_cut():
+    """A cluster-wide LIST's envelope RV can never be ahead of a
+    missing event: a watch from the returned RV plus the listed items
+    reconstructs every object that exists afterwards (the informer's
+    list+watch contract, hammered across stripes)."""
+    kube = FakeKube()
+    per_writer = 150   # bounded: stay well inside the 4096-event
+    # history window so the list-RV watch below can never 410
+
+    def writer(w):
+        for i in range(per_writer):
+            kube.create("configmaps",
+                        _cm(f"w{w}-{i}", NS[i % len(NS)]))
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(10):
+            listing = kube.list("configmaps")
+            rv = int(listing["metadata"]["resourceVersion"])
+            seen = {(o["metadata"]["namespace"], o["metadata"]["name"])
+                    for o in listing["items"]}
+            # nothing with an RV at or below the envelope may be missing
+            for ev in kube.watch("configmaps", resource_version=rv,
+                                 timeout=0.05):
+                meta = ev["object"]["metadata"]
+                assert int(meta["resourceVersion"]) > rv
+                seen.add((meta["namespace"], meta["name"]))
+            now = {(o["metadata"]["namespace"], o["metadata"]["name"])
+                   for o in kube.list("configmaps")["items"]}
+            missing = now - seen
+            assert not missing, missing
+    finally:
+        for t in threads:
+            t.join()
+
+
+def test_stats_isolated_from_store_stripes_and_exact_at_rest():
+    """Request tallies ride per-thread cells (no shared lock on the
+    request hot path — a per-request stats lock was itself the top
+    contended site at 10k-CR scale): snapshots under live write load
+    are monotonic and never crash, and once writers quiesce the counts
+    are exact, per verb and per client."""
+    kube = FakeKube()
+    stop = threading.Event()
+    wrote = [0, 0]
+
+    def writer(w):
+        client = kube.client_for(f"stats-{w}")
+        i = 0
+        while not stop.is_set() and i < 2000:
+            client.create("configmaps", _cm(f"s-{w}-{i}", "ns-0"))
+            i += 1
+        wrote[w] = i
+
+    threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+               for w in range(2)]
+    for t in threads:
+        t.start()
+    last = 0
+    try:
+        for _ in range(100):
+            snap = kube.request_counts_snapshot()
+            creates = snap.get("create", 0)
+            assert creates >= last, "snapshots must be monotonic"
+            last = creates
+            kube.request_counts_snapshot(by_client=True)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    snap = kube.request_counts_snapshot()
+    by = kube.request_counts_snapshot(by_client=True)
+    assert snap["create"] == sum(wrote)
+    for w in range(2):
+        assert by[f"stats-{w}"]["create"] == wrote[w]
+    # the compat attribute surfaces stay live
+    assert kube.request_counts["create"] == sum(wrote)
+    assert kube.request_counts_by_client[f"stats-0"]["create"] == wrote[0]
+
+
+def test_cluster_list_survives_fresh_namespace_creation():
+    """Regression: cluster-wide LIST iterates a family's stripes while
+    create() inserts brand-new namespace stripes lock-free (setdefault)
+    — the snapshot must materialize atomically, not crash with
+    'dictionary changed size during iteration'."""
+    kube = FakeKube()
+    errors_seen: list[BaseException] = []
+    stop = threading.Event()
+
+    def lister():
+        try:
+            while not stop.is_set():
+                kube.list("configmaps")
+        except BaseException as e:  # noqa: BLE001 — the regression
+            errors_seen.append(e)
+
+    listers = [threading.Thread(target=lister, daemon=True)
+               for _ in range(3)]
+    for t in listers:
+        t.start()
+    try:
+        for i in range(300):   # every create = a fresh stripe insert
+            kube.create("configmaps", _cm("c", f"fresh-ns-{i}"))
+    finally:
+        stop.set()
+        for t in listers:
+            t.join()
+    assert not errors_seen, errors_seen[0]
+
+
+def test_orphan_gc_never_deletes_a_recreated_successor():
+    """The deferred orphan removal is identity-guarded: if the orphan
+    was already deleted and the name recreated with a live owner before
+    the deferred action runs, the successor must survive."""
+    kube = FakeKube()
+    owner = kube.create("configmaps", _cm("own2", "ns-0"))
+    kube.delete("configmaps", "own2", namespace="ns-0")
+    # direct white-box: simulate the deferred window by invoking the
+    # exact deferred action against a successor object
+    res = kube._res("secrets")
+    orphan_like = {"metadata": {"name": "kid2", "namespace": "ns-0"}}
+    kube.create("secrets", orphan_like)
+    successor = kube.get("secrets", "kid2", namespace="ns-0")
+    # a stale deferred removal carrying a DIFFERENT object identity
+    # must not touch the current occupant
+    stale = dict(successor)
+    assert kube._remove(res, ("", "secrets", "ns-0", "kid2"),
+                        expect=stale) is None
+    assert kube.get("secrets", "kid2", namespace="ns-0")
+
+
+def test_disowned_child_survives_owner_cascade():
+    """Removing ownerReferences before the owner dies must spare the
+    child — both via the index (sequential) and via the cascade's
+    object-truth re-check (the index entry is a hint, the immutable
+    stored object decides)."""
+    kube = FakeKube()
+    owner = kube.create("configmaps", _cm("own3", "ns-0"))
+    kube.create("secrets", {
+        "metadata": {"name": "freed", "namespace": "ns-0",
+                     "ownerReferences": [{"kind": "ConfigMap",
+                                          "name": "own3",
+                                          "uid": owner["metadata"]["uid"]}]},
+    })
+    kube.patch("secrets", "freed",
+               {"metadata": {"ownerReferences": []}}, namespace="ns-0")
+    kube.delete("configmaps", "own3", namespace="ns-0")
+    assert kube.get("secrets", "freed", namespace="ns-0")
+
+
+def test_adoption_by_dead_owner_is_collected():
+    """Patching in ownerReferences whose owners are ALL dead collects
+    the object like the create-path orphan check would — the window
+    where an adoption races the owner's cascade can never leak a live
+    child of a dead owner."""
+    kube = FakeKube()
+    owner = kube.create("configmaps", _cm("own4", "ns-0"))
+    uid = owner["metadata"]["uid"]
+    kube.create("secrets", {"metadata": {"name": "late", "namespace":
+                                         "ns-0"}})
+    kube.delete("configmaps", "own4", namespace="ns-0")
+    kube.patch("secrets", "late", {
+        "metadata": {"ownerReferences": [{"kind": "ConfigMap",
+                                          "name": "own4", "uid": uid}]},
+    }, namespace="ns-0")
+    with pytest.raises(errors.NotFound):
+        kube.get("secrets", "late", namespace="ns-0")
+
+
+def test_ttl_sweep_spares_a_concurrently_refreshed_event():
+    """The TTL sweep's removal is identity-guarded: an Event refreshed
+    after the doomed-snapshot commits a NEW object and must survive
+    (white-box: drive the guard with the stale identity directly)."""
+    kube = FakeKube()
+    kube.event_ttl_s = 3600
+    kube.create("events", {
+        "metadata": {"name": "ev.1", "namespace": "u1"},
+        "involvedObject": {"kind": "Notebook", "name": "nb"},
+        "type": "Normal", "reason": "Old", "message": "m", "count": 1,
+        "firstTimestamp": "2000-01-01T00:00:00Z",
+        "lastTimestamp": "2000-01-01T00:00:00Z",
+    }, namespace="u1")
+    res = kube._res("events")
+    stale = kube.get("events", "ev.1", namespace="u1")
+    # the refresh commits a new object between snapshot and removal
+    kube.patch("events", "ev.1", {"count": 2,
+                                  "lastTimestamp": "2030-01-01T00:00:00Z"},
+               namespace="u1")
+    assert kube._remove(res, ("", "events", "u1", "ev.1"),
+                        expect=stale) is None
+    assert kube.get("events", "ev.1", namespace="u1")["count"] == 2
+    # and the real sweep honors the fresh timestamp end-to-end
+    kube.compact_history()
+    assert kube.get("events", "ev.1", namespace="u1")
+
+
+def test_read_probes_do_not_allocate_stripes():
+    """GET/LIST/DELETE of never-seen namespaces answer NotFound/empty
+    without permanently allocating store stripes (a chatty prober must
+    not grow the fake without bound)."""
+    kube = FakeKube()
+    kube.create("configmaps", _cm("real", "ns-0"))
+    fam = kube._families[("", "configmaps")]
+    before = len(fam.stripes)
+    for i in range(50):
+        with pytest.raises(errors.NotFound):
+            kube.get("configmaps", "x", namespace=f"probe-{i}")
+        assert kube.list("configmaps",
+                         namespace=f"probe-{i}")["items"] == []
+        with pytest.raises(errors.NotFound):
+            kube.delete("configmaps", "x", namespace=f"probe-{i}")
+    assert len(fam.stripes) == before
+
+
+def test_racing_disown_and_readopt_index_stays_commit_ordered():
+    """Two writers racing disown/re-adopt commits on the same key: the
+    owner index applies in commit order (it updates under the family
+    event lock), so after the owner dies no surviving child may still
+    reference the dead uid — whichever write landed last decides, and
+    a referencing child is always collected."""
+    kube = FakeKube()
+    for r in range(30):
+        owner = kube.create("configmaps", _cm(f"race-own-{r}", "ns-0"))
+        uid = owner["metadata"]["uid"]
+        ref = [{"kind": "ConfigMap", "name": f"race-own-{r}", "uid": uid}]
+        kube.create("secrets", {"metadata": {
+            "name": f"race-kid-{r}", "namespace": "ns-0",
+            "ownerReferences": ref}})
+        barrier = threading.Barrier(2)
+
+        def disown():
+            barrier.wait()
+            try:
+                kube.patch("secrets", f"race-kid-{r}",
+                           {"metadata": {"ownerReferences": []}},
+                           namespace="ns-0")
+            except errors.ApiError:
+                pass
+
+        def readopt():
+            barrier.wait()
+            try:
+                kube.patch("secrets", f"race-kid-{r}",
+                           {"metadata": {"ownerReferences": ref}},
+                           namespace="ns-0")
+            except errors.ApiError:
+                pass
+
+        _run_threads([disown, readopt])
+        kube.delete("configmaps", f"race-own-{r}", namespace="ns-0")
+        try:
+            kid = kube.get("secrets", f"race-kid-{r}", namespace="ns-0")
+        except errors.NotFound:
+            continue   # collected: fine either way
+        refs = kid["metadata"].get("ownerReferences") or []
+        assert not any(x.get("uid") == uid for x in refs), (
+            "a child still referencing the dead owner survived — the "
+            "owner index missed a commit (ordering race)"
+        )
