@@ -276,19 +276,35 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "--store-lock-max-share 0.25"},
             # chaos smoke: the fault-injection family (cpbench/chaos.py)
             # — apiserver blackout, 410 Gone storms, node death, kubelet
-            # stall — then the invariant gate: 0 double bookings, 0
-            # orphaned children, recovery-time percentiles present
+            # stall, 429 throttle storms — then the invariant gate: 0
+            # double bookings, 0 orphaned children, recovery-time
+            # percentiles present
             {"name": "Run cpbench chaos --smoke",
              "run": "python -m service_account_auth_improvements_tpu."
                     "controlplane.cpbench --smoke "
                     "--scenario chaos_relist --scenario chaos_blackout "
                     "--scenario chaos_node_death "
                     "--scenario chaos_kubelet_stall "
+                    "--scenario chaos_429_storm "
                     "--out chaos_out.json --dump-dir bench_out"},
             {"name": "Chaos invariant gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
                     "--run chaos_out.json --chaos-only --slo-report"},
+            # HA smoke: the sharded-plane family (cpbench/ha.py) —
+            # replica sweep, leader-kill failover, APF A/B — then the
+            # failover gate: failover p95 within SLO, 0 dual reconciles
+            # / 0 orphaned keys through the handoff, protected lane's
+            # p95 held while the storm is squeezed (docs/ha.md)
+            {"name": "Run cpbench HA --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke "
+                    "--scenario ha_scale --scenario ha_failover "
+                    "--scenario ha_apf "
+                    "--out ha_out.json --dump-dir bench_out"},
+            {"name": "Failover + APF gate",
+             "run": "python tools/bench_gate.py "
+                    "--run ha_out.json --failover --slo-report"},
             # always(): when a gate fails, the JSON records ARE the
             # evidence — dropping them with the runner would force a
             # full local re-run just to see which leg tripped
@@ -297,6 +313,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
                       "path": "bench_out.json\nchaos_out.json\n"
+                              "ha_out.json\n"
                               "cplint_report.json\nbench_out/"}},
         ])},
     ),

@@ -37,10 +37,26 @@ def run_manager(register, argv=None, add_args=None) -> int:
                         help="reconcile workers per controller")
     parser.add_argument("--leader-elect", action="store_true",
                         help="enable Lease-based leader election "
-                             "(reference main.go:68 enable-leader-election)")
+                             "(reference main.go:68 enable-leader-election)"
+                             " — ACTIVE-PASSIVE HA: one replica works, "
+                             "the rest stand by")
     parser.add_argument("--leader-elect-name", default=None,
                         help="lease name (default: derived from the binary)")
     parser.add_argument("--leader-elect-namespace", default="kubeflow")
+    parser.add_argument("--shard", action="store_true",
+                        help="ACTIVE-ACTIVE HA (docs/ha.md): run as one "
+                             "replica of a sharded plane — every replica "
+                             "reconciles its own slice of the key space "
+                             "(engine/shard.py). Mutually exclusive with "
+                             "--leader-elect by construction: sharding IS "
+                             "the multi-writer safety story")
+    parser.add_argument("--shard-group", default=None,
+                        help="shard group name; replicas of one "
+                             "deployment share it (default: derived "
+                             "from the binary)")
+    parser.add_argument("--shards", type=int, default=None,
+                        help="virtual shard count (default 64; must "
+                             "agree across replicas of a group)")
     if add_args:
         add_args(parser)
     args = parser.parse_args(argv)
@@ -89,6 +105,40 @@ def run_manager(register, argv=None, add_args=None) -> int:
         profiler=obs.PROFILER,
     )
 
+    if args.shard and args.leader_elect:
+        # silently preferring one would leave the operator believing
+        # the OTHER HA story is in force (single-writer vs sharded
+        # active-active are different safety arguments)
+        parser.error("--shard and --leader-elect are mutually "
+                     "exclusive: sharding IS the multi-writer safety "
+                     "story (docs/ha.md)")
+    shard_runtime = None
+    if args.shard:
+        import socket
+        import sys
+        import uuid
+
+        from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E501
+            DEFAULT_NUM_SHARDS,
+            ShardRuntime,
+        )
+
+        group = args.shard_group or (
+            "cpshard-" + (sys.argv[0].rsplit("/", 1)[-1]
+                          .removesuffix(".py").replace("_", "-"))
+        )
+        identity = f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        shard_runtime = ShardRuntime(
+            client, identity, group=group,
+            namespace=args.leader_elect_namespace,
+            num_shards=args.shards or DEFAULT_NUM_SHARDS,
+            journal=obs.JOURNAL,
+        )
+        manager.attach_shard(shard_runtime.member)
+        shard_runtime.start()
+        logging.getLogger(__name__).info(
+            "cpshard: replica %s joined group %s", identity, group)
+
     elector = None
     if args.leader_elect:
         import sys
@@ -125,6 +175,10 @@ def run_manager(register, argv=None, add_args=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     manager.stop()
+    if shard_runtime is not None:
+        # graceful leave: clears the member lease so the coordinator
+        # reassigns our shards now instead of after the expiry
+        shard_runtime.stop()
     if elector is not None:
         elector.release()
     return 0

@@ -28,6 +28,9 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.actuator import 
 from service_account_auth_improvements_tpu.controlplane.cpbench.chaos import (  # noqa: E501,F401 — importing registers the chaos family into SCENARIOS
     CHAOS_SCENARIOS,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.ha import (  # noqa: E501,F401 — importing registers the ha_scale family into SCENARIOS
+    HA_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
     SCENARIOS,
     BenchConfig,
@@ -54,6 +57,10 @@ SMOKE_N = {
     "chaos_blackout": 8,      # half healthy, half mid-outage
     "chaos_node_death": 4,    # 4 gangs, one pool dies under its gang
     "chaos_kubelet_stall": 8,
+    "chaos_429_storm": 8,     # 8 gangs drained through 429 pulses
+    "ha_scale": 120,          # CRs per replica arm (x3 arms: 1/2/4)
+    "ha_failover": 60,        # two waves around the leader kill
+    "ha_apf": 400,            # protected-lane requests per A/B arm
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -67,6 +74,12 @@ FULL_N = {
     "chaos_blackout": 16,
     "chaos_node_death": 6,
     "chaos_kubelet_stall": 16,
+    "chaos_429_storm": 16,
+    "ha_scale": 10_000,       # the ROADMAP scale: 10k CRs per arm, and
+                              # ~100k watch events across the 4-replica
+                              # arm's informers
+    "ha_failover": 2_000,
+    "ha_apf": 3_000,
 }
 
 
@@ -86,6 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="include the chaos scenario family (fault "
                          "injection + recovery invariants; "
                          "docs/chaos.md) in the run")
+    ap.add_argument("--ha", action="store_true",
+                    help="include the ha_scale family (sharded "
+                         "multi-replica plane: replica sweep, "
+                         "leader-kill failover, APF A/B; docs/ha.md) "
+                         "in the run")
     ap.add_argument("--profile", action="store_true",
                     help="cpprof: sample hot stacks + lock contention + "
                          "saturation per scenario into extra.prof, and "
@@ -250,10 +268,13 @@ def run(args) -> dict:
     mode = "full" if args.full else "smoke"
     sizes = FULL_N if args.full else SMOKE_N
     # default run = the healthy family (the regression lane CI parses);
-    # --chaos folds the fault-injection family in; --scenario overrides
+    # --chaos folds the fault-injection family in, --ha the sharded-
+    # plane family (both arm-sweep benches, not latency-lane members);
+    # --scenario overrides
     wanted = args.scenario or sorted(
         name for name in SCENARIOS
-        if args.chaos or name not in CHAOS_SCENARIOS
+        if (args.chaos or name not in CHAOS_SCENARIOS)
+        and (getattr(args, "ha", False) or name not in HA_SCENARIOS)
     )
     started = time.monotonic()
     report: dict = {

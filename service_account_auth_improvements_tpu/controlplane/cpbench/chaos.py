@@ -674,11 +674,158 @@ def _run_chaos_kubelet_stall(cfg, world, chaos, rec,
     })
 
 
+def scenario_chaos_429_storm(cfg: BenchConfig) -> ScenarioResult:
+    """Apiserver flow control squeezing the CONTROLLERS mid-drain — the
+    429-storm injector the PR 6 chaos item promised (kube/chaos.py
+    ``storm_429``). Pulses of sustained 429 + Retry-After hit every
+    control-plane flow (the manager's informer traffic and each
+    reconciler's actor-attributed requests) while a tpusched drain is
+    in flight; the kubelet and the bench's own lanes keep their seats.
+    Invariants: every controller retries THROUGH the throttling without
+    losing a booking — 0 double-booked pools at any tick, 0 orphans,
+    the drain completes — and each pulse's recovery (storm end → next
+    notebook Ready) is timed."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "chaos_429_storm", scheduler=True)
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
+    rec = RecoveryTracker()
+    ns = "bench"
+    pools = max(2, cfg.n // 4)
+    for p in range(pools):
+        _mk_pool(world.kube, f"storm429-pool-{p}")
+    live: dict = {}
+    try:
+        return _run_chaos_429_storm(cfg, world, chaos, rec, ns, pools,
+                                    started, live)
+    finally:
+        if live.get("schedule") is not None:
+            live["schedule"].stop()
+        chaos.end_storm_429()
+        world.stop()
+
+
+def _run_chaos_429_storm(cfg, world, chaos, rec, ns, pools, started,
+                         live) -> ScenarioResult:
+    world.start()
+    #: who gets squeezed: the manager's own traffic and every
+    #: reconcile-actor flow — NOT the kubelet ("the kubelet keeps its
+    #: lane") and not the bench's poll client
+    squeezed = ("manager", "*Reconciler")
+    window_s = max(0.8, cfg.chaos_stall_s / 2)
+    pulse_marks: list[float] = []
+    pulse_pending: list[int] = []
+
+    def pulse():
+        pending = sum(1 for r in world.tracker.records()
+                      if r.ready is None)
+        pulse_pending.append(pending)
+        chaos.storm_429(clients=squeezed, duration_s=window_s,
+                        rate=1.0, retry_after=1)
+        pulse_marks.append(time.monotonic() + window_s)  # pulse END
+
+    want_pulses = max(1, cfg.chaos_pulses - 1)
+    steps = []
+    for i in range(want_pulses):
+        steps.append((0.15 + i * (window_s + 1.0), f"storm429-{i}",
+                      pulse))
+    schedule = live["schedule"] = ChaosSchedule(steps).start()
+
+    gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+    tpu = {"generation": "v5e", "topology": "4x4"}
+    all_names: list[str] = []
+    wave = 0
+
+    def create_wave():
+        nonlocal wave
+        names = [f"thr-w{wave}-{i:03d}" for i in range(cfg.n)]
+        wave += 1
+        all_names.extend(names)
+        gen.run(world.create_jobs(names, ns, tpu, want_ready=4))
+
+    create_wave()
+    deleted: set[str] = set()
+    double_bookings = 0
+    deadline = time.monotonic() + cfg.timeout \
+        + want_pulses * (window_s + 1.0)
+    while time.monotonic() < deadline:
+        drained = len(deleted) == len(all_names)
+        pulses_over = (len(pulse_marks) >= want_pulses
+                       and time.monotonic() > pulse_marks[-1] + 0.1)
+        if drained and pulses_over:
+            break
+        if drained:
+            # the drain outran the storm schedule: top up with another
+            # wave so every pulse throttles controllers doing REAL work
+            # — a pulse fired into an idle plane proves nothing
+            create_wave()
+        snapshot = world.cached.list("notebooks", namespace=ns,
+                                     group=GROUP)["items"]
+        live_nbs = [nb for nb in snapshot
+                    if nb["metadata"]["name"] not in deleted]
+        double_bookings += sum(
+            1 for m in _pool_bookings(live_nbs).values() if len(m) > 1)
+        for nb in live_nbs:
+            r = world.tracker.record(ns, nb["metadata"]["name"])
+            if r is not None and r.ready is not None:
+                name = nb["metadata"]["name"]
+                try:
+                    world.kube.delete("notebooks", name, namespace=ns,
+                                      group=GROUP)
+                except errors.NotFound:
+                    pass
+                deleted.add(name)
+        time.sleep(0.02)
+    schedule.stop()
+    chaos.end_storm_429()
+    ok = len(deleted) == len(all_names) \
+        and len(pulse_marks) >= want_pulses
+    # recovery per pulse: storm end → the next notebook turning Ready
+    # (throttled controllers resumed converging work)
+    readies = sorted(r.ready for r in world.tracker.records()
+                     if r.ready is not None)
+    for end_mark in pulse_marks:
+        after = [t for t in readies if t > end_mark]
+        if after:
+            rec.note_recovery("post_storm_ready",
+                              (after[0] - end_mark) * 1000.0)
+    if double_bookings:
+        rec.violation("double_booking", double_bookings)
+    if pulse_marks and not any(pulse_pending):
+        # every pulse fired into an already-drained world: the scenario
+        # throttled nobody doing real work — that is not evidence
+        rec.violation("storm_missed_work")
+    throttled_by_client = {
+        c: v.get("429", 0)
+        for c, v in world.kube.request_counts_snapshot(
+            by_client=True).items()
+        if v.get("429")
+    }
+    if not throttled_by_client:
+        rec.violation("storm_never_throttled")
+    if throttled_by_client.get("kubelet") or \
+            throttled_by_client.get("cpbench"):
+        # the protected lanes must keep their seats: a throttled
+        # kubelet/bench client means the squeeze hit the wrong flows
+        rec.violation("protected_lane_throttled")
+    return _chaos_result(world, cfg, started, ok, rec, chaos, {
+        "pools": pools,
+        "pulses": len(pulse_marks),
+        "pulse_window_s": window_s,
+        "pulse_pending": pulse_pending,
+        "squeezed_clients": list(squeezed),
+        "double_bookings": double_bookings,
+        "drained": len(deleted),
+        "throttled_by_client": throttled_by_client,
+    }, schedule=schedule)
+
+
 CHAOS_SCENARIOS = {
     "chaos_relist": scenario_chaos_relist,
     "chaos_blackout": scenario_chaos_blackout,
     "chaos_node_death": scenario_chaos_node_death,
     "chaos_kubelet_stall": scenario_chaos_kubelet_stall,
+    "chaos_429_storm": scenario_chaos_429_storm,
 }
 
 # the family registers into the shared scenario table (run_scenario and

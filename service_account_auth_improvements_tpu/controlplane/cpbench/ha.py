@@ -1,0 +1,760 @@
+"""cpbench ``ha_scale`` family: the sharded control plane, measured.
+
+Three scenarios prove (and gate) the two halves of the HA work — see
+docs/ha.md for the protocol and tools/bench_gate.py ``--failover`` for
+the CI legs:
+
+``ha_scale``     1/2/4-replica sweep over one FakeKube: N sharded
+                 Manager replicas (engine/shard.py) reconcile the same
+                 CR population, each owning a disjoint key space.
+                 Reports create→Ready tail latency and per-replica
+                 reconcile throughput per arm, plus the two invariants
+                 every arm must hold — 0 dual reconciles (the ledger
+                 wraps every replica's reconcile and records overlap),
+                 0 orphaned keys (every CR reaches Ready). At ``--full``
+                 this is the ROADMAP's 10k-CR / 100k-watch-event scale:
+                 the 4-replica arm alone delivers ~100k watch events
+                 across its informers.
+``ha_failover``  leader-kill mid-drain: the replica holding the
+                 coordinator Lease is killed (leases abandoned, not
+                 released) while half the population is still being
+                 created. The orphaned shards must be re-covered and
+                 their keys reconciled within the ``failover`` SLO
+                 (obs/slo.py) — per-CR create→Ready-through-the-kill
+                 samples feed its p95 — with 0 dual reconciles through
+                 the handoff and 0 orphaned keys.
+``ha_apf``       the priority-and-fairness A/B (kube/apf.py): a
+                 storming client with and without flow schemas, beside
+                 a protected kubelet lane and a live watch consumer.
+                 With APF on, the protected lane's p95 must hold
+                 (±20% of its no-storm baseline) while the storming
+                 client's throughput is measurably squeezed (429s with
+                 Retry-After, honored).
+
+The reconciler here is deliberately minimal (observe → stamp status
+Ready): the system under test is the CONTROL PLANE's scale-out —
+shard routing, handoff, informer fan-in, queue throughput — not the
+notebook lifecycle, which every other scenario already measures.
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
+    GROUP,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (  # noqa: E501
+    LoadGenerator,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
+    SCENARIOS,
+    BenchConfig,
+    ScenarioResult,
+    _nb,
+    by_client_delta,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: E501
+    Tracker,
+    percentiles,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Informer,
+    Manager,
+    Reconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.shard import (
+    DEFAULT_NUM_SHARDS,
+    ShardRuntime,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.apf import (
+    APF,
+    FlowSchema,
+    PriorityLevel,
+)
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    Journal,
+    Tracer,
+)
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    slo as slo_mod,
+)
+
+#: shard-protocol timings for the bench worlds: short leases so the
+#: failover arm measures the protocol, not a 15 s production expiry —
+#: the SLO target stays the production ceiling either way
+HA_LEASE_S = 1.0
+HA_TICK_S = 0.1
+
+#: the APF verdict thresholds have ONE definition — the gate's
+#: (tools/bench_gate.py, stdlib-only so the import is cheap): the
+#: scenario's recorded protected_held/ok and the CI leg judging the
+#: same record must never disagree
+from tools.bench_gate import (  # noqa: E402  (after the module docstring block above)
+    APF_PROTECTED_FLOOR_MS,
+    APF_PROTECTED_MAX_RATIO,
+    APF_STORM_MAX_RATIO,
+)
+
+
+def _wait_timeout(cfg: BenchConfig) -> float:
+    """Ready-wait deadline scaled to population: --full drives 10k CRs
+    through a GIL'd plane — a flat 30 s would time out the healthy
+    path it is trying to measure."""
+    return cfg.timeout + cfg.n * 0.01
+
+
+class _Ledger:
+    """The dual-reconcile detector: wraps every replica's reconcile so
+    any moment where two replicas run the SAME key concurrently is
+    recorded as a violation — the invariant the shard handoff protocol
+    exists to hold. Also the per-replica throughput ledger."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: dict[tuple, str] = {}
+        self.violations: list[tuple] = []
+        self.counts: dict[str, int] = {}
+
+    def wrap(self, reconciler, replica: str) -> None:
+        orig = reconciler.reconcile
+
+        def wrapped(req):
+            key = (req.namespace or "", req.name)
+            with self._lock:
+                other = self._inflight.get(key)
+                if other is not None and other != replica:
+                    self.violations.append((key, other, replica))
+                self._inflight[key] = replica
+                self.counts[replica] = self.counts.get(replica, 0) + 1
+            try:
+                return orig(req)
+            finally:
+                with self._lock:
+                    if self._inflight.get(key) == replica:
+                        del self._inflight[key]
+
+        reconciler.reconcile = wrapped
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"violations": list(self.violations),
+                    "counts": dict(self.counts)}
+
+
+class _HAReconciler(Reconciler):
+    """Minimal level-triggered reconciler: cached read, stamp status
+    Ready exactly once. Conflicts raise into the worker's backoff (the
+    production retry path); a deleted key is not an error."""
+
+    resource = "notebooks"
+    group = GROUP
+
+    def __init__(self, client, cached):
+        self.client = client
+        self.cached = cached
+
+    def reconcile(self, request):
+        try:
+            obj = self.cached.get("notebooks", request.name,
+                                  namespace=request.namespace,
+                                  group=GROUP)
+        except errors.NotFound:
+            return None
+        if (obj.get("status") or {}).get("readyReplicas"):
+            return None
+        obj = copy.deepcopy(obj)
+        obj["status"] = {"readyReplicas": 1}
+        try:
+            self.client.update_status("notebooks", obj)
+        except errors.NotFound:
+            return None
+        return None
+
+
+class _HAReplica:
+    """One Manager replica of the sharded plane: tagged client, its own
+    tracer (journal shared with the world), a ShardRuntime attached to
+    the Manager, and a per-replica reconciler class so apiserver
+    attribution and engine metrics split by replica."""
+
+    def __init__(self, kube, idx: int, world: "_HAWorld"):
+        self.identity = f"r{idx}"
+        self.client = kube.client_for(f"manager-{self.identity}")
+        self.trace = Tracer(max_traces=256)
+        world.journal.attach(self.trace)
+        self.mgr = Manager(self.client, tracer=self.trace,
+                           default_workers=2)
+        self.runtime = ShardRuntime(
+            kube.client_for(f"shard-{self.identity}"),
+            identity=self.identity, group=world.group,
+            num_shards=world.num_shards,
+            lease_duration=world.lease_s, tick_period=world.tick_s,
+            journal=world.journal,
+        )
+        self.mgr.attach_shard(self.runtime.member)
+        rec_cls = type(f"HARec_{self.identity}", (_HAReconciler,), {})
+        self.rec = rec_cls(self.client, self.mgr.cached_client())
+        world.ledger.wrap(self.rec, self.identity)
+        self.mgr.add_reconciler(self.rec)
+        # watch-event delivery ledger: one int cell per informer — each
+        # informer dispatches from its own single thread, so a plain
+        # increment is race-free and costs nothing
+        self.delivered = [0]
+
+        def count(ev_type, obj, _cell=self.delivered):
+            _cell[0] += 1
+
+        self.mgr.informer("notebooks", GROUP).add_handler(count)
+
+    def start(self) -> None:
+        self.runtime.start()
+        self.mgr.start()
+
+    def stop(self) -> None:
+        self.mgr.stop()
+        self.runtime.stop()
+
+    def kill(self) -> None:
+        """Crash: workers/informers stop, every Lease is abandoned
+        un-cleared — successors must wait out the expiry (what the
+        failover arm times)."""
+        self.mgr.stop()
+        self.runtime.kill()
+
+
+class _HAWorld:
+    """One FakeKube + N sharded replicas + a ready-watch, for one arm."""
+
+    def __init__(self, cfg: BenchConfig, tracker: Tracker, replicas: int,
+                 num_shards: int = DEFAULT_NUM_SHARDS,
+                 lease_s: float = HA_LEASE_S, tick_s: float = HA_TICK_S):
+        self.kube = FakeKube()
+        self.kube.default_client_id = "cpbench"
+        self.group = "ha"
+        self.num_shards = num_shards
+        self.lease_s = lease_s
+        self.tick_s = tick_s
+        self.tracker = tracker
+        self.journal = Journal()
+        self.ledger = _Ledger()
+        self.replicas = [_HAReplica(self.kube, i, self)
+                         for i in range(replicas)]
+        self._ready_delivered = [0]
+        self._ready_inf = Informer(self.kube.client_for("cpbench"),
+                                   "notebooks", group=GROUP)
+        self._ready_inf.add_handler(self._on_notebook)
+
+    def _on_notebook(self, ev_type: str, nb: dict) -> None:
+        self._ready_delivered[0] += 1
+        if ev_type == "DELETED":
+            return
+        if (nb.get("status") or {}).get("readyReplicas"):
+            meta = nb["metadata"]
+            self.tracker.note_ready(meta.get("namespace"), meta["name"])
+
+    def start(self) -> None:
+        for r in self.replicas:
+            r.start()
+        self._ready_inf.start()
+        self._ready_inf.wait_for_sync(10)
+
+    def stop(self) -> None:
+        self._ready_inf.stop()
+        for r in self.replicas:
+            r.stop()
+
+    def live_replicas(self) -> list["_HAReplica"]:
+        return [r for r in self.replicas
+                if not r.runtime.member._stop.is_set()]
+
+    def wait_covered(self, timeout: float = 10.0) -> bool:
+        """Block until the live replicas' ACTIVE shards cover the whole
+        space disjointly — the arm's steady state; creating load before
+        it would measure coordination latency, which ha_failover times
+        deliberately instead."""
+        every = set(range(self.num_shards))
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            owned = [r.runtime.member.active_shards()
+                     for r in self.live_replicas()]
+            union: set = set()
+            total = 0
+            for shards in owned:
+                union |= shards
+                total += len(shards)
+            if union == every and total == len(every):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def watch_events_delivered(self) -> int:
+        return sum(r.delivered[0] for r in self.replicas) \
+            + self._ready_delivered[0]
+
+    def create_jobs(self, names_ns: list[tuple[str, str]]):
+        def job(ns, name):
+            def run():
+                self.tracker.expect(ns, name)
+                self.kube.create("notebooks", _nb(name, ns, None))
+            return run
+
+        return [job(ns, name) for ns, name in names_ns]
+
+
+def _spread(names: list[str]) -> list[tuple[str, str]]:
+    """(namespace, name) pairs across 8 namespaces — the shard hash
+    covers both, and multiple namespaces keep the fake striped."""
+    return [(f"ha-{i % 8}", n) for i, n in enumerate(names)]
+
+
+def _arm_samples(tracker: Tracker, pairs) -> list[float]:
+    out = []
+    for ns, name in pairs:
+        rec = tracker.record(ns, name)
+        if rec is not None:
+            ms = rec.phase_ms().get("create_to_ready")
+            if ms is not None:
+                out.append(ms)
+    return out
+
+
+def scenario_ha_scale(cfg: BenchConfig) -> ScenarioResult:
+    """The replica sweep: same population, 1/2/4 sharded replicas."""
+    started = time.monotonic()
+    tracker = Tracker("ha_scale")
+    sweep: dict[str, dict] = {}
+    all_samples: list[float] = []
+    dual_total = orphaned_total = delivered_total = 0
+    ok = True
+    for replicas in (1, 2, 4):
+        world = _HAWorld(cfg, tracker, replicas)
+        try:
+            world.start()
+            covered = world.wait_covered(15)
+            pairs = _spread([f"ha{replicas}-{i:05d}"
+                             for i in range(cfg.n)])
+            t0 = time.monotonic()
+            LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+                world.create_jobs(pairs)
+            )
+            arm_ok = tracker.wait_ready(pairs, _wait_timeout(cfg))
+            elapsed = time.monotonic() - t0
+            led = world.ledger.snapshot()
+            samples = _arm_samples(tracker, pairs)
+            all_samples.extend(samples)
+            orphaned = len(pairs) - sum(
+                1 for ns, n in pairs
+                if (r := tracker.record(ns, n)) is not None
+                and r.ready is not None
+            )
+            delivered = world.watch_events_delivered()
+            reconciles = sum(led["counts"].values())
+            sweep[str(replicas)] = {
+                "replicas": replicas,
+                "n": len(pairs),
+                "covered_before_load": covered,
+                "elapsed_s": round(elapsed, 3),
+                "create_to_ready_ms": percentiles(samples),
+                "reconciles_by_replica": led["counts"],
+                "reconciles_per_s": round(reconciles / elapsed, 1)
+                if elapsed else None,
+                "per_replica_throughput_rps": {
+                    r: round(c / elapsed, 1)
+                    for r, c in led["counts"].items()
+                } if elapsed else {},
+                "dual_reconciles": len(led["violations"]),
+                "orphaned_keys": orphaned,
+                "watch_events_delivered": delivered,
+                "epochs": {r.identity: r.runtime.member.epoch
+                           for r in world.replicas},
+            }
+            dual_total += len(led["violations"])
+            orphaned_total += orphaned
+            delivered_total += delivered
+            ok = ok and arm_ok and covered \
+                and not led["violations"] and orphaned == 0
+        finally:
+            world.stop()
+    summary = tracker.summary()
+    summary["extra"] = {
+        "replica_sweep": sweep,
+        "num_shards": DEFAULT_NUM_SHARDS,
+        "dual_reconciles": dual_total,
+        "orphaned_keys": orphaned_total,
+        "watch_events_delivered": delivered_total,
+        "event_count": 0,
+        "journal": {},
+    }
+    summary["slo"] = slo_mod.report({"create_to_ready": all_samples})
+    return ScenarioResult(
+        name="ha_scale", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary, ok=ok,
+    )
+
+
+def scenario_ha_failover(cfg: BenchConfig) -> ScenarioResult:
+    """Leader-kill mid-drain: kill the coordinator-holding replica with
+    work outstanding; time until its orphaned shards' keys reconcile."""
+    started = time.monotonic()
+    tracker = Tracker("ha_failover")
+    world = _HAWorld(cfg, tracker, replicas=3)
+    try:
+        world.start()
+        covered = world.wait_covered(15)
+        gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+
+        wave1 = _spread([f"fo-a-{i:05d}" for i in range(cfg.n // 2)])
+        gen.run(world.create_jobs(wave1))
+        ok = tracker.wait_ready(wave1, _wait_timeout(cfg)) and covered
+
+        # the replica holding the coordinator Lease is the victim — the
+        # literal "leader-kill" arm
+        victim = None
+        deadline = time.monotonic() + 10
+        while victim is None and time.monotonic() < deadline:
+            for r in world.replicas:
+                if r.runtime.is_coordinator():
+                    victim = r
+                    break
+            time.sleep(0.02)
+        killed = victim.identity if victim is not None else None
+        t_kill = time.monotonic()
+        if victim is not None:
+            victim.kill()
+        # wave 2 lands INTO the failover window: the survivors own ~2/3
+        # of it immediately, the dead replica's third waits for the
+        # re-election + re-map + barrier + requeue — the tail the
+        # failover SLO bounds
+        wave2 = _spread([f"fo-b-{i:05d}"
+                         for i in range(cfg.n - len(wave1))])
+        gen.run(world.create_jobs(wave2))
+
+        survivors = [r for r in world.replicas if r is not victim]
+        elected_ms = recovered_ms = None
+        every = set(range(world.num_shards))
+        deadline = time.monotonic() + _wait_timeout(cfg)
+        while time.monotonic() < deadline:
+            if elected_ms is None and any(
+                    r.runtime.is_coordinator() for r in survivors):
+                elected_ms = round(
+                    (time.monotonic() - t_kill) * 1000.0, 1)
+            union: set = set()
+            for r in survivors:
+                union |= r.runtime.member.active_shards()
+            if union == every:
+                recovered_ms = round(
+                    (time.monotonic() - t_kill) * 1000.0, 1)
+                break
+            time.sleep(0.02)
+        ok = tracker.wait_ready(wave2, _wait_timeout(cfg)) and ok
+        failover_samples = [
+            (r.ready - t_kill) * 1000.0
+            for ns, n in wave2
+            if (r := tracker.record(ns, n)) is not None
+            and r.ready is not None and r.ready > t_kill
+        ]
+        led = world.ledger.snapshot()
+        orphaned = sum(
+            1 for ns, n in wave1 + wave2
+            if (r := tracker.record(ns, n)) is None or r.ready is None
+        )
+    finally:
+        world.stop()
+    summary = tracker.summary()
+    summary["extra"] = {
+        "replicas": 3,
+        "killed": killed,
+        "coordinator_elected_ms": elected_ms,
+        "shards_recovered_ms": recovered_ms,
+        "failover_ms": percentiles(failover_samples),
+        "dual_reconciles": len(led["violations"]),
+        "dual_reconcile_samples": led["violations"][:8],
+        "orphaned_keys": orphaned,
+        "reconciles_by_replica": led["counts"],
+        "watch_events_delivered": world.watch_events_delivered(),
+        "event_count": 0,
+        "journal": dict(world.journal.counts()),
+    }
+    summary["slo"] = slo_mod.report({
+        "create_to_ready": _arm_samples(tracker, wave1),
+        "failover": failover_samples,
+    })
+    ok = ok and killed is not None and recovered_ms is not None \
+        and not led["violations"] and orphaned == 0
+    return ScenarioResult(
+        name="ha_failover", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary, ok=ok,
+    )
+
+
+# ------------------------------------------------------------- APF A/B
+
+def _apf_engine() -> APF:
+    """The A/B's flow catalog: kubelet assured, watches in their own
+    lane, the bench's staging traffic bounded, leases exempt — and NO
+    schema for the storming client, which therefore lands in the small
+    catch-all level. That asymmetry is the design point: protection is
+    declared, storms are whatever is left."""
+    return APF(
+        levels=[
+            PriorityLevel("exempt", exempt=True),
+            PriorityLevel("node-critical", shares=40),
+            PriorityLevel("watch-lane", shares=10, queue_wait_s=0.1),
+            PriorityLevel("bench", shares=30),
+            # the catch-all is deliberately tight: a tiny share, a
+            # queue worth 5 ms of it — an unclassified storm burns its
+            # burst, then eats 429 + Retry-After (which its client
+            # honors, so the squeeze shows up as throughput, not CPU)
+            PriorityLevel("global-default", shares=2,
+                          queue_wait_s=0.005, burst_s=0.05),
+        ],
+        schemas=[
+            FlowSchema("system-leases", "exempt", plurals=("leases",)),
+            FlowSchema("kubelet", "node-critical",
+                       clients=("kubelet",)),
+            FlowSchema("watches", "watch-lane", verbs=("watch",)),
+            FlowSchema("bench", "bench", clients=("cpbench",)),
+        ],
+        total_rate=2000.0,
+        default_level="global-default",
+    )
+
+
+def _protected_loop(kube, n: int, names: list[str], ns: str) -> dict:
+    """The kubelet-lane workload: n paced read/status ops with per-op
+    latency. Returns latency percentiles + 429 count (must stay 0 — a
+    protected lane that gets throttled failed the whole point)."""
+    client = kube.client_for("kubelet")
+    lat_ms: list[float] = []
+    throttled = 0
+    for i in range(n):
+        name = names[i % len(names)]
+        t0 = time.monotonic()
+        try:
+            if i % 4 == 3:
+                obj = copy.deepcopy(
+                    client.get("notebooks", name, namespace=ns,
+                               group=GROUP))
+                obj["status"] = {"readyReplicas": 1, "beat": i}
+                client.update_status("notebooks", obj)
+            elif i % 16 == 8:
+                client.list("notebooks", namespace=ns, group=GROUP)
+            else:
+                client.get("notebooks", name, namespace=ns, group=GROUP)
+        except errors.TooManyRequests:
+            throttled += 1
+        except errors.ApiError:
+            pass
+        lat_ms.append((time.monotonic() - t0) * 1000.0)
+        time.sleep(0.002)   # a paced kubelet, not a tight loop
+    return {"latency_ms": percentiles(lat_ms), "throttled": throttled}
+
+
+def _storm(kube, stop: threading.Event, ns: str, seed: int,
+           honor_retry_after: bool = True) -> dict:
+    """One storming controller thread: tight create/patch loop,
+    retrying THROUGH 429s by honoring Retry-After (what every real
+    controller's backoff does — the squeeze works because the client
+    cooperates, and the throughput number shows the squeeze). Wake-ups
+    are jittered: four threads honoring the same integer Retry-After
+    would otherwise wake as a herd, and the herd's GIL blip — not any
+    apiserver behavior — would dominate the protected lane's p95."""
+    import random
+
+    rng = random.Random(seed)
+    client = kube.client_for("storm-ctl")
+    out = {"ops": 0, "throttled": 0}
+    i = 0
+    while not stop.is_set():
+        i += 1
+        name = f"storm-{threading.current_thread().name}-{i % 64}"
+        try:
+            try:
+                client.patch(
+                    "notebooks", name,
+                    {"metadata": {"annotations": {"storm/seq": str(i)}}},
+                    namespace=ns, group=GROUP)
+            except errors.NotFound:
+                client.create("notebooks", _nb(name, ns, None))
+            out["ops"] += 1
+        except errors.TooManyRequests as e:
+            out["throttled"] += 1
+            if honor_retry_after:
+                retry = min(float(e.retry_after or 1), 1.0)
+                stop.wait(retry * (0.75 + 0.5 * rng.random()))
+        except errors.ApiError:
+            pass
+    return out
+
+
+def _apf_arm(kube, cfg: BenchConfig, ns: str, names: list[str],
+             storm: bool) -> dict:
+    """One A/B arm: optional storm threads around the protected loop.
+    The storm warms in for 0.3 s first so the protected percentiles
+    measure SUSTAINED throttling, not the burst-bucket transient."""
+    stop = threading.Event()
+    results: list[dict] = []
+    threads = []
+    if storm:
+        # the storm works its OWN namespace: its object churn must not
+        # grow the protected lane's LIST — otherwise the protected p95
+        # measures store size, not flow control (measured: the
+        # every-16th-op LIST quintupled once storm CRs shared the ns)
+        storm_ns = f"{ns}-storm"
+
+        def run(idx):
+            results.append(_storm(kube, stop, storm_ns,
+                                  seed=cfg.seed + idx))
+
+        threads = [threading.Thread(target=run, args=(i,), name=f"s{i}",
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+    t0 = time.monotonic()
+    protected = _protected_loop(kube, cfg.n, names, ns)
+    elapsed = time.monotonic() - t0
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    storm_ops = sum(r["ops"] for r in results)
+    storm_429 = sum(r["throttled"] for r in results)
+    arm = {
+        "protected_p50_ms": (protected["latency_ms"] or {}).get("p50"),
+        "protected_p95_ms": (protected["latency_ms"] or {}).get("p95"),
+        "protected_throttled": protected["throttled"],
+        "elapsed_s": round(elapsed, 3),
+    }
+    if storm:
+        window = elapsed + 0.3
+        arm["storm_ops"] = storm_ops
+        arm["storm_ops_s"] = round(storm_ops / window, 1)
+        arm["storm_429s"] = storm_429
+    return arm
+
+
+def scenario_ha_apf(cfg: BenchConfig) -> ScenarioResult:
+    """The APF A/B: protected lane p95 must hold under a storm when
+    flow schemas are on; the storm must be measurably squeezed."""
+    started = time.monotonic()
+    tracker = Tracker("ha_apf")
+    kube = FakeKube()
+    kube.default_client_id = "cpbench"
+    ns = "apf"
+    names = [f"prot-{i}" for i in range(64)]
+    for name in names:
+        kube.create("notebooks", _nb(name, ns, None))
+    api_t0 = kube.request_counts_snapshot(by_client=True)
+
+    # live watch consumer for the whole scenario: the "watch lane keeps
+    # its seat" evidence — emit→receipt lag feeds the watch_delivery SLO
+    lag_ms: list[float] = []
+    stop_watch = threading.Event()
+
+    def consume():
+        rv = 0
+        while not stop_watch.is_set():
+            try:
+                for ev in kube.watch("notebooks", resource_version=rv,
+                                     group=GROUP, timeout=0.5):
+                    meta = (ev.get("object") or {}).get("metadata") or {}
+                    if meta.get("resourceVersion"):
+                        rv = int(meta["resourceVersion"])
+                    sent = ev.get("emittedAt")
+                    now = time.monotonic()
+                    if sent is not None and now >= sent:
+                        lag_ms.append((now - sent) * 1000.0)
+                    if stop_watch.is_set():
+                        return
+            except errors.ApiError:
+                stop_watch.wait(0.05)
+
+    watcher = threading.Thread(target=consume, name="apf-watch",
+                               daemon=True)
+    watcher.start()
+
+    baseline = _apf_arm(kube, cfg, ns, names, storm=False)
+    no_apf = _apf_arm(kube, cfg, ns, names, storm=True)
+    kube.enable_apf(apf=_apf_engine())
+    with_apf = _apf_arm(kube, cfg, ns, names, storm=True)
+    apf_snapshot = kube.apf.snapshot()
+    kube.disable_apf()
+    stop_watch.set()
+    watcher.join(timeout=5)
+
+    base_p95 = baseline["protected_p95_ms"] or 0.0
+    apf_p95 = with_apf["protected_p95_ms"] or 0.0
+    protected_ratio = round(apf_p95 / base_p95, 3) if base_p95 else None
+    # the lane "holds" when its p95 stays within ±20% of the no-storm
+    # baseline OR under an absolute floor: these are sub-millisecond
+    # in-memory ops, and on a loaded shared box a single 2 ms scheduler
+    # slice in either arm would flap a pure-ratio verdict (the no-APF
+    # storm arm measures ~10 ms — an order of magnitude, not jitter)
+    protected_held = (
+        protected_ratio is not None
+        and (protected_ratio <= APF_PROTECTED_MAX_RATIO
+             or apf_p95 <= APF_PROTECTED_FLOOR_MS)
+    )
+    noapf_ops = no_apf.get("storm_ops_s") or 0.0
+    apf_ops = with_apf.get("storm_ops_s") or 0.0
+    storm_ratio = round(apf_ops / noapf_ops, 3) if noapf_ops else None
+
+    summary = tracker.summary()
+    summary["extra"] = {
+        "apf": {
+            "baseline": baseline,
+            "storm_no_apf": no_apf,
+            "storm_apf": with_apf,
+            "protected_p95_ratio": protected_ratio,
+            "protected_held": protected_held,
+            "storm_throughput_ratio": storm_ratio,
+            "storm_429s": with_apf.get("storm_429s", 0),
+            "protected_429s": with_apf.get("protected_throttled", 0),
+            "levels": apf_snapshot["levels"],
+            "schemas": apf_snapshot["schemas"],
+        },
+        "throttled_by_client": {
+            c: v.get("429", 0)
+            for c, v in by_client_delta(
+                kube.request_counts_snapshot(by_client=True),
+                api_t0).items()
+            if v.get("429")
+        },
+        "watch_lag_ms": percentiles(lag_ms),
+        "event_count": 0,
+        "journal": {},
+    }
+    summary["slo"] = slo_mod.report({"watch_delivery": lag_ms})
+    ok = (
+        with_apf.get("storm_429s", 0) > 0
+        and with_apf.get("protected_throttled", 0) == 0
+        and protected_held
+        and storm_ratio is not None
+        and storm_ratio <= APF_STORM_MAX_RATIO
+    )
+    return ScenarioResult(
+        name="ha_apf", elapsed_s=time.monotonic() - started,
+        records=tracker.records(), summary=summary, ok=ok,
+    )
+
+
+HA_SCENARIOS = {
+    "ha_scale": scenario_ha_scale,
+    "ha_failover": scenario_ha_failover,
+    "ha_apf": scenario_ha_apf,
+}
+
+# registration, like the chaos family: importing the module is enough
+SCENARIOS.update(HA_SCENARIOS)
+
+#: re-exported so __main__ can keep the family out of the default
+#: (latency-lane) run the way it keeps chaos out
+__all__ = ["HA_SCENARIOS", "scenario_ha_scale", "scenario_ha_failover",
+           "scenario_ha_apf"]

@@ -22,3 +22,10 @@ from service_account_auth_improvements_tpu.controlplane.engine.metrics import ( 
     EngineMetrics,
     engine_metrics,
 )
+from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: F401
+    DEFAULT_NUM_SHARDS,
+    ShardCoordinator,
+    ShardMember,
+    ShardRuntime,
+    shard_of,
+)

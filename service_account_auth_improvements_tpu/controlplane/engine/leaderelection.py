@@ -71,6 +71,19 @@ def _parse(raw: str | None) -> datetime.datetime | None:
         return None
 
 
+def renew_stale(renew: datetime.datetime, duration: float,
+                tolerance: float, now: datetime.datetime) -> bool:
+    """THE lease staleness rule, shared by the elector's expiry check
+    and cpshard's membership/barrier liveness (engine/shard.py): stale
+    past duration + tolerance is dead, and a renewTime further in the
+    FUTURE than the same bound is a broken clock, not a hold. One
+    definition so a future skew-handling fix cannot make the elector
+    and the shard coordinator disagree about the same Lease holder."""
+    age = (now - renew).total_seconds()
+    bound = float(duration) + float(tolerance)
+    return age > bound or age < -bound
+
+
 class LeaderElector:
     def __init__(self, kube, lease_name: str,
                  namespace: str = "kubeflow",
@@ -157,6 +170,15 @@ class LeaderElector:
                 self._renewer.start()
                 return
             self._stop.wait(self.retry_period)
+
+    def abandon(self) -> None:
+        """Crash simulation / hard fencing: stop participating WITHOUT
+        clearing the lease. Unlike :meth:`release`, the successor must
+        wait out the full lease expiry — exactly what a killed process
+        leaves behind, and the path failover benches/chaos time. Never
+        touches the apiserver."""
+        self._stop.set()
+        self.is_leader = False
 
     def release(self) -> None:
         """Voluntary handoff on clean shutdown (clears holderIdentity so
@@ -262,7 +284,6 @@ class LeaderElector:
             # ours): the holder that wrote it declared how long its
             # heartbeat may be trusted, so the skew grace scales with it
             tol = 0.25 * float(duration)
-        age = (self._now() - renew).total_seconds()
         # stale past duration + tolerance → expired (the tolerance keeps
         # a healthy holder whose clock trails ours within bounds from
         # being deposed, and stops that holder self-evicting when it
@@ -270,7 +291,7 @@ class LeaderElector:
         # the FUTURE than the same bound is a broken clock, not a hold —
         # without that leg, a crashed holder that wrote a far-future
         # renewTime would keep the lease forever
-        return age > float(duration) + tol or age < -(float(duration) + tol)
+        return renew_stale(renew, float(duration), tol, self._now())
 
     def _try_acquire(self) -> bool:
         lease = self._get()

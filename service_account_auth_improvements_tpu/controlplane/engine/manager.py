@@ -34,6 +34,9 @@ from service_account_auth_improvements_tpu.controlplane.engine.metrics import (
 from service_account_auth_improvements_tpu.controlplane.engine.queue import (
     RateLimitingQueue,
 )
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    shard as shard_mod,
+)
 from service_account_auth_improvements_tpu.controlplane import obs
 from service_account_auth_improvements_tpu.utils.env import (
     get_env_bool,
@@ -93,6 +96,14 @@ class Controller:
         self._tl = threading.local()
 
     def enqueue(self, request: Request) -> None:
+        # sharded managers filter the watch stream at the enqueue
+        # boundary: events for keys another replica owns never enter
+        # this queue (HOLD keys do — the worker gate parks them until
+        # the handoff barrier clears)
+        member = self.manager.shard
+        if member is not None and member.admit(
+                request.namespace, request.name) == shard_mod.FOREIGN:
+            return
         self.queue.add(request)
 
     def enqueue_after(self, request: Request, delay: float) -> None:
@@ -102,6 +113,48 @@ class Controller:
                          dequeued: float) -> None:
         self._tl.wait = (req, enqueued)
 
+    #: a HOLD key (gained shard still behind its handoff barrier, or a
+    #: self-fenced member) re-queues on this cadence — long enough not
+    #: to spin, short enough that an activated gain picks up in tens of
+    #: milliseconds
+    SHARD_HOLD_RETRY_S = 0.05
+
+    def _shard_admit(self, req: Request) -> bool:
+        """Worker-side shard gate, re-checked at DEQUEUE time (the map
+        may have moved since the event enqueued): True = reconcile.
+        FOREIGN keys are dropped with a journaled per-key decision —
+        the evidence the explain engine stitches into "key moved
+        replicas mid-reconcile" — and HOLD keys park on a short retry.
+        A raising shard member fails SAFE (hold, retry): a stall is
+        recoverable, a dual reconcile is not."""
+        member = self.manager.shard
+        try:
+            verdict = member.admit(req.namespace, req.name)
+        except Exception:  # noqa: BLE001
+            verdict = shard_mod.HOLD
+        if verdict == shard_mod.OWN:
+            return True
+        if verdict == shard_mod.FOREIGN:
+            try:
+                jnl = getattr(self.manager.tracer, "journal", None)
+                if jnl is not None:
+                    jnl.decide(
+                        "shard",
+                        key=obs.object_key(self.reconciler.resource,
+                                           req.namespace, req.name),
+                        action="moved",
+                        shard=member.shard_for(req.namespace, req.name),
+                        owner=member.owner_of(req.namespace, req.name),
+                        identity=member.identity,
+                    )
+            except Exception:  # noqa: BLE001 — evidence, not control
+                pass
+            self.queue.forget(req)
+        else:
+            self.queue.add_after(req, self.SHARD_HOLD_RETRY_S)
+        self.queue.done(req)
+        return False
+
     def _worker(self) -> None:
         m = self.metrics
         tracer = self.manager.tracer
@@ -109,6 +162,9 @@ class Controller:
             req = self.queue.get()
             if req is None:
                 return
+            if self.manager.shard is not None and \
+                    not self._shard_admit(req):
+                continue
             m.active_workers.labels(self.name).inc()
             self.busy.busy()
             started = time.monotonic()
@@ -268,6 +324,93 @@ class Manager:
         self._controllers: list[Controller] = []
         self._cached_client: CachedClient | None = None
         self._started = False
+        #: sharded HA mode (engine/shard.py): a ShardMember whose
+        #: admit() gates every enqueue and every dequeue. None = this
+        #: replica owns the whole key space (the pre-HA behavior).
+        self.shard = None
+
+    # ----------------------------------------------------------- sharding
+
+    def attach_shard(self, member) -> "Manager":
+        """Run this manager as ONE replica of a sharded plane: only keys
+        the member owns are reconciled, and the member's handoff hooks
+        drive requeue/drop/drain (docs/ha.md). Call before start()."""
+        self.shard = member
+        member.on_gain = self._shard_gained
+        member.on_lose = self._shard_lost
+        member.drain_fn = self._shards_drained
+        return self
+
+    def _shard_gained(self, shards) -> None:
+        self.requeue_owned(shards)
+
+    def _shard_lost(self, shards) -> None:
+        self.drop_foreign()
+
+    def _shards_drained(self, shards) -> bool:
+        return not self.has_inflight(shards)
+
+    def requeue_owned(self, shards=None) -> int:
+        """Re-enqueue every cached primary key this replica owns
+        (restricted to ``shards`` when given) — the gaining side of a
+        handoff: keys whose events were filtered out while another
+        replica owned them re-enter through the informer cache, so a
+        handoff can delay a key but never lose it."""
+        wanted = set(shards) if shards is not None else None
+        n = 0
+        for ctl in self._controllers:
+            inf = self._informers.get(
+                (ctl.reconciler.group or "", ctl.reconciler.resource)
+            )
+            if inf is None:
+                continue
+            for obj in inf.list():
+                meta = obj.get("metadata") or {}
+                name = meta.get("name")
+                if not name:
+                    continue
+                ns = meta.get("namespace")
+                if self.shard is not None:
+                    if wanted is not None and \
+                            self.shard.shard_for(ns, name) not in wanted:
+                        continue
+                    if self.shard.admit(ns, name) != shard_mod.OWN:
+                        continue
+                ctl.enqueue(Request(ns, name))
+                n += 1
+        return n
+
+    def drop_foreign(self) -> int:
+        """Prune queued keys another replica now owns (the losing side
+        of a handoff). Doomed keys are decided OUTSIDE the queue lock
+        (pending_keys snapshot → discard) so the shard member's lock
+        never nests inside a queue lock; in-flight keys drain through
+        the worker gate instead."""
+        if self.shard is None:
+            return 0
+        dropped = 0
+        for ctl in self._controllers:
+            doomed = [
+                req for req in ctl.queue.pending_keys()
+                if self.shard.admit(req.namespace, req.name)
+                == shard_mod.FOREIGN
+            ]
+            dropped += ctl.queue.discard(doomed)
+        return dropped
+
+    def has_inflight(self, shards) -> bool:
+        """Any reconcile of the given shards still running? The shard
+        member's drain-before-ack gate (never dual-reconcile: the old
+        owner acks an epoch only once its workers have let go)."""
+        if self.shard is None:
+            return False
+        wanted = set(shards)
+        for ctl in self._controllers:
+            for req in ctl.queue.processing():
+                if self.shard.shard_for(req.namespace,
+                                        req.name) in wanted:
+                    return True
+        return False
 
     # ------------------------------------------------------------ wiring
 

@@ -164,6 +164,59 @@ class RateLimitingQueue:
                     self._observe_depth_locked()
                     self._lock.notify()
 
+    def pending_keys(self) -> list:
+        """Snapshot of every key waiting to run (pending + delayed +
+        dirty re-adds; NOT the in-flight set). Callers that prune by
+        predicate (shard handoff dropping foreign keys) take this
+        snapshot, decide OUTSIDE the queue lock, and pass the doomed
+        keys to :meth:`discard` — evaluating a predicate that takes its
+        own locks under this queue's lock would mint a lock-order edge
+        lockwatch has to prove safe."""
+        with self._lock:
+            return list(self._pending) \
+                + [k for (_, _, k) in self._delayed] \
+                + list(self._dirty)
+
+    def discard(self, keys) -> int:
+        """Drop the given keys from pending/delayed/dirty (shard
+        handoff: a replica that lost a key space must not keep working
+        its backlog of it). In-flight keys are untouched — the worker's
+        shard gate re-checks ownership at dequeue. Returns the number
+        of queue entries removed."""
+        doomed = set(keys)
+        if not doomed:
+            return 0
+        removed = 0
+        with self._lock:
+            hit = self._pending & doomed
+            if hit:
+                removed += len(hit)
+                self._pending -= hit
+                self._order = collections.deque(
+                    k for k in self._order if k not in hit
+                )
+                for k in hit:
+                    self._added_at.pop(k, None)
+            kept = [e for e in self._delayed if e[2] not in doomed]
+            removed += len(self._delayed) - len(kept)
+            if len(kept) != len(self._delayed):
+                self._delayed = kept
+                heapq.heapify(self._delayed)
+            dirty_hit = self._dirty & doomed
+            removed += len(dirty_hit)
+            self._dirty -= dirty_hit
+            for k in doomed:
+                self._failures.pop(k, None)
+            self._observe_depth_locked()
+        return removed
+
+    def processing(self) -> list:
+        """Snapshot of the in-flight keys (shard handoff drains on it:
+        a lost shard's ack waits until none of its keys are mid-
+        reconcile)."""
+        with self._lock:
+            return list(self._processing)
+
     def shutdown(self) -> None:
         with self._lock:
             self._shutdown = True

@@ -1,0 +1,860 @@
+"""cpshard: key-space sharding for a multi-replica Manager (docs/ha.md).
+
+One Manager process reconciling every key is the plane's last
+serialization point. This module splits the (namespace, name) key space
+into ``num_shards`` virtual shards and lets N Manager replicas own
+disjoint subsets, coordinated entirely through coordination.k8s.io
+Leases — the same substrate (and the same hardened expiry/skew rules)
+as ``engine/leaderelection.py``:
+
+- **shard map** — rendezvous (highest-random-weight) hashing assigns
+  every shard to exactly one live member; a membership change moves
+  only the shards that must move. The assignment is *published*, not
+  recomputed per replica: the elected coordinator writes it into the
+  ``<group>-map`` Lease with a monotonically increasing **epoch**, so
+  every replica applies the same map in the same order.
+- **membership** — each replica heartbeats its own ``<group>-member-*``
+  Lease; the coordinator treats an expired heartbeat as a dead member
+  (bounded skew tolerance, the leaderelection rules) and publishes a
+  new epoch without it.
+- **coordinator** — any replica may coordinate; a ``<group>-coordinator``
+  Lease (``LeaderElector``) picks one. Coordination is stateless — the
+  map lease is the state — so coordinator failover is just the next
+  elector winning and sweeping.
+
+Handoff protocol (the never-dual-reconcile argument, journaled end to
+end as ``kind="shard"`` decisions):
+
+1. The coordinator publishes epoch E.
+2. A member that LOSES shards under E stops admitting them immediately
+   (the safe direction), drains its in-flight reconciles of those
+   shards (``drain_fn``, wired to ``Manager.has_inflight``), and only
+   then publishes ``acked-epoch: E`` on its member Lease.
+3. A member that GAINS shards under E holds them (``admit`` returns
+   ``HOLD``) until every *live* fellow member has acked E — the old
+   owner either acked (it drained) or its heartbeat expired (it is
+   presumed dead, the Lease fencing convention). Then the gains
+   activate and ``on_gain`` requeues the shard's keys from the informer
+   cache, so a key can be *delayed* by a handoff but never lost.
+4. A member whose own heartbeat has gone stale past its lease duration
+   **self-fences**: it stops admitting everything (``HOLD``) until a
+   renew succeeds, exactly like the leader elector's renew-deadline
+   self-eviction — a partitioned replica must not keep reconciling
+   shards the coordinator has already given away.
+
+The residual window — a replica wedged mid-reconcile for longer than a
+whole lease expiry while partitioned — is the classic lease-fencing
+gap; closing it needs per-request fencing tokens at the apiserver,
+which no controller-runtime deployment has either (docs/ha.md).
+"""
+
+from __future__ import annotations
+
+import copy
+import hashlib
+import json
+import logging
+import threading
+import time
+import zlib
+
+from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+    LEASE_GROUP,
+    LeaderElector,
+    _fmt,
+    _now,
+    _parse,
+    renew_stale,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    journal as journal_mod,
+)
+
+log = logging.getLogger(__name__)
+
+#: default virtual-shard count: enough granularity that a 4-replica
+#: plane balances within ~12% while the map lease annotation stays small
+DEFAULT_NUM_SHARDS = 64
+
+#: admit() verdicts — the Manager's worker gate switches on these
+OWN = "own"
+HOLD = "hold"
+FOREIGN = "foreign"
+
+#: member-lease labels (the coordinator LISTs by them) and the map/ack
+#: annotations the protocol rides on
+LABEL_GROUP = "cpshard.tpukf.dev/group"
+LABEL_ROLE = "cpshard.tpukf.dev/role"
+ANN_EPOCH = "cpshard.tpukf.dev/epoch"
+ANN_MAP = "cpshard.tpukf.dev/map"
+ANN_MEMBERS = "cpshard.tpukf.dev/members"
+ANN_ACKED = "cpshard.tpukf.dev/acked-epoch"
+ANN_SHARDS = "cpshard.tpukf.dev/num-shards"
+
+
+def shard_of(namespace: str | None, name: str,
+             num_shards: int = DEFAULT_NUM_SHARDS) -> int:
+    """Deterministic (namespace, name) → shard id. crc32, NOT Python's
+    ``hash()``: the assignment must agree across replicas and restarts
+    (PYTHONHASHSEED randomizes ``hash``)."""
+    return zlib.crc32(f"{namespace or ''}/{name}".encode()) % num_shards
+
+
+def rendezvous_owner(shard: int, members) -> str | None:
+    """Highest-random-weight owner of one shard among ``members``: each
+    (shard, member) pair gets a stable 64-bit weight and the max wins —
+    so a member joining/leaving moves only the shards whose max changed
+    (1/N of the space on average), the consistent-hashing property the
+    handoff cost scales with."""
+    best = None
+    best_w = -1
+    for m in sorted(members):
+        w = int.from_bytes(
+            hashlib.blake2b(f"{shard}:{m}".encode(),
+                            digest_size=8).digest(), "big")
+        if w > best_w:
+            best, best_w = m, w
+    return best
+
+
+def assign(num_shards: int, members) -> dict[int, str]:
+    """The full shard map for a membership set ({} when empty)."""
+    members = sorted(members)
+    if not members:
+        return {}
+    return {s: rendezvous_owner(s, members) for s in range(num_shards)}
+
+
+def _lease_live(lease: dict, now, default_duration: float) -> bool:
+    """Is this heartbeat Lease held and fresh? THE SAME staleness rule
+    as the leader elector (leaderelection.renew_stale — one definition,
+    so the elector and the shard coordinator can never disagree about
+    the same holder), with the elector's default 25%-of-duration skew
+    tolerance."""
+    spec = (lease or {}).get("spec") or {}
+    if not spec.get("holderIdentity"):
+        return False
+    renew = _parse(spec.get("renewTime")) or _parse(spec.get("acquireTime"))
+    if renew is None:
+        return False
+    duration = spec.get("leaseDurationSeconds")
+    if duration is None:
+        duration = default_duration
+    return not renew_stale(renew, float(duration),
+                           0.25 * float(duration), now)
+
+
+def _decode_map(lease: dict) -> tuple[int, dict[int, str], list[str],
+                                      int]:
+    """(epoch, {shard: owner}, members, num_shards) from the map Lease;
+    (0, {}, [], 0) for an absent or unparseable map — a corrupt map
+    must read as 'no ownership anywhere' (safe), never as a crash.
+    ``num_shards`` comes from the published annotation so the count
+    survives even an EMPTY map (every member dead at one sweep) —
+    inferring it from len(map) alone would let a differently-configured
+    coordinator re-hash the whole key space across such a window."""
+    ann = ((lease or {}).get("metadata") or {}).get("annotations") or {}
+    try:
+        epoch = int(ann.get(ANN_EPOCH) or 0)
+        raw = json.loads(ann.get(ANN_MAP) or "{}")
+        members = json.loads(ann.get(ANN_MEMBERS) or "[]")
+        mapping = {int(s): o for s, o in raw.items()}
+        num = int(ann.get(ANN_SHARDS) or 0) or len(mapping)
+        return epoch, mapping, list(members), num
+    except (ValueError, TypeError, AttributeError):
+        return 0, {}, [], 0
+
+
+class ShardMember:
+    """One replica's view of the shard protocol: heartbeat + map watch +
+    the handoff state machine. ``admit(namespace, name)`` is the hot
+    call — the Manager asks it per event and per dequeue."""
+
+    def __init__(self, kube, identity: str,
+                 group: str = "cpshard",
+                 namespace: str = "kubeflow",
+                 num_shards: int = DEFAULT_NUM_SHARDS,
+                 lease_duration: float = 15.0,
+                 tick_period: float | None = None,
+                 journal=None, now_fn=None, mono_fn=None):
+        self.kube = kube
+        self.identity = identity
+        self.group = group
+        self.namespace = namespace
+        self.num_shards = num_shards
+        self.lease_duration = lease_duration
+        #: heartbeat + map-poll cadence; a quarter of the lease keeps
+        #: three renew attempts inside one expiry window
+        self.tick_period = tick_period if tick_period is not None \
+            else max(lease_duration / 4.0, 0.05)
+        self.journal = (journal if journal is not None
+                        else journal_mod.JOURNAL)
+        self._now = now_fn if now_fn is not None else _now
+        self._mono = mono_fn if mono_fn is not None else time.monotonic
+        self._lease_name = f"{group}-member-{identity}"
+        self._map_name = f"{group}-map"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        # ------- protocol state, every mutation under self._lock -------
+        self._epoch = 0
+        self._map: dict[int, str] = {}
+        self._active: frozenset = frozenset()
+        #: gained-but-barriered shards: shard -> epoch it arrived with
+        self._pending: dict[int, int] = {}
+        self._acked = 0
+        #: (epoch, frozenset of lost shards) awaiting drain before ack
+        self._ack_wait: tuple | None = None
+        self._fenced = False
+        self._last_renew_ok: float | None = None
+        #: False from the moment we fence until a map GET succeeds
+        #: again: while partitioned we may have MISSED epochs that moved
+        #: our shards away, so nothing may (re)activate off the stale
+        #: in-memory map — the barrier's acked-epoch test alone can't
+        #: catch it (everyone's ack is ≥ our stale epoch)
+        self._map_confirmed = True
+        # ------- wiring (Manager.attach_shard sets these) --------------
+        #: fn(gained_shards: set) — requeue the shards' keys from cache
+        self.on_gain = None
+        #: fn(lost_shards: set) — drop the shards' queued keys
+        self.on_lose = None
+        #: fn(lost_shards: set) -> bool — True when no reconcile of those
+        #: shards is still in flight (gates the epoch ack)
+        self.drain_fn = None
+
+    # ------------------------------------------------------------- public
+
+    def start(self) -> "ShardMember":
+        """Register the member Lease (so the coordinator sees us on its
+        next sweep) and start the tick loop."""
+        self._heartbeat()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"cpshard-{self.identity}", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Graceful leave: stop the loop and DELETE the member Lease so
+        the coordinator reassigns immediately instead of waiting out
+        the expiry — and so replica churn (every restart is a fresh
+        identity) can't accumulate Lease objects without bound."""
+        self._stop.set()
+        with self._lock:
+            self._active = frozenset()
+            self._pending.clear()
+        # an in-flight tick could heartbeat AFTER the delete below and
+        # resurrect the lease (degrading this graceful leave into an
+        # expiry wait); let it finish first — and if it is wedged in
+        # apiserver I/O past the bounded join, hand the re-delete to a
+        # reaper that waits it out, so shutdown never blocks on a slow
+        # apiserver but the Lease still cannot survive the leave
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self._delete_lease()
+        if self._thread is not None and self._thread.is_alive():
+            tick = self._thread
+
+            def reap():
+                tick.join()
+                self._delete_lease()
+
+            threading.Thread(target=reap, daemon=True,
+                             name=f"cpshard-reap-{self.identity}").start()
+        self._decide("member_left", identity=self.identity)
+
+    def _delete_lease(self) -> None:
+        try:
+            self.kube.delete("leases", self._lease_name,
+                             namespace=self.namespace,
+                             group=LEASE_GROUP)
+        except errors.ApiError:
+            pass  # the expiry + coordinator GC path covers it
+
+    def kill(self) -> None:
+        """Crash simulation (failover benches/chaos): stop participating
+        WITHOUT touching the apiserver — successors must wait out the
+        lease expiry, the path the failover SLO times."""
+        self._stop.set()
+        with self._lock:
+            self._active = frozenset()
+            self._pending.clear()
+
+    def admit(self, namespace: str | None, name: str) -> str:
+        """OWN / HOLD / FOREIGN for one key under the current epoch.
+        HOLD means "maybe mine, not yet safe" — gained-but-barriered
+        shards and a self-fenced member both hold, never reconcile.
+        The modulus is the PUBLISHED map's shard count (adopted in
+        _apply_map), never a local config that could disagree with the
+        coordinator's — two replicas computing the same key into
+        different shard ids is a dual reconcile waiting to happen."""
+        with self._lock:
+            if self._fenced:
+                return HOLD
+            s = shard_of(namespace, name, self.num_shards)
+            if s in self._active:
+                return OWN
+            if s in self._pending:
+                return HOLD
+            return FOREIGN
+
+    def shard_for(self, namespace: str | None, name: str) -> int:
+        return shard_of(namespace, name, self.num_shards)
+
+    def owner_of(self, namespace: str | None, name: str) -> str | None:
+        """Current map's owner for a key (None before the first map)."""
+        with self._lock:
+            return self._map.get(
+                shard_of(namespace, name, self.num_shards))
+
+    def active_shards(self) -> frozenset:
+        with self._lock:
+            return self._active
+
+    @property
+    def epoch(self) -> int:
+        with self._lock:
+            return self._epoch
+
+    def fenced(self) -> bool:
+        with self._lock:
+            return self._fenced
+
+    # ----------------------------------------------------------- internal
+
+    def _decide(self, action: str, **attrs) -> None:
+        try:
+            self.journal.decide("shard", action=action, group=self.group,
+                                **attrs)
+        except Exception:  # noqa: BLE001 — flight recorder, not control
+            pass
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.tick_period):
+            try:
+                self._tick()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("cpshard member %s tick failed",
+                              self.identity)
+
+    def _tick(self) -> None:
+        renewed = self._heartbeat()
+        self._update_fence(renewed)
+        self._read_map()
+        self._check_barrier()
+        self._check_ack()
+
+    def _heartbeat(self) -> bool:
+        """Create/renew the member Lease carrying the acked epoch.
+        Returns True on a successful write."""
+        with self._lock:
+            acked = self._acked
+        now = _fmt(self._now())
+        body = {
+            "apiVersion": f"{LEASE_GROUP}/v1",
+            "kind": "Lease",
+            "metadata": {
+                "name": self._lease_name,
+                "namespace": self.namespace,
+                "labels": {LABEL_GROUP: self.group,
+                           LABEL_ROLE: "member"},
+                "annotations": {ANN_ACKED: str(acked)},
+            },
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_duration,
+                "acquireTime": now,
+                "renewTime": now,
+            },
+        }
+        try:
+            try:
+                lease = self.kube.get("leases", self._lease_name,
+                                      namespace=self.namespace,
+                                      group=LEASE_GROUP)
+            except errors.NotFound:
+                self.kube.create("leases", body,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+            else:
+                lease = copy.deepcopy(lease)
+                lease.setdefault("metadata", {}).setdefault(
+                    "labels", {}).update(body["metadata"]["labels"])
+                lease["metadata"].setdefault("annotations", {})[
+                    ANN_ACKED] = str(acked)
+                spec = lease.setdefault("spec", {})
+                spec["holderIdentity"] = self.identity
+                spec["leaseDurationSeconds"] = self.lease_duration
+                spec["renewTime"] = now
+                self.kube.update("leases", lease,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+        except errors.ApiError as e:
+            log.warning("cpshard member %s: heartbeat failed: %s",
+                        self.identity, e)
+            return False
+        with self._lock:
+            self._last_renew_ok = self._mono()
+        return True
+
+    def _update_fence(self, renewed: bool) -> None:
+        """Self-fencing: a member whose own heartbeat has gone stale
+        past its advertised lease duration must assume the coordinator
+        presumed it dead and stop reconciling — the elector's
+        renew-deadline self-eviction, applied to shard ownership."""
+        event = None
+        with self._lock:
+            if renewed:
+                if self._fenced:
+                    self._fenced = False
+                    # re-entry after a fence: everything we still own
+                    # per the (possibly stale) map goes back through the
+                    # barrier as a fresh gain — if a newer epoch moved
+                    # it away meanwhile, _read_map drops it before it
+                    # can activate
+                    for s in self._active:
+                        self._pending[s] = self._epoch
+                    self._active = frozenset()
+                    event = "unfenced"
+            else:
+                last = self._last_renew_ok
+                stale = (last is None
+                         or self._mono() - last > self.lease_duration)
+                if stale and not self._fenced:
+                    self._fenced = True
+                    # the same partition that broke our heartbeat may
+                    # have hidden epochs from us: the in-memory map is
+                    # suspect until a fresh read lands
+                    self._map_confirmed = False
+                    event = "fenced"
+        if event is not None:
+            self._decide(event, identity=self.identity)
+
+    def _read_map(self) -> None:
+        try:
+            lease = self.kube.get("leases", self._map_name,
+                                  namespace=self.namespace,
+                                  group=LEASE_GROUP)
+        except errors.NotFound:
+            # an authoritative "no map exists" confirms as well as a
+            # map does (nothing was missed — there is nothing to miss)
+            with self._lock:
+                self._map_confirmed = True
+            return
+        except errors.ApiError:
+            return
+        epoch, mapping, _members, count = _decode_map(lease)
+        with self._lock:
+            stale = not self._map_confirmed
+            self._map_confirmed = True
+            if epoch <= self._epoch and not stale:
+                return
+        # a post-fence read re-applies even an unchanged (or, if the
+        # map Lease was recreated from scratch, a LOWER) epoch: the
+        # authoritative map must overwrite whatever the partition froze
+        self._apply_map(epoch, mapping, count)
+
+    def _apply_map(self, epoch: int, mapping: dict[int, str],
+                   count: int = 0) -> None:
+        """Apply a newer epoch: drop losses immediately (safe), queue
+        gains behind the ack barrier, arm the drain-then-ack step."""
+        lost_cb: set = set()
+        with self._lock:
+            if count and count != self.num_shards:
+                # adopt the PUBLISHED shard count: a rolling --shards
+                # change must not leave replicas hashing the same key
+                # into different moduli (dual reconcile one way, silent
+                # drop the other)
+                log.warning(
+                    "cpshard member %s: adopting published shard count "
+                    "%d (configured %d)", self.identity, count,
+                    self.num_shards)
+                self.num_shards = count
+            owned_new = {s for s, o in mapping.items()
+                         if o == self.identity}
+            lost = set(self._active) - owned_new
+            gained = owned_new - set(self._active) - set(self._pending)
+            # pending shards a newer epoch took away never activate
+            for s in list(self._pending):
+                if s not in owned_new:
+                    del self._pending[s]
+            for s in gained:
+                self._pending[s] = epoch
+            self._active = frozenset(set(self._active) - lost)
+            self._map = dict(mapping)
+            self._epoch = epoch
+            if self._ack_wait is not None:
+                # fold an unacked older epoch's losses into this one:
+                # the ack we eventually publish covers both. A shard the
+                # new epoch hands BACK to us leaves the drain set — we
+                # own it again, so reconciling it must not block our own
+                # ack (it would wedge every other member's barrier).
+                lost = (lost | set(self._ack_wait[1])) - owned_new
+            self._ack_wait = (epoch, frozenset(lost))
+            lost_cb = set(lost)
+        self._decide("map_seen", identity=self.identity, epoch=epoch,
+                     owned=len(owned_new), gained=len(gained),
+                     lost=len(lost_cb))
+        if lost_cb and self.on_lose is not None:
+            try:
+                self.on_lose(lost_cb)
+            except Exception:  # noqa: BLE001
+                log.exception("cpshard on_lose failed")
+
+    def _check_ack(self) -> None:
+        """Publish the epoch ack once every lost shard has drained —
+        the other half of the never-dual-reconcile argument: a gainer
+        only activates once this ack (or our expiry) is visible."""
+        with self._lock:
+            wait = self._ack_wait
+        if wait is None:
+            return
+        epoch, lost = wait
+        if lost and self.drain_fn is not None:
+            try:
+                if not self.drain_fn(set(lost)):
+                    return  # still reconciling a lost shard: no ack yet
+            except Exception:  # noqa: BLE001 — fail SAFE: keep waiting
+                log.exception("cpshard drain_fn failed")
+                return
+        with self._lock:
+            if self._ack_wait != wait:
+                return  # a newer epoch superseded this ack
+            self._acked = epoch
+            self._ack_wait = None
+        self._decide("handoff_acked", identity=self.identity,
+                     epoch=epoch, drained=len(lost))
+        self._heartbeat()  # publish the ack now, not a tick later
+
+    def _check_barrier(self) -> None:
+        """Activate pending gains whose barrier has cleared: every LIVE
+        fellow member has acked our epoch (a dead member's expiry IS its
+        ack — the lease fencing convention)."""
+        with self._lock:
+            if not self._pending or not self._map_confirmed:
+                return
+            epoch = self._epoch
+        try:
+            listing = self.kube.list(
+                "leases", namespace=self.namespace, group=LEASE_GROUP,
+                label_selector=(f"{LABEL_GROUP}={self.group},"
+                                f"{LABEL_ROLE}=member"),
+            )["items"]
+        except errors.ApiError:
+            return
+        now = self._now()
+        for lease in listing:
+            ident = (lease.get("spec") or {}).get("holderIdentity")
+            if not ident or ident == self.identity:
+                continue
+            if not _lease_live(lease, now, self.lease_duration):
+                continue  # presumed dead: its expiry is its ack
+            ann = (lease.get("metadata") or {}).get("annotations") or {}
+            try:
+                acked = int(ann.get(ANN_ACKED) or 0)
+            except ValueError:
+                acked = 0
+            if acked < epoch:
+                return  # a live member hasn't seen/drained this epoch
+        gained_cb: set = set()
+        with self._lock:
+            if self._epoch != epoch or not self._pending:
+                return
+            gained_cb = {s for s, e in self._pending.items()
+                         if e <= epoch}
+            if not gained_cb:
+                return
+            for s in gained_cb:
+                del self._pending[s]
+            self._active = frozenset(set(self._active) | gained_cb)
+        self._decide("handoff_gained", identity=self.identity,
+                     epoch=epoch, shards=len(gained_cb))
+        if self.on_gain is not None:
+            try:
+                self.on_gain(gained_cb)
+            except Exception:  # noqa: BLE001
+                log.exception("cpshard on_gain failed")
+
+
+class ShardCoordinator:
+    """The map writer: whoever holds the coordinator Lease sweeps the
+    member Leases and publishes a new epoch whenever the live set
+    changes. Stateless between sweeps — the map Lease is the state, so
+    coordinator failover is just the next elector winning."""
+
+    def __init__(self, kube, identity: str,
+                 group: str = "cpshard",
+                 namespace: str = "kubeflow",
+                 num_shards: int = DEFAULT_NUM_SHARDS,
+                 member_lease_duration: float = 15.0,
+                 sweep_period: float | None = None,
+                 journal=None, now_fn=None):
+        self.kube = kube
+        self.identity = identity
+        self.group = group
+        self.namespace = namespace
+        self.num_shards = num_shards
+        self.member_lease_duration = member_lease_duration
+        self.sweep_period = sweep_period if sweep_period is not None \
+            else max(member_lease_duration / 4.0, 0.05)
+        self.journal = (journal if journal is not None
+                        else journal_mod.JOURNAL)
+        self._now = now_fn if now_fn is not None else _now
+        self._map_name = f"{group}-map"
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "ShardCoordinator":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"cpshard-coord-{self.identity}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("cpshard coordinator sweep failed")
+            self._stop.wait(self.sweep_period)
+
+    #: a dead member Lease older than this many durations is garbage-
+    #: collected by the sweep — crashed replicas never delete their own
+    #: Lease (kill() must not touch the apiserver), and every restart
+    #: is a fresh identity, so without GC the membership LISTs would
+    #: grow with total historical restarts
+    LEASE_GC_DURATIONS = 4.0
+
+    def live_members(self) -> list[str]:
+        listing = self.kube.list(
+            "leases", namespace=self.namespace, group=LEASE_GROUP,
+            label_selector=(f"{LABEL_GROUP}={self.group},"
+                            f"{LABEL_ROLE}=member"),
+        )["items"]
+        now = self._now()
+        out = []
+        for lease in listing:
+            if _lease_live(lease, now, self.member_lease_duration):
+                out.append(lease["spec"]["holderIdentity"])
+            else:
+                self._maybe_gc(lease, now)
+        return sorted(out)
+
+    def _maybe_gc(self, lease: dict, now) -> None:
+        """Delete a member Lease dead long past any possible comeback
+        (holder cleared, or renewTime stale beyond LEASE_GC_DURATIONS x
+        its advertised duration)."""
+        spec = (lease or {}).get("spec") or {}
+        renew = _parse(spec.get("renewTime")) or \
+            _parse(spec.get("acquireTime"))
+        duration = float(spec.get("leaseDurationSeconds")
+                         or self.member_lease_duration)
+        doomed = not spec.get("holderIdentity") or renew is None or \
+            (now - renew).total_seconds() > duration * \
+            self.LEASE_GC_DURATIONS
+        if not doomed:
+            return
+        try:
+            self.kube.delete(
+                "leases", lease["metadata"]["name"],
+                namespace=self.namespace, group=LEASE_GROUP)
+        except (errors.ApiError, KeyError):
+            pass  # next sweep retries; GC must never fail coordination
+
+    def sweep(self) -> bool:
+        """One coordination pass; returns True when a new epoch was
+        published."""
+        members = self.live_members()
+        try:
+            lease = self.kube.get("leases", self._map_name,
+                                  namespace=self.namespace,
+                                  group=LEASE_GROUP)
+        except errors.NotFound:
+            lease = None
+        except errors.ApiError:
+            return False
+        epoch, old_map, old_members, old_count = _decode_map(lease)
+        if lease is not None and members == sorted(old_members):
+            return False  # membership unchanged: the map stands
+        if old_count and old_count != self.num_shards:
+            # the shard count is sticky to the FIRST published map: a
+            # coordinator configured differently (a rolling --shards
+            # change) adopts the live count instead of flip-flopping
+            # the whole key space every time a different replica wins
+            # coordination (changing the count requires deleting the
+            # map Lease — docs/ha.md). The count rides its own
+            # annotation so it survives even an EMPTY map (every member
+            # dead at one sweep).
+            log.warning(
+                "cpshard coordinator %s: adopting published shard "
+                "count %d (configured %d)", self.identity,
+                old_count, self.num_shards)
+            self.num_shards = old_count
+        mapping = assign(self.num_shards, members)
+        moved = sum(1 for s, o in mapping.items()
+                    if old_map.get(s) != o)
+        ann = {
+            ANN_EPOCH: str(epoch + 1),
+            ANN_MAP: json.dumps({str(s): o for s, o in mapping.items()},
+                                sort_keys=True),
+            ANN_MEMBERS: json.dumps(members),
+            ANN_SHARDS: str(self.num_shards),
+        }
+        now = _fmt(self._now())
+        try:
+            if lease is None:
+                self.kube.create("leases", {
+                    "apiVersion": f"{LEASE_GROUP}/v1",
+                    "kind": "Lease",
+                    "metadata": {"name": self._map_name,
+                                 "namespace": self.namespace,
+                                 "labels": {LABEL_GROUP: self.group,
+                                            LABEL_ROLE: "map"},
+                                 "annotations": ann},
+                    "spec": {"holderIdentity": self.identity,
+                             "acquireTime": now, "renewTime": now},
+                }, namespace=self.namespace, group=LEASE_GROUP)
+            else:
+                lease = copy.deepcopy(lease)
+                lease.setdefault("metadata", {}).setdefault(
+                    "annotations", {}).update(ann)
+                spec = lease.setdefault("spec", {})
+                spec["holderIdentity"] = self.identity
+                spec["renewTime"] = now
+                # resourceVersion carries over: two racing coordinators
+                # (a deposed one with a stale view) resolve by Conflict
+                self.kube.update("leases", lease,
+                                 namespace=self.namespace,
+                                 group=LEASE_GROUP)
+        except (errors.Conflict, errors.AlreadyExists):
+            return False  # another coordinator won; re-sweep later
+        except errors.ApiError as e:
+            log.warning("cpshard coordinator: map write failed: %s", e)
+            return False
+        try:
+            self.journal.decide(
+                "shard", action="map_applied", group=self.group,
+                epoch=epoch + 1, members=len(members), moved=moved,
+                coordinator=self.identity,
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        log.info("cpshard: epoch %d published (%d members, %d shards "
+                 "moved)", epoch + 1, len(members), moved)
+        return True
+
+
+class ShardRuntime:
+    """One replica's full shard stack: a heartbeating :class:`ShardMember`
+    plus candidacy for the coordinator Lease. ``member`` is what a
+    Manager attaches (``Manager.attach_shard``); the coordinator runs
+    only while this replica holds the ``<group>-coordinator`` Lease and
+    stops on deposal (losing the coordinator Lease is NOT fatal to a
+    replica — sharding continues under whoever wins next)."""
+
+    def __init__(self, kube, identity: str,
+                 group: str = "cpshard",
+                 namespace: str = "kubeflow",
+                 num_shards: int = DEFAULT_NUM_SHARDS,
+                 lease_duration: float = 15.0,
+                 tick_period: float | None = None,
+                 journal=None, recorder=None,
+                 now_fn=None, mono_fn=None):
+        self.identity = identity
+        jnl = journal if journal is not None else journal_mod.JOURNAL
+        self.member = ShardMember(
+            kube, identity, group=group, namespace=namespace,
+            num_shards=num_shards, lease_duration=lease_duration,
+            tick_period=tick_period, journal=jnl,
+            now_fn=now_fn, mono_fn=mono_fn,
+        )
+        self.coordinator = ShardCoordinator(
+            kube, identity, group=group, namespace=namespace,
+            num_shards=num_shards,
+            member_lease_duration=lease_duration,
+            sweep_period=tick_period, journal=jnl, now_fn=now_fn,
+        )
+        self.elector = LeaderElector(
+            kube, f"{group}-coordinator", namespace=namespace,
+            identity=identity, lease_duration=lease_duration,
+            renew_period=max(lease_duration / 4.0, 0.05),
+            retry_period=max(lease_duration / 8.0, 0.05),
+            on_lost=self.coordinator.stop,
+            journal=jnl, recorder=recorder,
+            now_fn=now_fn, mono_fn=mono_fn,
+        )
+        self._campaign_thread: threading.Thread | None = None
+        self._stopped = threading.Event()
+
+    def start(self) -> "ShardRuntime":
+        self.member.start()
+        self._campaign_thread = threading.Thread(
+            target=self._campaign, name=f"cpshard-campaign-{self.identity}",
+            daemon=True,
+        )
+        self._campaign_thread.start()
+        return self
+
+    def _campaign(self) -> None:
+        """Perpetual candidacy: win → coordinate → (deposed/self-evicted
+        → stop coordinating) → campaign again. One-shot candidacy would
+        strand the plane: in a 2-replica deployment two successive
+        apiserver outages would exhaust both replicas' single attempts
+        and no membership change would ever publish an epoch again."""
+        while not self._stopped.is_set():
+            try:
+                self.elector.acquire()
+            except RuntimeError as e:
+                if self._stopped.is_set():
+                    return  # released/abandoned: candidacy is over
+                # the elector's loud-failure path (RBAC Forbidden on
+                # leases): in a sharded plane NO coordinator means NO
+                # map, every key FOREIGN everywhere, zero reconciles —
+                # a silent return here would hide a dead plane behind
+                # green heartbeats and a green /readyz
+                log.error(
+                    "cpshard %s: coordinator candidacy failed — the "
+                    "plane will have no shard map until this is fixed: "
+                    "%s", self.identity, e)
+                self.member.journal.decide(
+                    "shard", action="candidacy_failed",
+                    group=self.member.group, identity=self.identity,
+                    error=str(e))
+                return
+            if self._stopped.is_set() or not self.elector.is_leader:
+                return
+            self.coordinator.start()
+            # hold until deposal (the elector's renew loop fires
+            # on_lost → coordinator.stop and clears is_leader) or until
+            # this runtime shuts down
+            while self.elector.is_leader \
+                    and not self._stopped.is_set():
+                self._stopped.wait(self.elector.retry_period)
+
+    def is_coordinator(self) -> bool:
+        return self.elector.is_leader
+
+    def stop(self) -> None:
+        """Graceful leave: hand the coordinator Lease over and delete
+        the member Lease so reassignment is immediate."""
+        self._stopped.set()
+        self.coordinator.stop()
+        self.elector.release()
+        self.member.stop()
+
+    def kill(self) -> None:
+        """Crash: abandon every Lease un-cleared — successors must wait
+        out the expiries (the failover path the benches time)."""
+        self._stopped.set()
+        self.coordinator.stop()
+        self.elector.abandon()
+        self.member.kill()

@@ -1,0 +1,275 @@
+"""Apiserver priority-and-fairness for FakeKube (docs/ha.md).
+
+A real apiserver classifies every request into a *flow* (FlowSchema:
+who is asking, for what) mapped to a *priority level* that owns a share
+of the server's concurrency plus bounded FIFO queues; a flow that
+exhausts its share and its queue gets 429 + Retry-After while every
+other level keeps its seats. PR 10 built the attribution this needs —
+``FakeKube`` knows per-request WHO is asking (client handle tag,
+reconcile-actor resolution) — and this module closes the loop: a
+storming controller gets squeezed, the kubelet/lease/watch lanes do
+not.
+
+Fidelity mapping (the fake's verbs complete in microseconds, so raw
+in-flight counting would never saturate — the *rate* at which seats
+turn over is the contended resource):
+
+- a priority level's ``shares`` buy it ``total_rate x shares / Σshares``
+  requests per second (its seat-turnover rate), with a burst bucket of
+  ``burst_s`` seconds of that rate — the token-bucket rendering of
+  "assured concurrency shares";
+- queuing: a request that misses a token may wait up to
+  ``queue_wait_s`` for one, FIFO per level, with the virtual queue
+  bounded at ``queue_wait_s`` worth of rate (negative bucket balance ==
+  queue depth — arrival order is reservation order, so the wait really
+  is FIFO);
+- beyond the queue: 429 ``TooManyRequests`` with ``Retry-After`` set to
+  when the level's bucket next expects a token. Clients that honor it
+  drain cleanly through a throttled window (kube/chaos.py's
+  ``storm_429`` proves the controllers do);
+- ``exempt`` levels (leases — leader election and the cpshard
+  heartbeat/map protocol are how the plane recovers from overload, so
+  flow control must never starve them) admit unconditionally and are
+  only counted.
+
+Zero-cost when disabled: ``FakeKube`` checks ``self.apf is None`` per
+request. Per-client 429 tallies ride the same per-thread stats cells as
+every other request count (``request_counts_snapshot(by_client=True)``
+gains a ``"429"`` row), so throttling is attributable, not silent.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import math
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.kube import errors
+
+__all__ = [
+    "APF", "FlowSchema", "PriorityLevel", "default_levels",
+    "default_schemas",
+]
+
+
+class PriorityLevel:
+    """One concurrency lane. ``shares`` buys a fraction of the server's
+    total seat-turnover rate; ``exempt`` levels bypass throttling
+    entirely (counted, never queued or rejected)."""
+
+    def __init__(self, name: str, shares: int = 1, *,
+                 exempt: bool = False,
+                 queue_wait_s: float = 0.05,
+                 burst_s: float = 0.25):
+        self.name = name
+        self.shares = shares
+        self.exempt = exempt
+        self.queue_wait_s = queue_wait_s
+        self.burst_s = burst_s
+
+
+class FlowSchema:
+    """Classification rule: requests matching every given field land in
+    ``level``. ``clients``/``verbs``/``plurals`` are fnmatch pattern
+    tuples (None = wildcard); first matching schema in catalog order
+    wins, mirroring FlowSchema ``matchingPrecedence``."""
+
+    def __init__(self, name: str, level: str, *,
+                 clients: tuple | None = None,
+                 verbs: tuple | None = None,
+                 plurals: tuple | None = None):
+        self.name = name
+        self.level = level
+        self.clients = tuple(clients) if clients else None
+        self.verbs = tuple(verbs) if verbs else None
+        self.plurals = tuple(plurals) if plurals else None
+
+    def matches(self, client: str, verb: str,
+                plural: str | None) -> bool:
+        if self.clients is not None and not any(
+                fnmatch.fnmatchcase(client or "", p)
+                for p in self.clients):
+            return False
+        if self.verbs is not None and verb not in self.verbs:
+            return False
+        if self.plurals is not None and not any(
+                fnmatch.fnmatchcase(plural or "", p)
+                for p in self.plurals):
+            return False
+        return True
+
+
+class _Bucket:
+    """One level's token bucket. Balance may go negative — each queued
+    (sleeping) request holds a reservation, so the negative balance IS
+    the FIFO queue depth and arrival order is service order."""
+
+    def __init__(self, rate: float, cap: float, queue_limit: float,
+                 mono_fn):
+        self._lock = threading.Lock()
+        self._mono = mono_fn
+        self.rate = rate
+        self.cap = cap
+        self.queue_limit = queue_limit
+        self._tokens = cap
+        self._last = mono_fn()
+        self.admitted = 0
+        self.queued = 0
+        self.rejected = 0
+
+    def _refill_locked(self) -> None:
+        now = self._mono()
+        self._tokens = min(self.cap,
+                           self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self, max_wait_s: float):
+        """Reserve one token. Returns the seconds to sleep before the
+        reservation matures (0.0 = immediate). Raises TooManyRequests
+        when the wait would exceed ``max_wait_s`` or the virtual queue
+        is full."""
+        with self._lock:
+            self._refill_locked()
+            after = self._tokens - 1.0
+            wait = 0.0 if after >= 0 else -after / self.rate
+            if wait > max_wait_s or -after > self.queue_limit + 1.0:
+                self.rejected += 1
+                retry = max(1, math.ceil(wait if wait > 0
+                                         else 1.0 / self.rate))
+                raise errors.TooManyRequests(
+                    "priority level over its concurrency share "
+                    f"(expected free seat in ~{wait:.2f}s)",
+                    retry_after=retry,
+                )
+            self._tokens = after
+            self.admitted += 1
+            if wait > 0:
+                self.queued += 1
+            return wait
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "rate_rps": round(self.rate, 2),
+                "admitted": self.admitted,
+                "queued": self.queued,
+                "rejected": self.rejected,
+                "tokens": round(self._tokens, 2),
+            }
+
+
+class APF:
+    """The flow-control engine one FakeKube attaches
+    (``kube.enable_apf()``). ``admit(client, verb, plural)`` either
+    returns (possibly after a bounded FIFO queue wait) or raises 429
+    ``TooManyRequests`` with Retry-After."""
+
+    def __init__(self, levels=None, schemas=None, *,
+                 total_rate: float = 3000.0,
+                 default_level: str | None = None,
+                 mono_fn=None, sleep_fn=None):
+        self.levels = {lv.name: lv for lv in (levels or default_levels())}
+        self.schemas = list(schemas if schemas is not None
+                            else default_schemas())
+        self.total_rate = total_rate
+        self._mono = mono_fn if mono_fn is not None else time.monotonic
+        self._sleep = sleep_fn if sleep_fn is not None else time.sleep
+        for schema in self.schemas:
+            if schema.level not in self.levels:
+                raise ValueError(
+                    f"flow schema {schema.name!r} names unknown "
+                    f"priority level {schema.level!r}"
+                )
+        non_exempt = [lv for lv in self.levels.values() if not lv.exempt]
+        self.default_level = default_level or (
+            non_exempt[-1].name if non_exempt
+            else next(iter(self.levels))
+        )
+        total_shares = sum(lv.shares for lv in non_exempt) or 1
+        self._buckets: dict[str, _Bucket] = {}
+        for lv in non_exempt:
+            rate = max(1.0, total_rate * lv.shares / total_shares)
+            self._buckets[lv.name] = _Bucket(
+                rate=rate,
+                cap=max(4.0, rate * lv.burst_s),
+                queue_limit=max(1.0, rate * lv.queue_wait_s),
+                mono_fn=self._mono,
+            )
+        self._stats_lock = threading.Lock()
+        self._exempt_admitted: dict[str, int] = {}
+        self._by_schema: dict[str, int] = {}
+
+    # ------------------------------------------------------------- intake
+
+    def classify(self, client: str, verb: str,
+                 plural: str | None) -> tuple[str, str]:
+        """(schema name, level name) for one request."""
+        for schema in self.schemas:
+            if schema.matches(client, verb, plural):
+                return schema.name, schema.level
+        return "(catch-all)", self.default_level
+
+    def admit(self, client: str, verb: str,
+              plural: str | None = None) -> None:
+        """Flow-control one request; may sleep (bounded FIFO queue) and
+        may raise ``TooManyRequests``. Called by FakeKube._count with no
+        fake lock held."""
+        schema_name, level_name = self.classify(client, verb, plural)
+        with self._stats_lock:
+            self._by_schema[schema_name] = \
+                self._by_schema.get(schema_name, 0) + 1
+        level = self.levels[level_name]
+        if level.exempt:
+            with self._stats_lock:
+                self._exempt_admitted[level_name] = \
+                    self._exempt_admitted.get(level_name, 0) + 1
+            return
+        wait = self._buckets[level_name].take(level.queue_wait_s)
+        if wait > 0:
+            self._sleep(wait)
+
+    # ------------------------------------------------------------- output
+
+    def snapshot(self) -> dict:
+        """Per-level admission/queue/reject tallies plus the per-schema
+        request split — cpbench scenario extras and unit assertions."""
+        out = {"levels": {}, "schemas": {}}
+        for name, lv in self.levels.items():
+            if lv.exempt:
+                with self._stats_lock:
+                    n = self._exempt_admitted.get(name, 0)
+                out["levels"][name] = {"exempt": True, "admitted": n}
+            else:
+                out["levels"][name] = self._buckets[name].snapshot()
+        with self._stats_lock:
+            out["schemas"] = dict(self._by_schema)
+        return out
+
+
+def default_levels() -> list[PriorityLevel]:
+    """The default priority-level catalog (docs/ha.md): shaped after the
+    real suggested configuration — leases exempt (the recovery
+    substrate), node/kubelet traffic assured, controllers broad but
+    bounded, a watch lane of its own, and a small catch-all so an
+    untagged stormer squeezes itself, not the plane."""
+    return [
+        PriorityLevel("exempt", shares=0, exempt=True),
+        PriorityLevel("node-critical", shares=30),
+        PriorityLevel("watch-lane", shares=15, queue_wait_s=0.1),
+        PriorityLevel("workload-high", shares=40),
+        PriorityLevel("global-default", shares=15),
+    ]
+
+
+def default_schemas() -> list[FlowSchema]:
+    return [
+        # lease traffic is how the plane heals (leader election, the
+        # cpshard membership/map/ack protocol): never flow-controlled —
+        # the same reasoning as upstream's system-leader-election level
+        FlowSchema("system-leases", "exempt", plurals=("leases",)),
+        FlowSchema("kubelet", "node-critical", clients=("kubelet",)),
+        FlowSchema("watches", "watch-lane", verbs=("watch",)),
+        FlowSchema("controllers", "workload-high",
+                   clients=("manager*", "*Reconciler", "(gc)")),
+    ]

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import contextlib
 import datetime
+import fnmatch
 import random
 import threading
 import time
@@ -83,6 +84,15 @@ class ChaosInjector:
         self._drop_rate = 0.0
         self._drop_types: tuple | None = None    # None = any event type
         self._reorder_rate = 0.0
+        #: 429 storm (the priority-and-fairness fault): matching clients
+        #: get TooManyRequests + Retry-After for a window — a throttled
+        #: apiserver squeezing specific flows, as APF would under real
+        #: overload. Controllers must retry through it without losing a
+        #: booking (cpbench chaos_429_storm proves they do).
+        self._storm429_until = 0.0
+        self._storm429_clients: tuple = ()
+        self._storm429_rate = 1.0
+        self._storm429_retry = 1
         #: reordering holds ONE event per watch channel until the next
         #: event overtakes it: id(watch) -> (held_since, watch, event)
         self._held: dict[int, tuple] = {}
@@ -109,6 +119,7 @@ class ChaosInjector:
         "gone_storm", "verb_latency_set", "verb_error_rate_set",
         "watch_faults_set", "nodes_killed", "nodes_repaired",
         "kubelet_stalled", "kubelet_unstalled",
+        "storm_429_started", "storm_429_ended",
     })
 
     # ------------------------------------------------------------ journal
@@ -182,6 +193,31 @@ class ChaosInjector:
         mid-churn cannot deadlock against in-flight verbs."""
         self._kube.compact_history(plural, group)
         self._note("gone_storm", plural=plural or "*")
+
+    def storm_429(self, clients: tuple = ("*",),
+                  duration_s: float = 1.0, rate: float = 1.0,
+                  retry_after: int = 1) -> None:
+        """Per-client throttle burst: for ``duration_s``, requests from
+        clients matching any fnmatch pattern in ``clients`` (the PR 10
+        attribution names — "manager", "kubelet", "*Reconciler", a
+        tagged bench handle) raise 429 ``TooManyRequests`` carrying
+        ``Retry-After: retry_after`` at probability ``rate``. Everyone
+        else keeps their seats — this is flow control squeezing a flow,
+        not an outage. Throttled requests are counted per client in
+        ``request_counts_snapshot(by_client=True)`` (the "429" row) and
+        as ``request_throttled`` in the injection counters."""
+        with self._lock:
+            self._storm429_until = time.monotonic() + duration_s
+            self._storm429_clients = tuple(clients)
+            self._storm429_rate = rate
+            self._storm429_retry = retry_after
+        self._note("storm_429_started", duration_s=duration_s,
+                   clients=",".join(clients), rate=rate)
+
+    def end_storm_429(self) -> None:
+        with self._lock:
+            self._storm429_until = 0.0
+        self._note("storm_429_ended")
 
     def set_verb_latency(self, verb: str, seconds: float) -> None:
         """Add fixed latency to one verb ('*' = all); 0 clears."""
@@ -276,9 +312,10 @@ class ChaosInjector:
 
     # ------------------------------------------------- FakeKube hook: API
 
-    def admit(self, verb: str) -> None:
+    def admit(self, verb: str, client: str | None = None) -> None:
         """Called by FakeKube at the top of every external request; may
-        sleep (latency) and may raise 503 (blackout / error rate)."""
+        sleep (latency) and may raise 503 (blackout / error rate) or
+        429 (a storm_429 window squeezing this client's flow)."""
         with self._lock:
             now = time.monotonic()
             blackout = now < self._blackout_until
@@ -287,12 +324,26 @@ class ChaosInjector:
             rate = self._verb_error_rate.get(
                 verb, self._verb_error_rate.get("*", 0.0))
             flaky = rate > 0 and self._rng.random() < rate
+            throttled = (
+                now < self._storm429_until
+                and any(fnmatch.fnmatchcase(client or "", p)
+                        for p in self._storm429_clients)
+                and (self._storm429_rate >= 1.0
+                     or self._rng.random() < self._storm429_rate)
+            )
+            retry_after = self._storm429_retry
         if delay > 0:
             time.sleep(delay)
         if blackout:
             self._note("request_blackholed", verb=verb)
             raise errors.ServiceUnavailable(
                 f"chaos: apiserver blackout ({verb})"
+            )
+        if throttled:
+            self._note("request_throttled", verb=verb, client=client)
+            raise errors.TooManyRequests(
+                f"chaos: 429 storm squeezing {client!r} ({verb})",
+                retry_after=retry_after,
             )
         if flaky:
             self._note("request_errored", verb=verb)

@@ -12,7 +12,7 @@ class ApiError(Exception):
         self.message = message or self.reason
 
     def to_status(self) -> dict:
-        return {
+        status = {
             "kind": "Status",
             "apiVersion": "v1",
             "status": "Failure",
@@ -20,18 +20,35 @@ class ApiError(Exception):
             "reason": self.reason,
             "code": self.code,
         }
+        # retryable rejections (503 outages, 429 flow control) carry the
+        # server's backoff hint in the body too (the real apiserver's
+        # StatusDetails.retryAfterSeconds), so a wire client rebuilding
+        # the error from the parsed Status keeps the REAL hint — without
+        # it, every transported 429 would collapse to the 1 s default
+        # and a Retry-After-honoring controller would hammer a lane that
+        # asked for 7 s
+        retry_after = getattr(self, "retry_after", None)
+        if retry_after is not None:
+            status["details"] = {"retryAfterSeconds": int(retry_after)}
+        return status
 
     @staticmethod
     def from_status(status: dict) -> "ApiError":
         code = status.get("code", 500)
         msg = status.get("message", "")
+        retry_after = (status.get("details") or {}).get(
+            "retryAfterSeconds")
         for cls in (NotFound, Conflict, AlreadyExists, BadRequest, Forbidden,
-                    Invalid, Gone, ServiceUnavailable):
+                    Invalid, Gone, ServiceUnavailable, TooManyRequests):
             if cls.code == code and (
                 cls.reason == status.get("reason")
                 or cls in (NotFound, Gone)
             ):
-                return cls(msg)
+                err = cls(msg)
+                if retry_after is not None and \
+                        hasattr(err, "retry_after"):
+                    err.retry_after = int(retry_after)
+                return err
         err = ApiError(msg)
         err.code = code
         return err
@@ -72,6 +89,21 @@ class Gone(ApiError):
     apiserver's signal that a watcher must relist (reason "Expired")."""
     code = 410
     reason = "Expired"
+
+
+class TooManyRequests(ApiError):
+    """429: apiserver flow control (priority-and-fairness) rejected the
+    request — the client's flow exhausted its concurrency share and its
+    queue. Retryable by definition, and ``retry_after`` tells the
+    client WHEN its lane expects a free seat (the Retry-After header on
+    the wire); clients that honor it drain through a throttled window
+    without hammering, clients that don't just earn more 429s."""
+    code = 429
+    reason = "TooManyRequests"
+
+    def __init__(self, message: str = "", retry_after: int | None = 1):
+        super().__init__(message)
+        self.retry_after = retry_after
 
 
 class ServiceUnavailable(ApiError):

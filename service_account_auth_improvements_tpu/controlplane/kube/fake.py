@@ -260,6 +260,13 @@ class FakeKube:
         #: the hooks reduce to one attribute check per request/event —
         #: the bench gate holds the healthy path to its usual numbers
         self.chaos = None
+        #: apiserver priority-and-fairness (kube/apf.py): flow schemas +
+        #: priority levels over the per-client attribution above. None =
+        #: no flow control (one attribute check per request, like chaos);
+        #: enable_apf() attaches an engine, and rejected requests raise
+        #: 429 TooManyRequests with Retry-After AND book a per-client
+        #: "429" tally so throttling is attributable, not silent.
+        self.apf = None
         #: auto-compaction: every N emitted events, drop the retained
         #: watch history (an aggressive etcd compaction). A watcher that
         #: reconnects from a pre-compaction RV gets 410 Gone and must
@@ -294,6 +301,23 @@ class FakeKube:
             self.chaos = ChaosInjector(self, seed=seed)
         return self.chaos
 
+    def enable_apf(self, apf=None, **kwargs):
+        """Attach (or return) this fake's priority-and-fairness engine
+        (kube/apf.py). Pass a constructed ``APF`` or keyword arguments
+        for one (levels/schemas/total_rate); default is the suggested
+        catalog — leases exempt, kubelet assured, controllers bounded."""
+        from service_account_auth_improvements_tpu.controlplane.kube.apf import (  # noqa: E501  (local import: flow control is optional machinery)
+            APF,
+        )
+
+        if self.apf is None:
+            self.apf = apf if apf is not None else APF(**kwargs)
+        return self.apf
+
+    def disable_apf(self) -> None:
+        """Drop flow control (the A/B lever the ha_apf bench arms flip)."""
+        self.apf = None
+
     def client_for(self, client_id: str) -> "_TaggedClient":
         """A client handle whose requests count under ``client_id`` in
         ``request_counts_snapshot(by_client=True)``. Same interface as
@@ -308,8 +332,9 @@ class FakeKube:
         running it, whichever handle it borrowed."""
         self.actor_fn = fn
 
-    def _count(self, verb: str) -> None:
-        if getattr(self._internal, "depth", 0):
+    def _count(self, verb: str, plural: str | None = None) -> None:
+        internal = bool(getattr(self._internal, "depth", 0))
+        if internal:
             client = "(gc)"
         else:
             client = None
@@ -337,9 +362,23 @@ class FakeKube:
         if by is None:
             by = cell.by_client[client] = {}
         by[verb] = by.get(verb, 0) + 1
-        if self.chaos is not None and \
-                not getattr(self._internal, "depth", 0):
-            self.chaos.admit(verb)
+        if internal or (self.chaos is None and self.apf is None):
+            # internal actors (the synchronous GC cascade, chaos's own
+            # remediation) are not network clients: neither faults nor
+            # flow control apply to them
+            return
+        try:
+            if self.chaos is not None:
+                self.chaos.admit(verb, client)
+            if self.apf is not None:
+                self.apf.admit(client, verb, plural)
+        except errors.TooManyRequests:
+            # throttling must be attributable, not silent: the per-client
+            # "429" row is how a bench (and an operator reading the
+            # by-client split) sees WHO got squeezed
+            cell.verbs["429"] = cell.verbs.get("429", 0) + 1
+            by["429"] = by.get("429", 0) + 1
+            raise
 
     def request_counts_snapshot(self, by_client: bool = False):
         """Copy of the per-verb tally (scenarios diff two snapshots);
@@ -499,7 +538,7 @@ class FakeKube:
 
     def create(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None) -> dict:
-        self._count("create")
+        self._count("create", plural)
         res = self._res(plural, group)
         if res.kind == "SubjectAccessReview":
             return self._evaluate_sar(obj)
@@ -609,7 +648,7 @@ class FakeKube:
 
     def get(self, plural: str, name: str, namespace: str | None = None,
             group: str | None = None) -> dict:
-        self._count("get")
+        self._count("get", plural)
         res = self._res(plural, group)
         key = self._key(res, namespace, name)
         stripe = self._stripe(self._family(res), key[2])
@@ -623,7 +662,7 @@ class FakeKube:
     def list(self, plural: str, namespace: str | None = None,
              label_selector: str = "", field_selector: str = "",
              group: str | None = None) -> dict:
-        self._count("list")
+        self._count("list", plural)
         res = self._res(plural, group)
         pred = parse_label_selector(label_selector)
         fpred = parse_field_selector(field_selector)
@@ -670,7 +709,7 @@ class FakeKube:
 
     def update(self, plural: str, obj: dict, namespace: str | None = None,
                group: str | None = None, subresource: str | None = None) -> dict:
-        self._count("update")
+        self._count("update", plural)
         res = self._res(plural, group)
         meta_in = obj.get("metadata") or {}
         name = meta_in.get("name")
@@ -757,7 +796,7 @@ class FakeKube:
 
     def patch(self, plural: str, name: str, patch, namespace: str | None = None,
               group: str | None = None, patch_type: str = "merge") -> dict:
-        self._count("patch")
+        self._count("patch", plural)
         res = self._res(plural, group)
         key = self._key(res, namespace, name)
         fam = self._family(res)
@@ -809,7 +848,7 @@ class FakeKube:
 
     def delete(self, plural: str, name: str, namespace: str | None = None,
                group: str | None = None) -> dict:
-        self._count("delete")
+        self._count("delete", plural)
         res = self._res(plural, group)
         key = self._key(res, namespace, name)
         fam = self._family(res)
@@ -987,7 +1026,7 @@ class FakeKube:
         status, not a truncated 200 stream). The returned generator blocks
         waiting for events; it ends after ``timeout`` seconds of inactivity
         if given (else runs until closed by the caller)."""
-        self._count("watch")
+        self._count("watch", plural)
         res = self._res(plural, group)
         fam = self._family(res)
         rv = int(resource_version or 0)

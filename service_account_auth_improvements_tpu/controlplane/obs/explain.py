@@ -43,7 +43,9 @@ from service_account_auth_improvements_tpu.controlplane.obs.trace import (
 
 #: journal kinds with no per-object key that still belong on every
 #: overlapping timeline — cluster-level causes of object-level symptoms
-AMBIENT_KINDS = ("chaos", "lease")
+#: (shard: election + handoff windows — a key that stalled because its
+#: shard was mid-handoff needs the map epoch named, not a generic wait)
+AMBIENT_KINDS = ("chaos", "lease", "shard")
 
 #: span names that carry explanatory weight (the reconcile firehose is
 #: summarized, not listed — except failures, which are always evidence)
@@ -262,6 +264,35 @@ def explain(namespace: str | None, name: str, *, kube=None, tracer=None,
                     "verb 503, watch channels severed)")
         elif action == "blackout_ended":
             what = "chaos: apiserver blackout ended"
+        elif action == "storm_429_started":
+            what = (f"chaos: 429 storm began "
+                    f"({attrs.get('duration_s', '?')}s window — clients "
+                    f"[{attrs.get('clients', '?')}] throttled with "
+                    "Retry-After)")
+        elif action == "storm_429_ended":
+            what = "chaos: 429 storm ended"
+        elif e["kind"] == "lease":
+            what = (f"lease {action}: {attrs.get('identity', '?')} "
+                    f"({attrs.get('detail', '')})").strip()
+        elif action == "map_applied":
+            what = (f"shard: map epoch {attrs.get('epoch', '?')} "
+                    f"published by {attrs.get('coordinator', '?')} "
+                    f"({attrs.get('members', '?')} member(s), "
+                    f"{attrs.get('moved', '?')} shard(s) moved)")
+        elif action == "map_seen":
+            what = (f"shard: {attrs.get('identity', '?')} applied epoch "
+                    f"{attrs.get('epoch', '?')} "
+                    f"(+{attrs.get('gained', 0)}/-{attrs.get('lost', 0)} "
+                    "shards)")
+        elif action == "handoff_acked":
+            what = (f"shard: {attrs.get('identity', '?')} drained and "
+                    f"acked epoch {attrs.get('epoch', '?')}")
+        elif action == "handoff_gained":
+            what = (f"shard: {attrs.get('identity', '?')} activated "
+                    f"{attrs.get('shards', '?')} gained shard(s) at "
+                    f"epoch {attrs.get('epoch', '?')} (barrier cleared)")
+        elif action in ("fenced", "unfenced"):
+            what = f"shard: {attrs.get('identity', '?')} {action}"
         items.append({"wall": wall, "source": e["kind"], "what": what,
                       "attrs": attrs})
 
@@ -323,7 +354,26 @@ def _verdict(obj, ready, items, sources) -> str:
             blocking = f"invalid TPU spec: {cond.get('message', '')}"
     if blocking:
         return "not Ready — " + blocking
+    # ONE reversed scan so RECENCY picks the verdict: a key that moved
+    # replicas an hour ago must not outrank the blackout happening now
     for i in reversed(items):
+        # per-key shard journal entry (engine/manager.py's worker gate
+        # journals the drop when a queued key's shard moved away): the
+        # key changed replicas mid-reconcile — the new owner's requeue
+        # is responsible now, and the timeline names it
+        if i["source"] == "journal" \
+                and (i.get("attrs") or {}).get("action") == "moved":
+            a = i["attrs"]
+            return ("not Ready — key moved replicas mid-reconcile "
+                    f"(shard {a.get('shard', '?')} handed from "
+                    f"{a.get('identity', '?')} to {a.get('owner', '?')}; "
+                    "awaiting the new owner's requeue)")
+        # only CHAOS qualifies as a blamable cluster-level cause:
+        # shard ambient entries (map epochs, handoff acks) fire on
+        # every routine startup/rolling-restart of a sharded plane and
+        # would misattribute an ordinary still-reconciling object —
+        # they stay in the timeline, but only the per-key "moved"
+        # entry above implicates sharding for THIS key
         if i["source"] == "chaos":
             return ("not Ready — most recent cluster-level cause: "
                     + i["what"])

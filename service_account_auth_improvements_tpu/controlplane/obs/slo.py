@@ -75,6 +75,18 @@ DEFAULT_OBJECTIVES = (
         "churn, p95 under 5s",
         target_ms=5_000.0,
     ),
+    Objective(
+        "failover",
+        "leader/replica kill -> orphaned shards re-owned and their "
+        "pending keys reconciled, p95 under 30s",
+        # the ceiling budgets PRODUCTION 15 s leases: a crashed
+        # replica's member + coordinator leases must expire
+        # (duration x 1.25 skew tolerance ~ 19 s, measured 22.6 s
+        # end-to-end over real HTTP binaries) before re-election and
+        # re-mapping even start — bench worlds with 1 s leases measure
+        # ~1.7 s, but the promise must hold at production timings
+        target_ms=30_000.0,
+    ),
 )
 
 OBJECTIVES_BY_NAME = {o.name: o for o in DEFAULT_OBJECTIVES}

@@ -324,15 +324,19 @@ def test_bench_gate_chaos_legs():
     assert bg.chaos_gate(chaos_record(), require_all=True) == []
     # each invariant leg trips on every scenario carrying the defect
     fails = bg.chaos_gate(chaos_record(db=1), require_all=True)
-    assert len(fails) == 4 and all("double_bookings" in f for f in fails)
+    n_family = len(bg.CHAOS_SCENARIOS)
+    assert len(fails) == n_family and all("double_bookings" in f
+                                           for f in fails)
     fails = bg.chaos_gate(chaos_record(orphans=2), require_all=True)
-    assert len(fails) == 4 and all("orphaned_children" in f
-                                   for f in fails)
+    assert len(fails) == n_family and all("orphaned_children" in f
+                                           for f in fails)
     fails = bg.chaos_gate(
         chaos_record(violations={"false_ready": 1}), require_all=True)
-    assert len(fails) == 4 and all("violations" in f for f in fails)
+    assert len(fails) == n_family and all("violations" in f
+                                           for f in fails)
     fails = bg.chaos_gate(chaos_record(recovery=False), require_all=True)
-    assert len(fails) == 4 and all("recovery_ms" in f for f in fails)
+    assert len(fails) == n_family and all("recovery_ms" in f
+                                           for f in fails)
     # an absent scenario only fails the dedicated chaos lane
     partial = chaos_record()
     del partial["scenarios"]["chaos_node_death"]

@@ -1,0 +1,923 @@
+"""cpshard + APF tests (ISSUE 12, docs/ha.md).
+
+The shard half: deterministic key→shard hashing, rendezvous minimal
+movement, the member/coordinator Lease protocol (cover, disjoint,
+graceful leave, crash failover), the ack barrier with drain-before-ack,
+the Manager's enqueue/worker gates, and the ownership HAMMER — three
+replicas through join / leave / leader-kill while a CR population
+drains, asserting the two invariants the protocol exists for: never
+dual-reconcile a key, never orphan one. Runs under CPLINT_LOCKWATCH=1
+in the tier-1 lane, so every lock the new machinery takes is
+order-checked for free.
+
+The APF half: storming flow squeezed, kubelet flow unharmed, exempt
+lane untouchable, Retry-After honored (injected clock — deterministic),
+per-client 429 attribution, and the chaos ``storm_429`` injector.
+
+Plus the ``bench_gate --failover`` leg (known-good/known-bad + CLI) and
+the explain engine's "key moved replicas mid-reconcile" verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Manager,
+    Reconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+    LEASE_GROUP,
+    LeaderElector,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.queue import (
+    RateLimitingQueue,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    shard as shard_mod,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.shard import (
+    ANN_EPOCH,
+    ANN_MAP,
+    ANN_MEMBERS,
+    DEFAULT_NUM_SHARDS,
+    ShardMember,
+    ShardRuntime,
+    assign,
+    shard_of,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.apf import (
+    APF,
+    FlowSchema,
+    PriorityLevel,
+)
+from service_account_auth_improvements_tpu.controlplane.obs import (
+    Journal,
+    Tracer,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.slo import (
+    OBJECTIVES_BY_NAME,
+)
+
+GROUP = "tpukf.dev"
+ALL_SHARDS = frozenset(range(DEFAULT_NUM_SHARDS))
+
+
+def _wait(pred, timeout=8.0, period=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(period)
+    return pred()
+
+
+# ------------------------------------------------------------ pure hashing
+
+def test_shard_of_deterministic_and_spread():
+    assert shard_of("ns", "a") == shard_of("ns", "a")
+    # the hash must not be Python's randomized hash(): pin a value so a
+    # future "optimization" to hash() (which varies per process) fails
+    # loudly instead of silently splitting ownership across replicas
+    import zlib
+
+    assert shard_of("ns", "a") == zlib.crc32(b"ns/a") % DEFAULT_NUM_SHARDS
+    hit = {shard_of(f"ns{i % 8}", f"nb-{i}") for i in range(2000)}
+    assert len(hit) == DEFAULT_NUM_SHARDS  # every shard reachable
+
+
+def test_rendezvous_minimal_movement():
+    three = assign(DEFAULT_NUM_SHARDS, ["r0", "r1", "r2"])
+    two = assign(DEFAULT_NUM_SHARDS, ["r0", "r1"])
+    # only the departed member's shards change owner
+    moved = [s for s in three if three[s] != two[s]]
+    assert moved and all(three[s] == "r2" for s in moved)
+    # join moves only shards TO the joiner
+    four = assign(DEFAULT_NUM_SHARDS, ["r0", "r1", "r2", "r3"])
+    moved = [s for s in three if three[s] != four[s]]
+    assert moved and all(four[s] == "r3" for s in moved)
+    # rough balance: nobody owns more than half the space at N=3
+    from collections import Counter
+
+    counts = Counter(three.values())
+    assert max(counts.values()) <= DEFAULT_NUM_SHARDS // 2
+    assert assign(DEFAULT_NUM_SHARDS, []) == {}
+
+
+# ----------------------------------------------------- protocol end-to-end
+
+def test_members_cover_disjoint_then_leave_then_kill():
+    kube = FakeKube()
+    r0 = ShardRuntime(kube, "r0", lease_duration=0.6,
+                      tick_period=0.05).start()
+    r1 = ShardRuntime(kube, "r1", lease_duration=0.6,
+                      tick_period=0.05).start()
+    try:
+        def covered():
+            a0, a1 = (r0.member.active_shards(),
+                      r1.member.active_shards())
+            return a0 | a1 == ALL_SHARDS and not (a0 & a1) \
+                and a0 and a1
+        assert _wait(covered), (r0.member.active_shards(),
+                                r1.member.active_shards())
+        assert r0.is_coordinator() != r1.is_coordinator() or \
+            _wait(lambda: r0.is_coordinator() != r1.is_coordinator())
+        # graceful leave: reassignment without waiting out the expiry
+        t0 = time.monotonic()
+        r1.stop()
+        assert _wait(
+            lambda: r0.member.active_shards() == ALL_SHARDS)
+        # crash: a replacement must take over AFTER the lease expiry
+        r2 = ShardRuntime(kube, "r2", lease_duration=0.6,
+                          tick_period=0.05).start()
+        try:
+            assert _wait(lambda: (r0.member.active_shards()
+                                  | r2.member.active_shards())
+                         == ALL_SHARDS
+                         and not (r0.member.active_shards()
+                                  & r2.member.active_shards()))
+            r0.kill()
+            t0 = time.monotonic()
+            assert _wait(
+                lambda: r2.member.active_shards() == ALL_SHARDS,
+                timeout=12)
+            # failover waited out the abandoned leases (no instant
+            # takeover = the fencing convention held)
+            assert time.monotonic() - t0 >= 0.2
+        finally:
+            r2.stop()
+    finally:
+        r0.kill()
+        r1.kill()
+
+
+def _write_map(kube, group, epoch, mapping, members):
+    body = {
+        "apiVersion": f"{LEASE_GROUP}/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": f"{group}-map", "namespace": "kubeflow",
+            "annotations": {
+                ANN_EPOCH: str(epoch),
+                ANN_MAP: json.dumps(
+                    {str(s): o for s, o in mapping.items()}),
+                ANN_MEMBERS: json.dumps(sorted(members)),
+            },
+        },
+        "spec": {"holderIdentity": "test-coordinator"},
+    }
+    try:
+        kube.create("leases", body, namespace="kubeflow",
+                    group=LEASE_GROUP)
+    except errors.AlreadyExists:
+        cur = kube.get("leases", f"{group}-map", namespace="kubeflow",
+                       group=LEASE_GROUP)
+        body["metadata"]["resourceVersion"] = \
+            cur["metadata"]["resourceVersion"]
+        kube.update("leases", body, namespace="kubeflow",
+                    group=LEASE_GROUP)
+
+
+def test_ack_barrier_gains_wait_for_old_owner_drain():
+    """The never-dual-reconcile core: B may not activate a gained shard
+    until A (its previous owner, still live) has DRAINED and acked."""
+    kube = FakeKube()
+    group = "barrier"
+    a = ShardMember(kube, "A", group=group, lease_duration=0.6,
+                    tick_period=0.05)
+    b = ShardMember(kube, "B", group=group, lease_duration=0.6,
+                    tick_period=0.05)
+    draining = {"blocked": True}
+    a.drain_fn = lambda shards: not draining["blocked"]
+    a.start()
+    b.start()
+    try:
+        every = {s: "A" for s in range(DEFAULT_NUM_SHARDS)}
+        _write_map(kube, group, 1, every, ["A", "B"])
+        assert _wait(lambda: a.active_shards() == ALL_SHARDS)
+        # epoch 2 moves shard 0 to B — while A pretends a reconcile of
+        # it is still in flight
+        moved = dict(every)
+        moved[0] = "B"
+        _write_map(kube, group, 2, moved, ["A", "B"])
+        assert _wait(lambda: a.admit and 0 not in a.active_shards())
+        # B sees the gain but must HOLD: A is live and has not acked
+        key_ns, key_name = _key_in_shard(0)
+        assert _wait(lambda: b.admit(key_ns, key_name) == shard_mod.HOLD)
+        time.sleep(0.3)   # barrier must still be holding
+        assert b.admit(key_ns, key_name) == shard_mod.HOLD
+        assert b.active_shards() == frozenset()
+        # A drains → acks → B activates
+        draining["blocked"] = False
+        assert _wait(lambda: b.admit(key_ns, key_name) == shard_mod.OWN)
+        assert a.admit(key_ns, key_name) == shard_mod.FOREIGN
+    finally:
+        a.kill()
+        b.kill()
+
+
+def _key_in_shard(shard: int, ns: str = "ns") -> tuple[str, str]:
+    i = 0
+    while True:
+        name = f"k{i}"
+        if shard_of(ns, name) == shard:
+            return ns, name
+        i += 1
+
+
+# ------------------------------------------------------- manager shard gates
+
+class _StubShard:
+    """Scriptable ShardMember stand-in for the Manager-gate tests."""
+
+    def __init__(self):
+        self.verdict = shard_mod.OWN
+        self.identity = "stub"
+
+    def admit(self, namespace, name):
+        return self.verdict
+
+    def shard_for(self, namespace, name):
+        return shard_of(namespace, name)
+
+    def owner_of(self, namespace, name):
+        return "somebody-else"
+
+
+class _CountingReconciler(Reconciler):
+    resource = "notebooks"
+    group = GROUP
+
+    def __init__(self):
+        self.seen: list[str] = []
+        self._lock = threading.Lock()
+
+    def reconcile(self, request):
+        with self._lock:
+            self.seen.append(request.name)
+        return None
+
+
+def test_manager_gates_foreign_hold_and_journal():
+    kube = FakeKube()
+    trace = Tracer()
+    journal = Journal().attach(trace)
+    mgr = Manager(kube, tracer=trace, default_workers=2)
+    rec = _CountingReconciler()
+    mgr.add_reconciler(rec)
+    stub = _StubShard()
+    mgr.shard = stub
+    mgr.start()
+    try:
+        # FOREIGN: the event never enters the queue, nothing reconciles
+        stub.verdict = shard_mod.FOREIGN
+        kube.create("notebooks", {"metadata": {"name": "f",
+                                               "namespace": "ns"}},
+                    group=GROUP)
+        time.sleep(0.3)
+        assert "f" not in rec.seen
+        # HOLD: enqueued but parked; flipping to OWN releases it
+        stub.verdict = shard_mod.HOLD
+        kube.create("notebooks", {"metadata": {"name": "h",
+                                               "namespace": "ns"}},
+                    group=GROUP)
+        time.sleep(0.3)
+        assert "h" not in rec.seen
+        stub.verdict = shard_mod.OWN
+        assert _wait(lambda: "h" in rec.seen)
+        # a dequeued key whose shard moved away journals the move —
+        # the explain engine's "key moved replicas" evidence
+        stub.verdict = shard_mod.HOLD
+        kube.create("notebooks", {"metadata": {"name": "m",
+                                               "namespace": "ns"}},
+                    group=GROUP)
+        time.sleep(0.2)
+        stub.verdict = shard_mod.FOREIGN
+        key = obs.object_key("notebooks", "ns", "m")
+        assert _wait(lambda: any(
+            e["attrs"].get("action") == "moved"
+            for e in journal.entries(key=key)))
+        assert "m" not in rec.seen
+    finally:
+        mgr.stop()
+
+
+def test_manager_requeue_owned_and_drop_foreign():
+    kube = FakeKube()
+    mgr = Manager(kube, default_workers=2)
+    rec = _CountingReconciler()
+    ctl = mgr.add_reconciler(rec)
+    stub = _StubShard()
+    mgr.shard = stub
+    stub.verdict = shard_mod.FOREIGN
+    mgr.start()
+    try:
+        for i in range(6):
+            kube.create("notebooks", {"metadata": {"name": f"x{i}",
+                                                   "namespace": "ns"}},
+                        group=GROUP)
+        time.sleep(0.3)
+        assert rec.seen == []
+        # gaining the space: requeue_owned re-enters every cached key
+        stub.verdict = shard_mod.OWN
+        n = mgr.requeue_owned()
+        assert n == 6
+        assert _wait(lambda: len(set(rec.seen)) == 6)
+        # losing it again: queued keys are pruned
+        stub.verdict = shard_mod.HOLD   # keys enqueue but park
+        for i in range(6):
+            kube.create("notebooks", {"metadata": {"name": f"y{i}",
+                                                   "namespace": "ns"}},
+                        group=GROUP)
+        time.sleep(0.3)
+        stub.verdict = shard_mod.FOREIGN
+        dropped = mgr.drop_foreign()
+        assert dropped >= 1
+        assert len(ctl.queue) == 0 or _wait(
+            lambda: len(ctl.queue) == 0)
+    finally:
+        mgr.stop()
+
+
+def test_queue_pending_discard_processing():
+    q = RateLimitingQueue()
+    q.add("a")
+    q.add("b")
+    q.add_after("c", 30)
+    assert sorted(q.pending_keys()) == ["a", "b", "c"]
+    assert q.discard(["a", "c"]) == 2
+    assert q.pending_keys() == ["b"]
+    got = q.get(timeout=1)
+    assert got == "b"
+    assert q.processing() == ["b"]
+    # a dirty re-add of a discarded key is dropped too
+    q.add("b")              # b is processing → dirty
+    assert q.discard(["b"]) == 1
+    q.done("b")
+    assert len(q) == 0
+    assert q.processing() == []
+
+
+# ------------------------------------------------------------- the hammer
+
+def test_shard_ownership_hammer_join_leave_leaderkill():
+    """Concurrent replicas through join / graceful leave / leader-kill:
+    never dual-reconcile a key, never orphan one. The cpbench _HAWorld
+    IS the harness (its ledger wraps every replica's reconcile), driven
+    here at unit scale."""
+    from service_account_auth_improvements_tpu.controlplane.cpbench.ha import (  # noqa: E501
+        _HAReplica,
+        _HAWorld,
+        BenchConfig,
+    )
+    from service_account_auth_improvements_tpu.controlplane.cpbench.tracker import (  # noqa: E501
+        Tracker,
+    )
+
+    cfg = BenchConfig(n=24, timeout=30.0)
+    tracker = Tracker("hammer")
+    world = _HAWorld(cfg, tracker, replicas=3, lease_s=0.6, tick_s=0.05)
+    pairs = []
+
+    def create(tag, n):
+        new = [(f"hs-{i % 4}", f"{tag}-{i:03d}") for i in range(n)]
+        pairs.extend(new)
+        for ns, name in new:
+            tracker.expect(ns, name)
+            world.kube.create("notebooks", {
+                "metadata": {"name": name, "namespace": ns}, "spec": {},
+            }, group=GROUP)
+        return new
+
+    try:
+        world.start()
+        assert world.wait_covered(12)
+        create("w1", 24)
+        assert tracker.wait_ready(pairs, 20)
+        # leader-kill mid-flight: find the coordinator, kill it, keep
+        # creating into the failover window
+        victim = None
+        assert _wait(lambda: any(r.runtime.is_coordinator()
+                                 for r in world.replicas))
+        for r in world.replicas:
+            if r.runtime.is_coordinator():
+                victim = r
+        victim.kill()
+        create("w2", 16)
+        assert tracker.wait_ready(pairs, 25), [
+            (ns, n) for ns, n in pairs
+            if (tracker.record(ns, n) or None) is None
+            or tracker.record(ns, n).ready is None
+        ]
+        # join: a fresh replica rebalances, and a graceful leave of an
+        # original survivor hands its space over cleanly
+        joiner = _HAReplica(world.kube, 9, world)
+        world.replicas.append(joiner)
+        joiner.start()
+        survivor = next(r for r in world.replicas
+                        if r is not victim and r is not joiner)
+        time.sleep(0.5)     # let the joiner enter the map
+        survivor.stop()
+        create("w3", 16)
+        assert tracker.wait_ready(pairs, 25)
+        led = world.ledger.snapshot()
+        assert led["violations"] == [], led["violations"]
+        # every replica that ran did real work at some point
+        assert sum(led["counts"].values()) >= len(pairs)
+    finally:
+        world.stop()
+
+
+# ------------------------------------------------------------------- APF
+
+def _clocked_apf(**kw):
+    clock = [0.0]
+
+    def mono():
+        return clock[0]
+
+    def sleep(s):
+        clock[0] += s
+
+    apf = APF(mono_fn=mono, sleep_fn=sleep, **kw)
+    return apf, clock
+
+
+def _ab_levels():
+    return [
+        PriorityLevel("exempt", exempt=True),
+        PriorityLevel("protected", shares=80),
+        PriorityLevel("small", shares=20, queue_wait_s=0.01,
+                      burst_s=0.05),
+    ]
+
+
+def _ab_schemas():
+    return [
+        FlowSchema("leases", "exempt", plurals=("leases",)),
+        FlowSchema("kubelet", "protected", clients=("kubelet",)),
+    ]
+
+
+def test_apf_storm_squeezed_kubelet_unharmed():
+    apf, clock = _clocked_apf(
+        levels=_ab_levels(), schemas=_ab_schemas(), total_rate=100.0,
+        default_level="small",
+    )
+    squeezed = admitted = 0
+    for _ in range(200):    # tight loop: no clock advance between calls
+        try:
+            apf.admit("storm-ctl", "create", "notebooks")
+            admitted += 1
+        except errors.TooManyRequests as e:
+            squeezed += 1
+            assert e.retry_after >= 1
+    assert squeezed > 150 and admitted < 50
+    # the kubelet flow rides its own bucket: unharmed by the storm
+    for _ in range(20):
+        apf.admit("kubelet", "get", "pods")
+    snap = apf.snapshot()
+    assert snap["levels"]["protected"]["rejected"] == 0
+    assert snap["levels"]["small"]["rejected"] == squeezed
+
+
+def test_apf_retry_after_honored_and_queueing():
+    apf, clock = _clocked_apf(
+        levels=_ab_levels(), schemas=_ab_schemas(), total_rate=100.0,
+        default_level="small",
+    )
+    # drain the small lane to rejection
+    got = None
+    for _ in range(200):
+        try:
+            apf.admit("storm-ctl", "create", "notebooks")
+        except errors.TooManyRequests as e:
+            got = e
+            break
+    assert got is not None
+    # honoring Retry-After: after the advertised wait the lane has a
+    # seat again
+    clock[0] += float(got.retry_after)
+    apf.admit("storm-ctl", "create", "notebooks")
+
+
+def test_apf_just_missed_token_queues_instead_of_rejecting():
+    apf, clock = _clocked_apf(
+        levels=_ab_levels(), schemas=_ab_schemas(), total_rate=100.0,
+        default_level="small",
+    )
+    # drain the burst exactly (small lane: rate 20/s, burst cap 4)
+    for _ in range(4):
+        apf.admit("storm-ctl", "create", "notebooks")
+    before = clock[0]
+    clock[0] += 0.045   # 0.9 tokens: just short of a whole one
+    # a request that just misses a token WAITS for it (bounded FIFO
+    # queue — sleep_fn advances the virtual clock) instead of failing
+    apf.admit("storm-ctl", "create", "notebooks")
+    assert clock[0] > before + 0.045   # it really slept
+    snap = apf.snapshot()
+    assert snap["levels"]["small"]["queued"] >= 1
+    assert snap["levels"]["small"]["rejected"] == 0
+
+
+def test_apf_exempt_lane_never_throttled():
+    apf, clock = _clocked_apf(
+        levels=_ab_levels(), schemas=_ab_schemas(), total_rate=10.0,
+        default_level="small",
+    )
+    for _ in range(500):
+        apf.admit("anyone", "update", "leases")   # exempt by plural
+    assert apf.snapshot()["levels"]["exempt"]["admitted"] == 500
+
+
+def test_fake_apf_429_counted_by_client():
+    kube = FakeKube()
+    kube.enable_apf(
+        levels=[PriorityLevel("tiny", shares=1, queue_wait_s=0.001,
+                              burst_s=0.01)],
+        schemas=[], total_rate=50.0, default_level="tiny",
+    )
+    storm = kube.client_for("storm")
+    throttled = 0
+    for i in range(60):
+        try:
+            storm.create("notebooks", {
+                "metadata": {"name": f"s{i}", "namespace": "x"}},
+                group=GROUP)
+        except errors.TooManyRequests:
+            throttled += 1
+    assert throttled > 0
+    by = kube.request_counts_snapshot(by_client=True)
+    assert by["storm"]["429"] == throttled
+    assert kube.request_counts_snapshot()["429"] == throttled
+    kube.disable_apf()
+    storm.create("notebooks", {"metadata": {"name": "after",
+                                            "namespace": "x"}},
+                 group=GROUP)
+
+
+def test_chaos_storm_429_per_client_window():
+    kube = FakeKube()
+    chaos = kube.enable_chaos()
+    journal = Journal()
+    chaos.journal = journal
+    chaos.storm_429(clients=("mgr*",), duration_s=30.0, retry_after=3)
+    mgr = kube.client_for("mgr-a")
+    kubelet = kube.client_for("kubelet")
+    try:
+        mgr.create("pods", {"metadata": {"name": "p", "namespace": "x"}})
+        raise AssertionError("storm did not throttle the matched client")
+    except errors.TooManyRequests as e:
+        assert e.retry_after == 3
+    # unmatched clients keep their seats
+    kubelet.create("pods", {"metadata": {"name": "p", "namespace": "x"}})
+    by = kube.request_counts_snapshot(by_client=True)
+    assert by["mgr-a"]["429"] == 1 and "429" not in by["kubelet"]
+    chaos.end_storm_429()
+    mgr.create("pods", {"metadata": {"name": "p2", "namespace": "x"}})
+    assert chaos.summary()["request_throttled"] == 1
+    kinds = [e["attrs"]["action"] for e in journal.entries()
+             if e["kind"] == "chaos"]
+    assert "storm_429_started" in kinds and "storm_429_ended" in kinds
+
+
+# --------------------------------------------------------------- elector
+
+def test_leaderelector_abandon_leaves_lease_for_expiry():
+    kube = FakeKube()
+    a = LeaderElector(kube, "aband", lease_duration=0.8,
+                      renew_period=0.1, retry_period=0.05,
+                      on_lost=lambda: None)
+    a.acquire()
+    assert a.is_leader
+    a.abandon()
+    assert not a.is_leader
+    # the lease is still held on the apiserver (no release/clear)
+    lease = kube.get("leases", "aband", namespace="kubeflow",
+                     group=LEASE_GROUP)
+    assert lease["spec"]["holderIdentity"] == a.identity
+    b = LeaderElector(kube, "aband", lease_duration=0.8,
+                      renew_period=0.1, retry_period=0.05,
+                      on_lost=lambda: None)
+    t0 = time.monotonic()
+    b.acquire()
+    try:
+        # B had to wait out A's abandoned lease (duration + skew tol)
+        assert b.is_leader
+        assert time.monotonic() - t0 >= 0.5
+    finally:
+        b.release()
+
+
+# -------------------------------------------------------- gate + explain
+
+def _good_ha_run() -> dict:
+    return {"scenarios": {
+        "ha_scale": {"extra": {"dual_reconciles": 0,
+                               "orphaned_keys": 0}},
+        "ha_failover": {
+            "extra": {"failover_ms": {"p50": 400.0, "p95": 1200.0},
+                      "dual_reconciles": 0, "orphaned_keys": 0},
+            "slo": {"failover": {"met": True, "attainment": 1.0}},
+        },
+        "ha_apf": {"extra": {"apf": {
+            "protected_p95_ratio": 0.98,
+            "storm_apf": {"protected_p95_ms": 0.9},
+            "storm_throughput_ratio": 0.01,
+            "storm_429s": 7,
+            "protected_429s": 0,
+        }}},
+    }}
+
+
+def test_failover_gate_known_good_and_bad():
+    from tools.bench_gate import failover_gate
+
+    assert failover_gate(_good_ha_run()) == []
+
+    run = _good_ha_run()
+    del run["scenarios"]["ha_failover"]
+    assert any("ha_failover: missing" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_failover"]["extra"]["dual_reconciles"] = 2
+    assert any("dual_reconciles=2" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_scale"]["extra"]["orphaned_keys"] = 1
+    assert any("orphaned_keys=1" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_failover"]["slo"]["failover"]["met"] = False
+    assert any("SLO" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    del run["scenarios"]["ha_failover"]["extra"]["failover_ms"]["p95"]
+    assert any("p95 missing" in f for f in failover_gate(run))
+
+    # protected lane squeezed: ratio over the bar AND above the floor
+    run = _good_ha_run()
+    apf = run["scenarios"]["ha_apf"]["extra"]["apf"]
+    apf["protected_p95_ratio"] = 3.0
+    apf["storm_apf"]["protected_p95_ms"] = 8.0
+    assert any("protected lane squeezed" in f for f in failover_gate(run))
+    # ...but a sub-floor absolute p95 is "held" however the ratio flaps
+    apf["storm_apf"]["protected_p95_ms"] = 1.5
+    assert failover_gate(run) == []
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_apf"]["extra"]["apf"][
+        "storm_throughput_ratio"] = 0.9
+    assert any("NOT squeezed" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_apf"]["extra"]["apf"]["storm_429s"] = 0
+    assert any("storm_429s=0" in f for f in failover_gate(run))
+
+    run = _good_ha_run()
+    run["scenarios"]["ha_apf"]["extra"]["apf"]["protected_429s"] = 3
+    assert any("throttled the flow" in f for f in failover_gate(run))
+
+
+def test_failover_gate_cli(tmp_path):
+    from tools import bench_gate
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_good_ha_run()))
+    assert bench_gate.main(["--run", str(good), "--failover"]) == 0
+
+    bad_run = _good_ha_run()
+    bad_run["scenarios"]["ha_failover"]["extra"]["orphaned_keys"] = 4
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_run))
+    assert bench_gate.main(["--run", str(bad), "--failover"]) == 1
+
+
+def test_explain_names_shard_move_and_windows():
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "moved-nb", "namespace": "t"}, "spec": {},
+    }, group=GROUP)
+    journal = Journal()
+    tracer = Tracer()
+    journal.attach(tracer)
+    key = obs.object_key("notebooks", "t", "moved-nb")
+    journal.decide("shard", action="map_applied", epoch=4, members=2,
+                   moved=21, coordinator="r1")
+    journal.decide("shard", key=key, action="moved", shard=7,
+                   owner="r1", identity="r0")
+    record = obs.explain("t", "moved-nb", kube=kube, tracer=tracer,
+                         journal=journal)
+    assert "moved replicas mid-reconcile" in record["verdict"]
+    assert "r1" in record["verdict"]
+    # the ambient handoff window is stitched into the timeline
+    assert any("map epoch 4" in i["what"] for i in record["timeline"])
+    rendered = obs.render_explain(record)
+    assert "shard" in rendered
+
+
+def test_runtime_recampaigns_after_deposal():
+    """Candidacy is perpetual: a deposed coordinator campaigns again
+    once the usurper's lease lapses — one-shot candidacy would strand
+    the plane with no coordinator after enough outages (review fix)."""
+    kube = FakeKube()
+    r = ShardRuntime(kube, "R", group="camp", lease_duration=0.5,
+                     tick_period=0.05).start()
+    try:
+        assert _wait(lambda: r.is_coordinator())
+        # a usurper takes the coordinator Lease (as a split-brain
+        # network partition would look from R's side): R must depose
+        # itself, then WIN AGAIN once the usurper's short lease lapses
+        from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+            _fmt,
+            _now,
+        )
+
+        lease = kube.get("leases", "camp-coordinator",
+                         namespace="kubeflow", group=LEASE_GROUP)
+        lease = json.loads(json.dumps(lease))
+        lease["spec"]["holderIdentity"] = "usurper"
+        lease["spec"]["leaseDurationSeconds"] = 0.2
+        lease["spec"]["renewTime"] = _fmt(_now())
+        kube.update("leases", lease, namespace="kubeflow",
+                    group=LEASE_GROUP)
+        assert _wait(lambda: not r.is_coordinator(), timeout=6)
+        assert _wait(lambda: r.is_coordinator(), timeout=10)
+    finally:
+        r.kill()
+
+
+def test_member_adopts_published_shard_count():
+    """A replica configured with the wrong --shards adopts the
+    PUBLISHED map's count — two replicas hashing one key into
+    different moduli would dual-reconcile or silently drop it
+    (review fix)."""
+    kube = FakeKube()
+    group = "modulus"
+    m = ShardMember(kube, "A", group=group, num_shards=16,
+                    lease_duration=0.6, tick_period=0.05).start()
+    try:
+        mapping = {s: "A" for s in range(DEFAULT_NUM_SHARDS)}
+        _write_map(kube, group, 1, mapping, ["A"])
+        assert _wait(lambda: m.num_shards == DEFAULT_NUM_SHARDS)
+        assert _wait(lambda: m.active_shards() == ALL_SHARDS)
+        # every key admits consistently under the adopted modulus
+        assert m.admit("x", "anything") == shard_mod.OWN
+    finally:
+        m.kill()
+
+
+def test_shard_count_sticky_across_empty_map():
+    """The published num-shards annotation survives an EMPTY map (every
+    member dead at one sweep): a differently-configured coordinator
+    winning afterwards must adopt it, not re-hash the key space
+    (review fix)."""
+    from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E501
+        ANN_SHARDS,
+        ShardCoordinator,
+        _decode_map,
+    )
+
+    kube = FakeKube()
+    group = "sticky"
+    # the last coordinator published an empty map (no live members)
+    # but the count annotation remains
+    body = {
+        "apiVersion": f"{LEASE_GROUP}/v1", "kind": "Lease",
+        "metadata": {"name": f"{group}-map", "namespace": "kubeflow",
+                     "annotations": {ANN_EPOCH: "5", ANN_MAP: "{}",
+                                     ANN_MEMBERS: "[]",
+                                     ANN_SHARDS: "64"}},
+        "spec": {"holderIdentity": "old-coordinator"},
+    }
+    kube.create("leases", body, namespace="kubeflow", group=LEASE_GROUP)
+    m = ShardMember(kube, "A", group=group, lease_duration=0.6,
+                    tick_period=0.05).start()
+    coord = ShardCoordinator(kube, "new", group=group, num_shards=16,
+                             member_lease_duration=0.6)
+    try:
+        assert _wait(lambda: coord.sweep() or coord.num_shards == 64,
+                     timeout=4)
+        lease = kube.get("leases", f"{group}-map", namespace="kubeflow",
+                         group=LEASE_GROUP)
+        epoch, mapping, members, count = _decode_map(lease)
+        assert count == 64 and len(mapping) == 64 and members == ["A"]
+    finally:
+        m.kill()
+
+
+def test_explain_routine_shard_traffic_is_not_a_verdict():
+    """Ambient shard entries (map epochs, handoff acks) fire on every
+    routine rolling restart — they belong in the TIMELINE but must not
+    be blamed for an ordinary still-reconciling object (review fix)."""
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "routine-nb", "namespace": "t"},
+        "spec": {},
+    }, group=GROUP)
+    journal = Journal()
+    tracer = Tracer()
+    journal.attach(tracer)
+    journal.decide("shard", action="map_applied", epoch=2, members=3,
+                   moved=20, coordinator="r0")
+    journal.decide("shard", action="handoff_acked", identity="r1",
+                   epoch=2, drained=0)
+    record = obs.explain("t", "routine-nb", kube=kube, tracer=tracer,
+                         journal=journal)
+    assert any(i["source"] == "shard" for i in record["timeline"])
+    assert "cluster-level cause" not in record["verdict"]
+    assert "no blocking condition" in record["verdict"]
+
+
+def test_429_retry_after_survives_the_wire():
+    """to_status/from_status round-trip keeps the server's backoff
+    hint: a wire client rebuilding the error from the parsed Status
+    must see the REAL Retry-After, not the 1 s default (review fix)."""
+    e = errors.TooManyRequests("squeezed", retry_after=7)
+    status = e.to_status()
+    assert status["details"]["retryAfterSeconds"] == 7
+    back = errors.ApiError.from_status(status)
+    assert isinstance(back, errors.TooManyRequests)
+    assert back.retry_after == 7
+    s503 = errors.ServiceUnavailable("down", retry_after=4).to_status()
+    assert errors.ApiError.from_status(s503).retry_after == 4
+
+
+def test_member_lease_lifecycle_no_leak():
+    """Graceful leave DELETES the member Lease, and the coordinator
+    garbage-collects Leases dead past 4x their duration — replica
+    churn must not grow the namespace without bound (review fix)."""
+    from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E501
+        ShardCoordinator,
+    )
+
+    kube = FakeKube()
+    m = ShardMember(kube, "gone", group="gc", lease_duration=0.2,
+                    tick_period=0.05).start()
+    assert kube.get("leases", "gc-member-gone", namespace="kubeflow",
+                    group=LEASE_GROUP)
+    m.stop()
+    try:
+        kube.get("leases", "gc-member-gone", namespace="kubeflow",
+                 group=LEASE_GROUP)
+        raise AssertionError("graceful leave left its Lease behind")
+    except errors.NotFound:
+        pass
+    # crash path: the Lease stays (kill never touches the apiserver)
+    # until the coordinator's sweep GCs it once dead past 4x duration
+    crashed = ShardMember(kube, "dead", group="gc", lease_duration=0.2,
+                          tick_period=0.05).start()
+    crashed.kill()
+    coord = ShardCoordinator(kube, "c", group="gc",
+                             member_lease_duration=0.2)
+    assert _wait(lambda: (coord.sweep(), "dead" not in
+                          coord.live_members())[1], timeout=2)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        coord.sweep()
+        try:
+            kube.get("leases", "gc-member-dead", namespace="kubeflow",
+                     group=LEASE_GROUP)
+        except errors.NotFound:
+            break
+        time.sleep(0.05)
+    else:
+        raise AssertionError("coordinator never GC'd the dead Lease")
+
+
+def test_explain_recent_cause_outranks_old_shard_move():
+    """A key that moved replicas an hour ago must not outrank the
+    blackout happening NOW — recency picks the verdict (review fix)."""
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "stale-nb", "namespace": "t"}, "spec": {},
+    }, group=GROUP)
+    journal = Journal()
+    tracer = Tracer()
+    journal.attach(tracer)
+    key = obs.object_key("notebooks", "t", "stale-nb")
+    journal.decide("shard", key=key, action="moved", shard=3,
+                   owner="r1", identity="r0")
+    journal.decide("chaos", action="blackout_started", duration_s=4.5)
+    record = obs.explain("t", "stale-nb", kube=kube, tracer=tracer,
+                         journal=journal)
+    assert "blackout" in record["verdict"]
+    assert "moved replicas" not in record["verdict"]
+
+
+def test_failover_slo_objective_declared():
+    obj = OBJECTIVES_BY_NAME["failover"]
+    assert obj.target_ms == 30_000.0
+    from service_account_auth_improvements_tpu.controlplane.obs import (
+        slo as slo_mod,
+    )
+
+    rec = slo_mod.report({"failover": [1200.0, 900.0, 22_600.0]})
+    assert rec["failover"]["met"]

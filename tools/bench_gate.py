@@ -88,9 +88,9 @@ MIN_HIT_RATE = 0.9
 #: with ENGINE_CACHED_READS=0
 READS_PER_RECONCILE_MAX = 2.0
 #: the chaos family (cpbench/chaos.py): every member present in a run
-#: gets the invariant legs; --chaos-only additionally requires all four
+#: gets the invariant legs; --chaos-only additionally requires all five
 CHAOS_SCENARIOS = ("chaos_relist", "chaos_blackout", "chaos_node_death",
-                   "chaos_kubelet_stall")
+                   "chaos_kubelet_stall", "chaos_429_storm")
 
 
 def chaos_scenarios_in(run: dict) -> list[str]:
@@ -297,6 +297,117 @@ def prof_gate(run: dict, max_overhead: float = PROF_OVERHEAD_MAX,
     return failures
 
 
+#: --failover leg thresholds. The protected lane "holds" at ≤ this p95
+#: ratio vs its no-storm baseline (the acceptance ±20%) OR under the
+#: absolute floor — sub-millisecond in-memory ops flap a pure ratio on
+#: shared-box scheduler jitter while a REAL squeeze measures ~10x
+#: (cpbench/ha.py measures both arms). The storm counts as squeezed only
+#: below this fraction of its unthrottled throughput.
+APF_PROTECTED_MAX_RATIO = 1.2
+APF_PROTECTED_FLOOR_MS = 2.0
+APF_STORM_MAX_RATIO = 0.5
+
+
+def failover_gate(run: dict) -> list[str]:
+    """--failover leg over the ha_scale family (cpbench/ha.py):
+
+    - ``ha_failover`` must be present with a failover_ms p95, its
+      ``failover`` SLO met, 0 dual reconciles through the handoff and 0
+      orphaned keys;
+    - ``ha_scale`` (when present) must show 0 dual reconciles / 0
+      orphaned keys across every replica arm;
+    - ``ha_apf`` must be present with the protected lane holding its
+      p95 (ratio ≤ 1.2 vs no-storm baseline, or under the absolute
+      floor), the storming client measurably squeezed
+      (throughput ratio ≤ 0.5, with > 0 attributed 429s), and zero
+      429s on the protected lane."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    fo = scenarios.get("ha_failover")
+    if fo is None:
+        failures.append(
+            "ha_failover: missing from run — no leader-kill failover "
+            "evidence"
+        )
+    else:
+        extra = fo.get("extra") or {}
+        failover = extra.get("failover_ms") or {}
+        if "p95" not in failover:
+            failures.append(
+                "ha_failover: failover_ms p95 missing — the kill was "
+                "not timed to recovery"
+            )
+        slo = (fo.get("slo") or {}).get("failover")
+        if not isinstance(slo, dict) or not slo.get("met"):
+            failures.append(
+                "ha_failover: failover SLO missing or not met — "
+                f"attainment {None if not isinstance(slo, dict) else slo.get('attainment')}"  # noqa: E501
+            )
+    for name in ("ha_scale", "ha_failover"):
+        s = scenarios.get(name)
+        if s is None:
+            continue
+        extra = s.get("extra") or {}
+        dual = extra.get("dual_reconciles")
+        if dual is None or dual > 0:
+            failures.append(
+                f"{name}: dual_reconciles={dual} (must be reported and "
+                "0 — two replicas ran the same key concurrently)"
+            )
+        orphaned = extra.get("orphaned_keys")
+        if orphaned is None or orphaned > 0:
+            failures.append(
+                f"{name}: orphaned_keys={orphaned} (must be reported "
+                "and 0 — a handoff may delay a key, never lose it)"
+            )
+    apf = scenarios.get("ha_apf")
+    if apf is None:
+        failures.append(
+            "ha_apf: missing from run — no priority-and-fairness A/B "
+            "evidence"
+        )
+        return failures
+    a = ((apf.get("extra") or {}).get("apf")) or {}
+    ratio = a.get("protected_p95_ratio")
+    p95 = ((a.get("storm_apf") or {}).get("protected_p95_ms"))
+    if not isinstance(ratio, (int, float)):
+        failures.append(
+            "ha_apf: protected_p95_ratio absent — the protected lane "
+            "was never measured against its baseline"
+        )
+    elif ratio > APF_PROTECTED_MAX_RATIO and not (
+            isinstance(p95, (int, float))
+            and p95 <= APF_PROTECTED_FLOOR_MS):
+        failures.append(
+            f"ha_apf: protected lane squeezed — p95 ratio {ratio} vs "
+            f"baseline exceeds {APF_PROTECTED_MAX_RATIO} (abs "
+            f"{p95} ms above the {APF_PROTECTED_FLOOR_MS} ms floor)"
+        )
+    storm_ratio = a.get("storm_throughput_ratio")
+    if not isinstance(storm_ratio, (int, float)):
+        failures.append(
+            "ha_apf: storm_throughput_ratio absent — no with/without "
+            "flow-schema throughput comparison"
+        )
+    elif storm_ratio > APF_STORM_MAX_RATIO:
+        failures.append(
+            f"ha_apf: storming client NOT squeezed — throughput ratio "
+            f"{storm_ratio} with flow schemas on exceeds "
+            f"{APF_STORM_MAX_RATIO} of unthrottled"
+        )
+    if not a.get("storm_429s"):
+        failures.append(
+            "ha_apf: storm_429s=0 — flow control never rejected the "
+            "storming client (was APF actually enabled in the arm?)"
+        )
+    if a.get("protected_429s"):
+        failures.append(
+            f"ha_apf: protected lane got {a['protected_429s']} 429s — "
+            "flow control throttled the flow it exists to protect"
+        )
+    return failures
+
+
 def lint_gate(report: dict) -> list[str]:
     """cplint-report leg: the report must be the real cplint record and
     carry zero unsuppressed errors — a missing or malformed report must
@@ -403,6 +514,12 @@ def main(argv=None) -> int:
                     help="cplint JSON report to assert clean (the CI "
                          "static-analysis step); usable alone or "
                          "alongside the bench legs")
+    ap.add_argument("--failover", action="store_true",
+                    help="fail on missing/violated failover p95, dual "
+                         "reconciles or orphaned keys in the ha_scale "
+                         "family, or a squeezed protected lane / "
+                         "un-squeezed storm in the APF A/B in --run "
+                         "(cpbench --ha; composes with the other legs)")
     ap.add_argument("--slo-report", action="store_true",
                     help="fail on any missed SLO objective or absent "
                          "per-scenario attainment record in --run "
@@ -450,6 +567,8 @@ def main(argv=None) -> int:
             # same asymmetry as --chaos-only: an explicitly requested
             # leg silently skipped is a misconfigured CI step passing
             ap.error("--slo-report requires --run")
+        if args.failover:
+            ap.error("--failover requires --run")
         if args.prof_report:
             ap.error("--prof-report requires --run")
         if args.store_lock_max_share is not None:
@@ -465,6 +584,8 @@ def main(argv=None) -> int:
             run = json.load(f)
     if run is not None and args.slo_report:
         failures += slo_gate(run)
+    if run is not None and args.failover:
+        failures += failover_gate(run)
     if args.store_lock_max_share is not None and not args.prof_report:
         # the share rides the per-scenario prof records: requesting it
         # without the leg that reads them is a misconfigured CI step
@@ -477,12 +598,14 @@ def main(argv=None) -> int:
         failures += chaos_gate(run, require_all=True)
     elif run is not None and (args.baseline
                               or not (args.slo_report
-                                      or args.prof_report)):
+                                      or args.prof_report
+                                      or args.failover)):
         # latency legs need the committed record; a pure --slo-report /
-        # --prof-report invocation legitimately runs without one
+        # --prof-report / --failover invocation legitimately runs
+        # without one
         if not args.baseline:
             ap.error("--baseline is required unless --chaos-only, "
-                     "--slo-report or --prof-report")
+                     "--slo-report, --prof-report or --failover")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -517,6 +640,16 @@ def main(argv=None) -> int:
             n = len(run.get("scenarios", {}))
             print(f"bench_gate ok: SLO attainment met in all "
                   f"{n} scenario(s)", file=sys.stderr)
+        if run is not None and args.failover:
+            fo = (run["scenarios"]["ha_failover"]["extra"]
+                  .get("failover_ms") or {})
+            a = (run["scenarios"]["ha_apf"]["extra"].get("apf") or {})
+            print(f"bench_gate ok: failover p95 "
+                  f"{fo.get('p95', float('nan')):.0f} ms, 0 dual "
+                  "reconciles / 0 orphaned keys; APF protected-lane "
+                  f"p95 ratio {a.get('protected_p95_ratio')} with "
+                  f"storm squeezed to {a.get('storm_throughput_ratio')}"
+                  " of unthrottled", file=sys.stderr)
         if run is not None and args.prof_report:
             ov = run.get("profiler_overhead") or {}
             print(f"bench_gate ok: cpprof attribution present in all "

@@ -420,11 +420,13 @@ def install() -> LockWatch:
     if not getattr(fake.FakeKube._count, "_lockwatch", False):
         orig_count = fake.FakeKube._count
 
-        def counted(self, verb):
+        def counted(self, verb, *args, **kwargs):
+            # *args/**kwargs: _count grew a plural parameter (APF flow
+            # classification) — the hook only cares about the verb
             w = active()   # current watch, surviving uninstall/reinstall
             if w is not None:
                 w.note_api_call(verb)
-            return orig_count(self, verb)
+            return orig_count(self, verb, *args, **kwargs)
 
         counted._lockwatch = True  # marker so double-install can't stack
         fake.FakeKube._count = counted

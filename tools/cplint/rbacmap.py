@@ -28,6 +28,7 @@ ROLES = {
             f"{CP}/scheduler",                    # ENABLE_SCHEDULER
             f"{CP}/obs/events.py",                # EventRecorder verbs
             f"{CP}/engine/leaderelection.py",     # --leader-elect
+            f"{CP}/engine/shard.py",               # --shard (cpshard HA)
         ),
     },
     "profile-controller": {
@@ -36,6 +37,7 @@ ROLES = {
             f"{CP}/controllers/profile.py",
             f"{CP}/obs/events.py",                # EventRecorder verbs
             f"{CP}/engine/leaderelection.py",
+            f"{CP}/engine/shard.py",               # --shard (cpshard HA)
         ),
     },
     "tensorboard-controller": {
@@ -44,6 +46,7 @@ ROLES = {
             f"{CP}/controllers/tensorboard.py",
             f"{CP}/obs/events.py",
             f"{CP}/engine/leaderelection.py",
+            f"{CP}/engine/shard.py",               # --shard (cpshard HA)
         ),
     },
     "pvcviewer-controller": {
@@ -52,6 +55,7 @@ ROLES = {
             f"{CP}/controllers/pvcviewer.py",
             f"{CP}/obs/events.py",
             f"{CP}/engine/leaderelection.py",
+            f"{CP}/engine/shard.py",               # --shard (cpshard HA)
         ),
     },
 }
