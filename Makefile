@@ -3,10 +3,12 @@
 
 .PHONY: test lint native manifests workflows images bench-cpu
 
+# -m "not slow": the slow lane (schedsim's full mutation matrix) runs
+# via `python -m tools.cplint.schedsim --mutations` in CI's bench lane
 test: native
-	python -m pytest tests/ -x -q
+	python -m pytest tests/ -x -q -m "not slow"
 
-# cplint: the six control-plane invariant passes (docs/cplint.md);
+# cplint: the ten control-plane invariant passes (docs/cplint.md);
 # exits nonzero on any unsuppressed finding
 lint:
 	python -m tools.cplint

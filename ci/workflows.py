@@ -111,8 +111,26 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
         {"pytest": job(
             [CHECKOUT, SETUP_PY, INSTALL_DEPS,
              {"name": "Build native components", "run": "make -C native"},
+             # -m "not slow": the slow lane (the full schedsim
+             # mutation matrix) is covered by the dedicated
+             # controlplane_bench step with its own deadline — running
+             # it here too would just double the spend
              {"name": "Run tests",
-              "run": "python -m pytest tests/ -x -q"}],
+              "run": "python -m pytest tests/ -x -q -m 'not slow'"},
+             # schedsim smoke: explore every consensus-protocol model
+             # under a bounded schedule budget (tools/cplint/schedsim);
+             # a violation dumps the exact replayable interleaving into
+             # schedsim_out/, uploaded below even when the step fails
+             {"name": "Schedule exploration smoke (schedsim)",
+              "run": "python -m tools.cplint.schedsim --budget 200 "
+                     "--deadline 180 --json schedsim_report.json "
+                     "--dump-dir schedsim_out"},
+             {"name": "Upload schedsim record",
+              "if": "always()",
+              "uses": "actions/upload-artifact@v4",
+              "with": {"name": "schedsim",
+                       "path": "schedsim_report.json\nschedsim_out/",
+                       "if-no-files-found": "ignore"}}],
             # CPLINT_LOCKWATCH: tests/conftest.py instruments every
             # controlplane Lock/RLock/Condition (tools/cplint/lockwatch)
             # and fails the session on lock-order cycles or held-lock
@@ -210,17 +228,30 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             # everything else in this job is stdlib-only
             {"name": "Install lint dependencies",
              "run": "pip install pyyaml"},
-            # the six invariant passes (lock-discipline, cache-mutation,
-            # queue-span, rbac-check, clock-injection, metrics — the
-            # last subsuming the old metrics_lint) fail the job on any
-            # unsuppressed finding; the JSON report is uploaded
-            # if: always() below so a red run carries its evidence
+            # the ten invariant passes (lock-discipline, cache-mutation,
+            # queue-span, rbac-check, clock-injection, metrics,
+            # event-reason, blocking-under-lock, check-then-act,
+            # mvcc-escape) fail the job on any unsuppressed finding;
+            # the JSON report is uploaded if: always() below so a red
+            # run carries its evidence
             {"name": "Control-plane invariant lint (cplint)",
              "run": "python -m tools.cplint --json cplint_report.json"},
+            # the gate additionally asserts the three concurrency-
+            # dataflow passes (blocking-under-lock / check-then-act /
+            # mvcc-escape) actually RAN and reports their counts
             {"name": "Lint report gate",
              "if": "always()",
              "run": "python tools/bench_gate.py "
                     "--lint-report cplint_report.json"},
+            # mutation validation: every hand-seeded protocol bug
+            # (ack-barrier dropped, self-fence skipped, MVCC identity
+            # check removed, dirty re-add lost, ...) must be CAUGHT by
+            # the schedule explorer within the CI budget — a model
+            # checker that can't re-find the bugs this repo already
+            # fixed once guards nothing (tools/cplint/schedsim.py)
+            {"name": "Schedsim mutation-catch suite",
+             "run": "python -m tools.cplint.schedsim --mutations "
+                    "--deadline 900 --json schedsim_mutations.json"},
             # the fresh run goes to bench_out.json so the committed
             # CONTROLPLANE_BENCH.json stays available as the gate
             # baseline. --profile: cpprof samples hot stacks + lock
@@ -314,7 +345,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "with": {"name": "controlplane-bench",
                       "path": "bench_out.json\nchaos_out.json\n"
                               "ha_out.json\n"
-                              "cplint_report.json\nbench_out/"}},
+                              "cplint_report.json\n"
+                              "schedsim_mutations.json\nbench_out/"}},
         ])},
     ),
     "images_multi_arch_test.yaml": workflow(

@@ -271,6 +271,10 @@ class FakeKubelet:
                 # old-pool pods and the gang wedges on
                 # SlicePlacementConflict forever
                 try:
+                    # the kubelet sync loop re-runs every period: a
+                    # raced delete is re-decided next sync, NotFound
+                    # is absorbed
+                    # cplint: disable=check-then-act — sync-loop re-decides
                     self.kube.delete("pods", pod_name, namespace=ns)
                 except errors.NotFound:
                     pass

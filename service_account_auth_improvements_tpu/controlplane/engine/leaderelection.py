@@ -35,6 +35,7 @@ import threading
 import time
 import uuid
 
+from service_account_auth_improvements_tpu.controlplane import syncpoint
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.obs import (
     journal as journal_mod,
@@ -294,6 +295,7 @@ class LeaderElector:
         return renew_stale(renew, float(duration), tol, self._now())
 
     def _try_acquire(self) -> bool:
+        syncpoint.sync("lease.try_acquire", self.identity)
         lease = self._get()
         now = _fmt(self._now())
         try:

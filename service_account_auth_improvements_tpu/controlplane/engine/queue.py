@@ -20,6 +20,8 @@ import heapq
 import threading
 import time
 
+from service_account_auth_improvements_tpu.controlplane import syncpoint
+
 
 class RateLimitingQueue:
     def __init__(self, base_delay: float = 0.005, max_delay: float = 60.0,
@@ -66,6 +68,7 @@ class RateLimitingQueue:
             self._metrics.workqueue_adds.labels(self.name).inc()
 
     def add(self, key) -> None:
+        syncpoint.sync("queue.add", key)
         with self._lock:
             if self._shutdown:
                 return
@@ -105,6 +108,7 @@ class RateLimitingQueue:
 
     def get(self, timeout: float | None = None):
         """Block for the next key; returns None on shutdown/timeout."""
+        syncpoint.sync("queue.get")
         popped = self._get(timeout)
         if popped is None:
             return None
@@ -153,6 +157,7 @@ class RateLimitingQueue:
                 self._lock.wait(wait)
 
     def done(self, key) -> None:
+        syncpoint.sync("queue.done", key)
         with self._lock:
             self._processing.discard(key)
             if key in self._dirty:
@@ -186,6 +191,7 @@ class RateLimitingQueue:
         doomed = set(keys)
         if not doomed:
             return 0
+        syncpoint.sync("queue.discard")
         removed = 0
         with self._lock:
             hit = self._pending & doomed

@@ -66,6 +66,7 @@ from service_account_auth_improvements_tpu.controlplane.engine.leaderelection im
     _parse,
     renew_stale,
 )
+from service_account_auth_improvements_tpu.controlplane import syncpoint
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.obs import (
     journal as journal_mod,
@@ -348,6 +349,7 @@ class ShardMember:
     def _heartbeat(self) -> bool:
         """Create/renew the member Lease carrying the acked epoch.
         Returns True on a successful write."""
+        syncpoint.sync("shard.heartbeat", self.identity)
         with self._lock:
             acked = self._acked
         now = _fmt(self._now())
@@ -432,6 +434,7 @@ class ShardMember:
             self._decide(event, identity=self.identity)
 
     def _read_map(self) -> None:
+        syncpoint.sync("shard.read_map", self.identity)
         try:
             lease = self.kube.get("leases", self._map_name,
                                   namespace=self.namespace,
@@ -506,6 +509,7 @@ class ShardMember:
         """Publish the epoch ack once every lost shard has drained —
         the other half of the never-dual-reconcile argument: a gainer
         only activates once this ack (or our expiry) is visible."""
+        syncpoint.sync("shard.ack", self.identity)
         with self._lock:
             wait = self._ack_wait
         if wait is None:
@@ -531,6 +535,7 @@ class ShardMember:
         """Activate pending gains whose barrier has cleared: every LIVE
         fellow member has acked our epoch (a dead member's expiry IS its
         ack — the lease fencing convention)."""
+        syncpoint.sync("shard.barrier", self.identity)
         with self._lock:
             if not self._pending or not self._map_confirmed:
                 return

@@ -63,6 +63,7 @@ import time
 import uuid
 import weakref
 
+from service_account_auth_improvements_tpu.controlplane import syncpoint
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.kube.registry import (
     DEFAULT_REGISTRY,
@@ -456,6 +457,16 @@ class FakeKube:
             stripe = fam.stripes.setdefault(ns, _Stripe())
         return stripe
 
+    def _commit_ok(self, stripe: _Stripe, key, cur: dict) -> bool:
+        """THE optimistic-commit identity check (caller holds the family
+        and stripe locks): the successor built lock-free from ``cur`` may
+        only commit while ``cur`` is still the stored object — a racing
+        writer's commit means recompute, never overwrite. One seam shared
+        by update/patch/delete so the never-lose-an-update argument has a
+        single definition (and the schedsim mutation suite one point to
+        break — docs/cplint.md)."""
+        return stripe.objects.get(key) is cur
+
     def _next_rv(self) -> tuple[int, bool]:
         """Allocate the next resourceVersion (lock-free atomic counter)
         and report whether the auto-compaction threshold tripped — the
@@ -769,9 +780,13 @@ class FakeKube:
                 nm["resourceVersion"] = cur["metadata"]["resourceVersion"]
                 if new == cur:
                     return copy.deepcopy(cur)
+                # the optimistic window: the successor was built from
+                # ``cur`` lock-free — a schedule explorer preempts HERE
+                # to interleave a racing commit (zero-cost otherwise)
+                syncpoint.sync("fake.commit", plural)
                 with fam.lock:
                     with stripe.lock:
-                        if stripe.objects.get(key) is not cur:
+                        if not self._commit_ok(stripe, key, cur):
                             continue    # lost the race: recompute
                         rv, compact = self._next_rv()
                         nm["resourceVersion"] = str(rv)
@@ -827,9 +842,10 @@ class FakeKube:
                 if new == cur:
                     # no-op patch: same RV, no watch event (kube semantics)
                     return copy.deepcopy(cur)
+                syncpoint.sync("fake.commit", plural)
                 with fam.lock:
                     with stripe.lock:
-                        if stripe.objects.get(key) is not cur:
+                        if not self._commit_ok(stripe, key, cur):
                             continue
                         rv, compact = self._next_rv()
                         new["metadata"]["resourceVersion"] = str(rv)
@@ -868,9 +884,10 @@ class FakeKube:
                     new = dict(cur)
                     new["metadata"] = {**cur["metadata"],
                                        "deletionTimestamp": _now()}
+                    syncpoint.sync("fake.commit", plural)
                     with fam.lock:
                         with stripe.lock:
-                            if stripe.objects.get(key) is not cur:
+                            if not self._commit_ok(stripe, key, cur):
                                 continue
                             rv, compact = self._next_rv()
                             new["metadata"]["resourceVersion"] = str(rv)
@@ -897,6 +914,7 @@ class FakeKube:
         stripe = self._stripe(fam, key[2])
         if stripe is None:
             return None
+        syncpoint.sync("fake.commit", res.plural)
         with fam.lock:
             with stripe.lock:
                 obj = stripe.objects.get(key)

@@ -264,6 +264,10 @@ class EventRecorder:
             count = int(existing.get("count") or 1) + 1
             with self._lock:
                 self._counts[name] = count
+            # Events are telemetry with client-go correlator
+            # semantics: a raced count patch loses a repeat tally,
+            # never cluster state; the local cache re-converges
+            # cplint: disable=check-then-act — telemetry, races lose a tally
             self.kube.patch(
                 "events", name,
                 {"count": count, "lastTimestamp": now},

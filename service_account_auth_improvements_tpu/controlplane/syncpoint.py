@@ -1,0 +1,58 @@
+"""Injectable sync points for the deterministic schedule explorer.
+
+The consensus-critical modules (engine/shard.py, engine/leaderelection.py,
+kube/fake.py, engine/queue.py) call :func:`sync` at the protocol
+transitions whose *ordering* their correctness arguments rest on: the
+optimistic-commit window between reading the current object and taking
+the commit locks, queue get→done transitions, heartbeat/map-read/
+barrier/ack phases of the shard handoff, lease acquire attempts. With
+no hook installed the call is one module-global load and a ``None``
+check — the same zero-cost-when-disabled shape as the chaos hooks
+(``self.chaos is not None``), safe on every hot path.
+
+tools/cplint/schedsim.py installs a hook that *suspends the calling
+thread* at each point and lets a cooperative scheduler enumerate
+interleavings (docs/cplint.md "Schedule exploration"). Nothing else in
+the repo should install one; production binaries never do.
+
+The hook contract: ``hook(label, detail)`` where ``label`` is a stable
+dotted identifier (``"fake.commit"``, ``"queue.done"``, ``"shard.ack"``)
+and ``detail`` an optional discriminator (plural, key) the explorer
+folds into its conflict relation. The hook is called on WHATEVER thread
+hit the point — schedule explorers must filter to their own model
+threads and no-op for everyone else. Hooks must never raise; a raising
+hook is a broken harness, not a broken plane, so ``sync`` lets the
+exception propagate loudly rather than swallowing evidence.
+"""
+
+from __future__ import annotations
+
+#: the installed hook, or None (the production state). Read directly
+#: (one global load) by sync(); tests swap it via install/uninstall.
+_HOOK = None
+
+
+def sync(label: str, detail=None) -> None:
+    """Mark a schedule-relevant transition. No-op unless a hook is
+    installed (schedsim test runs only)."""
+    hook = _HOOK
+    if hook is not None:
+        hook(label, detail)
+
+
+def install(hook) -> None:
+    """Install the scheduler hook (schedsim). Not reentrant — a second
+    explorer in the same process must uninstall the first."""
+    global _HOOK
+    if _HOOK is not None and hook is not None and hook is not _HOOK:
+        raise RuntimeError("a syncpoint hook is already installed")
+    _HOOK = hook
+
+
+def uninstall() -> None:
+    global _HOOK
+    _HOOK = None
+
+
+def active():
+    return _HOOK

@@ -43,6 +43,15 @@ if os.environ.get("CPLINT_LOCKWATCH"):
     _LOCKWATCH = _lockwatch_mod.install()
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 lane (-m 'not slow'); run "
+        "explicitly or via the CI steps that invoke the same tool "
+        "directly (e.g. schedsim --mutations)",
+    )
+
+
 def pytest_sessionfinish(session, exitstatus):
     if _LOCKWATCH is None:
         return

@@ -7,6 +7,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tests.jaxdrift import (
+    requires_jax_shard_map,
+    requires_orbax_placeholder,
+)
+
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
 from service_account_auth_improvements_tpu.parallel import use_mesh
@@ -75,6 +80,7 @@ def test_resume_training_matches_uninterrupted(tmp_path):
     )
 
 
+@requires_orbax_placeholder   # params-only restore uses ocp.PLACEHOLDER
 def test_restore_params_only_any_optimizer(tmp_path):
     """The serving path: params restored from the checkpoint's own
     metadata — no optimizer reconstruction — and bit-equal to the saved
@@ -121,6 +127,7 @@ def test_max_to_keep_gc(tmp_path):
     kept = sorted(d for d in os.listdir(tmp_path / "ck") if d.isdigit())
     assert kept == ["3", "4"], kept
 
+@requires_jax_shard_map   # the pp train step rides jax.shard_map
 def test_restore_onto_pipeline_mesh(tmp_path):
     """A checkpoint trained on an fsdp/tp mesh restores onto a pp mesh:
     the layer stack re-lands stage-sharded over pp (rule "layers": "pp")

@@ -364,15 +364,23 @@ def test_bench_gate_lint_leg():
     findings all fail (absence of evidence isn't cleanliness)."""
     bg = _load_bench_gate()
 
-    clean = {"schema": "cplint/v1", "ok": True,
+    ran = [{"name": n} for n in bg.LINT_REQUIRED_PASSES]
+    clean = {"schema": "cplint/v1", "ok": True, "passes": list(ran),
              "counts": {"errors": 0, "suppressed": 2}, "findings": []}
     assert bg.lint_gate(clean) == []
     # wrong/missing schema: not a cplint record at all
     fails = bg.lint_gate({"schema": "other/v1"})
     assert len(fails) == 1 and "cplint/v1" in fails[0]
     assert bg.lint_gate({}) and "cplint/v1" in bg.lint_gate({})[0]
+    # a report whose pass list is missing the concurrency-dataflow
+    # passes did not RUN them — clean-by-absence must fail (ISSUE 13)
+    stale = dict(clean)
+    stale["passes"] = [{"name": "lock-discipline"}]
+    fails = bg.lint_gate(stale)
+    assert len(fails) == 1 and "mvcc-escape" in fails[0] and \
+        "did not run" in fails[0]
     # unsuppressed findings fail and are named in the message
-    dirty = {"schema": "cplint/v1", "ok": False,
+    dirty = {"schema": "cplint/v1", "ok": False, "passes": list(ran),
              "counts": {"errors": 1},
              "findings": [{"pass": "lock-discipline", "path": "x.py",
                            "line": 7, "message": "racy", "severity":
@@ -382,7 +390,7 @@ def test_bench_gate_lint_leg():
         "lock-discipline" in fails[0]
     # counts without the errors field is malformed, not clean
     assert bg.lint_gate({"schema": "cplint/v1", "ok": True,
-                         "counts": {}})
+                         "passes": list(ran), "counts": {}})
     # a report that parses to a non-object (truncated/corrupt) must
     # fail the CLI leg, not read as clean (review fix)
     assert bg.main(["--lint-report", "/dev/null"]) == 1
@@ -404,9 +412,11 @@ def test_bench_gate_lint_cli(tmp_path):
 
     gate_py = pathlib.Path(__file__).resolve().parent.parent / \
         "tools" / "bench_gate.py"
+    bg = _load_bench_gate()
     clean = tmp_path / "clean.json"
     clean.write_text(_json.dumps(
         {"schema": "cplint/v1", "ok": True,
+         "passes": [{"name": n} for n in bg.LINT_REQUIRED_PASSES],
          "counts": {"errors": 0, "suppressed": 0}, "findings": []}
     ))
     proc = subprocess.run(

@@ -27,9 +27,12 @@ from tools.cplint import lockwatch as lw  # noqa: E402
 from tools.cplint.core import PassContext, run_passes  # noqa: E402
 from tools.cplint.passes import (  # noqa: E402
     ALL_PASSES,
+    blocking_under_lock,
     cache_mutation,
+    check_then_act,
     clock_injection,
     lock_discipline,
+    mvcc_escape,
     queue_span,
     rbac,
 )
@@ -73,7 +76,24 @@ def test_cli_exits_zero_and_writes_report(tmp_path):
     assert {p["name"] for p in report["passes"]} == {
         "lock-discipline", "cache-mutation", "queue-span", "rbac-check",
         "clock-injection", "metrics", "event-reason",
+        "blocking-under-lock", "check-then-act", "mvcc-escape",
     }
+
+
+def test_cli_list_passes():
+    """--list-passes: machine-readable catalog on stdout (CI/pre-commit
+    build fast --pass subsets from it instead of hardcoding names)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.cplint", "--list-passes"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    catalog = json.loads(proc.stdout)
+    assert catalog["schema"] == "cplint-passes/v1"
+    names = [p["name"] for p in catalog["passes"]]
+    assert "mvcc-escape" in names and "blocking-under-lock" in names \
+        and "check-then-act" in names
+    assert all(p["description"] for p in catalog["passes"])
 
 
 # ------------------------------------------------------ lock-discipline
@@ -531,6 +551,241 @@ class C:
     ctx, _ = _fixture_ctx(tmp_path, src)
     msgs = _messages(clock_injection.run(ctx))
     assert len(msgs) == 1 and "time.time" in msgs[0]
+
+
+# ------------------------------------------------- blocking-under-lock
+
+BAD_BLOCKING = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(1)
+
+    def bad_write(self):
+        with self._lock:
+            self.kube.patch("notebooks", "x", {})
+
+    def bad_bare(self):
+        self._lock.acquire()
+        self.kube.get("pods", "p")
+        self._lock.release()
+
+    def bad_join(self):
+        with self._lock:
+            self._thread.join()
+"""
+
+
+def test_blocking_under_lock_flags_all_shapes(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_BLOCKING)
+    msgs = _messages(blocking_under_lock.run(ctx))
+    assert len(msgs) == 4
+    assert any("time.sleep" in m for m in msgs)
+    assert any("apiserver patch()" in m for m in msgs)
+    assert any("apiserver get()" in m for m in msgs)
+    assert any(".join()" in m for m in msgs)
+
+
+def test_blocking_under_lock_clean_shapes(tmp_path):
+    src = """
+import threading
+import time
+
+class C:
+    def __init__(self):
+        self._lock = threading.Condition()
+
+    def good_after_release(self):
+        with self._lock:
+            x = 1
+        self.kube.patch("notebooks", "x", {})
+
+    def good_bare_released(self):
+        self._lock.acquire()
+        x = 1
+        self._lock.release()
+        self.kube.get("pods", "p")
+
+    def good_condwait(self):
+        with self._lock:
+            self._lock.wait(0.2)   # waiting on the HELD lock releases it
+
+def lock_free_sleep(self):
+    time.sleep(1)   # no lock in scope: not this pass's business
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(blocking_under_lock.run(ctx)) == []
+
+
+def test_blocking_under_lock_kube_exempt_and_suppression(tmp_path):
+    # the fake's own machinery runs under its own locks by design
+    ctx, _ = _fixture_ctx(
+        tmp_path, BAD_BLOCKING, rel=f"{CP}/kube/fixture.py")
+    assert blocking_under_lock.run(ctx) == []
+    # a justified suppression is honored and still counted
+    src = BAD_BLOCKING.replace(
+        "            time.sleep(1)",
+        "            # cplint: disable=blocking-under-lock — test seam\n"
+        "            time.sleep(1)",
+    )
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    findings = blocking_under_lock.run(ctx)
+    assert any(f.suppressed for f in findings)
+    assert len(_messages(findings)) == 3
+
+
+# ----------------------------------------------------- check-then-act
+
+BAD_CTA = """
+def sweep(self, ns, name):
+    sts = self._sts_inf.get(ns, name)
+    if sts is not None:
+        self.kube.delete("statefulsets", name, namespace=ns)
+"""
+
+
+def test_check_then_act_flags_cache_guarded_write(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_CTA)
+    msgs = _messages(check_then_act.run(ctx))
+    assert len(msgs) == 1 and "no live confirm" in msgs[0] and \
+        "delete" in msgs[0]
+
+
+def test_check_then_act_absolutions(tmp_path):
+    src = """
+def live_confirm(self, ns, name):
+    sts = self._sts_inf.get(ns, name)
+    if sts is not None:
+        cur = self.kube.live.get("statefulsets", name, namespace=ns)
+        self.kube.delete("statefulsets", name, namespace=ns)
+
+def requeue_path(self, ns, name):
+    sts = self._sts_inf.get(ns, name)
+    if sts is not None:
+        self.kube.delete("statefulsets", name, namespace=ns)
+        self.queue.add_rate_limited((ns, name))
+
+def requeue_after_idiom(self, ns, name):
+    requeue_after = 0.0
+    sts = self._sts_inf.get(ns, name)
+    if sts is not None:
+        self.kube.delete("statefulsets", name, namespace=ns)
+        requeue_after = 1.0
+    return requeue_after
+
+def rv_guarded_update(self, ns, name):
+    nb = self.kube.get("notebooks", name, namespace=ns)
+    if nb["spec"].get("stale"):
+        self.kube.update("notebooks", nb, namespace=ns)
+
+def unconditional_write(self, ns, name):
+    sts = self._sts_inf.get(ns, name)
+    self.kube.delete("statefulsets", name, namespace=ns)
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert _messages(check_then_act.run(ctx)) == []
+
+
+def test_check_then_act_suppression_honored(tmp_path):
+    src = BAD_CTA.replace(
+        '        self.kube.delete("statefulsets", name, namespace=ns)',
+        "        # cplint: disable=check-then-act — sweeper re-runs\n"
+        '        self.kube.delete("statefulsets", name, namespace=ns)',
+    )
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    findings = check_then_act.run(ctx)
+    assert _messages(findings) == []
+    assert len(findings) == 1 and findings[0].suppressed
+
+
+# -------------------------------------------------------- mvcc-escape
+
+def test_mvcc_escape_flags_producer_mutations(tmp_path):
+    src = """
+import copy
+
+class F:
+    def bad_stored_write(self, stripe, key):
+        obj = stripe.objects.get(key)
+        obj["metadata"]["deletionTimestamp"] = "now"
+
+    def bad_post_commit(self, stripe, key, cur):
+        new = copy.deepcopy(cur)
+        stripe.objects[key] = new
+        new["metadata"]["resourceVersion"] = "7"
+
+    def bad_shallow_subtree(self, stripe, key):
+        cur = stripe.objects.get(key)
+        new = dict(cur)
+        new["metadata"]["x"] = 1
+
+    def bad_event_mutation(self, ev):
+        ev["object"]["metadata"].pop("emittedAt")
+
+    def bad_alias(self, stripe, key):
+        obj = stripe.objects.get(key)
+        meta = obj["metadata"]
+        meta["labels"] = {}
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src,
+                          rel=f"{CP}/kube/fixture.py")
+    msgs = _messages(mvcc_escape.run(ctx))
+    assert len(msgs) == 5
+    assert any("committed to a stripe or emitted" in m for m in msgs)
+    assert any("SHALLOW copy" in m for m in msgs)
+
+
+def test_mvcc_escape_sanctioned_shapes_clean(tmp_path):
+    src = """
+import copy
+
+class F:
+    def good_cow(self, stripe, key, fam):
+        cur = stripe.objects.get(key)
+        new = dict(cur)
+        new["metadata"] = {**cur["metadata"], "x": 1}  # fresh slot
+        new["metadata"]["y"] = 2                       # now owned
+        stripe.objects[key] = new
+
+    def good_deepcopy(self, stripe, key):
+        obj = copy.deepcopy(stripe.objects.get(key))
+        obj["metadata"]["labels"] = {}
+
+    def good_event_copy(self, ev):
+        ev = dict(ev)
+        ev.pop("emittedAt", None)   # top level of the shallow copy
+"""
+    ctx, _ = _fixture_ctx(tmp_path, src,
+                          rel=f"{CP}/kube/fixture.py")
+    assert _messages(mvcc_escape.run(ctx)) == []
+
+
+def test_mvcc_escape_out_of_scope_and_suppression(tmp_path):
+    bad = """
+class F:
+    def write(self, stripe, key):
+        obj = stripe.objects.get(key)
+        obj["metadata"]["x"] = 1
+"""
+    # only kube/ is the producer side; engine consumers are
+    # cache-mutation's beat
+    ctx, _ = _fixture_ctx(tmp_path, bad)   # engine/ fixture path
+    assert mvcc_escape.run(ctx) == []
+    suppressed = bad.replace(
+        '        obj["metadata"]["x"] = 1',
+        "        # cplint: disable=mvcc-escape — pre-publication init\n"
+        '        obj["metadata"]["x"] = 1',
+    )
+    ctx, _ = _fixture_ctx(tmp_path, suppressed,
+                          rel=f"{CP}/kube/fixture.py")
+    findings = mvcc_escape.run(ctx)
+    assert len(findings) == 1 and findings[0].suppressed
 
 
 # -------------------------------------------------------------- lockwatch

@@ -5,6 +5,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tests.jaxdrift import requires_jax_05_numerics
+
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh
 from service_account_auth_improvements_tpu.train.data import DataConfig
@@ -16,6 +18,7 @@ TOKENS = np.random.default_rng(0).integers(
 )
 
 
+@requires_jax_05_numerics   # 12-step loss-descent window is numerics-tight
 def test_fit_descends_and_checkpoints(tmp_path):
     mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
     state, history = fit(

@@ -10,6 +10,8 @@ import time
 
 import pytest
 
+from tests.jaxdrift import requires_jax_shard_map
+
 from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
     GANG_GATE,
@@ -363,6 +365,7 @@ def test_multislice_mesh_dp_spans_slices():
     assert ids == sorted(ids)
 
 
+@requires_jax_shard_map   # the pipeline stage loop rides jax.shard_map
 def test_multislice_with_pipeline_inside_slice():
     """2 DCN slices (dp) × pipeline (pp=2) × tp=2 inside each slice: the
     layer pipeline's ppermute ring stays intra-slice while the gradient

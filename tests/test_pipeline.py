@@ -14,6 +14,12 @@ import jax.numpy as jnp
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from tests.jaxdrift import requires_jax_shard_map
+
+# the equivalence/train tests drive parallel/pipeline.py's
+# jax.shard_map stage loop (per-test marks below); the shape/mesh
+# VALIDATION tests reject before any shard_map call and keep running
+
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.parallel import (
     MeshConfig,
@@ -58,6 +64,7 @@ def _pp_mesh(pp, **kw):
 
 
 @pytest.mark.parametrize("n_micro", [2, 4, 8])
+@requires_jax_shard_map
 def test_pipeline_loss_matches_scan(setup, n_micro):
     params, tokens, mask, ref_loss, _ = setup
     cfg = dataclasses.replace(CFG, pp_microbatches=n_micro)
@@ -69,6 +76,7 @@ def test_pipeline_loss_matches_scan(setup, n_micro):
     assert abs(float(loss) - ref_loss) < 1e-4, (float(loss), ref_loss)
 
 
+@requires_jax_shard_map
 def test_pipeline_grads_match_scan(setup):
     params, tokens, mask, _, ref_grads = setup
     cfg = dataclasses.replace(CFG, pp_microbatches=4)
@@ -88,6 +96,7 @@ def test_pipeline_grads_match_scan(setup):
         )
 
 
+@requires_jax_shard_map
 def test_pipeline_four_stages(setup):
     params, tokens, mask, ref_loss, _ = setup
     mesh = _pp_mesh(4)
@@ -98,6 +107,7 @@ def test_pipeline_four_stages(setup):
     assert abs(float(loss) - ref_loss) < 1e-4
 
 
+@requires_jax_shard_map
 def test_pipeline_composes_with_tp(setup):
     """pp=2 × tp=2 × dp=2: the shard_map is manual only over pp, so tp
     head/mlp sharding and dp batch sharding partition automatically
@@ -115,6 +125,7 @@ def test_pipeline_composes_with_tp(setup):
     assert abs(float(loss) - ref_loss) < 1e-4
 
 
+@requires_jax_shard_map
 def test_pipeline_train_step_descends():
     """Full jitted train step (loss+grads+adamw) on a pp=2 mesh: the copy
     task must learn, proving backward + optimizer run through the
@@ -179,6 +190,7 @@ def test_pipeline_requires_pp_mesh():
         pipeline_layers(lambda h, lp: (h, 0.0), params["layers"], x)
 
 
+@requires_jax_shard_map
 def test_pipeline_moe_aux_counted_once():
     """Switch-MoE under pp: the aux (load-balance) loss must equal the
     pp=1 value — bubble ticks must not contribute phantom aux."""
@@ -205,6 +217,7 @@ def test_pipeline_moe_aux_counted_once():
     ), (float(ref_aux), float(aux))
 
 
+@requires_jax_shard_map
 def test_pipeline_moe_with_token_mask():
     """MoE + token mask + pp (the gate-crash regression): the mask is a
     batch-shaped const that must follow its microbatch through the

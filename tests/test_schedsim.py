@@ -1,0 +1,297 @@
+"""schedsim (ISSUE 13): the deterministic-interleaving explorer.
+
+Covers the scheduler itself (determinism, replay, deadlock detection,
+PCT/fair policies), the clean-HEAD gate (every protocol model explores
+violation-free), the lockwatch inversion fixtures re-run THROUGH the
+explorer (what lockwatch only catches when the OS scheduler cooperates,
+schedsim finds in a bounded budget), the sync-point inventory staying
+honest against the instrumented modules, and the mutation suite — a
+fast always-on subset plus the full ten-mutant matrix (slow lane; CI
+runs the same matrix via ``--mutations`` in controlplane_bench.yaml).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.cplint import schedsim as ss  # noqa: E402
+
+# the models script partitions/expiries; member warning logs are
+# expected noise in this module
+logging.getLogger(
+    "service_account_auth_improvements_tpu.controlplane"
+).setLevel(logging.CRITICAL)
+
+
+# ------------------------------------------------------- the scheduler
+
+def test_runs_are_deterministic():
+    """Same choices prefix → byte-identical decision sequence; the
+    whole replay story rests on this."""
+    a = ss._run_model(ss.LeaseRaceModel())
+    b = ss._run_model(ss.LeaseRaceModel())
+    assert a.choices_taken() == b.choices_taken()
+    assert [d["enabled"] for d in a.decisions] == \
+        [d["enabled"] for d in b.decisions]
+    assert a.violation is None and b.violation is None
+    # a forced prefix replays exactly
+    prefix = a.choices_taken()[:3]
+    c = ss._run_model(ss.LeaseRaceModel(), choices=prefix)
+    assert c.choices_taken()[:3] == prefix
+
+
+def test_explorer_finds_lock_inversion_deadlock():
+    """Satellite: the test_cplint A→B/B→A fixture through schedsim —
+    the explorer must FIND the deadlock within a small bounded budget,
+    where lockwatch alone needs the OS scheduler to cooperate."""
+    res = ss.explore(ss.LockInversionModel, max_schedules=60)
+    assert res["violations"], "inversion never found"
+    vio = res["violations"][0]["violation"]
+    assert vio["kind"] == "deadlock"
+    assert set(vio["threads"]) == {"T1", "T2"}
+    # the schedule is small: found well inside the budget
+    assert res["runs"] <= 20
+
+
+def test_explorer_ordered_control_is_clean_and_exhaustive():
+    res = ss.explore(ss.LockOrderedModel, max_schedules=60)
+    assert res["violations"] == []
+    assert res["exhaustive"], (
+        "the two-thread consistent-order space must drain within 60 "
+        "schedules"
+    )
+
+
+def test_violation_dump_replays_as_failing_schedule(tmp_path):
+    """A dumped schedule re-runs the EXACT interleaving: the violation
+    reproduces from the choice list alone."""
+    res = ss.explore(ss.LockInversionModel, max_schedules=60)
+    path = ss.dump_violation(res["violations"][0], tmp_path, 0)
+    dump = json.loads(path.read_text())
+    assert dump["schema"] == "schedsim/v1"
+    vio = ss.replay(dump)
+    assert vio is not None and vio["kind"] == "deadlock"
+
+
+def test_hooks_do_not_leak_after_a_run():
+    from service_account_auth_improvements_tpu.controlplane import (
+        syncpoint,
+    )
+    from tools.cplint import lockwatch
+
+    ss._run_model(ss.LeaseRaceModel())
+    assert syncpoint.active() is None
+    assert lockwatch.SCHED is None
+
+
+# ------------------------------------------------------ clean-HEAD gate
+
+@pytest.mark.parametrize("name", sorted(ss.MODELS))
+def test_clean_models_explore_violation_free(name):
+    """The tier-1 smoke of the CI clean gate: every protocol model at a
+    reduced budget. A violation here is a REAL finding against HEAD —
+    the dumped schedule in the assertion message is the repro."""
+    cls = ss.MODELS[name]
+    res = ss.explore(cls, max_schedules=min(cls.budget, 120),
+                     preemption_bound=cls.preemption_bound)
+    assert res["violations"] == [], res["violations"]
+
+
+@pytest.mark.parametrize("name", ["lease_race", "mvcc_update",
+                                  "queue_getdone", "lease_expiry"])
+def test_small_models_are_exhaustive(name):
+    """The four small models' bounded spaces DRAIN — the result is a
+    proof over the bound, not a sample."""
+    cls = ss.MODELS[name]
+    res = ss.explore(cls, max_schedules=400)
+    assert res["violations"] == []
+    assert res["exhaustive"]
+
+
+def test_fair_run_progress_handoff_completes():
+    """Liveness leg: under a round-robin-fair schedule the A→B handoff
+    completes — B activates, A forgets. A wedged ack barrier fails
+    here (the safety explorer can't assert liveness per-interleaving)."""
+    sim = ss.fair_run(ss.ShardHandoffModel)
+    assert sim.violation is None, sim.violation
+
+
+# ------------------------------------------------- sync-point honesty
+
+def test_sync_point_inventory_matches_instrumented_modules():
+    """Every label in SYNC_POINTS resolves to a real syncpoint.sync
+    call in the module its description names — the explorer's
+    serialization points and the docs can't drift from the code."""
+    cp = REPO / "service_account_auth_improvements_tpu/controlplane"
+    sources = {
+        "kube/fake.py": (cp / "kube/fake.py").read_text(),
+        "engine/queue.py": (cp / "engine/queue.py").read_text(),
+        "engine/shard.py": (cp / "engine/shard.py").read_text(),
+        "engine/leaderelection.py":
+            (cp / "engine/leaderelection.py").read_text(),
+    }
+    for label, where in ss.SYNC_POINTS.items():
+        module = next((m for m in sources if m in where), None)
+        assert module is not None, f"{label}: description names no "\
+            "instrumented module"
+        assert f'syncpoint.sync("{label}"' in sources[module], (
+            f"{label}: no syncpoint.sync call in {module}"
+        )
+
+
+def test_sync_hook_is_zero_cost_when_disabled():
+    """The production path: sync() with no hook installed is a global
+    load + None check — and install/uninstall round-trips."""
+    from service_account_auth_improvements_tpu.controlplane import (
+        syncpoint,
+    )
+
+    seen = []
+    assert syncpoint.active() is None
+    syncpoint.sync("anything", 1)   # no hook: no effect, no raise
+    syncpoint.install(seen.append and (lambda l, d: seen.append((l, d))))
+    try:
+        with pytest.raises(RuntimeError):
+            syncpoint.install(lambda l, d: None)   # not reentrant
+        syncpoint.sync("fake.commit", "pods")
+        assert seen == [("fake.commit", "pods")]
+    finally:
+        syncpoint.uninstall()
+    assert syncpoint.active() is None
+
+
+# ---------------------------------------------------- mutation suite
+
+#: one representative per subsystem, cheap enough for tier-1 (each is
+#: caught within ~30 schedules); the full ten-mutant matrix runs in
+#: the slow lane below and in CI's controlplane_bench mutation step
+FAST_MUTANTS = ("fake-commit-identity-dropped", "queue-dirty-dropped",
+                "lease-steal-held")
+
+
+@pytest.mark.parametrize("name", FAST_MUTANTS)
+def test_fast_mutants_are_caught(name):
+    record = ss.run_mutations([name], budget=400)
+    entry = record["mutants"][name]
+    assert entry["caught"], f"seeded bug {name} survived exploration"
+    assert entry["caught_by"]["choices"], "no replayable schedule"
+
+
+@pytest.mark.slow
+def test_full_mutation_matrix_is_caught():
+    """Acceptance: every seeded protocol mutant (≥8, covering shard
+    handoff, lease fencing, MVCC commit, queue get→done) caught within
+    the CI budget."""
+    record = ss.run_mutations()
+    assert len(record["mutants"]) >= 8
+    survivors = [n for n, r in record["mutants"].items()
+                 if not r["caught"]]
+    assert record["ok"] and not survivors, survivors
+    covered = {m for name in record["mutants"]
+               for m in ss.MUTANTS[name].models}
+    assert {"shard_handoff", "shard_fence", "lease_expiry",
+            "lease_race", "mvcc_update",
+            "queue_getdone"} <= covered
+
+
+def test_budget_exhaustion_is_not_deadline_interruption():
+    """Review fix: a mutant that survives its full budget with no
+    deadline set reads SURVIVED (a coverage regression), never
+    'interrupted' (which steers the operator at a deadline that was
+    never set); and a deadline cut IS marked interrupted."""
+    rec = ss.run_mutations(["shard-drop-ack-barrier"], budget=2)
+    entry = rec["mutants"]["shard-drop-ack-barrier"]
+    assert not entry["caught"] and not entry["interrupted"]
+    rec = ss.run_mutations(["shard-drop-ack-barrier"],
+                           deadline_s=0.0001)
+    entry = rec["mutants"]["shard-drop-ack-barrier"]
+    assert not entry["caught"] and entry["interrupted"]
+
+
+def test_cli_clean_gate_fails_when_deadline_starved(tmp_path):
+    """Review fix: a model the deadline starved to ZERO schedules
+    proved nothing — the gate must fail, not read absence of
+    exploration as cleanliness."""
+    # two models: the first consumes the (tiny) global deadline, the
+    # second inherits nothing and explores zero schedules
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.cplint.schedsim",
+         "--model", "lease_race", "--model", "mvcc_update",
+         "--budget", "50", "--deadline", "0.0001",
+         "--json", str(tmp_path / "rec.json")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "deadline starved" in proc.stderr
+    rec = json.loads((tmp_path / "rec.json").read_text())
+    assert rec["ok"] is False
+    assert rec["models"]["mvcc_update"]["runs"] == 0
+
+
+def test_mutant_patches_restore_cleanly():
+    """A mutant's patch is scoped to its context manager — after the
+    suite the pristine code is back (the clean gate depends on it)."""
+    from service_account_auth_improvements_tpu.controlplane.kube.fake import (  # noqa: E501
+        FakeKube,
+    )
+
+    orig = FakeKube._commit_ok
+    mut = ss.MUTANTS["fake-commit-identity-dropped"]
+    with mut.apply():
+        assert FakeKube._commit_ok is not orig
+    assert FakeKube._commit_ok is orig
+    # and the clean model still passes after a mutant ran
+    res = ss.explore(ss.LeaseRaceModel, max_schedules=60)
+    assert res["violations"] == []
+
+
+# --------------------------------------------------------------- CLI
+
+def test_cli_clean_gate_and_listings(tmp_path):
+    out = tmp_path / "rec.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.cplint.schedsim",
+         "--model", "lease_race", "--model", "queue_getdone",
+         "--budget", "80", "--json", str(out),
+         "--dump-dir", str(tmp_path / "dumps")],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    rec = json.loads(out.read_text())
+    assert rec["schema"] == "schedsim/v1" and rec["ok"]
+    assert set(rec["models"]) == {"lease_race", "queue_getdone"}
+    for flag, key in (("--list-models", "models"),
+                      ("--list-mutants", "mutants"),
+                      ("--list-sync-points", "sync_points")):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.cplint.schedsim", flag],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert key in json.loads(proc.stdout)
+
+
+def test_cli_replay_reproduces(tmp_path):
+    res = ss.explore(ss.LockInversionModel, max_schedules=60)
+    path = ss.dump_violation(res["violations"][0], tmp_path, 0)
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.cplint.schedsim",
+         "--replay", str(path)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 1
+    assert "reproduces" in proc.stderr
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

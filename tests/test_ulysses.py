@@ -9,8 +9,13 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.jaxdrift import requires_jax_shard_map
+
 from service_account_auth_improvements_tpu.models import llama
 from service_account_auth_improvements_tpu.ops.attention import _dense_attention
+
+# every test here wraps ulysses_attention in jax.shard_map
+pytestmark = requires_jax_shard_map
 from service_account_auth_improvements_tpu.parallel import MeshConfig, make_mesh, use_mesh
 from service_account_auth_improvements_tpu.parallel.ulysses import (
     ulysses_attention,

@@ -408,11 +408,22 @@ def failover_gate(run: dict) -> list[str]:
     return failures
 
 
+#: passes the lint report must PROVE ran (names in report["passes"]) —
+#: the three ISSUE 13 dataflow passes: a report written by an older
+#: cplint (or a --pass subset) silently missing them would read as
+#: clean while guarding nothing
+LINT_REQUIRED_PASSES = ("blocking-under-lock", "check-then-act",
+                        "mvcc-escape")
+
+
 def lint_gate(report: dict) -> list[str]:
     """cplint-report leg: the report must be the real cplint record and
     carry zero unsuppressed errors — a missing or malformed report must
     read as a failure, not as "no findings" (the same asymmetry as the
-    chaos recovery-evidence leg: absence of evidence isn't cleanliness)."""
+    chaos recovery-evidence leg: absence of evidence isn't cleanliness).
+    The concurrency-dataflow passes must additionally be PRESENT in the
+    report's pass list — ran, not merely clean-by-absence — and their
+    per-pass finding counts are reported either way."""
     failures = []
     if report.get("schema") != "cplint/v1":
         failures.append(
@@ -421,6 +432,22 @@ def lint_gate(report: dict) -> list[str]:
             "written by python -m tools.cplint --json?"
         )
         return failures
+    ran = {p.get("name") for p in report.get("passes") or []}
+    missing = [name for name in LINT_REQUIRED_PASSES if name not in ran]
+    if missing:
+        failures.append(
+            f"lint report is missing pass(es) {', '.join(missing)} — "
+            "the concurrency-dataflow passes did not run (older cplint "
+            "or a --pass subset?)"
+        )
+    counts: dict[str, list[int]] = {}
+    for f in report.get("findings") or []:
+        row = counts.setdefault(f.get("pass"), [0, 0])
+        row[1 if f.get("suppressed") else 0] += 1
+    for name in LINT_REQUIRED_PASSES:
+        active, suppressed = counts.get(name, [0, 0])
+        print(f"bench_gate: lint pass {name}: {active} finding(s), "
+              f"{suppressed} suppressed", file=sys.stderr)
     errors = (report.get("counts") or {}).get("errors")
     if errors is None:
         failures.append("lint report has no counts.errors field")

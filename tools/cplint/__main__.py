@@ -4,6 +4,7 @@ exit nonzero on unsuppressed errors.
     python -m tools.cplint                      # all passes
     python -m tools.cplint --pass lock-discipline --pass rbac-check
     python -m tools.cplint --json cplint_report.json   # CI record
+    python -m tools.cplint --list-passes        # machine-readable catalog
 """
 
 from __future__ import annotations
@@ -25,12 +26,24 @@ def main(argv=None) -> int:
                     metavar="NAME",
                     help="run only the named pass (repeatable); "
                          "names: " + ", ".join(p.NAME for p in ALL_PASSES))
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass catalog as JSON to stdout and "
+                         "exit (CI/pre-commit discover fast subsets "
+                         "from this instead of hardcoding names)")
     ap.add_argument("--json", dest="json_out", metavar="PATH",
                     help="write the SARIF-ish JSON report "
                          "(bench_gate --lint-report asserts it clean)")
     ap.add_argument("--repo", default=None,
                     help="repo root override (tests)")
     args = ap.parse_args(argv)
+
+    if args.list_passes:
+        print(json.dumps({
+            "schema": "cplint-passes/v1",
+            "passes": [{"name": p.NAME, "description": p.DESCRIPTION}
+                       for p in ALL_PASSES],
+        }, indent=2))
+        return 0
 
     known = {p.NAME for p in ALL_PASSES}
     only = set(args.passes or ())

@@ -73,6 +73,22 @@ CONTENTION_BUCKETS = (1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0)
 #: measurement overhead), not contention
 CONTENDED_WAIT_S = 1e-4
 
+#: the schedule explorer's hook (tools/cplint/schedsim.py), or None in
+#: every production/test run that isn't actively exploring. When set, a
+#: MODEL thread's blocking lock acquire routes through the cooperative
+#: scheduler (optional yield point + try-acquire/park-until-released
+#: protocol, so a lock held by a *suspended* model thread can never
+#: wedge the harness) and every FakeKube verb becomes a potential
+#: preemption point. Non-model threads pass straight through — the hook
+#: returns None for them. One module-global load on the fast path.
+SCHED = None
+
+
+def set_sched(hook) -> None:
+    """Install/clear the schedule-explorer hook (schedsim only)."""
+    global SCHED
+    SCHED = hook
+
 
 def _new_site_stats() -> dict:
     # "_lock" is the per-site raw stat lock (stripped from snapshots):
@@ -296,6 +312,15 @@ class _WatchedLock:
         self._inner = inner
 
     def acquire(self, blocking: bool = True, timeout: float = -1):
+        sched = SCHED
+        if sched is not None and blocking:
+            # schedsim protocol: returns None off model threads (fall
+            # through to the real acquire), True once the scheduler let
+            # this model thread take the lock
+            ok = sched.lock_acquire(self._site, self._inner)
+            if ok is not None:
+                self._watch.note_acquire(self._site, self, waited=0.0)
+                return ok
         t0 = self._watch._mono()
         ok = self._inner.acquire(blocking, timeout)
         if ok:
@@ -306,6 +331,9 @@ class _WatchedLock:
     def release(self):
         self._watch.note_release(self._site, self)
         self._inner.release()
+        sched = SCHED
+        if sched is not None:
+            sched.lock_release(self._site, self._inner)
 
     def __enter__(self):
         self.acquire()
@@ -375,6 +403,36 @@ def _creation_site(depth: int = 2) -> str | None:
     return f"{fname}:{frame.f_lineno}"
 
 
+def hook_fake_count() -> None:
+    """Wrap FakeKube._count — the choke point every external request
+    passes through before any lock is taken — so the active LockWatch
+    sees held-lock writes and the schedule explorer (SCHED) gets a
+    preemption point per apiserver verb. Idempotent; installed by
+    :func:`install` and by schedsim runs that skip the threading patch."""
+    from service_account_auth_improvements_tpu.controlplane.kube import (
+        fake,
+    )
+
+    if getattr(fake.FakeKube._count, "_lockwatch", False):
+        return
+    orig_count = fake.FakeKube._count
+
+    def counted(self, verb, *args, **kwargs):
+        # *args/**kwargs: _count grew a plural parameter (APF flow
+        # classification) — the hook only cares about the verb
+        w = active()   # current watch, surviving uninstall/reinstall
+        if w is not None:
+            w.note_api_call(verb)
+        sched = SCHED
+        if sched is not None:
+            sched.api_call(verb, args[0] if args
+                           else kwargs.get("plural"))
+        return orig_count(self, verb, *args, **kwargs)
+
+    counted._lockwatch = True  # marker so double-install can't stack
+    fake.FakeKube._count = counted
+
+
 def install() -> LockWatch:
     """Patch threading.Lock/RLock/Condition with creation-site-filtered
     watched variants and hook FakeKube's request choke point. Idempotent;
@@ -413,23 +471,7 @@ def install() -> LockWatch:
 
     # the apiserver choke point: FakeKube._count(verb) runs first in
     # every external request (before FakeKube's own lock is taken)
-    from service_account_auth_improvements_tpu.controlplane.kube import (
-        fake,
-    )
-
-    if not getattr(fake.FakeKube._count, "_lockwatch", False):
-        orig_count = fake.FakeKube._count
-
-        def counted(self, verb, *args, **kwargs):
-            # *args/**kwargs: _count grew a plural parameter (APF flow
-            # classification) — the hook only cares about the verb
-            w = active()   # current watch, surviving uninstall/reinstall
-            if w is not None:
-                w.note_api_call(verb)
-            return orig_count(self, verb, *args, **kwargs)
-
-        counted._lockwatch = True  # marker so double-install can't stack
-        fake.FakeKube._count = counted
+    hook_fake_count()
     return watch
 
 

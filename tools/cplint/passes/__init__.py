@@ -2,11 +2,14 @@
 / run(ctx)."""
 
 from tools.cplint.passes import (
+    blocking_under_lock,
     cache_mutation,
+    check_then_act,
     clock_injection,
     event_reason,
     lock_discipline,
     metrics,
+    mvcc_escape,
     queue_span,
     rbac,
 )
@@ -19,4 +22,7 @@ ALL_PASSES = (
     clock_injection,
     metrics,
     event_reason,
+    blocking_under_lock,
+    check_then_act,
+    mvcc_escape,
 )

@@ -1,0 +1,236 @@
+"""blocking-under-lock: no blocking operation inside a held
+controlplane lock.
+
+The PR 8 leader-elector finding, generalized into a pass: the LOST
+transition used to do a lease GET + Event write — each with a ~30 s
+HTTP timeout — on its way to ``on_lost``, keeping a deposed leader
+alive into the successor's term. The same shape under a *lock* is
+worse: every sibling worker parks behind a thread that is waiting on
+the network, a sleep, or another thread's lifetime. Lockwatch already
+bans apiserver WRITES under held locks dynamically; this pass catches
+the whole family statically, reads included, before any test runs.
+
+Flagged inside a ``with self.<lock>:`` block (or between a bare
+``.acquire()`` and its ``.release()``) in a class that creates the
+lock:
+
+- ``time.sleep(...)`` — scheduled delay under a lock serializes every
+  waiter behind the clock;
+- ``<thread>.join(...)`` — waiting on another thread's lifetime while
+  holding a lock that thread may want is a deadlock-by-design;
+- apiserver I/O — any verb (``get/list/watch/create/update/patch/
+  delete``) on a receiver named like a kube client (``kube``,
+  ``client``, ``api``); reads block exactly as long as writes when
+  chaos latency or a blackout is in play;
+- HTTP/socket calls (``urlopen``, ``request``, ``getresponse``,
+  ``connect``, ``sendall``, ``recv``).
+
+Out of scope: ``kube/`` itself (the fake IS the apiserver — its own
+machinery runs under its own locks by design, the same exemption
+lockwatch's held-write check applies), ``Condition.wait`` on the held
+lock (that RELEASES it — the sanctioned blocking-under-lock shape),
+and lock-free code (no lock in scope, no finding).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "blocking-under-lock"
+DESCRIPTION = (
+    "apiserver I/O, sleep, join, or socket work while holding a "
+    "controlplane lock"
+)
+
+SCOPE = CONTROLPLANE
+#: the fake apiserver's own machinery legitimately runs under its own
+#: locks (lockwatch carves out the same exemption for held-write checks)
+EXEMPT_PATH_FRAGMENT = "/kube/"
+
+#: apiserver verbs on a kube-client-shaped receiver
+KUBE_VERBS = frozenset({
+    "get", "list", "watch", "create", "update", "update_status",
+    "patch", "delete",
+})
+KUBE_RECEIVERS = frozenset({"kube", "client", "api", "live"})
+
+#: method names that block on the network regardless of receiver
+NET_CALLS = frozenset({
+    "urlopen", "getresponse", "connect", "sendall", "recv",
+})
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        if EXEMPT_PATH_FRAGMENT in path.as_posix():
+            continue
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_check_class(ctx, path, node))
+    return findings
+
+
+def _lock_attrs(cls: ast.ClassDef) -> set:
+    locks = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            name = astutil.call_name(node.value)
+            if name in ("Lock", "RLock", "Condition"):
+                for tgt in node.targets:
+                    attr = astutil.self_attr(tgt)
+                    if attr:
+                        locks.add(attr)
+    return locks
+
+
+def _is_lock_expr(expr: ast.AST, locks: set) -> str | None:
+    attr = astutil.self_attr(expr)
+    if attr in locks:
+        return attr
+    return None
+
+
+def _kube_receiver(node: ast.Call) -> bool:
+    """``self.kube.get(...)``, ``kube.update(...)``,
+    ``self._client.api.patch(...)`` — the receiver chain ends in a
+    kube-client-shaped name."""
+    if not isinstance(node.func, ast.Attribute):
+        return False
+    if node.func.attr not in KUBE_VERBS:
+        return False
+    chain = astutil.attr_chain(node.func.value)
+    if not chain:
+        return False
+    tail = chain[-1].lstrip("_")
+    return any(tail == r or tail.endswith("_" + r)
+               or tail.startswith(r + "_") or tail == r + "s"
+               for r in KUBE_RECEIVERS) or "kube" in tail
+
+
+def _blocking_reason(node: ast.Call, held_locks: set) -> str | None:
+    """Why this call blocks, or None."""
+    name = astutil.call_name(node)
+    chain = astutil.attr_chain(node.func) or []
+    if name == "sleep" and chain and chain[0] in ("time",):
+        return "time.sleep under a held lock"
+    if name == "join" and isinstance(node.func, ast.Attribute):
+        # only thread-ish receivers count — str.join / os.path.join
+        # share the method name, so the receiver NAME is the filter
+        recv = astutil.dotted(node.func.value) or ""
+        tail = recv.split(".")[-1]
+        if ("thread" in tail or tail in ("t", "worker")
+                or tail.startswith("_t")):
+            return f"{recv}.join() under a held lock"
+        return None
+    if name == "wait" and isinstance(node.func, ast.Attribute):
+        # Condition.wait on the HELD lock releases it (sanctioned);
+        # waiting on a DIFFERENT event/condition under a lock blocks
+        recv_attr = astutil.self_attr(node.func.value)
+        if recv_attr is not None and recv_attr not in held_locks:
+            # Event.wait with no/long timeout under a lock; a short
+            # timeout poll is still a hold — flag uniformly, suppress
+            # with justification where intended
+            return (f"self.{recv_attr}.wait() under a held lock "
+                    "(only waiting on the held lock's own Condition "
+                    "releases it)")
+        return None
+    if name in NET_CALLS:
+        return f"{name}() network call under a held lock"
+    if _kube_receiver(node):
+        return (f"apiserver {node.func.attr}() under a held lock — "
+                "a chaos latency/blackout turns this into every "
+                "sibling worker parked behind one request")
+    return None
+
+
+class _Scanner:
+    def __init__(self, ctx, path, locks):
+        self.ctx = ctx
+        self.path = path
+        self.locks = locks
+        self.findings: list = []
+
+    def scan_body(self, stmts, held: set) -> None:
+        # held threads ACROSS sibling statements (a bare .acquire()
+        # poisons everything until its .release()), copied at body
+        # boundaries so an inner block's acquire doesn't leak out
+        held = set(held)
+        for stmt in stmts:
+            self.scan_stmt(stmt, held)
+
+    def scan_stmt(self, stmt, held: set) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            inner = set(held)
+            for item in stmt.items:
+                lock = _is_lock_expr(item.context_expr, self.locks)
+                if lock:
+                    inner.add(lock)
+                else:
+                    self.scan_expr(item.context_expr, held)
+            self.scan_body(stmt.body, inner)
+            return
+        # bare acquire()/release() tracking within one body
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value,
+                                                     ast.Call):
+            call = stmt.value
+            if isinstance(call.func, ast.Attribute):
+                recv = astutil.self_attr(call.func.value)
+                if recv in self.locks:
+                    if call.func.attr == "acquire":
+                        held.add(recv)
+                        return
+                    if call.func.attr == "release":
+                        held.discard(recv)
+                        return
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, field, None)
+            if sub:
+                self.scan_body(sub, held)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self.scan_body(handler.body, held)
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self.scan_expr(node, held)
+
+    def scan_expr(self, expr, held: set) -> None:
+        if not held:
+            return
+        for node in astutil.walk_no_nested_functions(expr):
+            if isinstance(node, ast.Call):
+                reason = _blocking_reason(node, held)
+                if reason:
+                    lock_names = ", ".join(
+                        sorted("self." + x for x in held))
+                    self.findings.append(self.ctx.finding(
+                        NAME, self.path, node.lineno,
+                        f"{reason} (holding {lock_names}) — release "
+                        "the lock before blocking (the tpusched "
+                        "write-after-lock-drop rule, docs/cplint.md)",
+                    ))
+
+
+def _check_class(ctx, path, cls: ast.ClassDef) -> list:
+    locks = _lock_attrs(cls)
+    if not locks:
+        return []
+    scanner = _Scanner(ctx, path, locks)
+    for fn in cls.body:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            base = {next(iter(locks))} if fn.name.endswith("_locked") \
+                and len(locks) == 1 else set()
+            if fn.name.endswith("_locked") and len(locks) > 1:
+                base = set(locks)   # conservative: some lock is held
+            scanner.scan_body(fn.body, base)
+    return scanner.findings

@@ -1,0 +1,192 @@
+"""check-then-act: a decision read from the cache must not drive an
+unguarded write.
+
+The tpusched booking-stamp family, generalized: a reconciler reads
+state from the informer cache (or through ``CachedClient``), decides,
+and then performs a dependent apiserver write. Between the read and
+the write the world moves — the cache is a *level*, not a lock. The
+repo's three sanctioned shapes (docs/engine.md "When to force a live
+read") are:
+
+- **RV guard**: ``update`` of the (deep-copied) read object carries its
+  ``resourceVersion`` — a stale decision dies as a ``Conflict`` and the
+  level-triggered requeue re-decides. Updates are therefore exempt.
+- **live confirm**: re-read through ``.live`` before committing (what
+  the tpusched legacy-adoption fix did).
+- **requeue path**: the function visibly re-enters on failure —
+  ``add_rate_limited`` / ``add_after`` / a ``Result(requeue...)`` —
+  so a raced write converges instead of silently winning.
+
+Flagged: a ``create``/``delete``/``patch`` (the RV-*unguarded* verbs)
+inside a conditional whose test involves a cache-read value, in a
+function with none of the three shapes. This is deliberately a
+heuristic — it proves the *shape* is present, not that the guard
+actually covers the race; suppressions carry the argument when the
+analysis can't see it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.cplint.core import CONTROLPLANE
+from tools.cplint.passes.cache_mutation import (
+    _source_kind,
+    _known_plurals,
+)
+
+NAME = "check-then-act"
+DESCRIPTION = (
+    "cache-read decision followed by an RV-unguarded dependent write "
+    "with no live confirm or requeue path"
+)
+
+SCOPE = CONTROLPLANE
+#: kube/ is the apiserver + fault-injection layer itself: its reads
+#: are live by construction (there is no cache between the fake and
+#: itself), so the staleness this pass hunts cannot arise there
+EXEMPT_PATH_FRAGMENT = "/kube/"
+
+#: write verbs with NO optimistic-concurrency guard: a create races
+#: a concurrent create/delete, a delete races a recreate, a merge
+#: patch overwrites whatever landed since the read
+UNGUARDED_WRITES = frozenset({"create", "delete", "patch"})
+
+#: calls that prove a requeue path exists in this function
+REQUEUE_CALLS = frozenset({"add_rate_limited", "add_after",
+                           "enqueue_after"})
+
+
+def run(ctx) -> list:
+    plurals = _known_plurals()
+    findings = []
+    for path in ctx.files(*SCOPE):
+        if EXEMPT_PATH_FRAGMENT in path.as_posix():
+            continue
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_check_function(ctx, path, fn, plurals))
+    return findings
+
+
+def _has_absolution(fn: ast.AST) -> bool:
+    """Live confirm or requeue path anywhere in the function."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute) and node.attr == "live":
+            return True
+        if isinstance(node, ast.Call):
+            name = astutil.call_name(node)
+            if name in REQUEUE_CALLS:
+                return True
+            if name == "Result":
+                for kw in node.keywords:
+                    if kw.arg in ("requeue", "requeue_after"):
+                        return True
+                if node.args:
+                    return True
+        if isinstance(node, ast.Assign):
+            # the repo's helper idiom: a function computing a
+            # ``requeue_after`` for its caller's Result IS the requeue
+            # path, one frame removed
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and "requeue" in tgt.id:
+                    return True
+        if isinstance(node, ast.Raise):
+            # a raising branch re-levels through the worker's backoff —
+            # the engine's error path IS a requeue path
+            return True
+    return False
+
+
+def _names_in(expr: ast.AST) -> set:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _check_function(ctx, path, fn, plurals) -> list:
+    if _has_absolution(fn):
+        return []
+    # pass 1: find cache-read tainted names (flow order, same model as
+    # cache-mutation: assignment from a cache read, ["items"] hops,
+    # iteration)
+    tainted: set = set()
+    nodes = [n for n in astutil.walk_no_nested_functions(fn)
+             if hasattr(n, "lineno")]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+
+    def expr_tainted(expr) -> bool:
+        if isinstance(expr, ast.Call):
+            if _source_kind(expr, plurals):
+                return True
+            if isinstance(expr.func, ast.Attribute) and \
+                    expr.func.attr == "get":
+                base = astutil.base_name(expr.func.value)
+                return base in tainted
+            return False
+        if isinstance(expr, ast.Subscript):
+            base = astutil.base_name(expr)
+            if base in tainted:
+                return True
+            return isinstance(expr.value, ast.Call) and \
+                expr_tainted(expr.value)
+        if isinstance(expr, ast.Name):
+            return expr.id in tainted
+        if isinstance(expr, ast.Attribute):
+            base = astutil.base_name(expr)
+            return base in tainted
+        return False
+
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            hit = expr_tainted(node.value)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    if hit:
+                        tainted.add(tgt.id)
+                    else:
+                        tainted.discard(tgt.id)
+        elif isinstance(node, ast.For):
+            if expr_tainted(node.iter) and \
+                    isinstance(node.target, ast.Name):
+                tainted.add(node.target.id)
+    if not tainted:
+        return []
+    # pass 2: conditionals whose test reads a tainted name, guarding an
+    # unguarded write
+    findings = []
+
+    def scan(node, guarded_by: set):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return
+        if isinstance(node, ast.If):
+            test_names = _names_in(node.test) & tainted
+            for child in node.body:
+                scan(child, guarded_by | test_names)
+            for child in node.orelse:
+                scan(child, guarded_by | test_names)
+            return
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in UNGUARDED_WRITES and guarded_by:
+                plural = astutil.str_arg(node)
+                if plural in plurals:
+                    findings.append(ctx.finding(
+                        NAME, path, node.lineno,
+                        f"{node.func.attr}({plural!r}, ...) is guarded "
+                        f"by cache-read value(s) "
+                        f"{sorted(guarded_by)} with no live confirm, "
+                        "RV guard, or requeue path — the decision can "
+                        "be stale by the time the write lands (the "
+                        "tpusched booking-stamp family, "
+                        "docs/cplint.md)",
+                    ))
+        for child in ast.iter_child_nodes(node):
+            scan(child, guarded_by)
+
+    for stmt in fn.body:
+        scan(stmt, set())
+    return findings

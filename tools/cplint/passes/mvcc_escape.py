@@ -1,0 +1,330 @@
+"""mvcc-escape: stored/emitted FakeKube objects are immutable, by
+machine check instead of convention.
+
+The PR 11 copy-on-write contract (docs/fakekube.md): once an object is
+committed to a stripe (``stripe.objects[key] = x``) or emitted as a
+watch event (``_emit_locked`` / watch queue), it is SHARED — GET
+snapshots it by reference, watch fanout is zero-copy, informer caches
+hold the apiserver's own snapshots. One in-place mutation after that
+point tears state for every reader. Until now a single dynamic pass
+(cache-mutation, consumer side) plus convention enforced it; this pass
+checks the *producer* side statically, inside ``kube/``.
+
+Per function (kube/ scope):
+
+- **frozen sources**: reads from stripe storage (any ``.objects``
+  subscript/``.get``/``.values``/``.items`` access, including
+  iteration), objects passed to ``_emit_locked`` or a watch queue
+  ``put``, objects assigned INTO storage (frozen from the commit line
+  on — flow order matters: stamping the RV *before* the store insert
+  is the contract, after it is the bug), and ``event``/``ev``
+  function parameters (watch events are shared by contract);
+- **violations**: any in-place mutation of a frozen object — subscript
+  or attribute write, ``del``, augmented assignment, mutating method
+  calls — directly or through an alias (``meta = obj["metadata"]``);
+- **sanctioned shapes**: build a successor instead. ``copy.deepcopy``
+  / ``json_merge_patch`` / ``_apply_json_patch`` results are fully
+  fresh (mutate freely); ``dict(x)`` / ``{**x}`` are SHALLOW — the
+  top level is yours, every nested subtree is still shared, so only
+  top-level writes (and writes under a slot you re-assigned to a
+  fresh value first, the ``new["metadata"] = {**cur["metadata"],...}``
+  idiom) are allowed.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+
+NAME = "mvcc-escape"
+DESCRIPTION = (
+    "mutation of a FakeKube object after it was committed to a stripe "
+    "or emitted as a watch event"
+)
+
+SCOPE = (
+    "service_account_auth_improvements_tpu/controlplane/kube",
+)
+
+#: fully-fresh constructors: the result shares nothing with its source
+DEEP_FRESH = frozenset({"deepcopy", "json_merge_patch",
+                        "_apply_json_patch"})
+#: shallow constructors: top level fresh, subtrees shared
+SHALLOW_FRESH = frozenset({"dict"})
+
+#: parameters that carry shared watch events by contract
+EVENT_PARAMS = frozenset({"ev", "event"})
+
+_STATE_FRESH = "fresh"      # owns everything
+_STATE_SHALLOW = "shallow"  # owns the top level only
+_STATE_FROZEN = "frozen"    # owns nothing
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_check_function(ctx, path, fn))
+    return findings
+
+
+def _reads_storage(expr: ast.AST) -> bool:
+    """``stripe.objects.get(k)`` / ``stripe.objects[k]`` /
+    ``s.objects.values()`` — any read out of stripe storage."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr == "objects":
+            return True
+    return False
+
+
+def _is_storage_target(tgt: ast.AST) -> bool:
+    """``stripe.objects[key] = ...`` — the commit itself."""
+    return (isinstance(tgt, ast.Subscript)
+            and isinstance(tgt.value, ast.Attribute)
+            and tgt.value.attr == "objects")
+
+
+def _sub_depth(node: ast.AST) -> tuple[str | None, int, str | None]:
+    """(root var, subscript/attr depth, first-level constant key) of a
+    write target like ``x["metadata"]["labels"]``."""
+    depth = 0
+    first_key = None
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        depth += 1
+        if isinstance(node, ast.Subscript):
+            sl = node.slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value,
+                                                          str):
+                first_key = sl.value
+        else:
+            first_key = node.attr
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id, depth, first_key
+    return None, depth, first_key
+
+
+class _Fn:
+    def __init__(self, ctx, path, fn):
+        self.ctx = ctx
+        self.path = path
+        self.fn = fn
+        self.state: dict = {}       # var -> _STATE_*
+        self.aliases: dict = {}     # var -> root var
+        self.refreshed: dict = {}   # shallow var -> set of fresh slots
+        self.findings: list = []
+
+    def root(self, var: str | None) -> str | None:
+        seen = set()
+        while var in self.aliases and var not in seen:
+            seen.add(var)
+            var = self.aliases[var]
+        return var
+
+    def var_state(self, var: str | None) -> str | None:
+        return self.state.get(self.root(var))
+
+    def freeze(self, var: str | None) -> None:
+        var = self.root(var)
+        if var is not None:
+            self.state[var] = _STATE_FROZEN
+
+    def _value_state(self, expr: ast.AST):
+        """(state, source_root) the assigned value confers."""
+        if isinstance(expr, ast.Call):
+            name = astutil.call_name(expr)
+            if name in DEEP_FRESH:
+                return _STATE_FRESH, None
+            if name in SHALLOW_FRESH and expr.args:
+                return _STATE_SHALLOW, None
+            if name == "copy" and expr.func and \
+                    isinstance(expr.func, ast.Attribute) and \
+                    not expr.args:
+                return _STATE_SHALLOW, None
+            # x.get(...) / x.setdefault(...) off a tracked var: alias
+            # into its subtree
+            if isinstance(expr.func, ast.Attribute) and \
+                    expr.func.attr in ("get", "setdefault"):
+                base = astutil.base_name(expr.func.value)
+                if self.var_state(base) is not None:
+                    return "alias", base
+            if _reads_storage(expr):
+                return _STATE_FROZEN, None
+            return None, None
+        if isinstance(expr, ast.Dict):
+            # {**x, ...}: shallow over whatever x shares
+            if any(k is None for k in expr.keys):
+                return _STATE_SHALLOW, None
+            return _STATE_FRESH, None
+        if isinstance(expr, (ast.Subscript, ast.Attribute)):
+            if _reads_storage(expr):
+                return _STATE_FROZEN, None
+            base = astutil.base_name(expr)
+            if self.var_state(base) is not None:
+                return "alias", base
+            return None, None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.state or expr.id in self.aliases:
+                return "alias", expr.id
+            return None, None
+        return None, None
+
+    def flag(self, node, var, how: str) -> None:
+        self.findings.append(self.ctx.finding(
+            NAME, self.path, node.lineno,
+            f"{how} — the object reachable through {var!r} is already "
+            "committed to a stripe or emitted as a watch event and is "
+            "SHARED with every reader; commit a successor instead "
+            "(copy-on-write contract, docs/fakekube.md)",
+        ))
+
+    def check_write(self, tgt, node) -> None:
+        var, depth, first_key = _sub_depth(tgt)
+        if var is None or depth == 0:
+            return
+        st = self.var_state(var)
+        if st is None or st == _STATE_FRESH:
+            return
+        rootv = self.root(var)
+        if st == _STATE_FROZEN:
+            self.flag(node, var,
+                      f"in-place write {ast.unparse(tgt)!r}")
+            return
+        # shallow: depth-1 writes own the top level; deeper writes
+        # escape into shared subtrees unless that slot was refreshed
+        if depth == 1:
+            return
+        if first_key is not None and \
+                first_key in self.refreshed.get(rootv, set()):
+            return
+        self.flag(node, var,
+                  f"write through a SHALLOW copy "
+                  f"{ast.unparse(tgt)!r} reaches a shared subtree")
+
+    def note_shallow_refresh(self, tgt, value) -> None:
+        """``y[K] = <fresh>`` on a shallow var makes slot K owned."""
+        if not isinstance(tgt, ast.Subscript):
+            return
+        var, depth, first_key = _sub_depth(tgt)
+        rootv = self.root(var)
+        if depth != 1 or first_key is None or \
+                self.var_state(var) != _STATE_SHALLOW:
+            return
+        vstate, _src = self._value_state(value)
+        if vstate in (_STATE_FRESH, _STATE_SHALLOW):
+            self.refreshed.setdefault(rootv, set()).add(first_key)
+
+    def check_mutator_call(self, node: ast.Call) -> None:
+        name = astutil.call_name(node)
+        if name not in astutil.MUTATING_METHODS and name != "pop":
+            return
+        if not isinstance(node.func, ast.Attribute):
+            return
+        recv = node.func.value
+        var, depth, first_key = _sub_depth(recv)
+        if var is None:
+            # direct Name receiver: x.update(...)
+            if isinstance(recv, ast.Name):
+                var, depth, first_key = recv.id, 0, None
+            else:
+                return
+        st = self.var_state(var)
+        if st is None or st == _STATE_FRESH:
+            return
+        rootv = self.root(var)
+        if st == _STATE_FROZEN:
+            self.flag(node, var, f"mutating call .{name}()")
+            return
+        if depth == 0:
+            return   # top-level mutator on the shallow copy itself
+        if first_key is not None and \
+                first_key in self.refreshed.get(rootv, set()):
+            return
+        self.flag(node, var,
+                  f"mutating call .{name}() through a SHALLOW copy "
+                  "reaches a shared subtree")
+
+    def scan(self) -> list:
+        # event/ev parameters are shared watch events by contract
+        args = self.fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            if a.arg in EVENT_PARAMS:
+                self.state[a.arg] = _STATE_FROZEN
+        nodes = [n for n in astutil.walk_no_nested_functions(self.fn)
+                 if hasattr(n, "lineno")]
+        nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+        for node in nodes:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if _is_storage_target(tgt):
+                        # the commit: the committed object is frozen
+                        # from HERE on (stamping before the insert is
+                        # the contract; after it is the bug)
+                        vname = astutil.base_name(node.value)
+                        self.freeze(vname)
+                        continue
+                    if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                        self.check_write(tgt, node)
+                        self.note_shallow_refresh(tgt, node.value)
+                # (re)binding plain names
+                vstate, src = self._value_state(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.aliases.pop(tgt.id, None)
+                        self.state.pop(tgt.id, None)
+                        self.refreshed.pop(tgt.id, None)
+                        if vstate == "alias":
+                            self.aliases[tgt.id] = src
+                        elif vstate is not None:
+                            self.state[tgt.id] = vstate
+                    elif isinstance(tgt, ast.Tuple):
+                        # for key, obj in ...items(): handled by For
+                        for elt in tgt.elts:
+                            if isinstance(elt, ast.Name):
+                                self.aliases.pop(elt.id, None)
+                                self.state.pop(elt.id, None)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, (ast.Subscript,
+                                            ast.Attribute)):
+                    self.check_write(node.target, node)
+            elif isinstance(node, ast.Delete):
+                for tgt in node.targets:
+                    if _is_storage_target(tgt):
+                        continue   # removing the key is the delete verb
+                    if isinstance(tgt, (ast.Subscript, ast.Attribute)):
+                        self.check_write(tgt, node)
+            elif isinstance(node, ast.For):
+                taints = _reads_storage(node.iter)
+                names = []
+                if isinstance(node.target, ast.Name):
+                    names = [node.target.id]
+                elif isinstance(node.target, ast.Tuple):
+                    names = [e.id for e in node.target.elts
+                             if isinstance(e, ast.Name)]
+                for nm in names:
+                    self.aliases.pop(nm, None)
+                    if taints:
+                        self.state[nm] = _STATE_FROZEN
+                    else:
+                        self.state.pop(nm, None)
+            elif isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name == "_emit_locked" and len(node.args) >= 3:
+                    self.check_mutator_call(node)
+                    vname = astutil.base_name(node.args[2])
+                    self.freeze(vname)
+                elif name == "put" and node.args:
+                    vname = astutil.base_name(node.args[0])
+                    self.freeze(vname)
+                else:
+                    self.check_mutator_call(node)
+        return self.findings
+
+
+def _check_function(ctx, path, fn) -> list:
+    return _Fn(ctx, path, fn).scan()

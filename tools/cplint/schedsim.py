@@ -1,0 +1,1922 @@
+"""schedsim: deterministic-interleaving model checker for the plane's
+consensus protocols.
+
+The static passes prove shape; lockwatch catches whatever interleaving
+the OS scheduler happens to produce. This module closes the remaining
+gap: it runs *small-scope models* of the consensus-critical code — the
+cpshard handoff ack-barrier (engine/shard.py), leader-election expiry
+under skew (engine/leaderelection.py), FakeKube's MVCC optimistic
+commits (kube/fake.py), and the workqueue get→done contract
+(engine/queue.py) — under a **cooperative scheduler** that serializes
+the model's threads at instrumented sync points and *enumerates* their
+interleavings:
+
+- **sync points** come from three instrumented layers, all zero-cost in
+  production: explicit ``controlplane/syncpoint.py`` calls at protocol
+  transitions (the optimistic-commit window, queue transitions, shard
+  handoff phases, lease acquire), the lockwatch lock wrappers (so a
+  lock held by a *suspended* model thread parks the acquirer instead of
+  wedging the harness — and a real A→B/B→A inversion surfaces as a
+  detected deadlock), and the FakeKube ``_count`` choke point (every
+  apiserver verb is a potential preemption).
+- **exploration** is replay-based DFS with sleep-set partial-order
+  reduction (alternatives whose next operation commutes with the chosen
+  one are pruned — DPOR-style: one representative per Mazurkiewicz
+  trace) and CHESS-style preemption bounding, under a schedule budget
+  and wall deadline. Model threads otherwise run to their next block
+  point, so the default schedule is the cheap one and every preemption
+  is an explicit, replayable choice.
+- **violations** — a dual reconcile recorded by the model's ledger, a
+  lost update, an illegal lease takeover, a dropped level-triggered
+  re-add, a deadlock, a wedged barrier — dump a replayable schedule
+  (the exact choice list) as JSON; ``--replay`` re-runs that exact
+  interleaving, and tests/test_schedsim.py replays dumps as failing
+  tests.
+- **mutation validation** (``--mutations``): ~10 hand-seeded protocol
+  bugs (drop the ack barrier, ack before drain, skip self-fence,
+  activate through a stale post-fence map, ignore lease skew bounds,
+  steal held leases, drop the MVCC commit identity check, emit DELETED
+  at the stale RV, drop the dirty re-add, skip processing
+  registration) each applied as a runtime patch; every one must be
+  caught by the explorer within the CI budget, and clean HEAD must
+  explore violation-free. A checker that cannot catch a seeded
+  regression of a bug this repo already fixed once guards nothing.
+
+CLI::
+
+    python -m tools.cplint.schedsim                  # clean-HEAD gate
+    python -m tools.cplint.schedsim --mutations      # mutant suite
+    python -m tools.cplint.schedsim --model mvcc_update --budget 500
+    python -m tools.cplint.schedsim --replay schedsim_out/fail_0.json
+    python -m tools.cplint.schedsim --list-models --list-sync-points
+
+docs/cplint.md "Schedule exploration" is the operator's guide.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import datetime
+import heapq
+import json
+import pathlib
+import random
+import sys
+import threading
+import time
+
+REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+if str(REPO) not in sys.path:  # pragma: no cover - direct invocation
+    sys.path.insert(0, str(REPO))
+
+from service_account_auth_improvements_tpu.controlplane import (  # noqa: E402,E501
+    syncpoint,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
+    leaderelection,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E402,E501
+    LEASE_GROUP,
+    LeaderElector,
+    renew_stale as _pristine_renew_stale,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.queue import (  # noqa: E402,E501
+    RateLimitingQueue,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E402,E501
+    ANN_ACKED,
+    ANN_EPOCH,
+    ANN_MAP,
+    ANN_MEMBERS,
+    ANN_SHARDS,
+    FOREIGN,
+    HOLD,
+    OWN,
+    ShardMember,
+    shard_of,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (  # noqa: E402,E501
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.kube.fake import (  # noqa: E402,E501
+    FakeKube,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.journal import (  # noqa: E402,E501
+    Journal,
+)
+from tools.cplint import lockwatch  # noqa: E402
+
+GROUP = "tpukf.dev"
+
+#: the sync-point inventory the explorer serializes on — kept in ONE
+#: place so docs, --list-sync-points, and the instrumented modules can
+#: be diffed (tests assert each label resolves to a real syncpoint.sync
+#: call in its module). The three new static passes analyze exactly the
+#: regions between these points: blocking-under-lock walks the lock
+#: sites lockwatch instruments, mvcc-escape the commit points, and
+#: check-then-act the read→write windows the "fake.commit" point lets
+#: this explorer preempt inside.
+SYNC_POINTS = {
+    "fake.commit": "kube/fake.py — the optimistic-commit window "
+                   "(successor built lock-free from the current object; "
+                   "a racing commit must force a recompute)",
+    "queue.add": "engine/queue.py — key becomes pending (or dirty)",
+    "queue.get": "engine/queue.py — worker pickup, key → _processing",
+    "queue.done": "engine/queue.py — key released; dirty re-adds "
+                  "re-level here",
+    "queue.discard": "engine/queue.py — shard handoff backlog prune",
+    "shard.heartbeat": "engine/shard.py — member Lease renew carrying "
+                       "the acked epoch",
+    "shard.read_map": "engine/shard.py — map Lease poll / epoch apply",
+    "shard.barrier": "engine/shard.py — gained-shard activation "
+                     "barrier (every live fellow member acked)",
+    "shard.ack": "engine/shard.py — drain-then-ack of a lost epoch",
+    "lease.try_acquire": "engine/leaderelection.py — one acquire/renew "
+                         "attempt against the Lease",
+}
+
+
+class Violation(AssertionError):
+    """A model invariant failed under the explored interleaving."""
+
+
+class _Abort(BaseException):
+    """Internal: unwind a suspended model thread during teardown."""
+
+
+# =====================================================================
+# virtual clock + ledger
+# =====================================================================
+
+_EPOCH0 = datetime.datetime(2026, 1, 1, tzinfo=datetime.timezone.utc)
+
+
+class VClock:
+    """Deterministic wall+mono clock pair for the protocol models —
+    time only moves when a scripted step advances it."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def now(self) -> datetime.datetime:
+        return _EPOCH0 + datetime.timedelta(seconds=self.t)
+
+    def mono(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
+class Ledger:
+    """The dual-reconcile detector (the PR 12 ha bench ledger, reduced
+    to model scale): enter/exit around each model reconcile; two actors
+    inside the same unit concurrently is the violation the shard
+    protocol exists to prevent. Single-threaded by construction — only
+    one model thread runs at a time."""
+
+    def __init__(self):
+        self._inflight: dict = {}     # unit -> set of actors
+        self.violations: list[str] = []
+
+    def enter(self, actor: str, unit) -> None:
+        cur = self._inflight.setdefault(unit, set())
+        if cur:
+            self.violations.append(
+                f"dual reconcile of {unit!r}: {actor} overlaps "
+                f"{sorted(cur)}"
+            )
+        cur.add(actor)
+
+    def exit(self, actor: str, unit) -> None:
+        self._inflight.get(unit, set()).discard(actor)
+
+    def busy(self, actor: str, units=None) -> bool:
+        for unit, actors in self._inflight.items():
+            if actor in actors and (units is None or unit in units):
+                return True
+        return False
+
+
+# =====================================================================
+# the cooperative scheduler
+# =====================================================================
+
+class _Member:
+    __slots__ = ("name", "fn", "thread", "gate", "state", "op", "pred",
+                 "blocked", "error")
+
+    def __init__(self, name, fn):
+        self.name = name
+        self.fn = fn
+        self.thread = None
+        self.gate = threading.Event()
+        self.state = "new"   # new|ready|running|lockwait|condwait|done
+        self.op = None       # (label, resource, kind)
+        self.pred = None
+        self.blocked = None
+        self.error = None
+
+
+_ACTIVE: "SchedSim | None" = None
+
+
+def step(label: str, detail=None) -> None:
+    """Model-script yield point (``sync:model.<label>``). No-op outside
+    a schedsim run, so model bodies are plain callable code."""
+    syncpoint.sync("model." + label, detail)
+
+
+def wait_until(pred, label: str = "cond", timeout: float = 5.0) -> None:
+    """Park the calling model thread until ``pred()`` is true (the
+    scheduler re-evaluates at every decision). Off a model thread this
+    degrades to a real-time spin so model setup code can reuse it."""
+    sim = _ACTIVE
+    if sim is not None:
+        me = sim._me()
+        if me is not None:
+            # resource None = conflicts with everything: the predicate
+            # reads state written by plain model code between other
+            # threads' ops, which the resource relation cannot see —
+            # never prune around a wait
+            sim._park(me, "condwait",
+                      op=("wait:" + label, None, "read"),
+                      pred=pred)
+            return
+    deadline = time.monotonic() + timeout
+    while not pred():
+        if time.monotonic() > deadline:
+            raise Violation(f"wait_until({label}) timed out off-sim")
+        time.sleep(0.001)
+
+
+class SchedSim:
+    """One deterministic run: model threads execute one at a time; the
+    scheduler picks who advances at each instrumented sync point, from
+    a replayable ``choices`` prefix and a default policy after it
+    (``block``: run-to-block DFS default; ``rr``: fair round-robin for
+    progress checks)."""
+
+    #: real-time ceiling for one model thread to reach its next sync
+    #: point — model code is in-memory work; anything longer is a hung
+    #: harness, not a slow model
+    HANG_TIMEOUT_S = 20.0
+
+    def __init__(self, threads, yield_on=None, choices=(),
+                 max_decisions: int = 2000, policy: str = "block",
+                 priorities: dict | None = None,
+                 change_points: set | None = None):
+        self._members = [_Member(name, fn) for name, fn in threads]
+        self._cv = threading.Condition()
+        self._tls = threading.local()
+        self._filter = yield_on
+        self._choices = list(choices)
+        self._max_decisions = max_decisions
+        self._policy = policy
+        #: PCT mode: rank per thread name (higher runs first) + the
+        #: decision indices where the current top enabled thread is
+        #: demoted below everyone — one demotion per change point is
+        #: exactly the PCT "d preemption points" schedule family
+        self._prio = dict(priorities or {})
+        self._changes = set(change_points or ())
+        self._last: _Member | None = None
+        self._aborting = False
+        self.decisions: list[dict] = []
+        self.violation: dict | None = None
+
+    # ----------------------------------------------- model-thread side
+
+    def _me(self) -> _Member | None:
+        return getattr(self._tls, "member", None)
+
+    def _park(self, member: _Member, state: str, op=None, pred=None,
+              blocked=None) -> None:
+        with self._cv:
+            member.state = state
+            if op is not None:
+                member.op = op
+            member.pred = pred
+            member.blocked = blocked
+            self._cv.notify_all()
+        member.gate.wait()
+        member.gate.clear()
+        if self._aborting:
+            raise _Abort()
+
+    def _op(self, label: str, resource, kind: str) -> None:
+        m = self._me()
+        if m is None or self._aborting:
+            return
+        if self._filter is not None and not self._filter(label):
+            return
+        self._park(m, "ready", op=(label, resource, kind))
+
+    # --- hook surface (syncpoint / lockwatch / FakeKube._count) ---
+
+    def sync_hook(self, label: str, detail=None) -> None:
+        # resource is the LABEL alone: two members' "lease.try_acquire"
+        # points must conflict (both touch the lease) even though their
+        # details differ — conflict resources may over-approximate,
+        # never under-approximate, or the reduction prunes real
+        # interleavings and the explorer goes blind
+        self._op("sync:" + label, ("sync", label), "write")
+
+    def api_call(self, verb: str, plural) -> None:
+        kind = "read" if verb in ("get", "list", "watch") else "write"
+        self._op(f"kube:{verb}:{plural}", ("kube", plural), kind)
+
+    def lock_acquire(self, site: str, inner):
+        """lockwatch wrapper entry for blocking acquires: None off
+        model threads (caller does the real acquire); True once the
+        scheduler let this model thread take the lock. A lock held by a
+        suspended model thread parks the acquirer (``lockwait``) until
+        its release — the harness can never wedge on a real lock, and
+        an inversion becomes a detected deadlock instead of a hang."""
+        m = self._me()
+        if m is None or self._aborting:
+            return None
+        label = "lock:" + site
+        if self._filter is None or self._filter(label):
+            self._park(m, "ready", op=(label, ("lock", site), "lock"))
+        while True:
+            if inner.acquire(False):
+                return True
+            self._park(m, "lockwait",
+                       op=("lockwait:" + site, ("lock", site), "lock"),
+                       blocked=id(inner))
+
+    def lock_release(self, site: str, inner) -> None:
+        m = self._me()
+        if m is None:
+            return
+        with self._cv:
+            for o in self._members:
+                if o.state == "lockwait" and o.blocked == id(inner):
+                    o.state = "ready"
+                    o.blocked = None
+
+    # ------------------------------------------------- scheduler side
+
+    def _bootstrap(self, member: _Member) -> None:
+        self._tls.member = member
+        try:
+            # initial park: the explorer controls start order too
+            self._park(member, "ready",
+                       op=("start:" + member.name,
+                           ("start", member.name), "read"))
+            member.fn()
+        except _Abort:
+            pass
+        except BaseException as e:  # noqa: BLE001 — recorded as evidence
+            member.error = e
+        finally:
+            with self._cv:
+                member.state = "done"
+                self._cv.notify_all()
+
+    def _pick_default(self, ready: list) -> _Member:
+        if self._policy == "rr":
+            order = self._members
+            start = (order.index(self._last) + 1
+                     if self._last in order else 0)
+            for i in range(len(order)):
+                cand = order[(start + i) % len(order)]
+                if cand in ready:
+                    return cand
+        if self._policy == "pct":
+            idx = len(self.decisions)
+            top = max(ready, key=lambda m: self._prio.get(m.name, 0))
+            if idx in self._changes:
+                floor = min(self._prio.values(), default=0) - 1
+                self._prio[top.name] = floor
+                top = max(ready,
+                          key=lambda m: self._prio.get(m.name, 0))
+            return top
+        if self._last is not None and self._last in ready:
+            return self._last
+        return ready[0]
+
+    def run(self) -> "SchedSim":
+        for m in self._members:
+            m.thread = threading.Thread(
+                target=self._bootstrap, args=(m,),
+                name=f"schedsim-{m.name}", daemon=True,
+            )
+            m.thread.start()
+        try:
+            while True:
+                chosen = None
+                with self._cv:
+                    deadline = time.monotonic() + self.HANG_TIMEOUT_S
+                    while any(m.state in ("new", "running")
+                              for m in self._members):
+                        self._cv.wait(timeout=0.5)
+                        if time.monotonic() > deadline:
+                            self.violation = {
+                                "kind": "hung-thread",
+                                "threads": [m.name for m in self._members
+                                            if m.state in ("new",
+                                                           "running")],
+                            }
+                            break
+                    if self.violation is not None:
+                        break
+                    for m in self._members:
+                        if m.state == "condwait":
+                            try:
+                                if m.pred():
+                                    m.state = "ready"
+                                    m.pred = None
+                            except Exception as e:  # noqa: BLE001
+                                # record the broken predicate but leave
+                                # the member PARKED (not "done"): its
+                                # thread is still in gate.wait, and only
+                                # _abort_all's gate.set can unwind it —
+                                # marking it done here would leak the
+                                # thread past teardown
+                                m.error = e
+                    if any(m.error is not None for m in self._members):
+                        break   # recorded below; abort the rest
+                    ready = [m for m in self._members
+                             if m.state == "ready"]
+                    if not ready:
+                        parked = [m for m in self._members
+                                  if m.state in ("lockwait", "condwait")]
+                        if parked:
+                            self.violation = {
+                                "kind": "deadlock",
+                                "threads": {
+                                    m.name: (m.op[0] if m.op else "?")
+                                    for m in parked
+                                },
+                            }
+                        break
+                    if len(self.decisions) >= self._max_decisions:
+                        self.violation = {
+                            "kind": "hang",
+                            "detail": f"decision budget "
+                                      f"{self._max_decisions} exhausted "
+                                      "— the model never quiesced",
+                        }
+                        break
+                    idx = len(self.decisions)
+                    if idx < len(self._choices):
+                        want = self._choices[idx]
+                        chosen = next((m for m in ready
+                                       if m.name == want), None)
+                        if chosen is None:
+                            self.violation = {
+                                "kind": "replay-divergence",
+                                "want": want,
+                                "enabled": [m.name for m in ready],
+                            }
+                            break
+                    else:
+                        chosen = self._pick_default(ready)
+                    prev = self._last
+                    self.decisions.append({
+                        "enabled": [m.name for m in ready],
+                        "ops": {m.name: m.op for m in ready},
+                        "chosen": chosen.name,
+                        "prev": prev.name if prev else None,
+                        "prev_enabled": bool(prev in ready),
+                    })
+                    self._last = chosen
+                    chosen.state = "running"
+                chosen.gate.set()
+        finally:
+            self._abort_all()
+        if self.violation is None:
+            for m in self._members:
+                if m.error is not None:
+                    assertion = isinstance(m.error,
+                                           (Violation, AssertionError))
+                    self.violation = {
+                        "kind": "assertion" if assertion else "exception",
+                        "thread": m.name,
+                        "message": f"{type(m.error).__name__}: "
+                                   f"{m.error}",
+                    }
+                    break
+        return self
+
+    def choices_taken(self) -> list[str]:
+        return [d["chosen"] for d in self.decisions]
+
+    def _abort_all(self) -> None:
+        with self._cv:
+            self._aborting = True
+            for m in self._members:
+                if m.state != "done":
+                    m.gate.set()
+        for m in self._members:
+            if m.thread is not None:
+                m.thread.join(timeout=2.0)
+
+
+# =====================================================================
+# running a model under the hooks
+# =====================================================================
+
+def _run_model(model, choices=(), policy: str = "block",
+               priorities=None, change_points=None) -> SchedSim:
+    """One scheduled run of a freshly-built model. Hooks are installed
+    for the duration only; the scheduler runs on the calling thread."""
+    global _ACTIVE
+    lockwatch.hook_fake_count()
+    sim = SchedSim(model.threads(), yield_on=model.yield_on,
+                   choices=choices, max_decisions=model.max_decisions,
+                   policy=policy, priorities=priorities,
+                   change_points=change_points)
+    syncpoint.install(sim.sync_hook)
+    lockwatch.set_sched(sim)
+    _ACTIVE = sim
+    try:
+        sim.run()
+    finally:
+        _ACTIVE = None
+        lockwatch.set_sched(None)
+        syncpoint.uninstall()
+    if sim.violation is None:
+        try:
+            model.check()
+        except (Violation, AssertionError) as e:
+            sim.violation = {"kind": "check", "message": str(e)}
+    return sim
+
+
+def _conflicts(op_a, op_b) -> bool:
+    """Dependence relation for the sleep-set reduction: two operations
+    commute unless they touch the same resource with at least one
+    writer (lock ops always conflict on their site)."""
+    if op_a is None or op_b is None:
+        return True   # unknown op: be conservative, never prune
+    _, ra, ka = op_a
+    _, rb, kb = op_b
+    if ra is None or rb is None:
+        return True
+    if ra != rb:
+        return False
+    return not (ka == "read" and kb == "read")
+
+
+def explore(model_factory, max_schedules: int = 400,
+            preemption_bound: int = 2, deadline_s: float | None = None,
+            stop_on_first: bool = True, seed: int = 0,
+            dfs_share: float = 0.5) -> dict:
+    """Two-phase schedule search. Phase 1: replay-based DFS with
+    sleep-set partial-order reduction and preemption bounding — for the
+    small models this is *exhaustive* within the bounds (the stack
+    drains and the result is a proof over that space). Phase 2 (only
+    when phase 1 exhausts its share of the budget without draining):
+    seeded PCT-style sampling — random thread priorities with
+    ``preemption_bound`` demotion points per run (Burckhardt et al.'s
+    probabilistic concurrency testing), which reaches the
+    few-specific-preemptions interleavings deep models hide far faster
+    than systematic order. Deterministic for a given seed, and every
+    violation carries the exact replayable choice list either way.
+
+    Returns ``{"runs", "violations", "interrupted", "exhaustive"}`` —
+    ``interrupted`` means the wall DEADLINE cut the search short (the
+    operator should raise it); plain budget exhaustion is the normal
+    bounded-search outcome and is reported as neither interrupted nor
+    exhaustive."""
+    t0 = time.monotonic()
+    stack: list[tuple[tuple, frozenset]] = [((), frozenset())]
+    runs = 0
+    violations: list[dict] = []
+    interrupted = False
+    exhaustive = False
+    dfs_budget = max(1, int(max_schedules * dfs_share))
+    est_len = 20   # decision-count estimate for PCT change points
+    while stack:
+        if deadline_s is not None and \
+                time.monotonic() - t0 > deadline_s:
+            interrupted = True
+            break
+        if runs >= dfs_budget:
+            break
+        choices, sleep = stack.pop()
+        model = model_factory()
+        sim = _run_model(model, choices=choices)
+        runs += 1
+        est_len = max(est_len, len(sim.decisions))
+        if sim.violation is not None:
+            violations.append({
+                "model": model.name,
+                "choices": sim.choices_taken(),
+                "violation": sim.violation,
+            })
+            if stop_on_first:
+                break
+            continue
+        # ---- push unexplored alternatives (sleep sets + preemption
+        # bound), walking the run from the first free decision on
+        all_choices = sim.choices_taken()
+        # cumulative preemption count per decision index
+        pre = 0
+        preempt_before = []
+        for d in sim.decisions:
+            preempt_before.append(pre)
+            if d["prev_enabled"] and d["chosen"] != d["prev"]:
+                pre += 1
+        sleep_now = set(sleep)
+        for i in range(len(choices), len(sim.decisions)):
+            d = sim.decisions[i]
+            ops = d["ops"]
+            chosen = d["chosen"]
+            sleep_now &= set(d["enabled"])
+            pushed: list[str] = []
+            for t in d["enabled"]:
+                if t == chosen or t in sleep_now:
+                    continue
+                p = preempt_before[i] + (
+                    1 if d["prev_enabled"] and t != d["prev"] else 0)
+                if p > preemption_bound:
+                    continue
+                done_siblings = {chosen, *pushed}
+                child_sleep = frozenset(
+                    u for u in (sleep_now | done_siblings) - {t}
+                    if u in ops and not _conflicts(ops[u], ops[t])
+                )
+                stack.append((tuple(all_choices[:i]) + (t,),
+                              child_sleep))
+                pushed.append(t)
+            sleep_now = {u for u in sleep_now
+                         if u in ops
+                         and not _conflicts(ops[u], ops[chosen])}
+    else:
+        exhaustive = not violations or not stop_on_first
+    # ---- phase 2: PCT sampling over the remaining budget
+    if not exhaustive and not interrupted \
+            and not (violations and stop_on_first):
+        rng = random.Random(seed)
+        names = [n for n, _ in model_factory().threads()]
+        while runs < max_schedules:
+            if deadline_s is not None and \
+                    time.monotonic() - t0 > deadline_s:
+                interrupted = True
+                break
+            prio = {n: i for i, n in enumerate(
+                rng.sample(names, len(names)))}
+            changes = {rng.randrange(max(est_len, 1))
+                       for _ in range(preemption_bound)}
+            model = model_factory()
+            sim = _run_model(model, policy="pct", priorities=prio,
+                             change_points=changes)
+            runs += 1
+            est_len = max(est_len, len(sim.decisions))
+            if sim.violation is not None:
+                violations.append({
+                    "model": model.name,
+                    "choices": sim.choices_taken(),
+                    "violation": sim.violation,
+                })
+                if stop_on_first:
+                    break
+    return {"runs": runs, "violations": violations,
+            "interrupted": interrupted, "exhaustive": exhaustive}
+
+
+def fair_run(model_factory) -> SchedSim:
+    """One round-robin-fair schedule — the progress/liveness check (a
+    wedged barrier shows up here as a hang or a failed progress
+    assertion, where the safety explorer cannot assert liveness
+    per-interleaving)."""
+    model = model_factory()
+    sim = _run_model(model, policy="rr")
+    if sim.violation is None:
+        progress = getattr(model, "progress", None)
+        if progress is not None:
+            try:
+                progress()
+            except (Violation, AssertionError) as e:
+                sim.violation = {"kind": "progress", "message": str(e)}
+    return sim
+
+
+# =====================================================================
+# model helpers
+# =====================================================================
+
+def _key_in_shard(shard: int, num_shards: int,
+                  ns: str = "ns") -> tuple[str, str]:
+    i = 0
+    while True:
+        name = f"k{i}"
+        if shard_of(ns, name, num_shards) == shard:
+            return ns, name
+        i += 1
+
+
+def _write_map(kube, group: str, epoch: int, mapping: dict,
+               members: list, num_shards: int,
+               namespace: str = "kubeflow") -> None:
+    """Publish a shard map Lease directly (the models script epochs —
+    deterministic movement beats rendezvous for a small-scope model)."""
+    ann = {
+        ANN_EPOCH: str(epoch),
+        ANN_MAP: json.dumps({str(s): o for s, o in mapping.items()},
+                            sort_keys=True),
+        ANN_MEMBERS: json.dumps(sorted(members)),
+        ANN_SHARDS: str(num_shards),
+    }
+    name = f"{group}-map"
+    body = {
+        "apiVersion": f"{LEASE_GROUP}/v1",
+        "kind": "Lease",
+        "metadata": {"name": name, "namespace": namespace,
+                     "annotations": ann},
+        "spec": {"holderIdentity": "sim-coordinator"},
+    }
+    try:
+        cur = kube.get("leases", name, namespace=namespace,
+                       group=LEASE_GROUP)
+    except errors.NotFound:
+        kube.create("leases", body, namespace=namespace,
+                    group=LEASE_GROUP)
+        return
+    body["metadata"]["resourceVersion"] = \
+        cur["metadata"]["resourceVersion"]
+    kube.update("leases", body, namespace=namespace, group=LEASE_GROUP)
+
+
+def _yield_on_sync(label: str) -> bool:
+    return label.startswith("sync:")
+
+
+class _FlakyKube:
+    """Per-member partition wrapper: fail this member's apiserver verbs
+    while the scripted flags say it is cut off (heartbeat writes can
+    heal separately from map reads — the partial-heal window the
+    post-fence re-entry fix closed)."""
+
+    def __init__(self, inner, flags: dict, map_name: str):
+        self._inner = inner
+        self._flags = flags
+        self._map_name = map_name
+
+    def _down(self, write: bool, name: str | None = None) -> bool:
+        if not self._flags.get("partitioned"):
+            return False
+        if self._flags.get("heal_writes"):
+            # heartbeats land again, but map READS still fail — the
+            # stale-map window _map_confirmed guards
+            return name == self._map_name
+        return True
+
+    def get(self, plural, name, **kw):
+        if self._down(False, name):
+            raise errors.ApiError("sim: partitioned")
+        return self._inner.get(plural, name, **kw)
+
+    def list(self, plural, **kw):
+        if self._down(False):
+            raise errors.ApiError("sim: partitioned")
+        return self._inner.list(plural, **kw)
+
+    def create(self, plural, obj, **kw):
+        if self._down(True):
+            raise errors.ApiError("sim: partitioned")
+        return self._inner.create(plural, obj, **kw)
+
+    def update(self, plural, obj, **kw):
+        if self._down(True):
+            raise errors.ApiError("sim: partitioned")
+        return self._inner.update(plural, obj, **kw)
+
+    def delete(self, plural, name, **kw):
+        if self._down(True):
+            raise errors.ApiError("sim: partitioned")
+        return self._inner.delete(plural, name, **kw)
+
+
+# =====================================================================
+# the models
+# =====================================================================
+
+class ShardHandoffModel:
+    """Two live members, a scripted coordinator moving one shard A→B,
+    and a reconciler loop per member gated by ``admit()`` — the
+    never-dual-reconcile core: B may not run a key until A drained and
+    acked (or expired). The ledger records any overlap."""
+
+    name = "shard_handoff"
+    max_decisions = 2000
+    preemption_bound = 2
+    budget = 400
+
+    NUM_SHARDS = 2
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        self.ledger = Ledger()
+        self.group = "sim"
+        jnl = Journal()
+
+        def mk(ident):
+            return ShardMember(
+                self.kube, ident, group=self.group,
+                num_shards=self.NUM_SHARDS, lease_duration=600.0,
+                tick_period=0.01, journal=jnl,
+                now_fn=self.clock.now, mono_fn=self.clock.mono,
+            )
+
+        self.a = mk("A")
+        self.b = mk("B")
+        self.a.drain_fn = \
+            lambda shards: not self.ledger.busy("A", set(shards))
+        self.b.drain_fn = \
+            lambda shards: not self.ledger.busy("B", set(shards))
+        self.key = _key_in_shard(0, self.NUM_SHARDS)
+        # setup (unscheduled, deterministic): epoch 1 gives A everything
+        _write_map(self.kube, self.group, 1, {0: "A", 1: "A"}, ["A"],
+                   self.NUM_SHARDS)
+        self.a._heartbeat()
+        self.a._read_map()
+        self.a._check_barrier()
+        self.a._check_ack()
+        assert self.a.admit(*self.key) == OWN
+        self.b._heartbeat()
+        self.b._read_map()
+        self.b._check_ack()
+
+    yield_on = staticmethod(_yield_on_sync)
+
+    def _reconcile(self, member: ShardMember, actor: str) -> None:
+        for _ in range(2):
+            if member.admit(*self.key) == OWN:
+                self.ledger.enter(actor, 0)
+                step("reconcile", self.key)
+                self.ledger.exit(actor, 0)
+            else:
+                step("reconcile.skip", actor)
+
+    def _ticks(self, member: ShardMember, n: int) -> None:
+        for _ in range(n):
+            member._heartbeat()
+            member._read_map()
+            member._check_barrier()
+            member._check_ack()
+
+    def _publish_epoch2(self) -> None:
+        step("publish", 2)
+        _write_map(self.kube, self.group, 2, {0: "B", 1: "A"},
+                   ["A", "B"], self.NUM_SHARDS)
+
+    def threads(self):
+        return [
+            ("A.rec", lambda: self._reconcile(self.a, "A")),
+            ("coord", self._publish_epoch2),
+            ("B.tick", lambda: self._ticks(self.b, 3)),
+            ("A.tick", lambda: self._ticks(self.a, 3)),
+            ("B.rec", lambda: self._reconcile(self.b, "B")),
+        ]
+
+    def check(self):
+        if self.ledger.violations:
+            raise Violation("; ".join(self.ledger.violations))
+
+    def progress(self):
+        if self.b.admit(*self.key) != OWN:
+            raise Violation(
+                "handoff wedged: B never activated shard 0 under a "
+                "fair schedule (barrier stuck?)"
+            )
+        if self.a.admit(*self.key) != FOREIGN:
+            raise Violation("A still admits the moved key")
+
+
+class ShardFenceModel:
+    """A partitioned member must self-fence before the rest of the
+    plane may presume it dead — and after the partition half-heals
+    (heartbeats land, map reads still fail), nothing may re-activate
+    off the stale pre-fence map (``_map_confirmed``). The clock only
+    advances while no A-reconcile is in flight, encoding the protocol's
+    fairness assumption (reconciles are short against lease windows;
+    the residual wedged-past-expiry gap is documented in docs/ha.md and
+    deliberately NOT modeled)."""
+
+    name = "shard_fence"
+    max_decisions = 2000
+    preemption_bound = 2
+    budget = 400
+
+    NUM_SHARDS = 2
+    DUR = 600.0
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        self.ledger = Ledger()
+        self.group = "simf"
+        self.flags = {"partitioned": False, "heal_writes": False}
+        self.ticks = {"A": 0}
+        jnl = Journal()
+        self.a = ShardMember(
+            _FlakyKube(self.kube, self.flags, f"{self.group}-map"),
+            "A", group=self.group, num_shards=self.NUM_SHARDS,
+            lease_duration=self.DUR, tick_period=0.01, journal=jnl,
+            now_fn=self.clock.now, mono_fn=self.clock.mono,
+        )
+        self.b = ShardMember(
+            self.kube, "B", group=self.group,
+            num_shards=self.NUM_SHARDS, lease_duration=self.DUR,
+            tick_period=0.01, journal=jnl,
+            now_fn=self.clock.now, mono_fn=self.clock.mono,
+        )
+        self.b.drain_fn = \
+            lambda shards: not self.ledger.busy("B", set(shards))
+        self.key = _key_in_shard(0, self.NUM_SHARDS)
+        _write_map(self.kube, self.group, 1, {0: "A", 1: "A"}, ["A"],
+                   self.NUM_SHARDS)
+        self.a._heartbeat()
+        self.a._read_map()
+        self.a._check_barrier()
+        self.a._check_ack()
+        assert self.a.admit(*self.key) == OWN
+        self.b._heartbeat()
+        self.b._read_map()
+        self.b._check_ack()
+
+    yield_on = staticmethod(_yield_on_sync)
+
+    def _partition_script(self):
+        step("partition")
+        self.flags["partitioned"] = True
+        # past A's own renew deadline (DUR) but inside the liveness
+        # window others grant it (1.25 × DUR): A gets its fencing chance
+        self.clock.advance(self.DUR + 1)
+        wait_until(lambda: self.ticks["A"] >= 1
+                   and not self.ledger.busy("A"), label="a-ticked")
+        step("expire")
+        self.clock.advance(0.5 * self.DUR)   # now stale to everyone
+        wait_until(lambda: not self.ledger.busy("A"), label="a-idle")
+        step("heal-writes")
+        self.flags["heal_writes"] = True
+
+    def _a_ticks(self):
+        # the member's tick loop never stops in production; the phase
+        # gates keep the model's finite iterations from being burned
+        # before the window they exist to explore (a run-to-block
+        # scheduler would otherwise spend all four pre-heal)
+        wait_until(lambda: self.flags["partitioned"], label="part")
+        for _ in range(2):
+            self.a._tick()
+            self.ticks["A"] += 1
+        wait_until(lambda: self.flags["heal_writes"], label="healed")
+        for _ in range(2):
+            self.a._tick()
+            self.ticks["A"] += 1
+
+    def _a_reconcile(self):
+        # gated on the heal: the stale-map re-entry window IS the
+        # post-heal tick, so the reconciler must not burn its
+        # iterations while A is unambiguously partitioned
+        wait_until(lambda: self.flags["heal_writes"], label="healed")
+        for _ in range(2):
+            if self.a.admit(*self.key) == OWN:
+                self.ledger.enter("A", 0)
+                step("reconcile", self.key)
+                self.ledger.exit("A", 0)
+            else:
+                step("reconcile.skip", "A")
+
+    def _b_script(self):
+        wait_until(lambda: self.flags["partitioned"], label="part")
+        for _ in range(2):
+            self.b._heartbeat()
+            self.b._read_map()
+            self.b._check_barrier()
+            self.b._check_ack()
+        wait_until(lambda: self.flags["heal_writes"], label="healed")
+        for _ in range(2):
+            self.b._heartbeat()
+            self.b._read_map()
+            self.b._check_barrier()
+            self.b._check_ack()
+
+    def _b_reconcile(self):
+        wait_until(lambda: self.flags["heal_writes"], label="healed")
+        for _ in range(2):
+            if self.b.admit(*self.key) == OWN:
+                self.ledger.enter("B", 0)
+                step("reconcile", self.key)
+                self.ledger.exit("B", 0)
+            else:
+                step("reconcile.skip", "B")
+
+    def _coord(self):
+        wait_until(lambda: self.flags["partitioned"], label="part")
+        step("publish", 2)
+        _write_map(self.kube, self.group, 2, {0: "B", 1: "B"}, ["B"],
+                   self.NUM_SHARDS)
+
+    def threads(self):
+        return [
+            ("part", self._partition_script),
+            ("coord", self._coord),
+            ("A.tick", self._a_ticks),
+            ("A.rec", self._a_reconcile),
+            ("B.tick", self._b_script),
+            ("B.rec", self._b_reconcile),
+        ]
+
+    def check(self):
+        if self.ledger.violations:
+            raise Violation("; ".join(self.ledger.violations))
+
+
+class LeaseExpiryModel:
+    """Two candidates with skewed clocks racing acquire/renew around an
+    expiry: every successful takeover must be *legal* under the
+    pristine staleness rule (captured before any mutant patches it) —
+    deposing a holder whose renew is within duration + tolerance is the
+    split-brain the hardened expiry exists to prevent."""
+
+    name = "lease_expiry"
+    max_decisions = 800
+    preemption_bound = 2
+    budget = 300
+
+    DUR = 10.0
+    SKEW = 11.0     # > DUR, < DUR + 0.25*DUR: only the tolerance saves
+                    # the holder from this candidate's clock
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        jnl = Journal()
+        self.illegal: list[str] = []
+        self.acquires: list[str] = []
+        self.c1 = LeaderElector(
+            self.kube, "sim-el", identity="c1",
+            lease_duration=self.DUR, on_lost=lambda: None,
+            now_fn=self.clock.now, mono_fn=self.clock.mono,
+            journal=jnl,
+        )
+        skew = self.SKEW
+
+        def ahead():
+            return self.clock.now() + datetime.timedelta(seconds=skew)
+
+        self.c2 = LeaderElector(
+            self.kube, "sim-el", identity="c2",
+            lease_duration=self.DUR, on_lost=lambda: None,
+            now_fn=ahead, mono_fn=self.clock.mono, journal=jnl,
+        )
+
+    def yield_on(self, label):
+        return label.startswith("sync:")
+
+    def _snapshot(self):
+        try:
+            return self.kube.get("leases", "sim-el",
+                                 namespace="kubeflow", group=LEASE_GROUP)
+        except errors.NotFound:
+            return None
+
+    def _attempt(self, c: LeaderElector, ident: str) -> None:
+        prev = self._snapshot()
+        try:
+            ok = c._try_acquire()
+        except errors.ApiError:
+            return
+        if not ok:
+            return
+        self.acquires.append(ident)
+        if prev is None:
+            return
+        spec = prev.get("spec") or {}
+        holder = spec.get("holderIdentity")
+        if not holder or holder == ident:
+            return
+        renew = leaderelection._parse(spec.get("renewTime")) or \
+            leaderelection._parse(spec.get("acquireTime"))
+        dur = float(spec.get("leaseDurationSeconds") or self.DUR)
+        if renew is not None and not _pristine_renew_stale(
+                renew, dur, 0.25 * dur, c._now()):
+            self.illegal.append(
+                f"{ident} deposed {holder} whose lease was still "
+                f"within duration+tolerance (renew {renew})"
+            )
+
+    def _t1(self):
+        self._attempt(self.c1, "c1")
+        step("held")
+        self._attempt(self.c1, "c1")   # renew
+
+    def _t2(self):
+        for _ in range(2):
+            self._attempt(self.c2, "c2")
+            step("candidate")
+
+    def _crash(self):
+        step("crash")
+        # c1 stops renewing; push its hold past duration + tolerance
+        # even on its own clock
+        self.clock.advance(self.DUR * 1.4)
+
+    def threads(self):
+        return [("T1", self._t1), ("T2", self._t2),
+                ("TC", self._crash)]
+
+    def check(self):
+        if self.illegal:
+            raise Violation("; ".join(self.illegal))
+
+    def progress(self):
+        if not self.acquires:
+            raise Violation("nobody ever acquired the lease")
+
+
+class LeaseRaceModel:
+    """Two candidates racing an optimistic update of a holderless
+    Lease: the MVCC commit identity check must let exactly one win —
+    both winning is two active reconcilers."""
+
+    name = "lease_race"
+    max_decisions = 400
+    preemption_bound = 2
+    budget = 200
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        jnl = Journal()
+        now = leaderelection._fmt(self.clock.now())
+        self.kube.create("leases", {
+            "apiVersion": f"{LEASE_GROUP}/v1",
+            "kind": "Lease",
+            "metadata": {"name": "sim-race", "namespace": "kubeflow"},
+            "spec": {"holderIdentity": None,
+                     "leaseDurationSeconds": 10,
+                     "acquireTime": now, "renewTime": now},
+        }, namespace="kubeflow", group=LEASE_GROUP)
+        self.wins: list[str] = []
+
+        def mk(ident):
+            return LeaderElector(
+                self.kube, "sim-race", identity=ident,
+                lease_duration=10.0, on_lost=lambda: None,
+                now_fn=self.clock.now, mono_fn=self.clock.mono,
+                journal=jnl,
+            )
+
+        self.c1, self.c2 = mk("c1"), mk("c2")
+
+    def yield_on(self, label):
+        return label.startswith("sync:")
+
+    def _race(self, c, ident):
+        try:
+            if c._try_acquire():
+                self.wins.append(ident)
+        except errors.ApiError:
+            pass
+
+    def threads(self):
+        return [("T1", lambda: self._race(self.c1, "c1")),
+                ("T2", lambda: self._race(self.c2, "c2"))]
+
+    def check(self):
+        if len(self.wins) != 1:
+            raise Violation(
+                f"expected exactly one winner of the holderless lease, "
+                f"got {self.wins} — "
+                + ("a lost update let both commit"
+                   if len(self.wins) > 1 else "nobody won")
+            )
+
+
+class MvccUpdateModel:
+    """Two writers incrementing one CR through optimistic updates, then
+    a delete; the watch history must show every successful commit
+    (no lost update) in strictly increasing RV order with the DELETED
+    event RV-bumped past the last write."""
+
+    name = "mvcc_update"
+    max_decisions = 600
+    preemption_bound = 2
+    budget = 300
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.kube.create("notebooks", {
+            "metadata": {"name": "x", "namespace": "ns"},
+            "spec": {"n": 0},
+        }, namespace="ns", group=GROUP)
+        self.successes = 0
+        self.done = {"T1": False, "T2": False}
+
+    def yield_on(self, label):
+        return label.startswith("sync:")
+
+    def _incr(self, tid):
+        for _ in range(2):
+            while True:
+                try:
+                    cur = self.kube.get("notebooks", "x",
+                                        namespace="ns", group=GROUP)
+                except errors.NotFound:
+                    break
+                cur["spec"]["n"] = int(cur["spec"]["n"]) + 1
+                try:
+                    self.kube.update("notebooks", cur, namespace="ns",
+                                     group=GROUP)
+                except errors.Conflict:
+                    continue
+                except errors.NotFound:
+                    break
+                self.successes += 1
+                break
+        self.done[tid] = True
+
+    def _delete(self):
+        wait_until(lambda: all(self.done.values()), label="writers")
+        step("delete")
+        try:
+            self.kube.delete("notebooks", "x", namespace="ns",
+                             group=GROUP)
+        except errors.NotFound:
+            pass
+
+    def threads(self):
+        return [("T1", lambda: self._incr("T1")),
+                ("T2", lambda: self._incr("T2")),
+                ("T3", self._delete)]
+
+    def check(self):
+        events = []
+        for ev in self.kube.watch("notebooks", namespace="ns",
+                                  group=GROUP, resource_version=0,
+                                  timeout=0.01):
+            events.append(ev)
+        rvs = [int(ev["object"]["metadata"]["resourceVersion"])
+               for ev in events]
+        if rvs != sorted(rvs) or len(set(rvs)) != len(rvs):
+            raise Violation(
+                f"watch RVs not strictly increasing: {rvs} — history "
+                "order no longer matches RV order"
+            )
+        if not events or events[-1]["type"] != "DELETED":
+            raise Violation("DELETED event missing or not terminal")
+        mods = [ev for ev in events if ev["type"] == "MODIFIED"]
+        if len(mods) != self.successes:
+            raise Violation(
+                f"{self.successes} updates succeeded but only "
+                f"{len(mods)} MODIFIED events exist"
+            )
+        final_n = int(mods[-1]["object"]["spec"]["n"]) if mods else 0
+        if final_n != self.successes:
+            raise Violation(
+                f"lost update: {self.successes} commits succeeded but "
+                f"the final object shows n={final_n}"
+            )
+        if mods and rvs[-1] <= int(
+                mods[-1]["object"]["metadata"]["resourceVersion"]):
+            raise Violation(
+                "DELETED event rode a stale resourceVersion — a "
+                "resume-from-last-RV watcher would drop the delete"
+            )
+
+
+class QueueGetDoneModel:
+    """Workers and a producer over one RateLimitingQueue: a key is
+    never processed by two workers at once (per-key serialization) and
+    a re-add while processing is never lost (level triggering) — the
+    final drain must leave no key whose last event is its add."""
+
+    name = "queue_getdone"
+    max_decisions = 600
+    preemption_bound = 2
+    budget = 300
+
+    def __init__(self):
+        self.q = RateLimitingQueue()
+        self.q.add("K1")           # setup: pre-hook, unscheduled
+        self.ledger = Ledger()
+        self.events: list[tuple] = []
+
+    def yield_on(self, label):
+        return (label.startswith("sync:queue.")
+                or label.startswith("sync:model."))
+
+    def _worker(self, wid, iters):
+        for _ in range(iters):
+            k = self.q.get(timeout=0.005)
+            if k is None:
+                continue
+            self.events.append(("get", k))
+            self.ledger.enter(wid, k)
+            step("proc", k)
+            self.ledger.exit(wid, k)
+            self.q.done(k)
+
+    def _producer(self):
+        for k in ("K1", "K2"):
+            self.events.append(("add", k))
+            self.q.add(k)
+
+    def threads(self):
+        return [("W1", lambda: self._worker("W1", 2)),
+                ("P", self._producer),
+                ("W2", lambda: self._worker("W2", 1))]
+
+    def check(self):
+        if self.ledger.violations:
+            raise Violation("; ".join(self.ledger.violations))
+        # final drain: anything still pending is observed now; a key
+        # whose LAST event remains its add was dropped on the floor
+        while True:
+            k = self.q.get(timeout=0.005)
+            if k is None:
+                break
+            self.events.append(("get", k))
+            self.q.done(k)
+        last: dict = {}
+        for kind, k in self.events:
+            last[k] = kind
+        dropped = sorted(k for k, kind in last.items() if kind == "add")
+        if dropped:
+            raise Violation(
+                f"level-trigger lost: key(s) {dropped} were added but "
+                "never surfaced again (dirty re-add dropped?)"
+            )
+
+
+class LockInversionModel:
+    """The test_cplint two-thread A→B/B→A fixture as a schedsim model:
+    the explorer must FIND the deadlock interleaving within a bounded
+    budget — lockwatch alone only catches it when the OS scheduler
+    cooperates. Deliberately violating: not part of the clean gate."""
+
+    name = "lock_inversion"
+    max_decisions = 200
+    preemption_bound = 2
+    budget = 60
+
+    def __init__(self):
+        self.watch = lockwatch.LockWatch()
+        self.a = self.watch.lock("/x/controlplane/sched.py:10")
+        self.b = self.watch.lock("/x/controlplane/informer.py:20")
+
+    def yield_on(self, label):
+        return label.startswith("lock:")
+
+    def _t1(self):
+        with self.a:
+            with self.b:
+                pass
+
+    def _t2(self):
+        with self.b:
+            with self.a:
+                pass
+
+    def threads(self):
+        return [("T1", self._t1), ("T2", self._t2)]
+
+    def check(self):
+        pass
+
+
+class LockOrderedModel(LockInversionModel):
+    """Control for the inversion model: both threads take A→B — no
+    interleaving deadlocks, the explorer must come back clean."""
+
+    name = "lock_ordered"
+
+    def _t2(self):
+        with self.a:
+            with self.b:
+                pass
+
+
+#: the clean-gate models: clean HEAD must explore every one of these
+#: violation-free within the CI budget
+MODELS: dict = {
+    m.name: m for m in (
+        ShardHandoffModel, ShardFenceModel, LeaseExpiryModel,
+        LeaseRaceModel, MvccUpdateModel, QueueGetDoneModel,
+    )
+}
+
+#: deliberately-violating demo models (lockwatch fixtures re-run
+#: through the explorer); addressable via --model, excluded from the
+#: default gate
+DEMO_MODELS: dict = {
+    m.name: m for m in (LockInversionModel, LockOrderedModel)
+}
+
+
+# =====================================================================
+# the seeded mutants
+# =====================================================================
+
+def _patched(obj, attr, repl):
+    @contextlib.contextmanager
+    def cm():
+        orig = getattr(obj, attr)
+        setattr(obj, attr, repl)
+        try:
+            yield
+        finally:
+            setattr(obj, attr, orig)
+    return cm
+
+
+def _mut_drop_ack_barrier(self):
+    # seeded bug: activate gained shards WITHOUT consulting fellow
+    # members' acked epochs (the PR 12 barrier removed)
+    syncpoint.sync("shard.barrier", self.identity)
+    with self._lock:
+        gained = set(self._pending)
+        self._pending.clear()
+        self._active = frozenset(set(self._active) | gained)
+    if gained and self.on_gain is not None:
+        self.on_gain(gained)
+
+
+def _mut_ack_before_drain(self):
+    # seeded bug: publish the epoch ack without waiting for in-flight
+    # reconciles of the lost shards to drain
+    syncpoint.sync("shard.ack", self.identity)
+    with self._lock:
+        wait = self._ack_wait
+    if wait is None:
+        return
+    with self._lock:
+        if self._ack_wait != wait:
+            return
+        self._acked = wait[0]
+        self._ack_wait = None
+    self._heartbeat()
+
+
+def _mut_never_fence(self, renewed):
+    # seeded bug: a member whose heartbeat went stale keeps admitting
+    return None
+
+
+def _mut_barrier_ignores_fence(self):
+    # seeded bug: the _map_confirmed gate removed — a post-fence member
+    # re-activates through the stale pre-partition map (the exact
+    # re-entry hole the PR 12 review closed)
+    syncpoint.sync("shard.barrier", self.identity)
+    with self._lock:
+        if not self._pending:
+            return
+        epoch = self._epoch
+    try:
+        listing = self.kube.list(
+            "leases", namespace=self.namespace, group=LEASE_GROUP,
+            label_selector=("cpshard.tpukf.dev/group="
+                            f"{self.group},cpshard.tpukf.dev/role"
+                            "=member"),
+        )["items"]
+    except errors.ApiError:
+        return
+    from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E501
+        shard as shard_mod,
+    )
+    now = self._now()
+    for lease in listing:
+        ident = (lease.get("spec") or {}).get("holderIdentity")
+        if not ident or ident == self.identity:
+            continue
+        if not shard_mod._lease_live(lease, now, self.lease_duration):
+            continue
+        ann = (lease.get("metadata") or {}).get("annotations") or {}
+        try:
+            acked = int(ann.get(ANN_ACKED) or 0)
+        except ValueError:
+            acked = 0
+        if acked < epoch:
+            return
+    gained = set()
+    with self._lock:
+        if self._epoch != epoch or not self._pending:
+            return
+        gained = {s for s, e in self._pending.items() if e <= epoch}
+        if not gained:
+            return
+        for s in gained:
+            del self._pending[s]
+        self._active = frozenset(set(self._active) | gained)
+    if self.on_gain is not None:
+        self.on_gain(gained)
+
+
+def _mut_renew_stale_no_tolerance(renew, duration, tolerance, now):
+    # seeded bug: the skew tolerance and the broken-future-clock leg
+    # dropped — a candidate's fast clock deposes a healthy holder
+    return (now - renew).total_seconds() > float(duration)
+
+
+def _mut_expired_always(self, lease):
+    # seeded bug: every hold reads as expired — candidates steal live
+    # leases
+    return True
+
+
+def _mut_commit_ok_always(self, stripe, key, cur):
+    # seeded bug: the MVCC identity check removed — a racing commit is
+    # silently overwritten (the lost update)
+    return True
+
+
+def _mut_remove_stale_rv(self, res, key, expect=None):
+    # seeded bug: DELETED events carry the pre-delete resourceVersion
+    # (the exact bug the striped-MVCC refactor fixed: a
+    # resume-from-last-RV watcher drops the delete)
+    fam = self._family(res)
+    stripe = self._stripe(fam, key[2])
+    if stripe is None:
+        return None
+    syncpoint.sync("fake.commit", res.plural)
+    with fam.lock:
+        with stripe.lock:
+            obj = stripe.objects.get(key)
+            if obj is None or (expect is not None and obj is not expect):
+                return None
+            self._next_rv()
+            del stripe.objects[key]
+        self._emit_locked(fam, "DELETED", obj)   # stale RV!
+    uid = obj["metadata"].get("uid")
+    with self._uids_lock:
+        if uid:
+            self._uids.discard(uid)
+    if uid:
+        self._defer("cascade", None, uid)
+    return obj
+
+
+def _mut_done_drops_dirty(self, key):
+    # seeded bug: done() forgets the dirty re-add — a key re-added
+    # while processing is lost (level triggering broken)
+    syncpoint.sync("queue.done", key)
+    with self._lock:
+        self._processing.discard(key)
+        self._dirty.discard(key)
+
+
+def _mut_get_skips_processing(self, timeout):
+    # seeded bug: dequeue does not register the key in _processing —
+    # two workers can run the same key concurrently and a re-add while
+    # processing re-queues immediately instead of going dirty
+    deadline = time.monotonic() + timeout if timeout else None
+    with self._lock:
+        while True:
+            now = time.monotonic()
+            while self._delayed and self._delayed[0][0] <= now:
+                _, _, key = heapq.heappop(self._delayed)
+                if key not in self._pending:
+                    self._pending.add(key)
+                    self._order.append(key)
+                    self._note_pending_locked(key)
+            if self._order:
+                key = self._order.popleft()
+                self._pending.discard(key)
+                enqueued = self._added_at.pop(key, None)
+                self._observe_depth_locked()
+                return key, enqueued, time.monotonic()
+            if self._shutdown:
+                return None
+            wait = 0.2
+            if self._delayed:
+                wait = min(wait, max(self._delayed[0][0] - now, 0.001))
+            if deadline is not None:
+                if now >= deadline:
+                    return None
+                wait = min(wait, deadline - now)
+            self._lock.wait(wait)
+
+
+class Mutant:
+    def __init__(self, name: str, models: tuple, apply_cm,
+                 description: str):
+        self.name = name
+        self.models = models
+        self.apply = apply_cm
+        self.description = description
+
+
+MUTANTS: dict = {
+    m.name: m for m in (
+        Mutant("shard-drop-ack-barrier", ("shard_handoff",),
+               _patched(ShardMember, "_check_barrier",
+                        _mut_drop_ack_barrier),
+               "gained shards activate without the fellow-member ack "
+               "barrier"),
+        Mutant("shard-ack-before-drain", ("shard_handoff",),
+               _patched(ShardMember, "_check_ack",
+                        _mut_ack_before_drain),
+               "a lost epoch is acked while its reconciles are still "
+               "in flight"),
+        Mutant("shard-skip-self-fence", ("shard_fence",),
+               _patched(ShardMember, "_update_fence", _mut_never_fence),
+               "a member whose heartbeat staled keeps admitting its "
+               "shards"),
+        Mutant("shard-stale-map-reactivation", ("shard_fence",),
+               _patched(ShardMember, "_check_barrier",
+                        _mut_barrier_ignores_fence),
+               "a post-fence member re-activates through the stale "
+               "pre-partition map (no _map_confirmed gate)"),
+        Mutant("lease-skew-ignored", ("lease_expiry",),
+               _patched(leaderelection, "renew_stale",
+                        _mut_renew_stale_no_tolerance),
+               "lease expiry drops the skew tolerance — a fast clock "
+               "deposes a healthy holder"),
+        Mutant("lease-steal-held", ("lease_expiry",),
+               _patched(LeaderElector, "_expired", _mut_expired_always),
+               "every hold reads as expired — candidates steal live "
+               "leases"),
+        Mutant("fake-commit-identity-dropped",
+               ("lease_race", "mvcc_update"),
+               _patched(FakeKube, "_commit_ok", _mut_commit_ok_always),
+               "the MVCC optimistic-commit identity check removed — "
+               "racing writers silently overwrite each other"),
+        Mutant("fake-delete-stale-rv", ("mvcc_update",),
+               _patched(FakeKube, "_remove", _mut_remove_stale_rv),
+               "DELETED watch events carry the pre-delete RV"),
+        Mutant("queue-dirty-dropped", ("queue_getdone",),
+               _patched(RateLimitingQueue, "done",
+                        _mut_done_drops_dirty),
+               "done() forgets the dirty re-add — level triggering "
+               "lost"),
+        Mutant("queue-processing-unregistered", ("queue_getdone",),
+               _patched(RateLimitingQueue, "_get",
+                        _mut_get_skips_processing),
+               "dequeue skips _processing registration — per-key "
+               "serialization lost"),
+    )
+}
+
+
+def run_mutations(names=None, budget: int | None = None,
+                  deadline_s: float | None = None) -> dict:
+    """Run each seeded mutant's target models under the explorer; a
+    mutant is CAUGHT when any target model yields a violation within
+    budget. ``deadline_s`` bounds the WHOLE suite (shared across
+    mutants — the knob an operator sets is the step's wall time, not a
+    per-exploration slice); a mutant whose exploration was cut short
+    by it records ``interrupted`` so a deadline-starved run reads as
+    "raise the deadline", not as a protocol regression. Returns the
+    machine record (ok = every mutant caught)."""
+    t0 = time.monotonic()
+    results = {}
+    for name in sorted(names or MUTANTS):
+        mut = MUTANTS[name]
+        caught_by = None
+        runs_total = 0
+        interrupted = False
+        with mut.apply():
+            for model_name in mut.models:
+                cls = MODELS[model_name]
+                # mutants hide deeper than the clean gate's budget: the
+                # PCT phase needs room (the deepest seeded bug lands
+                # around run ~1600 at seed 0 — 2500 leaves headroom)
+                per_model = (budget if budget is not None
+                             else max(cls.budget, 2500))
+                remaining = None
+                if deadline_s is not None:
+                    remaining = deadline_s - (time.monotonic() - t0)
+                res = explore(
+                    cls,
+                    max_schedules=per_model,
+                    preemption_bound=cls.preemption_bound,
+                    deadline_s=remaining,
+                )
+                runs_total += res["runs"]
+                interrupted = interrupted or res["interrupted"]
+                if res["violations"]:
+                    caught_by = {
+                        "model": model_name,
+                        "runs": res["runs"],
+                        "violation": res["violations"][0]["violation"],
+                        "choices": res["violations"][0]["choices"],
+                    }
+                    break
+        results[name] = {
+            "description": mut.description,
+            "caught": caught_by is not None,
+            "caught_by": caught_by,
+            "runs": runs_total,
+            "interrupted": interrupted,
+        }
+    return {
+        "schema": "schedsim/v1",
+        "mode": "mutations",
+        "ok": all(r["caught"] for r in results.values()),
+        "mutants": results,
+    }
+
+
+# =====================================================================
+# dumps + replay
+# =====================================================================
+
+def dump_violation(vio: dict, out_dir: pathlib.Path,
+                   index: int) -> pathlib.Path:
+    out_dir.mkdir(parents=True, exist_ok=True)
+    path = out_dir / f"schedsim_{vio['model']}_{index}.json"
+    with open(path, "w") as f:
+        json.dump({"schema": "schedsim/v1", "mode": "schedule",
+                   **vio}, f, indent=2)
+    return path
+
+
+def replay(dump: dict) -> dict | None:
+    """Re-run the exact dumped interleaving; returns the reproduced
+    violation (None when the schedule now runs clean — the bug was
+    fixed)."""
+    name = dump["model"]
+    cls = MODELS.get(name) or DEMO_MODELS.get(name)
+    if cls is None:
+        raise KeyError(f"unknown model {name!r}")
+    sim = _run_model(cls(), choices=dump["choices"])
+    return sim.violation
+
+
+# =====================================================================
+# CLI
+# =====================================================================
+
+def main(argv=None) -> int:
+    import logging
+
+    # the models drive members through scripted partitions; their
+    # warning logs are expected noise here, not signal
+    logging.getLogger(
+        "service_account_auth_improvements_tpu"
+    ).setLevel(logging.CRITICAL)
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.cplint.schedsim",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--model", action="append", dest="models",
+                    metavar="NAME",
+                    help="explore only the named model (repeatable); "
+                         "default: every clean-gate model")
+    ap.add_argument("--budget", type=int, default=None,
+                    help="max schedules per model (default: each "
+                         "model's own)")
+    ap.add_argument("--preemptions", type=int, default=None,
+                    help="preemption bound (default: each model's own)")
+    ap.add_argument("--deadline", type=float, default=None,
+                    metavar="SECONDS",
+                    help="wall-clock ceiling for the WHOLE invocation "
+                         "(shared across every model/mutant explored — "
+                         "what a CI step's wall budget means)")
+    ap.add_argument("--mutations", action="store_true",
+                    help="run the seeded-mutant catch suite instead of "
+                         "the clean gate")
+    ap.add_argument("--mutant", action="append", dest="mutants",
+                    metavar="NAME",
+                    help="with --mutations: only the named mutant(s)")
+    ap.add_argument("--replay", metavar="PATH",
+                    help="re-run a dumped schedule; exits 1 when the "
+                         "violation reproduces")
+    ap.add_argument("--dump-dir", default="schedsim_out",
+                    help="where failing schedules are dumped "
+                         "(default: schedsim_out)")
+    ap.add_argument("--json", dest="json_out", metavar="PATH",
+                    help="write the machine-readable run record")
+    ap.add_argument("--fair", action="store_true",
+                    help="additionally run each model's round-robin "
+                         "progress check")
+    ap.add_argument("--list-models", action="store_true")
+    ap.add_argument("--list-mutants", action="store_true")
+    ap.add_argument("--list-sync-points", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_models:
+        print(json.dumps({
+            "models": {n: (MODELS | DEMO_MODELS)[n].__doc__.split("\n")[0]
+                       for n in sorted(MODELS | DEMO_MODELS)},
+        }, indent=2))
+        return 0
+    if args.list_mutants:
+        print(json.dumps({
+            "mutants": {n: {"models": list(m.models),
+                            "description": m.description}
+                        for n, m in sorted(MUTANTS.items())},
+        }, indent=2))
+        return 0
+    if args.list_sync_points:
+        print(json.dumps({"sync_points": SYNC_POINTS}, indent=2))
+        return 0
+
+    if args.replay:
+        with open(args.replay) as f:
+            dump = json.load(f)
+        vio = replay(dump)
+        if vio is not None:
+            print(f"schedsim: replay of {dump['model']} reproduces: "
+                  f"{vio}", file=sys.stderr)
+            return 1
+        print(f"schedsim: replay of {dump['model']} runs clean",
+              file=sys.stderr)
+        return 0
+
+    if args.mutations:
+        unknown = set(args.mutants or ()) - set(MUTANTS)
+        if unknown:
+            ap.error(f"unknown mutant(s): {', '.join(sorted(unknown))}")
+        record = run_mutations(args.mutants, budget=args.budget,
+                               deadline_s=args.deadline)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(record, f, indent=2)
+        for name, r in sorted(record["mutants"].items()):
+            if r["caught"]:
+                cb = r["caught_by"]
+                print(f"schedsim: mutant {name} CAUGHT by {cb['model']} "
+                      f"after {cb['runs']} schedule(s): "
+                      f"{cb['violation']['kind']}", file=sys.stderr)
+            elif r.get("interrupted"):
+                # a deadline-starved exploration is NOT evidence the
+                # mutant is uncatchable — say so (and still fail: a
+                # suite that couldn't finish proves nothing)
+                print(f"schedsim: mutant {name} NOT CAUGHT within the "
+                      f"deadline ({r['runs']} schedules, interrupted) "
+                      "— raise --deadline/--budget",
+                      file=sys.stderr)
+            else:
+                print(f"schedsim: mutant {name} SURVIVED "
+                      f"({r['runs']} schedules) — {r['description']}",
+                      file=sys.stderr)
+        return 0 if record["ok"] else 1
+
+    # ------------------------------------------------- clean-HEAD gate
+    names = args.models or sorted(MODELS)
+    unknown = set(names) - set(MODELS) - set(DEMO_MODELS)
+    if unknown:
+        ap.error(f"unknown model(s): {', '.join(sorted(unknown))}")
+    record: dict = {"schema": "schedsim/v1", "mode": "explore",
+                    "models": {}, "ok": True}
+    dumped = 0
+    t0 = time.monotonic()
+    for name in names:
+        cls = MODELS.get(name) or DEMO_MODELS[name]
+        remaining = None
+        if args.deadline is not None:
+            remaining = args.deadline - (time.monotonic() - t0)
+        res = explore(
+            cls,
+            max_schedules=args.budget or cls.budget,
+            preemption_bound=(args.preemptions
+                              if args.preemptions is not None
+                              else cls.preemption_bound),
+            deadline_s=remaining,
+        )
+        entry = {"runs": res["runs"],
+                 "violations": len(res["violations"]),
+                 "interrupted": res["interrupted"],
+                 "exhaustive": res["exhaustive"]}
+        if res["runs"] == 0:
+            # a model the deadline starved to ZERO schedules proved
+            # nothing — the gate must not read absence of exploration
+            # as cleanliness (the bench_gate lint-leg asymmetry)
+            record["ok"] = False
+            record["models"][name] = entry
+            print(f"schedsim: {name}: 0 schedules explored (deadline "
+                  "starved) — no evidence either way; raise --deadline",
+                  file=sys.stderr)
+            continue
+        if args.fair and not res["violations"]:
+            fr = fair_run(cls)
+            entry["fair_ok"] = fr.violation is None
+            if fr.violation is not None:
+                res["violations"].append({
+                    "model": name, "choices": fr.choices_taken(),
+                    "violation": fr.violation,
+                })
+                entry["violations"] += 1
+        record["models"][name] = entry
+        for vio in res["violations"]:
+            record["ok"] = False
+            path = dump_violation(vio, pathlib.Path(args.dump_dir),
+                                  dumped)
+            dumped += 1
+            print(f"schedsim: {name}: {vio['violation']} — schedule "
+                  f"dumped to {path} (re-run: python -m "
+                  f"tools.cplint.schedsim --replay {path})",
+                  file=sys.stderr)
+        if not res["violations"]:
+            print(f"schedsim: {name}: {res['runs']} schedule(s) "
+                  "explored, no violation"
+                  + (" (exhaustive within bounds)"
+                     if res.get("exhaustive") else " (budget spent)"),
+                  file=sys.stderr)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(record, f, indent=2)
+    return 0 if record["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
