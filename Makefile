@@ -8,10 +8,13 @@
 test: native
 	python -m pytest tests/ -x -q -m "not slow"
 
-# cplint: the ten control-plane invariant passes (docs/cplint.md);
-# exits nonzero on any unsuppressed finding
+# both analyzers: cplint's ten control-plane invariant passes
+# (docs/cplint.md) and jaxlint's five JAX-stack passes
+# (docs/jaxlint.md); exits nonzero on any unsuppressed finding in
+# either (cplint's exit status is deferred so jaxlint always runs)
 lint:
-	python -m tools.cplint
+	@rc=0; python -m tools.cplint || rc=1; \
+	python -m tools.jaxlint || rc=1; exit $$rc
 
 native:
 	$(MAKE) -C native

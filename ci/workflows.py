@@ -107,7 +107,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
     "unit_tests.yaml": workflow(
         "Unit Tests",
         ["service_account_auth_improvements_tpu/**", "tests/**", "native/**",
-         "frontends/**"],
+         "frontends/**", "tools/jaxlint/**"],
         {"pytest": job(
             [CHECKOUT, SETUP_PY, INSTALL_DEPS,
              {"name": "Build native components", "run": "make -C native"},
@@ -125,11 +125,20 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
               "run": "python -m tools.cplint.schedsim --budget 200 "
                      "--deadline 180 --json schedsim_report.json "
                      "--dump-dir schedsim_out"},
-             {"name": "Upload schedsim record",
+             # jaxlint: the five JAX-stack discipline passes over
+             # train/parallel/ops/models (tools/jaxlint); the findings
+             # report is uploaded if: always() below so a red run
+             # carries its evidence
+             {"name": "JAX stack invariant lint (jaxlint)",
+              "if": "always()",
+              "run": "python -m tools.jaxlint "
+                     "--json jaxlint_report.json"},
+             {"name": "Upload schedsim + jaxlint records",
               "if": "always()",
               "uses": "actions/upload-artifact@v4",
               "with": {"name": "schedsim",
-                       "path": "schedsim_report.json\nschedsim_out/",
+                       "path": "schedsim_report.json\n"
+                               "jaxlint_report.json\nschedsim_out/",
                        "if-no-files-found": "ignore"}}],
             # CPLINT_LOCKWATCH: tests/conftest.py instruments every
             # controlplane Lock/RLock/Condition (tools/cplint/lockwatch)
@@ -221,7 +230,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
          "manifests/controllers/**",
          "tests/test_cpbench.py", "tests/test_cpprof.py",
          "tools/metrics_lint.py",
-         "tools/cplint/**", "tools/bench_gate.py"],
+         "tools/cplint/**", "tools/jaxlint/**",
+         "tools/bench_gate.py"],
         {"cpbench": job([
             CHECKOUT, SETUP_PY,
             # cplint needs pyyaml for the rbac-check manifest diff;
@@ -236,13 +246,33 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             # run carries its evidence
             {"name": "Control-plane invariant lint (cplint)",
              "run": "python -m tools.cplint --json cplint_report.json"},
-            # the gate additionally asserts the three concurrency-
-            # dataflow passes (blocking-under-lock / check-then-act /
-            # mvcc-escape) actually RAN and reports their counts
+            # the JAX-stack sibling: host-sync-in-step, retrace-hazard,
+            # rng-key-reuse, donation-after-donate,
+            # mesh-axis-consistency over train/parallel/ops/models
+            # (pure AST — no jax install needed in this lane)
+            {"name": "JAX stack invariant lint (jaxlint)",
+             "if": "always()",
+             "run": "python -m tools.jaxlint "
+                    "--json jaxlint_report.json"},
+            # the gate additionally asserts the three cplint
+            # concurrency-dataflow passes AND the five jaxlint passes
+            # actually RAN (present-in-report, not clean-by-absence)
+            # and reports their counts — one report of EACH schema is
+            # required, so dropping an analyzer fails
             {"name": "Lint report gate",
              "if": "always()",
              "run": "python tools/bench_gate.py "
-                    "--lint-report cplint_report.json"},
+                    "--lint-report cplint_report.json "
+                    "--lint-report jaxlint_report.json"},
+            # jaxlint mutation validation: every hand-seeded JAX
+            # discipline bug (per-step float(loss), reused dropout key,
+            # donated-then-read state, typo'd mesh axis, unhashable
+            # static arg, ...) must be caught by its pass while clean
+            # HEAD stays clean (tools/jaxlint/mutants.py; deterministic
+            # AST analysis, no budget knobs)
+            {"name": "jaxlint mutation-catch suite",
+             "run": "python -m tools.jaxlint --mutations "
+                    "--json jaxlint_mutations.json"},
             # mutation validation: every hand-seeded protocol bug
             # (ack-barrier dropped, self-fence skipped, MVCC identity
             # check removed, dirty re-add lost, ...) must be CAUGHT by
@@ -346,6 +376,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                       "path": "bench_out.json\nchaos_out.json\n"
                               "ha_out.json\n"
                               "cplint_report.json\n"
+                              "jaxlint_report.json\n"
+                              "jaxlint_mutations.json\n"
                               "schedsim_mutations.json\nbench_out/"}},
         ])},
     ),

@@ -61,13 +61,18 @@ def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
     reporting a perfect-looking 0-token score."""
     step = step or make_eval_step(cfg, mesh=mesh, rules=rules,
                                   packed=packed)
-    total, count = 0.0, 0.0
+    # device-side accumulators: each batch's (nll_sum, n) is ADDED on
+    # device and dispatch stays asynchronous — the one float() sync
+    # happens after the last batch, not per batch (a per-batch float()
+    # serializes host and device for the whole eval; jaxlint
+    # host-sync-in-step caught exactly that here)
+    total = count = None
 
     def run(tokens, mask):
         nonlocal total, count
         s, n = step(params, tokens, mask)
-        total += float(s)
-        count += float(n)
+        total = s if total is None else total + s
+        count = n if count is None else count + n
 
     for batch in batches:
         if isinstance(batch, (tuple, list)):
@@ -82,12 +87,13 @@ def evaluate(cfg: llama.LlamaConfig, params, batches, mesh=None,
                 run(tokens, mask)
         else:
             run(tokens, mask)
+    count = float(count) if count is not None else 0.0
     if count == 0:
         raise ValueError(
             "evaluate() saw no tokens — empty or already-exhausted "
             "batches iterable?"
         )
-    loss = total / count
+    loss = float(total) / count
     return {
         "loss": loss,
         "perplexity": float(np.exp(min(loss, 80.0))),

@@ -43,6 +43,22 @@ from service_account_auth_improvements_tpu.train.step import (
 )
 
 
+def _maybe_jitwatch(fn, site: str):
+    """Instrument a step under tools/jaxlint's recompile/transfer
+    watcher when JAXLINT_JITWATCH=1 (the lockwatch enablement shape:
+    identity — one env read, zero per-call cost — when off, or when
+    the tools package isn't on the path of a production install)."""
+    import os
+
+    if not os.environ.get("JAXLINT_JITWATCH"):
+        return fn
+    try:
+        from tools.jaxlint import jitwatch
+    except ImportError:
+        return fn
+    return jitwatch.maybe_wrap(fn, site=site)
+
+
 @dataclasses.dataclass(frozen=True)
 class LoopConfig:
     steps: int
@@ -113,9 +129,9 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
     if lora is not None:
         # packed corpora train with the boundary loss mask only (the
         # adapter step has no segment-masked attention path)
-        raw_step = lora_mod.make_lora_train_step(
+        raw_step = _maybe_jitwatch(lora_mod.make_lora_train_step(
             cfg, lora, optimizer=optimizer, mesh=mesh, packed=packed
-        )
+        ), "train.loop.step")
 
         def step_fn(state, batch, mask):
             return raw_step(state, base_params, batch, mask)
@@ -128,11 +144,13 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
                             if packed and cfg.attn_impl == "dense"
                             else None),
         )
+        step_fn = _maybe_jitwatch(step_fn, "train.loop.step")
     eval_step = None
     if loop.eval_every and eval_data is not None:
         from service_account_auth_improvements_tpu.train import evaluate
 
         eval_step = evaluate.make_eval_step(cfg, mesh=mesh, packed=packed)
+        eval_step = _maybe_jitwatch(eval_step, "train.loop.eval_step")
         # materialize once: the eval set is re-iterated every cadence,
         # and a generator would be exhausted after the first eval
         eval_data = list(eval_data)
@@ -147,6 +165,9 @@ def fit(cfg: llama.LlamaConfig, mesh, tokens, data_cfg: DataConfig,
                 # the first executed step carries JIT compilation; start
                 # the throughput clock after it so history records real
                 # step time, not amortized compile
+                # (fires ONCE per run — t0 latches non-None: a
+                # deliberate compile barrier, not a per-step sync)
+                # jaxlint: disable=host-sync-in-step — one-time barrier
                 jax.block_until_ready(metrics["loss"])
                 t0, timed_from = time.perf_counter(), i + 1
             if loop.log_every and (i + 1) % loop.log_every == 0:

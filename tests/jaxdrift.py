@@ -20,7 +20,24 @@ try:
 except Exception:  # pragma: no cover - orbax always present in CI
     _ocp = None
 
-_JAX_MM = tuple(int(x) for x in jax.__version__.split(".")[:2])
+def _version_mm(version: str) -> tuple:
+    """(major, minor) from a version string, tolerating rc/dev suffixes
+    in either field — a parse failure must degrade to "new enough"
+    (no skip, hence the LARGE sentinel: these guards skip on OLD
+    stacks), never raise at import and take the file red at collection
+    (the self-test in tests/test_jaxdrift.py pins both properties)."""
+    out = []
+    for field in version.split(".")[:2]:
+        digits = ""
+        for ch in field:
+            if not ch.isdigit():
+                break
+            digits += ch
+        out.append(int(digits) if digits else 9999)
+    return tuple(out)
+
+
+_JAX_MM = _version_mm(jax.__version__)
 
 #: jax.shard_map was promoted to the top-level namespace after 0.4.x;
 #: parallel/pipeline.py, parallel/ring.py and parallel/ulysses.py are
@@ -57,3 +74,16 @@ requires_jax_05_numerics = pytest.mark.skipif(
         "(numerics differ from the targeted >=0.5 stack)"
     ),
 )
+
+#: every drift guard this module exports, by name — the self-test
+#: surface (tests/test_jaxdrift.py): each guard's probe must have
+#: EVALUATED to a plain bool at import (hasattr/version probes never
+#: raise — a renamed upstream API must flip a guard to
+#: skip-with-reason, never surface as a collection error) and carry a
+#: reason naming the drift. New guards must be registered here or the
+#: self-test fails the inventory pin.
+GUARDS = {
+    "requires_jax_shard_map": requires_jax_shard_map,
+    "requires_orbax_placeholder": requires_orbax_placeholder,
+    "requires_jax_05_numerics": requires_jax_05_numerics,
+}

@@ -359,7 +359,7 @@ def test_bench_gate_chaos_legs():
 
 
 def test_bench_gate_lint_leg():
-    """lint_gate: the cplint-report leg passes only on a well-formed
+    """lint_gate: the lint-report leg passes only on a well-formed
     clean record — wrong schema, missing counts, and unsuppressed
     findings all fail (absence of evidence isn't cleanliness)."""
     bg = _load_bench_gate()
@@ -368,9 +368,21 @@ def test_bench_gate_lint_leg():
     clean = {"schema": "cplint/v1", "ok": True, "passes": list(ran),
              "counts": {"errors": 0, "suppressed": 2}, "findings": []}
     assert bg.lint_gate(clean) == []
-    # wrong/missing schema: not a cplint record at all
+    # a jaxlint record gates against ITS required passes (ISSUE 14)
+    jclean = {"schema": "jaxlint/v1", "ok": True,
+              "passes": [{"name": n}
+                         for n in bg.JAXLINT_REQUIRED_PASSES],
+              "counts": {"errors": 0, "suppressed": 1}, "findings": []}
+    assert bg.lint_gate(jclean) == []
+    jstale = dict(jclean)
+    jstale["passes"] = [{"name": "host-sync-in-step"}]
+    fails = bg.lint_gate(jstale)
+    assert len(fails) == 1 and "mesh-axis-consistency" in fails[0] and \
+        "did not run" in fails[0]
+    # wrong/missing schema: not a lint record at all
     fails = bg.lint_gate({"schema": "other/v1"})
-    assert len(fails) == 1 and "cplint/v1" in fails[0]
+    assert len(fails) == 1 and "cplint/v1" in fails[0] and \
+        "jaxlint/v1" in fails[0]
     assert bg.lint_gate({}) and "cplint/v1" in bg.lint_gate({})[0]
     # a report whose pass list is missing the concurrency-dataflow
     # passes did not RUN them — clean-by-absence must fail (ISSUE 13)
@@ -419,12 +431,28 @@ def test_bench_gate_lint_cli(tmp_path):
          "passes": [{"name": n} for n in bg.LINT_REQUIRED_PASSES],
          "counts": {"errors": 0, "suppressed": 0}, "findings": []}
     ))
+    jclean = tmp_path / "jclean.json"
+    jclean.write_text(_json.dumps(
+        {"schema": "jaxlint/v1", "ok": True,
+         "passes": [{"name": n} for n in bg.JAXLINT_REQUIRED_PASSES],
+         "counts": {"errors": 0, "suppressed": 0}, "findings": []}
+    ))
     proc = subprocess.run(
-        [_sys.executable, str(gate_py), "--lint-report", str(clean)],
+        [_sys.executable, str(gate_py), "--lint-report", str(clean),
+         "--lint-report", str(jclean)],
         capture_output=True, text=True,
     )
     assert proc.returncode == 0, proc.stderr
-    assert "cplint report clean" in proc.stderr
+    assert "cplint + jaxlint reports clean" in proc.stderr
+    # ONE analyzer's report alone must fail — dropping the other from
+    # CI cannot read as clean (the ISSUE 13 asymmetry, both ways)
+    for only, missing in ((clean, "jaxlint/v1"), (jclean, "cplint/v1")):
+        proc = subprocess.run(
+            [_sys.executable, str(gate_py), "--lint-report", str(only)],
+            capture_output=True, text=True,
+        )
+        assert proc.returncode == 1
+        assert f"no {missing} lint report given" in proc.stderr
     proc = subprocess.run(
         [_sys.executable, str(gate_py), "--lint-report",
          str(tmp_path / "missing.json")],

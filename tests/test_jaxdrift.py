@@ -1,0 +1,134 @@
+"""Self-test for the version-drift skip guards (tests/jaxdrift.py).
+
+The drift set only self-retires cleanly if every guard's probe keeps
+EVALUATING: a renamed jax/orbax API must flip a guard to
+skip-with-reason, never to a collection error that takes the whole
+test file red. These tests pin that contract — the probe results are
+plain bools computed at import (never callables that could raise at
+collection), every reason names the drift, and the module still
+imports when the probed libraries are broken or absent entirely.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+import types
+
+import pytest
+
+import tests.jaxdrift as jaxdrift
+
+
+def _mark_of(guard):
+    """The underlying pytest mark (works across pytest mark layouts)."""
+    mark = getattr(guard, "mark", None)
+    assert mark is not None, "guard is not a pytest mark decorator"
+    return mark
+
+
+def test_guard_inventory_is_registered():
+    """Every module-level requires_* guard is in GUARDS — new guards
+    must join the self-test surface."""
+    exported = {name for name in vars(jaxdrift)
+                if name.startswith("requires_")}
+    assert exported == set(jaxdrift.GUARDS)
+
+
+@pytest.mark.parametrize("name", sorted(jaxdrift.GUARDS))
+def test_guard_probe_evaluated_to_bool(name):
+    """The skip condition is an already-evaluated bool, not a deferred
+    expression that could raise at collection time."""
+    mark = _mark_of(jaxdrift.GUARDS[name])
+    assert mark.name == "skipif"
+    assert len(mark.args) == 1
+    assert isinstance(mark.args[0], bool), (
+        f"{name}: skipif condition is {type(mark.args[0]).__name__}, "
+        "want an import-time-evaluated bool"
+    )
+
+
+@pytest.mark.parametrize("name", sorted(jaxdrift.GUARDS))
+def test_guard_reason_names_the_drift(name):
+    reason = _mark_of(jaxdrift.GUARDS[name]).kwargs.get("reason", "")
+    assert "drift" in reason, (
+        f"{name}: the skip reason must say WHY (version drift) so a "
+        "skipped run reads as expected drift, not a mystery"
+    )
+
+
+def _reload_with(monkeypatch, **replacements):
+    """Reload jaxdrift with sys.modules entries replaced; restores the
+    real module afterwards regardless of outcome."""
+    for mod_name, mod in replacements.items():
+        if mod is None:
+            monkeypatch.setitem(sys.modules, mod_name, None)
+        else:
+            monkeypatch.setitem(sys.modules, mod_name, mod)
+    try:
+        return importlib.reload(jaxdrift)
+    finally:
+        monkeypatch.undo()
+        importlib.reload(jaxdrift)
+
+
+def test_missing_shard_map_flips_to_skip(monkeypatch):
+    """A jax without shard_map (the actual drift on 0.4.x images) makes
+    the guard a skip, and import still succeeds."""
+    stub = types.ModuleType("jax")
+    stub.__version__ = "0.4.0"
+    # no shard_map attribute at all
+    mod = _reload_with(monkeypatch, jax=stub)
+    mark = _mark_of(mod.requires_jax_shard_map)
+    assert mark.args[0] is True        # condition: skip
+    assert "shard_map" in mark.kwargs["reason"]
+
+
+def test_broken_orbax_flips_to_skip(monkeypatch):
+    """An orbax whose import RAISES (not merely missing an attr) still
+    yields an importable module with the guard skipping — the
+    try/except in jaxdrift is the collection-error firewall."""
+
+    class _Exploding(types.ModuleType):
+        def __getattr__(self, item):   # import orbax.checkpoint -> boom
+            raise RuntimeError("broken orbax install")
+
+    broken = _Exploding("orbax")
+    mod = _reload_with(monkeypatch, **{"orbax": broken,
+                                       "orbax.checkpoint": None})
+    mark = _mark_of(mod.requires_orbax_placeholder)
+    assert mark.args[0] is True
+    assert "orbax" in mark.kwargs["reason"]
+
+
+def test_unparseable_jax_version_still_imports(monkeypatch):
+    """A future jax whose version string grows a suffix in the first
+    two fields must not crash the version probe at import."""
+    import jax as real_jax
+
+    stub = types.ModuleType("jax")
+    stub.__version__ = "1.0rc1.dev2"
+    stub.shard_map = getattr(real_jax, "shard_map", lambda *a: None)
+    mod = _reload_with(monkeypatch, jax=stub)
+    # whatever the parse decided, it DECIDED — bool, not exception
+    assert isinstance(_mark_of(mod.requires_jax_05_numerics).args[0],
+                      bool)
+
+
+def test_unparseable_version_degrades_to_no_skip():
+    """A field the parser can't read means "new enough", NOT "ancient":
+    these guards skip on OLD stacks, so an unparseable future version
+    must not flip them to skip-forever."""
+    assert jaxdrift._version_mm("main.dev") >= (0, 5)
+    assert jaxdrift._version_mm("v1.0") >= (0, 5)     # non-digit lead
+    assert jaxdrift._version_mm("0.4.37") == (0, 4)   # real old stack
+    assert jaxdrift._version_mm("0.5.0rc1") == (0, 5)
+
+
+def test_guards_restored_after_reload_games():
+    """The real module state survives the stub reloads above (ordering
+    safety for the rest of the suite)."""
+    import jax
+
+    mark = _mark_of(jaxdrift.requires_jax_shard_map)
+    assert mark.args[0] == (not hasattr(jax, "shard_map"))

@@ -1,0 +1,733 @@
+"""jaxlint: the JAX tree stays clean, every pass fires on its known-bad
+fixture and stays quiet on the known-good one, ``# jaxlint: disable=``
+suppressions are honored (and stay disjoint from cplint's), the seeded
+mutant matrix is caught (fast subset here, full matrix marked slow —
+CI's bench lane runs ``python -m tools.jaxlint --mutations``), and the
+jitwatch runtime watcher pins a deliberately-retracing function caught
+at budget while a compliant train step runs green.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.cplint.core import run_passes  # noqa: E402
+from tools.jaxlint import mutants  # noqa: E402
+from tools.jaxlint.core import jax_context  # noqa: E402
+from tools.jaxlint.passes import (  # noqa: E402
+    ALL_PASSES,
+    donation,
+    host_sync,
+    mesh_axes,
+    retrace_hazard,
+    rng_reuse,
+)
+
+SCOPE = "service_account_auth_improvements_tpu"
+PASS_NAMES = {
+    "host-sync-in-step", "retrace-hazard", "rng-key-reuse",
+    "donation-after-donate", "mesh-axis-consistency",
+}
+
+
+def _fixture_ctx(tmp_path, source: str,
+                 rel: str = f"{SCOPE}/train/fixture.py",
+                 mesh_axes_decl: str = '("dp", "fsdp", "tp", "sp")'):
+    """A throwaway repo containing one JAX module (plus a minimal mesh
+    module so mesh-axis-consistency has declarations to diff against)."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    mesh = tmp_path / f"{SCOPE}/parallel/mesh.py"
+    if not mesh.exists():
+        mesh.parent.mkdir(parents=True, exist_ok=True)
+        mesh.write_text(f"MESH_AXES = {mesh_axes_decl}\n")
+    return jax_context(repo=tmp_path), path
+
+
+def _messages(findings, include_suppressed=False):
+    return [f.message for f in findings
+            if include_suppressed or not f.suppressed]
+
+
+# ------------------------------------------------------------ the tree
+
+def test_repo_is_clean():
+    findings = run_passes(ALL_PASSES, jax_context(REPO))
+    active = [f.format() for f in findings if not f.suppressed]
+    assert active == [], "\n".join(active)
+
+
+def test_cli_exits_zero_and_writes_report(tmp_path):
+    out = tmp_path / "report.json"
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--json", str(out)],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(out.read_text())
+    assert report["schema"] == "jaxlint/v1"
+    assert report["ok"] is True
+    assert report["counts"]["errors"] == 0
+    assert {p["name"] for p in report["passes"]} == PASS_NAMES
+
+
+def test_cli_list_passes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--list-passes"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    catalog = json.loads(proc.stdout)
+    assert catalog["schema"] == "jaxlint-passes/v1"
+    assert {p["name"] for p in catalog["passes"]} == PASS_NAMES
+    assert all(p["description"] for p in catalog["passes"])
+
+
+def test_cli_rejects_unknown_pass():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.jaxlint", "--pass", "nope"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode != 0
+    assert "unknown pass" in proc.stderr
+
+
+# ------------------------------------------------------ host-sync-in-step
+
+BAD_SYNC_JIT = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        y = x * 2
+        print(y)
+        return float(y)
+"""
+
+BAD_SYNC_LOOP = """
+    def train(step_fn, batches, state):
+        losses = []
+        for b in batches:
+            state, metrics = step_fn(state, b)
+            losses.append(float(metrics["loss"]))
+        return state, losses
+"""
+
+GOOD_SYNC = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        jax.debug.print("x={x}", x=x)      # sanctioned
+        n = int(x.shape[0])                # static read
+        return x * n
+
+    def train(step_fn, batches, state, log_every=10):
+        for i, b in enumerate(batches):
+            state, metrics = step_fn(state, b)
+            if (i + 1) % log_every == 0:
+                log = float(metrics["loss"])   # cadence-gated boundary
+        final = float(metrics["loss"])         # after the loop
+        return state, final
+"""
+
+SUPPRESSED_SYNC = """
+    def train(step_fn, batches, state):
+        for b in batches:
+            state, metrics = step_fn(state, b)
+            # jaxlint: disable=host-sync-in-step — fixture justification
+            probe = float(metrics["loss"])
+        return state
+"""
+
+
+def test_host_sync_flags_jit_scope(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_SYNC_JIT)
+    msgs = _messages(host_sync.run(ctx))
+    assert any("float()" in m for m in msgs)
+    assert any("print()" in m for m in msgs)
+
+
+def test_host_sync_flags_per_step_loop(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_SYNC_LOOP)
+    msgs = _messages(host_sync.run(ctx))
+    assert len(msgs) == 1 and "per-step path" in msgs[0]
+
+
+def test_host_sync_known_good_clean(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, GOOD_SYNC)
+    assert _messages(host_sync.run(ctx)) == []
+
+
+def test_host_sync_suppression_honored(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, SUPPRESSED_SYNC)
+    findings = host_sync.run(ctx)
+    assert _messages(findings) == []
+    assert len(_messages(findings, include_suppressed=True)) == 1
+
+
+def test_cplint_suppression_does_not_silence_jaxlint(tmp_path):
+    """The two analyzers' disable comments are disjoint namespaces."""
+    src = SUPPRESSED_SYNC.replace("jaxlint: disable",
+                                  "cplint: disable")
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert len(_messages(host_sync.run(ctx))) == 1
+
+
+# ------------------------------------------------------- retrace-hazard
+
+BAD_RETRACE = """
+    import jax
+    from functools import partial
+
+    _BUCKETS = {}
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def step(x, mode=[]):
+        if x > 0:
+            x = x + 1
+        note = f"x={x}"
+        cache = _BUCKETS
+        return x
+"""
+
+GOOD_RETRACE = """
+    import jax
+    from functools import partial
+
+    _LIMITS = (1, 2, 3)          # immutable: fine to close over
+
+    @partial(jax.jit, static_argnames=("mode",))
+    def step(x, mode="train"):
+        b = x.shape[0]           # static derivation
+        if b > 1:                # static: no hazard
+            x = x * 2
+        if mode == "train":      # static arg: fine
+            x = x + 1
+        if x is None:            # identity test: fine
+            return x
+        return x + _LIMITS[0]
+"""
+
+SUPPRESSED_RETRACE = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        # jaxlint: disable=retrace-hazard — fixture justification
+        if x > 0:
+            x = x + 1
+        return x
+"""
+
+
+def test_retrace_flags_all_four_shapes(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_RETRACE)
+    msgs = _messages(retrace_hazard.run(ctx))
+    assert any("unhashable" in m for m in msgs)
+    assert any("`if` on traced" in m for m in msgs)
+    assert any("f-string" in m for m in msgs)
+    assert any("mutable module global" in m for m in msgs)
+
+
+def test_retrace_known_good_clean(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, GOOD_RETRACE)
+    assert _messages(retrace_hazard.run(ctx)) == []
+
+
+def test_retrace_suppression_honored(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, SUPPRESSED_RETRACE)
+    findings = retrace_hazard.run(ctx)
+    assert _messages(findings) == []
+    assert len(_messages(findings, include_suppressed=True)) == 1
+
+
+# -------------------------------------------------------- rng-key-reuse
+
+BAD_RNG = """
+    import jax
+
+    def sample(key):
+        a = jax.random.normal(key, (2,))
+        b = jax.random.uniform(key, (2,))
+        return a + b
+
+    def loopy(key, n):
+        out = []
+        for i in range(n):
+            out.append(jax.random.normal(key, (2,)))
+        return out
+"""
+
+GOOD_RNG = """
+    import jax
+
+    def sample(key):
+        key, ka = jax.random.split(key)
+        a = jax.random.normal(ka, (2,))
+        kb = jax.random.fold_in(key, 7)     # fold_in re-derives
+        b = jax.random.uniform(kb, (2,))
+        return a + b
+
+    def loopy(key, n):
+        out = []
+        for i in range(n):
+            key, sub = jax.random.split(key)
+            out.append(jax.random.normal(sub, (2,)))
+        return out
+
+    def pooled(key, n):
+        keys = jax.random.split(key, n)     # key pool
+        return [jax.random.normal(k, (2,)) for k in keys]
+
+    def branches(key, flag):
+        if flag:
+            return jax.random.normal(key, (2,))
+        else:
+            return jax.random.uniform(key, (2,))
+"""
+
+SUPPRESSED_RNG = """
+    import jax
+
+    def sample(key):
+        a = jax.random.normal(key, (2,))
+        # jaxlint: disable=rng-key-reuse — fixture justification
+        b = jax.random.uniform(key, (2,))
+        return a + b
+"""
+
+
+def test_rng_flags_double_use_and_loop_carry(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_RNG)
+    msgs = _messages(rng_reuse.run(ctx))
+    assert any("second time" in m for m in msgs)
+    assert any("never re-bound" in m for m in msgs)
+    assert len(msgs) == 2
+
+
+def test_rng_flags_comprehension_reuse(tmp_path):
+    """[normal(key, ...) for _ in r]: the loop-carry bug in expression
+    clothing — every element draws from the SAME key."""
+    src = """
+        import jax
+
+        def bad(key, n):
+            return [jax.random.normal(key, (2,)) for _ in range(n)]
+    """
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(rng_reuse.run(ctx))
+    assert any("once per element" in m for m in msgs)
+
+
+def test_rng_known_good_clean(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, GOOD_RNG)
+    assert _messages(rng_reuse.run(ctx)) == []
+
+
+def test_rng_suppression_honored(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, SUPPRESSED_RNG)
+    findings = rng_reuse.run(ctx)
+    assert _messages(findings) == []
+    assert len(_messages(findings, include_suppressed=True)) == 1
+
+
+# ------------------------------------------------- donation-after-donate
+
+BAD_DONATION = """
+    import jax
+
+    def make_step():
+        def step_fn(state, batch):
+            return state + batch, state
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def train(state, batches):
+        step = make_step()
+        for b in batches:
+            new_state, m = step(state, b)
+            stale = state + 1          # read after donation
+            state = new_state
+        return state
+"""
+
+GOOD_DONATION = """
+    import jax
+
+    def make_step():
+        def step_fn(state, batch):
+            return state + batch, state
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def train(state, batches):
+        step = make_step()
+        for b in batches:
+            state, m = step(state, b)   # re-binding idiom: the old
+        return state                    # buffer is never touched
+"""
+
+SUPPRESSED_DONATION = """
+    import jax
+
+    def make_step():
+        def step_fn(state, batch):
+            return state + batch, state
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def train(state, batches):
+        step = make_step()
+        new_state, m = step(state, batches)
+        # jaxlint: disable=donation-after-donate — fixture justification
+        stale = state + 1
+        return new_state
+"""
+
+
+def test_donation_flags_read_after_donate(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_DONATION)
+    msgs = _messages(donation.run(ctx))
+    assert len(msgs) == 1 and "donated" in msgs[0]
+
+
+def test_donation_known_good_clean(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, GOOD_DONATION)
+    assert _messages(donation.run(ctx)) == []
+
+
+def test_donation_suppression_honored(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, SUPPRESSED_DONATION)
+    findings = donation.run(ctx)
+    assert _messages(findings) == []
+    assert len(_messages(findings, include_suppressed=True)) == 1
+
+
+def test_donation_argnames_resolve(tmp_path):
+    """donate_argnames resolves to positions via the wrapped signature."""
+    src = """
+        import jax
+
+        def make_step():
+            def step_fn(state, batch):
+                return state + batch
+            return jax.jit(step_fn, donate_argnames=("state",))
+
+        def train(state, b):
+            step = make_step()
+            out = step(state, b)
+            return state + out        # read after donation
+    """
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    assert len(_messages(donation.run(ctx))) == 1
+
+
+# --------------------------------------------- mesh-axis-consistency
+
+BAD_MESH = """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        s = P(("dp", "fsdpp"), None)       # typo'd axis
+        return jax.lax.psum(x, "tp")
+"""
+
+GOOD_MESH = """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(x, axis_name: str = "sp"):
+        s = P(("dp", "fsdp"), None)
+        y = jax.lax.psum(x, "tp")
+        return jax.lax.axis_index(axis_name)
+"""
+
+SUPPRESSED_MESH = """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def f(x):
+        # jaxlint: disable=mesh-axis-consistency — fixture justification
+        s = P("ghost")
+        return x
+"""
+
+
+def test_mesh_flags_unknown_axis(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, BAD_MESH)
+    msgs = _messages(mesh_axes.run(ctx))
+    assert any("'fsdpp'" in m and "not declared" in m for m in msgs)
+
+
+def test_mesh_flags_declared_but_unused(tmp_path):
+    # only dp/tp/sp are used -> fsdp is a dead declared axis
+    src = """
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        def f(x, axis_name="sp"):
+            return jax.lax.psum(x * len(P("dp", "tp")), "tp")
+    """
+    ctx, _ = _fixture_ctx(tmp_path, src)
+    msgs = _messages(mesh_axes.run(ctx))
+    assert any("'fsdp'" in m and "never referenced" in m for m in msgs)
+
+
+def test_mesh_known_good_clean(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, GOOD_MESH)
+    assert _messages(mesh_axes.run(ctx)) == []
+
+
+def test_mesh_suppression_honored(tmp_path):
+    ctx, _ = _fixture_ctx(tmp_path, SUPPRESSED_MESH,
+                          mesh_axes_decl='("dp", "ghost2")')
+    findings = mesh_axes.run(ctx)
+    # the typo itself is suppressed; the unused declared axes report
+    # at the declaration (unsuppressed there, by design)
+    typo = [f for f in findings if "ghost'" in f.message]
+    assert typo and all(f.suppressed for f in typo)
+
+
+def test_mesh_missing_declaration_is_a_finding(tmp_path):
+    src = "X = 1\n"
+    td = tmp_path / "norepo"
+    p = td / f"{SCOPE}/train/fixture.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(src)
+    ctx = jax_context(repo=td)
+    msgs = _messages(mesh_axes.run(ctx))
+    assert any("could not resolve MESH_AXES" in m for m in msgs)
+
+
+# ------------------------------------------------------- mutant matrix
+
+FAST_MUTANTS = ("per_step_float_loss", "reused_round_key",
+                "typo_axis_partitionspec")
+
+
+def _run_named_mutants(names) -> dict:
+    keep = tuple(m for m in mutants.MUTANTS if m.name in names)
+    assert len(keep) == len(names)
+    orig = mutants.MUTANTS
+    mutants.MUTANTS = keep
+    try:
+        return mutants.run_mutations(repo=REPO)
+    finally:
+        mutants.MUTANTS = orig
+
+
+def test_mutant_matrix_covers_every_pass():
+    """≥8 mutants, and every pass has at least one seeded bug."""
+    assert len(mutants.MUTANTS) >= 8
+    assert {m.expect for m in mutants.MUTANTS} == PASS_NAMES
+
+
+def test_fast_mutant_subset_caught():
+    record = _run_named_mutants(FAST_MUTANTS)
+    assert record["ok"], record
+    assert record["caught"] == len(FAST_MUTANTS)
+    assert record["clean_head_ok"]
+
+
+@pytest.mark.slow
+def test_full_mutant_matrix_caught():
+    record = mutants.run_mutations(repo=REPO)
+    assert record["ok"], record
+    assert record["caught"] == record["total"] == len(mutants.MUTANTS)
+
+
+def test_mutant_anchor_drift_fails_loud(tmp_path, monkeypatch):
+    """A mutant whose patch anchor no longer matches reads as NOT
+    caught with an explicit drift error — never as silent coverage."""
+    bad = mutants.Mutant(
+        name="drifted", path=mutants.MUTANTS[0].path,
+        old="THIS TEXT IS NOWHERE", new="x", expect="host-sync-in-step",
+    )
+    monkeypatch.setattr(mutants, "MUTANTS", (bad,))
+    record = mutants.run_mutations(repo=REPO)
+    assert not record["ok"]
+    assert "drifted" in record["mutants"][0]["name"]
+    assert "matched 0 times" in record["mutants"][0]["error"]
+
+
+# ------------------------------------------------------------ jitwatch
+
+@pytest.fixture
+def jitwatch_mod():
+    from tools.jaxlint import jitwatch
+
+    yield jitwatch
+    jitwatch.uninstall()
+
+
+def test_jitwatch_catches_retrace_storm(jitwatch_mod):
+    import jax
+    import jax.numpy as jnp
+
+    w = jitwatch_mod.JitWatch(budget=2)
+    f = jax.jit(lambda x: x * 2)
+    wf = w.wrap(f, site="storm")
+    with pytest.raises(jitwatch_mod.RecompileBudgetExceeded) as ei:
+        for n in range(1, 6):       # every call a fresh shape
+            wf(jnp.ones(n))
+    assert ei.value.site == "storm"
+    assert ei.value.compiles > 2
+    assert "storm" in w.over_budget()
+
+
+def test_jitwatch_compliant_step_green(jitwatch_mod):
+    import jax
+    import jax.numpy as jnp
+
+    w = jitwatch_mod.JitWatch(budget=2)
+    f = jax.jit(lambda x: x + 1)
+    wf = w.wrap(f, site="steady")
+    for _ in range(5):              # one shape, one executable
+        wf(jnp.ones(4))
+    snap = w.snapshot()
+    assert snap["steady"]["calls"] == 5
+    assert snap["steady"]["compiles"] <= 2
+    assert w.over_budget() == []
+
+
+def test_jitwatch_train_loop_green(jitwatch_mod, monkeypatch, tmp_path):
+    """The existing train-loop path runs green under the watcher: the
+    fit() step stays inside its compile budget with the transfer guard
+    armed (CPU backend: host==device keeps the guard quiet — the
+    recompile counter is the CPU-assertable half; docs/jaxlint.md)."""
+    import numpy as np
+
+    from service_account_auth_improvements_tpu.models import llama
+    from service_account_auth_improvements_tpu.parallel import (
+        MeshConfig,
+        make_mesh,
+    )
+    from service_account_auth_improvements_tpu.train.data import DataConfig
+    from service_account_auth_improvements_tpu.train.loop import (
+        LoopConfig,
+        fit,
+    )
+
+    monkeypatch.setenv("JAXLINT_JITWATCH", "1")
+    watch = jitwatch_mod.install(budget=3)
+    cfg = llama.PRESETS["tiny"]
+    mesh = make_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=4096, dtype=np.int32)
+    state, hist = fit(
+        cfg, mesh, tokens, DataConfig(batch=4, seq=32),
+        LoopConfig(steps=4, log_every=2), log=lambda *a: None,
+    )
+    snap = watch.snapshot()
+    assert snap["train.loop.step"]["calls"] == 4
+    assert snap["train.loop.step"]["compiles"] <= 3
+    assert watch.over_budget() == []
+    assert len([h for h in hist if "loss" in h]) == 2
+
+
+def test_jitwatch_log_fallback_engages_for_cacheless_callables(
+        jitwatch_mod):
+    """A wrapped callable WITHOUT the private _cache_size attr (a
+    closure around inner jits, or a future jax that renames the attr)
+    must not leave the watcher inert: the jax.log_compiles stream is
+    hooked automatically and in-call compile events are attributed to
+    the wrapper — a re-jit-per-call storm still trips the budget."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jitwatch_mod.JitWatch(budget=3)
+
+    def storm(x):                    # fresh jit per call: the bug
+        return jax.jit(lambda y: y * 1.25)(x)
+
+    assert not hasattr(storm, "_cache_size")
+    wf = w.wrap(storm, site="cacheless-storm")
+    with pytest.raises(jitwatch_mod.RecompileBudgetExceeded):
+        for _ in range(8):
+            wf(jnp.ones(4))
+
+    # compliant shape: a closure over ONE prebuilt jit compiles only
+    # on its first call and stays inside the budget
+    g = jax.jit(lambda y: y + 1)
+
+    def steady(x):
+        return g(x)
+
+    ws = w.wrap(steady, site="cacheless-steady")
+    for _ in range(6):
+        ws(jnp.ones(4))
+    assert "cacheless-steady" not in w.over_budget()
+
+
+def test_jitwatch_shared_site_accumulates_across_wrappers(jitwatch_mod):
+    """Several wrappers at one site (a re-built step per fit) SUM into
+    the site's cumulative count, while the budget judges each wrapper
+    alone — re-wrapping can't reset the evidence, and a legitimate
+    fresh jit per fit can't trip another fit's budget."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jitwatch_mod.JitWatch(budget=2)
+    for _ in range(3):                  # three "fits", fresh jit each
+        f = jax.jit(lambda x: x * 2)
+        wf = w.wrap(f, site="shared")
+        wf(jnp.ones(4))                 # one compile per wrapper
+    snap = w.snapshot()["shared"]
+    assert snap["compiles"] == 3        # cumulative across wrappers
+    assert snap["wrapper_max"] == 1     # no single wrapper over budget
+    assert w.over_budget() == []
+
+
+def test_jitwatch_install_explicit_budget_wins(jitwatch_mod):
+    """install(budget=N) on an already-existing watch takes effect for
+    subsequent wraps — an earlier maybe_wrap's default can't silently
+    override the budget a test declared."""
+    first = jitwatch_mod.install()      # default budget
+    again = jitwatch_mod.install(budget=9)
+    assert again is first and first.budget == 9
+
+
+def test_jitwatch_maybe_wrap_is_identity_when_off(jitwatch_mod,
+                                                  monkeypatch):
+    monkeypatch.delenv("JAXLINT_JITWATCH", raising=False)
+
+    def fn(x):
+        return x
+
+    assert jitwatch_mod.maybe_wrap(fn, site="x") is fn
+
+
+def test_jitwatch_budget_env_override(jitwatch_mod, monkeypatch):
+    monkeypatch.setenv("JAXLINT_JITWATCH_BUDGET", "7")
+    assert jitwatch_mod.JitWatch().budget == 7
+
+
+def test_jitwatch_log_compiles_hook(jitwatch_mod):
+    """The jax.log_compiles stream is hooked and counts per-name
+    compile events (the _cache_size fallback path)."""
+    import jax
+    import jax.numpy as jnp
+
+    w = jitwatch_mod.JitWatch()
+    w.start_logs()
+    try:
+        def fresh_fn(x):
+            return x * 3
+
+        jf = jax.jit(fresh_fn)
+        jf(jnp.ones(3))
+        jf(jnp.ones(5))             # second shape: second compile
+        counts = w.compile_counts()
+        assert counts.get("fresh_fn", 0) >= 2, counts
+    finally:
+        w.stop_logs()

@@ -1,9 +1,13 @@
 """fit(): trains, checkpoints, and resumes bit-identically to an
-uninterrupted run (train/loop.py)."""
+uninterrupted run (train/loop.py) — with the jaxlint jitwatch armed:
+every fit() in this file runs under the recompile budget and transfer
+guard, so a retrace regression in the step path fails HERE, at the
+offending call, not as a slow-suite symptom (docs/jaxlint.md)."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tests.jaxdrift import requires_jax_05_numerics
 
@@ -16,6 +20,26 @@ CFG = llama.PRESETS["tiny"]
 TOKENS = np.random.default_rng(0).integers(
     0, CFG.vocab_size, size=8192, dtype=np.int32
 )
+
+#: per-WRAPPER budget: each fit() builds a fresh jitted step that may
+#: mint two executables (the first call's state is freshly device_put,
+#: later calls carry the step's own committed output shardings) —
+#: anything past 3 from one step instance is a retrace bug
+JITWATCH_BUDGET = 3
+
+
+@pytest.fixture(autouse=True)
+def _jitwatch(monkeypatch):
+    """Arm tools/jaxlint's runtime watcher for every test in this file;
+    fail the test if any wrapped step left its site over budget."""
+    from tools.jaxlint import jitwatch
+
+    monkeypatch.setenv("JAXLINT_JITWATCH", "1")
+    watch = jitwatch.install(budget=JITWATCH_BUDGET)
+    yield watch
+    over = watch.over_budget()
+    jitwatch.uninstall()
+    assert over == [], f"jitwatch: sites over compile budget: {over}"
 
 
 @requires_jax_05_numerics   # 12-step loss-descent window is numerics-tight

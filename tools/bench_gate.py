@@ -57,9 +57,11 @@ Exit 0 = within tolerance.  Usage:
     python tools/bench_gate.py --baseline CONTROLPLANE_BENCH.json \
         --run chaos_out.json --chaos-only
 
-    # static-analysis lane: assert the cplint report exists and holds
-    # zero unsuppressed errors (python -m tools.cplint --json wrote it)
-    python tools/bench_gate.py --lint-report cplint_report.json
+    # static-analysis lane: assert BOTH analyzer reports exist and hold
+    # zero unsuppressed errors (python -m tools.cplint/jaxlint --json
+    # wrote them; one report of each schema is required)
+    python tools/bench_gate.py --lint-report cplint_report.json \
+        --lint-report jaxlint_report.json
 """
 
 from __future__ import annotations
@@ -408,43 +410,57 @@ def failover_gate(run: dict) -> list[str]:
     return failures
 
 
-#: passes the lint report must PROVE ran (names in report["passes"]) —
-#: the three ISSUE 13 dataflow passes: a report written by an older
-#: cplint (or a --pass subset) silently missing them would read as
-#: clean while guarding nothing
+#: passes each lint report must PROVE ran (names in report["passes"]),
+#: keyed by report schema — the three ISSUE 13 cplint dataflow passes
+#: plus the five ISSUE 14 jaxlint passes: a report written by an older
+#: analyzer (or a --pass subset) silently missing them would read as
+#: clean while guarding nothing. LINT_REQUIRED_PASSES keeps its
+#: historical name/shape (the cplint trio) for the cplint leg.
 LINT_REQUIRED_PASSES = ("blocking-under-lock", "check-then-act",
                         "mvcc-escape")
+JAXLINT_REQUIRED_PASSES = ("host-sync-in-step", "retrace-hazard",
+                           "rng-key-reuse", "donation-after-donate",
+                           "mesh-axis-consistency")
+#: schema -> (required passes, the CLI that writes the report)
+LINT_SCHEMAS = {
+    "cplint/v1": (LINT_REQUIRED_PASSES, "python -m tools.cplint"),
+    "jaxlint/v1": (JAXLINT_REQUIRED_PASSES, "python -m tools.jaxlint"),
+}
 
 
 def lint_gate(report: dict) -> list[str]:
-    """cplint-report leg: the report must be the real cplint record and
-    carry zero unsuppressed errors — a missing or malformed report must
-    read as a failure, not as "no findings" (the same asymmetry as the
-    chaos recovery-evidence leg: absence of evidence isn't cleanliness).
-    The concurrency-dataflow passes must additionally be PRESENT in the
-    report's pass list — ran, not merely clean-by-absence — and their
-    per-pass finding counts are reported either way."""
+    """lint-report leg: the report must be a real cplint OR jaxlint
+    record and carry zero unsuppressed errors — a missing or malformed
+    report must read as a failure, not as "no findings" (the same
+    asymmetry as the chaos recovery-evidence leg: absence of evidence
+    isn't cleanliness). The schema's required passes must additionally
+    be PRESENT in the report's pass list — ran, not merely
+    clean-by-absence — and their per-pass finding counts are reported
+    either way. main() further requires the --lint-report set to cover
+    BOTH schemas, so dropping one analyzer's report from CI fails."""
     failures = []
-    if report.get("schema") != "cplint/v1":
+    schema = report.get("schema")
+    if schema not in LINT_SCHEMAS:
         failures.append(
             "lint report schema is "
-            f"{report.get('schema')!r}, want 'cplint/v1' — was this "
-            "written by python -m tools.cplint --json?"
+            f"{schema!r}, want 'cplint/v1' or 'jaxlint/v1' — was this "
+            "written by python -m tools.cplint/jaxlint --json?"
         )
         return failures
+    required, writer = LINT_SCHEMAS[schema]
     ran = {p.get("name") for p in report.get("passes") or []}
-    missing = [name for name in LINT_REQUIRED_PASSES if name not in ran]
+    missing = [name for name in required if name not in ran]
     if missing:
         failures.append(
-            f"lint report is missing pass(es) {', '.join(missing)} — "
-            "the concurrency-dataflow passes did not run (older cplint "
-            "or a --pass subset?)"
+            f"lint report ({schema}) is missing pass(es) "
+            f"{', '.join(missing)} — they did not run (older analyzer "
+            f"or a --pass subset of {writer}?)"
         )
     counts: dict[str, list[int]] = {}
     for f in report.get("findings") or []:
         row = counts.setdefault(f.get("pass"), [0, 0])
         row[1 if f.get("suppressed") else 0] += 1
-    for name in LINT_REQUIRED_PASSES:
+    for name in required:
         active, suppressed = counts.get(name, [0, 0])
         print(f"bench_gate: lint pass {name}: {active} finding(s), "
               f"{suppressed} suppressed", file=sys.stderr)
@@ -459,8 +475,8 @@ def lint_gate(report: dict) -> list[str]:
             if not f.get("suppressed")
         ][:5]
         failures.append(
-            f"cplint reported {errors} unsuppressed finding(s): "
-            + "; ".join(examples)
+            f"{schema.split('/')[0]} reported {errors} unsuppressed "
+            "finding(s): " + "; ".join(examples)
         )
     if not report.get("ok") and not failures:
         failures.append("lint report ok=false with zero errors — "
@@ -537,10 +553,13 @@ def main(argv=None) -> int:
                     help="check only the chaos invariant legs and "
                          "require all four chaos scenarios in the run "
                          "(the CI chaos smoke step)")
-    ap.add_argument("--lint-report", metavar="PATH",
-                    help="cplint JSON report to assert clean (the CI "
-                         "static-analysis step); usable alone or "
-                         "alongside the bench legs")
+    ap.add_argument("--lint-report", metavar="PATH", action="append",
+                    help="lint JSON report to assert clean (repeatable; "
+                         "the CI static-analysis step passes BOTH the "
+                         "cplint and jaxlint reports — the leg fails "
+                         "unless one report of each schema is given, so "
+                         "dropping an analyzer can't read as clean); "
+                         "usable alone or alongside the bench legs")
     ap.add_argument("--failover", action="store_true",
                     help="fail on missing/violated failover p95, dual "
                          "reconciles or orphaned keys in the ha_scale "
@@ -571,22 +590,35 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     failures = []
     if args.lint_report:
-        try:
-            with open(args.lint_report) as f:
-                lint = json.load(f)
-        except (OSError, ValueError) as e:
-            lint = None
-            failures.append(f"lint report unreadable: {e}")
-        if isinstance(lint, dict):
-            failures += lint_gate(lint)
-        elif lint is not None:
-            # parsed but not an object (list/null/string): a truncated
-            # or corrupted report must fail, not read as clean
-            failures.append(
-                "lint report is not a JSON object "
-                f"(got {type(lint).__name__}) — was this written by "
-                "python -m tools.cplint --json?"
-            )
+        schemas_seen: set = set()
+        for path in args.lint_report:
+            try:
+                with open(path) as f:
+                    lint = json.load(f)
+            except (OSError, ValueError) as e:
+                failures.append(f"lint report unreadable: {e}")
+                continue
+            if isinstance(lint, dict):
+                failures += lint_gate(lint)
+                if lint.get("schema") in LINT_SCHEMAS:
+                    schemas_seen.add(lint["schema"])
+            else:
+                # parsed but not an object (list/null/string): a
+                # truncated or corrupted report must fail, not read
+                # as clean
+                failures.append(
+                    "lint report is not a JSON object "
+                    f"(got {type(lint).__name__}) — was this written "
+                    "by python -m tools.cplint/jaxlint --json?"
+                )
+        for schema, (_, writer) in sorted(LINT_SCHEMAS.items()):
+            if schema not in schemas_seen:
+                failures.append(
+                    f"no {schema} lint report given — the "
+                    f"{schema.split('/')[0]} passes did not run "
+                    f"({writer} --json writes it; pass it as another "
+                    "--lint-report)"
+                )
     if args.run is None:
         if not args.lint_report:
             ap.error("--run is required unless --lint-report is given")
@@ -644,8 +676,8 @@ def main(argv=None) -> int:
         print(f"bench_gate FAIL: {f}", file=sys.stderr)
     if not failures:
         if args.lint_report:
-            print("bench_gate ok: cplint report clean (0 unsuppressed "
-                  "findings)", file=sys.stderr)
+            print("bench_gate ok: cplint + jaxlint reports clean "
+                  "(0 unsuppressed findings)", file=sys.stderr)
         if run is None:
             pass
         elif args.chaos_only:

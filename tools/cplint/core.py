@@ -31,12 +31,20 @@ CONTROLPLANE = (
 #: names ("— handed off, all closers run in the worker") can never be
 #: mis-read as more pass names
 _NAMES = r"[A-Za-z0-9_-]+(?:\s*,\s*[A-Za-z0-9_-]+)*"
-_DISABLE_RE = re.compile(
-    r"#\s*cplint:\s*disable=(" + _NAMES + ")"
-)
-_DISABLE_FILE_RE = re.compile(
-    r"#\s*cplint:\s*disable-file=(" + _NAMES + ")"
-)
+
+
+def suppression_res(tool: str) -> tuple:
+    """(line-disable, file-disable) regexes for one analyzer's comment
+    namespace — cplint and jaxlint share the suppression machinery but
+    read disjoint ``# <tool>: disable=`` comments, so silencing a
+    control-plane pass can never accidentally silence a numerics pass."""
+    return (
+        re.compile(r"#\s*" + tool + r":\s*disable=(" + _NAMES + ")"),
+        re.compile(r"#\s*" + tool + r":\s*disable-file=(" + _NAMES + ")"),
+    )
+
+
+_DISABLE_RE, _DISABLE_FILE_RE = suppression_res("cplint")
 
 
 @dataclasses.dataclass
@@ -81,30 +89,40 @@ class Suppressions:
         return False
 
 
-def load_suppressions(source: str) -> Suppressions:
+def load_suppressions(source: str, tool: str = "cplint") -> Suppressions:
     lines: dict = {}
     file_level: set = set()
+    # re.compile results are cached by the re module, so deriving the
+    # pair per call costs nothing and keeps ONE pattern definition
+    disable_re, disable_file_re = suppression_res(tool)
     def names_in(spec: str):
         # the regex already guarantees a comma-separated token list
         return {chunk.strip() for chunk in spec.split(",")
                 if chunk.strip()}
 
     for i, raw in enumerate(source.splitlines(), 1):
-        m = _DISABLE_RE.search(raw)
+        m = disable_re.search(raw)
         if m:
             lines.setdefault(i, set()).update(names_in(m.group(1)))
         if i <= 20:
-            fm = _DISABLE_FILE_RE.search(raw)
+            fm = disable_file_re.search(raw)
             if fm:
                 file_level.update(names_in(fm.group(1)))
     return Suppressions(lines=lines, file_level=file_level)
 
 
 class PassContext:
-    """Parsed-module cache + suppression index shared across passes."""
+    """Parsed-module cache + suppression index shared across passes.
 
-    def __init__(self, repo: pathlib.Path | None = None):
+    ``tool`` names the suppression-comment namespace this context reads
+    (``# <tool>: disable=<pass>``); jaxlint constructs the same context
+    with ``tool="jaxlint"``.
+    """
+
+    def __init__(self, repo: pathlib.Path | None = None,
+                 tool: str = "cplint"):
         self.repo = pathlib.Path(repo) if repo else REPO
+        self.tool = tool
         self._parsed: dict = {}   # path -> (tree, source) | None
         self._suppr: dict = {}    # path -> Suppressions
 
@@ -134,7 +152,7 @@ class PassContext:
                 source = path.read_text()
                 tree = ast.parse(source, filename=str(path))
                 self._parsed[key] = (tree, source)
-                self._suppr[key] = load_suppressions(source)
+                self._suppr[key] = load_suppressions(source, self.tool)
             except (OSError, SyntaxError):
                 self._parsed[key] = None
         return self._parsed[key]
@@ -169,12 +187,12 @@ def run_passes(passes, ctx: PassContext | None = None,
     return findings
 
 
-def report_dict(findings, passes) -> dict:
+def report_dict(findings, passes, schema: str = "cplint/v1") -> dict:
     """The SARIF-ish JSON record: CI uploads it ``if: always()`` and
     ``tools/bench_gate.py --lint-report`` asserts errors == 0."""
     active = [f for f in findings if not f.suppressed]
     return {
-        "schema": "cplint/v1",
+        "schema": schema,
         "ok": not active,
         "counts": {
             "errors": len(active),

@@ -1,0 +1,2 @@
+"""jaxlint: machine-checked discipline for the JAX train/inference
+stack — the numerics-side sibling of tools/cplint (docs/jaxlint.md)."""

@@ -1,0 +1,87 @@
+"""CLI: ``python -m tools.jaxlint`` — run every pass over the JAX
+packages, print findings, exit nonzero on unsuppressed errors.
+
+    python -m tools.jaxlint                       # all five passes
+    python -m tools.jaxlint --pass rng-key-reuse --pass host-sync-in-step
+    python -m tools.jaxlint --json jaxlint_report.json   # CI record
+    python -m tools.jaxlint --list-passes         # machine-readable catalog
+    python -m tools.jaxlint --mutations           # seeded-mutant validation
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from tools.cplint.core import report_dict, run_passes
+from tools.jaxlint.core import jax_context
+from tools.jaxlint.passes import ALL_PASSES
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.jaxlint",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("--pass", dest="passes", action="append",
+                    metavar="NAME",
+                    help="run only the named pass (repeatable); "
+                         "names: " + ", ".join(p.NAME for p in ALL_PASSES))
+    ap.add_argument("--list-passes", action="store_true",
+                    help="print the pass catalog as JSON to stdout and "
+                         "exit (same jaxlint-passes/v1 shape as cplint's "
+                         "catalog; CI builds --pass subsets from it)")
+    ap.add_argument("--json", dest="json_out", metavar="PATH",
+                    help="write the SARIF-ish JSON report "
+                         "(bench_gate --lint-report asserts it clean)")
+    ap.add_argument("--mutations", action="store_true",
+                    help="run the seeded-mutant validation suite: every "
+                         "hand-seeded JAX-discipline bug must be caught "
+                         "by its pass while clean HEAD stays clean "
+                         "(tools/jaxlint/mutants.py)")
+    ap.add_argument("--repo", default=None,
+                    help="repo root override (tests)")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        print(json.dumps({
+            "schema": "jaxlint-passes/v1",
+            "passes": [{"name": p.NAME, "description": p.DESCRIPTION}
+                       for p in ALL_PASSES],
+        }, indent=2))
+        return 0
+
+    if args.mutations:
+        from tools.jaxlint import mutants
+        record = mutants.run_mutations(repo=args.repo)
+        if args.json_out:
+            with open(args.json_out, "w") as f:
+                json.dump(record, f, indent=2)
+        return mutants.print_record(record)
+
+    known = {p.NAME for p in ALL_PASSES}
+    only = set(args.passes or ())
+    unknown = only - known
+    if unknown:
+        ap.error(f"unknown pass(es): {', '.join(sorted(unknown))}")
+
+    ctx = jax_context(repo=args.repo)
+    findings = run_passes(ALL_PASSES, ctx, only=only or None)
+    report = report_dict(findings, ALL_PASSES, schema="jaxlint/v1")
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2)
+    for finding in findings:
+        print(finding.format(), file=sys.stderr)
+    counts = report["counts"]
+    print(
+        f"jaxlint: {counts['errors']} finding(s), "
+        f"{counts['suppressed']} suppressed",
+        file=sys.stderr,
+    )
+    return 1 if counts["errors"] else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

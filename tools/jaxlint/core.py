@@ -1,0 +1,191 @@
+"""Shared jaxlint infrastructure: scan scope, jit-scope resolution.
+
+jaxlint rides cplint's pass architecture unchanged (tools/cplint/core:
+PassContext, Finding, suppression index) — the context is constructed
+with ``tool="jaxlint"`` so ``# jaxlint: disable=<pass>`` comments are the
+suppression surface, disjoint from cplint's. What is jaxlint-specific
+lives here: the four JAX package roots, and the **jit-scope resolver**
+every traced-context pass shares (host-sync, retrace-hazard,
+donation-after-donate all need to know "is this function's body traced
+code?" and "what is marked static / donated?").
+
+A function is *jit scope* when any of:
+
+- it carries a ``@jax.jit`` / ``@jit`` / ``@pjit`` / ``@shard_map``
+  decorator, directly or through ``functools.partial`` (the
+  ``@partial(jax.jit, static_argnames=...)`` idiom);
+- its NAME is passed to a ``jit``/``pjit``/``shard_map`` call anywhere
+  in the module (``return jax.jit(step_fn, donate_argnums=(0,))`` — the
+  make_train_step factory shape), matched conservatively by name;
+- it is lexically nested inside a jit-scope function (``loss_fn`` /
+  ``micro`` inside ``step_fn``: their bodies trace in the same call).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from tools.cplint import astutil
+from tools.cplint.core import (  # noqa: F401  (re-exports for passes)
+    Finding,
+    PassContext,
+    report_dict,
+    run_passes,
+)
+
+#: the JAX half of the tree — the ONE place the scan scope lives
+JAX_ROOTS = (
+    "service_account_auth_improvements_tpu/train",
+    "service_account_auth_improvements_tpu/parallel",
+    "service_account_auth_improvements_tpu/ops",
+    "service_account_auth_improvements_tpu/models",
+)
+
+#: the mesh builder module the mesh-axis pass reads declarations from
+MESH_MODULE = "service_account_auth_improvements_tpu/parallel/mesh.py"
+
+#: call names that enter a traced context
+JIT_WRAPPERS = frozenset({"jit", "pjit", "shard_map"})
+
+
+def jax_context(repo=None) -> PassContext:
+    """A PassContext reading ``# jaxlint: disable=`` suppressions."""
+    return PassContext(repo=repo, tool="jaxlint")
+
+
+@dataclasses.dataclass
+class JitInfo:
+    """How one function enters jit scope."""
+    fn: ast.AST                     # the FunctionDef node
+    static_names: set               # params marked static (by name)
+    donate_nums: tuple              # positional argnums donated
+    donate_names: tuple             # argnames donated
+    via: str                        # "decorator" | "wrapped" | "nested"
+
+
+def _tuple_of_ints(node) -> tuple:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, int))
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    return ()
+
+
+def _tuple_of_strs(node) -> tuple:
+    if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant)
+                     and isinstance(e.value, str))
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return ()
+
+
+def jit_call_meta(call: ast.Call) -> dict | None:
+    """{'target': name|None, 'static_names', 'static_nums',
+    'donate_nums', 'donate_names'} when ``call`` is a
+    jit/pjit/shard_map application, else None."""
+    name = astutil.call_name(call)
+    if name not in JIT_WRAPPERS:
+        return None
+    target = None
+    if call.args and isinstance(call.args[0], ast.Name):
+        target = call.args[0].id
+    meta = {"target": target, "static_names": set(), "static_nums": (),
+            "donate_nums": (), "donate_names": ()}
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            meta["static_names"] = set(_tuple_of_strs(kw.value))
+        elif kw.arg == "static_argnums":
+            meta["static_nums"] = _tuple_of_ints(kw.value)
+        elif kw.arg == "donate_argnums":
+            meta["donate_nums"] = _tuple_of_ints(kw.value)
+        elif kw.arg == "donate_argnames":
+            meta["donate_names"] = _tuple_of_strs(kw.value)
+    return meta
+
+
+def _decorator_meta(fn) -> dict | None:
+    """jit metadata from a decorator list, if any decorator is a jit
+    entry: ``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``, or a
+    direct ``@jax.jit(...)``/``@shard_map(...)`` factory call."""
+    for dec in fn.decorator_list:
+        if isinstance(dec, (ast.Name, ast.Attribute)):
+            chain = astutil.attr_chain(dec) or []
+            if chain and chain[-1] in JIT_WRAPPERS:
+                return {"target": fn.name, "static_names": set(),
+                        "static_nums": (), "donate_nums": (),
+                        "donate_names": ()}
+            continue
+        if not isinstance(dec, ast.Call):
+            continue
+        call = dec
+        if astutil.call_name(dec) == "partial" and dec.args:
+            # @partial(jax.jit, static_argnames=...): the partial's
+            # keywords ARE the jit keywords
+            chain = astutil.attr_chain(dec.args[0]) or []
+            if not (chain and chain[-1] in JIT_WRAPPERS):
+                continue
+            call = ast.Call(func=dec.args[0], args=[],
+                            keywords=dec.keywords)
+        meta = jit_call_meta(call)
+        if meta is not None:
+            meta["target"] = fn.name
+            return meta
+    return None
+
+
+def param_names(fn) -> list:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)] + \
+           [p.arg for p in a.kwonlyargs]
+
+
+def positional_params(fn) -> list:
+    a = fn.args
+    return [p.arg for p in (a.posonlyargs + a.args)]
+
+
+def jit_scopes(tree: ast.AST) -> dict:
+    """{FunctionDef node: JitInfo} for every jit-scope function in the
+    module (see module docstring for the three entry shapes)."""
+    # 1) every jit/pjit/shard_map call wrapping a plain name, module-wide
+    wrapped: dict = {}       # target fn name -> meta
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            meta = jit_call_meta(node)
+            if meta and meta["target"]:
+                wrapped[meta["target"]] = meta
+
+    scopes: dict = {}
+    for fn in astutil.iter_functions(tree):
+        meta = _decorator_meta(fn)
+        via = "decorator"
+        if meta is None and fn.name in wrapped:
+            meta = wrapped[fn.name]
+            via = "wrapped"
+        if meta is None:
+            continue
+        pos = positional_params(fn)
+        static = set(meta["static_names"])
+        for i in meta["static_nums"]:
+            if 0 <= i < len(pos):
+                static.add(pos[i])
+        scopes[fn] = JitInfo(fn=fn, static_names=static,
+                             donate_nums=meta["donate_nums"],
+                             donate_names=meta["donate_names"], via=via)
+
+    # 2) nested defs inside a jit-scope function trace in the same
+    # call (ast.walk is transitive, so nested-of-nested is covered)
+    for fn in list(scopes):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)) and \
+                    node is not fn and node not in scopes:
+                scopes[node] = JitInfo(
+                    fn=node, static_names=set(), donate_nums=(),
+                    donate_names=(), via="nested")
+    return scopes

@@ -1,0 +1,267 @@
+"""jitwatch: runtime recompile/transfer watcher for step functions.
+
+The static retrace-hazard pass catches the shapes it can prove; only
+the running program shows whether a step function ACTUALLY recompiles
+(a float32/weak-type flip, a shape wobble from a ragged tail batch, a
+config object whose __hash__ churns) — the lockwatch idea applied to
+the XLA compile cache. Two instruments, one wrapper:
+
+- **per-call-site compile counter**: ``watch.wrap(step_fn, site=...,
+  budget=N)`` counts executables minted for THAT callable —
+  primarily via the jit wrapper's own cache size (``_cache_size()``,
+  exact and per-function), falling back to the global
+  ``jax.log_compiles`` stream (``start_logs()`` hooks the ``jax``
+  logger the way ``jax.config.jax_log_compiles`` emits) when the
+  attribute is absent or broken — the stream is started AUTOMATICALLY
+  at wrap time in that case (an inert watcher passing budget asserts
+  vacuously is the failure mode this guards), and executables minted
+  DURING each wrapped call are attributed to the wrapper (in-call
+  windowing: closures around inner jits count too; concurrent
+  compiles from other threads conflate, a documented
+  over-approximation). The first compile is expected; the budget
+  bounds each WRAPPER's own executable count (a fresh ``fit()``
+  legitimately builds a fresh jit), and a call that pushes a wrapper
+  past it raises :class:`RecompileBudgetExceeded` AT the offending
+  call — the test fails pointing at the call site, not at a
+  slow-suite symptom. The site's snapshot additionally reports the
+  cumulative cross-wrapper total (``compiles``) and the worst single
+  wrapper (``wrapper_max``, what ``over_budget()`` judges) — a
+  re-jit-per-call pattern reads as ``compiles ≈ calls`` there.
+- **transfer attribution**: each wrapped call runs under
+  ``jax.transfer_guard_device_to_host("disallow")``, so an unexpected
+  device→host pull inside the step raises with the call site in the
+  traceback. On the CPU backend host==device and XLA never routes a
+  guarded transfer, so the guard is structurally quiet there — the
+  recompile counter is the CPU-testable half; the guard earns its keep
+  on real TPU runs (documented in docs/jaxlint.md).
+
+Enablement follows lockwatch: ``JAXLINT_JITWATCH=1`` turns
+:func:`maybe_wrap` from an identity function into real
+instrumentation — zero cost when off (one env read at wrap time, no
+per-call overhead), so the train loop wires it unconditionally.
+``JAXLINT_JITWATCH_BUDGET`` overrides the default per-site budget.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+
+DEFAULT_BUDGET = 3
+
+#: jax_log_compiles messages that mark one executable build; both the
+#: pxla "Compiling <name> with global shapes" line (one per executable)
+#: and older dispatch variants are matched, keyed by function name
+_COMPILE_RE = re.compile(
+    r"Compiling ([A-Za-z0-9_<>.-]+) with global shapes"
+)
+
+
+class RecompileBudgetExceeded(AssertionError):
+    """A wrapped step minted more executables than its budget."""
+
+    def __init__(self, site: str, compiles: int, budget: int):
+        self.site, self.compiles, self.budget = site, compiles, budget
+        super().__init__(
+            f"jitwatch: {site!r} compiled {compiles} executables "
+            f"(budget {budget}) — a retrace per call burns the "
+            "accelerator silently; check static args, shapes, and "
+            "weak types (docs/jaxlint.md)"
+        )
+
+
+class _LogCounter(logging.Handler):
+    """Counts compile events off the jax logger: per function name
+    (the human-readable view) and in total (the in-call attribution
+    window the wrap fallback uses)."""
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.counts: dict = {}
+        self.total = 0
+
+    def emit(self, record):
+        m = _COMPILE_RE.search(record.getMessage())
+        if m:
+            name = m.group(1)
+            self.counts[name] = self.counts.get(name, 0) + 1
+            self.total += 1
+
+
+class JitWatch:
+    """Recompile/transfer watcher; one per process when installed."""
+
+    def __init__(self, budget: int | None = None):
+        env = os.environ.get("JAXLINT_JITWATCH_BUDGET")
+        self.budget = budget if budget is not None else (
+            int(env) if env else DEFAULT_BUDGET)
+        self.sites: dict = {}     # site -> {calls, compiles, budget}
+        self._log_counter: _LogCounter | None = None
+        self._saved_log_compiles = None
+
+    # ------------------------------------------------------- wrapping
+
+    def wrap(self, fn, site: str | None = None, budget: int | None = None,
+             guard_transfers: bool = True):
+        """Instrument a jitted callable. Returns a callable with the
+        same signature that raises RecompileBudgetExceeded when the
+        site's executable count passes its budget, and (on backends
+        where host != device) fails loud on device→host transfers
+        inside the call."""
+        import jax
+
+        site = site or getattr(fn, "__name__", repr(fn))
+        limit = budget if budget is not None else self.budget
+        stats = self.sites.setdefault(
+            site, {"calls": 0, "compiles": 0, "wrapper_max": 0,
+                   "budget": limit})
+        cache_size = getattr(fn, "_cache_size", None)
+        if cache_size is not None:
+            try:
+                cache_size()     # probe NOW: a renamed/broken private
+            except Exception:    # API must fall back, not go inert
+                cache_size = None
+        if cache_size is None:
+            # the promised jax.log_compiles fallback must actually
+            # ENGAGE on this path — without it the watcher would count
+            # zero forever and every budget assert passes vacuously.
+            # Executables minted DURING each wrapped call are
+            # attributed to this wrapper (in-call windowing — no name
+            # matching, so closures around inner jits count too;
+            # concurrent compiles from OTHER threads inside the window
+            # conflate, a documented over-approximation).
+            self.start_logs()
+        # The budget bounds each WRAPPER's own executable count — a
+        # fresh fit() legitimately builds a fresh jit (its own cache),
+        # so several wrappers may share one site. The site additionally
+        # accumulates the cumulative delta across wrappers in
+        # "compiles" (reporting: total executables the site minted —
+        # a per-call re-jit pattern shows up there as compiles≈calls)
+        # and tracks the worst single wrapper in "wrapper_max" (what
+        # over_budget() judges).
+        seen = {"compiles": 0}
+
+        def wrapped(*args, **kwargs):
+            stats["calls"] += 1
+            counter = self._log_counter
+            pre = counter.total if counter is not None else 0
+            if guard_transfers:
+                with jax.transfer_guard_device_to_host("disallow"):
+                    out = fn(*args, **kwargs)
+            else:
+                out = fn(*args, **kwargs)
+            if cache_size is not None:
+                try:
+                    now = cache_size()
+                except Exception:
+                    now = seen["compiles"]
+            elif counter is not None:
+                now = seen["compiles"] + max(0, counter.total - pre)
+            else:
+                now = seen["compiles"]
+            if now > seen["compiles"]:
+                stats["compiles"] += now - seen["compiles"]
+                seen["compiles"] = now
+            if now > stats["wrapper_max"]:
+                stats["wrapper_max"] = now
+            if now > stats["budget"]:
+                raise RecompileBudgetExceeded(
+                    site, now, stats["budget"])
+            return out
+
+        wrapped.__name__ = getattr(fn, "__name__", site)
+        wrapped._jitwatch_site = site
+        return wrapped
+
+    # ------------------------------------------------- log_compiles hook
+
+    def start_logs(self) -> None:
+        """Hook ``jax.log_compiles``: flip the config flag and attach a
+        counting handler to the ``jax`` logger — the global view (and
+        the _cache_size fallback)."""
+        import jax
+
+        if self._log_counter is not None:
+            return
+        self._log_counter = _LogCounter()
+        logger = logging.getLogger("jax")
+        logger.addHandler(self._log_counter)
+        self._saved_level = logger.level
+        if logger.level > logging.WARNING or logger.level == 0:
+            logger.setLevel(logging.WARNING)
+        self._saved_log_compiles = jax.config.jax_log_compiles
+        jax.config.update("jax_log_compiles", True)
+
+    def stop_logs(self) -> None:
+        import jax
+
+        if self._log_counter is None:
+            return
+        logger = logging.getLogger("jax")
+        logger.removeHandler(self._log_counter)
+        logger.setLevel(self._saved_level)
+        jax.config.update("jax_log_compiles",
+                          bool(self._saved_log_compiles))
+        self._log_counter = None
+
+    def compile_counts(self) -> dict:
+        """{function name: compile events} from the log stream (the
+        human-readable view; the wrap fallback windows the TOTAL)."""
+        return dict(self._log_counter.counts) if self._log_counter else {}
+
+    # -------------------------------------------------------- reporting
+
+    def snapshot(self) -> dict:
+        return {site: dict(st) for site, st in self.sites.items()}
+
+    def over_budget(self) -> list:
+        """Sites where some single wrapper out-compiled its budget
+        (the per-wrapper semantics the raise enforces; "compiles" in
+        the snapshot is the cumulative cross-wrapper total)."""
+        return [site for site, st in self.sites.items()
+                if st["wrapper_max"] > st["budget"]]
+
+
+# --------------------------------------------------------- installation
+
+_GLOBAL: JitWatch | None = None
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("JAXLINT_JITWATCH"))
+
+
+def active() -> JitWatch | None:
+    return _GLOBAL
+
+
+def install(budget: int | None = None) -> JitWatch:
+    """Create (or return) the process-global watch. Idempotent — but an
+    EXPLICIT budget always takes effect for subsequent wraps, even when
+    a watch already exists (an earlier maybe_wrap may have created it
+    with the default; silently keeping that would enforce a budget the
+    caller never asked for). Sites already wrapped keep the budget they
+    were wrapped with."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = JitWatch(budget=budget)
+    elif budget is not None:
+        _GLOBAL.budget = budget
+    return _GLOBAL
+
+
+def uninstall() -> None:
+    global _GLOBAL
+    if _GLOBAL is not None:
+        _GLOBAL.stop_logs()
+    _GLOBAL = None
+
+
+def maybe_wrap(fn, site: str, budget: int | None = None):
+    """The production seam (train/loop.py): identity when
+    JAXLINT_JITWATCH is unset — one env read at wrap time, zero
+    per-call cost — else wrap under the global watch."""
+    if not enabled():
+        return fn
+    return install().wrap(fn, site=site, budget=budget)

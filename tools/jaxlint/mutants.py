@@ -1,0 +1,230 @@
+"""Mutation validation: a lint that can't re-find seeded bugs guards
+nothing (the schedsim discipline applied to jaxlint).
+
+Each mutant is ONE hand-seeded JAX-discipline bug — a textual patch
+against a REAL file in the scan scope (``old`` must match exactly once,
+so tree drift fails loud instead of silently testing nothing) — paired
+with the pass expected to catch it. The runner copies the scanned
+packages into a scratch repo, applies one mutant at a time, runs the
+expected pass, and requires (a) at least one unsuppressed finding from
+that pass in the mutated file, and (b) the un-mutated tree clean. The
+whole suite is deterministic: no sampling, no seeds — AST analysis
+either proves the property or it doesn't, so "caught" here is a
+stable CI gate, not a probabilistic budget.
+
+    python -m tools.jaxlint --mutations [--json record.json]
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import shutil
+import sys
+import tempfile
+
+from tools.cplint.core import run_passes
+from tools.jaxlint.core import JAX_ROOTS, jax_context
+from tools.jaxlint.passes import ALL_PASSES
+
+_TRAIN = "service_account_auth_improvements_tpu/train"
+_PARALLEL = "service_account_auth_improvements_tpu/parallel"
+_MODELS = "service_account_auth_improvements_tpu/models"
+
+
+@dataclasses.dataclass(frozen=True)
+class Mutant:
+    name: str
+    path: str          # repo-relative file the bug is seeded into
+    old: str           # exact source snippet (must match exactly once)
+    new: str           # the seeded bug
+    expect: str        # pass NAME expected to catch it
+
+
+#: the seeded-bug matrix — every entry must be CAUGHT by its pass.
+#: Mutants are lint-only (never executed), so a patch may be
+#: semantically silly as long as it is the SHAPE of the bug family.
+MUTANTS = (
+    # 1. the canonical stall: a per-step float() of the loss in the
+    # train loop, ungated by any logging cadence
+    Mutant(
+        name="per_step_float_loss",
+        path=f"{_TRAIN}/loop.py",
+        old="state, metrics = step_fn(state, batch, mask)",
+        new="state, metrics = step_fn(state, batch, mask)\n"
+            "            loss_now = float(metrics[\"loss\"])",
+        expect="host-sync-in-step",
+    ),
+    # 2. a sync INSIDE the jitted step function itself
+    Mutant(
+        name="float_in_jitted_step",
+        path=f"{_TRAIN}/step.py",
+        old="        gnorm = optax.global_norm(grads)",
+        new="        gnorm = float(optax.global_norm(grads))",
+        expect="host-sync-in-step",
+    ),
+    # 3. reused sampling key: the rejection-threshold draw re-consumes
+    # the round key that the later correction split consumes again
+    Mutant(
+        name="reused_round_key",
+        path=f"{_MODELS}/speculative.py",
+        old="        u = jax.random.uniform(ukey, (gamma,))",
+        new="        u = jax.random.uniform(key, (gamma,))",
+        expect="rng-key-reuse",
+    ),
+    # 4. loop-carried key: every LoRA target initialized from the SAME
+    # key (split-per-target dropped)
+    Mutant(
+        name="loop_carried_lora_key",
+        path=f"{_TRAIN}/lora.py",
+        old="        key, ka = jax.random.split(key)",
+        new="        ka = jax.random.split(key)[0]",
+        expect="rng-key-reuse",
+    ),
+    # 5. donated-then-read params: the train loop keeps a reference to
+    # the state it just donated to the step
+    Mutant(
+        name="donated_state_read",
+        path=f"{_TRAIN}/loop.py",
+        old="            state, metrics = step_fn(state, batch, mask)",
+        new="            new_state, metrics = step_fn(state, batch, mask)\n"
+            "            stale_params = state.params\n"
+            "            state = new_state",
+        expect="donation-after-donate",
+    ),
+    # 6. typo'd axis in a PartitionSpec: the batch sharding silently
+    # replicates instead of splitting over fsdp
+    Mutant(
+        name="typo_axis_partitionspec",
+        path=f"{_TRAIN}/data.py",
+        old="P((\"dp\", \"fsdp\"), None)",
+        new="P((\"dp\", \"fsdpp\"), None)",
+        expect="mesh-axis-consistency",
+    ),
+    # 7. typo'd axis in a collective default: ring attention permutes
+    # over an axis no mesh declares
+    Mutant(
+        name="typo_axis_collective_default",
+        path=f"{_PARALLEL}/ring.py",
+        old="def ring_attention_local(q, k, v, *, axis_name: str = \"sp\",",
+        new="def ring_attention_local(q, k, v, *, axis_name: str = \"spp\",",
+        expect="mesh-axis-consistency",
+    ),
+    # 8. unhashable static arg: a mutable default on a static_argnames
+    # parameter — TypeError on first call, per-instance retrace for
+    # object defaults
+    Mutant(
+        name="unhashable_static_arg",
+        path=f"{_MODELS}/generate.py",
+        old="def _sample_jit(logits, key, temperature, top_p, *, top_k, "
+            "greedy,\n                use_top_p):",
+        new="def _sample_jit(logits, key, temperature, top_p, *, top_k, "
+            "greedy,\n                use_top_p=[]):",
+        expect="retrace-hazard",
+    ),
+    # 9. Python branch on a traced value inside the jitted step
+    Mutant(
+        name="python_if_on_traced",
+        path=f"{_TRAIN}/step.py",
+        old="        if grad_accum == 1:",
+        new="        if tokens[0, 0] == 0 or grad_accum == 1:",
+        expect="retrace-hazard",
+    ),
+    # 10. double-consumed stream key: the first-token sample reuses the
+    # stream key that the decode split consumes again
+    Mutant(
+        name="reused_stream_key",
+        path=f"{_MODELS}/generate.py",
+        old="    first = _sample_jit(logits, first_key, t, p, top_k=k_, "
+            "greedy=greedy,",
+        new="    first = _sample_jit(logits, key, t, p, top_k=k_, "
+            "greedy=greedy,",
+        expect="rng-key-reuse",
+    ),
+)
+
+
+def _pass_by_name(name: str):
+    for p in ALL_PASSES:
+        if p.NAME == name:
+            return p
+    raise KeyError(name)
+
+
+def _copy_scope(src_repo: pathlib.Path, dst_repo: pathlib.Path) -> None:
+    for root in JAX_ROOTS:
+        shutil.copytree(src_repo / root, dst_repo / root)
+
+
+def run_mutations(repo=None) -> dict:
+    """Apply each mutant to a scratch copy of the scan scope; the
+    expected pass must flag the mutated file. Returns the JSON record
+    (schema jaxlint-mutants/v1)."""
+    src = pathlib.Path(repo) if repo else \
+        pathlib.Path(__file__).resolve().parent.parent.parent
+
+    # clean-HEAD gate first: a dirty baseline would let any mutant
+    # "pass" on pre-existing noise
+    base_ctx = jax_context(repo=src)
+    baseline = [f for f in run_passes(ALL_PASSES, base_ctx)
+                if not f.suppressed]
+
+    results = []
+    for m in MUTANTS:
+        scratch = pathlib.Path(tempfile.mkdtemp(prefix="jaxlint_mut_"))
+        try:
+            _copy_scope(src, scratch)
+            target = scratch / m.path
+            text = target.read_text()
+            occurrences = text.count(m.old)
+            if occurrences != 1:
+                results.append({
+                    "name": m.name, "pass": m.expect, "caught": False,
+                    "error": f"patch anchor matched {occurrences} times "
+                             f"in {m.path} (want exactly 1) — tree "
+                             "drifted; update the mutant",
+                })
+                continue
+            target.write_text(text.replace(m.old, m.new))
+            ctx = jax_context(repo=scratch)
+            findings = [
+                f for f in _pass_by_name(m.expect).run(ctx)
+                if not f.suppressed and f.path == m.path
+            ]
+            results.append({
+                "name": m.name, "pass": m.expect,
+                "caught": bool(findings),
+                "findings": [f.to_dict() for f in findings[:3]],
+            })
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+    return {
+        "schema": "jaxlint-mutants/v1",
+        "clean_head_findings": [f.to_dict() for f in baseline],
+        "clean_head_ok": not baseline,
+        "mutants": results,
+        "caught": sum(1 for r in results if r["caught"]),
+        "total": len(results),
+        "ok": not baseline and all(r["caught"] for r in results),
+    }
+
+
+def print_record(record: dict) -> int:
+    """Human summary to stderr; exit status for the CLI."""
+    if not record["clean_head_ok"]:
+        print("jaxlint mutations: clean HEAD is NOT clean — fix or "
+              "suppress baseline findings first:", file=sys.stderr)
+        for f in record["clean_head_findings"][:10]:
+            print(f"  {f['path']}:{f['line']} [{f['pass']}] "
+                  f"{f['message']}", file=sys.stderr)
+    for r in record["mutants"]:
+        status = "caught" if r["caught"] else "NOT CAUGHT"
+        extra = f" — {r['error']}" if r.get("error") else ""
+        print(f"jaxlint mutations: {r['name']} [{r['pass']}] "
+              f"{status}{extra}", file=sys.stderr)
+    print(f"jaxlint mutations: {record['caught']}/{record['total']} "
+          f"caught, clean head "
+          f"{'ok' if record['clean_head_ok'] else 'DIRTY'}",
+          file=sys.stderr)
+    return 0 if record["ok"] else 1

@@ -1,0 +1,18 @@
+"""Pass registry: one module per pass, each exposing NAME / DESCRIPTION
+/ run(ctx) — the cplint shape, over the JAX scan scope."""
+
+from tools.jaxlint.passes import (
+    donation,
+    host_sync,
+    mesh_axes,
+    retrace_hazard,
+    rng_reuse,
+)
+
+ALL_PASSES = (
+    host_sync,
+    retrace_hazard,
+    rng_reuse,
+    donation,
+    mesh_axes,
+)

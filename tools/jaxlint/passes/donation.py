@@ -1,0 +1,205 @@
+"""donation-after-donate: a donated buffer read after the donating call.
+
+``donate_argnums`` hands the argument's HBM buffers to XLA for in-place
+reuse — the standard train-state idiom (``state, m = step(state, ...)``
+re-binds the name, so the dead buffer is never touched). Reading the
+OLD value after the donating call dereferences a deleted buffer:
+``RuntimeError: Array has been deleted`` on TPU, and silently-working
+garbage on backends where donation is a no-op (CPU) — the worst kind of
+portability bug.
+
+Two sweeps:
+
+- **registry** (whole scan scope, cross-module by name): which
+  callables donate which argument positions/names — direct
+  ``jax.jit(f, donate_argnums=...)`` bindings, ``@partial(jax.jit,
+  donate_argnums=...)`` decorations, and FACTORY functions whose
+  ``return jax.jit(..., donate_argnums=...)`` hands back a donating
+  callable (``make_train_step`` → every ``step_fn =
+  make_train_step(...)`` call site donates).
+- **check** (per function, flow-ordered): at a call through a donating
+  callable, positional args that are plain names become donated-dead —
+  unless the same statement re-binds them (the sanctioned idiom). Any
+  later read of a dead name is the finding; re-binding revives it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.jaxlint.core import (
+    JAX_ROOTS,
+    jit_call_meta,
+    jit_scopes,
+    positional_params,
+)
+
+NAME = "donation-after-donate"
+DESCRIPTION = (
+    "an argument donated via donate_argnums/donate_argnames read after "
+    "the donating call in the same scope"
+)
+
+
+def _donation_of(call: ast.Call):
+    """(donate_nums, donate_names) when call is a donating jit."""
+    meta = jit_call_meta(call)
+    if meta and (meta["donate_nums"] or meta["donate_names"]):
+        return meta["donate_nums"], meta["donate_names"], meta["target"]
+    return None
+
+
+def _build_registry(ctx) -> dict:
+    """{callable name: set of donated positional indices} across the
+    scan scope. donate_argnames resolve to positions via the wrapped
+    function's signature when it is defined in the same module."""
+    registry: dict = {}
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        fns = {f.name: f for f in astutil.iter_functions(tree)}
+
+        def positions(nums, names, target):
+            pos = set(nums)
+            if names and target and target in fns:
+                params = positional_params(fns[target])
+                pos |= {params.index(n) for n in names if n in params}
+            return pos
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                d = _donation_of(node)
+                if d is None:
+                    continue
+                nums, names, target = d
+                pos = positions(nums, names, target)
+                if pos and target:
+                    # jax.jit(step_fn, donate_argnums=...): calls
+                    # through the wrapped NAME donate
+                    registry.setdefault(target + "@jit",
+                                        set()).update(pos)
+        # factories: a function whose return IS a donating jit hands
+        # back a donating callable (the make_train_step shape)
+        for fn in fns.values():
+            for node in astutil.walk_no_nested_functions(fn):
+                if isinstance(node, ast.Return) and \
+                        isinstance(node.value, ast.Call):
+                    d = _donation_of(node.value)
+                    if d:
+                        nums, names, target = d
+                        pos = positions(nums, names, target)
+                        if pos:
+                            registry.setdefault(fn.name, set()).update(pos)
+        # decorated functions donate when CALLED by name
+        for fn, info in jit_scopes(tree).items():
+            pos = set(info.donate_nums)
+            if info.donate_names:
+                params = positional_params(fn)
+                pos |= {params.index(n) for n in info.donate_names
+                        if n in params}
+            if pos:
+                registry.setdefault(fn.name + "@jit", set()).update(pos)
+    return registry
+
+
+def run(ctx) -> list:
+    registry = _build_registry(ctx)
+    findings = []
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_check_fn(ctx, path, fn, registry))
+    return findings
+
+
+def _assigned_names(targets) -> set:
+    names: set = set()
+    for tgt in targets:
+        if isinstance(tgt, ast.Name):
+            names.add(tgt.id)
+        else:
+            names.update(e.id for e in getattr(tgt, "elts", [])
+                         if isinstance(e, ast.Name))
+    return names
+
+
+def _check_fn(ctx, path, fn, registry) -> list:
+    findings = []
+    #: local var -> donated position set (a donating callable binding)
+    donating: dict = {}
+    #: var name -> (line donated at, callee) for donated-dead values
+    dead: dict = {}
+
+    def donated_positions(call: ast.Call):
+        name = astutil.call_name(call)
+        if name is None:
+            return None
+        if isinstance(call.func, ast.Name) and name in donating:
+            return donating[name]
+        if name + "@jit" in registry:
+            return registry[name + "@jit"]
+        return None
+
+    def mark_dead(call, bound: set, stmt) -> None:
+        pos = donated_positions(call)
+        if not pos:
+            return
+        callee = astutil.call_name(call)
+        end = getattr(stmt, "end_lineno", None) or stmt.lineno
+        for i in pos:
+            if i < len(call.args) and \
+                    isinstance(call.args[i], ast.Name):
+                var = call.args[i].id
+                if var not in bound:   # same-stmt re-binding revives
+                    dead[var] = (stmt.lineno, end, callee)
+
+    nodes = [n for n in astutil.walk_no_nested_functions(fn)
+             if hasattr(n, "lineno")]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+    seen_calls: set = set()    # Call nodes handled via their Assign
+    for node in nodes:
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and node.id in dead:
+            line, end, callee = dead[node.id]
+            if node.lineno <= end:
+                continue   # part of the donating statement itself
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"{node.id!r} was donated to {callee!r} at line {line} "
+                "and is read here — its buffers belong to XLA now "
+                "(Array-deleted error on TPU, silent garbage where "
+                "donation is a no-op); re-bind the result or drop "
+                "the donation",
+            ))
+            del dead[node.id]     # one report per donation site
+            continue
+        if isinstance(node, ast.Assign):
+            # donating-callable binding: step = make_train_step(...)
+            if isinstance(node.value, ast.Call):
+                cal = astutil.call_name(node.value)
+                if cal in registry:
+                    for n in _assigned_names(node.targets):
+                        donating[n] = registry[cal]
+                d = _donation_of(node.value)
+                if d and d[0]:
+                    for n in _assigned_names(node.targets):
+                        donating[n] = set(d[0])
+            bound = _assigned_names(node.targets)
+            for call in ast.walk(node.value):
+                if isinstance(call, ast.Call):
+                    seen_calls.add(id(call))
+                    mark_dead(call, bound, node)
+            # plain re-binding revives donated-dead names
+            for n in bound:
+                dead.pop(n, None)
+                if not isinstance(node.value, ast.Call):
+                    donating.pop(n, None)
+        elif isinstance(node, ast.Call) and id(node) not in seen_calls:
+            mark_dead(node, set(), node)
+    return findings

@@ -1,0 +1,222 @@
+"""host-sync-in-step: device→host synchronization in traced or per-step
+code.
+
+A single ``float(loss)`` inside the hot path serializes the TPU: the
+host blocks until the step's whole computation flushes, the device then
+idles until the host re-dispatches — the exact stall Podracer-style
+throughput engineering exists to avoid (arXiv:2104.06272). Two scopes
+are checked:
+
+- **jit scope** (``tools/jaxlint/core.jit_scopes``): ``float()`` /
+  ``int()`` / ``bool()`` / ``.item()`` / ``.tolist()`` /
+  ``np.asarray()`` / ``np.array()`` / ``jax.device_get()`` /
+  ``.block_until_ready()`` / ``print()`` applied to traced values
+  inside a jit/pjit/shard_map-traced function. These either force a
+  sync per call or fail under trace; ``jax.debug.print`` /
+  ``jax.debug.callback`` are the sanctioned shapes and stay silent.
+  Shape/dtype reads (``x.shape``/``x.ndim``/``len(x)``) are static at
+  trace time and exempt.
+
+- **step path**: a function that calls a step function (callable whose
+  name contains ``step``) and host-syncs a value derived from its
+  result. Cadence-gated sites (inside an ``if`` whose test contains a
+  ``%`` — the ``(i + 1) % log_every == 0`` logging idiom) are
+  loop-BOUNDARY logging and exempt; a sync executed per iteration (or
+  per call of a loop-less helper invoked from the batch loop) is the
+  finding. Syncs after the loop ends (final metrics, checkpoint step
+  stamps) are loop-boundary by construction and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.jaxlint.core import JAX_ROOTS, jit_scopes, param_names
+
+NAME = "host-sync-in-step"
+DESCRIPTION = (
+    "device-to-host sync (float/int/bool/.item/np.asarray/print/"
+    "block_until_ready) inside a traced function or the per-step path"
+)
+
+#: builtins whose call on a device value forces a sync
+SYNC_BUILTINS = frozenset({"float", "int", "bool"})
+#: method calls that force a sync
+SYNC_METHODS = frozenset({"item", "tolist", "block_until_ready"})
+#: numpy-namespace converters (receiver np/numpy/onp)
+NP_CONVERTERS = frozenset({"asarray", "array"})
+NP_NAMES = frozenset({"np", "numpy", "onp"})
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        scopes = jit_scopes(tree)
+        for fn in scopes:
+            findings.extend(_check_jit_fn(ctx, path, fn, scopes[fn]))
+        for fn in astutil.iter_functions(tree):
+            if fn not in scopes:
+                findings.extend(_check_step_path(ctx, path, fn))
+    return findings
+
+
+# --------------------------------------------------------- jit scope
+
+def _is_static_read(expr: ast.AST) -> bool:
+    """shape/dtype/ndim/size reads and len() are static at trace time."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in (
+                "shape", "ndim", "dtype", "size"):
+            return True
+        if isinstance(node, ast.Call) and \
+                astutil.call_name(node) == "len":
+            return True
+    return False
+
+
+def _sync_call(node: ast.Call) -> str | None:
+    """Describe the sync a call performs, or None."""
+    name = astutil.call_name(node)
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        if name in SYNC_BUILTINS:
+            if node.args and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_static_read(node.args[0]):
+                return f"{name}() on a traced value"
+            return None
+        if name == "print":
+            if any(not isinstance(a, ast.Constant) for a in node.args):
+                return "print() of traced values (use jax.debug.print)"
+            return None
+        return None
+    if isinstance(fn, ast.Attribute):
+        chain = astutil.attr_chain(fn) or []
+        if chain[:2] == ["jax", "debug"]:
+            return None          # jax.debug.print/callback: sanctioned
+        if name in SYNC_METHODS:
+            # covers both x.block_until_ready() and the module-level
+            # jax.block_until_ready(x) spelling
+            return f".{name}()"
+        if name == "device_get" and chain[:1] == ["jax"]:
+            return "jax.device_get()"
+        if name in NP_CONVERTERS and chain[0] in NP_NAMES:
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                return f"{chain[0]}.{name}() on a traced value"
+    return None
+
+
+def _check_jit_fn(ctx, path, fn, info) -> list:
+    findings = []
+    for node in astutil.walk_no_nested_functions(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        how = _sync_call(node)
+        if how:
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"{how} inside jit-scope function {fn.name!r} — forces "
+                "a device-to-host sync (or fails) under trace; keep "
+                "values on device and sync at the loop boundary",
+            ))
+    return findings
+
+
+# --------------------------------------------------------- step path
+
+def _is_step_call(node: ast.Call) -> bool:
+    name = astutil.call_name(node)
+    return bool(name) and "step" in name
+
+
+def _cadence_gated(node: ast.AST, parents: dict) -> bool:
+    """True when any enclosing ``if``'s test contains a ``%`` — the
+    ``(i + 1) % every == 0`` logging/checkpoint cadence idiom."""
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, ast.If):
+            for sub in ast.walk(cur.test):
+                if isinstance(sub, ast.BinOp) and \
+                        isinstance(sub.op, ast.Mod):
+                    return True
+        cur = parents.get(id(cur))
+    return False
+
+
+def _enclosing_loop(node: ast.AST, parents: dict):
+    cur = parents.get(id(node))
+    while cur is not None:
+        if isinstance(cur, (ast.For, ast.While)):
+            return cur
+        cur = parents.get(id(cur))
+    return None
+
+
+def _check_step_path(ctx, path, fn) -> list:
+    # 1) names carrying step results (state, metrics, s, n, ...)
+    derived: set = set()
+    nodes = [n for n in astutil.walk_no_nested_functions(fn)]
+    parents: dict = {}
+    for parent in nodes:
+        for child in ast.iter_child_nodes(parent):
+            parents[id(child)] = parent
+
+    has_step_call = False
+    for node in nodes:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_step_call(node.value):
+            has_step_call = True
+            for tgt in node.targets:
+                for elt in ([tgt] if isinstance(tgt, ast.Name)
+                            else getattr(tgt, "elts", [])):
+                    if isinstance(elt, ast.Name):
+                        derived.add(elt.id)
+        elif isinstance(node, ast.Call) and _is_step_call(node):
+            has_step_call = True
+    if not has_step_call or not derived:
+        return []
+
+    # one propagation sweep: x = f(derived) keeps x derived
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            reads = {n.id for n in ast.walk(node.value)
+                     if isinstance(n, ast.Name)}
+            if reads & derived:
+                for tgt in node.targets:
+                    for elt in ([tgt] if isinstance(tgt, ast.Name)
+                                else getattr(tgt, "elts", [])):
+                        if isinstance(elt, ast.Name):
+                            derived.add(elt.id)
+
+    fn_has_loop = any(isinstance(n, (ast.For, ast.While)) for n in nodes)
+
+    findings = []
+    for node in nodes:
+        if not isinstance(node, ast.Call):
+            continue
+        how = _sync_call(node)
+        if how is None:
+            continue
+        reads = {n.id for n in ast.walk(node)
+                 if isinstance(n, ast.Name)}
+        if not (reads & derived):
+            continue
+        in_loop = _enclosing_loop(node, parents) is not None
+        if in_loop:
+            if _cadence_gated(node, parents):
+                continue       # loop-boundary logging cadence
+        elif fn_has_loop:
+            continue           # after/before the loop: boundary sync
+        findings.append(ctx.finding(
+            NAME, path, node.lineno,
+            f"{how} on a step result in the per-step path of "
+            f"{fn.name!r} — blocks the host every iteration; move the "
+            "sync to a cadence-gated loop boundary or keep the "
+            "accumulator on device",
+        ))
+    return findings

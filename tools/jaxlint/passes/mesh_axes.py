@@ -1,0 +1,182 @@
+"""mesh-axis-consistency: axis-name strings validated against the mesh.
+
+A typo'd axis name doesn't error — ``psum(x, "dpp")`` over an axis the
+mesh never declared fails at run time deep in lowering, and a
+``PartitionSpec`` naming a ghost axis silently REPLICATES the tensor:
+the SPMD collective you wrote becomes a full gather plus redundant
+compute on every chip, visible only as a throughput cliff.
+
+Two-way diff (the cplint rbac-check shape):
+
+- **declared**: the axis tuple the repo's mesh builders actually build
+  from — ``MESH_AXES`` in ``parallel/mesh.py`` (plus any literal
+  ``Mesh(..., ("a", "b"))`` axis tuples there);
+- **used**: every axis-name string literal at a spec/collective site
+  across the scan scope — ``PartitionSpec``/``P`` arguments (nested
+  tuples included), ``axis_name=``/``axis_names=`` keyword values AND
+  parameter defaults (also ``batch_axes``/``head_axis``/
+  ``kv_head_axis`` defaults, the sp-attention wrapper convention),
+  positional axis arguments of the collective family
+  (``psum``/``pmean``/``ppermute``/``all_gather``/``all_to_all``/
+  ``axis_index``/``axis_size``...), and the mesh-axis VALUES of logical
+  sharding rule tables (``DEFAULT_RULES``-shaped dicts mapping logical
+  names to mesh axes);
+- **unknown** axis → finding at the use site; **declared-but-never-
+  used** axis → finding at the declaration (dead parallelism dimension:
+  either the mesh wastes a factor of the chip count or code stopped
+  exercising it — both worth a human look).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.jaxlint.core import JAX_ROOTS, MESH_MODULE
+
+NAME = "mesh-axis-consistency"
+DESCRIPTION = (
+    "axis names at PartitionSpec/shard_map/collective sites diffed "
+    "both ways against the axes the mesh builders declare"
+)
+
+#: spec constructors whose string args are mesh axis names
+SPEC_CTORS = frozenset({"PartitionSpec", "P"})
+#: collectives whose axis argument is positional arg 1
+COLLECTIVES_ARG1 = frozenset({
+    "psum", "pmean", "pmax", "pmin", "ppermute", "all_gather",
+    "all_to_all", "psum_scatter", "pswapaxes",
+})
+#: collectives whose axis argument is positional arg 0
+COLLECTIVES_ARG0 = frozenset({"axis_index", "axis_size"})
+#: keyword names that carry axis names wherever they appear
+AXIS_KWARGS = frozenset({"axis_name", "axis_names", "batch_axes",
+                         "head_axis", "kv_head_axis"})
+#: rule-table names whose dict VALUES are mesh axes
+RULE_TABLES = frozenset({"DEFAULT_RULES"})
+
+
+def _strings_in(node) -> list:
+    """(value, lineno) for every string constant in a literal
+    str/tuple/list/set expression."""
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            out.append((sub.value, sub.lineno))
+    return out
+
+
+def declared_axes(ctx) -> tuple:
+    """(axes set, decl_path, decl_line) from the mesh module."""
+    path = ctx.repo / MESH_MODULE
+    parsed = ctx.parse(path)
+    if parsed is None:
+        return set(), path, 1
+    tree, _ = parsed
+    axes: set = set()
+    line = 1
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == "MESH_AXES":
+                axes.update(v for v, _ in _strings_in(value))
+                line = node.lineno
+    return axes, path, line
+
+
+def run(ctx) -> list:
+    axes, decl_path, decl_line = declared_axes(ctx)
+    findings = []
+    if not axes:
+        findings.append(ctx.finding(
+            NAME, decl_path, decl_line,
+            "could not resolve MESH_AXES from the mesh module — the "
+            "axis diff has nothing to validate against",
+        ))
+        return findings
+
+    used: dict = {}   # axis -> first use (path, line)
+
+    def check(value: str, path, line) -> None:
+        used.setdefault(value, (path, line))
+        if value not in axes:
+            findings.append(ctx.finding(
+                NAME, path, line,
+                f"axis name {value!r} is not declared by the mesh "
+                f"builders (MESH_AXES = {tuple(sorted(axes))}) — a "
+                "PartitionSpec over it silently replicates; a "
+                "collective over it fails at run time",
+            ))
+
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                name = astutil.call_name(node)
+                if name in SPEC_CTORS:
+                    for v, ln in _strings_in_args(node.args):
+                        check(v, path, ln)
+                elif name in COLLECTIVES_ARG1 and len(node.args) >= 2:
+                    for v, ln in _strings_in(node.args[1]):
+                        check(v, path, ln)
+                elif name in COLLECTIVES_ARG0 and len(node.args) >= 1:
+                    for v, ln in _strings_in(node.args[0]):
+                        check(v, path, ln)
+                for kw in node.keywords:
+                    if kw.arg in AXIS_KWARGS:
+                        for v, ln in _strings_in(kw.value):
+                            check(v, path, ln)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                for pname, default in _default_pairs(node):
+                    if pname in AXIS_KWARGS and default is not None:
+                        for v, ln in _strings_in(default):
+                            check(v, path, ln)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for tgt in targets:
+                    if isinstance(tgt, ast.Name) and \
+                            tgt.id in RULE_TABLES and \
+                            isinstance(node.value, ast.Dict):
+                        # keys are LOGICAL names; the VALUES are mesh
+                        # axes (str / tuple-of-str / None)
+                        for val in node.value.values:
+                            for v, ln in _strings_in(val):
+                                check(v, path, ln)
+
+    for axis in sorted(axes - set(used)):
+        findings.append(ctx.finding(
+            NAME, decl_path, decl_line,
+            f"mesh axis {axis!r} is declared in MESH_AXES but never "
+            "referenced by any spec, collective, or sharding rule — a "
+            "dead parallelism dimension",
+        ))
+    return findings
+
+
+def _strings_in_args(args) -> list:
+    out = []
+    for a in args:
+        out.extend(_strings_in(a))
+    return out
+
+
+def _default_pairs(fn):
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        yield p.arg, d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        yield p.arg, d

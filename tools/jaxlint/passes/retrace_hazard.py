@@ -1,0 +1,259 @@
+"""retrace-hazard: shapes that retrace (or fail) a jitted function.
+
+A retrace storm burns TPU time silently: the step runs, just 100×
+slower, recompiling every call. The statically-catchable shapes, all
+checked inside jit-scope functions (``tools/jaxlint/core.jit_scopes``):
+
+- **unhashable static arg**: a parameter marked static via
+  ``static_argnums``/``static_argnames`` whose default is a mutable
+  literal (``[]``/``{}``/``set()``...) — jit hashes static args for the
+  cache key, so the first call raises ``TypeError: unhashable``; a
+  custom ``__eq__``-less object retraces per instance.
+- **Python control flow on traced values**: ``if``/``while`` whose test
+  reads a traced parameter (or a value derived from one) — under trace
+  this raises ``TracerBoolConversionError`` or, with shape-polymorphic
+  revisions, silently forks the trace. ``x is None`` / ``x is not
+  None`` identity tests are Python-level structure checks and exempt;
+  ``.shape``/``.dtype``/``len()`` derivations are static and exempt
+  (flow-sensitive taint, the mvcc-escape alias-tracking style).
+- **f-string/format of a tracer**: ``f"{loss}"`` / ``"".format(loss)``
+  materializes ``Traced<...>`` junk at trace time (once), not the
+  value — almost always a logging bug that also hides a future sync.
+- **closure over a mutable module global**: a jit-scope function
+  reading a module-level name bound to a ``dict``/``list``/``set``
+  literal — the closure value is baked at FIRST trace; later mutations
+  are silently ignored (or force callers into manual cache-busting).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint import astutil
+from tools.jaxlint.core import (
+    JAX_ROOTS,
+    jit_scopes,
+    param_names,
+)
+
+NAME = "retrace-hazard"
+DESCRIPTION = (
+    "jit retrace/trace-failure hazards: unhashable static args, Python "
+    "control flow or string-formatting on traced values, closure over "
+    "mutable module globals"
+)
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                     ast.DictComp, ast.SetComp)
+_MUTABLE_CTORS = frozenset({"list", "dict", "set", "bytearray",
+                            "defaultdict", "OrderedDict", "deque"})
+
+
+def _is_mutable_value(node) -> bool:
+    if isinstance(node, _MUTABLE_LITERALS):
+        return True
+    return isinstance(node, ast.Call) and \
+        astutil.call_name(node) in _MUTABLE_CTORS
+
+
+def _mutable_globals(tree) -> dict:
+    """{name: lineno} of module-level names bound to mutable values."""
+    out: dict = {}
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not _is_mutable_value(value):
+            continue
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                out[tgt.id] = node.lineno
+    return out
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        mut_globals = _mutable_globals(tree)
+        scopes = jit_scopes(tree)
+        for fn, info in scopes.items():
+            findings.extend(
+                _check_fn(ctx, path, fn, info, mut_globals))
+    return findings
+
+
+def _default_pairs(fn):
+    """(param_name, default_node) pairs, positional and kw-only."""
+    a = fn.args
+    pos = a.posonlyargs + a.args
+    for p, d in zip(pos[len(pos) - len(a.defaults):], a.defaults):
+        yield p.arg, d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        if d is not None:
+            yield p.arg, d
+
+
+class _Taint:
+    """Traced-value taint over local names, flow-ordered."""
+
+    def __init__(self, fn, info):
+        self.tainted: set = set()
+        for p in param_names(fn):
+            if p not in info.static_names and p != "self":
+                self.tainted.add(p)
+
+    @staticmethod
+    def _static_derivation(expr) -> bool:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute) and node.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                return True
+            if isinstance(node, ast.Call) and \
+                    astutil.call_name(node) in ("len", "int", "bool",
+                                                "float", "isinstance"):
+                # int()/bool() of a tracer is the host-sync pass's
+                # finding; for taint purposes the RESULT is concrete
+                return True
+        return False
+
+    def reads_tainted(self, expr) -> set:
+        """Tainted names the expression reads. ``x is None`` identity
+        tests are Python-level structure checks — Name occurrences
+        inside them don't count (tracked by node identity, so the same
+        name still counts when ALSO read outside the identity test)."""
+        if self._static_derivation(expr):
+            return set()
+        ident_nodes = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in node.ops):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Name):
+                        ident_nodes.add(id(sub))
+        hits = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Name) and \
+                    node.id in self.tainted and \
+                    id(node) not in ident_nodes:
+                hits.add(node.id)
+        return hits
+
+    def assign(self, node: ast.Assign):
+        value_tainted = bool(self.reads_tainted(node.value))
+        for tgt in node.targets:
+            for elt in ([tgt] if isinstance(tgt, ast.Name)
+                        else getattr(tgt, "elts", [])):
+                if isinstance(elt, ast.Name):
+                    if value_tainted:
+                        self.tainted.add(elt.id)
+                    else:
+                        self.tainted.discard(elt.id)
+
+
+def _check_fn(ctx, path, fn, info, mut_globals) -> list:
+    findings = []
+
+    # --- unhashable static args
+    for pname, default in _default_pairs(fn):
+        if pname in info.static_names and _is_mutable_value(default):
+            findings.append(ctx.finding(
+                NAME, path, default.lineno,
+                f"static arg {pname!r} of jitted {fn.name!r} has an "
+                "unhashable (mutable) default — jit hashes static args "
+                "for its cache key: this raises TypeError on first "
+                "call, and an object default retraces per instance",
+            ))
+
+    taint = _Taint(fn, info)
+    local_names = set(param_names(fn))
+    nodes = [n for n in astutil.walk_no_nested_functions(fn)
+             if hasattr(n, "lineno")]
+    nodes.sort(key=lambda n: (n.lineno, n.col_offset))
+    for node in nodes:
+        if isinstance(node, ast.Assign):
+            taint.assign(node)
+            for tgt in node.targets:
+                for elt in ([tgt] if isinstance(tgt, ast.Name)
+                            else getattr(tgt, "elts", [])):
+                    if isinstance(elt, ast.Name):
+                        local_names.add(elt.id)
+        elif isinstance(node, (ast.If, ast.While)):
+            hits = taint.reads_tainted(node.test)
+            if hits:
+                kind = "while" if isinstance(node, ast.While) else "if"
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"Python `{kind}` on traced value(s) "
+                    f"{', '.join(sorted(hits))} inside jitted "
+                    f"{fn.name!r} — raises under trace (or forks the "
+                    "program); use jnp.where / lax.cond, or mark the "
+                    "arg static",
+                ))
+        elif isinstance(node, ast.IfExp):
+            hits = taint.reads_tainted(node.test)
+            if hits:
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"conditional expression on traced value(s) "
+                    f"{', '.join(sorted(hits))} inside jitted "
+                    f"{fn.name!r} — raises under trace; use jnp.where "
+                    "/ lax.cond, or mark the arg static",
+                ))
+        elif isinstance(node, ast.JoinedStr):
+            hits = set()
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    hits |= taint.reads_tainted(part.value)
+            if hits:
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"f-string formats traced value(s) "
+                    f"{', '.join(sorted(hits))} inside jitted "
+                    f"{fn.name!r} — renders Traced<...> at trace time, "
+                    "not the runtime value (jax.debug.print formats "
+                    "runtime values)",
+                ))
+        elif isinstance(node, ast.Call):
+            if astutil.call_name(node) == "format" and \
+                    isinstance(node.func, ast.Attribute):
+                hits = set()
+                for a in list(node.args) + [kw.value
+                                            for kw in node.keywords]:
+                    hits |= taint.reads_tainted(a)
+                if hits:
+                    findings.append(ctx.finding(
+                        NAME, path, node.lineno,
+                        f".format() of traced value(s) "
+                        f"{', '.join(sorted(hits))} inside jitted "
+                        f"{fn.name!r} — renders Traced<...> at trace "
+                        "time, not the runtime value",
+                    ))
+
+    # --- closure over mutable module globals (reads not shadowed by a
+    # local binding)
+    flagged = set()
+    for node in nodes:
+        if isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and \
+                node.id in mut_globals and \
+                node.id not in local_names and node.id not in flagged:
+            flagged.add(node.id)
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                f"jitted {fn.name!r} closes over mutable module global "
+                f"{node.id!r} (bound at line {mut_globals[node.id]}) — "
+                "the value is baked into the trace on first call; "
+                "later mutations are silently ignored",
+            ))
+    return findings

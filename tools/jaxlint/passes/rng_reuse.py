@@ -1,0 +1,282 @@
+"""rng-key-reuse: one PRNG key value consumed twice.
+
+JAX keys are VALUES, not stateful generators: two primitives fed the
+same key draw perfectly correlated randomness (the reused-dropout-mask
+bug that silently flattens a training curve). The sanctioned discipline
+is split/fold_in-then-consume — every consumption sees a fresh key.
+
+Per function, flow-ordered dataflow over key-typed locals:
+
+- **key sources**: ``jax.random.key/PRNGKey(...)``, elements of
+  ``jax.random.split(...)`` (tuple-unpacked), ``fold_in(...)`` results,
+  and parameters named like keys (``key``, ``rng``, ``*_key``,
+  ``*_rng``, ``prng*``);
+- **consumption**: the key passed as any argument to any call —
+  sampling primitives, model ``init``s, ``split`` itself (two
+  ``split(key)`` calls yield IDENTICAL children). ``fold_in(key, i)``
+  is the sanctioned re-derivation shape (distinct data per call) and
+  does not consume;
+- **reuse**: a second consumption with no intervening re-binding is the
+  finding. Branches of an ``if``/``else`` are mutually exclusive and
+  merge by max-count, not sum;
+- **loop-carried reuse**: a loop body that consumes a key bound
+  OUTSIDE the loop and never re-binds it in the body feeds every
+  iteration the same key — flagged even though each textual
+  consumption appears once. Arrays of keys (``split(key, n)`` kept
+  whole and indexed/scanned per element) are key POOLS and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.cplint import astutil
+from tools.jaxlint.core import JAX_ROOTS, param_names
+
+NAME = "rng-key-reuse"
+DESCRIPTION = (
+    "a PRNG key consumed by two primitives without an intervening "
+    "split/fold_in, or threaded through loop iterations unchanged"
+)
+
+_KEY_PARAM_RE = re.compile(r"(^|_)(key|rng)s?$|^prng")
+_SOURCE_CALLS = frozenset({"key", "PRNGKey", "fold_in"})
+
+
+def _is_random_call(node: ast.Call, name: str) -> bool:
+    chain = astutil.attr_chain(node.func) or []
+    return chain[-1:] == [name] and ("random" in chain or len(chain) == 1)
+
+
+def _is_key_source(node) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = astutil.call_name(node)
+    return name in _SOURCE_CALLS and _is_random_call(node, name)
+
+
+def _is_split(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        astutil.call_name(node) == "split" and _is_random_call(node, "split")
+
+
+def _is_fold_in(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        astutil.call_name(node) == "fold_in" and \
+        _is_random_call(node, "fold_in")
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*JAX_ROOTS):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        for fn in astutil.iter_functions(tree):
+            findings.extend(_Fn(ctx, path, fn).scan())
+    return findings
+
+
+class _Fn:
+    def __init__(self, ctx, path, fn):
+        self.ctx = ctx
+        self.path = path
+        self.fn = fn
+        self.findings: list = []
+
+    def scan(self) -> list:
+        uses: dict = {}   # key var -> consumption count
+        for p in param_names(self.fn):
+            if _KEY_PARAM_RE.search(p):
+                uses[p] = 0
+        self._block(self.fn.body, uses)
+        return self.findings
+
+    # ------------------------------------------------------- statements
+
+    def _block(self, stmts, uses: dict) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, uses)
+
+    def _stmt(self, stmt, uses: dict) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return   # separate dynamic context; scanned on its own
+        if isinstance(stmt, ast.Assign):
+            self._consume_expr(stmt.value, uses)
+            self._bind_targets(stmt.targets, stmt.value, uses)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._consume_expr(stmt.value, uses)
+            return
+        if isinstance(stmt, ast.If):
+            self._consume_expr(stmt.test, uses)
+            then_uses = dict(uses)
+            self._block(stmt.body, then_uses)
+            else_uses = dict(uses)
+            self._block(stmt.orelse, else_uses)
+            # exclusive branches: a key used once in EACH branch was
+            # still used once per execution — merge by max
+            for k in set(then_uses) | set(else_uses):
+                uses[k] = max(then_uses.get(k, 0), else_uses.get(k, 0))
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            self._loop(stmt, uses)
+            return
+        if isinstance(stmt, (ast.With,)):
+            for item in stmt.items:
+                self._consume_expr(item.context_expr, uses)
+            self._block(stmt.body, uses)
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._consume_expr(stmt.value, uses)
+            return
+        if isinstance(stmt, ast.Try):
+            self._block(stmt.body, uses)
+            for handler in stmt.handlers:
+                self._block(handler.body, uses)
+            self._block(stmt.orelse, uses)
+            self._block(stmt.finalbody, uses)
+            return
+        # default: consume any calls inside
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._consume_call(node, uses)
+
+    def _loop(self, stmt, uses: dict) -> None:
+        if isinstance(stmt, ast.For):
+            self._consume_expr(stmt.iter, uses)
+            # the loop target binds per-iteration values: a key-named
+            # target (``for k in keys:``) is a fresh key each pass,
+            # anything else shadows whatever was tracked under the name
+            for elt in ([stmt.target]
+                        if isinstance(stmt.target, ast.Name)
+                        else getattr(stmt.target, "elts", [])):
+                if isinstance(elt, ast.Name):
+                    if _KEY_PARAM_RE.search(elt.id):
+                        uses[elt.id] = 0
+                    else:
+                        uses.pop(elt.id, None)
+        outer = set(uses)    # keys live (bound) before the loop body
+        consumed, rebound = self._body_key_flow(stmt.body)
+        for k in sorted(consumed & outer - rebound):
+            self.findings.append(self.ctx.finding(
+                NAME, self.path, stmt.lineno,
+                f"key {k!r} is consumed inside this loop but never "
+                "re-bound in the body — every iteration draws from the "
+                "SAME key (split or fold_in per iteration)",
+            ))
+        # body effects on the outer state: run the body once normally
+        # (counts accumulate; rebindings reset)
+        self._block(stmt.body, uses)
+
+    def _body_key_flow(self, stmts) -> tuple:
+        """(consumed, rebound) key-var names across a loop body."""
+        consumed: set = set()
+        rebound: set = set()
+        for stmt in stmts:
+            for node in astutil.walk_no_nested_functions(stmt):
+                if isinstance(node, ast.Call) and not _is_fold_in(node):
+                    for a in list(node.args) + [kw.value
+                                                for kw in node.keywords]:
+                        if isinstance(a, ast.Name):
+                            consumed.add(a.id)
+                if isinstance(node, ast.Assign):
+                    for tgt in node.targets:
+                        for elt in ([tgt] if isinstance(tgt, ast.Name)
+                                    else getattr(tgt, "elts", [])):
+                            if isinstance(elt, ast.Name):
+                                rebound.add(elt.id)
+        return consumed, rebound
+
+    # ------------------------------------------------------ expressions
+
+    def _consume_expr(self, expr, uses: dict) -> None:
+        for node in astutil.walk_no_nested_functions(expr):
+            if isinstance(node, (ast.ListComp, ast.SetComp,
+                                 ast.GeneratorExp, ast.DictComp)):
+                self._comprehension(node, uses)
+            if isinstance(node, ast.Call):
+                self._consume_call(node, uses)
+
+    def _comprehension(self, comp, uses: dict) -> None:
+        """``[normal(key, ...) for _ in r]`` consumes ``key`` once per
+        ELEMENT — the loop-carry bug in expression clothing. Keys bound
+        by the comprehension's own targets (``for k in keys``) are
+        fresh per element and fine."""
+        bound: set = set()
+        for gen in comp.generators:
+            for elt in ([gen.target]
+                        if isinstance(gen.target, ast.Name)
+                        else getattr(gen.target, "elts", [])):
+                if isinstance(elt, ast.Name):
+                    bound.add(elt.id)
+        elements = ([comp.key, comp.value]
+                    if isinstance(comp, ast.DictComp) else [comp.elt])
+        for element in elements:
+            for node in astutil.walk_no_nested_functions(element):
+                if isinstance(node, ast.Call) and not _is_fold_in(node):
+                    for a in (list(node.args)
+                              + [kw.value for kw in node.keywords]):
+                        if isinstance(a, ast.Name) and a.id in uses \
+                                and a.id not in bound:
+                            self.findings.append(self.ctx.finding(
+                                NAME, self.path, node.lineno,
+                                f"key {a.id!r} is consumed once per "
+                                "element of this comprehension — every "
+                                "element draws from the SAME key "
+                                "(split a key pool outside, or fold_in "
+                                "the element index)",
+                            ))
+
+    def _consume_call(self, call: ast.Call, uses: dict) -> None:
+        if _is_fold_in(call):
+            return   # sanctioned re-derivation: does not consume
+        for a in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(a, ast.Name) and a.id in uses:
+                uses[a.id] += 1
+                if uses[a.id] == 2:
+                    self.findings.append(self.ctx.finding(
+                        NAME, self.path, call.lineno,
+                        f"key {a.id!r} is consumed a second time here "
+                        "with no intervening split/fold_in — both "
+                        "consumers draw IDENTICAL randomness",
+                    ))
+
+    # --------------------------------------------------------- binding
+
+    def _bind_targets(self, targets, value, uses: dict) -> None:
+        names: list = []
+        for tgt in targets:
+            if isinstance(tgt, ast.Name):
+                names.append(tgt.id)
+            else:
+                names.extend(e.id for e in getattr(tgt, "elts", [])
+                             if isinstance(e, ast.Name))
+        if _is_key_source(value) or _is_split(value):
+            is_split = _is_split(value)
+            unpacked = any(isinstance(t, (ast.Tuple, ast.List))
+                           for t in targets)
+            for n in names:
+                if is_split and not unpacked and len(names) == 1:
+                    # keys = split(key, n): a key POOL — per-element
+                    # consumption (scan/index/iter) is the idiom;
+                    # drop any tracked state rather than miscount
+                    uses.pop(n, None)
+                else:
+                    uses[n] = 0
+            return
+        if isinstance(value, ast.Subscript) and \
+                isinstance(value.value, ast.Call) and \
+                _is_split(value.value):
+            # sub = split(key, n)[0]
+            for n in names:
+                uses[n] = 0
+            return
+        # any other (re)binding makes the old tracked value dead
+        for n in names:
+            if n in uses:
+                del uses[n]
