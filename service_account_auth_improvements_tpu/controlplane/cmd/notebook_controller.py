@@ -26,6 +26,22 @@ from service_account_auth_improvements_tpu.controlplane.scheduler import (
 from service_account_auth_improvements_tpu.utils.env import get_env_bool
 
 
+def _add_args(parser):
+    parser.add_argument(
+        "--placement-policy", choices=("best_fit", "learned"),
+        default=None,
+        help="tpusched placement policy (docs/scheduler.md 'Learned "
+             "placement'): best_fit (default) or learned — the trained "
+             "scorer, which abstains back to best_fit on a missing "
+             "checkpoint, unknown pool count, or low confidence "
+             "(env PLACEMENT_POLICY)")
+    parser.add_argument(
+        "--policy-checkpoint", default=None,
+        help="policy.npz path for --placement-policy=learned "
+             "(env SCHED_POLICY_CHECKPOINT); retrains land by mtime, "
+             "no restart needed")
+
+
 def _register(client, manager, args):
     metrics = NotebookMetrics()
     NotebookReconciler(client, metrics).register(manager)
@@ -34,11 +50,15 @@ def _register(client, manager, args):
     if get_env_bool("ENABLE_SCHEDULER", False):
         # metrics on the global REGISTRY so the ops endpoint exports the
         # queue depth / time-to-placement / preemption series
-        SchedulerReconciler(client, SchedulerMetrics()).register(manager)
+        SchedulerReconciler(
+            client, SchedulerMetrics(),
+            placement_policy=args.placement_policy,
+            policy_checkpoint=args.policy_checkpoint,
+        ).register(manager)
 
 
 def main(argv=None) -> int:
-    return run_manager(_register, argv)
+    return run_manager(_register, argv, add_args=_add_args)
 
 
 if __name__ == "__main__":

@@ -31,6 +31,9 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.chaos import (  
 from service_account_auth_improvements_tpu.controlplane.cpbench.ha import (  # noqa: E501,F401 — importing registers the ha_scale family into SCENARIOS
     HA_SCENARIOS,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.policy import (  # noqa: E501,F401 — importing registers the sched_policy family into SCENARIOS
+    POLICY_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
     SCENARIOS,
     BenchConfig,
@@ -61,6 +64,8 @@ SMOKE_N = {
     "ha_scale": 120,          # CRs per replica arm (x3 arms: 1/2/4)
     "ha_failover": 60,        # two waves around the leader kill
     "ha_apf": 400,            # protected-lane requests per A/B arm
+    "sched_policy": 12,       # per A/B arm (best_fit, then learned)
+    "sched_policy_frag": 16,  # single-host churn per arm
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -80,6 +85,8 @@ FULL_N = {
                               # arm's informers
     "ha_failover": 2_000,
     "ha_apf": 3_000,
+    "sched_policy": 48,       # the sched_contention --full scale
+    "sched_policy_frag": 64,
 }
 
 
@@ -104,6 +111,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "multi-replica plane: replica sweep, "
                          "leader-kill failover, APF A/B; docs/ha.md) "
                          "in the run")
+    ap.add_argument("--policy", action="store_true",
+                    help="include the sched_policy family (learned "
+                         "placement A/B: best_fit arm → train on its "
+                         "journal → learned arm; needs the JAX half "
+                         "of the tree; docs/scheduler.md) in the run")
+    ap.add_argument("--journal-out", default="", metavar="DIR",
+                    help="dump each scenario's decision journal as "
+                         "<DIR>/<scenario>_journal.jsonl next to the "
+                         "bench record — the sched-journal/v1 harvest "
+                         "surface the placement policy trains on "
+                         "(empty string disables)")
     ap.add_argument("--profile", action="store_true",
                     help="cpprof: sample hot stacks + lock contention + "
                          "saturation per scenario into extra.prof, and "
@@ -275,6 +293,8 @@ def run(args) -> dict:
         name for name in SCENARIOS
         if (args.chaos or name not in CHAOS_SCENARIOS)
         and (getattr(args, "ha", False) or name not in HA_SCENARIOS)
+        and (getattr(args, "policy", False)
+             or name not in POLICY_SCENARIOS)
     )
     started = time.monotonic()
     report: dict = {
@@ -345,6 +365,16 @@ def run(args) -> dict:
                 print(f"{name}: folded profile -> {fold_path}",
                       file=sys.stderr)
         report["scenarios"][name] = entry
+        if result.journal_jsonl and getattr(args, "journal_out", ""):
+            # the harvest surface, standalone: sched-journal/v1 rows
+            # ready for scheduler/policy/train.py --journal
+            os.makedirs(args.journal_out, exist_ok=True)
+            jpath = os.path.join(args.journal_out,
+                                 f"{name}_journal.jsonl")
+            with open(jpath, "w") as f:
+                f.write(result.journal_jsonl)
+            print(f"{name}: decision journal -> {jpath}",
+                  file=sys.stderr)
         if result.blackbox and getattr(args, "dump_dir", ""):
             # black-box flight record: journal tail + explain timeline
             # per non-Ready/violating object, one file per scenario

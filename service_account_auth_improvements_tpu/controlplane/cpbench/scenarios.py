@@ -124,6 +124,12 @@ class ScenarioResult:
     #: + explain timelines) — the CLI writes it into bench_out/ so a
     #: failed gate carries its own evidence
     blackbox: dict | None = None
+    #: the scenario's full decision journal as JSONL (Journal.to_jsonl)
+    #: — the learned-placement harvest surface; ``cpbench
+    #: --journal-out`` writes it next to the bench record so benches
+    #: ARE the training-set generator (docs/scheduler.md). None for
+    #: scenarios without a decision journal.
+    journal_jsonl: str | None = None
 
 
 # --------------------------------------------------------------- fixtures
@@ -162,7 +168,10 @@ class _NotebookWorld:
 
     def __init__(self, cfg: BenchConfig, scenario: str,
                  fetch_kernels=None, scheduler: bool = False,
-                 relist_period: float = 0.0):
+                 relist_period: float = 0.0,
+                 placement_policy: str | None = None,
+                 policy_checkpoint: str | None = None,
+                 preemption: bool = True):
         self.kube = FakeKube()
         # per-client request attribution (cpprof): the bench's own
         # traffic (creates, deletes, cache-miss polls) books under
@@ -199,8 +208,11 @@ class _NotebookWorld:
             # tpusched owns admission: the notebook controller creates no
             # children until placement stamps the node-pool annotation
             self.reconciler.use_scheduler = True
-            self.sched = SchedulerReconciler(self.kube,
-                                             enable_preemption=True)
+            self.sched = SchedulerReconciler(
+                self.kube, enable_preemption=preemption,
+                placement_policy=placement_policy,
+                policy_checkpoint=policy_checkpoint,
+            )
             self.tracker.instrument_reconciler(self.sched)
             self.sched.register(self.mgr)
         self.culler = None
@@ -438,6 +450,7 @@ def _finish(world, cfg: BenchConfig, names: list[str], ns: str,
         summary=summary,
         ok=ok and summary["failed"] == 0,
         blackbox=world.blackbox(),
+        journal_jsonl=world.journal.to_jsonl(),
     )
 
 
@@ -511,6 +524,7 @@ def scenario_gang_ready(cfg: BenchConfig) -> ScenarioResult:
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0 and gated_left == 0,
         blackbox=world.blackbox(),
+        journal_jsonl=world.journal.to_jsonl(),
     )
 
 
@@ -622,6 +636,7 @@ def scenario_churn(cfg: BenchConfig) -> ScenarioResult:
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0,
         blackbox=world.blackbox(),
+        journal_jsonl=world.journal.to_jsonl(),
     )
 
 
@@ -966,6 +981,7 @@ def scenario_sched_contention(cfg: BenchConfig) -> ScenarioResult:
         records=world.tracker.records(), summary=summary,
         ok=ok and summary["failed"] == 0 and len(placement_ms) == cfg.n,
         blackbox=world.blackbox(violating=violating),
+        journal_jsonl=world.journal.to_jsonl(),
     )
 
 

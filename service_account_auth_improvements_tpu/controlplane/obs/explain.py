@@ -56,8 +56,11 @@ TIMELINE_SPANS = {
 }
 
 #: attrs that never cross the tenant boundary (same contract as the
-#: dashboard traces API): cluster-wide occupancy is operator-only
-CLUSTER_ATTRS = ("free_chips", "queue_depth")
+#: dashboard traces API): cluster-wide occupancy is operator-only —
+#: including the learned-placement evidence (per-pool scores and the
+#: feasibility mask reconstruct the whole cluster's free-chip map)
+CLUSTER_ATTRS = ("free_chips", "total_chips", "feasible", "scores",
+                 "queue_depth")
 
 
 def _parse_wall(raw) -> float | None:
@@ -245,6 +248,16 @@ def explain(namespace: str | None, name: str, *, kube=None, tracer=None,
             or attrs.get("reason") or attrs.get("action")
         if detail:
             what += f": {detail}"
+        if e["kind"] == "placement" and attrs.get("policy"):
+            # which policy decided (and why it fell back) is tenant-safe
+            # prose; the score vector / feasibility mask — cluster-wide
+            # occupancy — stays in attrs, which redact() strips and
+            # render_explain (the operator explainz surface) expands
+            # into the evidence trail (docs/scheduler.md)
+            what += f" [{attrs['policy']}"
+            if attrs.get("fallback"):
+                what += f" fallback: {attrs['fallback']}"
+            what += "]"
         items.append({"wall": wall, "source": "journal", "what": what,
                       "attrs": attrs})
     lo = window_lo if window_lo is not None else min(
@@ -410,6 +423,20 @@ def render_explain(record: dict) -> str:
     for item in record["timeline"]:
         ts = item.get("wall_iso") or "????-??-??T??:??:??"
         lines.append(f"  {ts}  [{item['source']:9s}] {item['what']}")
+        attrs = item.get("attrs") or {}
+        if attrs.get("policy") == "learned" and attrs.get("scores"):
+            # the learned decision's evidence trail, operator view
+            # only: a record that went through redact() has no scores
+            # left here, so nothing tenant-facing can leak through
+            # this rendering
+            ranked = sorted(attrs["scores"].items(),
+                            key=lambda kv: -kv[1])
+            lines.append(
+                "            scores: " + ", ".join(
+                    f"{pool}={score:g}" for pool, score in ranked))
+            lines.append(
+                "            feasible: ["
+                + ", ".join(attrs.get("feasible") or ()) + "]")
     if not record["timeline"]:
         lines.append("  (no recorded history)")
     return "\n".join(lines) + "\n"

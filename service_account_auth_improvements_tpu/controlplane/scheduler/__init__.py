@@ -19,6 +19,7 @@ from service_account_auth_improvements_tpu.controlplane.scheduler.placement impo
     best_fit,
     demand_from,
     feasible,
+    feasible_pools,
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.preemption import (  # noqa: F401,E501
     choose_victim,

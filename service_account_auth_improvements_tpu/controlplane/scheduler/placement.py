@@ -56,15 +56,31 @@ def feasible(pool: SlicePool, used: int, demand: Demand) -> bool:
             and pool.chips_per_host >= demand.total_chips)
 
 
+def feasible_pools(pools: dict[str, SlicePool], used: dict[str, int],
+                   demand: Demand) -> list[str]:
+    """Names of every pool that could host ``demand`` right now, sorted
+    for determinism. This is THE feasibility definition: ``best_fit``
+    chooses among these, and the learned policy's infeasibility mask is
+    built from exactly this list — a second, diverging definition here
+    would be a double-booking factory (a policy scoring a pool best-fit
+    would refuse is a policy stamping annotations the inventory can't
+    honor)."""
+    return sorted(
+        name for name, pool in pools.items()
+        if feasible(pool, used.get(name, 0), demand)
+    )
+
+
 def best_fit(pools: dict[str, SlicePool], used: dict[str, int],
              demand: Demand) -> str | None:
     """Name of the feasible pool with the least leftover capacity after
     placement, or None when nothing fits."""
     best: tuple[int, str] | None = None
     for name, pool in pools.items():
-        if not feasible(pool, used.get(name, 0), demand):
+        pool_used = used.get(name, 0)
+        if not feasible(pool, pool_used, demand):
             continue
-        leftover = pool.total_chips - used.get(name, 0) - demand.total_chips
+        leftover = pool.total_chips - pool_used - demand.total_chips
         if best is None or (leftover, name) < best:
             best = (leftover, name)
     return best[1] if best else None

@@ -1,0 +1,29 @@
+"""schedpolicy: learned placement trained on the plane's own journal.
+
+The journal→train→serve loop, closed (docs/scheduler.md "Learned
+placement"):
+
+- ``features``: the pinned ``sched-journal/v1`` placement-row schema and
+  the featurizer that turns journal rows into fixed-width training
+  examples (schema half is stdlib-pure; the array half needs numpy);
+- ``model``: the masked pool scorer, ONE forward definition that runs
+  under numpy (serving) and jax.numpy (training) alike — the
+  infeasibility mask is applied INSIDE the model, so it cannot emit a
+  pool the shared ``placement.feasible_pools`` definition rejects;
+- ``train``: the training loop on the repo's own train-stack shape
+  (jitted step with donation, seeded RNG, checkpoint/resume, the
+  jitwatch seam), deterministic at a fixed seed;
+- ``serve``: ``PolicyChooser`` behind the scheduler reconciler's
+  ``placement_policy="learned"`` — numpy-only inference, abstains
+  (→ best_fit) on a missing checkpoint, unknown pool count, or low
+  confidence.
+
+Import discipline — THIS ``__init__`` IMPORTS NOTHING: the scheduler
+reconciler (and through it every controlplane binary and the stdlib-
+only cpbench CI lane) imports ``features`` for the schema constants,
+which must work on an install with no numpy and no JAX anywhere.
+``serve``/``model`` need numpy and are imported lazily by the
+reconciler's learned branch; ``train`` needs JAX and is imported by
+the training CLI and benches only. Import submodules explicitly
+(``from ...policy import features``); nothing is re-exported here.
+"""

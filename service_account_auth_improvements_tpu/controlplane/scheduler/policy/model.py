@@ -1,0 +1,94 @@
+"""The placement scorer: a shared per-pool MLP with the mask inside.
+
+Architecture: every pool is scored by the SAME small MLP over
+``concat(pool_block, global_block)`` — permutation-equivariant over
+pools (the policy learns what a good pool looks like, not which array
+slot it sits in) and pool-count-agnostic up to ``features.MAX_POOLS``.
+Infeasible pools are masked to -inf INSIDE :func:`forward`, so the
+argmax over the model's output can never name a pool the shared
+``placement.feasible_pools`` definition rejects — illegal pools are
+unrepresentable, not merely penalized.
+
+ONE forward definition, two backends: :func:`forward` takes the array
+namespace as ``xp`` (numpy for serving — no JAX import, no jit compile
+latency under the scheduler's placement lock; jax.numpy for training,
+where ``train.make_policy_step`` jits it). A test pins the two
+backends' outputs equal, so serving can never drift from what was
+trained.
+
+This module imports numpy only; :func:`init_params` is the single
+JAX-touching function and imports it lazily (training-side callers
+only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.policy.features import (  # noqa: E501
+    GLOBAL_FEATURES,
+    POOL_FEATURES,
+)
+
+#: per-pool scorer input width
+IN_FEATURES = POOL_FEATURES + GLOBAL_FEATURES
+DEFAULT_HIDDEN = 32
+#: masked logit for infeasible pools: large enough that no finite
+#: learned score outranks it, small enough to stay softmax-safe in f32
+NEG_INF = -1e9
+
+#: parameter tree leaf names (flat dict — npz-checkpoint-friendly)
+PARAM_KEYS = ("w1", "b1", "w2", "b2", "w3", "b3")
+
+
+def init_params(key, hidden: int = DEFAULT_HIDDEN) -> dict:
+    """Seeded parameter init (JAX PRNG — the training side's entry
+    point; serving only ever LOADS params from a checkpoint)."""
+    import jax
+
+    k1, k2, k3 = jax.random.split(key, 3)
+    scale1 = 1.0 / np.sqrt(IN_FEATURES)
+    scale2 = 1.0 / np.sqrt(hidden)
+    return {
+        "w1": jax.random.normal(k1, (IN_FEATURES, hidden)) * scale1,
+        "b1": jax.numpy.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, hidden)) * scale2,
+        "b2": jax.numpy.zeros((hidden,)),
+        "w3": jax.random.normal(k3, (hidden, 1)) * scale2,
+        "b3": jax.numpy.zeros((1,)),
+    }
+
+
+def forward(params: dict, pool_feats, glob, mask, xp=np):
+    """Masked per-pool scores.
+
+    ``pool_feats``: (..., P, POOL_FEATURES); ``glob``:
+    (..., GLOBAL_FEATURES); ``mask``: (..., P) bool. Returns (..., P)
+    scores with every infeasible slot at :data:`NEG_INF` — applied
+    here, inside the model, not by callers.
+    """
+    glob_b = xp.broadcast_to(
+        glob[..., None, :],
+        pool_feats.shape[:-1] + (GLOBAL_FEATURES,),
+    )
+    x = xp.concatenate([pool_feats, glob_b], axis=-1)
+    h = xp.tanh(x @ params["w1"] + params["b1"])
+    h = xp.tanh(h @ params["w2"] + params["b2"])
+    scores = (h @ params["w3"] + params["b3"])[..., 0]
+    return xp.where(mask, scores, NEG_INF)
+
+
+def choose_index(params: dict, pool_feats, glob, mask) -> tuple:
+    """Serving-side decision (numpy): (argmax index, scores,
+    confidence). Confidence is the softmax mass on the winner over the
+    FEASIBLE slots — the abstention signal. Returns index -1 when no
+    slot is feasible."""
+    scores = forward(params, pool_feats, glob, mask, xp=np)
+    if not mask.any():
+        return -1, scores, 0.0
+    idx = int(np.argmax(scores))
+    feasible_scores = scores[mask]
+    shifted = feasible_scores - feasible_scores.max()
+    probs = np.exp(shifted) / np.exp(shifted).sum()
+    confidence = float(probs.max())
+    return idx, scores, confidence

@@ -1,0 +1,139 @@
+"""PolicyChooser: the learned half of ``--placement-policy=learned``.
+
+Inference is pure numpy (``model.forward`` with ``xp=np``): no JAX
+import, no jit, no compile latency — the chooser runs under the
+scheduler's placement lock, where a first-call XLA compile would stall
+every reconcile worker. The checkpoint (``train.py``'s ``policy.npz``)
+is lazily loaded and re-checked by mtime, so a retrain lands without a
+scheduler restart.
+
+The fallback contract (docs/scheduler.md): ``choose`` returns ``None``
+— and :attr:`abstain_reason` says why — whenever the policy should NOT
+decide, and the reconciler then runs plain ``best_fit``:
+
+- no checkpoint at the configured path (or unreadable/wrong-schema);
+- the inventory exceeds the model's fixed width
+  (``features.MAX_POOLS``);
+- the feasible set is empty (nothing to score — the park path);
+- confidence below ``min_confidence`` (softmax mass on the winner over
+  the FEASIBLE slots).
+
+When it does decide, the choice is in the feasible set BY CONSTRUCTION:
+the mask is applied inside ``model.forward`` (infeasible slots score
+-1e9) and the mask comes from the same ``placement.feasible_pools``
+list best-fit chooses from. The reconciler re-checks membership anyway
+— belt and suspenders around the one invariant that matters
+(double-booking-free placement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.policy import (  # noqa: E501
+    features,
+    model,
+)
+
+DEFAULT_MIN_CONFIDENCE = 0.05
+
+
+@dataclasses.dataclass(frozen=True)
+class PolicyChoice:
+    """One learned decision and its evidence trail: the chosen pool
+    plus the full (finite) score vector the journal records so
+    ``explainz`` can show WHY this pool won."""
+
+    pool: str
+    scores: dict   # {pool name: rounded score; infeasible pools omitted}
+    confidence: float
+
+
+class PolicyChooser:
+    """Loads ``policy.npz`` and scores feasible pools; thread-safe by
+    construction (reads immutable loaded arrays; reload swaps the whole
+    dict reference)."""
+
+    def __init__(self, checkpoint_path: str | None,
+                 min_confidence: float = DEFAULT_MIN_CONFIDENCE):
+        self.checkpoint_path = checkpoint_path
+        self.min_confidence = min_confidence
+        self.abstain_reason = "checkpoint-missing"
+        self._loaded: dict | None = None
+        self._mtime: float | None = None
+
+    # ------------------------------------------------------------ loading
+
+    def _ensure_loaded(self) -> bool:
+        path = self.checkpoint_path
+        if not path:
+            self.abstain_reason = "checkpoint-unconfigured"
+            return False
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            self._loaded = None
+            self._mtime = None
+            self.abstain_reason = "checkpoint-missing"
+            return False
+        if mtime != self._mtime:
+            # the mtime is cached for FAILED parses too: an
+            # unreadable/wrong-schema file must cost one read per
+            # file version, not one per placement decision (choose
+            # runs under the scheduler lock)
+            from service_account_auth_improvements_tpu.controlplane.scheduler.policy.train import (  # noqa: E501
+                load_checkpoint,
+            )
+
+            self._mtime = mtime
+            self._loaded = load_checkpoint(path)
+        if self._loaded is None:
+            self.abstain_reason = "checkpoint-unreadable"
+            return False
+        return True
+
+    # ------------------------------------------------------------ choosing
+
+    def choose(self, pools: dict, used: dict, demand, feas,
+               queue_depth: int = 0) -> PolicyChoice | None:
+        """Score ``feas`` (the shared feasibility list the reconciler
+        computed) for ``demand``; None = abstain (reason in
+        :attr:`abstain_reason`)."""
+        if not feas:
+            self.abstain_reason = "no-feasible-pool"
+            return None
+        if not self._ensure_loaded():
+            return None
+        free = {name: pool.total_chips - used.get(name, 0)
+                for name, pool in pools.items()}
+        total = {name: pool.total_chips for name, pool in pools.items()}
+        encoded = features.encode_state(
+            free, total, feas, demand.total_chips, demand.num_hosts,
+            queue_depth,
+        )
+        if encoded is None:
+            self.abstain_reason = "too-many-pools"
+            return None
+        pool_feats, glob, mask, order = encoded
+        idx, scores, confidence = model.choose_index(
+            self._loaded["params"], pool_feats, glob, mask,
+        )
+        if idx < 0:
+            self.abstain_reason = "no-feasible-pool"
+            return None
+        if confidence < self.min_confidence:
+            self.abstain_reason = (
+                f"low-confidence ({confidence:.3f} < "
+                f"{self.min_confidence})")
+            return None
+        score_map = {
+            order[i]: round(float(scores[i]), 4)
+            for i in range(len(order)) if mask[i]
+        }
+        return PolicyChoice(pool=order[idx], scores=score_map,
+                            confidence=round(confidence, 4))
+
+    def ready(self) -> bool:
+        """True when a checkpoint is loadable right now (ops surface)."""
+        return self._ensure_loaded()

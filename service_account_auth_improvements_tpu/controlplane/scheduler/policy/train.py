@@ -1,0 +1,280 @@
+"""Policy training: the repo's own train-stack shape, on journal rows.
+
+The loop mirrors ``train/loop.py`` deliberately — one jitted step with
+the previous state donated, seeded RNG, checkpoint/resume keyed by the
+state's own step counter, cadence-gated host syncs (``% log_every``),
+and the jitwatch seam (``JAXLINT_JITWATCH=1`` arms the recompile
+budget, exactly as the big loop's tests run) — so the discipline
+jaxlint enforces on the numerics half covers the control plane training
+itself.
+
+Objective: outcome-weighted behavior cloning (advantage-weighted
+regression's offline shape). Each journal row is a (state, decision,
+time-to-placement) tuple; the loss is cross-entropy against the logged
+decision over the MASKED scores, weighted by ``1/(1+ttp_s)`` — fast
+placements are imitated harder than ones that sat in the queue, which
+is how the policy can beat pure best-fit imitation on fragmentation-
+heavy workloads without an online actor/learner split (Podracer,
+arXiv:2104.06272, names that follow-up).
+
+Checkpoints are a single ``policy.npz`` (atomic tmp+rename — serving
+may read mid-train): the policy state is kilobytes, so the train
+stack's orbax machinery (built for HBM-scale sharded states) would be
+pure overhead here; the resume contract is the same — restart continues
+from the saved step with identical batches.
+
+Determinism: fixed ``seed`` fixes init AND the per-step batch draw
+(``np.random.default_rng((seed, step))``), so two runs — or one run
+resumed — produce bit-identical parameters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from typing import Any, NamedTuple
+
+import numpy as np
+
+from service_account_auth_improvements_tpu.controlplane.scheduler.policy import (  # noqa: E501
+    features,
+    model,
+)
+
+CKPT_FILE = "policy.npz"
+CKPT_SCHEMA = "sched-policy-ckpt/v1"
+
+
+class PolicyState(NamedTuple):
+    step: Any
+    params: Any
+    opt_state: Any
+
+
+def _maybe_jitwatch(fn, site: str):
+    """train/loop.py's seam, verbatim contract: identity when
+    JAXLINT_JITWATCH is unset or the tools package is absent."""
+    if not os.environ.get("JAXLINT_JITWATCH"):
+        return fn
+    try:
+        from tools.jaxlint import jitwatch
+    except ImportError:
+        return fn
+    return jitwatch.maybe_wrap(fn, site=site)
+
+
+def make_policy_step(optimizer):
+    """Jitted ``step(state, batch) -> (state, metrics)``; ``batch`` is
+    ``(pool_feats, glob, mask, label, weight)``. Donates the previous
+    state (the train-stack idiom — rebind, never reread)."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    def loss_fn(params, pool_feats, glob, mask, label, weight):
+        scores = model.forward(params, pool_feats, glob, mask, xp=jnp)
+        logp = jax.nn.log_softmax(scores, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, label[:, None].astype(jnp.int32), axis=-1
+        )[:, 0]
+        return -(weight * picked).sum() / jnp.maximum(weight.sum(), 1e-6)
+
+    def step_fn(state: PolicyState, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(state.params, *batch)
+        updates, opt_state = optimizer.update(
+            grads, state.opt_state, state.params
+        )
+        params = optax.apply_updates(state.params, updates)
+        return PolicyState(state.step + 1, params, opt_state), {
+            "loss": loss,
+        }
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+# ----------------------------------------------------------- checkpoint
+
+def save_checkpoint(workdir: str, state: PolicyState,
+                    hidden: int) -> str:
+    """Atomic ``policy.npz`` write; returns the path. Carries the
+    optimizer-state leaves too (flat, by index — the treedef is
+    regenerated from ``optimizer.init`` at resume), so a resumed run
+    is the run that never stopped, Adam moments included."""
+    import jax
+
+    os.makedirs(workdir, exist_ok=True)
+    path = os.path.join(workdir, CKPT_FILE)
+    payload = {
+        "schema": np.array(CKPT_SCHEMA),
+        "journal_schema": np.array(features.JOURNAL_SCHEMA),
+        "step": np.array(int(state.step), np.int64),
+        "hidden": np.array(int(hidden), np.int64),
+    }
+    for key in model.PARAM_KEYS:
+        payload[f"param/{key}"] = np.asarray(state.params[key])
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(state.opt_state)):
+        payload[f"opt/{i}"] = np.asarray(leaf)
+    fd, tmp = tempfile.mkstemp(dir=workdir, suffix=".npz.tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez(f, **payload)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return path
+
+
+def load_checkpoint(path: str) -> dict | None:
+    """{"params": {name: np.ndarray}, "step", "hidden"} or None when
+    the file is absent/unreadable/wrong-schema — the serving side turns
+    None into an abstention, never a crash."""
+    if not path or not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            if str(z["schema"]) != CKPT_SCHEMA:
+                return None
+            opt_keys = sorted(
+                (k for k in z.files if k.startswith("opt/")),
+                key=lambda k: int(k.split("/", 1)[1]),
+            )
+            return {
+                "params": {k: z[f"param/{k}"]
+                           for k in model.PARAM_KEYS},
+                "opt_leaves": [z[k] for k in opt_keys],
+                "step": int(z["step"]),
+                "hidden": int(z["hidden"]),
+            }
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def latest_step(workdir: str) -> int | None:
+    loaded = load_checkpoint(os.path.join(workdir, CKPT_FILE))
+    return loaded["step"] if loaded else None
+
+
+# ------------------------------------------------------------- training
+
+def fit_policy(data: dict, *, seed: int = 0, steps: int = 300,
+               batch_size: int = 64, hidden: int = model.DEFAULT_HIDDEN,
+               learning_rate: float = 1e-2, workdir: str | None = None,
+               ckpt_every: int = 0, log_every: int = 50,
+               log=None) -> tuple:
+    """Train on a ``features.dataset`` dict; returns (state, history).
+
+    Resume: with ``workdir`` holding a checkpoint, training continues
+    from its step over the identical per-step batch schedule — the same
+    contract as ``train/loop.py``'s fit.
+    """
+    import jax
+
+    from service_account_auth_improvements_tpu.train.step import (
+        make_optimizer,
+    )
+
+    n = int(data["label"].shape[0])
+    if n == 0:
+        raise ValueError("empty training set: no usable placement rows "
+                         "(journal too small, or schema drift — see "
+                         "features.check_row)")
+    optimizer = make_optimizer(learning_rate=learning_rate,
+                               weight_decay=0.0)
+    start = 0
+    resumed = (load_checkpoint(os.path.join(workdir, CKPT_FILE))
+               if workdir else None)
+    if resumed is not None:
+        hidden = resumed["hidden"]
+        params = jax.tree.map(jax.numpy.asarray, resumed["params"])
+        start = resumed["step"]
+        opt_state = optimizer.init(params)
+        treedef = jax.tree_util.tree_structure(opt_state)
+        leaves = resumed.get("opt_leaves") or []
+        if len(leaves) == treedef.num_leaves:
+            opt_state = jax.tree_util.tree_unflatten(
+                treedef, [jax.numpy.asarray(x) for x in leaves])
+        state = PolicyState(jax.numpy.asarray(start, jax.numpy.int32),
+                            params, opt_state)
+        if log:
+            log(f"resumed from step {start}")
+    else:
+        params = model.init_params(jax.random.key(seed), hidden=hidden)
+        state = PolicyState(jax.numpy.zeros((), jax.numpy.int32),
+                            params, optimizer.init(params))
+    step = _maybe_jitwatch(make_policy_step(optimizer),
+                           "scheduler.policy.step")
+    weight = (1.0 / (1.0 + data["ttp_s"])).astype(np.float32)
+    history = []
+    for i in range(start, steps):
+        # per-step derived stream: deterministic, resume-stable
+        idx = np.random.default_rng((seed, i)).integers(
+            0, n, size=batch_size)
+        batch = (data["pool_feats"][idx], data["glob"][idx],
+                 data["mask"][idx], data["label"][idx], weight[idx])
+        state, metrics = step(state, batch)
+        if log_every and (i + 1) % log_every == 0:
+            loss = float(metrics["loss"])
+            history.append({"step": i + 1, "loss": loss})
+            if log:
+                log(f"policy step {i + 1}/{steps} loss={loss:.4f}")
+        if workdir and ckpt_every and (i + 1) % ckpt_every == 0:
+            save_checkpoint(workdir, state, hidden)
+    if workdir and int(state.step) > start:
+        save_checkpoint(workdir, state, hidden)
+    return state, history
+
+
+def train_from_journal(journal_path: str, workdir: str, *,
+                       seed: int = 0, steps: int = 300,
+                       batch_size: int = 64,
+                       log=None) -> dict:
+    """Journal JSONL → trained checkpoint; returns the run record
+    (example/drop counts, final loss, checkpoint path) — what the
+    cpbench policy scenario and the CI training step report."""
+    entries = features.load_journal_jsonl(journal_path)
+    data = features.dataset(entries)
+    state, history = fit_policy(
+        data, seed=seed, steps=steps, batch_size=batch_size,
+        workdir=workdir, log=log,
+    )
+    return {
+        "examples": int(data["label"].shape[0]),
+        "dropped_rows": int(data["dropped"]),
+        "steps": int(state.step),
+        "seed": seed,
+        "final_loss": history[-1]["loss"] if history else None,
+        "checkpoint": os.path.join(workdir, CKPT_FILE),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m service_account_auth_improvements_tpu."
+             "controlplane.scheduler.policy.train",
+        description="train the placement policy from a decision-journal "
+                    "JSONL dump (cpbench --journal-out writes them)",
+    )
+    ap.add_argument("--journal", required=True,
+                    help="journal JSONL (sched-journal/v1 placement "
+                         "rows)")
+    ap.add_argument("--workdir", required=True,
+                    help="checkpoint directory (policy.npz lands here; "
+                         "an existing checkpoint resumes)")
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    record = train_from_journal(
+        args.journal, args.workdir, seed=args.seed, steps=args.steps,
+        batch_size=args.batch_size, log=print,
+    )
+    print(json.dumps(record, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import copy
 import logging
+import os
 import threading
 import time
 
@@ -78,7 +79,10 @@ from service_account_auth_improvements_tpu.controlplane.scheduler.inventory impo
 from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: E501
     best_fit,
     demand_from,
-    feasible,
+    feasible_pools,
+)
+from service_account_auth_improvements_tpu.controlplane.scheduler.policy.features import (  # noqa: E501
+    JOURNAL_SCHEMA,
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.preemption import (  # noqa: E501
     choose_victim,
@@ -145,7 +149,9 @@ class SchedulerReconciler(Reconciler):
     group = GROUP
 
     def __init__(self, kube, metrics: SchedulerMetrics | None = None,
-                 enable_preemption: bool | None = None):
+                 enable_preemption: bool | None = None,
+                 placement_policy: str | None = None,
+                 policy_checkpoint: str | None = None):
         self.kube = kube
         self.metrics = metrics or SchedulerMetrics(Registry())
         self.recorder = EventRecorder(kube, "tpusched")
@@ -153,6 +159,40 @@ class SchedulerReconciler(Reconciler):
             enable_preemption if enable_preemption is not None
             else get_env_bool("ENABLE_PREEMPTION", False)
         )
+        # learned placement (docs/scheduler.md "Learned placement"):
+        # best_fit stays the default AND the fallback — the chooser is
+        # only consulted for unpinned demands, abstains on a missing/
+        # unloadable checkpoint or low confidence, and masks
+        # infeasible pools inside the model so it can never emit a
+        # pool the shared feasible_pools() definition rejects
+        self.placement_policy = (
+            placement_policy if placement_policy is not None
+            else os.environ.get("PLACEMENT_POLICY", "best_fit")
+        )
+        if self.placement_policy not in ("best_fit", "learned"):
+            raise ValueError(
+                f"placement_policy={self.placement_policy!r} "
+                "(want best_fit|learned)"
+            )
+        self._chooser = None
+        if self.placement_policy == "learned":
+            # lazy, ImportError-safe: the learned path needs the JAX
+            # half of the tree; a controlplane-only install (the CI
+            # bench lane) degrades to best_fit LOUDLY, not cryptically
+            try:
+                from service_account_auth_improvements_tpu.controlplane.scheduler.policy.serve import (  # noqa: E501
+                    PolicyChooser,
+                )
+                self._chooser = PolicyChooser(
+                    policy_checkpoint
+                    or os.environ.get("SCHED_POLICY_CHECKPOINT")
+                )
+            except ImportError as e:
+                log.warning(
+                    "placement-policy=learned but the policy stack is "
+                    "unavailable (%s); every placement falls back to "
+                    "best_fit", e,
+                )
         self._lock = threading.RLock()
         self._queue = AdmissionQueue()
         self._assigned: dict[tuple[str, str], Assignment] = {}
@@ -600,19 +640,64 @@ class SchedulerReconciler(Reconciler):
                                f"{entry.demand.total_chips}",
                                nb, park_events)
                     continue
+                # ONE feasibility sweep (placement.feasible_pools)
+                # serves the pin check, best_fit, and the learned
+                # policy's mask — divergence here is a double-booking
+                # factory
+                feas = feasible_pools(pools, used, entry.demand)
+                policy_attrs: dict = {}
                 if entry.pinned_pool:
-                    pin = pools.get(entry.pinned_pool)
-                    pool = entry.pinned_pool if pin is not None and \
-                        feasible(pin, used.get(entry.pinned_pool, 0),
-                                 entry.demand) else None
+                    pool = (entry.pinned_pool
+                            if entry.pinned_pool in feas else None)
                     if pool is None:
                         self._park(entry, REASON_UNSCHEDULABLE,
                                    f"pinned pool {entry.pinned_pool} is "
                                    "absent, mismatched, or lacks free "
                                    "chips", nb, park_events)
                         continue
+                    policy_attrs["policy"] = "pinned"
                 else:
-                    pool = best_fit(pools, used, entry.demand)
+                    pool = None
+                    if self._chooser is not None and feas:
+                        try:
+                            # len-1: THIS entry is still queued here,
+                            # but the journal row below records the
+                            # depth after its removal — the chooser
+                            # must see the feature exactly as the
+                            # training rows encode it (features.py's
+                            # train/serve-identical contract)
+                            choice = self._chooser.choose(
+                                pools, used, entry.demand, feas,
+                                queue_depth=len(self._queue) - 1,
+                            )
+                        except Exception:  # noqa: BLE001 — a stale-
+                            # width/corrupt checkpoint must degrade to
+                            # best_fit, never wedge the placement pass
+                            # (this runs under the scheduler lock)
+                            log.exception("policy chooser failed; "
+                                          "falling back to best_fit")
+                            choice = None
+                            self._chooser.abstain_reason = \
+                                "policy-error"
+                        if choice is not None and choice.pool in feas:
+                            # in feas by construction (the mask lives
+                            # inside the model); the re-check is the
+                            # belt that turns a policy bug into a
+                            # fallback instead of a double booking
+                            pool = choice.pool
+                            policy_attrs = {"policy": "learned",
+                                            "scores": choice.scores}
+                        else:
+                            policy_attrs = {
+                                "policy": "best_fit",
+                                "fallback": (
+                                    "illegal-choice"
+                                    if choice is not None
+                                    else self._chooser.abstain_reason),
+                            }
+                    if pool is None:
+                        pool = best_fit(pools, used, entry.demand)
+                        policy_attrs.setdefault("policy", "best_fit")
                     if pool is None:
                         self._park(entry, REASON_UNSCHEDULABLE,
                                    f"no {entry.demand.slice_class} pool "
@@ -631,15 +716,29 @@ class SchedulerReconciler(Reconciler):
                     priority=entry.priority, seq=self._assign_seq,
                 )
                 self._unstamped.add(entry.key)
-                # the (inventory-state, decision) tuple a learned
-                # placement policy trains on (docs/scheduler.md RL hook):
-                # free chips per pool AS SEEN at decision time
+                # the (inventory-state, decision) tuple the learned
+                # placement policy trains on — the PINNED
+                # sched-journal/v1 row (scheduler/policy/features.py
+                # asserts these field names; a rename here rots the
+                # training set): free chips per pool AS SEEN at
+                # decision time, pool capacities, the shared
+                # feasibility mask, the demand shape, and which policy
+                # decided (with its score vector when learned)
                 decision_state = {
+                    "schema": JOURNAL_SCHEMA,
                     "free_chips": {
                         p: pools[p].total_chips - used.get(p, 0)
                         for p in sorted(pools)
                     },
+                    "total_chips": {
+                        p: pools[p].total_chips for p in sorted(pools)
+                    },
+                    "feasible": feas,
+                    "demand_chips": entry.demand.total_chips,
+                    "demand_hosts": entry.demand.num_hosts,
+                    "slice_class": entry.demand.slice_class,
                     "queue_depth": len(self._queue),  # O(1), lock held
+                    **policy_attrs,
                 }
                 placed.append((entry, pool, decision_state))
                 live.pop(entry.key, None)

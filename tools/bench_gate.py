@@ -410,6 +410,125 @@ def failover_gate(run: dict) -> list[str]:
     return failures
 
 
+#: the learned-placement A/B family (cpbench/policy.py): both members
+#: must be present under --policy — the fragmentation-heavy variant is
+#: exactly the shape a policy regression hides in
+POLICY_SCENARIOS = ("sched_policy", "sched_policy_frag")
+#: smoke-scale attainment is quantized (one sample moves it by 1/n);
+#: the learned arm may trail best_fit by at most max(this, one
+#: sample's worth) before the leg calls it "worse" — at --full scale
+#: one sample is 1/48 and the comparison tightens automatically
+POLICY_ATTAINMENT_SLACK = 0.051
+
+
+def policy_gate(run: dict) -> list[str]:
+    """--policy leg over the sched_policy A/B family:
+
+    - both family members present, each with a best_fit AND a learned
+      arm (a missing learned arm usually means training failed — the
+      recorded ``train_error`` is quoted);
+    - per arm: ``double_bookings`` reported and 0 (chip-accounted —
+      the one invariant that matters), the workload drained, ttp
+      p50/p95 present, fragmentation reported;
+    - learned arm: ``illegal_choices`` reported and 0 (a learned pick
+      outside the shared feasibility mask — unrepresentable by
+      construction, and this counter is the proof), and > 0 actual
+      learned decisions (an all-fallback arm is not an A/B);
+    - SLO attainment no worse: per objective, the learned arm may not
+      miss one best_fit met, nor trail its attainment beyond the
+      smoke-quantization slack."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    for name in POLICY_SCENARIOS:
+        s = scenarios.get(name)
+        if s is None:
+            failures.append(f"{name}: missing from run — no learned-"
+                            "placement A/B evidence")
+            continue
+        extra = s.get("extra") or {}
+        arms = extra.get("arms") or {}
+        learned = arms.get("learned")
+        if learned is None:
+            failures.append(
+                f"{name}: no learned arm — training failed? "
+                f"(train_error={extra.get('train_error')!r})"
+            )
+        for arm_name in ("best_fit", "learned"):
+            arm = arms.get(arm_name)
+            if arm is None:
+                if arm_name == "best_fit":
+                    failures.append(f"{name}: no best_fit arm")
+                continue
+            db = arm.get("double_bookings")
+            if db is None or db > 0:
+                failures.append(
+                    f"{name}/{arm_name}: double_bookings={db} (must "
+                    "be reported and 0)"
+                )
+            if not arm.get("drained"):
+                failures.append(
+                    f"{name}/{arm_name}: workload did not drain — "
+                    "placements stalled"
+                )
+            ttp = arm.get("ttp_ms") or {}
+            if "p50" not in ttp or "p95" not in ttp:
+                failures.append(
+                    f"{name}/{arm_name}: ttp_ms p50/p95 missing"
+                )
+            frag = arm.get("fragmentation") or {}
+            if not frag.get("decisions") \
+                    or frag.get("leftover_chips_mean") is None \
+                    or frag.get("stranded_free_chips_mean") is None:
+                failures.append(
+                    f"{name}/{arm_name}: fragmentation record "
+                    "absent/empty — no leftover-chip evidence"
+                )
+        if learned is None:
+            continue
+        illegal = learned.get("illegal_choices")
+        if illegal is None or illegal > 0:
+            failures.append(
+                f"{name}: illegal_choices={illegal} — the policy "
+                "chose (or would have chosen) a pool the shared "
+                "feasibility check rejects (must be reported and 0)"
+            )
+        n_learned = (learned.get("decisions") or {}).get("learned", 0)
+        if not n_learned:
+            failures.append(
+                f"{name}: 0 learned decisions — every placement fell "
+                f"back to best_fit (fallbacks="
+                f"{learned.get('fallbacks')}); the arm judged nothing"
+            )
+        base_slo = (arms.get("best_fit") or {}).get("slo") or {}
+        learned_slo = learned.get("slo") or {}
+        for objective in sorted(base_slo):
+            base = base_slo[objective]
+            got = learned_slo.get(objective)
+            if got is None:
+                failures.append(
+                    f"{name}: learned arm has no {objective} SLO "
+                    "record while best_fit does"
+                )
+                continue
+            base_att = base.get("attainment") or 0.0
+            got_att = got.get("attainment") or 0.0
+            # one-sample tolerance: at smoke n a single quantum is
+            # 1/n, which can exceed the flat slack — a lone missed
+            # sample must not flake CI (met derives from attainment,
+            # so the attainment comparison subsumes a met flip)
+            slack = max(POLICY_ATTAINMENT_SLACK,
+                        1.0 / max(got.get("n") or 1, 1) + 1e-6)
+            if got_att < base_att - slack:
+                failures.append(
+                    f"{name}: learned {objective} attainment "
+                    f"{got_att} worse than best_fit's {base_att} "
+                    f"(beyond the {round(slack, 4)} one-sample "
+                    "slack) — the policy loses to the heuristic it "
+                    "replaced"
+                )
+    return failures
+
+
 #: passes each lint report must PROVE ran (names in report["passes"]),
 #: keyed by report schema — the three ISSUE 13 cplint dataflow passes
 #: plus the five ISSUE 14 jaxlint passes: a report written by an older
@@ -560,6 +679,13 @@ def main(argv=None) -> int:
                          "unless one report of each schema is given, so "
                          "dropping an analyzer can't read as clean); "
                          "usable alone or alongside the bench legs")
+    ap.add_argument("--policy", action="store_true",
+                    help="fail on missing/violated learned-placement "
+                         "A/B evidence in --run (cpbench --policy; "
+                         "both sched_policy scenarios, 0 double "
+                         "bookings and 0 illegal choices per arm, "
+                         "learned SLO attainment no worse than "
+                         "best_fit; composes with the other legs)")
     ap.add_argument("--failover", action="store_true",
                     help="fail on missing/violated failover p95, dual "
                          "reconciles or orphaned keys in the ha_scale "
@@ -628,6 +754,8 @@ def main(argv=None) -> int:
             ap.error("--slo-report requires --run")
         if args.failover:
             ap.error("--failover requires --run")
+        if args.policy:
+            ap.error("--policy requires --run")
         if args.prof_report:
             ap.error("--prof-report requires --run")
         if args.store_lock_max_share is not None:
@@ -645,6 +773,8 @@ def main(argv=None) -> int:
         failures += slo_gate(run)
     if run is not None and args.failover:
         failures += failover_gate(run)
+    if run is not None and args.policy:
+        failures += policy_gate(run)
     if args.store_lock_max_share is not None and not args.prof_report:
         # the share rides the per-scenario prof records: requesting it
         # without the leg that reads them is a misconfigured CI step
@@ -658,13 +788,15 @@ def main(argv=None) -> int:
     elif run is not None and (args.baseline
                               or not (args.slo_report
                                       or args.prof_report
-                                      or args.failover)):
+                                      or args.failover
+                                      or args.policy)):
         # latency legs need the committed record; a pure --slo-report /
-        # --prof-report / --failover invocation legitimately runs
-        # without one
+        # --prof-report / --failover / --policy invocation legitimately
+        # runs without one
         if not args.baseline:
             ap.error("--baseline is required unless --chaos-only, "
-                     "--slo-report, --prof-report or --failover")
+                     "--slo-report, --prof-report, --failover or "
+                     "--policy")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -709,6 +841,23 @@ def main(argv=None) -> int:
                   f"p95 ratio {a.get('protected_p95_ratio')} with "
                   f"storm squeezed to {a.get('storm_throughput_ratio')}"
                   " of unthrottled", file=sys.stderr)
+        if run is not None and args.policy:
+            for name in POLICY_SCENARIOS:
+                arms = (run["scenarios"][name]["extra"]["arms"])
+                bf, ln = arms["best_fit"], arms["learned"]
+                print(
+                    f"bench_gate ok: {name} ttp p50/p95 best_fit "
+                    f"{bf['ttp_ms'].get('p50', float('nan')):.0f}/"
+                    f"{bf['ttp_ms'].get('p95', float('nan')):.0f} ms "
+                    f"vs learned "
+                    f"{ln['ttp_ms'].get('p50', float('nan')):.0f}/"
+                    f"{ln['ttp_ms'].get('p95', float('nan')):.0f} ms, "
+                    f"stranded free chips "
+                    f"{bf['fragmentation']['stranded_free_chips_mean']}"
+                    f" vs "
+                    f"{ln['fragmentation']['stranded_free_chips_mean']}"
+                    f", 0 double bookings / 0 illegal choices",
+                    file=sys.stderr)
         if run is not None and args.prof_report:
             ov = run.get("profiler_overhead") or {}
             print(f"bench_gate ok: cpprof attribution present in all "

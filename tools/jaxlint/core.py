@@ -34,12 +34,16 @@ from tools.cplint.core import (  # noqa: F401  (re-exports for passes)
     run_passes,
 )
 
-#: the JAX half of the tree — the ONE place the scan scope lives
+#: the JAX half of the tree — the ONE place the scan scope lives.
+#: scheduler/policy is the control plane's one JAX consumer (the
+#: learned-placement training loop, docs/scheduler.md): its policy-
+#: training code lands under the same five-pass discipline as train/
 JAX_ROOTS = (
     "service_account_auth_improvements_tpu/train",
     "service_account_auth_improvements_tpu/parallel",
     "service_account_auth_improvements_tpu/ops",
     "service_account_auth_improvements_tpu/models",
+    "service_account_auth_improvements_tpu/controlplane/scheduler/policy",
 )
 
 #: the mesh builder module the mesh-axis pass reads declarations from
