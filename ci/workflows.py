@@ -352,11 +352,32 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "--scenario chaos_node_death "
                     "--scenario chaos_kubelet_stall "
                     "--scenario chaos_429_storm "
+                    "--scenario chaos_park_blackout "
                     "--out chaos_out.json --dump-dir bench_out"},
             {"name": "Chaos invariant gate",
              "run": "python tools/bench_gate.py "
                     "--baseline CONTROLPLANE_BENCH.json "
                     "--run chaos_out.json --chaos-only --slo-report"},
+            # parking smoke: the park_resume family (cpbench/park.py)
+            # — park/resume latency percentiles, thundering-herd
+            # resume storm, park-during-gang, oversubscription A/B —
+            # then the park gate: every parked notebook resumed, 0
+            # lost checkpoints / 0 double bookings / 0 pods while
+            # parked, resume-latency SLO met, and the headline:
+            # oversubscription ratio ≥1.5× with SLO attainment no
+            # worse than the non-oversubscribed baseline arm
+            # (docs/scheduler.md "Oversubscription & parking")
+            {"name": "Run cpbench park --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke "
+                    "--scenario park_resume_cycle "
+                    "--scenario park_resume_storm "
+                    "--scenario park_during_gang "
+                    "--scenario park_oversubscribe "
+                    "--out park_out.json --dump-dir bench_out"},
+            {"name": "Park/oversubscription gate",
+             "run": "python tools/bench_gate.py "
+                    "--run park_out.json --park"},
             # HA smoke: the sharded-plane family (cpbench/ha.py) —
             # replica sweep, leader-kill failover, APF A/B — then the
             # failover gate: failover p95 within SLO, 0 dual reconciles
@@ -410,6 +431,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "uses": "actions/upload-artifact@v4",
              "with": {"name": "controlplane-bench",
                       "path": "bench_out.json\nchaos_out.json\n"
+                              "park_out.json\n"
                               "ha_out.json\npolicy_out.json\n"
                               "cplint_report.json\n"
                               "jaxlint_report.json\n"

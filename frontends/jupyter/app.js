@@ -50,6 +50,13 @@
       const columns = [
         { title: "Status", render: (nb) => {
             const icon = statusIcon(nb.status.phase, nb.status.message);
+            if (nb.status.phase === "parked") {
+              // checkpoint-parked (scale-to-zero), not a dead stop:
+              // state is committed and Start restores it — say so in
+              // the row, not just the tooltip
+              icon.appendChild(document.createTextNode(
+                " (resume on open)"));
+            }
             if (nb.queue && nb.queue.position) {
               // tpusched parking: show where the notebook stands instead
               // of an unexplained Pending (reason lives in the tooltip)
@@ -73,7 +80,11 @@
 
     function rowActions(nb) {
       const row = el("div", { class: "row" });
-      const stopped = nb.status.phase === "stopped";
+      // parked is a stopped state with committed checkpoint state: the
+      // same Start action resumes it (the backend stamps the
+      // resume-request when it sees the checkpoint annotation)
+      const stopped = nb.status.phase === "stopped" ||
+        nb.status.phase === "parked";
       row.appendChild(el("button", {
         onclick: async () => {
           try {

@@ -19,11 +19,18 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
     NotebookMetrics,
     NotebookReconciler,
 )
+from service_account_auth_improvements_tpu.controlplane.parking import (
+    Parker,
+    ParkStore,
+)
 from service_account_auth_improvements_tpu.controlplane.scheduler import (
     SchedulerMetrics,
     SchedulerReconciler,
 )
-from service_account_auth_improvements_tpu.utils.env import get_env_bool
+from service_account_auth_improvements_tpu.utils.env import (
+    get_env_bool,
+    get_env_default,
+)
 
 
 def _add_args(parser):
@@ -46,7 +53,14 @@ def _register(client, manager, args):
     metrics = NotebookMetrics()
     NotebookReconciler(client, metrics).register(manager)
     if get_env_bool("ENABLE_CULLING", False):
-        CullingReconciler(client, metrics).register(manager)
+        # checkpoint-park (docs/scheduler.md "Oversubscription &
+        # parking") is wired by PARK_STORE_DIR; without it every idle
+        # decision stays a plain cull and park requests are ignored —
+        # tpusched's oversubscription mode requires this to be set on
+        # the culling member or victims never actually release chips
+        park_dir = get_env_default("PARK_STORE_DIR", "")
+        parker = Parker(ParkStore(park_dir)) if park_dir else None
+        CullingReconciler(client, metrics, parker=parker).register(manager)
     if get_env_bool("ENABLE_SCHEDULER", False):
         # metrics on the global REGISTRY so the ops endpoint exports the
         # queue depth / time-to-placement / preemption series

@@ -28,11 +28,27 @@ TPU additions (proposals/20260729-tpu-aware-culling.md):
 - tpusched interop: a notebook parked in the admission queue
   (``Scheduled=False`` — controlplane/scheduler) is skipped entirely. It
   has no kernels and looks idle, but it holds no chips, and stamping the
-  stop annotation would silently drop it out of the queue it waits in.
+  stop annotation would silently drop it out of the queue it waits in;
+- the **park verb** (controlplane/parking): with a :class:`Parker`
+  wired, an idle notebook whose culling policy allows it is
+  *checkpoint-parked* instead of plain-stopped — state committed to the
+  park store FIRST, then one patch stamps stop + parked + checkpoint
+  ref (crash between the two leaves a running notebook and an orphaned
+  checkpoint, never a stopped notebook with no state). The culler is
+  also the single park EXECUTOR for scheduler-requested parks
+  (oversubscription / preempt-park: tpusched stamps
+  ``park-requested``, this controller checkpoints and stops) and the
+  resume FINISHER (stop cleared + ``resume-requested`` stamped →
+  restore from the ref, clear the park annotations, feed the
+  resume-latency SLO). A resume racing an in-flight park request
+  cancels the park — the notebook never stopped, nothing to restore.
 
 Env knobs (reference :30-40, :405): CULL_IDLE_TIME (minutes, default 1440),
 IDLENESS_CHECK_PERIOD (minutes, default 1), CLUSTER_DOMAIN, DEV,
-CULL_UNREACHABLE_LIMIT (consecutive failures, default 30, 0 disables).
+CULL_UNREACHABLE_LIMIT (consecutive failures, default 30, 0 disables),
+CULL_PARK_DEFAULT (park idle notebooks by default when a parker is
+wired; per-notebook ``tpukf.dev/culling-policy: park`` opts in
+regardless).
 """
 
 from __future__ import annotations
@@ -57,7 +73,12 @@ from service_account_auth_improvements_tpu.controlplane.events import (
 from service_account_auth_improvements_tpu.controlplane.kube import errors
 from service_account_auth_improvements_tpu.controlplane.metrics import Registry
 from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane import parking
+from service_account_auth_improvements_tpu.controlplane.parking import (
+    CheckpointError,
+)
 from service_account_auth_improvements_tpu.utils.env import (
+    get_env_bool,
     get_env_default,
     get_env_int,
 )
@@ -103,12 +124,17 @@ class CullingReconciler(Reconciler):
     group = GROUP
 
     def __init__(self, kube, metrics: NotebookMetrics | None = None,
-                 fetch_kernels=default_fetch_kernels, now=None):
+                 fetch_kernels=default_fetch_kernels, now=None,
+                 parker=None):
         self.kube = kube
         self.metrics = metrics or NotebookMetrics(Registry())
         self.recorder = EventRecorder(kube, "culling-controller")
         self.fetch_kernels = fetch_kernels
         self.now = now or (lambda: dt.datetime.now(dt.timezone.utc))
+        #: controlplane/parking Parker; None = parking disabled (every
+        #: idle decision stays a plain cull, park requests are ignored)
+        self.parker = parker
+        self.park_default = get_env_bool("CULL_PARK_DEFAULT", False)
         self.cull_idle_minutes = get_env_int("CULL_IDLE_TIME", 1440)
         self.check_period_minutes = get_env_int("IDLENESS_CHECK_PERIOD", 1)
         self.cluster_domain = get_env_default("CLUSTER_DOMAIN", "cluster.local")
@@ -155,8 +181,33 @@ class CullingReconciler(Reconciler):
         annots = nb["metadata"].get("annotations") or {}
         if STOP_ANNOTATION in annots:
             return Result()  # already stopped; resume clears and re-enqueues
+        if self.parker is not None and \
+                parking.RESUME_REQUESTED_ANNOTATION in annots:
+            # resume in progress (stop cleared on a parked notebook):
+            # restore from the ref, clear the park state, feed the SLO.
+            # Checked before every other branch — a resume must finish
+            # even for training-policy notebooks, and it WINS the race
+            # against any in-flight park request (the notebook never
+            # stopped; _finish_resume cancels the request).
+            return self._finish_resume(req, nb, annots, period)
         if annots.get(CULLING_POLICY) in ("training", "disabled"):
+            if self.parker is not None and \
+                    parking.PARK_REQUESTED_ANNOTATION in annots:
+                # a park request against an opted-out notebook (raced
+                # policy edit): the policy wins — cancel loudly
+                self._cancel_park(req, nb, "culling-policy forbids parking")
             return Result(requeue_after=period.total_seconds())
+        if self.parker is not None and \
+                parking.PARK_REQUESTED_ANNOTATION in annots:
+            # tpusched asked (oversubscription or preempt-park): this
+            # controller is the single park executor — checkpoint, then
+            # stop, regardless of kernel business (preemption semantics)
+            return self._execute_park(
+                req, nb, annots,
+                annots.get(parking.PARK_REQUESTED_ANNOTATION)
+                or parking.PARK_PREEMPTED,
+                period,
+            )
         if self._is_queued(nb):
             # Parked by tpusched (Scheduled=False): the notebook has no
             # pods, no kernels, and looks maximally idle — but it holds
@@ -247,6 +298,16 @@ class CullingReconciler(Reconciler):
 
         idle_for = now - last_activity
         if idle_for > dt.timedelta(minutes=self.cull_idle_minutes):
+            if self._park_allowed(annots):
+                # park verb: same trigger as the cull, but the chips
+                # come back resumable — checkpoint commits inside
+                # _execute_park BEFORE any stop annotation lands (the
+                # probe-timestamp patch is folded into the park patch)
+                return self._execute_park(req, nb, annots,
+                                          parking.PARK_IDLE, period,
+                                          kernels=kernels,
+                                          idle_for=idle_for,
+                                          base_patch=patch)
             patch["metadata"]["annotations"][STOP_ANNOTATION] = (
                 now.strftime(TIME_FMT)
             )
@@ -265,6 +326,163 @@ class CullingReconciler(Reconciler):
         self.kube.patch("notebooks", req.name, patch,
                         namespace=req.namespace, group=GROUP)
         return Result(requeue_after=period.total_seconds())
+
+    # ------------------------------------------------------- park / resume
+
+    def _park_allowed(self, annots: dict) -> bool:
+        """Idle-park eligibility: a parker is wired AND the notebook
+        opted in (``culling-policy: park``) or the deployment parks by
+        default with no policy set."""
+        if self.parker is None:
+            return False
+        policy = annots.get(CULLING_POLICY)
+        if policy == parking.POLICY_PARK:
+            return True
+        return self.park_default and policy is None
+
+    def _execute_park(self, req: Request, nb: dict, annots: dict,
+                      reason: str, period, kernels=None,
+                      idle_for=None, base_patch=None) -> Result:
+        """The park verb: COMMIT the checkpoint, then stamp stop +
+        parked + checkpoint ref in ONE patch. Ordering is the crash
+        invariant — a Manager death between the save and the patch
+        leaves a running notebook plus an orphaned checkpoint (this
+        reconcile retries), never a stopped notebook with no state."""
+        now = self.now()
+        key = obs.object_key("notebooks", req.namespace, req.name)
+        try:
+            ref = self.parker.park(nb, kernels)
+        except Exception as e:  # noqa: BLE001 — a failed save must
+            # never stop the notebook; retry on the probe cadence
+            self.recorder.event(
+                nb, "Warning", parking.REASON_PARK_CANCELLED,
+                f"park checkpoint failed ({e}); notebook left running",
+            )
+            obs.decide("park", key=key,
+                       reason=parking.REASON_PARK_CANCELLED,
+                       park_reason=reason, outcome="checkpoint-failed")
+            return Result(requeue_after=period.total_seconds())
+        patch = base_patch or {"metadata": {"annotations": {}}}
+        patch["metadata"]["annotations"].update({
+            STOP_ANNOTATION: now.strftime(TIME_FMT),
+            parking.PARKED_ANNOTATION: now.strftime(TIME_FMT),
+            parking.CHECKPOINT_ANNOTATION: ref,
+            parking.PARK_REASON_ANNOTATION: reason,
+            parking.PARK_REQUESTED_ANNOTATION: None,
+        })
+        try:
+            self.kube.patch("notebooks", req.name, patch,
+                            namespace=req.namespace, group=GROUP)
+        except errors.NotFound:
+            return Result()
+        self.metrics.parked.labels(req.namespace).inc()
+        detail = (f" after {idle_for.total_seconds() / 3600:.1f}h idle"
+                  if idle_for is not None else "")
+        self.recorder.event(
+            nb, "Normal", parking.REASON_PARKED,
+            f"Parked ({reason}){detail}; checkpoint {ref} — "
+            "chips released, resume on open",
+        )
+        obs.decide(
+            "park", key=key, reason=parking.REASON_PARKED,
+            park_reason=reason, checkpoint=ref,
+            **({"idle_s": round(idle_for.total_seconds(), 1)}
+               if idle_for is not None else {}),
+        )
+        return Result(requeue_after=period.total_seconds())
+
+    def _finish_resume(self, req: Request, nb: dict, annots: dict,
+                       period) -> Result:
+        """Resume finisher: restore from the committed ref, clear the
+        park annotations, observe resume latency. Clears any in-flight
+        park request too (resume wins the park/resume race — nothing
+        stopped, nothing to re-checkpoint)."""
+        now = self.now()
+        key = obs.object_key("notebooks", req.namespace, req.name)
+        ref = annots.get(parking.CHECKPOINT_ANNOTATION)
+        clear = {
+            parking.RESUME_REQUESTED_ANNOTATION: None,
+            parking.PARKED_ANNOTATION: None,
+            parking.PARK_REASON_ANNOTATION: None,
+            parking.PARK_REQUESTED_ANNOTATION: None,
+            parking.PARKED_FOR_ANNOTATION: None,
+            parking.CHECKPOINT_ANNOTATION: None,
+        }
+        state = None
+        if ref:
+            try:
+                state = self.parker.restore(ref)
+            except CheckpointError as e:
+                # lost checkpoint: surface it LOUDLY, then clear the
+                # park state so the notebook comes back fresh instead
+                # of wedging on a ref nothing can serve (the chaos gate
+                # counts these via the journal outcome)
+                self.recorder.event(
+                    nb, "Warning", parking.REASON_RESUME_FAILED,
+                    f"checkpoint {ref} unrestorable ({e}); "
+                    "resuming with a fresh server state",
+                )
+                obs.decide("resume", key=key,
+                           reason=parking.REASON_RESUME_FAILED,
+                           outcome="lost-checkpoint", checkpoint=ref)
+                try:
+                    self.kube.patch(
+                        "notebooks", req.name,
+                        {"metadata": {"annotations": clear}},
+                        namespace=req.namespace, group=GROUP,
+                    )
+                except errors.NotFound:
+                    pass
+                return Result(requeue_after=period.total_seconds())
+        requested = _parse_time(
+            annots.get(parking.RESUME_REQUESTED_ANNOTATION, "")
+        )
+        latency_ms = None
+        if requested is not None:
+            latency_ms = max((now - requested).total_seconds(), 0.0) * 1000.0
+        try:
+            self.kube.patch("notebooks", req.name,
+                            {"metadata": {"annotations": clear}},
+                            namespace=req.namespace, group=GROUP)
+        except errors.NotFound:
+            return Result()
+        self.metrics.resumed.labels(req.namespace).inc()
+        if latency_ms is not None:
+            # the resume-latency SLO sample (obs/slo.py): resume request
+            # (stop cleared) -> state restored into the control plane
+            obs.slo_observe("resume_latency", latency_ms)
+        self.recorder.event(
+            nb, "Normal", parking.REASON_RESUMED,
+            (f"Resumed from checkpoint {ref}" if ref
+             else "Resume requested with no checkpoint; starting fresh"),
+        )
+        obs.decide(
+            "resume", key=key, reason=parking.REASON_RESUMED,
+            checkpoint=ref or "",
+            restored_kernels=len((state or {}).get("kernels") or ()),
+            **({"resume_latency_ms": round(latency_ms, 3)}
+               if latency_ms is not None else {}),
+        )
+        return Result(requeue_after=period.total_seconds())
+
+    def _cancel_park(self, req: Request, nb: dict, why: str) -> None:
+        try:
+            self.kube.patch(
+                "notebooks", req.name,
+                {"metadata": {"annotations": {
+                    parking.PARK_REQUESTED_ANNOTATION: None,
+                }}}, namespace=req.namespace, group=GROUP,
+            )
+        except errors.NotFound:
+            return
+        self.recorder.event(nb, "Normal", parking.REASON_PARK_CANCELLED,
+                            f"park request cancelled: {why}")
+        obs.decide(
+            "park",
+            key=obs.object_key("notebooks", req.namespace, req.name),
+            reason=parking.REASON_PARK_CANCELLED, outcome="cancelled",
+            detail=why,
+        )
 
     @staticmethod
     def _is_queued(nb: dict) -> bool:

@@ -49,6 +49,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import (
     Registry,
 )
 from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane import parking
 from service_account_auth_improvements_tpu.utils.env import (
     get_env_bool,
     get_env_default,
@@ -131,6 +132,16 @@ class NotebookMetrics:
         )
         self.culled = Counter(
             "notebook_culled_total", "Notebooks culled", ("namespace",),
+            registry=registry,
+        )
+        self.parked = Counter(
+            "notebook_parked_total",
+            "Notebooks checkpoint-parked (scale-to-zero)", ("namespace",),
+            registry=registry,
+        )
+        self.resumed = Counter(
+            "notebook_resumed_total",
+            "Notebooks resumed from a park checkpoint", ("namespace",),
             registry=registry,
         )
 
@@ -983,6 +994,17 @@ class NotebookReconciler(Reconciler):
             status["conditions"] = (
                 status["conditions"] + [gang_cond]
             )[-MAX_STATUS_CONDITIONS:]
+        annots = nb["metadata"].get("annotations") or {}
+        if self._stopped(nb) and parking.PARKED_ANNOTATION in annots:
+            # checkpoint-parked, not merely stopped: the phase + ref make
+            # the state queryable (explainz verdict, dashboard "Parked
+            # (resume on open)") without reading annotations. The status
+            # dict is rebuilt from scratch every refresh, so both keys
+            # vanish naturally once the resume clears the annotations.
+            status["phase"] = "Parked"
+            ref = annots.get(parking.CHECKPOINT_ANNOTATION)
+            if ref:
+                status["checkpointRef"] = ref
         if self._stopped(nb):
             self.metrics.running.labels(ns).set(0)
         else:

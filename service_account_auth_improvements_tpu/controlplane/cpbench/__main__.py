@@ -31,6 +31,9 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.chaos import (  
 from service_account_auth_improvements_tpu.controlplane.cpbench.ha import (  # noqa: E501,F401 — importing registers the ha_scale family into SCENARIOS
     HA_SCENARIOS,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.park import (  # noqa: E501,F401 — importing registers the park_resume family into SCENARIOS
+    PARK_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane.cpbench.policy import (  # noqa: E501,F401 — importing registers the sched_policy family into SCENARIOS
     POLICY_SCENARIOS,
 )
@@ -61,11 +64,16 @@ SMOKE_N = {
     "chaos_node_death": 4,    # 4 gangs, one pool dies under its gang
     "chaos_kubelet_stall": 8,
     "chaos_429_storm": 8,     # 8 gangs drained through 429 pulses
+    "chaos_park_blackout": 8,  # 4 parked + 4 queued through 2 outages
     "ha_scale": 120,          # CRs per replica arm (x3 arms: 1/2/4)
     "ha_failover": 60,        # two waves around the leader kill
     "ha_apf": 400,            # protected-lane requests per A/B arm
     "sched_policy": 12,       # per A/B arm (best_fit, then learned)
     "sched_policy_frag": 16,  # single-host churn per arm
+    "park_resume_cycle": 8,   # paced park→resume per-notebook latency
+    "park_resume_storm": 12,  # thundering-herd park/resume bursts
+    "park_during_gang": 4,    # 2 gangs parked under a second wave
+    "park_oversubscribe": 6,  # 6 gangs through 2 pools (x2 arms)
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -80,6 +88,7 @@ FULL_N = {
     "chaos_node_death": 6,
     "chaos_kubelet_stall": 16,
     "chaos_429_storm": 16,
+    "chaos_park_blackout": 16,
     "ha_scale": 10_000,       # the ROADMAP scale: 10k CRs per arm, and
                               # ~100k watch events across the 4-replica
                               # arm's informers
@@ -87,6 +96,10 @@ FULL_N = {
     "ha_apf": 3_000,
     "sched_policy": 48,       # the sched_contention --full scale
     "sched_policy_frag": 64,
+    "park_resume_cycle": 32,
+    "park_resume_storm": 48,
+    "park_during_gang": 8,
+    "park_oversubscribe": 16,
 }
 
 
@@ -116,6 +129,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "placement A/B: best_fit arm → train on its "
                          "journal → learned arm; needs the JAX half "
                          "of the tree; docs/scheduler.md) in the run")
+    ap.add_argument("--park", action="store_true",
+                    help="include the park_resume family (checkpoint-"
+                         "park/resume latency, resume storm, park-"
+                         "during-gang, oversubscription A/B; "
+                         "docs/scheduler.md 'Oversubscription & "
+                         "parking') in the run")
     ap.add_argument("--journal-out", default="", metavar="DIR",
                     help="dump each scenario's decision journal as "
                          "<DIR>/<scenario>_journal.jsonl next to the "
@@ -295,6 +314,8 @@ def run(args) -> dict:
         and (getattr(args, "ha", False) or name not in HA_SCENARIOS)
         and (getattr(args, "policy", False)
              or name not in POLICY_SCENARIOS)
+        and (getattr(args, "park", False)
+             or name not in PARK_SCENARIOS)
     )
     started = time.monotonic()
     report: dict = {

@@ -35,6 +35,8 @@ Invariant glossary and injector catalog: docs/chaos.md.
 
 from __future__ import annotations
 
+import shutil
+import tempfile
 import time
 import urllib.error
 import urllib.request
@@ -44,6 +46,9 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
 )
 from service_account_auth_improvements_tpu.controlplane.cpbench.loadgen import (  # noqa: E501
     LoadGenerator,
+)
+from service_account_auth_improvements_tpu.controlplane.cpbench import (
+    park as park_bench,
 )
 from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import (  # noqa: E501
     SCENARIOS,
@@ -64,7 +69,10 @@ from service_account_auth_improvements_tpu.controlplane.kube.chaos import (
 from service_account_auth_improvements_tpu.controlplane.metrics import (
     Registry,
 )
-from service_account_auth_improvements_tpu.controlplane import tpu as tpu_mod
+from service_account_auth_improvements_tpu.controlplane import (
+    parking,
+    tpu as tpu_mod,
+)
 
 
 # ------------------------------------------------------ invariant helpers
@@ -820,12 +828,166 @@ def _run_chaos_429_storm(cfg, world, chaos, rec, ns, pools, started,
     }, schedule=schedule)
 
 
+def scenario_chaos_park_blackout(cfg: BenchConfig) -> ScenarioResult:
+    """Parked checkpoints survive a blackout. Half the fleet is placed
+    and Ready on one-slice pools, the other half queued behind them.
+    Park requests are stamped on every placed notebook and the apiserver
+    goes dark (every verb 503, watch channels severed) while the
+    culler's checkpoint+stop patches are in flight; a second outage
+    lands the same way mid-resume. Invariants: zero lost checkpoints
+    (every Parked CR's ref still restores), zero CRs stopped-with-parked
+    but missing their checkpoint ref (the single-patch commit held
+    through the outage), zero double bookings while freed pools re-admit
+    the waiters, and every parked notebook both parks and resumes after
+    lights-on."""
+    started = time.monotonic()
+    store = tempfile.mkdtemp(prefix="cpbench-park-chaos-")
+    try:
+        return _run_chaos_park_blackout(cfg, started, store)
+    finally:
+        shutil.rmtree(store, ignore_errors=True)
+
+
+def _park_observe(fn, default):
+    """Bench-side observation during an outage: the poll reads ride the
+    same apiserver the blackout is 503ing, so an unobservable tick
+    reports ``default`` instead of crashing the scenario — nothing the
+    tick would have seen can change until the lights come back on."""
+    try:
+        return fn()
+    except errors.ApiError:
+        return default
+
+
+def _run_chaos_park_blackout(cfg: BenchConfig, started: float,
+                             store: str) -> ScenarioResult:
+    world = park_bench._mk_park_world(cfg, "chaos_park_blackout", store,
+                                      scheduler=True)
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
+    rec = RecoveryTracker()
+    try:
+        world.start()
+        ns = "bench"
+        n = max(2, cfg.n - cfg.n % 2)
+        pools = [f"pkbo-pool-{i}" for i in range(n // 2)]
+        for p in pools:
+            # one 2x2 slice per pool: >1 booking on a pool is a double
+            # booking by construction
+            _mk_pool(world.kube, p, hosts=1, chips="4", topology="2x2")
+        tpu = {"generation": "v5e", "topology": "2x2"}
+        names = [f"pkbo-{i:02d}" for i in range(n)]
+        gen = LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate)
+        gen.run(world.create_jobs(names, ns, tpu, want_ready=1))
+        # capacity fits exactly half: wait for that many Ready, the rest
+        # hold queue positions behind them
+        first: list[str] = []
+        deadline = time.monotonic() + cfg.timeout
+        while time.monotonic() < deadline and len(first) < len(pools):
+            first = [nm for nm in names
+                     if (r := world.tracker.record(ns, nm)) is not None
+                     and r.ready is not None]
+            time.sleep(0.05)
+        ok = len(first) == len(pools)
+        waiters = [nm for nm in names if nm not in first]
+
+        # stamp park requests, then lights out while the checkpoint+stop
+        # patches are in flight on the culler's cadence
+        for nm in first:
+            park_bench._request_park(world, ns, nm)
+        lights_on = time.monotonic() + cfg.chaos_window_s
+        chaos.start_blackout(cfg.chaos_window_s, sever=True)
+        double_bookings = 0
+        parked: set[str] = set()
+        deadline = time.monotonic() + cfg.timeout + cfg.chaos_window_s
+        while time.monotonic() < deadline and len(parked) < len(first):
+            for nm in first:
+                if nm in parked:
+                    continue
+                a = _park_observe(
+                    lambda nm=nm: park_bench._annots(world, ns, nm),
+                    None)
+                if a is not None and park_bench._is_parked(a):
+                    parked.add(nm)
+                    rec.note_recovery("park", max(
+                        0.0, (time.monotonic() - lights_on) * 1000.0))
+            double_bookings = max(double_bookings, _park_observe(
+                lambda: park_bench._audit_double_bookings(world, ns), 0))
+            time.sleep(0.05)
+        if len(parked) < len(first):
+            rec.violation("park_never_completed",
+                          len(first) - len(parked))
+        # mid-park atomicity: parked-but-checkpointless would mean the
+        # outage tore the single-patch commit apart
+        torn = 0
+        for nm in first:
+            a = park_bench._annots(world, ns, nm) or {}
+            if parking.PARKED_ANNOTATION in a and \
+                    parking.CHECKPOINT_ANNOTATION not in a:
+                torn += 1
+        if torn:
+            rec.violation("stopped_without_checkpoint", torn)
+        lost = park_bench._lost_checkpoints(world, ns, names)
+        if lost:
+            rec.violation("lost_checkpoint", lost)
+        # the parks freed real chips: the queued half must place and
+        # converge on the released pools
+        ok = world.tracker.wait_ready(
+            [(ns, nm) for nm in waiters], cfg.timeout) and ok
+
+        # drain the second wave, then a second outage mid-resume
+        for nm in waiters:
+            try:
+                world.kube.delete("notebooks", nm, namespace=ns,
+                                  group=GROUP)
+            except errors.NotFound:
+                pass
+        for nm in sorted(parked):
+            park_bench._request_resume(world, ns, nm)
+        lights_on = time.monotonic() + cfg.chaos_window_s
+        chaos.start_blackout(cfg.chaos_window_s, sever=True)
+        resumed: set[str] = set()
+        deadline = time.monotonic() + cfg.timeout + cfg.chaos_window_s
+        while time.monotonic() < deadline and len(resumed) < len(parked):
+            for nm in sorted(parked):
+                if nm not in resumed and _park_observe(
+                        lambda nm=nm: park_bench._is_resumed(
+                            world, ns, nm, 1), False):
+                    resumed.add(nm)
+                    rec.note_recovery("resume", max(
+                        0.0, (time.monotonic() - lights_on) * 1000.0))
+            double_bookings = max(double_bookings, _park_observe(
+                lambda: park_bench._audit_double_bookings(world, ns), 0))
+            time.sleep(0.05)
+        if len(resumed) < len(parked):
+            rec.violation("resume_never_completed",
+                          len(parked) - len(resumed))
+        lost_after = park_bench._lost_checkpoints(world, ns, names)
+        if lost_after:
+            rec.violation("lost_checkpoint_post_resume", lost_after)
+        ok = (ok and torn == 0 and lost == 0 and lost_after == 0
+              and double_bookings == 0
+              and len(parked) == len(first)
+              and len(resumed) == len(parked))
+        return _chaos_result(world, cfg, started, ok, rec, chaos, {
+            "pools": len(pools),
+            "parked": len(parked),
+            "resumed": len(resumed),
+            "double_bookings": double_bookings,
+            "lost_checkpoints": lost + lost_after,
+            "stopped_without_checkpoint": torn,
+        })
+    finally:
+        world.stop()
+
+
 CHAOS_SCENARIOS = {
     "chaos_relist": scenario_chaos_relist,
     "chaos_blackout": scenario_chaos_blackout,
     "chaos_node_death": scenario_chaos_node_death,
     "chaos_kubelet_stall": scenario_chaos_kubelet_stall,
     "chaos_429_storm": scenario_chaos_429_storm,
+    "chaos_park_blackout": scenario_chaos_park_blackout,
 }
 
 # the family registers into the shared scenario table (run_scenario and

@@ -171,7 +171,8 @@ class _NotebookWorld:
                  relist_period: float = 0.0,
                  placement_policy: str | None = None,
                  policy_checkpoint: str | None = None,
-                 preemption: bool = True):
+                 preemption: bool = True,
+                 parker=None, oversubscribe: bool = False):
         self.kube = FakeKube()
         # per-client request attribution (cpprof): the bench's own
         # traffic (creates, deletes, cache-miss polls) books under
@@ -212,13 +213,17 @@ class _NotebookWorld:
                 self.kube, enable_preemption=preemption,
                 placement_policy=placement_policy,
                 policy_checkpoint=policy_checkpoint,
+                oversubscribe=oversubscribe,
             )
             self.tracker.instrument_reconciler(self.sched)
             self.sched.register(self.mgr)
         self.culler = None
         if fetch_kernels is not None:
+            # parker: wires checkpoint-park into the culler (park_resume
+            # family) — the same plane the scheduler's oversubscription
+            # mode depends on to actually free chips
             self.culler = CullingReconciler(
-                self.kube, fetch_kernels=fetch_kernels
+                self.kube, fetch_kernels=fetch_kernels, parker=parker
             )
             self.culler.check_period_minutes = cfg.cull_period_minutes
             self.tracker.instrument_reconciler(self.culler)

@@ -51,8 +51,8 @@ AMBIENT_KINDS = ("chaos", "lease", "shard")
 #: summarized, not listed — except failures, which are always evidence)
 TIMELINE_SPANS = {
     "apiserver.create", "sched.admit", "sched.queue_wait", "sched.place",
-    "sched.preempt", "notebook.children", "notebook.gang",
-    "notebook.ready", "kubelet.actuation",
+    "sched.preempt", "sched.park", "sched.resume", "notebook.children",
+    "notebook.gang", "notebook.ready", "kubelet.actuation",
 }
 
 #: attrs that never cross the tenant boundary (same contract as the
@@ -353,6 +353,23 @@ def _verdict(obj, ready, items, sources) -> str:
         return "unknown object: no CR, no trace, no journal entries"
     if ready:
         return "Ready"
+    status = (obj or {}).get("status") or {}
+    if status.get("phase") == "Parked":
+        # checkpoint-parked (controlplane/parking), NOT stuck: zero
+        # chips held, state committed, resume on open. Keyed off the
+        # status phase — explain must not import the parking package
+        # (obs is imported BY it transitively via the controllers).
+        ref = status.get("checkpointRef")
+        verdict = "Parked — scale-to-zero"
+        if ref:
+            verdict += f", checkpoint {ref}"
+        for i in reversed(items):
+            reason = ((i.get("attrs") or {}).get("park_reason")
+                      if i["source"] == "journal" else None)
+            if reason:
+                verdict += f" (parked: {reason})"
+                break
+        return verdict + "; resume on open"
     blocking = None
     for cond in ((obj or {}).get("status") or {}).get("conditions") or []:
         if cond.get("type") == "Scheduled" and cond.get("status") == "False":

@@ -47,6 +47,8 @@ SPAN_KINDS = {
     "sched.admit": "admission",
     "sched.place": "placement",
     "sched.preempt": "preemption",
+    "sched.park": "park",
+    "sched.resume": "resume",
     "notebook.ready": "ready",
 }
 

@@ -1,0 +1,92 @@
+"""notebookpark: checkpoint-park / scale-to-zero notebooks.
+
+Every notebook this plane admits holds its TPU chips forever once Ready,
+so peak fleet size equals peak concurrent tenants. Parking breaks that
+equation: an idle (or tpusched-preempted) notebook is *checkpointed*,
+its pods torn down and its pool booking released — it costs zero chips —
+and a user hit re-enqueues it through the existing admission queue,
+restoring from the checkpoint ref. With parking on, the scheduler can
+oversubscribe: when no pool is feasible for a waiter, it parks the
+coldest parkable tenant (idle-age ranked) instead of queueing the
+hottest (scheduler/reconciler.py oversubscription mode).
+
+Layering (deliberately stdlib-pure, like features.py's schema half):
+
+- :mod:`store` — the durable checkpoint store. Rides the
+  ``train/checkpoint.py`` shape (``save(dir, state) -> step`` /
+  ``latest_step`` / ``restore``) with an atomic-rename commit protocol,
+  but imports NOTHING outside the stdlib: the controlplane path must
+  stay importable on the no-deps CI bench lane, and train/checkpoint.py
+  imports jax/orbax at module level. The real train-state integration
+  swaps the store's serializer, not the protocol.
+- :mod:`parker` — park/resume orchestration helpers over the store
+  (state snapshot → ref, ref → state, annotation patch assembly). The
+  CR writes themselves stay in the controllers: culling.py owns the
+  park verb (checkpoint-then-stop, in that order — the crash-safety
+  invariant), the scheduler owns the park *request*.
+
+Protocol (the schedsim ``park-resume`` model checks these orderings):
+
+1. **park**: checkpoint COMMITS before the stop annotation lands — a
+   Manager crash between the two leaves a running notebook plus an
+   orphaned checkpoint (retried, harmless), never a stopped notebook
+   with no state.
+2. **release**: the stop reconcile clears the pool annotation BEFORE
+   the booking is freed (the scheduler's existing stop ordering) — two
+   live annotations on one pool would read as a double booking.
+3. **resume**: clearing the stop annotation + stamping
+   ``resume-requested`` re-enters admission; the restore happens from
+   the committed ref and the park annotations clear only after it
+   succeeds. A resume racing an in-flight park request cancels the
+   park (the notebook never stopped, nothing to restore).
+"""
+
+from __future__ import annotations
+
+#: park request: set by the culler (idle) or tpusched (oversubscription /
+#: preemption); value is the park reason. The culling reconciler is the
+#: single park EXECUTOR — it checkpoints, then stops.
+PARK_REQUESTED_ANNOTATION = "tpukf.dev/park-requested"
+#: park completed at this timestamp (set atomically with the stop
+#: annotation, after the checkpoint committed)
+PARKED_ANNOTATION = "tpukf.dev/parked"
+#: the committed checkpoint ref ("<ns>/<name>@<step>") the resume path
+#: restores from — the CR's durable pointer into the store
+CHECKPOINT_ANNOTATION = "tpukf.dev/park-checkpoint"
+#: why the notebook was parked (idle | preempted | oversubscribed) —
+#: journaled as the sched-journal/v1 ``park_reason`` field
+PARK_REASON_ANNOTATION = "tpukf.dev/park-reason"
+#: resume asked at this timestamp (stamped when the stop annotation is
+#: cleared on a parked notebook) — the resume-latency SLO's start mark
+RESUME_REQUESTED_ANNOTATION = "tpukf.dev/resume-requested"
+#: waiter a victim was parked FOR under oversubscription (the parking
+#: analog of tpukf.dev/preempted-by)
+PARKED_FOR_ANNOTATION = "tpukf.dev/parked-for"
+
+#: culling-policy value opting a notebook into idle-PARK (checkpoint +
+#: scale-to-zero) instead of a plain cull
+POLICY_PARK = "park"
+
+#: park reason vocabulary (bounded, queryable — journal + explainz)
+PARK_IDLE = "idle"
+PARK_PREEMPTED = "preempted"
+PARK_OVERSUBSCRIBED = "oversubscribed"
+
+#: Event reasons (cplint event-reason: constant, CamelCase)
+REASON_PARKED = "Parked"
+REASON_RESUMED = "Resumed"
+REASON_RESUME_FAILED = "ResumeFailed"
+REASON_PARK_CANCELLED = "ParkCancelled"
+
+from service_account_auth_improvements_tpu.controlplane.parking.store import (  # noqa: E402,F401,E501
+    CheckpointError,
+    ParkStore,
+    latest_step,
+    restore,
+    save,
+)
+from service_account_auth_improvements_tpu.controlplane.parking.parker import (  # noqa: E402,F401,E501
+    Parker,
+    default_state_from,
+    parse_ref,
+)

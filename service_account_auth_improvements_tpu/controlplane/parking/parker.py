@@ -1,0 +1,94 @@
+"""Park/resume orchestration over the checkpoint store.
+
+The :class:`Parker` owns WHAT gets checkpointed and WHERE it lives; the
+controllers own the CR writes around it (culling.py executes the park —
+checkpoint first, stop second — and finishes the resume; the scheduler
+only ever *requests* a park). Keeping the kube traffic out of this
+module keeps the parking package import-pure: stdlib only, importable
+from the scheduler, the webapps, and the obs layer without cycles.
+"""
+
+from __future__ import annotations
+
+from service_account_auth_improvements_tpu.controlplane.parking.store import (
+    CheckpointError,
+    ParkStore,
+)
+
+
+def parse_ref(ref: str) -> tuple[str, str, int | None]:
+    """``"<ns>/<name>@<step>"`` -> (ns, name, step). Tolerates a missing
+    step (``step`` None restores the newest commit)."""
+    if not ref or "/" not in ref:
+        raise CheckpointError(f"malformed checkpoint ref {ref!r}")
+    path, _, raw_step = ref.partition("@")
+    ns, _, name = path.partition("/")
+    if not name:
+        raise CheckpointError(f"malformed checkpoint ref {ref!r}")
+    step: int | None = None
+    if raw_step:
+        try:
+            step = int(raw_step)
+        except ValueError:
+            raise CheckpointError(
+                f"malformed checkpoint ref {ref!r}"
+            ) from None
+    return ns, name, step
+
+
+def default_state_from(nb: dict, kernels=None) -> dict:
+    """The state snapshot a park persists when no richer fetcher is
+    wired: the CR's spec (the server's full shape — image, resources,
+    volumes, TPU demand) plus the live kernel list the culler already
+    probed. The real notebook-server integration replaces this with the
+    kernel/session export API; the train stack's bit-identical state
+    rides the same ``save -> step -> restore`` protocol either way."""
+    meta = nb.get("metadata") or {}
+    return {
+        "schema": "notebookpark/v1",
+        "notebook": {
+            "namespace": meta.get("namespace"),
+            "name": meta.get("name"),
+            "uid": meta.get("uid"),
+        },
+        "spec": nb.get("spec") or {},
+        "kernels": list(kernels or ()),
+    }
+
+
+class Parker:
+    """Checkpoint side of park/resume for one store."""
+
+    def __init__(self, store: ParkStore, fetch_state=None):
+        self.store = store
+        #: ``fetch_state(nb, kernels) -> dict`` — the pluggable snapshot
+        #: (benches inject synthetic payloads; production wires the
+        #: notebook server's session-export endpoint)
+        self.fetch_state = fetch_state or default_state_from
+
+    def park(self, nb: dict, kernels=None) -> str:
+        """Snapshot + COMMIT the checkpoint; returns the ref the caller
+        must stamp onto the CR *together with* the stop annotation.
+        Raises on any failure — the caller must not stop a notebook
+        whose state never committed."""
+        meta = nb.get("metadata") or {}
+        state = self.fetch_state(nb, kernels)
+        return self.store.save(meta.get("namespace") or "",
+                               meta["name"], state)
+
+    def restore(self, ref: str) -> dict:
+        """State for a committed ref (falling back to the notebook's
+        newest commit when the exact step was pruned). Raises
+        :class:`CheckpointError` when nothing restorable exists — the
+        lost-checkpoint signal the chaos gate counts."""
+        ns, name, step = parse_ref(ref)
+        return self.store.restore(ns, name, step=step)
+
+    def resumable(self, ref: str) -> bool:
+        """Cheap liveness probe for a ref — the chaos invariant check
+        ("every Parked CR resumable afterward") without side effects."""
+        try:
+            self.restore(ref)
+            return True
+        except CheckpointError:
+            return False

@@ -1,0 +1,162 @@
+"""Durable park-checkpoint store — the ``train/checkpoint.py`` shape in
+pure stdlib.
+
+The train stack's checkpoint API is three verbs over a directory of
+numbered steps: ``save(directory, state) -> step``, ``latest_step``,
+``restore``. Parking rides exactly that shape so the real train-state
+integration is a serializer swap, not a protocol change — but it cannot
+import train/checkpoint.py (module-level jax/orbax imports; the
+controlplane must stay importable on the no-deps CI bench lane), so the
+protocol is reimplemented here over JSON files.
+
+Commit protocol (the chaos "parked checkpoints survive a blackout"
+invariant rests on it):
+
+- a step is written into a ``._tmp_<step>-<nonce>`` staging directory,
+  its state file fsynced, and then the directory is ``os.rename``d to
+  ``step_<n>`` — rename is atomic on POSIX, so a step directory either
+  exists complete or not at all. A crash mid-save leaves staging
+  garbage (swept on the next save), never a torn checkpoint;
+- ``latest_step`` only ever sees committed (renamed) steps;
+- retention keeps the newest ``max_to_keep`` steps, pruned AFTER the
+  new step committed — the store never passes through a zero-step
+  state while a notebook is parked.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import uuid
+
+STEP_PREFIX = "step_"
+STATE_FILE = "state.json"
+
+
+class CheckpointError(Exception):
+    """A checkpoint that should exist doesn't (lost, torn, unreadable)."""
+
+
+def _step_dirs(directory: str) -> list[int]:
+    try:
+        names = os.listdir(directory)
+    except FileNotFoundError:
+        return []
+    steps = []
+    for n in names:
+        if n.startswith(STEP_PREFIX):
+            try:
+                steps.append(int(n[len(STEP_PREFIX):]))
+            except ValueError:
+                continue
+    return sorted(steps)
+
+
+def save(directory: str, state: dict, *, max_to_keep: int = 3) -> int:
+    """Commit ``state`` as the next step under ``directory``; returns the
+    step number. Mirrors train/checkpoint.save's signature minus the
+    orbax manager."""
+    os.makedirs(directory, exist_ok=True)
+    # sweep staging garbage from crashed saves (cheap, bounded by the
+    # handful of tmp dirs a crash can leave)
+    for n in os.listdir(directory):
+        if n.startswith("._tmp_"):
+            shutil.rmtree(os.path.join(directory, n), ignore_errors=True)
+    existing = _step_dirs(directory)
+    step = (existing[-1] + 1) if existing else 1
+    tmp = os.path.join(directory, f"._tmp_{step}-{uuid.uuid4().hex[:8]}")
+    os.makedirs(tmp)
+    path = os.path.join(tmp, STATE_FILE)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(state, f, sort_keys=True, default=str)
+        f.flush()
+        os.fsync(f.fileno())
+    final = os.path.join(directory, f"{STEP_PREFIX}{step}")
+    try:
+        os.rename(tmp, final)  # the atomic commit point
+    except OSError:
+        # lost a concurrent-save race for this step number: our state is
+        # not newer than the winner's; drop the staging dir
+        shutil.rmtree(tmp, ignore_errors=True)
+        committed = _step_dirs(directory)
+        if not committed:
+            raise CheckpointError(
+                f"checkpoint commit failed for {directory} step {step}"
+            )
+        return committed[-1]
+    # prune AFTER commit: never a zero-step window
+    for old in _step_dirs(directory)[:-max_to_keep]:
+        shutil.rmtree(os.path.join(directory, f"{STEP_PREFIX}{old}"),
+                      ignore_errors=True)
+    return step
+
+
+def latest_step(directory: str) -> int | None:
+    """Newest committed step, or None — train/checkpoint.latest_step."""
+    steps = _step_dirs(directory)
+    return steps[-1] if steps else None
+
+
+def restore(directory: str, step: int | None = None) -> dict:
+    """Load a committed step's state (the newest when ``step`` is None).
+    Raises :class:`CheckpointError` when it is missing or torn."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise CheckpointError(f"no committed checkpoint in {directory}")
+    path = os.path.join(directory, f"{STEP_PREFIX}{step}", STATE_FILE)
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError) as e:
+        raise CheckpointError(
+            f"checkpoint {directory} step {step} unreadable: {e}"
+        ) from e
+
+
+class ParkStore:
+    """Per-notebook view over the step store: refs are
+    ``<ns>/<name>@<step>`` — the durable pointer the CR's
+    park-checkpoint annotation carries."""
+
+    def __init__(self, root: str, max_to_keep: int = 3):
+        self.root = root
+        self.max_to_keep = max_to_keep
+        # save serialization per process: two culler workers parking the
+        # same notebook must not race step numbering (the annotation
+        # patch after the slower save would point at a pruned step)
+        self._lock = threading.Lock()
+
+    def _dir(self, namespace: str, name: str) -> str:
+        # flat "<ns>/<name>" under root; names are k8s-legal (no "/")
+        return os.path.join(self.root, namespace or "_cluster", name)
+
+    def save(self, namespace: str, name: str, state: dict) -> str:
+        with self._lock:
+            step = save(self._dir(namespace, name), state,
+                        max_to_keep=self.max_to_keep)
+        return f"{namespace}/{name}@{step}"
+
+    def latest_ref(self, namespace: str, name: str) -> str | None:
+        step = latest_step(self._dir(namespace, name))
+        if step is None:
+            return None
+        return f"{namespace}/{name}@{step}"
+
+    def restore(self, namespace: str, name: str,
+                step: int | None = None) -> dict:
+        directory = self._dir(namespace, name)
+        try:
+            return restore(directory, step=step)
+        except CheckpointError:
+            if step is None:
+                raise
+            # the exact step was pruned/lost but a newer commit exists:
+            # the newest committed state is strictly more recent than
+            # the ref — restoring it loses nothing
+            return restore(directory, step=None)
+
+    def delete(self, namespace: str, name: str) -> None:
+        shutil.rmtree(self._dir(namespace, name), ignore_errors=True)

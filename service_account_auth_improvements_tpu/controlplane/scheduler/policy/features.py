@@ -68,6 +68,19 @@ PLACEMENT_FIELDS = frozenset({
     "policy",          # "best_fit" | "learned" | "pinned"
 })
 
+#: OPTIONAL typed riders on sched-journal/v1 rows — the parking
+#: vocabulary (PR: notebookpark). ``park_reason`` rides on ``park`` rows
+#: (idle | preempted | oversubscribed — why the victim lost its chips;
+#: the label a future learned park policy trains on) and
+#: ``resume_latency_ms`` on ``resume`` rows (the resume-latency SLO
+#: sample, journaled so the decision record carries its own outcome).
+#: Riders are type-checked when present but never required — a plain
+#: placement row stays exactly PLACEMENT_FIELDS.
+RIDER_FIELDS = {
+    "park_reason": str,
+    "resume_latency_ms": (int, float),
+}
+
 #: fixed model width: examples hold up to this many pools (sorted by
 #: name; serving abstains beyond it). Features are per-pool blocks, so
 #: the scorer itself is pool-count-agnostic up to the pad.
@@ -108,6 +121,9 @@ def check_row(attrs: dict) -> list[str]:
     if "feasible" in attrs and not isinstance(attrs["feasible"],
                                               (list, tuple)):
         problems.append("feasible is not a list")
+    for rider, types in RIDER_FIELDS.items():
+        if rider in attrs and not isinstance(attrs[rider], types):
+            problems.append(f"rider {rider} is not {types}")
     return problems
 
 

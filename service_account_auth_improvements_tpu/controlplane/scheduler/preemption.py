@@ -47,3 +47,44 @@ def choose_victim(assignments: list[Assignment],
     if not candidates:
         return None
     return min(candidates, key=lambda a: (a.priority, -a.seq))
+
+
+def choose_park_victim(assignments: list[Assignment],
+                       pools: dict[str, SlicePool], used: dict[str, int],
+                       demand: Demand,
+                       idle_age_s) -> tuple[Assignment, float] | None:
+    """Oversubscription: the assignment to checkpoint-PARK so ``demand``
+    can place — the COLDEST parkable tenant, not the lowest-priority one.
+
+    Parking differs from preemption in both eligibility and ranking:
+
+    - no priority fence — parking is lossless (state committed, resume
+      on open), so even an equal- or higher-priority idler may yield.
+      What it costs the victim is resume latency, which is why ranking
+      is by idle age: the tenant least likely to notice pays;
+    - ``idle_age_s(assignment) -> float | None`` is the parkability
+      oracle (the reconciler derives it from the culler's last-activity
+      annotation). None = not parkable (opted out, already
+      stopping/parking, or no activity signal — never park blind);
+    - same single-release rule as preemption: only an assignment whose
+      lone release makes some pool feasible qualifies (no cascades).
+
+    Returns (victim, idle_age_s) — the age is journaled as evidence —
+    or None when no single park unblocks the demand.
+    """
+    candidates = []
+    for a in assignments:
+        pool = pools.get(a.pool)
+        if pool is None:
+            continue
+        if not feasible(pool, used.get(a.pool, 0) - a.chips, demand):
+            continue
+        age = idle_age_s(a)
+        if age is None:
+            continue
+        candidates.append((a, float(age)))
+    if not candidates:
+        return None
+    # coldest first; ties park the youngest assignment (keep long-
+    # running tenants stable, the preemption tie-break transplanted)
+    return max(candidates, key=lambda c: (c[1], c[0].seq))

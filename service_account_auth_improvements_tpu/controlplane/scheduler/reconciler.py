@@ -26,7 +26,16 @@ Per Notebook reconcile:
 - **preemption** (opt-in ENABLE_PREEMPTION): a higher-priority queued
   notebook evicts the lowest-priority running notebook whose slice frees
   enough chips — routed through the normal cull path (stop annotation) so
-  teardown and chip release are checkpoint-safe.
+  teardown and chip release are checkpoint-safe;
+- **oversubscription** (opt-in ENABLE_OVERSUBSCRIPTION, requires a
+  parker-wired culler): when no pool is feasible for a waiter, park the
+  COLDEST parkable tenant (idle-age ranked, ``preemption.
+  choose_park_victim``) instead of queueing the hottest — the victim is
+  checkpointed by the culler (``park-requested`` annotation; this
+  scheduler never stops anything itself) and costs zero chips until a
+  user hit resumes it through this same queue. With oversubscription
+  on, preemption evictions are also routed as parks (the victim comes
+  back resumable instead of cold-stopped).
 
 Assignments are durable on the CR; the in-memory book is rebuilt from the
 Notebook list at startup (``setup``) or lazily per reconcile, so a
@@ -40,6 +49,7 @@ follow-up.
 from __future__ import annotations
 
 import copy
+import datetime
 import logging
 import os
 import threading
@@ -48,6 +58,9 @@ import time
 from service_account_auth_improvements_tpu.controlplane import tpu
 from service_account_auth_improvements_tpu.controlplane.controllers import (
     helpers,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.culling import (  # noqa: E501
+    CULLING_POLICY,
 )
 from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E501
     GROUP,
@@ -71,6 +84,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import (
     Registry,
 )
 from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane import parking
 from service_account_auth_improvements_tpu.controlplane.scheduler.inventory import (  # noqa: E501
     Assignment,
     pools_from_nodes,
@@ -85,6 +99,7 @@ from service_account_auth_improvements_tpu.controlplane.scheduler.policy.feature
     JOURNAL_SCHEMA,
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.preemption import (  # noqa: E501
+    choose_park_victim,
     choose_victim,
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.queue import (
@@ -142,6 +157,11 @@ class SchedulerMetrics:
             "Running notebooks evicted for higher-priority queued ones",
             registry=registry,
         )
+        self.parks = Counter(
+            "tpusched_parks_total",
+            "Park requests issued so a waiter could place "
+            "(oversubscription)", registry=registry,
+        )
 
 
 class SchedulerReconciler(Reconciler):
@@ -151,7 +171,8 @@ class SchedulerReconciler(Reconciler):
     def __init__(self, kube, metrics: SchedulerMetrics | None = None,
                  enable_preemption: bool | None = None,
                  placement_policy: str | None = None,
-                 policy_checkpoint: str | None = None):
+                 policy_checkpoint: str | None = None,
+                 oversubscribe: bool | None = None):
         self.kube = kube
         self.metrics = metrics or SchedulerMetrics(Registry())
         self.recorder = EventRecorder(kube, "tpusched")
@@ -159,6 +180,22 @@ class SchedulerReconciler(Reconciler):
             enable_preemption if enable_preemption is not None
             else get_env_bool("ENABLE_PREEMPTION", False)
         )
+        #: oversubscription mode (module docstring): park the coldest
+        #: parkable tenant when no pool is feasible. Requires a
+        #: parker-wired CullingReconciler in the same plane — this
+        #: scheduler only stamps ``park-requested``; nothing frees until
+        #: the culler checkpoints and stops the victim.
+        self.oversubscribe = (
+            oversubscribe if oversubscribe is not None
+            else get_env_bool("ENABLE_OVERSUBSCRIPTION", False)
+        )
+        #: oversubscription admission-retry cadence. Parkability is
+        #: time-dependent — a victim becomes eligible only once it turns
+        #: Ready and the culler's probe stamps its idle age — so a
+        #: waiter that found neither a feasible pool nor a parkable
+        #: victim requeues itself on this cadence instead of waiting for
+        #: an unrelated event to wake the queue.
+        self.park_retry_s = 5.0
         # learned placement (docs/scheduler.md "Learned placement"):
         # best_fit stays the default AND the fallback — the chooser is
         # only consulted for unpinned demands, abstains on a missing/
@@ -198,6 +235,13 @@ class SchedulerReconciler(Reconciler):
         self._assigned: dict[tuple[str, str], Assignment] = {}
         self._assign_seq = 0
         self._evicting: set[tuple[str, str]] = set()
+        #: one-park-in-flight guard, the _evicting discipline applied to
+        #: oversubscription: a victim we stamped ``park-requested`` on
+        #: stays booked (its chips are NOT free) until the culler's
+        #: checkpoint+stop lands and the stop reconcile _forgets it —
+        #: choosing a second victim meanwhile would cascade parks for
+        #: one waiter
+        self._parking: set[tuple[str, str]] = set()
         #: placements committed to the book whose annotation stamp hasn't
         #: landed yet (the stamp happens lock-free after the pass).
         #: Preemption must not choose these as victims: the victim's
@@ -332,6 +376,13 @@ class SchedulerReconciler(Reconciler):
         # one-eviction-in-flight guard would disable preemption forever.
         with self._lock:
             self._evicting.discard(key)
+            if parking.PARK_REQUESTED_ANNOTATION not in annots:
+                # park request resolved without a stop: the culler
+                # cancelled it (policy raced) or a resume won — release
+                # the one-park-in-flight guard. While the request is
+                # still pending the mark must HOLD (the checkpoint+stop
+                # is in flight on the culler's cadence).
+                self._parking.discard(key)
         # Once placed, the ANNOTATION is the authoritative placement —
         # the notebook controller renders pods from it even if the user
         # edits spec.tpu.nodePool afterwards (placement is sticky until
@@ -448,6 +499,12 @@ class SchedulerReconciler(Reconciler):
                        "pinned_pool": resolved.node_pool or ""},
             )
         self._run_queue()
+        if self.oversubscribe and self._queue.get(key) is not None:
+            # still waiting under oversubscription: a victim may become
+            # parkable purely by the passage of time (Ready + idle-age
+            # stamp), which emits no event on THIS key — retry on a
+            # cadence (see park_retry_s)
+            return Result(requeue_after=self.park_retry_s)
         return Result()
 
     # -------------------------------------------------------- bookkeeping
@@ -487,6 +544,7 @@ class SchedulerReconciler(Reconciler):
         with self._lock:
             self._queue.remove(key)
             self._evicting.discard(key)
+            self._parking.discard(key)
             self._unstamped.discard(key)
             return self._assigned.pop(key, None) is not None
 
@@ -612,6 +670,7 @@ class SchedulerReconciler(Reconciler):
         placed: list[tuple] = []       # (entry, pool) — booked, unstamped
         park_events: list[tuple] = []  # (nb, reason, message)
         evict: tuple | None = None     # (victim, entry)
+        park: tuple | None = None      # (victim, entry, age, state)
         with self._lock:
             pools = pools_from_nodes(self._nodes())
             used = used_chips(self._assigned.values(), pools)
@@ -743,10 +802,21 @@ class SchedulerReconciler(Reconciler):
                 placed.append((entry, pool, decision_state))
                 live.pop(entry.key, None)
                 used[pool] = used.get(pool, 0) + entry.demand.total_chips
-            if self.enable_preemption and not self._evicting:
+            if self.enable_preemption and not self._evicting \
+                    and not self._parking:
                 evict = self._choose_preemption(pools, used, budgets)
                 if evict is not None:
                     self._evicting.add(evict[0].key)
+                    if self.oversubscribe:
+                        # preempt-PARK: the eviction routes through the
+                        # park request below, so the victim also holds
+                        # the park-in-flight guard until its stop lands
+                        self._parking.add(evict[0].key)
+            if self.oversubscribe and evict is None \
+                    and not self._parking and not self._evicting:
+                park = self._choose_park(pools, used, budgets)
+                if park is not None:
+                    self._parking.add(park[0].key)
             restamp, depth = self._position_snapshot(live)
         # Apiserver writes AFTER the lock drops: a pass that stamps
         # several placements and restamps O(queue) positions would
@@ -758,6 +828,8 @@ class SchedulerReconciler(Reconciler):
             self._finish_place(entry, pool, decision_state)
         if evict is not None:
             self._finish_evict(*evict)
+        if park is not None:
+            self._finish_park(*park)
         for nb, reason, message in park_events:
             self.recorder.event(nb, WARNING, reason, message)
         for nb, reason, message, pos, total in restamp:
@@ -972,19 +1044,188 @@ class SchedulerReconciler(Reconciler):
                 return victim, entry
         return None
 
-    def _finish_evict(self, victim, entry) -> None:
-        """Lock-free half of preemption: route the eviction through the
-        cull path (stop annotation). Further passes re-run once the
-        victim's chips actually free — release is event-driven via the
-        victim's stop reconcile."""
+    def _idle_age_s(self, assignment) -> float | None:
+        """Parkability oracle for one assignment (cache reads, under the
+        lock like the rest of the pass): idle seconds since the culler's
+        last-activity stamp, or None when the tenant must not be parked —
+        opted out (``culling-policy: training|disabled``), already
+        stopping/parking/deleting, or carrying NO activity signal (a
+        notebook the culler never probed is never parked blind)."""
+        nb = self._get_nb(assignment.key)
+        if nb is None or nb["metadata"].get("deletionTimestamp"):
+            return None
+        annots = nb["metadata"].get("annotations") or {}
+        if STOP_ANNOTATION in annots \
+                or parking.PARK_REQUESTED_ANNOTATION in annots \
+                or parking.RESUME_REQUESTED_ANNOTATION in annots:
+            return None
+        if annots.get(CULLING_POLICY) in ("training", "disabled"):
+            return None
+        last = annots.get(helpers.LAST_ACTIVITY)
+        if not last:
+            return None
+        for fmt in ("%Y-%m-%dT%H:%M:%SZ", "%Y-%m-%dT%H:%M:%S.%fZ"):
+            try:
+                stamp = datetime.datetime.strptime(last, fmt).replace(
+                    tzinfo=datetime.timezone.utc)
+            except (TypeError, ValueError):
+                continue
+            age = (datetime.datetime.now(datetime.timezone.utc)
+                   - stamp).total_seconds()
+            return max(age, 0.0)
+        return None
+
+    def _choose_park(self, pools, used, budgets):
+        """Decision half of oversubscription, under the lock: the
+        (victim, waiter, idle_age, journal_state) tuple parking the
+        COLDEST parkable tenant for the highest-priority waiter a single
+        park can unblock, or None. Same pinned-pool and quota fences as
+        preemption — a quota-blocked waiter only benefits from a
+        same-namespace victim — but no priority fence: parking is
+        lossless (choose_park_victim's docstring)."""
+        assignments = list(self._assigned.values())
+        for entry in self._queue.ordered():
+            budget = budgets.get(entry.namespace)
+            ns_used = sum(a.chips for a in assignments
+                          if a.namespace == entry.namespace)
+
+            def eligible(victim) -> bool:
+                if entry.pinned_pool and victim.pool != entry.pinned_pool:
+                    return False
+                if budget is None:
+                    return True
+                freed = (victim.chips
+                         if victim.namespace == entry.namespace else 0)
+                return (ns_used - freed + entry.demand.total_chips
+                        <= budget)
+
+            chosen = choose_park_victim(
+                [a for a in assignments
+                 if a.key not in self._unstamped
+                 and a.key not in self._evicting
+                 and a.key not in self._parking and eligible(a)],
+                pools, used, entry.demand, self._idle_age_s,
+            )
+            if chosen is None:
+                continue
+            victim, age = chosen
+            # the pinned sched-journal/v1 row (features.py check_row
+            # passes: all 12 placement fields + the park_reason rider) —
+            # the (state, decision) tuple a learned WHEN-to-park policy
+            # trains on. pool/chips describe the decision (the slice the
+            # park frees); feasible is the waiter's mask at decision
+            # time — empty, which is WHY a park was needed.
+            state = {
+                "schema": JOURNAL_SCHEMA,
+                "pool": victim.pool,
+                "chips": victim.chips,
+                "time_to_placement_s": round(
+                    time.monotonic() - entry.enqueued, 6),
+                "free_chips": {
+                    p: pools[p].total_chips - used.get(p, 0)
+                    for p in sorted(pools)
+                },
+                "total_chips": {
+                    p: pools[p].total_chips for p in sorted(pools)
+                },
+                "feasible": feasible_pools(pools, used, entry.demand),
+                "demand_chips": entry.demand.total_chips,
+                "demand_hosts": entry.demand.num_hosts,
+                "slice_class": entry.demand.slice_class,
+                "queue_depth": len(self._queue),
+                "policy": "coldest_idle",
+                "park_reason": parking.PARK_OVERSUBSCRIBED,
+                "idle_age_s": round(age, 1),
+                "waiter_priority": entry.priority,
+            }
+            return victim, entry, age, state
+        return None
+
+    def _finish_park(self, victim, entry, age: float,
+                     decision_state: dict) -> None:
+        """Lock-free half of oversubscription: stamp the park request.
+        The culler executes it (checkpoint, THEN stop) on its own
+        cadence; chips free only when the victim's stop reconcile runs
+        — this scheduler never stops anything itself, so a crashed
+        Manager mid-park leaves a running victim and a pending request,
+        never a stopped victim without a checkpoint."""
         try:
             self.kube.patch(
                 "notebooks", victim.name,
                 {"metadata": {"annotations": {
-                    STOP_ANNOTATION: _utcnow(),
-                    PREEMPTED_BY_ANNOTATION:
+                    parking.PARK_REQUESTED_ANNOTATION:
+                        parking.PARK_OVERSUBSCRIBED,
+                    parking.PARKED_FOR_ANNOTATION:
                         f"{entry.namespace}/{entry.name}",
                 }}}, namespace=victim.namespace, group=GROUP,
+            )
+        except errors.NotFound:
+            self._forget(victim.key)
+            return
+        except errors.ApiError:
+            # outage mid-request: release the park-in-flight guard (no
+            # annotation landed, so no stop reconcile will ever clear it
+            # for us) and re-drive via the waiter's requeue
+            with self._lock:
+                self._parking.discard(victim.key)
+            if self._ctl is not None:
+                self._ctl.queue.add_after(
+                    Request(entry.namespace, entry.name), 0.5
+                )
+            return
+        self.metrics.parks.inc()
+        now = time.monotonic()
+        # journaled on the WAITER's key (like sched.preempt): the park
+        # is the waiter's placement story; the victim's own timeline
+        # carries the culler's park decision. Same tenant redaction as
+        # preemption — across namespaces the row names THAT a park
+        # happened, not whose workload.
+        victim_ref = (f"{victim.namespace}/{victim.name}"
+                      if victim.namespace == entry.namespace
+                      else "(other namespace)")
+        obs.record(
+            "sched.park",
+            obs.object_key("notebooks", entry.namespace, entry.name),
+            now, now,
+            attrs={"victim": victim_ref, **decision_state},
+        )
+        victim_nb = self._get_nb(victim.key)
+        if victim_nb is not None:
+            self.recorder.event(
+                victim_nb, "Normal", parking.REASON_PARKED,
+                f"park requested (idle {age / 60.0:.0f} min) to free "
+                f"{victim.chips} chips for waiting notebook "
+                f"{entry.namespace}/{entry.name} (oversubscription)",
+            )
+        log.info("tpusched park-requested %s/%s (idle %.0fs) for %s/%s",
+                 victim.namespace, victim.name, age, entry.namespace,
+                 entry.name)
+
+    def _finish_evict(self, victim, entry) -> None:
+        """Lock-free half of preemption: route the eviction through the
+        cull path (stop annotation). Further passes re-run once the
+        victim's chips actually free — release is event-driven via the
+        victim's stop reconcile. With oversubscription on the eviction
+        becomes a preempt-PARK: the victim gets a ``park-requested``
+        stamp instead of a direct stop, so the culler checkpoints its
+        state first and the tenant comes back resumable."""
+        if self.oversubscribe:
+            annotations = {
+                parking.PARK_REQUESTED_ANNOTATION: parking.PARK_PREEMPTED,
+                PREEMPTED_BY_ANNOTATION:
+                    f"{entry.namespace}/{entry.name}",
+            }
+        else:
+            annotations = {
+                STOP_ANNOTATION: _utcnow(),
+                PREEMPTED_BY_ANNOTATION:
+                    f"{entry.namespace}/{entry.name}",
+            }
+        try:
+            self.kube.patch(
+                "notebooks", victim.name,
+                {"metadata": {"annotations": annotations}},
+                namespace=victim.namespace, group=GROUP,
             )
         except errors.NotFound:
             self._forget(victim.key)
@@ -996,6 +1237,7 @@ class SchedulerReconciler(Reconciler):
             # reconcile will ever discard the mark for us)
             with self._lock:
                 self._evicting.discard(victim.key)
+                self._parking.discard(victim.key)
             if self._ctl is not None:
                 self._ctl.queue.add_after(
                     Request(entry.namespace, entry.name), 0.5

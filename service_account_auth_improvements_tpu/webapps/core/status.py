@@ -14,6 +14,10 @@ class STATUS_PHASE:
     UNAVAILABLE = "unavailable"
     TERMINATING = "terminating"
     STOPPED = "stopped"
+    #: checkpoint-parked (controlplane/parking): scale-to-zero with
+    #: committed state — distinct from STOPPED so the frontend renders
+    #: "resume on open" instead of a generic halt
+    PARKED = "parked"
 
 
 def create_status(phase: str = "", message: str = "",

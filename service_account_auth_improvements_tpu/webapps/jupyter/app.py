@@ -14,6 +14,7 @@ import datetime as dt
 from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
     STOP_ANNOTATION,
 )
+from service_account_auth_improvements_tpu.controlplane import parking
 from service_account_auth_improvements_tpu.webapps.core import (
     frontend_dirs,
     HttpError,
@@ -251,7 +252,21 @@ def build_app(kube, static_dir: str | None = None,
                 )
             patch = {"metadata": {"annotations": {STOP_ANNOTATION: _now()}}}
         else:
-            patch = {"metadata": {"annotations": {STOP_ANNOTATION: None}}}
+            annotations = {STOP_ANNOTATION: None}
+            nb = api.get("notebooks", name, ns)
+            annots = nb["metadata"].get("annotations") or {}
+            if parking.CHECKPOINT_ANNOTATION in annots:
+                # starting a PARKED notebook is a resume: stamp the
+                # request (the resume-latency SLO's start mark; the
+                # culler restores from the checkpoint ref and clears the
+                # park state) alongside the stop-clear that re-enters
+                # tpusched admission
+                annotations[parking.RESUME_REQUESTED_ANNOTATION] = _now()
+            if parking.PARK_REQUESTED_ANNOTATION in annots:
+                # a start racing an in-flight park request: the user
+                # wins — clearing the request cancels the park
+                annotations[parking.PARK_REQUESTED_ANNOTATION] = None
+            patch = {"metadata": {"annotations": annotations}}
         api.patch("notebooks", name, patch, ns)
         return {"message": "ok"}
 

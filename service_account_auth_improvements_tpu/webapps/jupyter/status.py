@@ -90,6 +90,15 @@ def process_status(notebook: dict, events: list | None = None) -> dict:
 
     if STOP_ANNOTATION in annotations:
         if ready == 0:
+            if nb_status.get("phase") == "Parked":
+                # checkpoint-parked (controlplane/parking), not merely
+                # stopped: state is committed and a start re-admits +
+                # restores — say so instead of the generic halt
+                return create_status(
+                    STATUS_PHASE.PARKED,
+                    "Parked (resume on open) — notebook state is "
+                    "checkpointed; starting restores it.",
+                )
             return create_status(
                 STATUS_PHASE.STOPPED,
                 "No Pods are currently running for this Notebook Server.",

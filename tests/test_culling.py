@@ -288,3 +288,142 @@ def test_one_unreachable_server_does_not_serialize_namespace(monkeypatch):
     finally:
         release_slow.set()
         mgr.stop()
+
+
+# ---------------------------------------------------------------- park verb
+
+
+def _park_world(kernels, tmp_path, annotations=None, idle_minutes=60):
+    """_world plus a wired Parker (controlplane/parking) over tmp_path."""
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    kube, rec = _world(kernels, annotations=annotations,
+                       idle_minutes=idle_minutes)
+    parker = parking.Parker(parking.ParkStore(str(tmp_path)))
+    rec.parker = parker
+    return kube, rec, parker
+
+
+def _reasons(kube):
+    return {e.get("reason")
+            for e in kube.list("events", namespace="u")["items"]}
+
+
+def test_idle_park_checkpoints_instead_of_cull(tmp_path):
+    """culling-policy: park — the idle trigger parks: checkpoint commits,
+    then ONE patch stamps stop + parked + checkpoint ref + reason."""
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    stale = (NOW - dt.timedelta(minutes=120)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec, parker = _park_world(
+        [{"execution_state": "idle", "last_activity": stale}], tmp_path,
+        annotations={CULLING_POLICY: parking.POLICY_PARK},
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert parking.PARKED_ANNOTATION in a
+    assert a[parking.PARK_REASON_ANNOTATION] == parking.PARK_IDLE
+    ref = a[parking.CHECKPOINT_ANNOTATION]
+    assert parker.resumable(ref)
+    assert parking.REASON_PARKED in _reasons(kube)
+    # the probe-timestamp patch folded into the park patch
+    assert a[LAST_CHECK] == "2026-07-29T12:00:00Z"
+
+
+def test_park_default_env_parks_unannotated_notebooks(tmp_path):
+    stale = (NOW - dt.timedelta(minutes=120)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec, _ = _park_world(
+        [{"execution_state": "idle", "last_activity": stale}], tmp_path,
+    )
+    rec.park_default = True
+    rec.reconcile(Request("u", "nb"))
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    assert parking.PARKED_ANNOTATION in _annots(kube)
+
+
+def test_no_parker_means_plain_cull_even_with_policy(tmp_path):
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    stale = (NOW - dt.timedelta(minutes=120)).strftime("%Y-%m-%dT%H:%M:%SZ")
+    kube, rec = _world(
+        [{"execution_state": "idle", "last_activity": stale}],
+        annotations={CULLING_POLICY: parking.POLICY_PARK},
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert parking.PARKED_ANNOTATION not in a
+
+
+def test_requested_park_executes_even_when_busy(tmp_path):
+    """tpusched preempt-park: the request overrides kernel business —
+    preemption semantics, the checkpoint is the consolation."""
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    kube, rec, parker = _park_world(
+        [{"execution_state": "busy"}], tmp_path,
+        annotations={parking.PARK_REQUESTED_ANNOTATION:
+                     parking.PARK_PREEMPTED},
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert a[parking.PARK_REASON_ANNOTATION] == parking.PARK_PREEMPTED
+    assert parking.PARK_REQUESTED_ANNOTATION not in a
+    assert parker.resumable(a[parking.CHECKPOINT_ANNOTATION])
+
+
+def test_training_policy_cancels_park_request(tmp_path):
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    kube, rec, _ = _park_world(
+        [{"execution_state": "idle"}], tmp_path,
+        annotations={CULLING_POLICY: "training",
+                     parking.PARK_REQUESTED_ANNOTATION:
+                     parking.PARK_OVERSUBSCRIBED},
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert parking.PARK_REQUESTED_ANNOTATION not in a
+    assert parking.REASON_PARK_CANCELLED in _reasons(kube)
+
+
+def test_checkpoint_failure_never_stops_the_notebook(tmp_path):
+    """The crash invariant's error leg: a failed save leaves the
+    notebook RUNNING (retry on the probe cadence), never stopped with
+    no state."""
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    kube, rec, parker = _park_world(
+        [{"execution_state": "busy"}], tmp_path,
+        annotations={parking.PARK_REQUESTED_ANNOTATION:
+                     parking.PARK_PREEMPTED},
+    )
+    def _boom(nb, kernels=None):
+        raise OSError("disk full")
+    parker.park = _boom
+    res = rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert parking.PARKED_ANNOTATION not in a
+    assert res.requeue_after == 60.0
+    assert parking.REASON_PARK_CANCELLED in _reasons(kube)
+
+
+def test_parked_notebook_is_not_probed(tmp_path):
+    """STOP + Parked: the culler's early-exit — no probe traffic against
+    a notebook with zero pods."""
+    from service_account_auth_improvements_tpu.controlplane import parking
+
+    calls = []
+    kube, rec = _world(None, annotations={
+        STOP_ANNOTATION: "2026-07-29T11:00:00Z",
+        parking.PARKED_ANNOTATION: "2026-07-29T11:00:00Z",
+        parking.CHECKPOINT_ANNOTATION: "u/nb@1",
+    })
+    rec.fetch_kernels = lambda url: calls.append(url)
+    rec.reconcile(Request("u", "nb"))
+    assert calls == []

@@ -1,0 +1,316 @@
+"""notebookpark lifecycle (controlplane/parking): store commit
+protocol, park verb, resume finisher, and the races.
+
+The culler is the single park EXECUTOR and resume FINISHER
+(controllers/culling.py); the store is the stdlib reimplementation of
+the train/checkpoint.py shape with an atomic-rename commit. The
+scenarios here are the ISSUE's four: idle-park, preempt-park, resume,
+and the resume-while-parking race (resume wins). The interleaving
+proofs live in tools/cplint/schedsim.py's ``park_resume`` model; these
+are the fast deterministic legs.
+"""
+
+import datetime as dt
+import os
+import time
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane import parking
+from service_account_auth_improvements_tpu.controlplane.controllers.culling import (
+    CULLING_POLICY,
+    CullingReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (
+    STOP_ANNOTATION,
+    NotebookReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (
+    Manager,
+    Request,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import (
+    FakeKube,
+    errors,
+)
+from service_account_auth_improvements_tpu.controlplane.parking import (
+    CheckpointError,
+    Parker,
+    ParkStore,
+    parse_ref,
+)
+
+NOW = dt.datetime(2026, 7, 29, 12, 0, 0, tzinfo=dt.timezone.utc)
+FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+# ------------------------------------------------------------------ store
+
+
+def test_store_ref_roundtrip(tmp_path):
+    store = ParkStore(str(tmp_path))
+    ref = store.save("u", "nb", {"spec": {"n": 1}})
+    assert ref == "u/nb@1"
+    assert store.restore(*parse_ref(ref)[:2],
+                         step=parse_ref(ref)[2]) == {"spec": {"n": 1}}
+    assert store.save("u", "nb", {"spec": {"n": 2}}) == "u/nb@2"
+    assert store.latest_ref("u", "nb") == "u/nb@2"
+
+
+def test_store_missing_checkpoint_raises(tmp_path):
+    store = ParkStore(str(tmp_path))
+    with pytest.raises(CheckpointError):
+        store.restore("u", "ghost")
+    assert store.latest_ref("u", "ghost") is None
+
+
+def test_store_pruned_step_falls_back_to_newest(tmp_path):
+    """Retention keeps max_to_keep steps; a ref pointing at a pruned
+    step restores the NEWEST commit (strictly more recent — loses
+    nothing), only a truly empty store raises."""
+    store = ParkStore(str(tmp_path), max_to_keep=2)
+    for n in range(1, 5):
+        store.save("u", "nb", {"n": n})
+    # steps 1-2 pruned, 3-4 kept
+    assert store.restore("u", "nb", step=1) == {"n": 4}
+    store.delete("u", "nb")
+    with pytest.raises(CheckpointError):
+        store.restore("u", "nb", step=1)
+
+
+def test_store_staging_garbage_is_swept(tmp_path):
+    """A crash mid-save leaves a ._tmp_ staging dir, never a torn
+    step — the next save sweeps it."""
+    store = ParkStore(str(tmp_path))
+    store.save("u", "nb", {"n": 1})
+    d = os.path.join(str(tmp_path), "u", "nb")
+    os.makedirs(os.path.join(d, "._tmp_9-dead"))
+    store.save("u", "nb", {"n": 2})
+    left = [n for n in os.listdir(d) if n.startswith("._tmp_")]
+    assert left == []
+    assert store.restore("u", "nb") == {"n": 2}
+
+
+@pytest.mark.parametrize("bad", ["", "nb", "/nb@x", "u/nb@notanint"])
+def test_parse_ref_malformed(bad):
+    with pytest.raises(CheckpointError):
+        parse_ref(bad)
+
+
+# ------------------------------------------------------------- lifecycle
+
+
+def _world(tmp_path, kernels=None, annotations=None, idle_minutes=60):
+    kube = FakeKube()
+    kube.create("notebooks", {
+        "metadata": {"name": "nb", "namespace": "u",
+                     "annotations": dict(annotations or {})},
+        "spec": {"tpu": {"accelerator": "v5litepod-16"}},
+    })
+    parker = Parker(ParkStore(str(tmp_path)))
+    rec = CullingReconciler(
+        kube, fetch_kernels=lambda url: kernels, now=lambda: NOW,
+        parker=parker,
+    )
+    rec.cull_idle_minutes = idle_minutes
+    return kube, rec, parker
+
+
+def _annots(kube):
+    return kube.get("notebooks", "nb", namespace="u",
+                    group="tpukf.dev")["metadata"]["annotations"]
+
+
+def _patch(kube, annotations):
+    kube.patch("notebooks", "nb",
+               {"metadata": {"annotations": annotations}},
+               namespace="u", group="tpukf.dev")
+
+
+def _reasons(kube):
+    return {e.get("reason")
+            for e in kube.list("events", namespace="u")["items"]}
+
+
+def test_idle_park_lifecycle(tmp_path):
+    """idle-park: the cull trigger with policy park checkpoints the
+    kernel list and scale-to-zeroes — chips come back resumable."""
+    stale = (NOW - dt.timedelta(hours=2)).strftime(FMT)
+    kernels = [{"execution_state": "idle", "last_activity": stale}]
+    kube, rec, parker = _world(
+        tmp_path, kernels=kernels,
+        annotations={CULLING_POLICY: parking.POLICY_PARK},
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert a[parking.PARK_REASON_ANNOTATION] == parking.PARK_IDLE
+    state = parker.restore(a[parking.CHECKPOINT_ANNOTATION])
+    assert state["schema"] == "notebookpark/v1"
+    assert state["kernels"] == kernels
+    assert state["spec"]["tpu"]["accelerator"] == "v5litepod-16"
+
+
+def test_preempt_park_lifecycle(tmp_path):
+    """preempt-park: tpusched stamps the request; the culler executes
+    it on its next pass regardless of kernel business, and records the
+    waiter it was parked for."""
+    kube, rec, parker = _world(
+        tmp_path, kernels=[{"execution_state": "busy"}],
+        annotations={
+            parking.PARK_REQUESTED_ANNOTATION: parking.PARK_OVERSUBSCRIBED,
+            parking.PARKED_FOR_ANNOTATION: "u/waiter",
+        },
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION in a
+    assert a[parking.PARK_REASON_ANNOTATION] == parking.PARK_OVERSUBSCRIBED
+    assert a[parking.PARKED_FOR_ANNOTATION] == "u/waiter"
+    assert parking.PARK_REQUESTED_ANNOTATION not in a
+    assert parker.resumable(a[parking.CHECKPOINT_ANNOTATION])
+
+
+def test_resume_lifecycle(tmp_path):
+    """resume: stop cleared + resume-requested → restore from the ref,
+    clear EVERY park annotation, emit Resumed. The notebook comes back
+    with nothing left over to confuse the next reconcile."""
+    kube, rec, parker = _world(
+        tmp_path, kernels=[{"execution_state": "busy"}],
+        annotations={parking.PARK_REQUESTED_ANNOTATION:
+                     parking.PARK_PREEMPTED},
+    )
+    rec.reconcile(Request("u", "nb"))          # park
+    assert STOP_ANNOTATION in _annots(kube)
+    # the open hit (webapps/jupyter PATCH): clear stop, stamp resume
+    requested = (NOW - dt.timedelta(seconds=3)).strftime(FMT)
+    _patch(kube, {STOP_ANNOTATION: None,
+                  parking.RESUME_REQUESTED_ANNOTATION: requested})
+    rec.reconcile(Request("u", "nb"))          # finish the resume
+    a = _annots(kube)
+    for key in (STOP_ANNOTATION, parking.PARKED_ANNOTATION,
+                parking.CHECKPOINT_ANNOTATION,
+                parking.PARK_REASON_ANNOTATION,
+                parking.RESUME_REQUESTED_ANNOTATION,
+                parking.PARK_REQUESTED_ANNOTATION):
+        assert key not in a, key
+    assert parking.REASON_RESUMED in _reasons(kube)
+
+
+def test_resume_wins_park_race(tmp_path):
+    """resume-while-parking: a resume request racing an in-flight park
+    request cancels the park — the notebook never stops (nothing was
+    checkpointed yet, nothing to restore), and BOTH request
+    annotations clear in one pass."""
+    kube, rec, parker = _world(
+        tmp_path, kernels=[{"execution_state": "busy"}],
+        annotations={
+            # tpusched's park request and the user's resume landed
+            # between culler passes, park not yet executed
+            parking.PARK_REQUESTED_ANNOTATION: parking.PARK_OVERSUBSCRIBED,
+            parking.RESUME_REQUESTED_ANNOTATION: NOW.strftime(FMT),
+        },
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert STOP_ANNOTATION not in a
+    assert parking.PARK_REQUESTED_ANNOTATION not in a
+    assert parking.RESUME_REQUESTED_ANNOTATION not in a
+    assert parking.REASON_PARKED not in _reasons(kube)
+
+
+def test_resume_finishes_even_for_training_policy(tmp_path):
+    """The resume branch outranks the policy opt-out: a notebook whose
+    policy flipped to training while parked must still resume."""
+    kube, rec, parker = _world(
+        tmp_path, kernels=[{"execution_state": "busy"}],
+        annotations={parking.PARK_REQUESTED_ANNOTATION:
+                     parking.PARK_PREEMPTED},
+    )
+    rec.reconcile(Request("u", "nb"))          # park
+    _patch(kube, {STOP_ANNOTATION: None,
+                  parking.RESUME_REQUESTED_ANNOTATION: NOW.strftime(FMT),
+                  CULLING_POLICY: "training"})
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert parking.RESUME_REQUESTED_ANNOTATION not in a
+    assert parking.PARKED_ANNOTATION not in a
+    assert parking.REASON_RESUMED in _reasons(kube)
+
+
+def test_lost_checkpoint_resumes_fresh_and_loudly(tmp_path):
+    """A ref nothing can serve must not wedge the notebook: the resume
+    clears the park state (fresh server) and surfaces ResumeFailed —
+    the signal the chaos gate counts as a lost checkpoint."""
+    kube, rec, parker = _world(
+        tmp_path, kernels=[{"execution_state": "busy"}],
+        annotations={
+            parking.PARKED_ANNOTATION: NOW.strftime(FMT),
+            parking.CHECKPOINT_ANNOTATION: "u/nb@404",
+            parking.RESUME_REQUESTED_ANNOTATION: NOW.strftime(FMT),
+        },
+    )
+    rec.reconcile(Request("u", "nb"))
+    a = _annots(kube)
+    assert parking.CHECKPOINT_ANNOTATION not in a
+    assert parking.RESUME_REQUESTED_ANNOTATION not in a
+    assert parking.REASON_RESUME_FAILED in _reasons(kube)
+
+
+# ------------------------------------------------- Parked phase (status)
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_parked_phase_rendered_and_cleared(tmp_path):
+    """The notebook controller surfaces parking in status: a stopped
+    notebook with the parked annotation reads phase=Parked +
+    checkpointRef (the dashboard's "Parked (resume on open)" row and
+    the explainz verdict read exactly this); a resume clearing the
+    annotations drops both keys on the next refresh."""
+    kube = FakeKube()
+    mgr = Manager(kube)
+    NotebookReconciler(kube).register(mgr)
+    mgr.start()
+    try:
+        kube.create("notebooks", {
+            "metadata": {"name": "nb", "namespace": "u",
+                         "annotations": {}},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "notebook", "image": "jupyter:latest"},
+            ]}}},
+        })
+
+        def _status():
+            try:
+                return kube.get("notebooks", "nb", namespace="u",
+                                group="tpukf.dev").get("status") or {}
+            except errors.NotFound:
+                return {}
+
+        assert _wait(lambda: _status() != {})
+        kube.patch("notebooks", "nb", {"metadata": {"annotations": {
+            STOP_ANNOTATION: NOW.strftime(FMT),
+            parking.PARKED_ANNOTATION: NOW.strftime(FMT),
+            parking.CHECKPOINT_ANNOTATION: "u/nb@1",
+        }}}, namespace="u", group="tpukf.dev")
+        assert _wait(lambda: _status().get("phase") == "Parked")
+        assert _status().get("checkpointRef") == "u/nb@1"
+        # resume: the finisher clears the park annotations; the status
+        # rebuild drops phase/checkpointRef with them
+        kube.patch("notebooks", "nb", {"metadata": {"annotations": {
+            STOP_ANNOTATION: None,
+            parking.PARKED_ANNOTATION: None,
+            parking.CHECKPOINT_ANNOTATION: None,
+        }}}, namespace="u", group="tpukf.dev")
+        assert _wait(lambda: _status().get("phase") != "Parked")
+        assert "checkpointRef" not in _status()
+    finally:
+        mgr.stop()

@@ -92,7 +92,8 @@ READS_PER_RECONCILE_MAX = 2.0
 #: the chaos family (cpbench/chaos.py): every member present in a run
 #: gets the invariant legs; --chaos-only additionally requires all five
 CHAOS_SCENARIOS = ("chaos_relist", "chaos_blackout", "chaos_node_death",
-                   "chaos_kubelet_stall", "chaos_429_storm")
+                   "chaos_kubelet_stall", "chaos_429_storm",
+                   "chaos_park_blackout")
 
 
 def chaos_scenarios_in(run: dict) -> list[str]:
@@ -529,6 +530,102 @@ def policy_gate(run: dict) -> list[str]:
     return failures
 
 
+#: the checkpoint-park family (cpbench/park.py): all four members must
+#: be present under --park — latency, herd, gang-interleave, and the
+#: oversubscription A/B each guard a different failure shape
+PARK_SCENARIOS = ("park_resume_cycle", "park_resume_storm",
+                  "park_during_gang", "park_oversubscribe")
+#: the headline acceptance: chips served per physical chip with
+#: oversubscription on — below this, parking never actually multiplied
+#: the fleet
+PARK_OVERSUB_MIN_RATIO = 1.5
+
+
+def park_gate(run: dict) -> list[str]:
+    """--park leg over the park_resume family (cpbench/park.py):
+
+    - all four family members present;
+    - cycle/storm: every parked notebook resumed, zero lost checkpoints
+      (each ref round-trips the store), zero pods while parked (the
+      chips were actually free), park/resume latency p50/p95 present,
+      and the ``resume_latency`` SLO met;
+    - park_during_gang: zero double bookings and zero lost checkpoints
+      through the park→second-wave→resume interleave;
+    - park_oversubscribe: oversubscription ratio ≥ 1.5× physical, above
+      its non-oversubscribed baseline arm, with create→Ready SLO
+      attainment no worse than that baseline, zero double bookings and
+      zero lost checkpoints — the paper's scale-to-zero headline."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    for name in PARK_SCENARIOS:
+        s = scenarios.get(name)
+        if s is None:
+            failures.append(f"{name}: missing from run — no "
+                            "checkpoint-park evidence")
+            continue
+        extra = s.get("extra") or {}
+        lost = extra.get("lost_checkpoints")
+        if lost is None or lost > 0:
+            failures.append(
+                f"{name}: lost_checkpoints={lost} (must be reported "
+                "and 0 — a parked notebook whose ref no longer "
+                "restores is a lost notebook)"
+            )
+        if name in ("park_resume_cycle", "park_resume_storm"):
+            parked, resumed = extra.get("parked"), extra.get("resumed")
+            if not parked or resumed != parked:
+                failures.append(
+                    f"{name}: parked={parked} resumed={resumed} — "
+                    "every parked notebook must resume"
+                )
+            pods = extra.get("pods_while_parked")
+            if pods is None or pods > 0:
+                failures.append(
+                    f"{name}: pods_while_parked={pods} (must be "
+                    "reported and 0 — parked notebooks still holding "
+                    "pods are not scale-to-zero)"
+                )
+            for leg in ("park_ms", "resume_ms"):
+                dist = extra.get(leg) or {}
+                if "p50" not in dist or "p95" not in dist:
+                    failures.append(f"{name}: {leg} p50/p95 missing")
+            slo = (s.get("slo") or {}).get("resume_latency")
+            if not isinstance(slo, dict) or not slo.get("met"):
+                failures.append(
+                    f"{name}: resume_latency SLO missing or not met — "
+                    f"attainment {None if not isinstance(slo, dict) else slo.get('attainment')}"  # noqa: E501
+                )
+        if name in ("park_during_gang", "park_oversubscribe"):
+            db = extra.get("double_bookings")
+            if db is None or db > 0:
+                failures.append(
+                    f"{name}: double_bookings={db} (must be reported "
+                    "and 0)"
+                )
+        if name == "park_oversubscribe":
+            ratio = extra.get("oversubscription_ratio")
+            base = extra.get("baseline_ratio")
+            if not isinstance(ratio, (int, float)) \
+                    or ratio < PARK_OVERSUB_MIN_RATIO:
+                failures.append(
+                    f"{name}: oversubscription_ratio={ratio} below "
+                    f"{PARK_OVERSUB_MIN_RATIO} — parking never "
+                    "multiplied the fleet"
+                )
+            elif isinstance(base, (int, float)) and ratio <= base:
+                failures.append(
+                    f"{name}: oversubscription_ratio={ratio} does not "
+                    f"beat the non-oversubscribed baseline {base}"
+                )
+            if not extra.get("slo_attainment_held"):
+                failures.append(
+                    f"{name}: create→Ready SLO attainment fell below "
+                    "the non-oversubscribed baseline — the extra "
+                    "tenants were paid for with the product promise"
+                )
+    return failures
+
+
 #: passes each lint report must PROVE ran (names in report["passes"]),
 #: keyed by report schema — the three ISSUE 13 cplint dataflow passes
 #: plus the five ISSUE 14 jaxlint passes: a report written by an older
@@ -686,6 +783,15 @@ def main(argv=None) -> int:
                          "bookings and 0 illegal choices per arm, "
                          "learned SLO attainment no worse than "
                          "best_fit; composes with the other legs)")
+    ap.add_argument("--park", action="store_true",
+                    help="fail on missing/violated checkpoint-park "
+                         "evidence in --run (cpbench --park; all four "
+                         "park_resume scenarios, every park resumed, 0 "
+                         "lost checkpoints / double bookings / pods "
+                         "while parked, resume_latency SLO met, "
+                         "oversubscription ratio >= 1.5x at attainment "
+                         "no worse than baseline; composes with the "
+                         "other legs)")
     ap.add_argument("--failover", action="store_true",
                     help="fail on missing/violated failover p95, dual "
                          "reconciles or orphaned keys in the ha_scale "
@@ -756,6 +862,8 @@ def main(argv=None) -> int:
             ap.error("--failover requires --run")
         if args.policy:
             ap.error("--policy requires --run")
+        if args.park:
+            ap.error("--park requires --run")
         if args.prof_report:
             ap.error("--prof-report requires --run")
         if args.store_lock_max_share is not None:
@@ -775,6 +883,8 @@ def main(argv=None) -> int:
         failures += failover_gate(run)
     if run is not None and args.policy:
         failures += policy_gate(run)
+    if run is not None and args.park:
+        failures += park_gate(run)
     if args.store_lock_max_share is not None and not args.prof_report:
         # the share rides the per-scenario prof records: requesting it
         # without the leg that reads them is a misconfigured CI step
@@ -789,14 +899,15 @@ def main(argv=None) -> int:
                               or not (args.slo_report
                                       or args.prof_report
                                       or args.failover
-                                      or args.policy)):
+                                      or args.policy
+                                      or args.park)):
         # latency legs need the committed record; a pure --slo-report /
-        # --prof-report / --failover / --policy invocation legitimately
-        # runs without one
+        # --prof-report / --failover / --policy / --park invocation
+        # legitimately runs without one
         if not args.baseline:
             ap.error("--baseline is required unless --chaos-only, "
-                     "--slo-report, --prof-report, --failover or "
-                     "--policy")
+                     "--slo-report, --prof-report, --failover, "
+                     "--policy or --park")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -858,6 +969,21 @@ def main(argv=None) -> int:
                     f"{ln['fragmentation']['stranded_free_chips_mean']}"
                     f", 0 double bookings / 0 illegal choices",
                     file=sys.stderr)
+        if run is not None and args.park:
+            cyc = (run["scenarios"]["park_resume_cycle"]["extra"])
+            osub = (run["scenarios"]["park_oversubscribe"]["extra"])
+            print(
+                f"bench_gate ok: park p50/p95 "
+                f"{cyc['park_ms'].get('p50', float('nan')):.0f}/"
+                f"{cyc['park_ms'].get('p95', float('nan')):.0f} ms, "
+                f"resume p50/p95 "
+                f"{cyc['resume_ms'].get('p50', float('nan')):.0f}/"
+                f"{cyc['resume_ms'].get('p95', float('nan')):.0f} ms, "
+                f"oversubscription "
+                f"{osub.get('oversubscription_ratio')}x (baseline "
+                f"{osub.get('baseline_ratio')}x) with SLO attainment "
+                "held, 0 lost checkpoints / 0 double bookings",
+                file=sys.stderr)
         if run is not None and args.prof_report:
             ov = run.get("profiler_overhead") or {}
             print(f"bench_gate ok: cpprof attribution present in all "

@@ -6,8 +6,10 @@ the OS scheduler happens to produce. This module closes the remaining
 gap: it runs *small-scope models* of the consensus-critical code — the
 cpshard handoff ack-barrier (engine/shard.py), leader-election expiry
 under skew (engine/leaderelection.py), FakeKube's MVCC optimistic
-commits (kube/fake.py), and the workqueue get→done contract
-(engine/queue.py) — under a **cooperative scheduler** that serializes
+commits (kube/fake.py), the workqueue get→done contract
+(engine/queue.py), and the park→release→resume→re-admit protocol
+(controlplane/parking + controllers/culling.py, driven against the
+real CullingReconciler) — under a **cooperative scheduler** that serializes
 the model's threads at instrumented sync points and *enumerates* their
 interleavings:
 
@@ -32,12 +34,14 @@ interleavings:
   (the exact choice list) as JSON; ``--replay`` re-runs that exact
   interleaving, and tests/test_schedsim.py replays dumps as failing
   tests.
-- **mutation validation** (``--mutations``): ~10 hand-seeded protocol
+- **mutation validation** (``--mutations``): ~13 hand-seeded protocol
   bugs (drop the ack barrier, ack before drain, skip self-fence,
   activate through a stale post-fence map, ignore lease skew bounds,
   steal held leases, drop the MVCC commit identity check, emit DELETED
   at the stale RV, drop the dirty re-add, skip processing
-  registration) each applied as a runtime patch; every one must be
+  registration, stop a parking notebook before its checkpoint commits,
+  stamp a never-committed checkpoint ref, drop the resume-wins park
+  cancellation) each applied as a runtime patch; every one must be
   caught by the explorer within the CI budget, and clean HEAD must
   explore violation-free. A checker that cannot catch a seeded
   regression of a bug this repo already fixed once guards nothing.
@@ -63,6 +67,7 @@ import json
 import pathlib
 import random
 import sys
+import tempfile
 import threading
 import time
 
@@ -71,7 +76,19 @@ if str(REPO) not in sys.path:  # pragma: no cover - direct invocation
     sys.path.insert(0, str(REPO))
 
 from service_account_auth_improvements_tpu.controlplane import (  # noqa: E402,E501
+    parking,
     syncpoint,
+    tpu as tpu_mod,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.culling import (  # noqa: E402,E501
+    CullingReconciler,
+)
+from service_account_auth_improvements_tpu.controlplane.controllers.notebook import (  # noqa: E402,E501
+    STOP_ANNOTATION,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
+    Request,
+    Result,
 )
 from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
     leaderelection,
@@ -1347,6 +1364,266 @@ class QueueGetDoneModel:
             )
 
 
+_PARK_MODEL_ROOT: str | None = None
+
+
+def _park_model_store() -> "parking.ParkStore":
+    """One shared on-disk store root per process — the explorer builds
+    a fresh model per schedule, and a per-run mkdtemp would leak
+    thousands of directories; each model init wipes its notebook's
+    subtree instead, so schedules stay independent."""
+    global _PARK_MODEL_ROOT
+    if _PARK_MODEL_ROOT is None:
+        _PARK_MODEL_ROOT = tempfile.mkdtemp(prefix="schedsim-park-")
+    return parking.ParkStore(_PARK_MODEL_ROOT)
+
+
+class ParkResumeModel:
+    """Park→release→resume→re-admit (controlplane/parking) over the
+    REAL CullingReconciler — the single park executor and resume
+    finisher — with a scripted tpusched mirror: the mirror stamps the
+    oversubscription park request, waits for the stop, clears the pool
+    annotation BEFORE freeing the booking, admits the waiter onto the
+    freed pool, and then re-requests a park while the user's resume is
+    still in flight (the next oversubscription round racing the resume
+    finisher — the exact window the resume-wins rule exists for).
+    Invariants over the FULL watch history plus the final state: no
+    torn park (a Parked instant without its checkpoint ref), every
+    Parked instant carries a restorable ref, no lost checkpoint on
+    resume (a ResumeFailed event), at most one booking per pool per
+    instant, and final convergence — the notebook ends running with
+    every park annotation cleared (a leftover park request would
+    re-park the notebook the user just resumed)."""
+
+    name = "park_resume"
+    max_decisions = 800
+    preemption_bound = 2
+    budget = 300
+
+    NS = "team"
+    NB = "victim"
+    POOL_A = "pool-a"
+    POOL_B = "pool-b"
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        self.store = _park_model_store()
+        self.store.delete(self.NS, self.NB)   # fresh store per schedule
+        self.parker = parking.Parker(self.store)
+        self.culler = CullingReconciler(
+            self.kube, fetch_kernels=lambda url: None,
+            now=self.clock.now, parker=self.parker,
+        )
+        self.kube.create("notebooks", {
+            "metadata": {"name": self.NB, "namespace": self.NS,
+                         "annotations": {
+                             tpu_mod.ANNOTATION_NODEPOOL: self.POOL_A,
+                         }},
+            "spec": {"tpu": {"accelerator": "v5litepod-16"}},
+            "status": {"readyReplicas": 1},
+        }, namespace=self.NS, group=GROUP)
+        #: booking mirror: pool -> holders; two holders at any instant
+        #: is the double booking the release ordering prevents
+        self.holders = {self.POOL_A: {self.NB}, self.POOL_B: set()}
+        self.double: list[str] = []
+        self.resume_patched = False
+
+    def yield_on(self, label):
+        return (label.startswith("sync:fake.")
+                or label.startswith("sync:model."))
+
+    # ---------------------------------------------------------- helpers
+
+    def _annots(self) -> dict:
+        try:
+            nb = self.kube.get("notebooks", self.NB, namespace=self.NS,
+                               group=GROUP)
+        except errors.NotFound:
+            return {}
+        return nb["metadata"].get("annotations") or {}
+
+    def _stopped(self) -> bool:
+        return STOP_ANNOTATION in self._annots()
+
+    def _parked(self) -> bool:
+        return parking.PARKED_ANNOTATION in self._annots()
+
+    def _requested(self) -> bool:
+        return parking.PARK_REQUESTED_ANNOTATION in self._annots()
+
+    def _resume_pending(self) -> bool:
+        return parking.RESUME_REQUESTED_ANNOTATION in self._annots()
+
+    def _book(self, pool: str, name: str) -> None:
+        held = self.holders[pool]
+        if held:
+            self.double.append(
+                f"{pool} booked for {name} while held by {sorted(held)}"
+            )
+        held.add(name)
+
+    def _patch_nb(self, annotations: dict) -> None:
+        try:
+            self.kube.patch("notebooks", self.NB,
+                            {"metadata": {"annotations": annotations}},
+                            namespace=self.NS, group=GROUP)
+        except errors.NotFound:
+            pass
+
+    # ---------------------------------------------------------- threads
+
+    def _sched(self):
+        # oversubscription: no pool feasible for the waiter — park the
+        # coldest tenant (scheduler/reconciler.py _finish_park shape)
+        step("sched.request")
+        self._patch_nb({
+            parking.PARK_REQUESTED_ANNOTATION:
+                parking.PARK_OVERSUBSCRIBED,
+            parking.PARKED_FOR_ANNOTATION: "waiter",
+        })
+        wait_until(self._stopped, label="park.stop")
+        # release: clear the placement BEFORE freeing the chips (the
+        # scheduler's stop-branch ordering — two live annotations on
+        # one pool would read as a double booking), then admit the
+        # waiter onto the freed pool
+        step("sched.release")
+        self._patch_nb({tpu_mod.ANNOTATION_NODEPOOL: None})
+        step("sched.free")
+        self.holders[self.POOL_A].discard(self.NB)
+        self._book(self.POOL_A, "waiter")
+        # the NEXT oversubscription round racing the resume finisher:
+        # the request must land on a still-resuming notebook or not at
+        # all, so it rides an optimistic update gated on the
+        # resume-requested annotation
+        wait_until(lambda: self.resume_patched, label="resume.seen")
+        for _ in range(4):
+            try:
+                nb = self.kube.get("notebooks", self.NB,
+                                   namespace=self.NS, group=GROUP)
+            except errors.NotFound:
+                return
+            annots = nb["metadata"].setdefault("annotations", {})
+            if parking.RESUME_REQUESTED_ANNOTATION not in annots:
+                return   # resume already finished: nothing to race
+            annots[parking.PARK_REQUESTED_ANNOTATION] = (
+                parking.PARK_OVERSUBSCRIBED)
+            try:
+                self.kube.update("notebooks", nb, namespace=self.NS,
+                                 group=GROUP)
+                return
+            except errors.Conflict:
+                continue
+            except errors.NotFound:
+                return
+
+    def _culler(self):
+        req = Request(self.NS, self.NB)
+
+        def settled():
+            return (self.resume_patched and not self._resume_pending()
+                    and not self._stopped())
+
+        while not settled():
+            wait_until(lambda: (settled() or self._requested()
+                                or self._resume_pending()),
+                       label="culler.wake")
+            if settled():
+                break
+            step("culler.pass")
+            self.culler.reconcile(req)
+
+    def _user(self):
+        wait_until(self._parked, label="parked")
+        # the open hit: the webapp PATCH clears the stop annotation,
+        # stamps resume-requested when a checkpoint exists, and cancels
+        # any in-flight park request (webapps/jupyter/app.py mirror)
+        step("user.open")
+        annots = self._annots()
+        patch = {STOP_ANNOTATION: None}
+        if parking.CHECKPOINT_ANNOTATION in annots:
+            patch[parking.RESUME_REQUESTED_ANNOTATION] = (
+                self.clock.now().strftime("%Y-%m-%dT%H:%M:%SZ"))
+        if parking.PARK_REQUESTED_ANNOTATION in annots:
+            patch[parking.PARK_REQUESTED_ANNOTATION] = None
+        self._patch_nb(patch)
+        self.resume_patched = True
+        wait_until(lambda: (not self._resume_pending()
+                            and not self._stopped()),
+                   label="resumed")
+        # re-admission: the resumed notebook goes back through the
+        # queue and books a (new) pool
+        step("user.readmit")
+        self._book(self.POOL_B, self.NB)
+
+    def threads(self):
+        return [("SCHED", self._sched), ("CULL", self._culler),
+                ("USER", self._user)]
+
+    # ------------------------------------------------------------ check
+
+    def check(self):
+        if self.double:
+            raise Violation("double booking: " + "; ".join(self.double))
+        parked_instants = 0
+        for ev in self.kube.watch("notebooks", namespace=self.NS,
+                                  group=GROUP, resource_version=0,
+                                  timeout=0.01):
+            if ev["type"] == "DELETED":
+                continue
+            annots = (ev["object"]["metadata"].get("annotations")
+                      or {})
+            if parking.PARKED_ANNOTATION not in annots:
+                continue
+            parked_instants += 1
+            ref = annots.get(parking.CHECKPOINT_ANNOTATION)
+            if not ref:
+                raise Violation(
+                    "torn park: a Parked state without its checkpoint "
+                    "ref is in the history — a crash there strands a "
+                    "stopped notebook with no restorable state"
+                )
+            if not self.parker.resumable(ref):
+                raise Violation(
+                    f"Parked state carries unrestorable ref {ref!r} — "
+                    "the checkpoint never committed before the stop "
+                    "landed"
+                )
+        if not parked_instants:
+            raise Violation(
+                "the park never executed: no Parked state in the "
+                "watch history"
+            )
+        for ev in self.kube.list("events",
+                                 namespace=self.NS)["items"]:
+            if ev.get("reason") == parking.REASON_RESUME_FAILED:
+                raise Violation(
+                    "lost checkpoint: the resume finisher raised "
+                    f"ResumeFailed — {ev.get('message')}"
+                )
+        final = self._annots()
+        leftover = sorted(
+            a for a in (STOP_ANNOTATION, parking.PARKED_ANNOTATION,
+                        parking.CHECKPOINT_ANNOTATION,
+                        parking.PARK_REASON_ANNOTATION,
+                        parking.PARK_REQUESTED_ANNOTATION,
+                        parking.RESUME_REQUESTED_ANNOTATION,
+                        parking.PARKED_FOR_ANNOTATION)
+            if a in final
+        )
+        if leftover:
+            raise Violation(
+                f"resume did not win: the notebook ended with "
+                f"{leftover} still set — a pending park request here "
+                "re-parks the notebook the user just resumed"
+            )
+        if self.holders != {self.POOL_A: {"waiter"},
+                            self.POOL_B: {self.NB}}:
+            raise Violation(
+                f"re-admission bookkeeping diverged: {self.holders}"
+            )
+
+
 class LockInversionModel:
     """The test_cplint two-thread A→B/B→A fixture as a schedsim model:
     the explorer must FIND the deadlock interleaving within a bounded
@@ -1401,6 +1678,7 @@ MODELS: dict = {
     m.name: m for m in (
         ShardHandoffModel, ShardFenceModel, LeaseExpiryModel,
         LeaseRaceModel, MvccUpdateModel, QueueGetDoneModel,
+        ParkResumeModel,
     )
 }
 
@@ -1595,6 +1873,67 @@ def _mut_get_skips_processing(self, timeout):
             self._lock.wait(wait)
 
 
+def _mut_park_stop_before_checkpoint(self, req, nb, annots, reason,
+                                     period, kernels=None,
+                                     idle_for=None, base_patch=None):
+    # seeded bug: the park verb's crash invariant inverted — stop +
+    # parked stamped BEFORE the checkpoint commits (the torn-park
+    # window the real _execute_park exists to close)
+    now = self.now()
+    patch = base_patch or {"metadata": {"annotations": {}}}
+    patch["metadata"]["annotations"].update({
+        STOP_ANNOTATION: now.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        parking.PARKED_ANNOTATION: now.strftime("%Y-%m-%dT%H:%M:%SZ"),
+        parking.PARK_REASON_ANNOTATION: reason,
+        parking.PARK_REQUESTED_ANNOTATION: None,
+    })
+    try:
+        self.kube.patch("notebooks", req.name, patch,
+                        namespace=req.namespace, group=GROUP)
+    except errors.NotFound:
+        return Result()
+    ref = self.parker.park(nb, kernels)
+    try:
+        self.kube.patch("notebooks", req.name,
+                        {"metadata": {"annotations": {
+                            parking.CHECKPOINT_ANNOTATION: ref,
+                        }}}, namespace=req.namespace, group=GROUP)
+    except errors.NotFound:
+        pass
+    return Result(requeue_after=period.total_seconds())
+
+
+def _mut_park_uncommitted_ref(self, nb, kernels=None):
+    # seeded bug: park hands back a ref whose save never committed —
+    # the checkpoint the resume will need does not exist
+    meta = nb.get("metadata") or {}
+    return f"{meta.get('namespace') or ''}/{meta['name']}@1"
+
+
+def _mut_resume_keeps_park_request(self, req, nb, annots, period):
+    # seeded bug: the resume finisher no longer cancels an in-flight
+    # park request ("resume wins" dropped) — the next culler pass
+    # re-parks the notebook the user just resumed
+    ref = annots.get(parking.CHECKPOINT_ANNOTATION)
+    if ref:
+        try:
+            self.parker.restore(ref)
+        except Exception:  # noqa: BLE001 — mutant keeps the happy path
+            pass
+    try:
+        self.kube.patch("notebooks", req.name,
+                        {"metadata": {"annotations": {
+                            parking.RESUME_REQUESTED_ANNOTATION: None,
+                            parking.PARKED_ANNOTATION: None,
+                            parking.PARK_REASON_ANNOTATION: None,
+                            parking.PARKED_FOR_ANNOTATION: None,
+                            parking.CHECKPOINT_ANNOTATION: None,
+                        }}}, namespace=req.namespace, group=GROUP)
+    except errors.NotFound:
+        return Result()
+    return Result(requeue_after=period.total_seconds())
+
+
 class Mutant:
     def __init__(self, name: str, models: tuple, apply_cm,
                  description: str):
@@ -1652,6 +1991,23 @@ MUTANTS: dict = {
                         _mut_get_skips_processing),
                "dequeue skips _processing registration — per-key "
                "serialization lost"),
+        Mutant("park-stop-before-checkpoint", ("park_resume",),
+               _patched(CullingReconciler, "_execute_park",
+                        _mut_park_stop_before_checkpoint),
+               "the park verb stops the notebook BEFORE the checkpoint "
+               "commits — a crash in the window strands a stopped "
+               "notebook with no restorable state"),
+        Mutant("park-ref-never-committed", ("park_resume",),
+               _patched(parking.Parker, "park",
+                        _mut_park_uncommitted_ref),
+               "park stamps a checkpoint ref whose save never "
+               "committed — the resume finds nothing restorable"),
+        Mutant("park-resume-keeps-request", ("park_resume",),
+               _patched(CullingReconciler, "_finish_resume",
+                        _mut_resume_keeps_park_request),
+               "the resume finisher no longer cancels an in-flight "
+               "park request — the next culler pass re-parks a "
+               "just-resumed notebook"),
     )
 }
 
