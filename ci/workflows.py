@@ -353,6 +353,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                     "--scenario chaos_kubelet_stall "
                     "--scenario chaos_429_storm "
                     "--scenario chaos_park_blackout "
+                    "--scenario chaos_alert_fidelity "
                     "--out chaos_out.json --dump-dir bench_out"},
             {"name": "Chaos invariant gate",
              "run": "python tools/bench_gate.py "
@@ -392,6 +393,24 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             {"name": "Failover + APF gate",
              "run": "python tools/bench_gate.py "
                     "--run ha_out.json --failover --slo-report"},
+            # fleet observability smoke (docs/observability.md
+            # "Fleet"): ha_scale's replica sweep with the aggregator
+            # scraping every replica over real HTTP, plus the
+            # alert-fidelity blackout — then the fleet gate: stitched
+            # cross-replica traces with handoff-gap spans, duration-
+            # weighted attribution >= 0.95, scrape-overhead A/B held,
+            # page alert fired during the outage / resolved after / 0
+            # false fires. One run file: the gate grades stitching and
+            # alerting as one piece of evidence.
+            {"name": "Run cpbench fleet --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke "
+                    "--scenario ha_scale "
+                    "--scenario chaos_alert_fidelity "
+                    "--out fleet_out.json --dump-dir bench_out"},
+            {"name": "Fleet observability gate",
+             "run": "python tools/bench_gate.py "
+                    "--run fleet_out.json --fleet"},
             # learned placement (docs/scheduler.md): the A/B family
             # needs the JAX half of the tree — installed HERE so every
             # earlier step keeps proving the control plane runs
@@ -432,7 +451,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "with": {"name": "controlplane-bench",
                       "path": "bench_out.json\nchaos_out.json\n"
                               "park_out.json\n"
-                              "ha_out.json\npolicy_out.json\n"
+                              "ha_out.json\nfleet_out.json\n"
+                              "policy_out.json\n"
                               "cplint_report.json\n"
                               "jaxlint_report.json\n"
                               "jaxlint_mutations.json\n"

@@ -159,6 +159,40 @@
             `${metrics.length} series from the metrics service`)));
       }
     } catch (e) { /* metrics service optional */ }
+
+    // cpfleet panel: replica liveness, firing burn-rate alerts, the
+    // autoscaler saturation roll-up. Admin-only on the server (403 for
+    // everyone else) and best-effort here — a single-replica or
+    // unwired deployment just doesn't grow the card
+    try {
+      const { fleet } = await api("GET", "api/fleet");
+      const reps = fleet.replicas || {};
+      const names = Object.keys(reps).sort();
+      const up = names.filter((n) => reps[n].up).length;
+      const firing = ((fleet.alerts || {}).rules || [])
+        .filter((r) => r.state === "firing");
+      const sat = (fleet.saturation || {}).fleet || {};
+      const card = el("div", { class: "card" },
+        el("h3", { style: "margin-top:0" }, "Fleet"),
+        el("div", { class: fleet.partial ? "" : "muted" },
+          `${up}/${names.length} replicas up` +
+          (fleet.partial ? ` — PARTIAL: ${fleet.dark.join(", ")} dark`
+            : "")),
+        el("div", { class: "muted" },
+          `saturation (hottest replica): queue ` +
+          `${sat.queue_depth_per_worker ?? "—"}/worker, busy ` +
+          `${sat.busy_ratio ?? "—"}`),
+        el("div", { class: "muted" },
+          `${fleet.stitched_multi_replica || 0} stitched ` +
+          `cross-replica trace(s), ${fleet.trace_count || 0} total`));
+      for (const r of firing) {
+        card.appendChild(el("div", {},
+          `⚠ ${r.severity} alert firing: ${r.objective} burning ` +
+          `${r.burn_short}x / ${r.burn_long}x ` +
+          `(threshold ${r.threshold}x)`));
+      }
+      main.appendChild(card);
+    } catch (e) { /* fleet panel is admin-only and optional */ }
   }
 
   function renderIframe(path) {

@@ -88,6 +88,66 @@ def run_manager(register, argv=None, add_args=None) -> int:
 
     slo_engine = default_engine().attach(obs.TRACER)
 
+    if args.shard and args.leader_elect:
+        # silently preferring one would leave the operator believing
+        # the OTHER HA story is in force (single-writer vs sharded
+        # active-active are different safety arguments)
+        parser.error("--shard and --leader-elect are mutually "
+                     "exclusive: sharding IS the multi-writer safety "
+                     "story (docs/ha.md)")
+    shard_runtime = None
+    fleet_agg = None
+    alert_engine = None
+    if args.shard:
+        import socket
+        import sys
+        import uuid
+
+        from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E501
+            DEFAULT_NUM_SHARDS,
+            ShardRuntime,
+        )
+        from service_account_auth_improvements_tpu.controlplane.events import (  # noqa: E501
+            EventRecorder,
+        )
+
+        group = args.shard_group or (
+            "cpshard-" + (sys.argv[0].rsplit("/", 1)[-1]
+                          .removesuffix(".py").replace("_", "-"))
+        )
+        identity = f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
+        shard_runtime = ShardRuntime(
+            client, identity, group=group,
+            namespace=args.leader_elect_namespace,
+            num_shards=args.shards or DEFAULT_NUM_SHARDS,
+            journal=obs.JOURNAL,
+            # member-Lease ops-url advertisement: this is how the fleet
+            # aggregator on the coordinator discovers every replica's
+            # scrape endpoint — no extra registry, membership IS the
+            # service discovery
+            ops_url=f"http://{socket.gethostname()}:{args.metrics_port}",
+        )
+        manager.attach_shard(shard_runtime.member)
+        # cpfleet: every replica carries an aggregator + alert engine;
+        # only the coordinator-lease holder scrapes (the loop skips
+        # ticks while is_coordinator is False), so /debug/fleetz and
+        # /alertz answer wherever the coordinator lands after failover
+        alert_engine = obs.AlertEngine(
+            objectives=slo_engine.objectives,
+            journal=obs.JOURNAL,
+            recorder=EventRecorder(client, f"{group}-fleet"),
+            namespace=args.leader_elect_namespace,
+        )
+        fleet_agg = obs.FleetAggregator(
+            obs.lease_replicas_fn(
+                client, group=group,
+                namespace=args.leader_elect_namespace,
+            ),
+            alerts=alert_engine,
+            is_coordinator=shard_runtime.is_coordinator,
+            journal=obs.JOURNAL,
+        )
+
     # readiness is LIVE informer-sync state, not a started flag: a watch
     # that loses its caches after startup (long apiserver outage) reads
     # not-ready again instead of lying to the kubelet
@@ -103,41 +163,17 @@ def run_manager(register, argv=None, add_args=None) -> int:
         # /debug/profilez: the process profiler (idle unless CPPROF=1 —
         # the page then says so instead of 404ing)
         profiler=obs.PROFILER,
+        # /debug/fleetz + /alertz (obs/fleet, obs/alerts; --shard only)
+        fleet=fleet_agg, alerts=alert_engine,
     )
 
-    if args.shard and args.leader_elect:
-        # silently preferring one would leave the operator believing
-        # the OTHER HA story is in force (single-writer vs sharded
-        # active-active are different safety arguments)
-        parser.error("--shard and --leader-elect are mutually "
-                     "exclusive: sharding IS the multi-writer safety "
-                     "story (docs/ha.md)")
-    shard_runtime = None
-    if args.shard:
-        import socket
-        import sys
-        import uuid
-
-        from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: E501
-            DEFAULT_NUM_SHARDS,
-            ShardRuntime,
-        )
-
-        group = args.shard_group or (
-            "cpshard-" + (sys.argv[0].rsplit("/", 1)[-1]
-                          .removesuffix(".py").replace("_", "-"))
-        )
-        identity = f"{socket.gethostname()}-{uuid.uuid4().hex[:6]}"
-        shard_runtime = ShardRuntime(
-            client, identity, group=group,
-            namespace=args.leader_elect_namespace,
-            num_shards=args.shards or DEFAULT_NUM_SHARDS,
-            journal=obs.JOURNAL,
-        )
-        manager.attach_shard(shard_runtime.member)
+    if shard_runtime is not None:
         shard_runtime.start()
+        fleet_agg.start()
         logging.getLogger(__name__).info(
-            "cpshard: replica %s joined group %s", identity, group)
+            "cpshard: replica %s joined group %s "
+            "(fleet aggregator armed; scrapes while coordinator)",
+            identity, group)
 
     elector = None
     if args.leader_elect:
@@ -175,6 +211,8 @@ def run_manager(register, argv=None, add_args=None) -> int:
         signal.signal(sig, lambda *_: stop.set())
     stop.wait()
     manager.stop()
+    if fleet_agg is not None:
+        fleet_agg.stop()
     if shard_runtime is not None:
         # graceful leave: clears the member lease so the coordinator
         # reassigns our shards now instead of after the expiry

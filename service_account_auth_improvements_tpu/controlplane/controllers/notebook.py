@@ -354,7 +354,10 @@ class NotebookReconciler(Reconciler):
         # incarnation — the durable correlation handle for dashboards /
         # kubectl (the in-memory binding alone would die with the pod).
         # A MISMATCHED annotation (exported manifest re-applied, carrying
-        # the old incarnation's id) is re-stamped to self-heal.
+        # the old incarnation's id) is re-stamped to self-heal. On a
+        # handed-off key the gaining replica resolves the SAME id (uid-
+        # derived; annotation honored for uid-less objects), so both
+        # replicas' spans stitch into one fleet trace (obs/fleet.py).
         trace_id = obs.object_trace_id("notebooks", nb)
         if (nb["metadata"].get("annotations") or {}).get(
                 obs.TRACE_ANNOTATION) != trace_id:
@@ -367,6 +370,12 @@ class NotebookReconciler(Reconciler):
                 )
             except errors.NotFound:
                 return Result()
+            except errors.ApiError:
+                # the stamp is telemetry: a flaky apiserver (429 storm,
+                # blackout) must not fail the reconcile over it — the
+                # in-memory binding below still attributes this pass,
+                # and the next reconcile retries the PATCH
+                pass
 
         try:
             resolved = tpu.resolve((nb.get("spec") or {}).get("tpu"))

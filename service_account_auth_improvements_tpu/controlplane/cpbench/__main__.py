@@ -65,6 +65,7 @@ SMOKE_N = {
     "chaos_kubelet_stall": 8,
     "chaos_429_storm": 8,     # 8 gangs drained through 429 pulses
     "chaos_park_blackout": 8,  # 4 parked + 4 queued through 2 outages
+    "chaos_alert_fidelity": 8,  # canary-fed page alert through a blackout
     "ha_scale": 120,          # CRs per replica arm (x3 arms: 1/2/4)
     "ha_failover": 60,        # two waves around the leader kill
     "ha_apf": 400,            # protected-lane requests per A/B arm
@@ -89,6 +90,7 @@ FULL_N = {
     "chaos_kubelet_stall": 16,
     "chaos_429_storm": 16,
     "chaos_park_blackout": 16,
+    "chaos_alert_fidelity": 16,
     "ha_scale": 10_000,       # the ROADMAP scale: 10k CRs per arm, and
                               # ~100k watch events across the 4-replica
                               # arm's informers
@@ -129,6 +131,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "placement A/B: best_fit arm → train on its "
                          "journal → learned arm; needs the JAX half "
                          "of the tree; docs/scheduler.md) in the run")
+    ap.add_argument("--fleet", action="store_true",
+                    help="include the cpfleet observability lane "
+                         "(ha_scale's fleet-aggregated replica sweep + "
+                         "chaos_alert_fidelity's burn-rate alert "
+                         "fire/resolve check; gated by bench_gate "
+                         "--fleet; docs/observability.md 'Fleet') in "
+                         "the run")
     ap.add_argument("--park", action="store_true",
                     help="include the park_resume family (checkpoint-"
                          "park/resume latency, resume storm, park-"
@@ -308,14 +317,16 @@ def run(args) -> dict:
     # --chaos folds the fault-injection family in, --ha the sharded-
     # plane family (both arm-sweep benches, not latency-lane members);
     # --scenario overrides
+    fleet_lane = {"ha_scale", "chaos_alert_fidelity"}
     wanted = args.scenario or sorted(
         name for name in SCENARIOS
-        if (args.chaos or name not in CHAOS_SCENARIOS)
-        and (getattr(args, "ha", False) or name not in HA_SCENARIOS)
-        and (getattr(args, "policy", False)
-             or name not in POLICY_SCENARIOS)
-        and (getattr(args, "park", False)
-             or name not in PARK_SCENARIOS)
+        if (getattr(args, "fleet", False) and name in fleet_lane)
+        or ((args.chaos or name not in CHAOS_SCENARIOS)
+            and (getattr(args, "ha", False) or name not in HA_SCENARIOS)
+            and (getattr(args, "policy", False)
+                 or name not in POLICY_SCENARIOS)
+            and (getattr(args, "park", False)
+                 or name not in PARK_SCENARIOS))
     )
     started = time.monotonic()
     report: dict = {

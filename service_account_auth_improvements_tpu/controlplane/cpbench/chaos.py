@@ -35,6 +35,7 @@ Invariant glossary and injector catalog: docs/chaos.md.
 
 from __future__ import annotations
 
+import dataclasses
 import shutil
 import tempfile
 import time
@@ -70,6 +71,7 @@ from service_account_auth_improvements_tpu.controlplane.metrics import (
     Registry,
 )
 from service_account_auth_improvements_tpu.controlplane import (
+    obs,
     parking,
     tpu as tpu_mod,
 )
@@ -981,6 +983,170 @@ def _run_chaos_park_blackout(cfg: BenchConfig, started: float,
         world.stop()
 
 
+def scenario_chaos_alert_fidelity(cfg: BenchConfig) -> ScenarioResult:
+    """The fleet's page alert is TRUSTWORTHY: zero false fires over a
+    healthy canary lane, fires during an injected apiserver blackout,
+    resolves promptly after recovery. The full production pipeline runs
+    over real HTTP — a canary SloEngine exposes cumulative counters on
+    an ops port, the FleetAggregator scrapes/merges them, and the
+    AlertEngine evaluates the SRE-workbook page rule (14.4x burn over
+    both windows, windows compressed via ``AlertRule.scaled`` so the
+    REAL window math runs against a seconds-long outage). The canary is
+    an apiserver LIST on a deadline; the blackout 503s it instantly, so
+    every dark tick is a violation the moment it happens — no waiting
+    out a timeout to learn the apiserver is gone."""
+    started = time.monotonic()
+    world = _NotebookWorld(cfg, "chaos_alert_fidelity")
+    chaos = world.kube.enable_chaos(seed=cfg.seed)
+    chaos.journal = world.journal
+    rec = RecoveryTracker()
+    registry = Registry()
+    canary = obs.Objective(
+        "canary_probe",
+        "alert-fidelity canary: apiserver LIST round-trip under the "
+        "probe deadline (an outage violates instantly)",
+        target_ms=250.0,
+    )
+    canary_slo = obs.SloEngine(objectives=(canary,), registry=registry)
+    # the workbook page rule with compressed windows: scaled() shrinks
+    # the 5 m short window to 0.8 s; the long window is then pinned to
+    # 2.5 s (the workbook's 1:12 ratio would need a 10 s+ blackout to
+    # saturate — the threshold/two-window math is what's under test,
+    # not the wall-clock size of the windows)
+    base = next(r for r in obs.DEFAULT_RULES if r.severity == "page")
+    page = dataclasses.replace(base.scaled(0.8 / base.short_s),
+                               long_s=2.5)
+    engine = obs.AlertEngine(
+        objectives=(canary,), rules=(page,),
+        journal=world.journal,
+        recorder=obs.EventRecorder(world.kube, "cpfleet-bench"),
+        namespace="bench",
+    )
+    server = serve_ops(0, host="127.0.0.1", registry=registry,
+                       tracer=world.trace, slo=canary_slo,
+                       alerts=engine)
+    port = server.server_address[1]
+    agg = obs.FleetAggregator(
+        lambda: {"replica-0": f"http://127.0.0.1:{port}"},
+        objectives=(canary,), alerts=engine, journal=world.journal,
+    )
+    try:
+        return _run_chaos_alert_fidelity(
+            cfg, world, chaos, rec, started,
+            canary=canary, canary_slo=canary_slo, engine=engine,
+            agg=agg, page=page, port=port)
+    finally:
+        world.stop()
+        server.shutdown()
+        server.server_close()
+
+
+def _run_chaos_alert_fidelity(cfg, world, chaos, rec, started, *,
+                              canary, canary_slo, engine, agg, page,
+                              port) -> ScenarioResult:
+    world.start()
+    ns = "bench"
+    tpu = {"generation": "v5e", "topology": "2x2"}
+    names = [f"fid-{i}" for i in range(cfg.n)]
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(names, ns, tpu, want_ready=1))
+    ok = world.tracker.wait_ready([(ns, n) for n in names], cfg.timeout)
+
+    canaries = 0
+
+    def tick():
+        # one canary probe + one full fleet scrape (real HTTP): the
+        # exact production data path metric → scrape → merge → evaluate
+        nonlocal canaries
+        canaries += 1
+        t0 = time.monotonic()
+        try:
+            world.kube.list("notebooks", namespace=ns, group=GROUP)
+            canary_ms = (time.monotonic() - t0) * 1000.0
+        except errors.ApiError:
+            canary_ms = canary.target_ms * 20
+        canary_slo.observe("canary_probe", canary_ms)
+        agg.scrape_once()
+        time.sleep(0.08)
+
+    def page_row() -> dict:
+        return next(r for r in engine.status()["rules"]
+                    if r["severity"] == "page")
+
+    # phase 1 — healthy lane, longer than the long window: any fire
+    # here is a false fire (the zero-false-positives half of fidelity)
+    healthy_until = time.monotonic() + page.long_s + 1.0
+    while time.monotonic() < healthy_until:
+        tick()
+    false_fires = page_row()["fired_count"]
+    if false_fires:
+        rec.violation("alert_false_fire", false_fires)
+
+    # phase 2 — lights out; the page must fire while the outage is
+    # still in progress (an alert that fires after recovery is a report,
+    # not a page)
+    blackout_s = cfg.chaos_window_s
+    dark_at = time.monotonic()
+    lights_on = dark_at + blackout_s
+    chaos.start_blackout(blackout_s, sever=True)
+    fired_ms = None
+    alertz_saw_firing = False
+    while time.monotonic() < lights_on:
+        tick()
+        if fired_ms is None and page_row()["state"] == "firing":
+            fired_ms = round((time.monotonic() - dark_at) * 1000.0, 3)
+            rec.note_recovery("alert_fire", fired_ms)
+            # acceptance over the wire: /alertz (always answerable,
+            # even mid-outage — the ops port is not the apiserver)
+            body = _http_body(port, "/alertz")
+            alertz_saw_firing = bool(body) and '"firing"' in body
+    if fired_ms is None:
+        rec.violation("page_never_fired")
+
+    # phase 3 — recovery: healthy canaries drain the short window and
+    # the page must resolve (the multi-window shape's whole point: no
+    # hour of post-incident paging)
+    resolved_ms = None
+    deadline = time.monotonic() + cfg.timeout
+    while time.monotonic() < deadline:
+        tick()
+        if fired_ms is not None and page_row()["state"] == "ok":
+            resolved_ms = round(
+                (time.monotonic() - lights_on) * 1000.0, 3)
+            rec.note_recovery("alert_resolve", resolved_ms)
+            break
+    if fired_ms is not None and resolved_ms is None:
+        rec.violation("page_never_resolved")
+
+    # the plane itself must also have survived: a post-outage wave
+    # converges (informers healed), so alert fidelity never trades away
+    # the blackout scenario's recovery promise
+    post = [f"fid-post-{i}" for i in range(max(1, cfg.n // 2))]
+    LoadGenerator(cfg.concurrency, cfg.pattern, cfg.rate).run(
+        world.create_jobs(post, ns, tpu, want_ready=1))
+    ok = world.tracker.wait_ready([(ns, n) for n in post],
+                                  cfg.timeout) and ok
+
+    fired = fired_ms is not None
+    resolved = resolved_ms is not None
+    ok = ok and false_fires == 0 and fired and resolved
+    return _chaos_result(world, cfg, started, ok, rec, chaos, {
+        "blackout_s": blackout_s,
+        "alert_fidelity": {
+            "false_fires": false_fires,
+            "fired_during_blackout": fired,
+            "resolved_after_recovery": resolved,
+            "fire_after_ms": fired_ms,
+            "resolve_after_ms": resolved_ms,
+            "alertz_http_firing": alertz_saw_firing,
+            "canaries": canaries,
+            "page_rule": {"threshold": page.burn_threshold,
+                          "short_s": page.short_s,
+                          "long_s": page.long_s},
+        },
+    })
+
+
 CHAOS_SCENARIOS = {
     "chaos_relist": scenario_chaos_relist,
     "chaos_blackout": scenario_chaos_blackout,
@@ -988,6 +1154,7 @@ CHAOS_SCENARIOS = {
     "chaos_kubelet_stall": scenario_chaos_kubelet_stall,
     "chaos_429_storm": scenario_chaos_429_storm,
     "chaos_park_blackout": scenario_chaos_park_blackout,
+    "chaos_alert_fidelity": scenario_chaos_alert_fidelity,
 }
 
 # the family registers into the shared scenario table (run_scenario and

@@ -1,6 +1,6 @@
 """Controller HTTP sidecar endpoints: /metrics, /healthz, /readyz,
 /debug/tracez, /debug/explainz, /debug/profilez, /slostatus,
-/debug/threadz.
+/debug/threadz, /debug/fleetz, /alertz.
 
 The manager-port surface of the reference binaries (metrics on :8080,
 probes — components/notebook-controller/main.go:64-131), plus the
@@ -10,13 +10,17 @@ process's recent lifecycle traces slowest-first (obs/tracez.py;
 bounds the page); /debug/explainz/<ns>/<name> is the cpscope explain
 engine's operator view — conditions + Events + spans + journal stitched
 into one causal timeline (obs/explain.py); /slostatus reports declared
-SLO attainment and error-budget burn (obs/slo.py).
+SLO attainment and error-budget burn (obs/slo.py); /debug/fleetz renders
+the cpfleet cross-replica view — stitched traces, fleet SLO rows,
+per-replica saturation — on the coordinator-lease holder (obs/fleet.py);
+/alertz is the burn-rate alert table (obs/alerts.py).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -27,7 +31,8 @@ from service_account_auth_improvements_tpu.controlplane.metrics import REGISTRY
 def serve_ops(port: int, registry=None, ready_check=None,
               host: str = "0.0.0.0", tracer=None,
               ready_detail=None, kube=None, journal=None,
-              slo=None, profiler=None) -> ThreadingHTTPServer:
+              slo=None, profiler=None, fleet=None,
+              alerts=None) -> ThreadingHTTPServer:
     """Start the ops endpoint in a daemon thread; returns the server.
 
     ``ready_check() -> bool`` drives /readyz's status code;
@@ -42,7 +47,11 @@ def serve_ops(port: int, registry=None, ready_check=None,
     ``slo`` (an obs.SloEngine) serves /slostatus; ``profiler`` (an
     obs.Profiler, default the process-global one) serves
     /debug/profilez — hot stacks + contended locks + saturation,
-    ``?controller=``/``?fold=`` filtered."""
+    ``?controller=``/``?fold=`` filtered; ``fleet`` (an
+    obs.FleetAggregator) serves /debug/fleetz — 404 when not wired, 503
+    when this replica is not the coordinator (every replica carries the
+    route; the coordinator lease elects the one that answers);
+    ``alerts`` (an obs.AlertEngine) serves /alertz."""
     reg = registry if registry is not None else REGISTRY
     trc = tracer if tracer is not None else obs.TRACER
     jnl = journal if journal is not None else obs.JOURNAL
@@ -95,10 +104,74 @@ def serve_ops(port: int, registry=None, ready_check=None,
                 if limit <= 0:  # ?limit=-1 must not invert the slice
                     limit = 50
                 key = q.get("key", [None])[0]
-                body = obs.render_tracez(trc, limit=limit,
-                                         key=key).encode()
+                if q.get("format", [None])[0] == "json":
+                    # the fleet aggregator's scrape shape: raw span
+                    # snapshots plus this process's monotonic/wall
+                    # anchors so the stitcher can rebase span times
+                    # onto a cross-replica-comparable clock
+                    traces = trc.traces()
+                    if key is not None:
+                        traces = [t for t in traces
+                                  if t.get("key") == key]
+                    traces.sort(key=lambda t: -t["duration_s"])
+                    body = json.dumps(
+                        {"schema": "tracez/v1",
+                         "mono": time.monotonic(),
+                         "wall": time.time(),
+                         "traces": traces[:limit]},
+                        sort_keys=True, default=str,
+                    ).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                else:
+                    body = obs.render_tracez(trc, limit=limit,
+                                             key=key).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+            elif self.path.startswith("/debug/fleetz"):
+                if fleet is None:
+                    body = b"no fleet aggregator wired on this port"
+                    self.send_response(404)
+                elif not fleet.is_coordinator():
+                    # loud, not wrong: a non-coordinator's view would
+                    # silently be a stale partial fleet
+                    body = (b"not the fleet coordinator; "
+                            b"ask the coordinator-lease holder")
+                    self.send_response(503)
+                else:
+                    q = parse_qs(urlparse(self.path).query)
+                    snap = fleet.snapshot()
+                    if q.get("format", [None])[0] == "json":
+                        body = json.dumps(snap, indent=2,
+                                          sort_keys=True,
+                                          default=str).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                    else:
+                        try:
+                            limit = int(q.get("limit", ["10"])[0])
+                        except ValueError:
+                            limit = 10
+                        body = obs.render_fleetz(
+                            snap, limit=limit if limit > 0 else 10
+                        ).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+            elif self.path.startswith("/alertz"):
+                # always answerable (unlike /debug/fleetz): firing state
+                # must be visible even mid-election, and an unwired port
+                # says so instead of 404ing a probe
+                if alerts is not None:
+                    body = json.dumps(alerts.status(), indent=2,
+                                      sort_keys=True).encode()
+                else:
+                    body = json.dumps(
+                        {"schema": "alertz/v1", "rules": [],
+                         "note": "no AlertEngine wired on this port"}
+                    ).encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain")
+                self.send_header("Content-Type", "application/json")
             elif self.path.startswith("/debug/explainz/"):
                 # /debug/explainz/<ns>/<name> — operator view, no
                 # tenant redaction (this port is cluster-internal, like

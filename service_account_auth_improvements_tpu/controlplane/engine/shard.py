@@ -92,6 +92,11 @@ ANN_MAP = "cpshard.tpukf.dev/map"
 ANN_MEMBERS = "cpshard.tpukf.dev/members"
 ANN_ACKED = "cpshard.tpukf.dev/acked-epoch"
 ANN_SHARDS = "cpshard.tpukf.dev/num-shards"
+#: ops-endpoint advertisement: each member heartbeat stamps its own
+#: serve_ops base URL so the fleet aggregator (obs/fleet.py) can derive
+#: its scrape-target set from the membership protocol itself — the
+#: live-replica set and the scrape set can never disagree
+ANN_OPS = "cpshard.tpukf.dev/ops-url"
 
 
 def shard_of(namespace: str | None, name: str,
@@ -178,13 +183,18 @@ class ShardMember:
                  num_shards: int = DEFAULT_NUM_SHARDS,
                  lease_duration: float = 15.0,
                  tick_period: float | None = None,
-                 journal=None, now_fn=None, mono_fn=None):
+                 journal=None, now_fn=None, mono_fn=None,
+                 ops_url: str | None = None):
         self.kube = kube
         self.identity = identity
         self.group = group
         self.namespace = namespace
         self.num_shards = num_shards
         self.lease_duration = lease_duration
+        #: this replica's serve_ops base URL, advertised on the member
+        #: Lease (ANN_OPS) for fleet-aggregator discovery; None = not
+        #: scrapable (no ops server, e.g. unit-test members)
+        self.ops_url = ops_url
         #: heartbeat + map-poll cadence; a quarter of the lease keeps
         #: three renew attempts inside one expiry window
         self.tick_period = tick_period if tick_period is not None \
@@ -370,6 +380,8 @@ class ShardMember:
                 "renewTime": now,
             },
         }
+        if self.ops_url:
+            body["metadata"]["annotations"][ANN_OPS] = self.ops_url
         try:
             try:
                 lease = self.kube.get("leases", self._lease_name,
@@ -383,8 +395,10 @@ class ShardMember:
                 lease = copy.deepcopy(lease)
                 lease.setdefault("metadata", {}).setdefault(
                     "labels", {}).update(body["metadata"]["labels"])
-                lease["metadata"].setdefault("annotations", {})[
-                    ANN_ACKED] = str(acked)
+                ann = lease["metadata"].setdefault("annotations", {})
+                ann[ANN_ACKED] = str(acked)
+                if self.ops_url:
+                    ann[ANN_OPS] = self.ops_url
                 spec = lease.setdefault("spec", {})
                 spec["holderIdentity"] = self.identity
                 spec["leaseDurationSeconds"] = self.lease_duration
@@ -773,14 +787,15 @@ class ShardRuntime:
                  lease_duration: float = 15.0,
                  tick_period: float | None = None,
                  journal=None, recorder=None,
-                 now_fn=None, mono_fn=None):
+                 now_fn=None, mono_fn=None,
+                 ops_url: str | None = None):
         self.identity = identity
         jnl = journal if journal is not None else journal_mod.JOURNAL
         self.member = ShardMember(
             kube, identity, group=group, namespace=namespace,
             num_shards=num_shards, lease_duration=lease_duration,
             tick_period=tick_period, journal=jnl,
-            now_fn=now_fn, mono_fn=mono_fn,
+            now_fn=now_fn, mono_fn=mono_fn, ops_url=ops_url,
         )
         self.coordinator = ShardCoordinator(
             kube, identity, group=group, namespace=namespace,

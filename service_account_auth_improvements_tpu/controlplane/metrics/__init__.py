@@ -6,7 +6,9 @@ from service_account_auth_improvements_tpu.controlplane.metrics.registry import 
     Histogram,
     Registry,
     REGISTRY,
+    counter_delta,
     escape_help,
     escape_label_value,
     format_labels,
+    merge_bucket_counts,
 )

@@ -196,6 +196,36 @@ class Histogram(_Metric):
         return "\n".join(lines)
 
 
+def counter_delta(prev: float | None, cur: float) -> float:
+    """Contribution of one scrape to a merged cumulative counter, with
+    reset detection — THE one definition (the fleet scraper and any
+    future federation path must agree): a counter that went backwards is
+    a restarted replica, not a negative rate, so the new raw value IS
+    the delta (everything since the restart; the pre-restart total is
+    already folded into the accumulator by earlier scrapes)."""
+    if prev is None or cur < prev:
+        return cur
+    return cur - prev
+
+
+def merge_bucket_counts(into: list, add) -> list:
+    """Element-wise sum of two cumulative histogram bucket-count lists
+    (the ``Histogram._counts`` shape: one slot per declared bucket plus
+    the trailing +Inf/total slot). Bucket-wise merge is only sound when
+    both sides declared the SAME bounds — a length mismatch means they
+    did not, and silently truncating would mis-attribute tail latency,
+    so it raises instead."""
+    add = list(add)
+    if len(into) != len(add):
+        raise ValueError(
+            f"histogram bucket count mismatch: {len(into)} vs {len(add)}"
+            " — merging histograms with different bucket layouts"
+        )
+    for i, v in enumerate(add):
+        into[i] += v
+    return into
+
+
 class Registry:
     def __init__(self):
         self._metrics: list[_Metric] = []

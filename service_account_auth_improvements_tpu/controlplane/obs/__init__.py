@@ -1,5 +1,6 @@
 """cpscope: tracing, events, decision journal, explain engine, SLOs,
-and the cpprof profiler (docs/observability.md)."""
+the cpprof profiler, and the cpfleet cross-replica aggregation plane
+with burn-rate alerting (docs/observability.md)."""
 
 from service_account_auth_improvements_tpu.controlplane.obs.trace import (  # noqa: F401,E501
     TRACE_ANNOTATION,
@@ -39,6 +40,19 @@ from service_account_auth_improvements_tpu.controlplane.obs.slo import (  # noqa
     Objective,
     SloEngine,
     observe as slo_observe,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.alerts import (  # noqa: F401,E501
+    ALERT_SCHEMA,
+    DEFAULT_RULES,
+    AlertEngine,
+    AlertRule,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.fleet import (  # noqa: F401,E501
+    FleetAggregator,
+    lease_replicas_fn,
+    parse_exposition,
+    render_fleetz,
+    stitch_traces,
 )
 from service_account_auth_improvements_tpu.controlplane.obs.prof import (  # noqa: F401,E501
     PROFILER,

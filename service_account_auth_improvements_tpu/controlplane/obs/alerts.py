@@ -1,0 +1,279 @@
+"""cpalert: multi-window multi-burn-rate SLO alerting (docs/observability.md).
+
+obs/slo.py has computed error-budget burn since PR 8 — and paged nobody.
+This module closes that gap with the SRE-workbook alert shape: a rule
+fires only when the burn rate over a SHORT window and a LONG window both
+exceed a threshold. The long window proves the burn is sustained (one
+slow reconcile can't page), the short window makes the alert resolve
+promptly once the bleeding stops (without it, a 1 h window would keep
+paging for an hour after recovery).
+
+Burn is computed from **cumulative counter points** (``slo_samples_total``
+/ ``slo_violations_total``, fed by the fleet aggregator's reset-corrected
+merge — obs/fleet.py — or by a single process's own engine), NOT from the
+SLO engine's retained-sample ring: a ring-based burn stays elevated until
+the incident's samples age out of retention, which would pin a page alert
+long after recovery. Counter deltas over explicit windows resolve the
+moment healthy traffic resumes.
+
+Every state transition is journaled as a pinned ``alert/v1`` row and
+emitted as an Event, so "when did this page, and why" is answerable from
+the flight recorder alone. ``status()`` is the ``/alertz`` body.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+from service_account_auth_improvements_tpu.controlplane.obs.slo import (
+    DEFAULT_OBJECTIVES,
+)
+
+#: pinned journal row schema — field names are asserted by tests the way
+#: sched-journal/v1 rows are; consumers parse these rows, so renames are
+#: breaking changes
+ALERT_SCHEMA = "alert/v1"
+
+#: Event reasons (module-level constants — the cplint event-reason pass)
+REASON_ALERT_FIRING = "AlertFiring"
+REASON_ALERT_RESOLVED = "AlertResolved"
+
+#: Event types, local copies to keep obs/alerts importable without the
+#: events module's kube surface (values are the k8s API constants)
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class AlertRule:
+    """One multi-window burn-rate rule. ``objective=None`` is a template
+    applied to every declared objective (the usual case — the workbook
+    thresholds are objective-independent)."""
+
+    severity: str          # "page" | "ticket"
+    burn_threshold: float  # both windows must burn at least this fast
+    short_s: float
+    long_s: float
+    objective: str | None = None
+
+    def scaled(self, factor: float) -> "AlertRule":
+        """The same rule with compressed/stretched windows — bench and
+        chaos scenarios run injections measured in seconds, not hours,
+        and must exercise the REAL window math, just faster."""
+        return dataclasses.replace(self, short_s=self.short_s * factor,
+                                   long_s=self.long_s * factor)
+
+
+#: the SRE-workbook catalog (ch. 5, "multiwindow, multi-burn-rate"):
+#: page when 2% of a 30-day budget burns in an hour (14.4x), ticket on a
+#: sustained 1x burn — budget exhausted exactly on schedule is still a
+#: problem, just not a 2 a.m. one. Short windows are 1/12 of the long
+#: window, the workbook's reset-latency compromise.
+DEFAULT_RULES = (
+    AlertRule(severity="page", burn_threshold=14.4,
+              short_s=300.0, long_s=3600.0),
+    AlertRule(severity="ticket", burn_threshold=1.0,
+              short_s=1800.0, long_s=21600.0),
+)
+
+
+@dataclasses.dataclass
+class _RuleState:
+    rule: AlertRule
+    state: str = "ok"              # "ok" | "firing"
+    since_mono: float | None = None
+    fired_count: int = 0
+    resolved_count: int = 0
+    burn_short: float | None = None
+    burn_long: float | None = None
+
+
+class AlertEngine:
+    """Burn-rate evaluation over a stream of cumulative counter points.
+
+    Feed :meth:`observe` one ``(samples_total, violations_total)`` point
+    per objective per evaluation tick (the fleet aggregator calls it
+    from every scrape). The engine keeps just enough point history to
+    cover the longest window and evaluates every rule on each point:
+
+    - **fire** when burn(short) AND burn(long) are both ≥ the threshold;
+    - **resolve** when burn(short) drops below it (the long window keeps
+      history, the short window answers "is it still happening");
+    - **no data holds state** — a window with zero new samples yields no
+      burn verdict, and flapping on silence would make every quiet
+      period an implicit all-clear.
+    """
+
+    def __init__(self, objectives=None, rules=None, *,
+                 journal=None, recorder=None,
+                 namespace: str = "kubeflow", mono_fn=None):
+        self.objectives = tuple(objectives or DEFAULT_OBJECTIVES)
+        self._by_obj = {o.name: o for o in self.objectives}
+        self.namespace = namespace
+        self.journal = journal
+        self.recorder = recorder
+        self._mono = mono_fn if mono_fn is not None else time.monotonic
+        self._lock = threading.Lock()
+        #: objective -> [(mono, samples_total, violations_total), ...]
+        self._points: dict[str, list] = {o.name: []
+                                         for o in self.objectives}
+        rules = tuple(rules or DEFAULT_RULES)
+        self._states: dict[tuple[str, str], _RuleState] = {}
+        for obj in self.objectives:
+            for rule in rules:
+                if rule.objective is not None \
+                        and rule.objective != obj.name:
+                    continue
+                bound = dataclasses.replace(rule, objective=obj.name)
+                self._states[(obj.name, rule.severity)] = _RuleState(bound)
+        self._max_window = max(
+            (st.rule.long_s for st in self._states.values()), default=0.0
+        )
+
+    # ---------------------------------------------------------- ingestion
+
+    def observe(self, objective: str, samples_total: float,
+                violations_total: float, now: float | None = None) -> None:
+        """One cumulative point (already reset-corrected by the caller's
+        merge — metrics.counter_delta); evaluates every rule bound to
+        this objective. Unknown objectives are ignored, not raised: the
+        fleet scrape may carry bench-world objectives this engine never
+        declared, and telemetry must not take down the scrape loop."""
+        if objective not in self._by_obj:
+            return
+        now = self._mono() if now is None else now
+        transitions = []
+        with self._lock:
+            points = self._points[objective]
+            points.append((now, float(samples_total),
+                           float(violations_total)))
+            # keep one point OLDER than the longest window as the
+            # baseline its delta is computed against
+            cutoff = now - self._max_window
+            while len(points) > 2 and points[1][0] <= cutoff:
+                points.pop(0)
+            for st in self._states.values():
+                if st.rule.objective != objective:
+                    continue
+                tr = self._evaluate_locked(st, points, now)
+                if tr is not None:
+                    transitions.append(tr)
+        for st, state in transitions:
+            self._announce(st, state)
+
+    def _burn_locked(self, points, window_s: float,
+                     now: float) -> float | None:
+        """Burn rate over the trailing window from cumulative points:
+        (violation fraction of the window's NEW samples) / budget. None
+        when the window saw no new samples (no data, hold state) or
+        history has only one point (cold start)."""
+        if len(points) < 2:
+            return None
+        base = points[0]
+        for p in points:
+            if p[0] <= now - window_s:
+                base = p
+            else:
+                break
+        cur = points[-1]
+        ds = cur[1] - base[1]
+        dv = cur[2] - base[2]
+        if ds <= 0:
+            return None
+        return dv / ds  # violation fraction; threshold folds the budget
+
+    def _evaluate_locked(self, st: _RuleState, points, now):
+        obj = self._by_obj[st.rule.objective]
+        budget = 1.0 - obj.objective
+        if budget <= 0:
+            return None  # a zero-budget objective can't express burn
+        short = self._burn_locked(points, st.rule.short_s, now)
+        long_ = self._burn_locked(points, st.rule.long_s, now)
+        st.burn_short = None if short is None else short / budget
+        st.burn_long = None if long_ is None else long_ / budget
+        thr = st.rule.burn_threshold
+        if st.state == "ok":
+            if st.burn_short is not None and st.burn_long is not None \
+                    and st.burn_short >= thr and st.burn_long >= thr:
+                st.state = "firing"
+                st.since_mono = now
+                st.fired_count += 1
+                return (st, "firing")
+        else:
+            if st.burn_short is not None and st.burn_short < thr:
+                st.state = "ok"
+                st.since_mono = now
+                st.resolved_count += 1
+                return (st, "resolved")
+        return None
+
+    # ------------------------------------------------------ announcements
+
+    def _announce(self, st: _RuleState, state: str) -> None:
+        rule = st.rule
+        if self.journal is not None:
+            # the pinned flight-recorder row (schema ALERT_SCHEMA):
+            # consumers key on these field names
+            self.journal.decide(
+                "alert", key=f"slo/{rule.objective}/{rule.severity}",
+                schema=ALERT_SCHEMA, objective=rule.objective,
+                severity=rule.severity, state=state,
+                burn_short=st.burn_short, burn_long=st.burn_long,
+                threshold=rule.burn_threshold,
+                short_s=rule.short_s, long_s=rule.long_s,
+            )
+        if self.recorder is not None:
+            involved = {
+                "apiVersion": "tpukf.dev/v1",
+                "kind": "FleetSLO",
+                "metadata": {"name": rule.objective,
+                             "namespace": self.namespace},
+            }
+            firing = state == "firing"
+            if firing:
+                etype, reason = WARNING, REASON_ALERT_FIRING
+            else:
+                etype, reason = NORMAL, REASON_ALERT_RESOLVED
+            self.recorder.event(
+                involved, etype, reason,
+                f"{rule.severity} burn-rate alert on {rule.objective} "
+                f"{state}: burn short={st.burn_short} "
+                f"long={st.burn_long} vs {rule.burn_threshold}x "
+                f"({rule.short_s:g}s/{rule.long_s:g}s windows)",
+            )
+
+    # ------------------------------------------------------------- status
+
+    def firing(self) -> list[dict]:
+        """Currently-firing rules only (the dashboard's red banner)."""
+        return [r for r in self.status()["rules"]
+                if r["state"] == "firing"]
+
+    def status(self) -> dict:
+        """The ``/alertz`` body: every bound rule with its live burn."""
+        now = self._mono()
+        rows = []
+        with self._lock:
+            for (objective, severity) in sorted(self._states):
+                st = self._states[(objective, severity)]
+                rows.append({
+                    "objective": objective,
+                    "severity": severity,
+                    "threshold": st.rule.burn_threshold,
+                    "short_s": st.rule.short_s,
+                    "long_s": st.rule.long_s,
+                    "state": st.state,
+                    "burn_short": _round(st.burn_short),
+                    "burn_long": _round(st.burn_long),
+                    "for_s": (None if st.since_mono is None
+                              else round(now - st.since_mono, 3)),
+                    "fired_count": st.fired_count,
+                    "resolved_count": st.resolved_count,
+                })
+        return {"schema": "alertz/v1", "rules": rows}
+
+
+def _round(v: float | None) -> float | None:
+    return None if v is None else round(v, 4)

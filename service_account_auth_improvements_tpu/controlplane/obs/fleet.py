@@ -1,0 +1,843 @@
+"""cpfleet: cross-replica observability for the sharded plane.
+
+cpshard (engine/shard.py) made the plane multi-replica; every
+observability surface stayed per-process. A notebook whose key is handed
+off mid-lifecycle leaves half its spans on the losing replica and half
+on the gainer, fleet SLO attainment is unknowable without hand-merging N
+scrapes, and saturation — the autoscaler's input — exists only as N
+disconnected gauge sets. This module is the aggregation plane:
+
+- **discovery** rides the membership protocol that already exists: each
+  replica's ``<group>-member-*`` Lease advertises its ops URL
+  (``cpshard.tpukf.dev/ops-url``, stamped by the member heartbeat), so
+  the live-replica set IS the scrape target set — no second registry to
+  drift (:func:`lease_replicas_fn`).
+- **metric federation** scrapes each replica's ``/metrics`` and merges
+  families by kind: counters (and histogram ``_bucket``/``_sum``/
+  ``_count`` series, which are counters) accumulate with **reset
+  detection** via :func:`metrics.counter_delta` — a restarted replica's
+  counter going backwards is a reset, not a negative rate; histogram
+  buckets merge element-wise via :func:`metrics.merge_bucket_counts`;
+  gauges are kept per-replica-labeled with an explicit fleet roll-up.
+- **trace stitching** (:func:`stitch_traces`) regroups every replica's
+  tracez spans by trace id — uid-derived (obs/trace.py
+  ``object_trace_id``), so the loser's and gainer's spans for one CR
+  incarnation already share an id — rebases each replica's monotonic
+  timestamps onto its scrape-reported wall anchor, and synthesizes a
+  ``shard.handoff_gap`` span over the dark window between one replica's
+  last span and the next replica's first: the handoff cost is a visible
+  stage, not missing time.
+- **fleet SLOs**: attainment per objective from the bucket-merged
+  ``slo_sample_duration_seconds`` histograms (obs/slo.py
+  ``attainment_from_counts`` — the same definition a single replica
+  uses), burn from the merged cumulative counters, both fed to the
+  burn-rate :class:`obs.alerts.AlertEngine` on every scrape.
+- **the autoscaler input signal**: ``fleet_workqueue_depth_per_worker``
+  and ``fleet_worker_busy_ratio``, per replica plus a ``replica="fleet"``
+  max roll-up. These two families are THE contract for the ROADMAP's
+  autoscaling item: scale Manager replicas up when the fleet roll-up
+  saturates, down when it idles — consumers should read these, not
+  re-derive from per-replica scrapes (docs/observability.md "Fleet").
+
+A replica that stops answering degrades the view LOUDLY (``partial``
+flag, ``PARTIAL FLEET`` banner on /debug/fleetz, ``fleet_replica_up`` 0,
+its last-known data marked stale) and never blocks the scrape of the
+others — a dark replica is a finding, not a deadlock. Stdlib only, like
+the rest of obs/.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
+    Gauge,
+    Registry,
+    counter_delta,
+    merge_bucket_counts,
+)
+from service_account_auth_improvements_tpu.controlplane.obs.slo import (
+    DEFAULT_OBJECTIVES,
+    attainment_from_counts,
+    burn_rate,
+)
+
+log = logging.getLogger(__name__)
+
+#: the SLO series the fleet merges (declared by obs/slo.py SloEngine)
+SLO_HIST_FAMILY = "slo_sample_duration_seconds"
+SLO_SAMPLES_FAMILY = "slo_samples_total"
+SLO_VIOLATIONS_FAMILY = "slo_violations_total"
+
+#: the per-replica saturation gauges rolled up into the autoscaler
+#: signal (declared by engine/metrics.py)
+DEPTH_FAMILY = "workqueue_depth_per_worker"
+BUSY_FAMILY = "controller_runtime_worker_busy_ratio"
+
+
+# --------------------------------------------------- exposition parsing
+
+def _unescape(value: str) -> str:
+    out = []
+    i = 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def _parse_label_body(body: str) -> tuple:
+    """``a="x",b="y"`` → (("a", "x"), ("b", "y")); honors escapes."""
+    labels = []
+    i = 0
+    n = len(body)
+    while i < n:
+        eq = body.index("=", i)
+        name = body[i:eq].strip().lstrip(",").strip()
+        if body[eq + 1] != '"':
+            raise ValueError(f"unquoted label value at {eq}")
+        j = eq + 2
+        buf = []
+        while j < n and body[j] != '"':
+            if body[j] == "\\" and j + 1 < n:
+                buf.append(body[j:j + 2])
+                j += 2
+            else:
+                buf.append(body[j])
+                j += 1
+        if j >= n:
+            raise ValueError("unterminated label value")
+        labels.append((name, _unescape("".join(buf))))
+        i = j + 1
+    return tuple(labels)
+
+
+def parse_exposition(text: str) -> dict:
+    """Prometheus text format → ``{family: {"type": kind, "samples":
+    {(sample_name, labels): value}}}``. ``labels`` is a tuple of
+    ``(name, value)`` pairs in exposition order with ``le``/``quantile``
+    included — the merge keys on it. Unparseable lines are counted into
+    the special ``""`` family's ``parse_errors`` (a corrupt series must
+    not void the whole scrape)."""
+    families: dict = {}
+    types: dict = {}
+    errors = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        try:
+            brace = line.find("{")
+            space = line.find(" ")
+            if brace != -1 and (space == -1 or brace < space):
+                name = line[:brace]
+                # closing brace: scan past quoted label values
+                j = brace + 1
+                in_q = False
+                while j < len(line):
+                    c = line[j]
+                    if c == "\\" and in_q:
+                        j += 2
+                        continue
+                    if c == '"':
+                        in_q = not in_q
+                    elif c == "}" and not in_q:
+                        break
+                    j += 1
+                labels = _parse_label_body(line[brace + 1:j])
+                rest = line[j + 1:]
+            else:
+                name = line[:space] if space != -1 else line
+                labels = ()
+                rest = line[space + 1:] if space != -1 else ""
+            value = float(rest.split()[0])
+        except (ValueError, IndexError):
+            errors += 1
+            continue
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                fam = name[:-len(suffix)]
+                break
+        entry = families.setdefault(
+            fam, {"type": types.get(fam, "untyped"), "samples": {}}
+        )
+        entry["type"] = types.get(fam, entry["type"])
+        entry["samples"][(name, labels)] = value
+    if errors:
+        families.setdefault("", {"type": "untyped", "samples": {}})[
+            "parse_errors"] = errors
+    return families
+
+
+def _is_cumulative(family: str, sample_name: str, kind: str) -> bool:
+    """Counters accumulate across scrapes; so do histogram bucket/sum/
+    count series (cumulative by definition). Everything else is a gauge
+    snapshot."""
+    if kind == "counter":
+        return True
+    if kind == "histogram" and sample_name != family:
+        return True
+    return False
+
+
+# ------------------------------------------------------ trace stitching
+
+def _merge_intervals(intervals: list) -> list:
+    out: list = []
+    for start, end in sorted(intervals):
+        if out and start <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], end)
+        else:
+            out.append([start, end])
+    return out
+
+
+#: inter-span gaps at or below this are bridged when computing
+#: attributed coverage: a GIL/scheduler pause between dequeue and
+#: span-open is measurement jitter, not structural dark time — the
+#: windows attribution exists to expose (handoffs, missing subsystems,
+#: dark replicas) are orders of magnitude larger
+GAP_TOLERANCE_S = 0.01
+
+
+def stitch_traces(payloads: dict,
+                  gap_tolerance_s: float = GAP_TOLERANCE_S) -> list[dict]:
+    """Merge per-replica tracez payloads (``{"mono": anchor, "wall":
+    anchor, "traces": [snapshots]}``) into fleet-wide traces.
+
+    Spans are rebased to wall-clock time (``t - mono_anchor +
+    wall_anchor``) — monotonic clocks are not comparable across
+    processes — then grouped by trace id. Where consecutive replica
+    segments of one trace leave a dark window (the loser drained, the
+    gainer had not yet activated), a synthetic ``shard.handoff_gap``
+    span covers it, so a handed-off key renders as ONE lifecycle whose
+    handoff cost is a visible stage. Per-trace ``attributed_fraction``
+    is the interval-union of all spans (synthetic included) over the
+    trace's wall duration."""
+    grouped: dict = {}
+    for replica in sorted(payloads):
+        payload = payloads[replica] or {}
+        offset = float(payload.get("wall", 0.0)) - \
+            float(payload.get("mono", 0.0))
+        for snap in payload.get("traces") or []:
+            tid = snap.get("trace_id")
+            if not tid:
+                continue
+            g = grouped.setdefault(tid, {"key": None, "spans": [],
+                                         "replicas": set(),
+                                         "errors": 0, "dropped": 0})
+            if g["key"] is None and snap.get("key"):
+                g["key"] = snap["key"]
+            g["errors"] += snap.get("errors") or 0
+            g["dropped"] += snap.get("dropped_spans") or 0
+            g["replicas"].add(replica)
+            for s in snap.get("spans") or []:
+                start = s.get("start")
+                if start is None:
+                    continue
+                end = s.get("end")
+                g["spans"].append({
+                    "name": s.get("name"),
+                    "span_id": s.get("span_id"),
+                    "parent_id": s.get("parent_id"),
+                    "replica": replica,
+                    "start": start + offset,
+                    "end": None if end is None else end + offset,
+                    "attrs": dict(s.get("attrs") or {}),
+                    "error": bool(s.get("error")),
+                })
+    out = []
+    for tid, g in grouped.items():
+        spans = g["spans"]
+        done = [s for s in spans if s["end"] is not None]
+        if not spans:
+            continue
+        # per-replica extents, ordered by first activity — the handoff
+        # sequence; gaps BETWEEN consecutive segments are the protocol's
+        # dark windows
+        extents = {}
+        for s in done:
+            lo, hi = extents.get(s["replica"], (s["start"], s["end"]))
+            extents[s["replica"]] = (min(lo, s["start"]),
+                                     max(hi, s["end"]))
+        ordered = sorted(extents.items(), key=lambda kv: kv[1][0])
+        gaps = []
+        for (prev_r, (_, prev_end)), (next_r, (next_start, _)) in zip(
+                ordered, ordered[1:]):
+            if next_start > prev_end:
+                gaps.append({
+                    "name": "shard.handoff_gap",
+                    "span_id": f"gap-{prev_r}-{next_r}",
+                    "parent_id": None,
+                    "replica": next_r,
+                    "start": prev_end,
+                    "end": next_start,
+                    "attrs": {"from": prev_r, "to": next_r,
+                              "synthetic": True},
+                    "error": False,
+                })
+        spans = sorted(spans + gaps, key=lambda s: s["start"])
+        starts = [s["start"] for s in spans]
+        ends = [s["end"] for s in spans if s["end"] is not None]
+        start = min(starts)
+        duration = (max(ends) - start) if ends else 0.0
+        covered = 0.0
+        prev_end = None
+        for lo, hi in _merge_intervals(
+                [[s["start"], s["end"]] for s in spans
+                 if s["end"] is not None]):
+            covered += hi - lo
+            if prev_end is not None and lo - prev_end <= gap_tolerance_s:
+                covered += lo - prev_end
+            prev_end = hi
+        stages: dict = {}
+        for s in spans:
+            if s["end"] is not None:
+                stages[s["name"]] = stages.get(s["name"], 0.0) + \
+                    (s["end"] - s["start"])
+        out.append({
+            "trace_id": tid,
+            "key": g["key"],
+            "replicas": sorted(g["replicas"]),
+            "start": start,
+            "duration_s": duration,
+            "spans": spans,
+            "stages": stages,
+            "errors": g["errors"],
+            "dropped_spans": g["dropped"],
+            "handoff_gaps": len(gaps),
+            "covered_s": round(min(covered, duration), 6),
+            "attributed_fraction": (
+                round(min(covered / duration, 1.0), 4)
+                if duration > 0 else 1.0
+            ),
+        })
+    out.sort(key=lambda t: -t["duration_s"])
+    return out
+
+
+# ------------------------------------------------------------ discovery
+
+def lease_replicas_fn(kube, group: str = "cpshard",
+                      namespace: str = "kubeflow",
+                      default_lease_duration: float = 15.0,
+                      now_fn=None):
+    """``replicas_fn`` for :class:`FleetAggregator`: live cpshard member
+    Leases that advertise an ops URL → ``{identity: url}``. Membership
+    freshness uses the protocol's own ``_lease_live`` rule, so the
+    scrape set and the shard coordinator can never disagree about who is
+    alive. A live member without the annotation (an old binary mid
+    rolling upgrade) is simply not scrapable yet — skipped, not fatal."""
+
+    def replicas() -> dict:
+        # engine.shard imported lazily: engine imports obs at module
+        # load, so a top-level obs.fleet → engine.shard import would
+        # cycle; discovery is the only place fleet needs it
+        from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E501
+            shard as shard_mod,
+        )
+        now = now_fn() if now_fn is not None else shard_mod._now()
+        try:
+            items = kube.list(
+                "leases", namespace=namespace,
+                group=shard_mod.LEASE_GROUP,
+                label_selector=(f"{shard_mod.LABEL_GROUP}={group},"
+                                f"{shard_mod.LABEL_ROLE}=member"),
+            )["items"]
+        except Exception:  # noqa: BLE001 — discovery outage ≠ crash
+            return {}
+        out = {}
+        for lease in items:
+            if not shard_mod._lease_live(lease, now,
+                                         default_lease_duration):
+                continue
+            ann = ((lease.get("metadata") or {})
+                   .get("annotations") or {})
+            url = ann.get(shard_mod.ANN_OPS)
+            if url:
+                out[lease["spec"]["holderIdentity"]] = url
+        return out
+
+    return replicas
+
+
+def _http_fetch(url: str, timeout: float = 2.0) -> str:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+# ----------------------------------------------------------- aggregator
+
+class FleetAggregator:
+    """Scrape → merge → stitch → alert, one cadence.
+
+    ``replicas_fn() -> {name: base_url}`` names the scrape targets (the
+    Lease-discovery default in production, an injected table in tests);
+    ``fetch_fn(url) -> str`` performs one HTTP GET (injected in tests —
+    the merge/stitch semantics are testable without sockets).
+    ``scrape_once()`` is the whole pipeline; ``start()`` runs it on a
+    period, skipping ticks while ``is_coordinator`` says another replica
+    owns the aggregation (every replica carries the code; the
+    coordinator lease elects the one that runs it)."""
+
+    def __init__(self, replicas_fn, *, fetch_fn=None,
+                 registry: Registry | None = None,
+                 objectives=None, alerts=None,
+                 is_coordinator=None, journal=None,
+                 period_s: float = 5.0, timeout_s: float = 2.0,
+                 mono_fn=None, wall_fn=None):
+        self.replicas_fn = replicas_fn
+        self.fetch_fn = fetch_fn if fetch_fn is not None else (
+            lambda url: _http_fetch(url, timeout_s))
+        self.objectives = tuple(objectives or DEFAULT_OBJECTIVES)
+        self.alerts = alerts
+        self.journal = journal
+        self.period_s = period_s
+        self._is_coordinator = is_coordinator
+        self._mono = mono_fn if mono_fn is not None else time.monotonic
+        self._wall = wall_fn if wall_fn is not None else time.time
+        self._lock = threading.Lock()
+        #: (replica, sample_name, labels) -> [last_raw, accumulated]
+        self._acc: dict = {}
+        #: replica -> {(sample_name, labels): value} (gauge snapshots)
+        self._gauges: dict = {}
+        #: replica -> latest tracez payload / slostatus body
+        self._tracez: dict = {}
+        self._slostatus: dict = {}
+        #: replica -> {"url", "up", "error", "scrape_ms",
+        #:             "last_ok_mono"}
+        self._replicas: dict = {}
+        self._snapshot: dict | None = None
+        self._merge_errors = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+        reg = registry if registry is not None else Registry()
+        self.registry = reg
+        self.g_up = Gauge(
+            "fleet_replica_up",
+            "1 when the replica's last ops scrape succeeded",
+            ("replica",), registry=reg)
+        self.c_scrape_errors = Counter(
+            "fleet_scrape_errors_total",
+            "failed replica ops scrapes",
+            ("replica",), registry=reg)
+        # THE autoscaler input signal (docs/observability.md "Fleet"):
+        # per-replica saturation plus the replica="fleet" max roll-up —
+        # scale on the hottest replica, not the average (sharding means
+        # one replica can saturate while the fleet mean looks idle)
+        self.g_depth = Gauge(
+            "fleet_workqueue_depth_per_worker",
+            "per-replica max workqueue depth per worker; "
+            "replica=fleet is the max roll-up the autoscaler consumes",
+            ("replica",), registry=reg)
+        self.g_busy = Gauge(
+            "fleet_worker_busy_ratio",
+            "per-replica max reconcile-worker busy ratio; "
+            "replica=fleet is the max roll-up the autoscaler consumes",
+            ("replica",), registry=reg)
+        self.g_att = Gauge(
+            "fleet_slo_attainment",
+            "fleet-merged SLO attainment per objective",
+            ("objective",), registry=reg)
+        self.g_burn = Gauge(
+            "fleet_slo_error_budget_burn",
+            "fleet-merged error-budget burn per objective",
+            ("objective",), registry=reg)
+
+    # ------------------------------------------------------------ control
+
+    def is_coordinator(self) -> bool:
+        fn = self._is_coordinator
+        return True if fn is None else bool(fn())
+
+    def start(self) -> "FleetAggregator":
+        self._thread = threading.Thread(
+            target=self._loop, name="cpfleet-scrape", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.is_coordinator():
+                    self.scrape_once()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                log.exception("cpfleet scrape failed")
+            self._stop.wait(self.period_s)
+
+    # ------------------------------------------------------------- scrape
+
+    def _scrape_replica(self, name: str, url: str) -> str | None:
+        """One replica's three surfaces; returns an error string or
+        None. Partial success still ingests what answered — a replica
+        with a broken tracez route keeps contributing metrics."""
+        base = url.rstrip("/")
+        error = None
+        try:
+            families = parse_exposition(self.fetch_fn(base + "/metrics"))
+            self._ingest_metrics(name, families)
+        except Exception as e:  # noqa: BLE001 — degrade, don't block
+            error = f"/metrics: {e!r}"
+        try:
+            self._slostatus[name] = json.loads(
+                self.fetch_fn(base + "/slostatus"))
+        except Exception as e:  # noqa: BLE001
+            error = error or f"/slostatus: {e!r}"
+        try:
+            self._tracez[name] = json.loads(
+                self.fetch_fn(base + "/debug/tracez?format=json"))
+        except Exception as e:  # noqa: BLE001
+            error = error or f"/tracez: {e!r}"
+        return error
+
+    def _ingest_metrics(self, replica: str, families: dict) -> None:
+        gauges: dict = {}
+        for family, entry in families.items():
+            kind = entry.get("type", "untyped")
+            for (name, labels), value in entry["samples"].items():
+                if _is_cumulative(family, name, kind):
+                    key = (replica, name, labels)
+                    ent = self._acc.get(key)
+                    if ent is None:
+                        self._acc[key] = [value, value]
+                    else:
+                        ent[1] += counter_delta(ent[0], value)
+                        ent[0] = value
+                else:
+                    gauges[(name, labels)] = value
+        self._gauges[replica] = gauges
+
+    def scrape_once(self) -> dict:
+        """One full pass: scrape every discovered replica, merge, stitch,
+        evaluate alerts, refresh gauges, publish the snapshot that
+        /debug/fleetz renders. Never raises for a dark replica — it is
+        reported, not fatal."""
+        now = self._mono()
+        targets = dict(self.replicas_fn() or {})
+        with self._lock:
+            for name, url in targets.items():
+                t0 = self._mono()
+                error = self._scrape_replica(name, url)
+                info = self._replicas.setdefault(
+                    name, {"url": url, "last_ok_mono": None})
+                info["url"] = url
+                info["scrape_ms"] = round((self._mono() - t0) * 1000, 3)
+                info["error"] = error
+                info["up"] = error is None
+                if error is None:
+                    info["last_ok_mono"] = self._mono()
+                else:
+                    self.c_scrape_errors.labels(name).inc()
+                self.g_up.labels(name).set(0.0 if error else 1.0)
+            # replicas that left the membership: their accumulated
+            # counters stay (their work happened), their liveness reads
+            # 0 — distinguish "left" from "dark" in the snapshot
+            for name in list(self._replicas):
+                if name not in targets:
+                    self._replicas[name]["up"] = False
+                    self._replicas[name]["error"] = "left membership"
+                    self.g_up.labels(name).set(0.0)
+            snapshot = self._build_snapshot_locked(now, targets)
+            self._snapshot = snapshot
+        # alerts fed OUTSIDE the lock: the engine journals/emits Events
+        # on transitions and telemetry fan-out must not extend the
+        # scrape critical section
+        if self.alerts is not None:
+            for name, row in snapshot["slo"].items():
+                self.alerts.observe(name, row["samples_total"],
+                                    row["violations_total"], now=now)
+                for rule in self.alerts.status()["rules"]:
+                    if rule["objective"] == name:
+                        row.setdefault("alerts", []).append(rule)
+            snapshot["alerts"] = self.alerts.status()
+        return snapshot
+
+    # -------------------------------------------------------------- merge
+
+    def _merged_counters_locked(self) -> dict:
+        merged: dict = {}
+        for (_replica, name, labels), (_last, acc) in self._acc.items():
+            key = (name, labels)
+            merged[key] = merged.get(key, 0.0) + acc
+        return merged
+
+    def _merged_hist_locked(self, family: str,
+                            match: dict) -> tuple | None:
+        """(bounds, cumulative counts) of one histogram family merged
+        across replicas via metrics.merge_bucket_counts; None without
+        samples. Replicas whose bucket layout disagrees are skipped and
+        counted as merge errors — mixing layouts would silently
+        mis-attribute tail latency."""
+        per_replica: dict = {}
+        for (replica, name, labels), (_last, acc) in self._acc.items():
+            if name != f"{family}_bucket":
+                continue
+            ld = dict(labels)
+            if any(ld.get(k) != v for k, v in match.items()):
+                continue
+            per_replica.setdefault(replica, {})[ld.get("le")] = acc
+        bounds = None
+        merged: list | None = None
+        for _replica, les in sorted(per_replica.items()):
+            try:
+                finite = sorted((float(le), le) for le in les
+                                if le not in (None, "+Inf"))
+            except ValueError:
+                self._merge_errors += 1
+                continue
+            b = tuple(x[0] for x in finite)
+            counts = [les[le] for _, le in finite] + \
+                [les.get("+Inf", 0.0)]
+            if merged is None:
+                bounds, merged = b, counts
+            elif b != bounds:
+                self._merge_errors += 1
+            else:
+                merge_bucket_counts(merged, counts)
+        if merged is None:
+            return None
+        return bounds, merged
+
+    def _build_snapshot_locked(self, now: float, targets: dict) -> dict:
+        merged = self._merged_counters_locked()
+        # fleet SLO rows: bucket-merged attainment + counter totals
+        slo: dict = {}
+        for obj in self.objectives:
+            hist = self._merged_hist_locked(
+                SLO_HIST_FAMILY, {"objective": obj.name})
+            att = None
+            if hist is not None:
+                att = attainment_from_counts(
+                    hist[0], hist[1], obj.target_ms / 1000.0)
+            burn = burn_rate(att, obj.objective)
+            samples = merged.get(
+                (SLO_SAMPLES_FAMILY, (("objective", obj.name),)), 0.0)
+            violations = merged.get(
+                (SLO_VIOLATIONS_FAMILY, (("objective", obj.name),)), 0.0)
+            slo[obj.name] = {
+                "target_ms": obj.target_ms,
+                "objective": obj.objective,
+                "n": int(samples),
+                "samples_total": samples,
+                "violations_total": violations,
+                "attainment": None if att is None else round(att, 4),
+                "burn": (None if burn is None
+                         else "inf" if burn == float("inf")
+                         else round(burn, 4)),
+                "met": att is not None and att >= obj.objective,
+            }
+            self.g_att.labels(obj.name).set(att if att is not None
+                                            else 0.0)
+            if burn is not None and burn != float("inf"):
+                self.g_burn.labels(obj.name).set(burn)
+        # saturation roll-up: per-replica max over label sets, fleet max
+        fleet_depth = fleet_busy = 0.0
+        saturation: dict = {}
+        for replica in sorted(targets):
+            gauges = self._gauges.get(replica) or {}
+            depth = max((v for (n, _l), v in gauges.items()
+                         if n == DEPTH_FAMILY), default=0.0)
+            busy = max((v for (n, _l), v in gauges.items()
+                        if n == BUSY_FAMILY), default=0.0)
+            saturation[replica] = {"queue_depth_per_worker": depth,
+                                   "busy_ratio": round(busy, 4)}
+            self.g_depth.labels(replica).set(depth)
+            self.g_busy.labels(replica).set(busy)
+            fleet_depth = max(fleet_depth, depth)
+            fleet_busy = max(fleet_busy, busy)
+        self.g_depth.labels("fleet").set(fleet_depth)
+        self.g_busy.labels("fleet").set(fleet_busy)
+        traces = stitch_traces(self._tracez)
+        multi = [t for t in traces if len(t["replicas"]) > 1]
+        graded = [t for t in traces if t["key"] and t["duration_s"] > 0]
+        attributed = [t["attributed_fraction"] for t in graded]
+        graded_dur = sum(t["duration_s"] for t in graded)
+        # PARTIAL means a CURRENT member is dark (scraped and failed) —
+        # a gracefully departed replica is a departure, not a hole in
+        # the view (its accumulated counters and last traces remain)
+        dark = sorted(n for n in targets
+                      if not self._replicas.get(n, {}).get("up"))
+        replicas = {
+            name: {k: info.get(k) for k in
+                   ("url", "up", "error", "scrape_ms", "last_ok_mono")}
+            for name, info in self._replicas.items()
+        }
+        for name, sat in saturation.items():
+            replicas.setdefault(name, {}).update(sat)
+        return {
+            "schema": "fleetz/v1",
+            "at_mono": now,
+            "at_wall": self._wall(),
+            "replicas": replicas,
+            "partial": bool(dark),
+            "dark": dark,
+            "merge_errors": self._merge_errors,
+            "slo": slo,
+            "saturation": {"fleet": {
+                "queue_depth_per_worker": fleet_depth,
+                "busy_ratio": round(fleet_busy, 4),
+            }},
+            "traces": traces[:50],
+            "trace_count": len(traces),
+            "stitched_multi_replica": len(multi),
+            "handoff_gap_spans": sum(t["handoff_gaps"] for t in traces),
+            "attributed_fraction": {
+                "n": len(attributed),
+                "min": round(min(attributed), 4) if attributed else None,
+                "mean": (round(sum(attributed) / len(attributed), 4)
+                         if attributed else None),
+                # duration-weighted: the fraction of total stitched
+                # lifecycle TIME that is attributed — the gated number;
+                # a per-trace min would grade micro-traces where one
+                # scheduler slice is half the lifecycle
+                "weighted": (
+                    round(min(sum(t["covered_s"] for t in graded)
+                              / graded_dur, 1.0), 4)
+                    if graded_dur > 0 else None),
+            },
+            "alerts": (self.alerts.status()
+                       if self.alerts is not None else None),
+        }
+
+    def snapshot(self) -> dict:
+        """Latest scrape result, scraping once if none exists yet (the
+        serve path's lazy first render)."""
+        with self._lock:
+            snap = self._snapshot
+        if snap is None:
+            snap = self.scrape_once()
+        return snap
+
+
+# ------------------------------------------------------------ rendering
+
+def _fmt_span_attrs(attrs: dict) -> str:
+    if not attrs:
+        return ""
+    inner = ", ".join(f"{k}={v}" for k, v in sorted(attrs.items()))
+    return " {" + inner + "}"
+
+
+def render_stitched_trace(trace: dict) -> str:
+    head = (
+        f"TRACE {trace['key'] or '(anonymous)'} "
+        f"id={trace['trace_id']} "
+        f"replicas={'+'.join(trace['replicas'])} "
+        f"duration={trace['duration_s'] * 1000:.1f}ms "
+        f"spans={len(trace['spans'])} "
+        f"handoff_gaps={trace['handoff_gaps']} "
+        f"attributed={trace['attributed_fraction']:.0%} "
+        f"errors={trace['errors']}"
+    )
+    lines = [head]
+    stages = sorted(trace["stages"].items(), key=lambda kv: -kv[1])
+    if stages:
+        lines.append("  stages: " + "  ".join(
+            f"{name}={dur * 1000:.1f}ms" for name, dur in stages))
+    for s in trace["spans"]:
+        offset = (s["start"] - trace["start"]) * 1000
+        dur = ((s["end"] - s["start"]) * 1000
+               if s["end"] is not None else float("nan"))
+        attrs = dict(s["attrs"])
+        attrs["replica"] = s["replica"]
+        lines.append(
+            f"  +{offset:9.1f}ms {dur:9.1f}ms "
+            f"{s['name']}{' ERROR' if s['error'] else ''}"
+            f"{_fmt_span_attrs(attrs)}"
+        )
+    return "\n".join(lines)
+
+
+def render_fleetz(snapshot: dict, limit: int = 10) -> str:
+    """The /debug/fleetz page: fleet SLO rows, per-replica saturation,
+    slowest stitched traces — with the partial-fleet state impossible to
+    miss."""
+    replicas = snapshot.get("replicas") or {}
+    up = sum(1 for r in replicas.values() if r.get("up"))
+    lines = [
+        f"cpfleet: {len(replicas)} replica(s), {up} up, "
+        f"{snapshot.get('trace_count', 0)} stitched trace(s) "
+        f"({snapshot.get('stitched_multi_replica', 0)} multi-replica, "
+        f"{snapshot.get('handoff_gap_spans', 0)} handoff gap(s))"
+    ]
+    if snapshot.get("partial"):
+        dark = ", ".join(snapshot.get("dark") or [])
+        lines.append(
+            f"PARTIAL FLEET: no data from [{dark}] — every row below "
+            "understates the fleet; fix the dark replicas first"
+        )
+    if snapshot.get("merge_errors"):
+        lines.append(f"merge errors: {snapshot['merge_errors']} "
+                     "(mismatched histogram bucket layouts skipped)")
+    alerts = snapshot.get("alerts") or {}
+    firing = [r for r in alerts.get("rules") or []
+              if r["state"] == "firing"]
+    for r in firing:
+        lines.append(
+            f"ALERT FIRING [{r['severity']}] {r['objective']}: burn "
+            f"short={r['burn_short']} long={r['burn_long']} "
+            f">= {r['threshold']}x for {r['for_s']}s (/alertz)"
+        )
+    lines.append("")
+    lines.append("-- fleet SLO (bucket-merged across replicas) --")
+    lines.append(f"{'objective':<20} {'attainment':>10} {'burn':>8} "
+                 f"{'n':>8}  met")
+    for name in sorted(snapshot.get("slo") or {}):
+        row = snapshot["slo"][name]
+        att = row["attainment"]
+        lines.append(
+            f"{name:<20} "
+            f"{('n/a' if att is None else f'{att:.4f}'):>10} "
+            f"{str(row['burn'] if row['burn'] is not None else 'n/a'):>8} "
+            f"{row['n']:>8}  {'yes' if row['met'] else 'NO'}"
+        )
+    lines.append("")
+    lines.append("-- per-replica saturation (the autoscaler signal: "
+                 "fleet_workqueue_depth_per_worker / "
+                 "fleet_worker_busy_ratio) --")
+    lines.append(f"{'replica':<24} {'up':>3} {'depth/worker':>13} "
+                 f"{'busy':>6} {'scrape_ms':>10}  error")
+    for name in sorted(replicas):
+        r = replicas[name]
+        lines.append(
+            f"{name:<24} {('y' if r.get('up') else 'N'):>3} "
+            f"{r.get('queue_depth_per_worker', 0.0):>13.2f} "
+            f"{r.get('busy_ratio', 0.0):>6.2f} "
+            f"{(r.get('scrape_ms') if r.get('scrape_ms') is not None else float('nan')):>10.1f}"  # noqa: E501
+            f"  {r.get('error') or ''}"
+        )
+    sat = (snapshot.get("saturation") or {}).get("fleet") or {}
+    lines.append(
+        f"{'fleet (max roll-up)':<24} {'':>3} "
+        f"{sat.get('queue_depth_per_worker', 0.0):>13.2f} "
+        f"{sat.get('busy_ratio', 0.0):>6.2f}"
+    )
+    lines.append("")
+    traces = snapshot.get("traces") or []
+    lines.append(f"-- slowest stitched traces (top {limit} of "
+                 f"{snapshot.get('trace_count', 0)}) --")
+    for t in traces[:limit]:
+        lines.append(render_stitched_trace(t))
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"

@@ -31,7 +31,9 @@ import dataclasses
 import threading
 
 from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Counter,
     Gauge,
+    Histogram,
     Registry,
 )
 from service_account_auth_improvements_tpu.controlplane.obs.trace import (
@@ -104,6 +106,13 @@ DEFAULT_OBJECTIVES = (
 
 OBJECTIVES_BY_NAME = {o.name: o for o in DEFAULT_OBJECTIVES}
 
+#: ``slo_sample_duration_seconds`` bucket bounds. Every DEFAULT_OBJECTIVES
+#: target (5/15/30/60 s) is an exact bound, so the fleet aggregator's
+#: bucket-merged attainment (:func:`attainment_from_counts`) is exact for
+#: the declared objectives, not merely conservative.
+SLO_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 30.0,
+               60.0, 120.0)
+
 
 def attainment(samples_ms, target_ms: float) -> float | None:
     """Fraction of samples meeting the target; None without samples."""
@@ -153,25 +162,38 @@ def report(samples_by_objective: dict, objectives=None) -> dict:
     return out
 
 
+def attainment_from_counts(bucket_bounds, counts,
+                           target_s: float) -> float | None:
+    """Attainment from cumulative bucket counts (the ``Histogram._counts``
+    shape: one slot per bound plus the trailing +Inf/total slot):
+    cumulative count of the largest bucket ≤ target over the total.
+    Conservative — when the target falls between bucket bounds the
+    bucket BELOW it is used (never over-reports attainment). This is
+    the ONE bucket→attainment definition: the in-process histogram path
+    below and the fleet aggregator's cross-replica bucket merge
+    (obs/fleet.py) both resolve here, so a single-replica /slostatus and
+    the fleet roll-up can never disagree about what a bucket means."""
+    counts = list(counts)
+    if not counts or counts[-1] == 0:
+        return None
+    total = counts[-1]
+    att = 0
+    for i, bound in enumerate(bucket_bounds):
+        if bound <= target_s:
+            att = counts[i]
+        else:
+            break
+    return att / total
+
+
 def attainment_from_histogram(hist, target_s: float,
                               label_values: tuple = ()) -> float | None:
-    """Attainment straight from a metrics/registry Histogram: cumulative
-    count of the smallest bucket ≥ target over the total. Conservative —
-    when the target falls between bucket bounds the bucket BELOW it is
-    used (never over-reports attainment)."""
+    """Attainment straight from a metrics/registry Histogram — the
+    in-process convenience wrapper over :func:`attainment_from_counts`."""
     key = tuple(str(v) for v in label_values)
     with hist._lock:
-        counts = hist._counts.get(key)
-        if not counts or counts[-1] == 0:
-            return None
-        total = counts[-1]
-        att = 0
-        for i, bound in enumerate(hist.buckets):
-            if bound <= target_s:
-                att = counts[i]
-            else:
-                break
-        return att / total
+        counts = list(hist._counts.get(key) or ())
+    return attainment_from_counts(hist.buckets, counts, target_s)
 
 
 class SloEngine:
@@ -199,6 +221,27 @@ class SloEngine:
             "error-budget burn rate (1.0 = budget spent exactly)",
             ("objective",), registry=reg,
         )
+        # the cumulative series the fleet aggregator federates: the
+        # gauges above are windowed over the retained sample ring (they
+        # answer "how are we doing lately"), while burn-rate ALERTING
+        # needs counter deltas over explicit windows — post-recovery, a
+        # ring-based burn would stay elevated until the bad samples age
+        # out of 4096, pinning a page alert long after the incident.
+        self.c_samples = Counter(
+            "slo_samples_total",
+            "SLO samples observed, cumulative per objective",
+            ("objective",), registry=reg,
+        )
+        self.c_violations = Counter(
+            "slo_violations_total",
+            "SLO samples over the objective's target, cumulative",
+            ("objective",), registry=reg,
+        )
+        self.h_samples = Histogram(
+            "slo_sample_duration_seconds",
+            "SLO sample latency; fleet attainment merges these buckets",
+            ("objective",), buckets=SLO_BUCKETS, registry=reg,
+        )
 
     def attach(self, tracer) -> "SloEngine":
         """Make this engine discoverable via ``current_tracer().slo`` —
@@ -212,6 +255,10 @@ class SloEngine:
         obj = self._by_name.get(objective)
         if obj is None:
             raise KeyError(f"undeclared SLO objective {objective!r}")
+        self.c_samples.labels(objective).inc()
+        if value_ms > obj.target_ms:
+            self.c_violations.labels(objective).inc()
+        self.h_samples.labels(objective).observe(value_ms / 1000.0)
         with self._lock:
             samples = self._samples[objective]
             samples.append(float(value_ms))

@@ -49,11 +49,15 @@ DEFAULT_LINKS = {
 def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
               mode: str | None = None,
               registration_flow: bool = True, tracer=None,
-              journal=None) -> WebApp:
+              journal=None, fleet=None) -> WebApp:
     """``kfam`` is any object with the KfamApp action surface
     (create_profile, create_binding, delete_binding, list_bindings) —
     in-process KfamApp or an HTTP client facade (the reference uses a
-    swagger-generated KFAM client, clients/profile_controller.ts)."""
+    swagger-generated KFAM client, clients/profile_controller.ts).
+
+    ``fleet`` is an obs.FleetAggregator (or any object with
+    ``snapshot() -> dict``): /api/fleet serves its cross-replica
+    snapshot to cluster admins — the dashboard's fleet panel."""
     default_static, shared = frontend_dirs("dashboard")
     app = WebApp("centraldashboard", static_dir=static_dir or default_static,
                  mode=mode, shared_static_dir=shared)
@@ -214,6 +218,23 @@ def build_app(kube, kfam, metrics=None, static_dir: str | None = None,
         except errors.NotFound:
             data = {"DASHBOARD_FORCE_IFRAME": True}
         return {"settings": data}
+
+    @app.route("GET", "/api/fleet")
+    def get_fleet(req):
+        """The cpfleet snapshot (obs/fleet.py): replica liveness,
+        fleet-merged SLO rows with firing alerts, the autoscaler
+        saturation roll-up, stitched-trace summary. Admin-gated — the
+        snapshot is cluster-scoped operator state (per-replica scrape
+        errors, cross-namespace trace keys), the same boundary that
+        keeps scheduler attrs off the tenant trace API."""
+        if fleet is None:
+            raise HttpError(405, "No fleet aggregator configured")
+        if not is_admin(req.user):
+            raise HttpError(403, "cluster admin only")
+        snap = dict(fleet.snapshot())
+        # the panel needs counts and health, not 50 full span trees
+        snap.pop("traces", None)
+        return {"fleet": snap}
 
     @app.route("GET", "/api/metrics/<mtype>")
     def get_metrics(req):

@@ -1,0 +1,627 @@
+"""cpfleet: cross-replica observability (obs/fleet.py, obs/alerts.py).
+
+What is pinned here, and why it must not regress:
+
+- **merge semantics**: counters accumulate across scrapes with reset
+  detection (a restarted replica must not subtract its history from the
+  fleet total); histogram buckets merge element-wise and a replica whose
+  bucket layout disagrees is SKIPPED and counted, never silently mixed;
+  gauges stay per-replica-labeled with an explicit ``replica="fleet"``
+  max roll-up — the autoscaler contract.
+- **trace stitching**: a handed-off key renders as ONE lifecycle — the
+  loser's and gainer's spans share the uid-derived trace id, the dark
+  window between them becomes a synthetic ``shard.handoff_gap`` span,
+  and attribution accounts for every wall-clock second.
+- **degradation is loud, never blocking**: a dark replica flips
+  ``partial``, lists itself in ``dark``, zeroes ``fleet_replica_up`` —
+  and the healthy replicas' data still flows. A graceful departure is
+  NOT a hole in the view.
+- **alert window math**: the SRE-workbook multi-window shape — fire only
+  when short AND long windows both burn, resolve on the short window,
+  hold state on no-data — evaluated over cumulative counter points so
+  recovery resolves promptly instead of waiting out a retention ring.
+- **the serve surface**: /debug/fleetz answers 200 on the coordinator,
+  503 elsewhere (loud, not stale), 404 unwired; /alertz always answers.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import time
+import urllib.error
+import urllib.request
+
+from service_account_auth_improvements_tpu.controlplane import obs
+from service_account_auth_improvements_tpu.controlplane.engine.leaderelection import (  # noqa: E501
+    LEASE_GROUP,
+    _fmt,
+    _now,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.serve import (
+    serve_ops,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.shard import (
+    ANN_OPS,
+    LABEL_GROUP,
+    LABEL_ROLE,
+)
+from service_account_auth_improvements_tpu.controlplane.kube import FakeKube
+from service_account_auth_improvements_tpu.controlplane.metrics import (
+    Registry,
+)
+
+PROBE = obs.Objective(
+    "probe", "test objective: sub-second round trip", target_ms=1000.0,
+)
+
+
+def _slo_text(samples: float, violations: float,
+              buckets: dict | None = None,
+              objective: str = "probe") -> str:
+    """Prometheus exposition for one replica's SLO series. ``buckets``
+    maps le-string -> cumulative count (``"+Inf"`` included)."""
+    lines = [
+        "# TYPE slo_samples_total counter",
+        f'slo_samples_total{{objective="{objective}"}} {samples}',
+        "# TYPE slo_violations_total counter",
+        f'slo_violations_total{{objective="{objective}"}} {violations}',
+    ]
+    if buckets:
+        lines.append("# TYPE slo_sample_duration_seconds histogram")
+        for le, count in buckets.items():
+            lines.append(
+                f'slo_sample_duration_seconds_bucket{{objective='
+                f'"{objective}",le="{le}"}} {count}')
+    return "\n".join(lines) + "\n"
+
+
+def _table_fetch(pages: dict):
+    """fetch_fn over a mutable ``{url_suffix_key: body}`` table; a key
+    mapped to an Exception instance raises it (a dark replica)."""
+
+    def fetch(url: str) -> str:
+        for key, body in pages.items():
+            if key in url:
+                if isinstance(body, Exception):
+                    raise body
+                return body
+        raise urllib.error.URLError(f"no route for {url}")
+
+    return fetch
+
+
+def _replica_pages(name: str, metrics_text: str,
+                   tracez: dict | None = None) -> dict:
+    return {
+        f"//{name}/metrics": metrics_text,
+        f"//{name}/slostatus": json.dumps({"schema": "slostatus/v1"}),
+        f"//{name}/debug/tracez": json.dumps(
+            tracez or {"schema": "tracez/v1", "mono": 0.0, "wall": 0.0,
+                       "traces": []}),
+    }
+
+
+# ------------------------------------------------------------ exposition
+
+
+def test_parse_exposition_families_escapes_and_parse_errors():
+    text = "\n".join([
+        "# HELP requests_total ignored",
+        "# TYPE requests_total counter",
+        'requests_total{code="200",path="/a\\"b\\\\c\\nd"} 7',
+        "# TYPE depth gauge",
+        "depth 3.5",
+        "# TYPE lat histogram",
+        'lat_bucket{le="0.5"} 2',
+        'lat_bucket{le="+Inf"} 4',
+        "lat_sum 1.25",
+        "lat_count 4",
+        "this line is garbage",
+    ])
+    fams = obs.parse_exposition(text)
+    assert fams["requests_total"]["type"] == "counter"
+    ((name, labels), value), = fams["requests_total"]["samples"].items()
+    assert name == "requests_total"
+    # escapes decoded: \" -> ", \\ -> \, \n -> newline
+    assert dict(labels)["path"] == '/a"b\\c\nd'
+    assert value == 7.0
+    assert fams["depth"]["samples"][("depth", ())] == 3.5
+    # _bucket/_sum/_count fold into the histogram family
+    hist = fams["lat"]
+    assert hist["type"] == "histogram"
+    assert hist["samples"][("lat_sum", ())] == 1.25
+    assert hist["samples"][
+        ("lat_bucket", (("le", "+Inf"),))] == 4.0
+    # the corrupt line is counted, not fatal
+    assert fams[""]["parse_errors"] == 1
+
+
+# --------------------------------------------------------- counter merge
+
+
+def test_counter_reset_detection_across_scrapes():
+    """A restarted replica's counter going backwards contributes its new
+    raw value (everything since the restart) — the fleet total keeps the
+    pre-restart history and never goes negative."""
+    pages = _replica_pages("r1", _slo_text(100, 10))
+    agg = obs.FleetAggregator(
+        lambda: {"r1": "http://r1"}, fetch_fn=_table_fetch(pages),
+        objectives=(PROBE,), registry=Registry())
+    snap = agg.scrape_once()
+    assert snap["slo"]["probe"]["samples_total"] == 100.0
+    # restart: raw totals drop 100 -> 40
+    pages.update(_replica_pages("r1", _slo_text(40, 4)))
+    snap = agg.scrape_once()
+    assert snap["slo"]["probe"]["samples_total"] == 140.0
+    assert snap["slo"]["probe"]["violations_total"] == 14.0
+    # normal monotonic growth keeps contributing plain deltas
+    pages.update(_replica_pages("r1", _slo_text(65, 6)))
+    snap = agg.scrape_once()
+    assert snap["slo"]["probe"]["samples_total"] == 165.0
+    assert snap["slo"]["probe"]["violations_total"] == 16.0
+
+
+def test_histogram_bucket_merge_and_layout_mismatch_skipped():
+    """Matching layouts merge bucket-wise (fleet attainment is computed
+    over the COMBINED distribution); a mismatched layout is skipped and
+    counted as a merge error — never silently mixed in."""
+    pages = {}
+    pages.update(_replica_pages("r1", _slo_text(
+        10, 2, buckets={"0.5": 4, "1.0": 8, "+Inf": 10})))
+    pages.update(_replica_pages("r2", _slo_text(
+        10, 8, buckets={"0.5": 1, "1.0": 2, "+Inf": 10})))
+    targets = {"r1": "http://r1", "r2": "http://r2"}
+    agg = obs.FleetAggregator(
+        lambda: dict(targets), fetch_fn=_table_fetch(pages),
+        objectives=(PROBE,), registry=Registry())
+    snap = agg.scrape_once()
+    row = snap["slo"]["probe"]
+    # 10 of 20 merged samples within the 1.0 s target bound
+    assert row["attainment"] == 0.5
+    assert row["met"] is False
+    assert snap["merge_errors"] == 0
+    # a third replica with a DIFFERENT bucket layout joins
+    pages.update(_replica_pages("r3", _slo_text(
+        5, 0, buckets={"0.25": 5, "+Inf": 5})))
+    targets["r3"] = "http://r3"
+    snap = agg.scrape_once()
+    assert snap["merge_errors"] >= 1
+    # attainment still reflects only the layout-consistent replicas
+    assert snap["slo"]["probe"]["attainment"] == 0.5
+    # but r3's plain counters still merged (only the histogram skipped)
+    assert snap["slo"]["probe"]["samples_total"] == 25.0
+
+
+def test_gauges_stay_per_replica_with_fleet_max_rollup():
+    """The autoscaler contract: fleet_workqueue_depth_per_worker /
+    fleet_worker_busy_ratio carry per-replica values plus a
+    replica="fleet" MAX roll-up — sharding means one replica can
+    saturate while the mean looks idle."""
+    def sat(depth, busy):
+        return ("# TYPE workqueue_depth_per_worker gauge\n"
+                f'workqueue_depth_per_worker{{queue="nb"}} {depth}\n'
+                "# TYPE controller_runtime_worker_busy_ratio gauge\n"
+                f"controller_runtime_worker_busy_ratio {busy}\n")
+
+    pages = {}
+    pages.update(_replica_pages("r1", sat(3.0, 0.25)))
+    pages.update(_replica_pages("r2", sat(7.0, 0.75)))
+    agg = obs.FleetAggregator(
+        lambda: {"r1": "http://r1", "r2": "http://r2"},
+        fetch_fn=_table_fetch(pages), objectives=(PROBE,),
+        registry=Registry())
+    snap = agg.scrape_once()
+    assert snap["saturation"]["fleet"] == {
+        "queue_depth_per_worker": 7.0, "busy_ratio": 0.75}
+    assert snap["replicas"]["r1"]["queue_depth_per_worker"] == 3.0
+    assert snap["replicas"]["r2"]["busy_ratio"] == 0.75
+    assert agg.g_depth.value("r1") == 3.0
+    assert agg.g_depth.value("fleet") == 7.0
+    assert agg.g_busy.value("fleet") == 0.75
+
+
+# ------------------------------------------------------------- stitching
+
+
+def test_stitch_traces_rebases_clocks_and_synthesizes_handoff_gap():
+    """Two replicas with incomparable monotonic clocks hold halves of
+    one lifecycle: the stitcher rebases onto each replica's wall anchor,
+    orders the segments, and covers the dark window between them with a
+    synthetic shard.handoff_gap span — the handoff cost is a visible
+    stage, not missing time."""
+    payloads = {
+        "ra": {"mono": 1000.0, "wall": 5000.0, "traces": [{
+            "trace_id": "t1", "key": "notebooks/ns/nb",
+            "spans": [
+                {"name": "notebook.create", "span_id": "a1",
+                 "parent_id": None, "start": 1000.0, "end": 1000.2,
+                 "attrs": {}, "error": False},
+                {"name": "reconcile", "span_id": "a2",
+                 "parent_id": None, "start": 1000.2, "end": 1000.5,
+                 "attrs": {}, "error": False},
+            ]}]},
+        "rb": {"mono": 50.0, "wall": 5001.0, "traces": [{
+            "trace_id": "t1", "key": "notebooks/ns/nb",
+            "spans": [
+                {"name": "reconcile", "span_id": "b1",
+                 "parent_id": None, "start": 50.0, "end": 50.4,
+                 "attrs": {}, "error": False},
+            ]}]},
+    }
+    (trace,) = obs.stitch_traces(payloads)
+    assert trace["key"] == "notebooks/ns/nb"
+    assert trace["replicas"] == ["ra", "rb"]
+    # ra's spans land at wall 5000.0..5000.5, rb's at 5001.0..5001.4
+    assert trace["start"] == 5000.0
+    assert abs(trace["duration_s"] - 1.4) < 1e-9
+    assert trace["handoff_gaps"] == 1
+    gap = next(s for s in trace["spans"]
+               if s["name"] == "shard.handoff_gap")
+    assert gap["span_id"] == "gap-ra-rb"
+    assert gap["attrs"] == {"from": "ra", "to": "rb", "synthetic": True}
+    assert abs(gap["start"] - 5000.5) < 1e-9
+    assert abs(gap["end"] - 5001.0) < 1e-9
+    # spans + synthetic gap account for the whole lifecycle
+    assert trace["attributed_fraction"] == 1.0
+    # the gap is a stage like any other
+    assert abs(trace["stages"]["shard.handoff_gap"] - 0.5) < 1e-9
+
+
+def test_stitch_attribution_bridges_jitter_but_not_dark_windows():
+    def payload(spans):
+        return {"r": {"mono": 0.0, "wall": 0.0, "traces": [{
+            "trace_id": "t", "key": "k",
+            "spans": [{"name": f"s{i}", "span_id": f"s{i}",
+                       "parent_id": None, "start": a, "end": b,
+                       "attrs": {}, "error": False}
+                      for i, (a, b) in enumerate(spans)]}]}}
+    # a 5 ms scheduler pause between spans is jitter, fully attributed
+    (t,) = obs.stitch_traces(payload([(0.0, 0.1), (0.105, 0.2)]))
+    assert t["attributed_fraction"] == 1.0
+    # a 100 ms same-replica hole is real dark time (no handoff to blame)
+    (t,) = obs.stitch_traces(payload([(0.0, 0.1), (0.2, 0.3)]))
+    assert t["handoff_gaps"] == 0
+    assert abs(t["attributed_fraction"] - 0.6667) < 1e-3
+
+
+def test_two_tracer_handoff_stitches_one_lifecycle():
+    """The satellite contract for reconcile trace-id adoption: because
+    the id is uid-derived (object_trace_id), the gaining replica's OWN
+    tracer independently lands spans on the SAME trace id the loser
+    used — and the stitcher reassembles one lifecycle with the handoff
+    visible."""
+    loser, gainer = obs.Tracer(), obs.Tracer()
+    nb = {"metadata": {"name": "nb", "namespace": "ns",
+                       "uid": "aaaa-bbbb-cccc-dddd-eeee"}}
+    key = "notebooks/ns/nb"
+    tid = obs.object_trace_id("notebooks", nb, tracer=loser)
+    with loser.span("reconcile", key=key):
+        time.sleep(0.01)
+    # handoff: the gainer sees the CR (uid + the stamped annotation the
+    # controller re-derives from it) and adopts the same id
+    handed = {"metadata": {**nb["metadata"],
+                           "annotations": {obs.TRACE_ANNOTATION: tid}}}
+    assert obs.object_trace_id("notebooks", handed, tracer=gainer) == tid
+    time.sleep(0.03)  # the dark window between drain and activation
+    with gainer.span("reconcile", key=key):
+        time.sleep(0.01)
+    (trace,) = obs.stitch_traces({
+        "loser": {"mono": 0.0, "wall": 0.0, "traces": loser.traces()},
+        "gainer": {"mono": 0.0, "wall": 0.0, "traces": gainer.traces()},
+    })
+    assert trace["trace_id"] == tid
+    assert trace["key"] == key
+    assert trace["replicas"] == ["gainer", "loser"]
+    assert trace["handoff_gaps"] == 1
+    assert any(s["name"] == "shard.handoff_gap"
+               for s in trace["spans"])
+    assert trace["attributed_fraction"] == 1.0
+
+
+# ------------------------------------------------- degradation semantics
+
+
+def test_dark_replica_is_loud_partial_and_never_blocks():
+    pages = _replica_pages("good", _slo_text(50, 5))
+    pages["//dark/"] = urllib.error.URLError("connection refused")
+    agg = obs.FleetAggregator(
+        lambda: {"good": "http://good", "dark": "http://dark"},
+        fetch_fn=_table_fetch(pages), objectives=(PROBE,),
+        registry=Registry())
+    snap = agg.scrape_once()  # must not raise
+    assert snap["partial"] is True
+    assert snap["dark"] == ["dark"]
+    assert snap["replicas"]["dark"]["up"] is False
+    assert "URLError" in snap["replicas"]["dark"]["error"]
+    # the healthy replica's data still flowed
+    assert snap["slo"]["probe"]["samples_total"] == 50.0
+    assert agg.g_up.value("good") == 1.0
+    assert agg.g_up.value("dark") == 0.0
+    assert agg.c_scrape_errors.value("dark") >= 1.0
+    # the page renders the partial state impossible to miss
+    assert "PARTIAL FLEET" in obs.render_fleetz(snap)
+
+
+def test_graceful_departure_is_not_a_dark_replica():
+    pages = {}
+    pages.update(_replica_pages("r1", _slo_text(10, 0)))
+    pages.update(_replica_pages("r2", _slo_text(20, 0)))
+    targets = {"r1": "http://r1", "r2": "http://r2"}
+    agg = obs.FleetAggregator(
+        lambda: dict(targets), fetch_fn=_table_fetch(pages),
+        objectives=(PROBE,), registry=Registry())
+    agg.scrape_once()
+    del targets["r2"]  # r2 left the membership (lease gone)
+    snap = agg.scrape_once()
+    # not partial: the view over CURRENT members is complete...
+    assert snap["partial"] is False and snap["dark"] == []
+    # ...but the departure is visible, and its history is kept
+    assert snap["replicas"]["r2"]["error"] == "left membership"
+    assert snap["replicas"]["r2"]["up"] is False
+    assert snap["slo"]["probe"]["samples_total"] == 30.0
+
+
+# ------------------------------------------------------------- discovery
+
+
+def test_lease_replicas_fn_discovers_live_annotated_members():
+    kube = FakeKube()
+
+    def lease(name, identity, renew, ops=None):
+        ann = {ANN_OPS: ops} if ops else {}
+        kube.create("leases", {
+            "apiVersion": f"{LEASE_GROUP}/v1", "kind": "Lease",
+            "metadata": {"name": name, "namespace": "kubeflow",
+                         "labels": {LABEL_GROUP: "cpshard",
+                                    LABEL_ROLE: "member"},
+                         "annotations": ann},
+            "spec": {"holderIdentity": identity,
+                     "leaseDurationSeconds": 15,
+                     "renewTime": _fmt(renew)},
+        }, namespace="kubeflow", group=LEASE_GROUP)
+
+    now = _now()
+    lease("m-r0", "r0", now, ops="http://r0:8080")
+    # live but no ops annotation (old binary mid rolling upgrade)
+    lease("m-r1", "r1", now)
+    # expired: presumed dead, never scraped
+    lease("m-r2", "r2", now - datetime.timedelta(seconds=120),
+          ops="http://r2:8080")
+    fn = obs.lease_replicas_fn(kube, group="cpshard",
+                               namespace="kubeflow")
+    assert fn() == {"r0": "http://r0:8080"}
+
+    class _Down:
+        def list(self, *a, **kw):
+            raise ConnectionError("apiserver down")
+
+    # a discovery outage degrades to an empty target set, not a crash
+    assert obs.lease_replicas_fn(_Down())() == {}
+
+
+# ----------------------------------------------------- alert window math
+
+
+class _Journal:
+    def __init__(self):
+        self.rows = []
+
+    def decide(self, kind, **kw):
+        self.rows.append((kind, kw))
+
+
+class _Recorder:
+    def __init__(self):
+        self.events = []
+
+    def event(self, involved, etype, reason, message, **kw):
+        self.events.append((etype, reason, message))
+
+
+def test_alert_fires_on_both_windows_and_resolves_on_short():
+    rule = obs.AlertRule(severity="page", burn_threshold=14.4,
+                         short_s=300.0, long_s=3600.0)
+    journal, rec = _Journal(), _Recorder()
+    eng = obs.AlertEngine(objectives=(PROBE,), rules=(rule,),
+                          journal=journal, recorder=rec)
+    # cold start: a single point can yield no burn verdict
+    eng.observe("probe", 0, 0, now=0.0)
+    assert eng.firing() == []
+    # healthy traffic: 1% violation fraction = 0.2x burn, no fire
+    eng.observe("probe", 100, 1, now=60.0)
+    assert eng.firing() == []
+    # sustained bleed: both windows still reach back to t=0, so the
+    # violation fraction must cross 14.4x * 5% budget = 0.72 over ALL
+    # the window's samples — 159/200 does (15.9x) -> fire
+    eng.observe("probe", 200, 159, now=120.0)
+    (f,) = eng.firing()
+    assert (f["severity"], f["state"]) == ("page", "firing")
+    assert f["burn_short"] >= 14.4 and f["burn_long"] >= 14.4
+    assert f["fired_count"] == 1
+    # healthy traffic resumes: the SHORT window clears the moment its
+    # trailing samples are clean — no waiting out the long window
+    eng.observe("probe", 1300, 160, now=500.0)
+    assert eng.firing() == []
+    rows = eng.status()["rules"]
+    assert rows[0]["resolved_count"] == 1
+    # every transition journaled (pinned schema) and emitted as Events
+    states = [kw["state"] for kind, kw in journal.rows
+              if kind == "alert"]
+    assert states == ["firing", "resolved"]
+    assert all(kw["schema"] == obs.ALERT_SCHEMA
+               for _, kw in journal.rows)
+    assert [(t, r) for t, r, _ in rec.events] == [
+        ("Warning", "AlertFiring"), ("Normal", "AlertResolved")]
+
+
+def test_alert_no_data_holds_state_and_unknown_objective_ignored():
+    rule = obs.AlertRule(severity="page", burn_threshold=14.4,
+                         short_s=300.0, long_s=3600.0)
+    eng = obs.AlertEngine(objectives=(PROBE,), rules=(rule,))
+    eng.observe("probe", 0, 0, now=0.0)
+    eng.observe("probe", 100, 80, now=10.0)   # 16x burn -> fires
+    assert len(eng.firing()) == 1
+    # silence: zero new samples in the short window is NOT an all-clear
+    eng.observe("probe", 100, 80, now=400.0)
+    assert len(eng.firing()) == 1
+    # healthy samples arrive -> resolves
+    eng.observe("probe", 200, 81, now=410.0)
+    assert eng.firing() == []
+    # an undeclared objective (another world's scrape) is ignored
+    eng.observe("not_declared", 10, 10, now=420.0)
+    assert all(r["objective"] == "probe"
+               for r in eng.status()["rules"])
+
+
+def test_alert_rule_scaled_compresses_windows_not_threshold():
+    base = obs.AlertRule(severity="page", burn_threshold=14.4,
+                         short_s=300.0, long_s=3600.0)
+    fast = base.scaled(0.01)
+    assert fast.burn_threshold == 14.4
+    assert (fast.short_s, fast.long_s) == (3.0, 36.0)
+    # the default catalog is the SRE-workbook shape
+    page = next(r for r in obs.DEFAULT_RULES if r.severity == "page")
+    ticket = next(r for r in obs.DEFAULT_RULES
+                  if r.severity == "ticket")
+    assert (page.burn_threshold, page.short_s, page.long_s) == \
+        (14.4, 300.0, 3600.0)
+    assert (ticket.burn_threshold, ticket.short_s, ticket.long_s) == \
+        (1.0, 1800.0, 21600.0)
+
+
+def test_scrape_feeds_alert_engine_fire_and_resolve():
+    """End to end through the aggregator: merged reset-corrected totals
+    drive the burn evaluation on every scrape, and the /alertz rows ride
+    on the fleet snapshot."""
+    clock = [0.0]
+    pages = _replica_pages("r1", _slo_text(10, 0))
+    eng = obs.AlertEngine(
+        objectives=(PROBE,),
+        rules=(obs.AlertRule(severity="page", burn_threshold=14.4,
+                             short_s=300.0, long_s=3600.0),))
+    agg = obs.FleetAggregator(
+        lambda: {"r1": "http://r1"}, fetch_fn=_table_fetch(pages),
+        objectives=(PROBE,), alerts=eng, registry=Registry(),
+        mono_fn=lambda: clock[0])
+    snap = agg.scrape_once()
+    assert snap["alerts"]["schema"] == "alertz/v1"
+    clock[0] = 10.0
+    pages.update(_replica_pages("r1", _slo_text(110, 90)))
+    snap = agg.scrape_once()
+    (row,) = snap["slo"]["probe"]["alerts"]
+    assert row["state"] == "firing"
+    clock[0] = 400.0
+    pages.update(_replica_pages("r1", _slo_text(1110, 91)))
+    snap = agg.scrape_once()
+    (row,) = snap["slo"]["probe"]["alerts"]
+    assert row["state"] == "ok"
+    assert row["resolved_count"] == 1
+
+
+# -------------------------------------------------------- serve surface
+
+
+def _get(port: int, path: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_fleetz_and_alertz_over_real_http():
+    """The acceptance path: a real replica ops port scraped by the
+    aggregator, served back on the coordinator's /debug/fleetz —
+    503 while not coordinator, 404 where never wired."""
+    replica_reg = Registry()
+    tracer = obs.Tracer()
+    slo = obs.SloEngine(objectives=(PROBE,), registry=replica_reg)
+    for ms in (100.0, 200.0, 300.0):
+        slo.observe("probe", ms)
+    with tracer.span("reconcile", key="notebooks/ns/nb"):
+        pass
+    replica = serve_ops(0, registry=replica_reg, host="127.0.0.1",
+                        tracer=tracer, slo=slo)
+    rport = replica.server_address[1]
+    is_coord = [False]
+    eng = obs.AlertEngine(objectives=(PROBE,))
+    agg = obs.FleetAggregator(
+        lambda: {"r0": f"http://127.0.0.1:{rport}"},
+        objectives=(PROBE,), alerts=eng,
+        is_coordinator=lambda: is_coord[0], registry=Registry())
+    coord = serve_ops(0, registry=Registry(), host="127.0.0.1",
+                      fleet=agg, alerts=eng)
+    cport = coord.server_address[1]
+    bare = serve_ops(0, registry=Registry(), host="127.0.0.1")
+    bport = bare.server_address[1]
+    try:
+        # not the coordinator: loud 503, never a stale partial answer
+        status, body = _get(cport, "/debug/fleetz")
+        assert status == 503 and "coordinator" in body
+        is_coord[0] = True
+        status, body = _get(cport, "/debug/fleetz?format=json")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["schema"] == "fleetz/v1"
+        assert snap["replicas"]["r0"]["up"] is True
+        assert snap["partial"] is False
+        row = snap["slo"]["probe"]
+        assert row["samples_total"] == 3.0
+        assert row["attainment"] == 1.0 and row["met"] is True
+        assert snap["trace_count"] >= 1
+        # the human rendering
+        status, body = _get(cport, "/debug/fleetz")
+        assert status == 200 and body.startswith("cpfleet:")
+        assert "notebooks/ns/nb" in body
+        # /alertz always answers with the live rule table
+        status, body = _get(cport, "/alertz")
+        assert status == 200
+        alertz = json.loads(body)
+        assert alertz["schema"] == "alertz/v1"
+        assert [r["objective"] for r in alertz["rules"]] == \
+            ["probe", "probe"]
+        # unwired port: fleetz 404s, alertz says so instead of 404ing
+        status, body = _get(bport, "/debug/fleetz")
+        assert status == 404
+        status, body = _get(bport, "/alertz")
+        assert status == 200
+        assert json.loads(body)["rules"] == []
+    finally:
+        for srv in (replica, coord, bare):
+            srv.shutdown()
+            srv.server_close()
+
+
+def test_snapshot_weighted_attribution_is_duration_weighted():
+    """The gated number weights by lifecycle time: one long
+    fully-attributed trace must dominate a micro-trace whose single
+    scheduler pause is half its duration."""
+    tracez = {"schema": "tracez/v1", "mono": 0.0, "wall": 0.0,
+              "traces": [
+                  {"trace_id": "long", "key": "notebooks/ns/big",
+                   "spans": [{"name": "s", "span_id": "s1",
+                              "parent_id": None, "start": 0.0,
+                              "end": 10.0, "attrs": {},
+                              "error": False}]},
+                  {"trace_id": "micro", "key": "notebooks/ns/small",
+                   "spans": [
+                       {"name": "a", "span_id": "m1",
+                        "parent_id": None, "start": 0.0, "end": 0.05,
+                        "attrs": {}, "error": False},
+                       {"name": "b", "span_id": "m2",
+                        "parent_id": None, "start": 0.1, "end": 0.15,
+                        "attrs": {}, "error": False}]},
+              ]}
+    pages = _replica_pages("r1", _slo_text(1, 0), tracez=tracez)
+    agg = obs.FleetAggregator(
+        lambda: {"r1": "http://r1"}, fetch_fn=_table_fetch(pages),
+        objectives=(PROBE,), registry=Registry())
+    att = agg.scrape_once()["attributed_fraction"]
+    assert att["n"] == 2
+    # per-trace min is dragged to 2/3 by the micro-trace...
+    assert abs(att["min"] - 0.6667) < 1e-3
+    # ...while time-weighted coverage reflects the fleet's actual dark
+    # time: 10.1 of 10.15 lifecycle seconds attributed
+    assert abs(att["weighted"] - (10.1 / 10.15)) < 1e-3

@@ -93,7 +93,7 @@ READS_PER_RECONCILE_MAX = 2.0
 #: gets the invariant legs; --chaos-only additionally requires all five
 CHAOS_SCENARIOS = ("chaos_relist", "chaos_blackout", "chaos_node_death",
                    "chaos_kubelet_stall", "chaos_429_storm",
-                   "chaos_park_blackout")
+                   "chaos_park_blackout", "chaos_alert_fidelity")
 
 
 def chaos_scenarios_in(run: dict) -> list[str]:
@@ -407,6 +407,146 @@ def failover_gate(run: dict) -> list[str]:
         failures.append(
             f"ha_apf: protected lane got {a['protected_429s']} 429s — "
             "flow control throttled the flow it exists to protect"
+        )
+    return failures
+
+
+#: --fleet leg thresholds (obs/fleet.py via cpbench/ha.py fleet arms and
+#: cpbench/chaos.py chaos_alert_fidelity). Stitched traces must attribute
+#: ≥ this fraction of every multi-replica trace's wall time to spans
+#: (synthetic handoff-gap spans included — the point is that handoff cost
+#: is VISIBLE, not that it is zero). The scrape A/B may cost at most this
+#: p95 ratio on create→Ready, with an absolute floor for the same
+#: shared-box-jitter reason as APF_PROTECTED_FLOOR_MS: these are
+#: sub-25-ms in-memory arms whose p95 over a smoke-sized sample swings
+#: by a full scheduler slice (~10 ms) run to run — the on-leg measures
+#: FASTER than the off-leg about half the time — so the delta floor
+#: must absorb one slice or a pure ratio flaps.
+FLEET_ATTRIBUTED_MIN = 0.95
+FLEET_OVERHEAD_MAX_RATIO = 1.05
+FLEET_OVERHEAD_FLOOR_MS = 10.0
+
+
+def fleet_gate(run: dict) -> list[str]:
+    """--fleet leg: cross-replica observability held end to end.
+
+    - ``ha_scale`` multi-replica arms carry a fleet record with
+      duration-weighted attributed_fraction ≥ 0.95 over stitched
+      traces (weighted, not per-trace min: micro-traces would grade a
+      single scheduler slice as half a lifecycle);
+    - the 4-replica arm stitched at least one multi-replica trace AND
+      synthesized at least one ``shard.handoff_gap`` span — a handed-off
+      key renders as ONE lifecycle with its dark window visible;
+    - the scrape-overhead A/B held (p95 ratio ≤ 1.05, or within the
+      absolute floor);
+    - ``chaos_alert_fidelity``: the page alert FIRED during the injected
+      blackout, RESOLVED after recovery, and fired ZERO times in the
+      healthy phase — an alert that can't show all three is either deaf
+      or crying wolf."""
+    failures = []
+    scenarios = run.get("scenarios", {})
+    scale = scenarios.get("ha_scale")
+    if scale is None:
+        failures.append(
+            "ha_scale: missing from run — no multi-replica fleet "
+            "evidence"
+        )
+    else:
+        extra = scale.get("extra") or {}
+        sweep = extra.get("replica_sweep") or {}
+        fleet_arms = 0
+        for arm_key in sorted(sweep):
+            arm = sweep[arm_key]
+            if (arm.get("replicas") or 0) < 2:
+                continue
+            fleet = arm.get("fleet")
+            if not isinstance(fleet, dict):
+                failures.append(
+                    f"ha_scale[{arm_key}]: multi-replica arm has no "
+                    "fleet record — the aggregator never scraped it"
+                )
+                continue
+            fleet_arms += 1
+            att = (fleet.get("attributed_fraction") or {})
+            aw, n = att.get("weighted"), att.get("n")
+            if not isinstance(aw, (int, float)) or not n:
+                failures.append(
+                    f"ha_scale[{arm_key}]: fleet attributed_fraction "
+                    f"absent (weighted={aw}, n={n}) — stitching "
+                    "produced no gradeable traces"
+                )
+            elif aw < FLEET_ATTRIBUTED_MIN:
+                failures.append(
+                    f"ha_scale[{arm_key}]: fleet attributed_fraction "
+                    f"weighted {aw} < {FLEET_ATTRIBUTED_MIN} over "
+                    f"n={n} stitched traces — lifecycle time went dark"
+                )
+            if (arm.get("replicas") or 0) >= 4:
+                if not fleet.get("stitched_multi_replica"):
+                    failures.append(
+                        f"ha_scale[{arm_key}]: no stitched multi-replica "
+                        "trace — the induced handoff never rendered as "
+                        "one lifecycle"
+                    )
+                if not fleet.get("handoff_gap_spans"):
+                    failures.append(
+                        f"ha_scale[{arm_key}]: no shard.handoff_gap "
+                        "span — the handoff's dark window is invisible"
+                    )
+        if fleet_arms == 0:
+            failures.append(
+                "ha_scale: no multi-replica arm carried a fleet record"
+            )
+        overhead = extra.get("fleet_overhead")
+        if not isinstance(overhead, dict):
+            failures.append(
+                "ha_scale: fleet_overhead A/B record missing — scrape "
+                "cost was never measured"
+            )
+        else:
+            ratio = overhead.get("ratio")
+            on = overhead.get("p95_on_ms")
+            off = overhead.get("p95_off_ms")
+            delta = (on - off if isinstance(on, (int, float))
+                     and isinstance(off, (int, float)) else None)
+            if not isinstance(ratio, (int, float)):
+                failures.append(
+                    f"ha_scale: fleet_overhead ratio absent "
+                    f"(on={on}, off={off})"
+                )
+            elif ratio > FLEET_OVERHEAD_MAX_RATIO and not (
+                    delta is not None
+                    and delta <= FLEET_OVERHEAD_FLOOR_MS):
+                failures.append(
+                    f"ha_scale: fleet scrape overhead {ratio} exceeds "
+                    f"{FLEET_OVERHEAD_MAX_RATIO} on create→Ready p95 "
+                    f"({off} → {on} ms, above the "
+                    f"{FLEET_OVERHEAD_FLOOR_MS} ms floor)"
+                )
+    fid = scenarios.get("chaos_alert_fidelity")
+    if fid is None:
+        failures.append(
+            "chaos_alert_fidelity: missing from run — no alert-fidelity "
+            "evidence"
+        )
+        return failures
+    rec = ((fid.get("extra") or {}).get("alert_fidelity")) or {}
+    false_fires = rec.get("false_fires")
+    if false_fires is None or false_fires > 0:
+        failures.append(
+            f"chaos_alert_fidelity: false_fires={false_fires} (must be "
+            "reported and 0 — the page alert cried wolf on a healthy "
+            "plane)"
+        )
+    if not rec.get("fired_during_blackout"):
+        failures.append(
+            "chaos_alert_fidelity: page alert never fired during the "
+            "apiserver blackout — the alert is deaf"
+        )
+    if not rec.get("resolved_after_recovery"):
+        failures.append(
+            "chaos_alert_fidelity: page alert never resolved after "
+            "recovery — it would page forever"
         )
     return failures
 
@@ -798,6 +938,18 @@ def main(argv=None) -> int:
                          "family, or a squeezed protected lane / "
                          "un-squeezed storm in the APF A/B in --run "
                          "(cpbench --ha; composes with the other legs)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fail on missing/violated cross-replica "
+                         "observability evidence in --run (cpbench "
+                         "--scenario ha_scale --scenario "
+                         "chaos_alert_fidelity): stitched-trace "
+                         "attributed_fraction >= 0.95 in multi-replica "
+                         "arms, a stitched multi-replica trace with a "
+                         "shard.handoff_gap span in the 4-replica arm, "
+                         "scrape-overhead A/B <= 1.05, and the page "
+                         "alert firing during the blackout / resolving "
+                         "after / 0 false fires when healthy (composes "
+                         "with the other legs)")
     ap.add_argument("--slo-report", action="store_true",
                     help="fail on any missed SLO objective or absent "
                          "per-scenario attainment record in --run "
@@ -860,6 +1012,8 @@ def main(argv=None) -> int:
             ap.error("--slo-report requires --run")
         if args.failover:
             ap.error("--failover requires --run")
+        if args.fleet:
+            ap.error("--fleet requires --run")
         if args.policy:
             ap.error("--policy requires --run")
         if args.park:
@@ -881,6 +1035,8 @@ def main(argv=None) -> int:
         failures += slo_gate(run)
     if run is not None and args.failover:
         failures += failover_gate(run)
+    if run is not None and args.fleet:
+        failures += fleet_gate(run)
     if run is not None and args.policy:
         failures += policy_gate(run)
     if run is not None and args.park:
@@ -899,15 +1055,16 @@ def main(argv=None) -> int:
                               or not (args.slo_report
                                       or args.prof_report
                                       or args.failover
+                                      or args.fleet
                                       or args.policy
                                       or args.park)):
         # latency legs need the committed record; a pure --slo-report /
-        # --prof-report / --failover / --policy / --park invocation
-        # legitimately runs without one
+        # --prof-report / --failover / --fleet / --policy / --park
+        # invocation legitimately runs without one
         if not args.baseline:
             ap.error("--baseline is required unless --chaos-only, "
                      "--slo-report, --prof-report, --failover, "
-                     "--policy or --park")
+                     "--fleet, --policy or --park")
         with open(args.baseline) as f:
             baseline = json.load(f)
         failures += gate(baseline, run, args.tolerance,
@@ -952,6 +1109,23 @@ def main(argv=None) -> int:
                   f"p95 ratio {a.get('protected_p95_ratio')} with "
                   f"storm squeezed to {a.get('storm_throughput_ratio')}"
                   " of unthrottled", file=sys.stderr)
+        if run is not None and args.fleet:
+            sweep = (run["scenarios"]["ha_scale"]["extra"]
+                     .get("replica_sweep") or {})
+            fleet4 = (sweep.get("4") or {}).get("fleet") or {}
+            overhead = (run["scenarios"]["ha_scale"]["extra"]
+                        .get("fleet_overhead") or {})
+            fid = (run["scenarios"]["chaos_alert_fidelity"]["extra"]
+                   .get("alert_fidelity") or {})
+            print("bench_gate ok: fleet attributed_fraction "
+                  f"{(fleet4.get('attributed_fraction') or {}).get('weighted')}"
+                  f" with {fleet4.get('stitched_multi_replica')} stitched"
+                  f" multi-replica trace(s) / "
+                  f"{fleet4.get('handoff_gap_spans')} handoff gap(s); "
+                  f"scrape overhead ratio {overhead.get('ratio')}; page "
+                  "alert fired-then-resolved with "
+                  f"{fid.get('false_fires')} false fires",
+                  file=sys.stderr)
         if run is not None and args.policy:
             for name in POLICY_SCENARIOS:
                 arms = (run["scenarios"][name]["extra"]["arms"])

@@ -4,7 +4,11 @@ tools/metrics_lint.py — same rules, shared AST infra; the old
 
 - counters end ``_total`` (and nothing else does);
 - histograms declare buckets explicitly;
-- no duplicate metric family across modules.
+- no duplicate metric family across modules;
+- ``fleet_*`` families are the cross-replica aggregation namespace:
+  declared only in obs/fleet.py, and every one carries a ``replica`` or
+  ``objective`` label (a fleet metric without the dimension it was
+  federated over is unreadable — which replica? which SLO?).
 """
 
 from __future__ import annotations
@@ -55,6 +59,28 @@ def _has_buckets(node: ast.Call) -> bool:
     return len(node.args) >= 4
 
 
+#: the one module allowed to declare fleet_* families (path suffix,
+#: compared with forward slashes)
+FLEET_MODULE = "obs/fleet.py"
+#: a fleet metric must carry at least one of these label dimensions
+FLEET_LABELS = ("replica", "objective")
+
+
+def _label_names(node: ast.Call) -> tuple | None:
+    """Literal label tuple of a metric construction; None when the
+    labels are non-literal (dynamic labels are someone else's problem —
+    this rule only judges what it can read)."""
+    arg = node.args[2] if len(node.args) >= 3 else next(
+        (kw.value for kw in node.keywords if kw.arg == "labels"), None)
+    if arg is None:
+        return ()
+    if isinstance(arg, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in arg.elts):
+        return tuple(e.value for e in arg.elts)
+    return None
+
+
 def lint_file(path: pathlib.Path, repo: pathlib.Path, tree=None):
     """(findings, declarations) for one file; declarations feed the
     cross-module duplicate check. Findings are (bare_message, lineno) —
@@ -90,6 +116,22 @@ def lint_file(path: pathlib.Path, repo: pathlib.Path, tree=None):
                 (f"histogram {name!r} must declare buckets "
                  "explicitly", node.lineno)
             )
+        if name.startswith("fleet_"):
+            if not str(rel).replace("\\", "/").endswith(FLEET_MODULE):
+                findings.append(
+                    (f"fleet metric {name!r} declared outside "
+                     f"{FLEET_MODULE} — the fleet_* namespace belongs "
+                     "to the cross-replica aggregator", node.lineno)
+                )
+            labels = _label_names(node)
+            if labels is not None and not any(
+                    lbl in labels for lbl in FLEET_LABELS):
+                findings.append(
+                    (f"fleet metric {name!r} must carry a "
+                     f"{' or '.join(repr(x) for x in FLEET_LABELS)} "
+                     "label (the dimension it federates over)",
+                     node.lineno)
+                )
     return findings, decls
 
 
