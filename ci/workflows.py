@@ -238,10 +238,11 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             # everything else in this job is stdlib-only
             {"name": "Install lint dependencies",
              "run": "pip install pyyaml"},
-            # the ten invariant passes (lock-discipline, cache-mutation,
-            # queue-span, rbac-check, clock-injection, metrics,
-            # event-reason, blocking-under-lock, check-then-act,
-            # mvcc-escape) fail the job on any unsuppressed finding;
+            # the eleven invariant passes (lock-discipline,
+            # cache-mutation, queue-span, rbac-check, clock-injection,
+            # metrics, event-reason, blocking-under-lock,
+            # check-then-act, mvcc-escape, autoscale-journal) fail the
+            # job on any unsuppressed finding;
             # the JSON report is uploaded if: always() below so a red
             # run carries its evidence
             {"name": "Control-plane invariant lint (cplint)",
@@ -254,8 +255,8 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
              "if": "always()",
              "run": "python -m tools.jaxlint "
                     "--json jaxlint_report.json"},
-            # the gate additionally asserts the three cplint
-            # concurrency-dataflow passes AND the five jaxlint passes
+            # the gate additionally asserts the four required cplint
+            # passes AND the five jaxlint passes
             # actually RAN (present-in-report, not clean-by-absence)
             # and reports their counts — one report of EACH schema is
             # required, so dropping an analyzer fails
@@ -411,6 +412,25 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
             {"name": "Fleet observability gate",
              "run": "python tools/bench_gate.py "
                     "--run fleet_out.json --fleet"},
+            # storm scale (docs/controlplane_bench.md "Storm scale"):
+            # trace-driven MMPP arrivals (workshop storm + diurnal
+            # tide + idler tail, heterogeneous tenants) through the
+            # sharded plane, with the hot-path A/B (PoolIndex +
+            # FakeKube watch fast path) and the saturation-driven
+            # replica autoscaler — then the storm gate: A/B improvement
+            # held at scale, 0 dual reconciles / 0 lost CRs, autoscaler
+            # scaled 1→N and back with 0 flaps inside bounds, scale-up
+            # SLO met. The 100k-CR / 1M-watch-event arm is --full
+            # behind BASELINE.md; smoke runs the reduced shape.
+            {"name": "Run cpbench storm --smoke",
+             "run": "python -m service_account_auth_improvements_tpu."
+                    "controlplane.cpbench --smoke --storm "
+                    "--scenario storm_scale --scenario storm_autoscale "
+                    "--scenario storm_chaos "
+                    "--out storm_out.json --dump-dir bench_out"},
+            {"name": "Storm scale + autoscale gate",
+             "run": "python tools/bench_gate.py "
+                    "--run storm_out.json --storm --slo-report"},
             # learned placement (docs/scheduler.md): the A/B family
             # needs the JAX half of the tree — installed HERE so every
             # earlier step keeps proving the control plane runs
@@ -452,6 +472,7 @@ COMPONENT_WORKFLOWS: dict[str, dict] = {
                       "path": "bench_out.json\nchaos_out.json\n"
                               "park_out.json\n"
                               "ha_out.json\nfleet_out.json\n"
+                              "storm_out.json\n"
                               "policy_out.json\n"
                               "cplint_report.json\n"
                               "jaxlint_report.json\n"

@@ -42,6 +42,9 @@ from service_account_auth_improvements_tpu.controlplane.cpbench.scenarios import
     BenchConfig,
     run_scenario,
 )
+from service_account_auth_improvements_tpu.controlplane.cpbench.storm import (  # noqa: E501,F401 — importing registers the storm_scale family into SCENARIOS
+    STORM_SCENARIOS,
+)
 from service_account_auth_improvements_tpu.controlplane import obs
 
 SCHEMA = "cpbench/v1"
@@ -75,6 +78,9 @@ SMOKE_N = {
     "park_resume_storm": 12,  # thundering-herd park/resume bursts
     "park_during_gang": 4,    # 2 gangs parked under a second wave
     "park_oversubscribe": 6,  # 6 gangs through 2 pools (x2 arms)
+    "storm_scale": 240,       # composed-arrival main arm (+2 A/B arms)
+    "storm_autoscale": 240,   # workshop storm against 1→3 replicas
+    "storm_chaos": 120,       # 429 storm + blackout composed
 }
 FULL_N = {
     "notebook_ready": 150,
@@ -102,6 +108,11 @@ FULL_N = {
     "park_resume_storm": 48,
     "park_during_gang": 8,
     "park_oversubscribe": 16,
+    "storm_scale": 100_000,   # the tentpole regime: 100k CRs, 5
+                              # watchers x ~2 events/CR => 1M+ watch
+                              # events through the fanout
+    "storm_autoscale": 4_000,
+    "storm_chaos": 2_000,
 }
 
 
@@ -144,6 +155,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "during-gang, oversubscription A/B; "
                          "docs/scheduler.md 'Oversubscription & "
                          "parking') in the run")
+    ap.add_argument("--storm", action="store_true",
+                    help="include the storm_scale family (trace-driven "
+                         "composed arrivals at the 100k-CR regime, "
+                         "hot-path A/B, saturation-driven replica "
+                         "autoscaling, composed chaos; gated by "
+                         "bench_gate --storm; docs/controlplane_bench"
+                         ".md 'Storm scale') in the run")
     ap.add_argument("--journal-out", default="", metavar="DIR",
                     help="dump each scenario's decision journal as "
                          "<DIR>/<scenario>_journal.jsonl next to the "
@@ -326,7 +344,9 @@ def run(args) -> dict:
             and (getattr(args, "policy", False)
                  or name not in POLICY_SCENARIOS)
             and (getattr(args, "park", False)
-                 or name not in PARK_SCENARIOS))
+                 or name not in PARK_SCENARIOS)
+            and (getattr(args, "storm", False)
+                 or name not in STORM_SCENARIOS))
     )
     started = time.monotonic()
     report: dict = {

@@ -1,0 +1,316 @@
+"""cpbench arrival processes: MMPP storms, tides, tails, traces.
+
+Every bench arm before this one hit the apiserver at a constant rate
+or in one burst (loadgen.py). Production Jupyter traffic is neither:
+XSEDE's Jupyter-at-scale deployments (arXiv:1805.04781) see **workshop
+storms** (hundreds of spawns inside two minutes), **diurnal tides**
+(the gateway's day, a slow sinusoid), and a **long tail of idlers**
+trickling in around the clock. This module generates those shapes and
+replays recorded traces, so the storm_scale family (cpbench/storm.py)
+drives the plane with traffic shaped like the deployments the paper
+targets instead of a constant drip.
+
+Three design rules, load-bearing for the bench contract:
+
+- **Deterministic.** Every generator takes a ``seed`` and draws from
+  its own ``random.Random`` — same knobs, same schedule, byte for
+  byte. Cross-run comparability is what makes the hot-path A/B
+  (bench_gate --storm) a measurement instead of a dice roll.
+- **Composable.** A shape returns plain arrival offsets (seconds from
+  t=0); :func:`compose` merges any number of them and :func:`rescale`
+  compresses a day-long tide into a bench-sized span. The 100k-CR
+  recipe in docs/controlplane_bench.md is storm + tide + tail summed.
+- **Replayable.** :func:`write_trace`/:func:`load_trace` round-trip a
+  schedule through the pinned ``arrivals-trace/v1`` JSONL schema, so a
+  future production trace can drive the identical bench path the
+  synthetic shapes use today.
+
+Tenancy rides along: :func:`tenant_mix` draws tens of thousands of
+heterogeneous tenants — 1-chip dabblers dominating by count, 4x4 gang
+trainers dominating by chips — and :func:`assign_tenants` pairs each
+arrival with one, giving the storm reconciler's placement sweep a
+realistic demand distribution (scheduler/placement.py shapes).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import random
+
+
+@dataclasses.dataclass(frozen=True)
+class Phase:
+    """One MMPP state: a Poisson arrival rate held for an
+    exponentially-distributed dwell."""
+
+    name: str
+    #: arrivals per second while the phase holds (0 = silence)
+    rate: float
+    #: mean phase duration, seconds (exponential)
+    mean_dwell_s: float
+
+
+class MMPP:
+    """Markov-modulated Poisson process: arrivals are Poisson at the
+    current phase's rate; the phase itself switches after an
+    exponential dwell (uniformly to one of the OTHER phases — the
+    classic 2-state burst/quiet chain, generalized). Exponential
+    memorylessness makes the discard-at-boundary switch exact: an
+    inter-arrival drawn past the phase end is simply abandoned and the
+    next phase's clock starts at the boundary."""
+
+    def __init__(self, phases, seed: int = 0):
+        phases = tuple(phases)
+        if not phases:
+            raise ValueError("MMPP needs at least one phase")
+        if all(p.rate <= 0 for p in phases):
+            raise ValueError("MMPP needs at least one phase with rate > 0")
+        for p in phases:
+            if p.mean_dwell_s <= 0:
+                raise ValueError(f"phase {p.name!r} mean_dwell_s must be > 0")
+        self.phases = phases
+        self.seed = seed
+
+    def offsets(self, n: int) -> list[float]:
+        """``n`` arrival offsets (seconds from t=0), sorted."""
+        rng = random.Random(self.seed)
+        out: list[float] = []
+        t = 0.0
+        phase = self.phases[0]
+        phase_end = rng.expovariate(1.0 / phase.mean_dwell_s)
+        while len(out) < n:
+            if phase.rate > 0:
+                nxt = t + rng.expovariate(phase.rate)
+            else:
+                nxt = phase_end
+            if nxt >= phase_end:
+                # phase switch at the boundary, arrival discarded
+                t = phase_end
+                others = [p for p in self.phases if p is not phase]
+                phase = rng.choice(others) if others else phase
+                phase_end = t + rng.expovariate(1.0 / phase.mean_dwell_s)
+                continue
+            t = nxt
+            out.append(t)
+        return out
+
+
+def interarrivals(offsets) -> list[float]:
+    return [b - a for a, b in zip(offsets, offsets[1:])]
+
+
+def burstiness(offsets) -> float | None:
+    """Coefficient of variation of the inter-arrival gaps: 1.0 is a
+    homogeneous Poisson process, > 1 is bursty (the storm signature a
+    constant-rate loadgen can never produce). None under 3 arrivals."""
+    gaps = interarrivals(offsets)
+    if len(gaps) < 2:
+        return None
+    mean = sum(gaps) / len(gaps)
+    if mean <= 0:
+        return None
+    var = sum((g - mean) ** 2 for g in gaps) / len(gaps)
+    return math.sqrt(var) / mean
+
+
+# ------------------------------------------------------------- shapes
+
+def workshop_storm(n: int, *, window_s: float = 120.0, seed: int = 0,
+                   start_s: float = 0.0) -> list[float]:
+    """The XSEDE signature: ~n spawns packed into roughly ``window_s``
+    (hundreds in two minutes at production numbers), hot bursts broken
+    by brief lulls — a 2-state MMPP with a >20:1 rate ratio."""
+    if n <= 0:
+        return []
+    base = n / window_s
+    storm = Phase("storm", rate=base * 1.6, mean_dwell_s=window_s / 6.0)
+    lull = Phase("lull", rate=base * 0.05, mean_dwell_s=window_s / 20.0)
+    return [start_s + t for t in MMPP((storm, lull), seed=seed).offsets(n)]
+
+
+def diurnal_tide(n: int, *, period_s: float = 600.0, seed: int = 0,
+                 start_s: float = 0.0, floor: float = 0.1) -> list[float]:
+    """The gateway's day: a sinusoidal-rate Poisson process (thinning
+    against the peak rate), ``floor`` being the overnight fraction of
+    peak. ``period_s`` is one full day — :func:`rescale` compresses a
+    real 86400 s tide into a bench-sized span."""
+    if n <= 0:
+        return []
+    if not 0.0 <= floor <= 1.0:
+        raise ValueError("floor must be in [0, 1]")
+    rng = random.Random(seed)
+    peak = 2.0 * n / period_s
+    out: list[float] = []
+    t = 0.0
+    while len(out) < n:
+        t += rng.expovariate(peak)
+        phase01 = (t % period_s) / period_s
+        envelope = floor + (1.0 - floor) * 0.5 * (
+            1.0 - math.cos(2.0 * math.pi * phase01))
+        if rng.random() <= envelope:
+            out.append(start_s + t)
+    return out
+
+
+def idler_tail(n: int, *, span_s: float = 900.0, seed: int = 0,
+               start_s: float = 0.0) -> list[float]:
+    """The long-tail idlers: a thin homogeneous Poisson drip across
+    ``span_s`` — individually invisible, collectively the population
+    that keeps caches warm and stores large."""
+    if n <= 0:
+        return []
+    rng = random.Random(seed)
+    rate = n / span_s
+    out: list[float] = []
+    t = 0.0
+    while len(out) < n:
+        t += rng.expovariate(rate)
+        out.append(start_s + t)
+    return out
+
+
+def compose(*schedules) -> list[float]:
+    """Merge shape schedules into one sorted arrival list — storms ride
+    on tides ride on the idler tail."""
+    out: list[float] = []
+    for s in schedules:
+        out.extend(s)
+    out.sort()
+    return out
+
+
+def rescale(offsets, span_s: float) -> list[float]:
+    """Compress or stretch a schedule to span ``span_s`` starting at 0,
+    preserving relative shape — the bench's pacing knob (a day-long
+    tide replayed in 30 s still tides)."""
+    offsets = list(offsets)
+    if not offsets:
+        return []
+    lo, hi = offsets[0], offsets[-1]
+    width = hi - lo
+    if width <= 0:
+        return [0.0] * len(offsets)
+    return [(t - lo) * span_s / width for t in offsets]
+
+
+# ------------------------------------------------------------ tenants
+
+@dataclasses.dataclass(frozen=True)
+class TenantProfile:
+    name: str
+    #: draw weight in the mix (fractions of the population)
+    weight: float
+    generation: str
+    topology: str
+    total_chips: int
+    num_hosts: int
+
+
+#: the heterogeneity the ROADMAP asks for: dabblers dominate by count,
+#: gang trainers dominate by chips. Shapes are real placement demands
+#: (scheduler/placement.py Demand fields) so the storm reconciler's
+#: feasibility sweep exercises the same slice classes tpusched does.
+DEFAULT_PROFILES = (
+    TenantProfile("dabbler", 0.78, "v4", "1x1", total_chips=1,
+                  num_hosts=1),
+    TenantProfile("classroom", 0.17, "v4", "2x2", total_chips=4,
+                  num_hosts=1),
+    TenantProfile("gang_trainer", 0.05, "v4", "4x4", total_chips=16,
+                  num_hosts=4),
+)
+
+#: the pinned tenant-row schema — tests/test_arrivals.py asserts these
+#: exact keys; a rename rots every recorded trace's tenant table
+TENANT_FIELDS = ("tenant", "profile", "generation", "topology",
+                 "total_chips", "num_hosts")
+
+
+def tenant_mix(num_tenants: int, *, seed: int = 0,
+               profiles=DEFAULT_PROFILES) -> list[dict]:
+    """``num_tenants`` tenant rows drawn by profile weight, seeded.
+    Row keys are exactly :data:`TENANT_FIELDS`."""
+    profiles = tuple(profiles)
+    if not profiles:
+        raise ValueError("tenant_mix needs at least one profile")
+    rng = random.Random(seed)
+    weights = [p.weight for p in profiles]
+    picks = rng.choices(profiles, weights=weights, k=num_tenants)
+    return [
+        {
+            "tenant": f"t{i:06d}",
+            "profile": p.name,
+            "generation": p.generation,
+            "topology": p.topology,
+            "total_chips": p.total_chips,
+            "num_hosts": p.num_hosts,
+        }
+        for i, p in enumerate(picks)
+    ]
+
+
+@dataclasses.dataclass(frozen=True)
+class Arrival:
+    """One scheduled spawn: when, and as whom."""
+
+    offset_s: float
+    tenant: str
+    profile: str
+
+
+def assign_tenants(offsets, tenants, *, seed: int = 0) -> list[Arrival]:
+    """Pair each arrival with a tenant row (uniform over tenants —
+    dabblers already dominate by population, not by per-tenant
+    activity). Offsets are rounded to microseconds so a schedule
+    survives the trace round-trip bit-exact."""
+    tenants = list(tenants)
+    if not tenants:
+        raise ValueError("assign_tenants needs at least one tenant")
+    rng = random.Random(seed)
+    return [Arrival(round(t, 6), row["tenant"], row["profile"])
+            for t, row in ((t, rng.choice(tenants)) for t in offsets)]
+
+
+# -------------------------------------------------------------- trace
+
+#: pinned trace schema: every row carries it, and load_trace rejects
+#: anything else — replayed production traces and synthetic schedules
+#: must be indistinguishable to the bench
+TRACE_SCHEMA = "arrivals-trace/v1"
+
+
+def write_trace(path: str, arrivals) -> int:
+    """Serialize a schedule as ``arrivals-trace/v1`` JSONL; returns the
+    row count. Deterministic: same schedule, same bytes."""
+    arrivals = list(arrivals)
+    with open(path, "w", encoding="utf-8") as f:
+        for a in arrivals:
+            f.write(json.dumps({
+                "schema": TRACE_SCHEMA,
+                "offset_s": a.offset_s,
+                "tenant": a.tenant,
+                "profile": a.profile,
+            }, sort_keys=True) + "\n")
+    return len(arrivals)
+
+
+def load_trace(path: str) -> list[Arrival]:
+    """Parse an ``arrivals-trace/v1`` JSONL file back into the exact
+    schedule :func:`write_trace` recorded (offsets re-sorted — a trace
+    spliced from multiple recorders may interleave)."""
+    out: list[Arrival] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("schema") != TRACE_SCHEMA:
+                raise ValueError(
+                    f"{path}:{lineno}: schema {row.get('schema')!r}, "
+                    f"want {TRACE_SCHEMA!r}")
+            out.append(Arrival(float(row["offset_s"]), row["tenant"],
+                               row.get("profile", "")))
+    out.sort(key=lambda a: a.offset_s)
+    return out

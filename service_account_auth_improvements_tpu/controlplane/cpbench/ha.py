@@ -215,6 +215,11 @@ class _HAReplica:
     the Manager, and a per-replica reconciler class so apiserver
     attribution and engine metrics split by replica."""
 
+    #: reconciler base — the storm family (cpbench/storm.py) swaps in a
+    #: reconciler with a placement sweep on the hot path; the dynamic
+    #: per-replica subclass below is built from whatever this names
+    rec_base = _HAReconciler
+
     def __init__(self, kube, idx: int, world: "_HAWorld",
                  serve: bool = False):
         self.identity = f"r{idx}"
@@ -247,7 +252,7 @@ class _HAReplica:
             journal=world.journal, ops_url=ops_url,
         )
         self.mgr.attach_shard(self.runtime.member)
-        rec_cls = type(f"HARec_{self.identity}", (_HAReconciler,), {})
+        rec_cls = type(f"HARec_{self.identity}", (self.rec_base,), {})
         self.rec = rec_cls(self.client, self.mgr.cached_client(),
                            tracker=world.tracker, slo=self.slo)
         world.ledger.wrap(self.rec, self.identity)
@@ -289,6 +294,10 @@ class _HAReplica:
 class _HAWorld:
     """One FakeKube + N sharded replicas + a ready-watch, for one arm."""
 
+    #: replica class — the storm family subclasses it (placement state
+    #: + per-replica saturation mirror) without copying the world
+    replica_cls = _HAReplica
+
     def __init__(self, cfg: BenchConfig, tracker: Tracker, replicas: int,
                  num_shards: int = DEFAULT_NUM_SHARDS,
                  lease_s: float = HA_LEASE_S, tick_s: float = HA_TICK_S,
@@ -302,7 +311,8 @@ class _HAWorld:
         self.tracker = tracker
         self.journal = Journal()
         self.ledger = _Ledger()
-        self.replicas = [_HAReplica(self.kube, i, self, serve=serve)
+        self.replicas = [self.replica_cls(self.kube, i, self,
+                                          serve=serve)
                          for i in range(replicas)]
         self._ready_delivered = [0]
         self._ready_inf = Informer(self.kube.client_for("cpbench"),

@@ -1,6 +1,6 @@
 """Load generator: concurrency + arrival pattern for apiserver writes.
 
-Two arrival patterns, the shapes that stress a control plane
+Three arrival patterns, the shapes that stress a control plane
 differently (NotebookOS, arXiv:2503.20591 — spawn storms at lecture
 start vs. steady drip):
 
@@ -12,6 +12,10 @@ start vs. steady drip):
   mean would wander between runs; constant spacing keeps runs
   comparable). The steady-state case — stresses the per-CR critical
   path with the system otherwise quiet.
+- ``schedule``: each job submitted at an explicit per-job offset from
+  t=0 — the trace/arrival-process case (cpbench/arrivals.py MMPP
+  storms, tides, replayed traces). The offsets list is the schedule;
+  determinism is the generator's job, pacing is this one's.
 
 Jobs run on a bounded thread pool either way: ``concurrency`` models
 how many clients write the apiserver at once, not how many CRs exist.
@@ -25,22 +29,33 @@ from concurrent.futures import ThreadPoolExecutor
 
 class LoadGenerator:
     def __init__(self, concurrency: int = 8, pattern: str = "burst",
-                 rate: float = 50.0):
-        if pattern not in ("burst", "rate"):
+                 rate: float = 50.0, offsets=None):
+        if pattern not in ("burst", "rate", "schedule"):
             raise ValueError(f"unknown arrival pattern {pattern!r}")
         if concurrency < 1:
             raise ValueError("concurrency must be >= 1")
         if pattern == "rate" and rate <= 0:
             raise ValueError("rate must be > 0")
+        if pattern == "schedule":
+            if offsets is None:
+                raise ValueError("pattern 'schedule' needs offsets")
+            offsets = list(offsets)
+            if any(b < a for a, b in zip(offsets, offsets[1:])):
+                raise ValueError("schedule offsets must be sorted")
         self.concurrency = concurrency
         self.pattern = pattern
         self.rate = rate
+        self.offsets = offsets
 
     def run(self, jobs) -> list:
         """Execute callables under the arrival pattern; returns each
         job's result, with raised exceptions returned in place (one bad
         CR must not sink the measurement of the other N-1)."""
         results = [None] * len(jobs)
+        if self.pattern == "schedule" and len(self.offsets) < len(jobs):
+            raise ValueError(
+                f"schedule has {len(self.offsets)} offsets for "
+                f"{len(jobs)} jobs")
 
         def call(i, job):
             try:
@@ -54,6 +69,11 @@ class LoadGenerator:
             for i, job in enumerate(jobs):
                 if self.pattern == "rate":
                     due = start + i / self.rate
+                    delay = due - time.monotonic()
+                    if delay > 0:
+                        time.sleep(delay)
+                elif self.pattern == "schedule":
+                    due = start + self.offsets[i]
                     delay = due - time.monotonic()
                     if delay > 0:
                         time.sleep(delay)

@@ -22,6 +22,12 @@ from service_account_auth_improvements_tpu.controlplane.engine.metrics import ( 
     EngineMetrics,
     engine_metrics,
 )
+from service_account_auth_improvements_tpu.controlplane.engine.autoscale import (  # noqa: F401
+    AUTOSCALE_SCHEMA,
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+    drain_then_leave,
+)
 from service_account_auth_improvements_tpu.controlplane.engine.shard import (  # noqa: F401
     DEFAULT_NUM_SHARDS,
     ShardCoordinator,

@@ -1,0 +1,285 @@
+"""Coordinator-side replica autoscaler: fleet saturation → join/leave.
+
+The fleet aggregator (obs/fleet.py) publishes exactly two gauges for
+this consumer — ``fleet_workqueue_depth_per_worker`` and
+``fleet_worker_busy_ratio``, ``replica="fleet"`` being the max roll-up
+across live replicas. This module closes the loop the ROADMAP left
+open: read those numbers, decide, and scale Manager replicas through
+the EXISTING cpshard join/leave protocol (engine/shard.py) — a
+scale-up is "start another replica's ShardRuntime + Manager", a
+scale-down is "drain one replica's workers, then leave". No new
+membership machinery: the handoff correctness the shard protocol
+already proves (dual-reconcile-free, barrier-acked) is exactly why
+the autoscaler may move replicas around at all.
+
+The decision rules, each load-bearing:
+
+- **Hysteresis, asymmetric.** Scale up after ``up_consecutive``
+  saturated scrapes (storms deserve fast reaction — the
+  ``scale_up_latency`` SLO in obs/slo.py bounds it); scale down only
+  after the longer ``down_consecutive`` idle streak plus a cooldown.
+  A diurnal tide's ebb must not thrash membership — the bench_gate
+  --storm leg pins ``flaps == 0``.
+- **One noisy scrape is nothing.** A neutral or contradicting scrape
+  resets the streak; a single saturated sample can never move the
+  fleet (tests/test_arrivals.py pins this).
+- **No decision on missing evidence.** A failed scrape (blackout,
+  partial fleet) yields ``None`` saturation — the autoscaler HOLDS.
+  Scaling on absence of data is how outages become outages-with-
+  membership-churn (the storm_chaos invariant).
+- **Bounds are absolute.** ``min_replicas``/``max_replicas`` clamp
+  every decision; the journal records wanting to exceed them as a
+  distinct ``hold`` reason so the bench can prove "never flaps past
+  bounds" rather than assume it.
+- **Every decision is journaled** as a pinned ``autoscale/v1`` row
+  (cplint's autoscale-journal pass enforces the pin) — the same
+  decision-journal discipline tpusched placement established, so a
+  future learned autoscaler has training rows from day one.
+
+Scale-down ordering lives in :func:`drain_then_leave`: workers drain
+BEFORE the member leaves. Leaving first re-maps the replica's shards
+while its workers still run reconciles — the dual-reconcile window the
+schedsim ``autoscale_membership`` model searches for (and its mutant
+proves the ledger catches).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+#: the pinned journal schema for autoscaler decisions; every
+#: ``decide("autoscale", ...)`` row must carry it (cplint:
+#: autoscale-journal)
+AUTOSCALE_SCHEMA = "autoscale/v1"
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscaleConfig:
+    """Thresholds and hysteresis. Defaults suit the bench worlds (2
+    workers/replica, sub-second scrape cadence); production tuning
+    belongs in config, not code."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    #: saturated when depth/worker OR busy ratio clears its high bar
+    depth_high: float = 8.0
+    busy_high: float = 0.9
+    #: idle only when BOTH are under their low bars — the deadband
+    #: between the bars is the hysteresis that keeps tides from
+    #: thrashing membership
+    depth_low: float = 1.0
+    busy_low: float = 0.5
+    #: consecutive saturated scrapes before scaling up (short: storms
+    #: deserve fast reaction, and one scrape alone still can't move us)
+    up_consecutive: int = 2
+    #: consecutive idle scrapes before scaling down (long: the ebb must
+    #: prove itself)
+    down_consecutive: int = 6
+    #: minimum seconds between membership actions
+    cooldown_s: float = 2.0
+    #: stabilization: a direction reversal within this window of the
+    #: previous action is held (reason ``stabilization``) instead of
+    #: executed; an executed reversal inside it would count as a flap —
+    #: the storm gate pins that count at 0
+    flap_window_s: float = 30.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if self.max_replicas < self.min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if self.depth_low > self.depth_high \
+                or self.busy_low > self.busy_high:
+            raise ValueError("low thresholds must not exceed high")
+        if self.up_consecutive < 2:
+            # < 2 would let a single noisy scrape move the fleet —
+            # exactly the flap source hysteresis exists to kill
+            raise ValueError("up_consecutive must be >= 2")
+        if self.down_consecutive < self.up_consecutive:
+            raise ValueError(
+                "down_consecutive must be >= up_consecutive "
+                "(scale-down hysteresis is the longer side)")
+
+
+class ReplicaAutoscaler:
+    """Feed fleet saturation samples in; join/leave callbacks come out.
+
+    ``scale_up_fn()``/``scale_down_fn()`` perform one membership step
+    (the caller binds them to starting/draining a replica through
+    cpshard); ``replica_count_fn()`` reports current live membership —
+    read fresh each decision, because replicas also die on their own
+    (failover) and the autoscaler must reason about reality, not its
+    own intent."""
+
+    def __init__(self, replica_count_fn, scale_up_fn, scale_down_fn,
+                 config: AutoscaleConfig | None = None, *,
+                 journal=None, mono_fn=time.monotonic):
+        self.cfg = config or AutoscaleConfig()
+        self._count = replica_count_fn
+        self._up = scale_up_fn
+        self._down = scale_down_fn
+        self._journal = journal
+        self._mono = mono_fn
+        self._lock = threading.Lock()
+        self._hot_streak = 0
+        self._idle_streak = 0
+        self._last_action: str | None = None
+        self._last_action_at: float | None = None
+        self.flaps = 0
+        self.decisions: list[dict] = []
+
+    # ------------------------------------------------------- classify
+
+    def _classify(self, saturation: dict | None) -> str:
+        """'saturated' | 'idle' | 'neutral' | 'missing'."""
+        if not saturation:
+            return "missing"
+        depth = saturation.get("queue_depth_per_worker")
+        busy = saturation.get("busy_ratio")
+        if depth is None and busy is None:
+            return "missing"
+        depth = 0.0 if depth is None else float(depth)
+        busy = 0.0 if busy is None else float(busy)
+        if depth >= self.cfg.depth_high or busy >= self.cfg.busy_high:
+            return "saturated"
+        if depth <= self.cfg.depth_low and busy <= self.cfg.busy_low:
+            return "idle"
+        return "neutral"
+
+    # --------------------------------------------------------- decide
+
+    def observe(self, saturation: dict | None) -> str:
+        """Ingest one fleet saturation sample
+        (``snapshot["saturation"]["fleet"]`` from obs/fleet.py) and act.
+        Returns the action taken: ``scale_up``, ``scale_down``, or
+        ``hold``."""
+        with self._lock:
+            state = self._classify(saturation)
+            now = self._mono()
+            replicas = int(self._count())
+            if state == "saturated":
+                self._hot_streak += 1
+                self._idle_streak = 0
+            elif state == "idle":
+                self._idle_streak += 1
+                self._hot_streak = 0
+            else:
+                # neutral or missing evidence: both streaks reset — a
+                # storm interrupted by one calm (or lost) scrape must
+                # re-prove itself, and an outage never scales anything
+                self._hot_streak = 0
+                self._idle_streak = 0
+
+            action, reason = "hold", state
+            in_cooldown = (
+                self._last_action_at is not None
+                and now - self._last_action_at < self.cfg.cooldown_s
+            )
+            if state == "saturated" \
+                    and self._hot_streak >= self.cfg.up_consecutive:
+                if replicas >= self.cfg.max_replicas:
+                    reason = "at-max-replicas"
+                elif in_cooldown:
+                    reason = "cooldown"
+                else:
+                    action, reason = "scale_up", "sustained-saturation"
+            elif state == "idle" \
+                    and self._idle_streak >= self.cfg.down_consecutive:
+                if replicas <= self.cfg.min_replicas:
+                    reason = "at-min-replicas"
+                elif in_cooldown:
+                    reason = "cooldown"
+                else:
+                    action, reason = "scale_down", "sustained-idle"
+
+            reversal_in_window = (
+                action != "hold"
+                and self._last_action is not None
+                and self._last_action != action
+                and self._last_action_at is not None
+                and now - self._last_action_at < self.cfg.flap_window_s
+            )
+            if reversal_in_window:
+                # stabilization: a direction reversal inside the flap
+                # window is HELD, not executed — the streak keeps
+                # accumulating and the action fires once the window
+                # passes. A storm's legitimate up-then-ebb-down is two
+                # actions OUTSIDE the window; inside it, churn is churn.
+                action, reason = "hold", "stabilization"
+            if action != "hold":
+                if self._last_action is not None \
+                        and self._last_action != action \
+                        and self._last_action_at is not None \
+                        and now - self._last_action_at \
+                        < self.cfg.flap_window_s:
+                    # unreachable while the stabilization hold above
+                    # stands — a tripwire, so any future path around it
+                    # shows up as a nonzero flap count the storm gate
+                    # pins to 0
+                    self.flaps += 1
+                self._last_action = action
+                self._last_action_at = now
+                self._hot_streak = 0
+                self._idle_streak = 0
+            row = {
+                "action": action,
+                "reason": reason,
+                "state": state,
+                "replicas": replicas,
+                "hot_streak": self._hot_streak,
+                "idle_streak": self._idle_streak,
+                "flaps": self.flaps,
+            }
+            self.decisions.append(row)
+            journal = self._journal
+        if journal is not None:
+            journal.decide("autoscale", schema=AUTOSCALE_SCHEMA, **row)
+        if action == "scale_up":
+            self._up()
+        elif action == "scale_down":
+            self._down()
+        return action
+
+    def snapshot(self) -> dict:
+        """The bench/gate evidence cut: counts, flaps, full decision
+        log tail."""
+        with self._lock:
+            ups = sum(1 for d in self.decisions
+                      if d["action"] == "scale_up")
+            downs = sum(1 for d in self.decisions
+                        if d["action"] == "scale_down")
+            return {
+                "schema": AUTOSCALE_SCHEMA,
+                "decisions": len(self.decisions),
+                "scale_ups": ups,
+                "scale_downs": downs,
+                "flaps": self.flaps,
+                "min_replicas": self.cfg.min_replicas,
+                "max_replicas": self.cfg.max_replicas,
+                "tail": self.decisions[-16:],
+            }
+
+
+def drain_then_leave(drained_fn, leave_fn, *, timeout_s: float = 10.0,
+                     poll_s: float = 0.05, sleep_fn=time.sleep,
+                     mono_fn=time.monotonic) -> bool:
+    """The scale-down ordering contract: wait for ``drained_fn()``
+    (workers idle, no reconcile in flight) BEFORE ``leave_fn()``
+    (shard member leave → re-map → successors requeue). Leaving first
+    opens the dual-reconcile window the shard ledger exists to catch —
+    the losing replica's in-flight reconcile races the gaining
+    replica's requeue of the same key. Returns False when the drain
+    timed out (the leave still happens: a wedged worker must not pin
+    membership forever — the barrier ack in the shard protocol is the
+    second line of defense)."""
+    deadline = mono_fn() + timeout_s
+    drained = True
+    while not drained_fn():
+        if mono_fn() >= deadline:
+            drained = False
+            break
+        sleep_fn(poll_s)
+    leave_fn()
+    return drained

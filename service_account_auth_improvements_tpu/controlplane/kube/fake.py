@@ -74,6 +74,7 @@ from service_account_auth_improvements_tpu.controlplane.kube.selectors import (
     parse_field_selector,
     parse_label_selector,
 )
+from service_account_auth_improvements_tpu.utils.env import get_env_bool
 
 __all__ = [
     "FakeKube", "json_merge_patch", "match_selector",
@@ -1067,10 +1068,28 @@ class FakeKube:
                 if w in fam.watchers:
                     fam.watchers.remove(w)
 
+        # fanout fast path, decided ONCE per watch instead of once per
+        # event: a cluster-wide watcher (no namespace, or a cluster-
+        # scoped resource — every informer in the engine) can never hit
+        # the foreign-namespace BOOKMARK branch, so ``_filter_ns`` is
+        # the identity for it and the per-event call is pure overhead
+        # on the fanout hot path. Safe precisely because the event
+        # already SHARES the immutable stored object (the COW contract,
+        # docs/fakekube.md — ``_emit_locked`` does no per-event
+        # deepcopy): there is no per-watcher copy to specialize, so
+        # skipping the filter changes nothing observable. The
+        # ``FAKEKUBE_WATCH_FASTPATH=0`` lever is the storm bench's A/B
+        # handle (cpbench/storm.py), read per watch() call.
+        passthrough = (
+            not (namespace and res.namespaced)
+            and get_env_bool("FAKEKUBE_WATCH_FASTPATH", True)
+        )
+
         def stream():
             try:
                 for ev in backlog:
-                    yield self._filter_ns(ev, res, namespace)
+                    yield ev if passthrough \
+                        else self._filter_ns(ev, res, namespace)
                 while not w.closed:
                     try:
                         ev = w.q.get(timeout=timeout if timeout else 0.5)
@@ -1078,7 +1097,8 @@ class FakeKube:
                         if timeout:
                             return
                         continue
-                    yield self._filter_ns(ev, res, namespace)
+                    yield ev if passthrough \
+                        else self._filter_ns(ev, res, namespace)
             finally:
                 cleanup()
 

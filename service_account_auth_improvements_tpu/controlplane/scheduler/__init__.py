@@ -16,6 +16,7 @@ from service_account_auth_improvements_tpu.controlplane.scheduler.inventory impo
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: F401,E501
     Demand,
+    PoolIndex,
     best_fit,
     demand_from,
     feasible,

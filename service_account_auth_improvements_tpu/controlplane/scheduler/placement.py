@@ -14,6 +14,14 @@ fit, it is wrong), then capacity:
 Best-fit minimizes leftover free chips after placement (tightest pool
 first) so large free pools stay whole for large slices; ties break on the
 pool name for determinism.
+
+At fleet scale the shape-first rule is also the index: :class:`PoolIndex`
+buckets pools by slice class once per inventory snapshot, so a sweep
+touches only the pools whose shape can match instead of every pool in
+the fleet — O(pools-of-this-shape) instead of O(pools), same result by
+construction (the bucket predicate IS ``feasible``'s first clause). The
+storm bench (cpbench/storm.py) A/Bs the index against the full sweep;
+``feasible`` remains the one feasibility definition either way.
 """
 
 from __future__ import annotations
@@ -56,27 +64,54 @@ def feasible(pool: SlicePool, used: int, demand: Demand) -> bool:
             and pool.chips_per_host >= demand.total_chips)
 
 
+class PoolIndex:
+    """Pools bucketed by slice class, built once per inventory
+    snapshot. The bucket key duplicates NOTHING: it is exactly the
+    shape clause of :func:`feasible`, so sweeping a bucket and sweeping
+    the whole dict return the same set (capacity is still checked pool
+    by pool). Build it where the snapshot is built — once per
+    scheduling pass, not per queue entry — and pass it to
+    :func:`feasible_pools`/:func:`best_fit`."""
+
+    def __init__(self, pools: dict[str, SlicePool]):
+        by_class: dict[str, list[tuple[str, SlicePool]]] = {}
+        for name, pool in pools.items():
+            key = f"{pool.generation}:{pool.topology}"
+            by_class.setdefault(key, []).append((name, pool))
+        self._by_class = by_class
+
+    def candidates(self, demand: Demand):
+        """(name, pool) pairs whose shape can match ``demand``."""
+        return self._by_class.get(demand.slice_class, ())
+
+
 def feasible_pools(pools: dict[str, SlicePool], used: dict[str, int],
-                   demand: Demand) -> list[str]:
+                   demand: Demand,
+                   index: PoolIndex | None = None) -> list[str]:
     """Names of every pool that could host ``demand`` right now, sorted
     for determinism. This is THE feasibility definition: ``best_fit``
     chooses among these, and the learned policy's infeasibility mask is
     built from exactly this list — a second, diverging definition here
     would be a double-booking factory (a policy scoring a pool best-fit
     would refuse is a policy stamping annotations the inventory can't
-    honor)."""
+    honor). ``index`` narrows the sweep to shape-matched candidates;
+    every candidate still goes through :func:`feasible`, so the index
+    can only skip pools the shape clause would reject anyway."""
+    cands = pools.items() if index is None else index.candidates(demand)
     return sorted(
-        name for name, pool in pools.items()
+        name for name, pool in cands
         if feasible(pool, used.get(name, 0), demand)
     )
 
 
 def best_fit(pools: dict[str, SlicePool], used: dict[str, int],
-             demand: Demand) -> str | None:
+             demand: Demand,
+             index: PoolIndex | None = None) -> str | None:
     """Name of the feasible pool with the least leftover capacity after
     placement, or None when nothing fits."""
     best: tuple[int, str] | None = None
-    for name, pool in pools.items():
+    cands = pools.items() if index is None else index.candidates(demand)
+    for name, pool in cands:
         pool_used = used.get(name, 0)
         if not feasible(pool, pool_used, demand):
             continue

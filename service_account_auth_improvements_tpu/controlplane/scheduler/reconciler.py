@@ -91,6 +91,7 @@ from service_account_auth_improvements_tpu.controlplane.scheduler.inventory impo
     used_chips,
 )
 from service_account_auth_improvements_tpu.controlplane.scheduler.placement import (  # noqa: E501
+    PoolIndex,
     best_fit,
     demand_from,
     feasible_pools,
@@ -674,6 +675,9 @@ class SchedulerReconciler(Reconciler):
         with self._lock:
             pools = pools_from_nodes(self._nodes())
             used = used_chips(self._assigned.values(), pools)
+            # shape index over THIS pass's snapshot: the sweep below
+            # runs once per queue entry, the bucketing once per pass
+            pool_index = PoolIndex(pools)
             budgets: dict[str, int | None] = {}
             live: dict[tuple[str, str], dict] = {}
             for entry in self._queue.ordered():
@@ -703,7 +707,8 @@ class SchedulerReconciler(Reconciler):
                 # serves the pin check, best_fit, and the learned
                 # policy's mask — divergence here is a double-booking
                 # factory
-                feas = feasible_pools(pools, used, entry.demand)
+                feas = feasible_pools(pools, used, entry.demand,
+                                      index=pool_index)
                 policy_attrs: dict = {}
                 if entry.pinned_pool:
                     pool = (entry.pinned_pool
@@ -755,7 +760,8 @@ class SchedulerReconciler(Reconciler):
                                     else self._chooser.abstain_reason),
                             }
                     if pool is None:
-                        pool = best_fit(pools, used, entry.demand)
+                        pool = best_fit(pools, used, entry.demand,
+                                        index=pool_index)
                         policy_attrs.setdefault("policy", "best_fit")
                     if pool is None:
                         self._park(entry, REASON_UNSCHEDULABLE,

@@ -1,0 +1,329 @@
+"""Arrival processes + replica autoscaler (ISSUE 20).
+
+Covers the storm bench's traffic generators (cpbench/arrivals.py —
+MMPP statistics, shape composition, trace round-trip, tenant mix) and
+the coordinator-side autoscaler units (engine/autoscale.py —
+hysteresis, bounds, cooldown, stabilization, missing-evidence hold,
+and the drain-then-leave scale-down ordering whose interleavings the
+schedsim ``autoscale_membership`` model explores).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+import pytest
+
+from service_account_auth_improvements_tpu.controlplane.cpbench import (
+    arrivals,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.autoscale import (  # noqa: E501
+    AUTOSCALE_SCHEMA,
+    AutoscaleConfig,
+    ReplicaAutoscaler,
+    drain_then_leave,
+)
+
+SAT = {"queue_depth_per_worker": 20.0, "busy_ratio": 1.0}
+IDLE = {"queue_depth_per_worker": 0.0, "busy_ratio": 0.0}
+NEUTRAL = {"queue_depth_per_worker": 4.0, "busy_ratio": 0.7}
+
+
+# ------------------------------------------------------------ arrivals
+
+def test_mmpp_is_seed_deterministic():
+    phases = (arrivals.Phase("hot", 50.0, 2.0),
+              arrivals.Phase("cold", 1.0, 2.0))
+    a = arrivals.MMPP(phases, seed=7).offsets(500)
+    b = arrivals.MMPP(phases, seed=7).offsets(500)
+    assert a == b
+    assert a != arrivals.MMPP(phases, seed=8).offsets(500)
+    assert a == sorted(a) and len(a) == 500
+
+
+def test_mmpp_single_phase_is_poisson_with_the_right_mean():
+    # one phase with an effectively infinite dwell: a homogeneous
+    # Poisson process — mean inter-arrival 1/rate, burstiness ~1
+    m = arrivals.MMPP((arrivals.Phase("p", 50.0, 1e9),), seed=3)
+    offs = m.offsets(4000)
+    gaps = arrivals.interarrivals(offs)
+    mean = sum(gaps) / len(gaps)
+    assert math.isclose(mean, 1 / 50.0, rel_tol=0.1)
+    assert 0.85 <= arrivals.burstiness(offs) <= 1.15
+
+
+def test_mmpp_validates_its_phases():
+    with pytest.raises(ValueError):
+        arrivals.MMPP(())
+    with pytest.raises(ValueError):
+        arrivals.MMPP((arrivals.Phase("silent", 0.0, 1.0),))
+    with pytest.raises(ValueError):
+        arrivals.MMPP((arrivals.Phase("bad", 1.0, 0.0),))
+
+
+def test_workshop_storm_is_bursty_where_the_idler_tail_is_not():
+    storm = arrivals.workshop_storm(800, window_s=120.0, seed=1)
+    tail = arrivals.idler_tail(800, span_s=900.0, seed=1)
+    assert arrivals.burstiness(storm) > 1.1
+    assert 0.8 <= arrivals.burstiness(tail) <= 1.2
+
+
+def test_diurnal_tide_concentrates_mid_period():
+    period = 600.0
+    offs = arrivals.diurnal_tide(2000, period_s=period, seed=3,
+                                 floor=0.0)
+    mid = sum(1 for t in offs
+              if 0.25 <= (t % period) / period <= 0.75)
+    # the (1-cos)/2 envelope puts ~82% of arrivals in the middle half;
+    # a uniform drip would put 50%
+    assert mid / len(offs) > 0.7
+
+
+def test_shapes_honor_n_start_and_seed():
+    for fn in (arrivals.workshop_storm, arrivals.diurnal_tide,
+               arrivals.idler_tail):
+        offs = fn(50, seed=2, start_s=100.0)
+        assert len(offs) == 50 and offs == sorted(offs)
+        assert offs[0] >= 100.0
+        assert fn(50, seed=2, start_s=100.0) == offs
+        assert fn(0, seed=2) == []
+
+
+def test_compose_and_rescale():
+    merged = arrivals.compose([3.0, 1.0], [2.0])
+    assert merged == [1.0, 2.0, 3.0]
+    assert arrivals.rescale([5.0, 10.0, 20.0], 30.0) == [0.0, 10.0, 30.0]
+    assert arrivals.rescale([], 30.0) == []
+    assert arrivals.rescale([4.0, 4.0], 30.0) == [0.0, 0.0]
+
+
+def test_tenant_mix_schema_and_proportions():
+    rows = arrivals.tenant_mix(4000, seed=0)
+    assert len(rows) == 4000
+    for row in rows[:10]:
+        assert tuple(row) == arrivals.TENANT_FIELDS
+    share = {p.name: 0 for p in arrivals.DEFAULT_PROFILES}
+    for row in rows:
+        share[row["profile"]] += 1
+    assert math.isclose(share["dabbler"] / 4000, 0.78, abs_tol=0.05)
+    assert math.isclose(share["gang_trainer"] / 4000, 0.05,
+                        abs_tol=0.03)
+    # dabblers dominate by count, gang trainers by chips — the
+    # heterogeneity the mix exists to model
+    chips = {p.name: 0 for p in arrivals.DEFAULT_PROFILES}
+    for row in rows:
+        chips[row["profile"]] += row["total_chips"]
+    assert share["dabbler"] > share["gang_trainer"]
+    assert chips["gang_trainer"] > chips["dabbler"] * 0.5
+    assert arrivals.tenant_mix(4000, seed=0) == rows
+
+
+def test_trace_roundtrip_is_exact(tmp_path):
+    offs = arrivals.compose(
+        arrivals.workshop_storm(60, window_s=30.0, seed=4),
+        arrivals.idler_tail(40, span_s=60.0, seed=5),
+    )
+    plan = arrivals.assign_tenants(offs, arrivals.tenant_mix(16, seed=6),
+                                   seed=7)
+    path = tmp_path / "trace.jsonl"
+    assert arrivals.write_trace(str(path), plan) == 100
+    replayed = arrivals.load_trace(str(path))
+    assert replayed == sorted(plan, key=lambda a: a.offset_s)
+    # byte-determinism: same schedule, same file
+    path2 = tmp_path / "trace2.jsonl"
+    arrivals.write_trace(str(path2), plan)
+    assert path.read_bytes() == path2.read_bytes()
+    for line in path.read_text().splitlines()[:3]:
+        assert json.loads(line)["schema"] == arrivals.TRACE_SCHEMA
+
+
+def test_load_trace_rejects_foreign_schema(tmp_path):
+    path = tmp_path / "bad.jsonl"
+    path.write_text(json.dumps({"schema": "arrivals-trace/v0",
+                                "offset_s": 0.0, "tenant": "t0"}) + "\n")
+    with pytest.raises(ValueError, match="arrivals-trace/v1"):
+        arrivals.load_trace(str(path))
+    with pytest.raises(ValueError):
+        arrivals.assign_tenants([1.0], [])
+
+
+# ---------------------------------------------------------- autoscaler
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def mono(self):
+        return self.t
+
+
+class _Journal:
+    def __init__(self):
+        self.rows = []
+
+    def decide(self, kind, **kw):
+        self.rows.append((kind, kw))
+
+
+def _asc(clock, journal=None, *, count=None, max_replicas=3,
+         cooldown_s=0.0, flap_window_s=0.0, down_consecutive=2):
+    calls = {"up": 0, "down": 0}
+    state = {"n": 1 if count is None else count}
+
+    def up():
+        calls["up"] += 1
+        state["n"] += 1
+
+    def down():
+        calls["down"] += 1
+        state["n"] -= 1
+
+    asc = ReplicaAutoscaler(
+        lambda: state["n"], up, down,
+        AutoscaleConfig(min_replicas=1, max_replicas=max_replicas,
+                        up_consecutive=2,
+                        down_consecutive=down_consecutive,
+                        cooldown_s=cooldown_s,
+                        flap_window_s=flap_window_s),
+        journal=journal, mono_fn=clock.mono,
+    )
+    return asc, calls
+
+
+def test_single_saturated_scrape_never_scales():
+    asc, calls = _asc(_Clock())
+    assert asc.observe(SAT) == "hold"
+    assert asc.observe(NEUTRAL) == "hold"   # neutral resets the streak
+    assert asc.observe(SAT) == "hold"
+    assert calls == {"up": 0, "down": 0}
+
+
+def test_sustained_saturation_scales_up_once_streak_met():
+    asc, calls = _asc(_Clock())
+    assert asc.observe(SAT) == "hold"
+    assert asc.observe(SAT) == "scale_up"
+    assert calls["up"] == 1
+    # the streak resets after an action: one more scrape can't fire
+    assert asc.observe(SAT) == "hold"
+    assert asc.observe(SAT) == "scale_up"
+
+
+def test_missing_evidence_holds_and_resets_streaks():
+    asc, calls = _asc(_Clock())
+    asc.observe(SAT)
+    assert asc.observe(None) == "hold"
+    assert asc.decisions[-1]["state"] == "missing"
+    assert asc.observe({}) == "hold"
+    # the interrupted streak must re-prove itself
+    assert asc.observe(SAT) == "hold"
+    assert calls == {"up": 0, "down": 0}
+
+
+def test_bounds_are_absolute_with_distinct_hold_reason():
+    asc, calls = _asc(_Clock(), count=3, max_replicas=3)
+    asc.observe(SAT)
+    assert asc.observe(SAT) == "hold"
+    assert asc.decisions[-1]["reason"] == "at-max-replicas"
+    asc2, calls2 = _asc(_Clock(), count=1)
+    asc2.observe(IDLE)
+    assert asc2.observe(IDLE) == "hold"
+    assert asc2.decisions[-1]["reason"] == "at-min-replicas"
+    assert calls == {"up": 0, "down": 0}
+    assert calls2 == {"up": 0, "down": 0}
+
+
+def test_cooldown_blocks_back_to_back_actions():
+    clock = _Clock()
+    asc, calls = _asc(clock, cooldown_s=5.0)
+    asc.observe(SAT)
+    assert asc.observe(SAT) == "scale_up"
+    asc.observe(SAT)
+    assert asc.observe(SAT) == "hold"
+    assert asc.decisions[-1]["reason"] == "cooldown"
+    # the streak kept accumulating through the held scrapes: the first
+    # scrape past the cooldown fires
+    clock.t = 6.0
+    assert asc.observe(SAT) == "scale_up"
+    assert calls["up"] == 2
+
+
+def test_stabilization_holds_reversal_inside_flap_window():
+    clock = _Clock()
+    asc, calls = _asc(clock, flap_window_s=10.0)
+    asc.observe(SAT)
+    assert asc.observe(SAT) == "scale_up"
+    # an immediate ebb: the down decision is ready but inside the flap
+    # window — held with the stabilization reason, flap count stays 0
+    asc.observe(IDLE)
+    assert asc.observe(IDLE) == "hold"
+    assert asc.decisions[-1]["reason"] == "stabilization"
+    assert asc.flaps == 0 and calls["down"] == 0
+    # past the window the accumulated idle streak fires legitimately
+    clock.t = 11.0
+    assert asc.observe(IDLE) == "scale_down"
+    assert asc.flaps == 0 and calls["down"] == 1
+
+
+def test_every_decision_journals_the_pinned_schema():
+    journal = _Journal()
+    asc, _ = _asc(_Clock(), journal)
+    asc.observe(SAT)
+    asc.observe(SAT)
+    asc.observe(None)
+    assert len(journal.rows) == 3
+    for kind, kw in journal.rows:
+        assert kind == "autoscale"
+        assert kw["schema"] == AUTOSCALE_SCHEMA
+        assert {"action", "reason", "state", "replicas"} <= set(kw)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(up_consecutive=1)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(up_consecutive=4, down_consecutive=3)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(depth_low=9.0, depth_high=8.0)
+
+
+def test_drain_then_leave_orders_drain_before_leave():
+    clock = _Clock()
+    events = []
+
+    def sleep(s):
+        clock.t += s
+        events.append("poll")
+
+    ok = drain_then_leave(
+        lambda: clock.t >= 0.2, lambda: events.append("leave"),
+        timeout_s=5.0, poll_s=0.1, sleep_fn=sleep, mono_fn=clock.mono,
+    )
+    assert ok
+    assert events == ["poll", "poll", "leave"]
+
+
+def test_drain_timeout_still_leaves():
+    # a wedged worker must not pin membership forever: the drain gives
+    # up at the deadline but the leave STILL happens (the shard
+    # protocol's barrier ack is the second line of defense)
+    clock = _Clock()
+    events = []
+
+    def sleep(s):
+        clock.t += s
+
+    ok = drain_then_leave(
+        lambda: False, lambda: events.append("leave"),
+        timeout_s=0.3, poll_s=0.1, sleep_fn=sleep, mono_fn=clock.mono,
+    )
+    assert not ok
+    assert events == ["leave"]
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

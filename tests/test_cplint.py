@@ -77,6 +77,7 @@ def test_cli_exits_zero_and_writes_report(tmp_path):
         "lock-discipline", "cache-mutation", "queue-span", "rbac-check",
         "clock-injection", "metrics", "event-reason",
         "blocking-under-lock", "check-then-act", "mvcc-escape",
+        "autoscale-journal",
     }
 
 

@@ -350,3 +350,55 @@ def test_orphan_create_is_garbage_collected(kube):
     }, group="apps")
     assert kube.get("statefulsets", "uidless", namespace="user1",
                     group="apps")
+
+
+def test_cluster_wide_fanout_shares_one_object_across_watchers(kube):
+    """The fanout COW contract (docs/fakekube.md): _emit_locked does no
+    per-event deepcopy, so every cluster-wide watcher receives THE
+    stored immutable object — zero per-watcher allocations on the
+    fanout hot path (the storm bench's 1M-event regime rides on this).
+    Identity across two watchers is the regression tripwire: any
+    reintroduced per-event copy breaks `is`."""
+    w1 = kube.watch("notebooks", resource_version=0, timeout=0.2)
+    w2 = kube.watch("notebooks", resource_version=0, timeout=0.2)
+    kube.create("notebooks", _nb())
+    e1, e2 = next(iter(w1)), next(iter(w2))
+    assert e1["type"] == e2["type"] == "ADDED"
+    assert e1["object"] is e2["object"]
+
+
+def test_watch_fastpath_off_still_filters_foreign_namespace(
+        kube, monkeypatch):
+    """FAKEKUBE_WATCH_FASTPATH=0 (the storm bench's A/B baseline arm)
+    keeps the per-event filter: a namespaced watcher sees foreign-
+    namespace events as RV-only BOOKMARKs, never the object."""
+    monkeypatch.setenv("FAKEKUBE_WATCH_FASTPATH", "0")
+    events = []
+    w = kube.watch("notebooks", namespace="user1", resource_version=0,
+                   timeout=0.2)
+    kube.create("notebooks", _nb("mine", "user1"))
+    kube.create("notebooks", _nb("theirs", "user2"))
+    events = list(w)
+    assert [e["type"] for e in events] == ["ADDED", "BOOKMARK"]
+    assert events[0]["object"]["metadata"]["name"] == "mine"
+    assert set(events[1]["object"]) == {"metadata"}
+    assert set(events[1]["object"]["metadata"]) == {"resourceVersion"}
+
+
+def test_watch_fastpath_is_namespace_safe_and_ab_equivalent(
+        kube, monkeypatch):
+    """The fast path only ever skips the filter for cluster-wide
+    watchers (where it is the identity): a namespaced watcher under
+    FASTPATH=1 still gets BOOKMARKs for foreign events, and the
+    cluster-wide stream is event-for-event identical across the A/B
+    lever — skipping the no-op call must change nothing observable."""
+    monkeypatch.setenv("FAKEKUBE_WATCH_FASTPATH", "1")
+    w_ns = kube.watch("notebooks", namespace="user1",
+                      resource_version=0, timeout=0.2)
+    w_fast = kube.watch("notebooks", resource_version=0, timeout=0.2)
+    monkeypatch.setenv("FAKEKUBE_WATCH_FASTPATH", "0")
+    w_slow = kube.watch("notebooks", resource_version=0, timeout=0.2)
+    kube.create("notebooks", _nb("mine", "user1"))
+    kube.create("notebooks", _nb("theirs", "user2"))
+    assert [e["type"] for e in w_ns] == ["ADDED", "BOOKMARK"]
+    assert list(w_fast) == list(w_slow)

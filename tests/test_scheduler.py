@@ -8,6 +8,7 @@ queued-forever (flag off), restart recovery, and the 100-notebooks-vs-4-
 slices scale test asserting serialized placement with no double-booking.
 """
 
+import random
 import time
 
 import pytest
@@ -31,11 +32,14 @@ from service_account_auth_improvements_tpu.controlplane.kube import (
 from service_account_auth_improvements_tpu.controlplane.scheduler import (
     CONDITION_SCHEDULED,
     PRIORITY_ANNOTATION,
+    Demand,
+    PoolIndex,
     SchedulerReconciler,
     SlicePool,
     best_fit,
     demand_from,
     feasible,
+    feasible_pools,
     pools_from_nodes,
 )
 
@@ -896,3 +900,42 @@ def test_preempted_victim_is_not_readopted_mid_teardown():
     assert _pool_of(kube, "vip") == "pool-a"
     cond = _sched_cond(kube, "victim")
     assert cond["status"] == "False", "victim queues behind the vip"
+
+
+# ------------------------------------------ PoolIndex / full-sweep parity
+
+
+def test_pool_index_matches_full_sweep_on_random_inventories():
+    # The index is a pure pruning structure: for any inventory, usage
+    # map, and demand, feasible_pools/best_fit must return the same
+    # answer with and without it (storm_scale A/Bs the timing; this
+    # pins the semantics).
+    rng = random.Random(20)
+    gens = ("v4", "v5e", "v5p")
+    topos = ("1x1", "2x2", "4x4", "2x2x4")
+    for _ in range(50):
+        pools = {}
+        used = {}
+        for i in range(rng.randrange(1, 12)):
+            name = f"pool-{i}"
+            hosts = rng.choice((1, 1, 2, 4))
+            pools[name] = SlicePool(
+                name, rng.choice(gens), rng.choice(topos),
+                num_hosts=hosts,
+                chips_per_host=rng.choice((4, 8, 16)),
+            )
+            if rng.random() < 0.6:
+                used[name] = rng.randrange(0, pools[name].total_chips + 1)
+        index = PoolIndex(pools)
+        for _ in range(20):
+            hosts = rng.choice((1, 1, 1, 2, 4, 8))
+            d = Demand(rng.choice(gens), rng.choice(topos),
+                       total_chips=rng.choice((1, 4, 8, 16, 64)),
+                       num_hosts=hosts)
+            full = feasible_pools(pools, used, d)
+            assert feasible_pools(pools, used, d, index=index) == full
+            assert (best_fit(pools, used, d, index=index)
+                    == best_fit(pools, used, d))
+            # the index may only ever skip pools `feasible` rejects
+            for name in full:
+                assert feasible(pools[name], used.get(name, 0), d)

@@ -2,6 +2,7 @@
 / run(ctx)."""
 
 from tools.cplint.passes import (
+    autoscale_journal,
     blocking_under_lock,
     cache_mutation,
     check_then_act,
@@ -25,4 +26,5 @@ ALL_PASSES = (
     blocking_under_lock,
     check_then_act,
     mvcc_escape,
+    autoscale_journal,
 )

@@ -1,0 +1,128 @@
+"""autoscale-journal: autoscaler decisions journal a pinned schema row.
+
+Every ``journal.decide("autoscale", ...)`` call in the controlplane
+scope must carry ``schema="autoscale/v1"`` — as a keyword whose value
+is the literal string or a Name resolving to a module-level constant
+holding it (``AUTOSCALE_SCHEMA`` in engine/autoscale.py is the one
+definition).
+
+Why a lint rule and not a runtime check: the decision journal is a
+TRAINING surface (the sched-journal/v1 precedent — schedpolicy trains
+on placement rows). An autoscale row without a pinned schema is
+unharvestable the day someone builds on it, and the writer is the only
+place the pin can be enforced before rows exist. The bench's
+``--storm`` gate proves rows are written; this pass proves every
+writer pins them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.cplint.core import CONTROLPLANE
+
+NAME = "autoscale-journal"
+DESCRIPTION = (
+    "journal.decide(\"autoscale\", ...) must pin schema=\"autoscale/v1\""
+    " — decision rows are a harvest surface, unversioned rows are "
+    "unharvestable"
+)
+
+SCOPE = CONTROLPLANE
+
+AUTOSCALE_KIND = "autoscale"
+AUTOSCALE_SCHEMA = "autoscale/v1"
+
+
+def run(ctx) -> list:
+    findings = []
+    for path in ctx.files(*SCOPE):
+        parsed = ctx.parse(path)
+        if parsed is None:
+            continue
+        tree, _ = parsed
+        findings.extend(_check_module(ctx, path, tree))
+    return findings
+
+
+def _module_str_constants(tree: ast.AST) -> dict:
+    """{name: value} for every module-level string assignment."""
+    out: dict = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str) \
+                and isinstance(node.target, ast.Name):
+            out[node.target.id] = node.value.value
+    return out
+
+
+def _is_autoscale_decide(node: ast.Call) -> bool:
+    """``<anything>.decide("autoscale", ...)`` — kind is the first
+    positional argument by the Journal.decide contract; a dynamic kind
+    that happens to equal "autoscale" at runtime is out of reach, but
+    the constant-kind idiom is what the codebase writes."""
+    fn = node.func
+    if not isinstance(fn, ast.Attribute) or fn.attr != "decide":
+        return False
+    return bool(node.args) and isinstance(node.args[0], ast.Constant) \
+        and node.args[0].value == AUTOSCALE_KIND
+
+
+def _check_module(ctx, path, tree) -> list:
+    findings = []
+    constants = _module_str_constants(tree)
+    # names imported from engine.autoscale resolve to the one pinned
+    # value — `from ...autoscale import AUTOSCALE_SCHEMA` is the idiom
+    for node in tree.body:
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.rsplit(".", 1)[-1] == "autoscale":
+            for alias in node.names:
+                if alias.name == "AUTOSCALE_SCHEMA":
+                    constants[alias.asname
+                              or alias.name] = AUTOSCALE_SCHEMA
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) \
+                or not _is_autoscale_decide(node):
+            continue
+        schema = None
+        for kw in node.keywords:
+            if kw.arg == "schema":
+                schema = kw.value
+        if schema is None:
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                "autoscale decision journaled without schema= — pin "
+                f"schema={AUTOSCALE_SCHEMA!r} (engine/autoscale.py "
+                "AUTOSCALE_SCHEMA) so the rows stay harvestable",
+            ))
+        elif isinstance(schema, ast.Constant):
+            if schema.value != AUTOSCALE_SCHEMA:
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"autoscale decision pins schema={schema.value!r}, "
+                    f"want {AUTOSCALE_SCHEMA!r} — one schema, one "
+                    "definition (engine/autoscale.py)",
+                ))
+        elif isinstance(schema, ast.Name):
+            value = constants.get(schema.id)
+            if value is not None and value != AUTOSCALE_SCHEMA:
+                findings.append(ctx.finding(
+                    NAME, path, node.lineno,
+                    f"autoscale decision pins schema via {schema.id} = "
+                    f"{value!r}, want {AUTOSCALE_SCHEMA!r}",
+                ))
+        else:
+            findings.append(ctx.finding(
+                NAME, path, node.lineno,
+                "autoscale decision schema= is a dynamic expression — "
+                f"use the literal {AUTOSCALE_SCHEMA!r} or the "
+                "AUTOSCALE_SCHEMA constant",
+            ))
+    return findings

@@ -7,9 +7,12 @@ gap: it runs *small-scope models* of the consensus-critical code — the
 cpshard handoff ack-barrier (engine/shard.py), leader-election expiry
 under skew (engine/leaderelection.py), FakeKube's MVCC optimistic
 commits (kube/fake.py), the workqueue get→done contract
-(engine/queue.py), and the park→release→resume→re-admit protocol
+(engine/queue.py), the park→release→resume→re-admit protocol
 (controlplane/parking + controllers/culling.py, driven against the
-real CullingReconciler) — under a **cooperative scheduler** that serializes
+real CullingReconciler), and the autoscaler's scale-down
+drain-then-leave ordering racing a shard handoff
+(engine/autoscale.py, driven through the real ReplicaAutoscaler) —
+under a **cooperative scheduler** that serializes
 the model's threads at instrumented sync points and *enumerates* their
 interleavings:
 
@@ -34,14 +37,15 @@ interleavings:
   (the exact choice list) as JSON; ``--replay`` re-runs that exact
   interleaving, and tests/test_schedsim.py replays dumps as failing
   tests.
-- **mutation validation** (``--mutations``): ~13 hand-seeded protocol
+- **mutation validation** (``--mutations``): ~14 hand-seeded protocol
   bugs (drop the ack barrier, ack before drain, skip self-fence,
   activate through a stale post-fence map, ignore lease skew bounds,
   steal held leases, drop the MVCC commit identity check, emit DELETED
   at the stale RV, drop the dirty re-add, skip processing
   registration, stop a parking notebook before its checkpoint commits,
   stamp a never-committed checkpoint ref, drop the resume-wins park
-  cancellation) each applied as a runtime patch; every one must be
+  cancellation, leave the membership before the scale-down drain)
+  each applied as a runtime patch; every one must be
   caught by the explorer within the CI budget, and clean HEAD must
   explore violation-free. A checker that cannot catch a seeded
   regression of a bug this repo already fixed once guards nothing.
@@ -89,6 +93,13 @@ from service_account_auth_improvements_tpu.controlplane.controllers.notebook imp
 from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
     Request,
     Result,
+)
+from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
+    autoscale as autoscale_mod,
+)
+from service_account_auth_improvements_tpu.controlplane.engine.autoscale import (  # noqa: E402,E501
+    AutoscaleConfig,
+    ReplicaAutoscaler,
 )
 from service_account_auth_improvements_tpu.controlplane.engine import (  # noqa: E402,E501
     leaderelection,
@@ -1046,6 +1057,160 @@ class ShardFenceModel:
             raise Violation("; ".join(self.ledger.violations))
 
 
+class AutoscaleMembershipModel:
+    """Scale-down membership decision racing a shard handoff: the REAL
+    ReplicaAutoscaler observes a sustained-idle fleet and fires
+    scale_down, whose ordering contract is drain_then_leave — the
+    victim's in-flight reconciles drain BEFORE the member leave that
+    re-maps its shards. B owns everything under epoch 1 while the
+    survivor A idles as a fresh member; the leave stops B (admit goes
+    FOREIGN), deletes its member Lease, and publishes epoch 2 giving A
+    the world; A's tick loop activates the gained shards (a departed
+    member owes no barrier ack). The ledger catches the window a
+    leave-without-drain opens: B suspended mid-reconcile while A
+    activates and reconciles the same key."""
+
+    name = "autoscale_membership"
+    max_decisions = 1500
+    preemption_bound = 2
+    budget = 300
+
+    NUM_SHARDS = 2
+
+    def __init__(self):
+        self.kube = FakeKube()
+        self.clock = VClock()
+        self.ledger = Ledger()
+        self.group = "sims"
+        self.left = False
+        self.published = False
+        jnl = Journal()
+
+        def mk(ident):
+            return ShardMember(
+                self.kube, ident, group=self.group,
+                num_shards=self.NUM_SHARDS, lease_duration=600.0,
+                tick_period=0.01, journal=jnl,
+                now_fn=self.clock.now, mono_fn=self.clock.mono,
+            )
+
+        self.a = mk("A")
+        self.b = mk("B")
+        self.key = _key_in_shard(0, self.NUM_SHARDS)
+        # setup (unscheduled, deterministic): epoch 1 gives B
+        # everything; A is a live member holding nothing — the replica
+        # the scale-down leaves behind
+        _write_map(self.kube, self.group, 1, {0: "B", 1: "B"}, ["B"],
+                   self.NUM_SHARDS)
+        self.b._heartbeat()
+        self.b._read_map()
+        self.b._check_barrier()
+        self.b._check_ack()
+        assert self.b.admit(*self.key) == OWN
+        self.a._heartbeat()
+        self.a._read_map()
+        self.a._check_ack()
+        self._drained = lambda: not self.ledger.busy("B")
+        self.asc = ReplicaAutoscaler(
+            lambda: 1 if self.left else 2,
+            lambda: None,   # the idle feed can never scale up
+            self._scale_down,
+            AutoscaleConfig(min_replicas=1, max_replicas=2,
+                            up_consecutive=2, down_consecutive=2,
+                            cooldown_s=0.0),
+            journal=jnl, mono_fn=self.clock.mono,
+        )
+
+    yield_on = staticmethod(_yield_on_sync)
+
+    def _scale_down(self):
+        # the production ordering contract under test — the mutant
+        # patches the MODULE function to leave without draining, so the
+        # call must go through the module attribute
+        step("scaledown")
+        autoscale_mod.drain_then_leave(
+            self._drained, self._leave, timeout_s=600.0,
+            sleep_fn=lambda _s: wait_until(self._drained,
+                                           label="drained"),
+            mono_fn=self.clock.mono,
+        )
+
+    def _leave(self):
+        step("leave")
+        self.left = True
+        # the production leave: stop() clears B's active set and
+        # deletes the member Lease, so A's barrier owes the departed
+        # member no ack
+        self.b.stop()
+        _write_map(self.kube, self.group, 2, {0: "A", 1: "A"}, ["A"],
+                   self.NUM_SHARDS)
+        self.published = True
+
+    def _autoscaler(self):
+        idle = {"queue_depth_per_worker": 0.0, "busy_ratio": 0.0}
+        for _ in range(3):
+            step("observe")
+            if self.asc.observe(idle) == "scale_down":
+                return
+
+    def _b_reconcile(self):
+        for _ in range(2):
+            if self.left:
+                step("reconcile.stopped", "B")
+                return
+            if self.b.admit(*self.key) == OWN:
+                self.ledger.enter("B", 0)
+                step("reconcile", self.key)
+                self.ledger.exit("B", 0)
+            else:
+                step("reconcile.skip", "B")
+
+    def _a_ticks(self):
+        # gated on the epoch-2 publish (the ShardFenceModel phase-gate
+        # idiom): the survivor's finite ticks must not be burned before
+        # the window they exist to explore
+        wait_until(lambda: self.published, label="epoch2")
+        for _ in range(3):
+            self.a._heartbeat()
+            self.a._read_map()
+            self.a._check_barrier()
+            self.a._check_ack()
+
+    def _a_reconcile(self):
+        wait_until(lambda: self.published, label="epoch2")
+        for _ in range(2):
+            if self.a.admit(*self.key) == OWN:
+                self.ledger.enter("A", 0)
+                step("reconcile", self.key)
+                self.ledger.exit("A", 0)
+            else:
+                step("reconcile.skip", "A")
+
+    def threads(self):
+        return [
+            ("B.rec", self._b_reconcile),
+            ("AS", self._autoscaler),
+            ("A.tick", self._a_ticks),
+            ("A.rec", self._a_reconcile),
+        ]
+
+    def check(self):
+        if self.ledger.violations:
+            raise Violation("; ".join(self.ledger.violations))
+
+    def progress(self):
+        if not self.left:
+            raise Violation(
+                "the sustained-idle fleet never scaled down under a "
+                "fair schedule"
+            )
+        if self.a.admit(*self.key) != OWN:
+            raise Violation(
+                "scale-down handoff wedged: the survivor never "
+                "activated the departed replica's shard"
+            )
+
+
 class LeaseExpiryModel:
     """Two candidates with skewed clocks racing acquire/renew around an
     expiry: every successful takeover must be *legal* under the
@@ -1676,9 +1841,9 @@ class LockOrderedModel(LockInversionModel):
 #: violation-free within the CI budget
 MODELS: dict = {
     m.name: m for m in (
-        ShardHandoffModel, ShardFenceModel, LeaseExpiryModel,
-        LeaseRaceModel, MvccUpdateModel, QueueGetDoneModel,
-        ParkResumeModel,
+        ShardHandoffModel, ShardFenceModel, AutoscaleMembershipModel,
+        LeaseExpiryModel, LeaseRaceModel, MvccUpdateModel,
+        QueueGetDoneModel, ParkResumeModel,
     )
 }
 
@@ -1934,6 +2099,14 @@ def _mut_resume_keeps_park_request(self, req, nb, annots, period):
     return Result(requeue_after=period.total_seconds())
 
 
+def _mut_leave_without_drain(drained_fn, leave_fn, **kw):
+    # seeded bug: the scale-down ordering contract inverted — the
+    # member leaves (re-mapping its shards) while its reconciles are
+    # still in flight
+    leave_fn()
+    return True
+
+
 class Mutant:
     def __init__(self, name: str, models: tuple, apply_cm,
                  description: str):
@@ -2008,6 +2181,12 @@ MUTANTS: dict = {
                "the resume finisher no longer cancels an in-flight "
                "park request — the next culler pass re-parks a "
                "just-resumed notebook"),
+        Mutant("autoscale-leave-without-drain", ("autoscale_membership",),
+               _patched(autoscale_mod, "drain_then_leave",
+                        _mut_leave_without_drain),
+               "scale-down leaves the membership before the victim's "
+               "reconciles drain — the dual-reconcile window "
+               "drain_then_leave exists to close"),
     )
 }
 
